@@ -1,0 +1,48 @@
+"""paddle_tpu: a TPU-native deep learning framework.
+
+A from-scratch rebuild of the capabilities of PaddlePaddle Fluid (~1.3,
+reference at /root/reference) designed TPU-first:
+
+- declarative Program IR in Python (framework.py), lowered whole-program to
+  XLA via JAX tracing (core/lowering.py) — no per-op interpreter;
+- autodiff by JAX reverse-mode AD behind the reference append_backward API;
+- data/model parallelism via jax.sharding Mesh + SPMD partitioner (parallel/)
+  instead of NCCL op-handles and transpilers;
+- ragged sequences via segment ids (ragged in stage 6) instead of LoD;
+- host-side input pipeline (reader/) instead of reader ops.
+"""
+import os
+
+# Make CPU test meshes deterministic and deadlock-free before jax import.
+os.environ.setdefault('XLA_FLAGS', '')
+
+from . import core
+from . import ops  # registers all op lowerings
+from . import framework
+from .framework import (Program, Block, Operator, Variable, Parameter,
+                        default_main_program, default_startup_program,
+                        program_guard, CPUPlace, TPUPlace, CUDAPlace,
+                        cpu_places, tpu_places, cuda_places)
+from .executor import Executor, Scope, global_scope, scope_guard
+from .backward import append_backward, calc_gradient, gradients
+from . import layers
+from . import initializer
+from . import optimizer
+from . import regularizer
+from . import clip
+from . import unique_name
+from .param_attr import ParamAttr, WeightNormParamAttr
+from . import io
+from .io import (save_vars, save_params, save_persistables, load_vars,
+                 load_params, load_persistables, save_inference_model,
+                 load_inference_model)
+from . import nets
+from . import metrics
+from . import profiler
+from .data_feeder import DataFeeder
+from . import compiler
+from .compiler import CompiledProgram
+from .parallel_executor import ParallelExecutor
+from .parallel_executor import ExecutionStrategy, BuildStrategy
+
+__version__ = '0.1.0'

@@ -1,0 +1,91 @@
+"""Program-level autodiff API.
+
+Capability parity with reference python/paddle/fluid/backward.py
+(append_backward:394, calc_gradient:613). TPU-native redesign: instead of
+rewriting the program with per-op grad descs (reference
+_append_backward_ops_:252 calling C++ grad makers via core.get_grad_op_desc),
+we append ONE `backward` meta op. At lowering time core/lowering.py runs the
+forward segment under jax.vjp, so JAX reverse-mode AD produces all gradients —
+grad de-dup (reference _addup_repetitive_outputs_:135), no-grad pruning
+(_remove_no_grad_branch_:204) and stop_gradient semantics come for free from
+the AD system and stop_gradient wrapping in the lowering.
+"""
+from .framework import (Program, Parameter, Variable, grad_var_name,
+                        default_main_program)
+
+__all__ = ['append_backward', 'calc_gradient', 'gradients']
+
+
+def _resolve_no_grad(no_grad_set):
+    out = set()
+    for item in (no_grad_set or []):
+        out.add(item.name if isinstance(item, Variable) else item)
+    return out
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, checkpoints=None):
+    """Append gradient computation for `loss` w.r.t. trainable parameters.
+
+    Returns list of (parameter, gradient_variable) pairs, like the reference.
+    """
+    program = loss.block.program
+    block = program.global_block()
+    no_grad = _resolve_no_grad(no_grad_set)
+
+    if parameter_list:
+        params = []
+        for p in parameter_list:
+            params.append(block.var(p) if isinstance(p, str) else p)
+    else:
+        params = [p for p in program.all_parameters()
+                  if getattr(p, 'trainable', True)]
+    params = [p for p in params if p.name not in no_grad]
+    if not params:
+        raise ValueError("append_backward: no trainable parameters found")
+
+    grad_vars = []
+    for p in params:
+        g = block.create_var(
+            name=grad_var_name(p.name), shape=p.shape, dtype=p.dtype,
+            persistable=False, stop_gradient=False)
+        grad_vars.append(g)
+
+    attrs = {'wrt_names': [p.name for p in params]}
+    if checkpoints:
+        attrs['checkpoints'] = [c.name if isinstance(c, Variable) else c
+                                for c in checkpoints]
+    block.append_op(
+        type='backward',
+        inputs={'Loss': [loss]},
+        outputs={'Grads': grad_vars},
+        attrs=attrs)
+    return list(zip(params, grad_vars))
+
+
+def calc_gradient(targets, inputs, target_gradients=None, no_grad_set=None):
+    """Gradients of `targets` w.r.t. arbitrary leaf `inputs`
+    (reference backward.py:613). Inputs must be fed/parameter leaves."""
+    if not isinstance(targets, (list, tuple)):
+        targets = [targets]
+    if not isinstance(inputs, (list, tuple)):
+        inputs = [inputs]
+    loss = targets[0]
+    block = loss.block
+    no_grad = _resolve_no_grad(no_grad_set)
+    wrt = [i for i in inputs if i.name not in no_grad]
+    grad_vars = []
+    for v in wrt:
+        g = block.create_var(
+            name=grad_var_name(v.name), shape=v.shape, dtype=v.dtype,
+            persistable=False, stop_gradient=False)
+        grad_vars.append(g)
+    block.append_op(
+        type='backward',
+        inputs={'Loss': [loss]},
+        outputs={'Grads': grad_vars},
+        attrs={'wrt_names': [v.name for v in wrt]})
+    return grad_vars
+
+
+gradients = calc_gradient

@@ -1,0 +1,97 @@
+"""CompiledProgram: execution-strategy wrapper over a Program.
+
+Reference python/paddle/fluid/compiler.py:37 CompiledProgram +
+with_data_parallel:77 (which wraps the C++ ParallelExecutor,
+parallel_executor.cc:184). TPU-native redesign: data parallelism is SPMD —
+the SAME compiled XLA program runs over a jax.sharding.Mesh with the batch
+dimension sharded; gradient allreduce (psum over ICI) is inserted by the XLA
+SPMD partitioner, replacing the whole OpHandle/NCCL machinery. See
+parallel/spmd.py for the execution path.
+"""
+from .framework import default_main_program
+
+__all__ = ['CompiledProgram', 'ExecutionStrategy', 'BuildStrategy']
+
+
+class ExecutionStrategy(object):
+    """Knobs of reference details/execution_strategy.h:22 — mostly no-ops
+    under XLA (scheduling is the compiler's job), kept for API parity."""
+
+    def __init__(self):
+        self.num_threads = 0
+        self.allow_op_delay = False
+        self.num_iteration_per_drop_scope = 1
+        self.use_experimental_executor = False
+
+
+class BuildStrategy(object):
+    """Reference details/build_strategy.h:34-96. On TPU:
+    - reduce_strategy AllReduce vs Reduce → psum vs reduce_scatter grads
+    - memory_optimize/inplace → XLA buffer assignment + donation (always on)
+    - fuse_* → XLA fusion (always on)
+    """
+
+    class ReduceStrategy(object):
+        AllReduce = 0
+        Reduce = 1
+
+    class GradientScaleStrategy(object):
+        CoeffNumDevice = 0
+        One = 1
+        Customized = 2
+
+    def __init__(self):
+        self.reduce_strategy = BuildStrategy.ReduceStrategy.AllReduce
+        self.gradient_scale_strategy = \
+            BuildStrategy.GradientScaleStrategy.CoeffNumDevice
+        self.debug_graphviz_path = ""
+        self.enable_sequential_execution = False
+        self.fuse_elewise_add_act_ops = False
+        self.fuse_relu_depthwise_conv = False
+        self.fuse_broadcast_ops = False
+        self.fuse_all_optimizer_ops = False
+        self.memory_optimize = False
+        self.enable_inplace = False
+        self.num_trainers = 1
+        self.trainer_id = 0
+
+
+class CompiledProgram(object):
+    def __init__(self, program=None):
+        self._program = program if program is not None \
+            else default_main_program()
+        self._is_data_parallel = False
+        self._loss_name = None
+        self._build_strategy = None
+        self._exec_strategy = None
+        self._share_vars_from = None
+        self._places = None
+        self._spmd = None
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, share_vars_from=None,
+                           places=None):
+        self._is_data_parallel = True
+        self._loss_name = loss_name
+        self._build_strategy = build_strategy or BuildStrategy()
+        self._exec_strategy = exec_strategy or ExecutionStrategy()
+        self._share_vars_from = share_vars_from
+        self._places = places
+        return self
+
+    def with_inference_optimize(self, config=None):
+        return self
+
+    # duck-typed hook called by Executor.run
+    def _executor_run(self, executor, feed, fetch_list, scope, return_numpy):
+        if not self._is_data_parallel:
+            return executor.run(self._program, feed=feed,
+                                fetch_list=fetch_list, scope=scope,
+                                return_numpy=return_numpy)
+        from .parallel import spmd
+        if self._spmd is None:
+            self._spmd = spmd.DataParallelRunner(
+                self._program, loss_name=self._loss_name,
+                build_strategy=self._build_strategy, places=self._places)
+        return self._spmd.run(executor, feed, fetch_list, scope,
+                              return_numpy)

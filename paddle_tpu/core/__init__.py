@@ -1,0 +1,4 @@
+from . import types
+from .types import VarType, convert_np_dtype_to_dtype_, dtype_to_np
+from .registry import OpRegistry, register_op, get_op, has_op, all_ops
+from . import lowering

@@ -1,0 +1,225 @@
+"""Whole-program lowering: Program IR -> one jax-traced, XLA-compiled callable.
+
+This module replaces three reference subsystems at once, the TPU-idiomatic way:
+
+- the serial Executor's interpret loop (reference framework/executor.cc:432-440
+  `for op in ops: op->Run(scope, place)`) -> a single traced function compiled
+  once by XLA; feed/fetch become function inputs/outputs;
+- per-op kernel dispatch (reference framework/operator.cc:907-960) -> each op's
+  registered `lower` emits jax/lax ops into the trace; XLA fuses and schedules
+  (subsuming the ir-pass fusions of reference framework/ir/*fuse_pass*);
+- desc-level autodiff (reference python backward.py:394 append_backward calling
+  C++ grad-op makers) -> the meta op `backward` runs the forward segment inside
+  jax.vjp, so gradients are computed by JAX reverse-mode AD with XLA-optimal
+  rematerialization, not by stitching grad-op descs.
+
+Random ops draw keys deterministically from a per-run base key folded with the
+op's index, so replaying a segment inside the vjp closure sees identical
+randomness (dropout masks match between forward env and grad closure).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import get_op
+
+
+class LowerContext(object):
+    """Mutable environment while tracing one block: var name -> jax value."""
+
+    def __init__(self, program, block, env, base_key, wrt=(), params=None):
+        self.program = program
+        self.block = block
+        self.env = env
+        self.base_key = base_key
+        self.op_index = 0
+        self.wrt = set(wrt)
+        # extra knobs lowerings may consult
+        self.params = params or {}
+
+    # ---- reading inputs --------------------------------------------------
+    def has(self, name):
+        return name in self.env
+
+    def get(self, name):
+        try:
+            return self.env[name]
+        except KeyError:
+            raise KeyError(
+                "variable %r used before definition while lowering op #%d "
+                "(%s) — is it fed / initialized?" %
+                (name, self.op_index, self.block.ops[self.op_index].type))
+
+    def in1(self, op, slot, default=None):
+        names = op.input(slot)
+        if not names:
+            return default
+        return self.get(names[0])
+
+    def in_list(self, op, slot):
+        return [self.get(n) for n in op.input(slot)]
+
+    # ---- writing outputs -------------------------------------------------
+    def set(self, name, value):
+        var = self.block._find_var_recursive(name)
+        if var is not None and var.stop_gradient and name not in self.wrt:
+            value = lax.stop_gradient(value)
+        self.env[name] = value
+
+    def out(self, op, slot, value, idx=0):
+        names = op.output(slot)
+        if not names:
+            return
+        self.set(names[idx], value)
+
+    def var(self, name):
+        return self.block._find_var_recursive(name)
+
+    # ---- rng -------------------------------------------------------------
+    def rng(self):
+        key = jax.random.fold_in(self.base_key, self.op_index)
+        seed = self.program.random_seed
+        if seed:
+            key = jax.random.fold_in(key, seed)
+        return key
+
+    def child(self, env, wrt=None):
+        c = LowerContext(self.program, self.block, env, self.base_key,
+                         wrt=self.wrt if wrt is None else wrt,
+                         params=self.params)
+        return c
+
+
+def lower_ops(ctx, ops, lo, hi):
+    for i in range(lo, hi):
+        ctx.op_index = i
+        op = ops[i]
+        get_op(op.type).lower(ctx, op)
+
+
+def lower_block(ctx, lo=0):
+    """Lower ops [lo:] of ctx.block, handling `backward` meta ops.
+
+    When a `backward` op is found at index b, ops [lo:b] are lowered inside a
+    jax.vjp closure (so forward activations are traced exactly once, and JAX
+    AD produces the gradients); the resulting env replaces ctx.env and
+    lowering continues after the backward op (optimizer ops etc.).
+    """
+    ops = ctx.block.ops
+    b = next((i for i in range(lo, len(ops)) if ops[i].type == 'backward'),
+             None)
+    if b is None:
+        lower_ops(ctx, ops, lo, len(ops))
+        return
+
+    bop = ops[b]
+    loss_name = bop.input('Loss')[0]
+    wrt_names = list(bop.attr('wrt_names'))
+    base_env = dict(ctx.env)
+
+    missing = [n for n in wrt_names if n not in base_env]
+    if missing:
+        raise ValueError(
+            "backward: cannot differentiate w.r.t. %s — they are neither fed "
+            "nor in scope state (only leaf variables are supported)" % missing)
+
+    def fwd(wrt_vals):
+        env2 = dict(base_env)
+        env2.update(wrt_vals)
+        sub = ctx.child(env2, wrt=set(wrt_names))
+        lower_ops(sub, ops, lo, b)
+        return env2[loss_name], env2
+
+    wrt_vals = {n: base_env[n] for n in wrt_names}
+    (loss_val, env2), pullback = _vjp_with_aux(fwd, wrt_vals)
+    grads = pullback(jnp.ones_like(loss_val))
+
+    ctx.env = env2
+    from ..framework import grad_var_name
+    grad_outs = bop.output('Grads')
+    for i, n in enumerate(wrt_names):
+        gname = grad_outs[i] if i < len(grad_outs) else grad_var_name(n)
+        g = grads[n]
+        ctx.env[gname] = g
+    lower_block(ctx, b + 1)
+
+
+def _vjp_with_aux(f, primal):
+    out, vjp_fn, aux = jax.vjp(f, primal, has_aux=True)
+    def pullback(ct):
+        return vjp_fn(ct)[0]
+    return (out, aux), pullback
+
+
+# ---------------------------------------------------------------------------
+# Program-level compilation
+# ---------------------------------------------------------------------------
+
+def analyze_state(program, fetch_names=()):
+    """Statically determine which persistable vars a program reads / writes.
+
+    Read persistables must be supplied from the Scope; written persistables
+    are returned as new state (the TPU equivalent of ops mutating Variables in
+    a reference Scope, framework/scope.h:48)."""
+    read, written = [], []
+    read_set, written_set = set(), set()
+
+    def _persistable(block, name):
+        v = block._find_var_recursive(name)
+        return v is not None and v.persistable
+
+    for block in program.blocks:
+        for op in block.ops:
+            names = list(op.input_arg_names)
+            if op.type == 'backward':
+                names += list(op.attr('wrt_names'))
+            for n in names:
+                if _persistable(block, n) and n not in read_set:
+                    read_set.add(n)
+                    read.append(n)
+            for n in op.output_arg_names:
+                if _persistable(block, n) and n not in written_set:
+                    written_set.add(n)
+                    written.append(n)
+    gb = program.global_block()
+    for n in fetch_names:
+        if _persistable(gb, n) and n not in read_set:
+            read_set.add(n)
+            read.append(n)
+    return read, written
+
+
+def build_fn(program, fetch_names, read_names, written_names):
+    """Build the raw (unjitted) whole-program function
+    fn(feed, ro_state, rw_state, key) -> (fetches, new_state)."""
+    written_set = set(written_names)
+    rw_names = [n for n in read_names if n in written_set]
+    ro_names = [n for n in read_names if n not in written_set]
+
+    def fn(feed, ro_state, rw_state, key):
+        env = {}
+        env.update(feed)
+        env.update(ro_state)
+        env.update(rw_state)
+        ctx = LowerContext(program, program.global_block(), env, key)
+        lower_block(ctx)
+        env = ctx.env
+        fetches = [env[n] for n in fetch_names]
+        new_state = {n: env[n] for n in written_names if n in env}
+        return fetches, new_state
+
+    return fn, ro_names, rw_names
+
+
+def build_callable(program, fetch_names, read_names, written_names):
+    """Single-device compile of build_fn.
+
+    rw_state (read-and-written persistables, e.g. params being optimized) is
+    donated to XLA so parameter updates alias their input buffers — the
+    equivalent of the reference's in-place optimizer kernels + memory passes
+    (details/inplace_op_pass.cc), for free via buffer donation."""
+    fn, ro_names, rw_names = build_fn(program, fetch_names, read_names,
+                                      written_names)
+    jitted = jax.jit(fn, donate_argnums=(2,))
+    return jitted, ro_names, rw_names

@@ -1,0 +1,72 @@
+"""Operator registry: op type -> lowering function (+ optional shape inference).
+
+This replaces the reference's static C++ registration machinery
+(framework/op_registry.h:197,237,240 REGISTER_OPERATOR / REGISTER_OP_*_KERNEL and
+framework/op_info.h OpInfoMap) with a decorator registry. There is no runtime
+kernel dispatch: an op's `lower` function emits jax/lax operations while the
+whole program is traced once and compiled by XLA (the TPU-idiomatic equivalent
+of the per-op kernel-key dispatch at reference framework/operator.cc:907-960).
+
+Gradients do not need per-op grad makers (reference grad_op_desc_maker.h:34):
+JAX reverse-mode AD differentiates the traced program. Ops whose gradient needs
+a custom rule use jax.custom_vjp inside their lowering.
+"""
+
+
+class OpDef(object):
+    __slots__ = ('type', 'lower', 'infer_shape', 'stateful', 'needs_rng')
+
+    def __init__(self, type, lower, infer_shape=None, stateful=False,
+                 needs_rng=False):
+        self.type = type
+        self.lower = lower
+        self.infer_shape = infer_shape
+        self.stateful = stateful
+        self.needs_rng = needs_rng
+
+
+class OpRegistry(object):
+    def __init__(self):
+        self._ops = {}
+
+    def register(self, type, lower, **kw):
+        if type in self._ops:
+            raise KeyError("op %r already registered" % type)
+        self._ops[type] = OpDef(type, lower, **kw)
+        return self._ops[type]
+
+    def get(self, type):
+        if type not in self._ops:
+            raise NotImplementedError(
+                "op %r has no TPU lowering registered" % type)
+        return self._ops[type]
+
+    def has(self, type):
+        return type in self._ops
+
+    def types(self):
+        return sorted(self._ops)
+
+
+_registry = OpRegistry()
+
+
+def register_op(type, infer_shape=None, stateful=False, needs_rng=False):
+    """Decorator: register `fn(ctx, op)` as the lowering for op `type`."""
+    def deco(fn):
+        _registry.register(type, fn, infer_shape=infer_shape,
+                           stateful=stateful, needs_rng=needs_rng)
+        return fn
+    return deco
+
+
+def get_op(type):
+    return _registry.get(type)
+
+
+def has_op(type):
+    return _registry.has(type)
+
+
+def all_ops():
+    return _registry.types()

@@ -1,0 +1,70 @@
+"""Variable/data types for the program IR.
+
+Mirrors the *semantics* of reference framework/framework.proto:103-143 (VarType
+with 19 kinds) and the dtype enum, re-expressed for a JAX/TPU-native stack:
+tensors are jax.Arrays, dtypes are numpy dtypes, and TPU-native bfloat16 is a
+first-class citizen.
+"""
+import numpy as np
+
+try:
+    import ml_dtypes
+    _BF16 = np.dtype(ml_dtypes.bfloat16)
+except Exception:  # pragma: no cover
+    _BF16 = None
+
+
+class VarType(object):
+    # tensor-ish
+    LOD_TENSOR = 'lod_tensor'            # dense array (+ optional ragged rows)
+    SELECTED_ROWS = 'selected_rows'      # sparse (indices, values) gradient
+    LOD_TENSOR_ARRAY = 'lod_tensor_array'
+    # bookkeeping
+    STEP_SCOPES = 'step_scopes'
+    LOD_RANK_TABLE = 'lod_rank_table'
+    FETCH_LIST = 'fetch_list'
+    FEED_MINIBATCH = 'feed_minibatch'
+    READER = 'reader'
+    RAW = 'raw'
+
+
+_STR_TO_NP = {
+    'bool': np.bool_,
+    'int8': np.int8,
+    'uint8': np.uint8,
+    'int16': np.int16,
+    'int32': np.int32,
+    'int64': np.int64,
+    'float16': np.float16,
+    'float32': np.float32,
+    'float64': np.float64,
+}
+if _BF16 is not None:
+    _STR_TO_NP['bfloat16'] = _BF16
+
+
+def convert_np_dtype_to_dtype_(dtype):
+    """Normalize a user-provided dtype (str or np dtype) to np.dtype."""
+    if isinstance(dtype, str):
+        if dtype not in _STR_TO_NP:
+            raise ValueError("unsupported dtype %r" % (dtype,))
+        return np.dtype(_STR_TO_NP[dtype])
+    return np.dtype(dtype)
+
+
+def dtype_to_np(dtype):
+    return convert_np_dtype_to_dtype_(dtype)
+
+
+def dtype_str(dtype):
+    d = np.dtype(dtype)
+    if _BF16 is not None and d == _BF16:
+        return 'bfloat16'
+    return d.name
+
+
+def is_float_dtype(dtype):
+    d = convert_np_dtype_to_dtype_(dtype)
+    if _BF16 is not None and d == _BF16:
+        return True
+    return np.issubdtype(d, np.floating)

@@ -1,0 +1,79 @@
+"""DataFeeder: convert python/numpy minibatch rows into feed dicts.
+
+Reference python/paddle/fluid/data_feeder.py (DataFeeder → LoDTensor batches,
+multi-device split). TPU-native: produces numpy feed dicts; multi-device
+split is handled by the sharding layer (parallel/), not by the feeder.
+"""
+import numpy as np
+
+from .framework import Variable, default_main_program
+
+__all__ = ['DataFeeder']
+
+
+class DataToLoDTensorConverter(object):
+    def __init__(self, shape, dtype):
+        self.shape = [s if s is not None and s >= 0 else -1 for s in shape]
+        self.dtype = dtype
+        self.data = []
+
+    def feed(self, data):
+        self.data.append(np.asarray(data))
+
+    def done(self):
+        tail = self.shape[1:] if self.shape and self.shape[0] == -1 \
+            else self.shape
+        arrs = []
+        for d in self.data:
+            a = np.asarray(d, dtype=self.dtype)
+            if tail and all(s >= 0 for s in tail) and \
+                    a.shape != tuple(tail):
+                a = a.reshape(tail)
+            arrs.append(a)
+        return np.stack(arrs)
+
+
+class DataFeeder(object):
+    def __init__(self, feed_list, place=None, program=None):
+        self.feed_dtypes = []
+        self.feed_names = []
+        self.feed_shapes = []
+        if program is None:
+            program = default_main_program()
+        for each_var in feed_list:
+            if isinstance(each_var, str):
+                each_var = program.global_block().var(each_var)
+            if not isinstance(each_var, Variable):
+                raise TypeError("feed_list entries must be Variables/names")
+            self.feed_names.append(each_var.name)
+            self.feed_shapes.append(each_var.shape)
+            self.feed_dtypes.append(each_var.dtype)
+        self.place = place
+
+    def feed(self, iterable):
+        converters = [DataToLoDTensorConverter(shape, dtype)
+                      for shape, dtype in zip(self.feed_shapes,
+                                              self.feed_dtypes)]
+        for each_sample in iterable:
+            assert len(each_sample) == len(converters), \
+                "sample width != number of feed vars"
+            for value, conv in zip(each_sample, converters):
+                conv.feed(value)
+        return {name: conv.done()
+                for name, conv in zip(self.feed_names, converters)}
+
+    def feed_parallel(self, iterable, num_places=None):
+        """Split one batch across devices (reference multi-device feed);
+        returns a list of per-device feed dicts."""
+        full = self.feed([s for chunk in iterable for s in chunk]) \
+            if isinstance(iterable[0], (list, tuple)) else self.feed(iterable)
+        if not num_places or num_places <= 1:
+            return [full]
+        out = []
+        for i in range(num_places):
+            d = {}
+            for k, v in full.items():
+                n = v.shape[0] // num_places
+                d[k] = v[i * n:(i + 1) * n]
+            out.append(d)
+        return out

@@ -1,0 +1,257 @@
+"""Executor + Scope.
+
+Capability parity with reference python/paddle/fluid/executor.py (Executor:262,
+run:451, global_scope:34) and the C++ serial executor it drives
+(framework/executor.cc:185). TPU-native redesign:
+
+- `Executor.run(program, feed, fetch_list)` compiles the whole program once per
+  (program version, feed signature, fetch list) into a single XLA executable
+  (program cache ≈ reference executor.py:224 _get_program_cache_key), then
+  repeatedly calls it. There is no per-op interpreter.
+- The Scope is a flat name -> array store holding persistable state (params,
+  optimizer moments, LR counters). It is the checkpointable pytree: the
+  reference's "everything persistable is the checkpoint" principle.
+- feed: numpy in; fetch: numpy out (device transfer at program boundary only —
+  the reference's feed/fetch ops collapse into function arguments/results).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .framework import (Program, Variable, default_main_program, CPUPlace,
+                        TPUPlace)
+from .core import lowering
+from .core.types import convert_np_dtype_to_dtype_
+
+__all__ = ['Executor', 'Scope', 'global_scope', 'scope_guard']
+
+
+class _TensorShim(object):
+    """Minimal LoDTensor-like view over a scope entry (numpy conversion +
+    set()), so reference-style `scope.find_var(n).get_tensor()` code works."""
+
+    def __init__(self, scope, name):
+        self._scope = scope
+        self._name = name
+
+    def __array__(self, dtype=None):
+        arr = np.asarray(self._scope._vars[self._name])
+        return arr.astype(dtype) if dtype is not None else arr
+
+    def shape(self):
+        return list(np.shape(self._scope._vars[self._name]))
+
+    def set(self, value, place=None):
+        self._scope._vars[self._name] = np.asarray(value)
+
+    def set_lod(self, lod):
+        self._scope._lods[self._name] = lod
+
+    def lod(self):
+        return self._scope._lods.get(self._name, [])
+
+
+class _VarShim(object):
+    def __init__(self, scope, name):
+        self._scope = scope
+        self._name = name
+
+    def get_tensor(self):
+        return _TensorShim(self._scope, self._name)
+
+
+class Scope(object):
+    """Flat variable store (reference framework/scope.h:48, minus the parent
+    chain — sub-scopes are an interpreter artifact; XLA keeps intermediates
+    in registers/HBM)."""
+
+    def __init__(self):
+        self._vars = {}
+        self._lods = {}
+
+    # dict-ish API used internally
+    def get(self, name, default=None):
+        return self._vars.get(name, default)
+
+    def set(self, name, value):
+        self._vars[name] = value
+
+    def update(self, d):
+        self._vars.update(d)
+
+    def has(self, name):
+        return name in self._vars
+
+    def names(self):
+        return sorted(self._vars)
+
+    def drop(self, name):
+        self._vars.pop(name, None)
+
+    # fluid-style API
+    def find_var(self, name):
+        if name not in self._vars:
+            return None
+        return _VarShim(self, name)
+
+    def var(self, name):
+        self._vars.setdefault(name, None)
+        return _VarShim(self, name)
+
+    def new_scope(self):
+        return Scope()
+
+
+_global_scope = Scope()
+_scope_stack = [_global_scope]
+
+
+def global_scope():
+    return _scope_stack[-1]
+
+
+class scope_guard(object):
+    def __init__(self, scope):
+        self._scope = scope
+
+    def __enter__(self):
+        _scope_stack.append(self._scope)
+
+    def __exit__(self, *a):
+        _scope_stack.pop()
+
+
+class _CompiledEntry(object):
+    # holds a strong ref to the program so id(program) cache keys can never
+    # alias a garbage-collected program's address
+    __slots__ = ('fn', 'fetch_names', 'ro_names', 'rw_names', 'written',
+                 'program')
+
+    def __init__(self, fn, fetch_names, ro_names, rw_names, written,
+                 program):
+        self.fn = fn
+        self.fetch_names = fetch_names
+        self.ro_names = ro_names
+        self.rw_names = rw_names
+        self.written = written
+        self.program = program
+
+
+class Executor(object):
+    def __init__(self, place=None):
+        self.place = place if place is not None else TPUPlace(0)
+        self._cache = {}
+        self._run_counter = 0
+
+    def close(self):
+        self._cache.clear()
+
+    # ------------------------------------------------------------------
+    def _feed_signature(self, feed):
+        return tuple(sorted((k, tuple(np.shape(v)), str(np.asarray(v).dtype))
+                            for k, v in feed.items()))
+
+    def _prepare_feed(self, program, feed):
+        out = {}
+        gb = program.global_block()
+        for name, value in feed.items():
+            var = gb._find_var_recursive(name)
+            arr = np.asarray(value)
+            if var is not None and var.dtype is not None and \
+                    arr.dtype != var.dtype:
+                # feeding python lists of ints to a float var etc.
+                if arr.dtype.kind in 'iub' and np.dtype(var.dtype).kind in 'iub':
+                    arr = arr.astype(var.dtype)
+                elif arr.dtype.kind == 'f' and np.dtype(var.dtype).kind == 'f':
+                    arr = arr.astype(var.dtype)
+                elif arr.dtype == np.float64:
+                    arr = arr.astype(var.dtype)
+            out[name] = arr
+        return out
+
+    def run(self, program=None, feed=None, fetch_list=None, feed_var_name='feed',
+            fetch_var_name='fetch', scope=None, return_numpy=True,
+            use_program_cache=True):
+        if program is None:
+            program = default_main_program()
+        # CompiledProgram support is injected by compiler.py via duck-typing:
+        if hasattr(program, '_executor_run'):
+            return program._executor_run(self, feed, fetch_list, scope,
+                                         return_numpy)
+        if scope is None:
+            scope = global_scope()
+        feed = self._prepare_feed(program, feed or {})
+        fetch_names = [v.name if isinstance(v, Variable) else v
+                       for v in (fetch_list or [])]
+
+        key = (id(program), program._version, self._feed_signature(feed),
+               tuple(fetch_names))
+        entry = self._cache.get(key) if use_program_cache else None
+        if entry is None:
+            read, written = lowering.analyze_state(program, fetch_names)
+            # only require state that is read before being written this run
+            needed = self._read_before_write(program, read, written,
+                                             set(feed), fetch_names)
+            fn, ro_names, rw_names = lowering.build_callable(
+                program, fetch_names, needed, written)
+            entry = _CompiledEntry(fn, fetch_names, ro_names, rw_names,
+                                   written, program)
+            if use_program_cache:
+                self._cache[key] = entry
+
+        ro_state, rw_state = {}, {}
+        for n in entry.ro_names:
+            ro_state[n] = self._state_value(scope, n, program)
+        for n in entry.rw_names:
+            rw_state[n] = self._state_value(scope, n, program)
+
+        self._run_counter += 1
+        seed = program.random_seed or 0
+        key_arr = jax.random.PRNGKey(
+            (seed * 1000003 + self._run_counter) % (2 ** 31))
+        fetches, new_state = entry.fn(feed, ro_state, rw_state, key_arr)
+        scope.update(new_state)
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return list(fetches)
+
+    # ------------------------------------------------------------------
+    def _state_value(self, scope, name, program):
+        v = scope.get(name)
+        if v is None:
+            raise RuntimeError(
+                "persistable variable %r is not initialized in the scope — "
+                "run the startup program first (reference: EnforceNotMet "
+                "'Var is not initialized')" % name)
+        if isinstance(v, np.ndarray) or np.isscalar(v):
+            return jnp.asarray(v)
+        return v
+
+    @staticmethod
+    def _read_before_write(program, read, written, feed_names, fetch_names):
+        """A persistable var written earlier in the program than any read
+        (e.g. created by fill_constant in the same program) need not come
+        from the scope."""
+        first_write = {}
+        first_read = {}
+        idx = 0
+        for block in program.blocks:
+            for op in block.ops:
+                names_in = list(op.input_arg_names)
+                if op.type == 'backward':
+                    names_in += list(op.attr('wrt_names'))
+                for n in names_in:
+                    first_read.setdefault(n, idx)
+                for n in op.output_arg_names:
+                    first_write.setdefault(n, idx)
+                idx += 1
+        for n in fetch_names:
+            first_read.setdefault(n, idx)
+        needed = []
+        for n in read:
+            if n in feed_names:
+                continue
+            if n in first_write and first_write[n] < first_read.get(n, idx + 1):
+                continue
+            needed.append(n)
+        return needed

@@ -1,0 +1,473 @@
+"""Program IR: Program / Block / Operator / Variable / Parameter.
+
+Capability parity with the reference's two-phase declarative UX
+(python/paddle/fluid/framework.py: Variable:242, Operator:565, Block:1011,
+Program:1877, Parameter:2510, default programs:2594-2612, program_guard:2662),
+re-designed TPU-first:
+
+- The IR is pure build-time Python (no protobuf round-trip, no C++ descs). It
+  exists so users can construct, clone, prune, serialize and transpile programs
+  — the same mutable-program API the reference exposes.
+- Execution never interprets this IR op-by-op. The Executor lowers a whole
+  (program, feed-signature) to a single jax-traced function and XLA compiles
+  it once (see core/lowering.py) — ProgramDesc ≈ jaxpr here.
+"""
+import collections
+import contextlib
+import copy
+import numpy as np
+
+from . import unique_name
+from .core.types import VarType, convert_np_dtype_to_dtype_, dtype_str
+
+__all__ = [
+    'Program', 'Block', 'Operator', 'Variable', 'Parameter',
+    'default_startup_program', 'default_main_program', 'program_guard',
+    'switch_main_program', 'switch_startup_program', 'grad_var_name',
+    'CPUPlace', 'TPUPlace', 'CUDAPlace', 'cpu_places', 'tpu_places',
+]
+
+GRAD_VAR_SUFFIX = '@GRAD'
+
+
+def grad_var_name(var_name):
+    return var_name + GRAD_VAR_SUFFIX
+
+
+# ---------------------------------------------------------------------------
+# Places. On TPU these are thin handles over jax devices; the mesh/sharding
+# machinery in paddle_tpu.parallel is the real multi-device story.
+# (reference platform/place.h:79 CPUPlace/CUDAPlace variant)
+# ---------------------------------------------------------------------------
+
+class _Place(object):
+    def __init__(self, device_id=0):
+        self.device_id = device_id
+
+    def __repr__(self):
+        return "%s(%d)" % (type(self).__name__, self.device_id)
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.device_id == other.device_id
+
+    def __hash__(self):
+        return hash((type(self).__name__, self.device_id))
+
+
+class CPUPlace(_Place):
+    def __init__(self):
+        super(CPUPlace, self).__init__(0)
+
+
+class TPUPlace(_Place):
+    pass
+
+
+# Compatibility alias so reference-style scripts run unchanged.
+CUDAPlace = TPUPlace
+
+
+def cpu_places(device_count=None):
+    import os
+    if device_count is None:
+        device_count = int(os.environ.get('CPU_NUM', 1))
+    return [CPUPlace() for _ in range(device_count)]
+
+
+def tpu_places(device_ids=None):
+    import jax
+    if device_ids is None:
+        device_ids = range(len(jax.devices()))
+    return [TPUPlace(i) for i in device_ids]
+
+
+cuda_places = tpu_places
+
+
+# ---------------------------------------------------------------------------
+# Variable
+# ---------------------------------------------------------------------------
+
+class Variable(object):
+    """A named slot in a Block.
+
+    Mirrors reference framework.py:242 Variable semantics: name, shape (with -1
+    for the batch dim), dtype, lod_level, persistable, stop_gradient. A
+    persistable Variable is state: it lives in a Scope across executor runs and
+    is exactly what checkpoints save (reference "everything persistable is the
+    checkpoint" principle).
+    """
+
+    def __init__(self, block, name=None, shape=None, dtype='float32',
+                 lod_level=0, persistable=False, stop_gradient=False,
+                 type=VarType.LOD_TENSOR, is_data=False, need_check_feed=False,
+                 initializer=None, **kwargs):
+        self.block = block
+        if name is None:
+            name = unique_name.generate('_generated_var')
+        self.name = name
+        self.shape = tuple(shape) if shape is not None else None
+        self.dtype = convert_np_dtype_to_dtype_(dtype) if dtype else None
+        self.lod_level = lod_level
+        self.persistable = persistable
+        self.stop_gradient = stop_gradient
+        self.type = type
+        self.is_data = is_data
+        self.op = None  # producing op, set by append_op
+
+    # -- introspection -----------------------------------------------------
+    def to_string(self, throw_on_error=False, with_details=False):
+        return ("var %s : %s shape=%s dtype=%s lod=%d persistable=%s"
+                % (self.name, self.type, self.shape,
+                   dtype_str(self.dtype) if self.dtype else None,
+                   self.lod_level, self.persistable))
+
+    __repr__ = __str__ = lambda self: self.to_string()
+
+    @property
+    def grad_name(self):
+        return grad_var_name(self.name)
+
+    def astype(self, dtype):
+        from .layers import tensor as _t
+        return _t.cast(self, dtype)
+
+    # python operator sugar (reference layers/math_op_patch.py)
+    def _binary(self, other, op, reverse=False):
+        from .layers import math_op_patch
+        return math_op_patch.binary_op(self, other, op, reverse)
+
+    def __add__(self, o): return self._binary(o, 'elementwise_add')
+    def __radd__(self, o): return self._binary(o, 'elementwise_add', True)
+    def __sub__(self, o): return self._binary(o, 'elementwise_sub')
+    def __rsub__(self, o): return self._binary(o, 'elementwise_sub', True)
+    def __mul__(self, o): return self._binary(o, 'elementwise_mul')
+    def __rmul__(self, o): return self._binary(o, 'elementwise_mul', True)
+    def __truediv__(self, o): return self._binary(o, 'elementwise_div')
+    def __rtruediv__(self, o): return self._binary(o, 'elementwise_div', True)
+    __div__ = __truediv__
+    def __pow__(self, o): return self._binary(o, 'elementwise_pow')
+    def __neg__(self): return self._binary(-1.0, 'elementwise_mul')
+    def __lt__(self, o): return self._binary(o, 'less_than')
+    def __le__(self, o): return self._binary(o, 'less_equal')
+    def __gt__(self, o): return self._binary(o, 'greater_than')
+    def __ge__(self, o): return self._binary(o, 'greater_equal')
+
+
+class Parameter(Variable):
+    """Trainable persistable variable (reference framework.py:2510)."""
+
+    def __init__(self, block, shape, dtype, **kwargs):
+        kwargs.setdefault('persistable', True)
+        self.trainable = kwargs.pop('trainable', True)
+        self.optimize_attr = kwargs.pop('optimize_attr', {'learning_rate': 1.0})
+        self.regularizer = kwargs.pop('regularizer', None)
+        self.gradient_clip_attr = kwargs.pop('gradient_clip_attr', None)
+        self.do_model_average = kwargs.pop('do_model_average', None)
+        self.initializer = kwargs.pop('initializer', None)
+        super(Parameter, self).__init__(block, shape=shape, dtype=dtype,
+                                        stop_gradient=False, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# Operator
+# ---------------------------------------------------------------------------
+
+class Operator(object):
+    """One op in a block: type + named input/output var-name lists + attrs.
+
+    Mirrors reference framework.py:565 Operator (which writes into a C++
+    OpDesc); here the op desc IS the python object. Inputs/outputs map slot
+    name -> list of variable names (always lists, like the proto).
+    """
+
+    def __init__(self, block, type, inputs=None, outputs=None, attrs=None):
+        self.block = block
+        self.type = type
+        self.attrs = dict(attrs or {})
+
+        def _canon(d):
+            out = collections.OrderedDict()
+            for slot, vs in (d or {}).items():
+                if vs is None:
+                    out[slot] = []
+                    continue
+                if not isinstance(vs, (list, tuple)):
+                    vs = [vs]
+                out[slot] = [v.name if isinstance(v, Variable) else v
+                             for v in vs]
+            return out
+
+        self.inputs = _canon(inputs)
+        self.outputs = _canon(outputs)
+
+    def input(self, slot):
+        return self.inputs.get(slot, [])
+
+    def output(self, slot):
+        return self.outputs.get(slot, [])
+
+    @property
+    def input_arg_names(self):
+        return [n for vs in self.inputs.values() for n in vs]
+
+    @property
+    def output_arg_names(self):
+        return [n for vs in self.outputs.values() for n in vs]
+
+    def attr(self, name, default=None):
+        return self.attrs.get(name, default)
+
+    def set_attr(self, name, val):
+        self.attrs[name] = val
+        self.block.program._bump_version()
+
+    has_attr = lambda self, name: name in self.attrs
+
+    def to_string(self):
+        ins = ", ".join("%s=%s" % (k, v) for k, v in self.inputs.items())
+        outs = ", ".join("%s=%s" % (k, v) for k, v in self.outputs.items())
+        return "{%s} = %s(%s) attrs=%s" % (outs, self.type, ins,
+                                           {k: v for k, v in self.attrs.items()
+                                            if not k.startswith('_')})
+
+    __repr__ = __str__ = to_string
+
+
+# ---------------------------------------------------------------------------
+# Block
+# ---------------------------------------------------------------------------
+
+class Block(object):
+    """Ordered op list + var table, with parent chain for sub-blocks
+    (reference framework.py:1011; framework.proto BlockDesc:171)."""
+
+    def __init__(self, program, idx, parent_idx=-1):
+        self.program = program
+        self.idx = idx
+        self.parent_idx = parent_idx
+        self.vars = collections.OrderedDict()  # name -> Variable
+        self.ops = []
+
+    @property
+    def parent_block(self):
+        if self.parent_idx < 0:
+            return None
+        return self.program.block(self.parent_idx)
+
+    # -- vars --------------------------------------------------------------
+    def create_var(self, **kwargs):
+        name = kwargs.get('name')
+        if name and name in self.vars:
+            return self.vars[name]
+        v = Variable(self, **kwargs)
+        self.vars[v.name] = v
+        self.program._bump_version()
+        return v
+
+    def create_parameter(self, **kwargs):
+        p = Parameter(self, **kwargs)
+        self.vars[p.name] = p
+        self.program._bump_version()
+        return p
+
+    def var(self, name):
+        v = self.vars.get(name)
+        if v is None:
+            raise ValueError("var %r not in block %d" % (name, self.idx))
+        return v
+
+    def _find_var_recursive(self, name):
+        b = self
+        while b is not None:
+            if name in b.vars:
+                return b.vars[name]
+            b = b.parent_block
+        return None
+
+    def has_var(self, name):
+        return name in self.vars
+
+    def all_parameters(self):
+        return [v for v in self.vars.values() if isinstance(v, Parameter)]
+
+    # -- ops ---------------------------------------------------------------
+    def append_op(self, type=None, inputs=None, outputs=None, attrs=None):
+        op = Operator(self, type, inputs, outputs, attrs)
+        self.ops.append(op)
+        for vs in op.outputs.values():
+            for n in vs:
+                v = self._find_var_recursive(n)
+                if v is not None and v.op is None:
+                    v.op = op
+        self.program._bump_version()
+        return op
+
+    def _insert_op(self, index, type=None, inputs=None, outputs=None,
+                   attrs=None):
+        op = Operator(self, type, inputs, outputs, attrs)
+        self.ops.insert(index, op)
+        self.program._bump_version()
+        return op
+
+    def _remove_op(self, index):
+        del self.ops[index]
+        self.program._bump_version()
+
+    def prepend_op(self, **kwargs):
+        return self._insert_op(0, **kwargs)
+
+    def to_string(self):
+        lines = ["block %d (parent %d):" % (self.idx, self.parent_idx)]
+        for v in self.vars.values():
+            lines.append("  " + v.to_string())
+        for op in self.ops:
+            lines.append("  " + op.to_string())
+        return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# Program
+# ---------------------------------------------------------------------------
+
+class Program(object):
+    """A whole computation: list of blocks, block 0 global
+    (reference framework.py:1877). clone()/prune() support transpilers,
+    inference export, and test fixtures, exactly like the reference."""
+
+    def __init__(self):
+        self.blocks = [Block(self, 0)]
+        self.current_block_idx = 0
+        self.random_seed = 0
+        self._version = 0          # bumped on any mutation; keys compile cache
+        self._seed_counter = 0
+        self._is_test = False
+        # op-role bookkeeping kept for API parity (op_proto_maker.h:26-36)
+        self._current_role = 'Forward'
+
+    # -- structure ---------------------------------------------------------
+    def global_block(self):
+        return self.blocks[0]
+
+    def block(self, idx):
+        return self.blocks[idx]
+
+    def current_block(self):
+        return self.blocks[self.current_block_idx]
+
+    def _create_block(self, parent_idx=None):
+        new_idx = len(self.blocks)
+        parent = self.current_block_idx if parent_idx is None else parent_idx
+        self.blocks.append(Block(self, new_idx, parent))
+        self.current_block_idx = new_idx
+        self._bump_version()
+        return self.current_block()
+
+    def _rollback(self):
+        self.current_block_idx = self.current_block().parent_idx
+
+    def _bump_version(self):
+        self._version += 1
+
+    # -- cloning / pruning -------------------------------------------------
+    def clone(self, for_test=False):
+        p = copy.deepcopy(self)
+        p._is_test = for_test or self._is_test
+        if for_test:
+            for block in p.blocks:
+                for op in block.ops:
+                    if 'is_test' in op.attrs:
+                        op.attrs['is_test'] = True
+                    if op.type == 'dropout':
+                        op.attrs['is_test'] = True
+        p._bump_version()
+        return p
+
+    def _prune(self, targets):
+        """Keep only ops needed to compute `targets` (names or Variables).
+        Reference framework/prune.cc via Program._prune. Used by
+        save_inference_model."""
+        names = set()
+        for t in targets:
+            names.add(t.name if isinstance(t, Variable) else t)
+        p = self.clone()
+        for block in p.blocks:
+            needed = set(names)
+            kept = []
+            for op in reversed(block.ops):
+                if any(n in needed for n in op.output_arg_names) or \
+                        op.type in ('feed',):
+                    kept.append(op)
+                    needed.update(op.input_arg_names)
+            kept.reverse()
+            block.ops = kept
+            used = set()
+            for op in block.ops:
+                used.update(op.input_arg_names)
+                used.update(op.output_arg_names)
+            block.vars = collections.OrderedDict(
+                (k, v) for k, v in block.vars.items()
+                if k in used or k in names or v.persistable)
+        p._bump_version()
+        return p
+
+    def list_vars(self):
+        for block in self.blocks:
+            for v in block.vars.values():
+                yield v
+
+    def all_parameters(self):
+        return [v for b in self.blocks for v in b.vars.values()
+                if isinstance(v, Parameter)]
+
+    def to_string(self, throw_on_error=False, with_details=False):
+        return "\n".join(b.to_string() for b in self.blocks)
+
+    __repr__ = __str__ = lambda self: self.to_string()
+
+    # -- misc --------------------------------------------------------------
+    @property
+    def num_blocks(self):
+        return len(self.blocks)
+
+
+# ---------------------------------------------------------------------------
+# Default programs + guards (reference framework.py:2594-2680)
+# ---------------------------------------------------------------------------
+
+_main_program_ = Program()
+_startup_program_ = Program()
+
+
+def default_startup_program():
+    return _startup_program_
+
+
+def default_main_program():
+    return _main_program_
+
+
+def switch_main_program(program):
+    global _main_program_
+    prev, _main_program_ = _main_program_, program
+    return prev
+
+
+def switch_startup_program(program):
+    global _startup_program_
+    prev, _startup_program_ = _startup_program_, program
+    return prev
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    prev_main = switch_main_program(main_program)
+    prev_start = None
+    if startup_program is not None:
+        prev_start = switch_startup_program(startup_program)
+    try:
+        yield
+    finally:
+        switch_main_program(prev_main)
+        if prev_start is not None:
+            switch_startup_program(prev_start)

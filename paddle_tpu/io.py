@@ -1,0 +1,160 @@
+"""Persistence: save/load vars, params, persistables, inference models.
+
+Capability parity with reference python/paddle/fluid/io.py (save_vars:92,
+save_params, save_persistables:441, load_vars, load_params,
+load_persistables:657, save_inference_model:862, load_inference_model:1014).
+
+TPU-native redesign: the Scope IS the checkpoint ("everything persistable is
+the checkpoint", reference operators/save_op.cc raw serialization) — we
+serialize scope entries with numpy .npz (single-file, save_combine-style) or
+one .npy per var (per-var files, save-op style). Inference models serialize
+the pruned Program via pickle of its IR + params, the analog of the
+reference's `__model__` ProgramDesc proto + param files.
+"""
+import os
+import pickle
+
+import numpy as np
+
+from .framework import Program, Parameter, Variable, default_main_program
+from .executor import global_scope
+
+__all__ = [
+    'save_vars', 'save_params', 'save_persistables', 'load_vars',
+    'load_params', 'load_persistables', 'save_inference_model',
+    'load_inference_model', 'get_program_parameter',
+]
+
+
+def _is_persistable(var):
+    return var.persistable
+
+
+def _is_parameter(var):
+    return isinstance(var, Parameter)
+
+
+def save_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    if main_program is None:
+        main_program = default_main_program()
+    if vars is None:
+        vars = [v for v in main_program.list_vars()
+                if predicate is None or predicate(v)]
+    scope = global_scope()
+    os.makedirs(dirname, exist_ok=True)
+    arrays = {}
+    for v in vars:
+        name = v.name if isinstance(v, Variable) else v
+        val = scope.get(name)
+        if val is None:
+            raise RuntimeError("variable %r has no value in scope" % name)
+        arrays[name] = np.asarray(val)
+    if filename is not None:
+        if not filename.endswith('.npz'):
+            filename += '.npz'  # np.savez appends it anyway; keep load in sync
+        np.savez(os.path.join(dirname, filename), **arrays)
+    else:
+        for name, arr in arrays.items():
+            np.save(os.path.join(dirname, name.replace('/', '%2F') + '.npy'),
+                    arr)
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    return save_vars(executor, dirname, main_program,
+                     predicate=_is_parameter, filename=filename)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    return save_vars(executor, dirname, main_program,
+                     predicate=_is_persistable, filename=filename)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    if main_program is None:
+        main_program = default_main_program()
+    if vars is None:
+        vars = [v for v in main_program.list_vars()
+                if predicate is None or predicate(v)]
+    scope = global_scope()
+    if filename is not None:
+        if not filename.endswith('.npz'):
+            filename += '.npz'
+        data = np.load(os.path.join(dirname, filename))
+        stored = {k: data[k] for k in data.files}
+    else:
+        stored = None
+    for v in vars:
+        name = v.name if isinstance(v, Variable) else v
+        if stored is not None:
+            if name not in stored:
+                raise RuntimeError("variable %r not found in %s"
+                                   % (name, filename))
+            scope.set(name, stored[name])
+        else:
+            path = os.path.join(dirname, name.replace('/', '%2F') + '.npy')
+            if not os.path.exists(path):
+                raise RuntimeError("variable file %r not found" % path)
+            scope.set(name, np.load(path))
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    return load_vars(executor, dirname, main_program,
+                     predicate=_is_parameter, filename=filename)
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    return load_vars(executor, dirname, main_program,
+                     predicate=_is_persistable, filename=filename)
+
+
+def get_program_parameter(program):
+    return program.all_parameters()
+
+
+MODEL_FILENAME = '__model__'
+PARAMS_FILENAME = '__params__.npz'
+
+
+def save_inference_model(dirname, feeded_var_names, target_vars, executor,
+                         main_program=None, model_filename=None,
+                         params_filename=None, export_for_deployment=True):
+    """Prune to feed/fetch + serialize program & params
+    (reference io.py:862)."""
+    if main_program is None:
+        main_program = default_main_program()
+    if isinstance(feeded_var_names, str):
+        feeded_var_names = [feeded_var_names]
+    if isinstance(target_vars, Variable):
+        target_vars = [target_vars]
+    target_names = [t.name for t in target_vars]
+
+    inference_program = main_program.clone(for_test=True)
+    pruned = inference_program._prune(target_names)
+
+    os.makedirs(dirname, exist_ok=True)
+    model_path = os.path.join(dirname, model_filename or MODEL_FILENAME)
+    with open(model_path, 'wb') as f:
+        pickle.dump({'program': pruned,
+                     'feed_names': list(feeded_var_names),
+                     'fetch_names': target_names}, f)
+    # save ALL persistables, not just Parameters: batch-norm moving stats etc.
+    # are persistable plain Variables (reference io.py:1011 does the same)
+    save_persistables(executor, dirname, pruned,
+                      filename=params_filename or PARAMS_FILENAME)
+    return target_names
+
+
+def load_inference_model(dirname, executor, model_filename=None,
+                         params_filename=None, pserver_endpoints=None):
+    """Returns (program, feed_names, fetch_names) (reference io.py:1014)."""
+    model_path = os.path.join(dirname, model_filename or MODEL_FILENAME)
+    with open(model_path, 'rb') as f:
+        blob = pickle.load(f)
+    program = blob['program']
+    load_persistables(executor, dirname, program,
+                      filename=params_filename or PARAMS_FILENAME)
+    fetch_vars = [program.global_block().var(n)
+                  for n in blob['fetch_names']]
+    return program, blob['feed_names'], fetch_vars

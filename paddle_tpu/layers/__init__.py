@@ -1,0 +1,26 @@
+from . import io
+from . import tensor
+from . import nn
+from . import ops
+from . import math_op_patch
+from . import metric_op
+from . import learning_rate_scheduler
+from . import control_flow
+from . import detection
+
+from .io import *
+from .tensor import *
+from .nn import *
+from .ops import *
+from .metric_op import *
+from .learning_rate_scheduler import *
+from .control_flow import *
+
+__all__ = []
+__all__ += io.__all__
+__all__ += tensor.__all__
+__all__ += nn.__all__
+__all__ += ops.__all__
+__all__ += metric_op.__all__
+__all__ += learning_rate_scheduler.__all__
+__all__ += control_flow.__all__

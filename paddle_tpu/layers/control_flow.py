@@ -1,0 +1,42 @@
+"""Control-flow layers — lax.scan/while/cond based (full versions: stage 6).
+
+Reference python/paddle/fluid/layers/control_flow.py (StaticRNN:278,
+While:504, ConditionalBlock:1055, Switch:1138, DynamicRNN)."""
+
+__all__ = ['less_than', 'equal', 'array_write', 'array_read',
+           'increment_cf']
+
+from ..layer_helper import LayerHelper
+
+
+def less_than(x, y, force_cpu=None, cond=None):
+    helper = LayerHelper('less_than')
+    if cond is None:
+        cond = helper.create_variable_for_type_inference(dtype='bool',
+                                                         shape=x.shape)
+    helper.append_op(type='less_than', inputs={'X': [x], 'Y': [y]},
+                     outputs={'Out': [cond]})
+    return cond
+
+
+def equal(x, y, cond=None):
+    helper = LayerHelper('equal')
+    if cond is None:
+        cond = helper.create_variable_for_type_inference(dtype='bool',
+                                                         shape=x.shape)
+    helper.append_op(type='equal', inputs={'X': [x], 'Y': [y]},
+                     outputs={'Out': [cond]})
+    return cond
+
+
+def array_write(x, i, array=None):
+    raise NotImplementedError("LoDTensorArray lands with stage 6 (scan)")
+
+
+def array_read(array, i):
+    raise NotImplementedError("LoDTensorArray lands with stage 6 (scan)")
+
+
+def increment_cf(x, value=1.0, in_place=True):
+    from .nn import increment as _inc
+    return _inc(x, value, in_place)

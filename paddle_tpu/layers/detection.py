@@ -1,0 +1,4 @@
+"""Detection layers (reference python/paddle/fluid/layers/detection.py:33-54,
+20 layers) — stage 7 wave."""
+
+__all__ = []

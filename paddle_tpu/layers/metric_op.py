@@ -1,0 +1,57 @@
+"""Metric layers (reference python/paddle/fluid/layers/metric_op.py)."""
+from ..layer_helper import LayerHelper
+from ..initializer import Constant
+
+__all__ = ['accuracy', 'auc']
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    helper = LayerHelper("accuracy")
+    shape = tuple(input.shape[:-1]) + (k,)
+    topk_out = helper.create_variable_for_type_inference(dtype=input.dtype,
+                                                         shape=shape)
+    topk_indices = helper.create_variable_for_type_inference(
+        dtype='int64', shape=shape)
+    helper.append_op(type='top_k', inputs={'X': [input]},
+                     outputs={'Out': [topk_out],
+                              'Indices': [topk_indices]},
+                     attrs={'k': k})
+    acc_out = helper.create_variable_for_type_inference(dtype='float32',
+                                                        shape=(1,))
+    if correct is None:
+        correct = helper.create_variable_for_type_inference(
+            dtype='int32', shape=(1,))
+    if total is None:
+        total = helper.create_variable_for_type_inference(dtype='int32',
+                                                          shape=(1,))
+    helper.append_op(
+        type='accuracy',
+        inputs={'Out': [topk_out], 'Indices': [topk_indices],
+                'Label': [label]},
+        outputs={'Accuracy': [acc_out], 'Correct': [correct],
+                 'Total': [total]})
+    acc_out.stop_gradient = True
+    return acc_out
+
+
+def auc(input, label, curve='ROC', num_thresholds=2 ** 12 - 1, topk=1,
+        slide_steps=1):
+    helper = LayerHelper("auc")
+    auc_out = helper.create_variable_for_type_inference(dtype='float32',
+                                                        shape=(1,))
+    stat_pos = helper.create_or_get_global_variable(
+        name=helper.name + '.stat_pos', dtype='int64',
+        shape=(num_thresholds + 1,))
+    helper.set_variable_initializer(stat_pos, Constant(0.0))
+    stat_neg = helper.create_or_get_global_variable(
+        name=helper.name + '.stat_neg', dtype='int64',
+        shape=(num_thresholds + 1,))
+    helper.set_variable_initializer(stat_neg, Constant(0.0))
+    helper.append_op(
+        type='auc',
+        inputs={'Predict': [input], 'Label': [label],
+                'StatPos': [stat_pos], 'StatNeg': [stat_neg]},
+        outputs={'AUC': [auc_out], 'StatPosOut': [stat_pos],
+                 'StatNegOut': [stat_neg]},
+        attrs={'curve': curve, 'num_thresholds': num_thresholds})
+    return auc_out, [stat_pos, stat_neg]

@@ -1,0 +1,19 @@
+"""Operator lowerings: each module registers op type -> jax lowering.
+
+The registry (core/registry.py) replaces the reference's 356 REGISTER_OPERATOR
+registrations (see SURVEY Appendix A; paddle/fluid/operators/). Every op here
+is a pure jax emission into the whole-program trace — XLA provides the kernel,
+fusion, and scheduling that the reference implemented per-op in C++/CUDA.
+"""
+from . import meta
+from . import math_ops
+from . import activations
+from . import tensor_ops
+from . import nn_ops
+from . import optimizer_ops
+from . import compare_ops
+from . import random_ops
+from . import metrics_ops
+from . import sequence_ops
+from . import control_flow_ops
+from . import detection_ops

@@ -1,0 +1,111 @@
+"""Activation ops.
+
+Reference: operators/activation_op.cc:559 REGISTER_ACTIVATION_OP + functor list
+activation_op.h:983-1014 (31 activations, each with a hand-written grad
+functor). Here each is one jnp expression; JAX AD supplies the gradients and
+XLA fuses them into surrounding matmuls (HBM-bandwidth win on TPU).
+"""
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+def _register_act(name, fn, attrs=()):
+    @register_op(name)
+    def _lower(ctx, op, _fn=fn, _attrs=attrs):
+        x = ctx.in1(op, 'X')
+        kw = {a: op.attr(a, d) for a, d in _attrs}
+        ctx.out(op, 'Out', _fn(x, **kw))
+
+
+_register_act('sigmoid', jax.nn.sigmoid)
+_register_act('logsigmoid', jax.nn.log_sigmoid)
+_register_act('exp', jnp.exp)
+_register_act('relu', jax.nn.relu)
+_register_act('gelu', lambda x: jax.nn.gelu(x, approximate=False))
+_register_act('tanh', jnp.tanh)
+_register_act('sqrt', jnp.sqrt)
+_register_act('rsqrt', jax.lax.rsqrt)
+_register_act('abs', jnp.abs)
+_register_act('ceil', jnp.ceil)
+_register_act('floor', jnp.floor)
+_register_act('cos', jnp.cos)
+_register_act('sin', jnp.sin)
+_register_act('round', jnp.round)
+_register_act('reciprocal', lambda x: 1.0 / x)
+_register_act('log', jnp.log)
+_register_act('square', jnp.square)
+_register_act('softplus', jax.nn.softplus)
+_register_act('softsign', jax.nn.soft_sign)
+_register_act('tanh_shrink', lambda x: x - jnp.tanh(x))
+
+_register_act('softshrink',
+              lambda x, lambda_: jnp.where(x > lambda_, x - lambda_,
+                                           jnp.where(x < -lambda_,
+                                                     x + lambda_, 0.0)),
+              attrs=(('lambda_', 0.5),))
+_register_act('brelu',
+              lambda x, t_min, t_max: jnp.clip(x, t_min, t_max),
+              attrs=(('t_min', 0.0), ('t_max', 24.0)))
+_register_act('soft_relu',
+              lambda x, threshold: jnp.log1p(
+                  jnp.exp(jnp.clip(x, -threshold, threshold))),
+              attrs=(('threshold', 40.0),))
+_register_act('pow', lambda x, factor: jnp.power(x, factor),
+              attrs=(('factor', 1.0),))
+_register_act('stanh',
+              lambda x, scale_a, scale_b: scale_b * jnp.tanh(scale_a * x),
+              attrs=(('scale_a', 0.67), ('scale_b', 1.7159)))
+_register_act('relu6',
+              lambda x, threshold: jnp.clip(x, 0.0, threshold),
+              attrs=(('threshold', 6.0),))
+_register_act('leaky_relu',
+              lambda x, alpha: jnp.where(x >= 0, x, alpha * x),
+              attrs=(('alpha', 0.02),))
+_register_act('elu',
+              lambda x, alpha: jnp.where(x >= 0, x,
+                                         alpha * (jnp.exp(x) - 1.0)),
+              attrs=(('alpha', 1.0),))
+_register_act('hard_shrink',
+              lambda x, threshold: jnp.where(jnp.abs(x) > threshold, x, 0.0),
+              attrs=(('threshold', 0.5),))
+_register_act('hard_sigmoid',
+              lambda x, slope, offset: jnp.clip(slope * x + offset, 0.0, 1.0),
+              attrs=(('slope', 0.2), ('offset', 0.5)))
+_register_act('swish',
+              lambda x, beta: x * jax.nn.sigmoid(beta * x),
+              attrs=(('beta', 1.0),))
+_register_act('thresholded_relu',
+              lambda x, threshold: jnp.where(x > threshold, x, 0.0),
+              attrs=(('threshold', 1.0),))
+_register_act('selu',
+              lambda x, scale, alpha: scale * jnp.where(
+                  x >= 0, x, alpha * (jnp.exp(x) - 1.0)),
+              attrs=(('scale', 1.0507009873554805),
+                     ('alpha', 1.6732632423543772)))
+_register_act('prelu_simple', lambda x, alpha: jnp.where(x >= 0, x, alpha * x),
+              attrs=(('alpha', 0.25),))
+
+
+@register_op('prelu')
+def _prelu(ctx, op):
+    x = ctx.in1(op, 'X')
+    alpha = ctx.in1(op, 'Alpha')
+    mode = op.attr('mode', 'all')
+    if mode == 'all':
+        a = alpha.reshape(())
+    elif mode == 'channel':
+        a = alpha.reshape((1, -1) + (1,) * (x.ndim - 2))
+    else:  # element
+        a = alpha.reshape((1,) + x.shape[1:])
+    ctx.out(op, 'Out', jnp.where(x >= 0, x, a * x))
+
+
+@register_op('maxout')
+def _maxout(ctx, op):
+    x = ctx.in1(op, 'X')  # NCHW
+    groups = op.attr('groups')
+    n, c, h, w = x.shape
+    out = x.reshape(n, c // groups, groups, h, w).max(axis=2)
+    ctx.out(op, 'Out', out)

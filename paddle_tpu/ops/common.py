@@ -1,0 +1,32 @@
+"""Shared helpers for op lowerings."""
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.types import convert_np_dtype_to_dtype_
+
+
+def np_dtype(attr_val, default='float32'):
+    if attr_val is None:
+        attr_val = default
+    return convert_np_dtype_to_dtype_(attr_val)
+
+
+def broadcast_y_to(x, y, axis):
+    """Reference elementwise axis-broadcast semantics
+    (operators/elementwise/elementwise_op.h): align y's dims to x starting at
+    `axis` (-1 = trailing alignment, numpy-style)."""
+    if axis is None:
+        axis = -1
+    if y.ndim == x.ndim or y.ndim == 0 or axis == -1:
+        return y
+    target = [1] * x.ndim
+    for i, s in enumerate(y.shape):
+        target[axis + i] = s
+    return y.reshape(target)
+
+
+def flatten_to_2d(x, num_col_dims):
+    """Reference `mul` op x_num_col_dims semantics (operators/mul_op.cc)."""
+    lead = int(np.prod(x.shape[:num_col_dims])) if num_col_dims > 0 else 1
+    tail = int(np.prod(x.shape[num_col_dims:])) if num_col_dims < x.ndim else 1
+    return x.reshape(lead, tail)

@@ -1,0 +1,37 @@
+"""Compare / logical ops (reference operators/controlflow/compare_op.cc,
+logical_op.cc)."""
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from .common import broadcast_y_to
+
+
+def _register_cmp(name, fn):
+    @register_op(name)
+    def _lower(ctx, op, _fn=fn):
+        x = ctx.in1(op, 'X')
+        y = ctx.in1(op, 'Y')
+        y = broadcast_y_to(x, y, op.attr('axis', -1))
+        ctx.out(op, 'Out', _fn(x, y))
+
+
+_register_cmp('equal', lambda x, y: x == y)
+_register_cmp('not_equal', lambda x, y: x != y)
+_register_cmp('less_than', lambda x, y: x < y)
+_register_cmp('less_equal', lambda x, y: x <= y)
+_register_cmp('greater_than', lambda x, y: x > y)
+_register_cmp('greater_equal', lambda x, y: x >= y)
+_register_cmp('logical_and', jnp.logical_and)
+_register_cmp('logical_or', jnp.logical_or)
+_register_cmp('logical_xor', jnp.logical_xor)
+
+
+@register_op('logical_not')
+def _logical_not(ctx, op):
+    ctx.out(op, 'Out', jnp.logical_not(ctx.in1(op, 'X')))
+
+
+@register_op('isfinite')
+def _isfinite(ctx, op):
+    x = ctx.in1(op, 'X')
+    ctx.out(op, 'Out', jnp.all(jnp.isfinite(x)).reshape(1))

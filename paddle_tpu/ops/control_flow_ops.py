@@ -1,0 +1,4 @@
+"""Control flow ops — while/conditional_block via lax loops (stage 6).
+Reference: operators/controlflow/while_op.cc:50, conditional_block_op.cc:72."""
+
+from ..core.registry import register_op

@@ -1,0 +1,3 @@
+"""Detection ops (reference operators/detection/, ~25 ops) — stage 7."""
+
+from ..core.registry import register_op

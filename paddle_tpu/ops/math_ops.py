@@ -1,0 +1,296 @@
+"""Dense math ops: mul/matmul, elementwise family, reductions, norms.
+
+Reference counterparts: operators/mul_op.cc, matmul_op.cc,
+elementwise/elementwise_*_op.cc (axis broadcast), reduce_ops/reduce_*_op.cc,
+sum_op.cc, mean_op.cc, cumsum_op.cc, sign_op.cc, l1_norm_op.cc,
+squared_l2_norm_op.cc, squared_l2_distance_op.cc, cos_sim_op.cc,
+bilinear_tensor_product_op.cc, minus_op.cc. All lower to jnp/lax; matmuls hit
+the MXU, and bf16/fp32 mixed precision is handled by dtype of the operands.
+"""
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op
+from .common import broadcast_y_to, flatten_to_2d
+
+
+@register_op('mul')
+def _mul(ctx, op):
+    x = ctx.in1(op, 'X')
+    y = ctx.in1(op, 'Y')
+    xnc = op.attr('x_num_col_dims', 1)
+    ynk = op.attr('y_num_col_dims', 1)
+    x2 = flatten_to_2d(x, xnc)
+    y2 = flatten_to_2d(y, ynk)
+    out = jnp.dot(x2, y2, preferred_element_type=jnp.float32)
+    out = out.astype(x.dtype)
+    out_shape = x.shape[:xnc] + y.shape[ynk:]
+    ctx.out(op, 'Out', out.reshape(out_shape))
+
+
+@register_op('matmul')
+def _matmul(ctx, op):
+    x = ctx.in1(op, 'X')
+    y = ctx.in1(op, 'Y')
+    tx = op.attr('transpose_X', False)
+    ty = op.attr('transpose_Y', False)
+    alpha = op.attr('alpha', 1.0)
+    if x.ndim == 1:
+        x = x.reshape(1, -1)
+    if y.ndim == 1:
+        y = y.reshape(-1, 1)
+    if tx:
+        x = jnp.swapaxes(x, -1, -2)
+    if ty:
+        y = jnp.swapaxes(y, -1, -2)
+    out = jnp.matmul(x, y, preferred_element_type=jnp.float32).astype(x.dtype)
+    if alpha != 1.0:
+        out = out * jnp.asarray(alpha, dtype=out.dtype)
+    ctx.out(op, 'Out', out)
+
+
+# -- elementwise family ------------------------------------------------------
+
+def _register_elementwise(name, fn):
+    @register_op(name)
+    def _lower(ctx, op, _fn=fn):
+        x = ctx.in1(op, 'X')
+        y = ctx.in1(op, 'Y')
+        y = broadcast_y_to(x, y, op.attr('axis', -1))
+        ctx.out(op, 'Out', _fn(x, y))
+
+
+_register_elementwise('elementwise_add', lambda x, y: x + y)
+_register_elementwise('elementwise_sub', lambda x, y: x - y)
+_register_elementwise('elementwise_mul', lambda x, y: x * y)
+_register_elementwise('elementwise_div', lambda x, y: x / y)
+_register_elementwise('elementwise_max', jnp.maximum)
+_register_elementwise('elementwise_min', jnp.minimum)
+_register_elementwise('elementwise_pow', jnp.power)
+_register_elementwise('elementwise_mod', jnp.mod)
+_register_elementwise('elementwise_floordiv', jnp.floor_divide)
+
+
+@register_op('minus')
+def _minus(ctx, op):
+    ctx.out(op, 'Out', ctx.in1(op, 'X') - ctx.in1(op, 'Y'))
+
+
+@register_op('sum')
+def _sum(ctx, op):
+    xs = ctx.in_list(op, 'X')
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    ctx.out(op, 'Out', out)
+
+
+@register_op('mean')
+def _mean(ctx, op):
+    x = ctx.in1(op, 'X')
+    ctx.out(op, 'Out', jnp.mean(x).reshape(1))
+
+
+# -- reductions --------------------------------------------------------------
+
+def _register_reduce(name, fn):
+    @register_op(name)
+    def _lower(ctx, op, _fn=fn):
+        x = ctx.in1(op, 'X')
+        dim = op.attr('dim', [0])
+        keep_dim = op.attr('keep_dim', False)
+        reduce_all = op.attr('reduce_all', False)
+        if reduce_all:
+            axes = None
+        else:
+            if not isinstance(dim, (list, tuple)):
+                dim = [dim]
+            axes = tuple(d % x.ndim for d in dim)
+        out = _fn(x, axis=axes, keepdims=keep_dim)
+        if axes is None and not keep_dim:
+            out = out.reshape(())
+        ctx.out(op, 'Out', out)
+
+
+_register_reduce('reduce_sum', jnp.sum)
+_register_reduce('reduce_mean', jnp.mean)
+_register_reduce('reduce_max', jnp.max)
+_register_reduce('reduce_min', jnp.min)
+_register_reduce('reduce_prod', jnp.prod)
+_register_reduce('reduce_all', jnp.all)
+_register_reduce('reduce_any', jnp.any)
+
+
+@register_op('cumsum')
+def _cumsum(ctx, op):
+    x = ctx.in1(op, 'X')
+    axis = op.attr('axis', -1)
+    exclusive = op.attr('exclusive', False)
+    reverse = op.attr('reverse', False)
+    if reverse:
+        x = jnp.flip(x, axis)
+    out = jnp.cumsum(x, axis=axis, dtype=x.dtype)
+    if exclusive:
+        out = out - x
+    if reverse:
+        out = jnp.flip(out, axis)
+    ctx.out(op, 'Out', out)
+
+
+@register_op('sign')
+def _sign(ctx, op):
+    ctx.out(op, 'Out', jnp.sign(ctx.in1(op, 'X')))
+
+
+@register_op('l1_norm')
+def _l1_norm(ctx, op):
+    x = ctx.in1(op, 'X')
+    ctx.out(op, 'Out', jnp.sum(jnp.abs(x)).reshape(()))
+
+
+@register_op('squared_l2_norm')
+def _squared_l2_norm(ctx, op):
+    x = ctx.in1(op, 'X')
+    ctx.out(op, 'Out', jnp.sum(x * x).reshape(1))
+
+
+@register_op('squared_l2_distance')
+def _squared_l2_distance(ctx, op):
+    x = ctx.in1(op, 'X')
+    y = ctx.in1(op, 'Y')
+    sub = x - y
+    ctx.out(op, 'sub_result', sub)
+    ctx.out(op, 'Out', jnp.sum(sub * sub, axis=tuple(range(1, sub.ndim)),
+                               keepdims=True))
+
+
+@register_op('cos_sim')
+def _cos_sim(ctx, op):
+    x = ctx.in1(op, 'X')
+    y = ctx.in1(op, 'Y')
+    xn = jnp.sqrt(jnp.sum(x * x, axis=-1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(y * y, axis=-1, keepdims=True))
+    out = jnp.sum(x * y, axis=-1, keepdims=True) / (xn * yn + 1e-12)
+    ctx.out(op, 'Out', out)
+    ctx.out(op, 'XNorm', xn)
+    ctx.out(op, 'YNorm', yn)
+
+
+@register_op('norm')
+def _norm(ctx, op):
+    x = ctx.in1(op, 'X')
+    axis = op.attr('axis', -1)
+    eps = op.attr('epsilon', 1e-10)
+    norm = jnp.sqrt(jnp.sum(x * x, axis=axis, keepdims=True) + eps)
+    ctx.out(op, 'Norm', norm)
+    ctx.out(op, 'Out', x / norm)
+
+
+@register_op('bilinear_tensor_product')
+def _bilinear_tensor_product(ctx, op):
+    x = ctx.in1(op, 'X')         # (N, M)
+    y = ctx.in1(op, 'Y')         # (N, P)
+    w = ctx.in1(op, 'Weight')    # (K, M, P)
+    bias = ctx.in1(op, 'Bias')
+    out = jnp.einsum('nm,kmp,np->nk', x, w, y)
+    if bias is not None:
+        out = out + bias
+    ctx.out(op, 'Out', out)
+
+
+@register_op('log_loss')
+def _log_loss(ctx, op):
+    p = ctx.in1(op, 'Predicted')
+    y = ctx.in1(op, 'Labels')
+    eps = op.attr('epsilon', 1e-4)
+    out = -y * jnp.log(p + eps) - (1.0 - y) * jnp.log(1.0 - p + eps)
+    ctx.out(op, 'Loss', out)
+
+
+@register_op('huber_loss')
+def _huber_loss(ctx, op):
+    x = ctx.in1(op, 'X')
+    y = ctx.in1(op, 'Y')
+    delta = op.attr('delta', 1.0)
+    r = y - x
+    ar = jnp.abs(r)
+    loss = jnp.where(ar <= delta, 0.5 * r * r,
+                     delta * (ar - 0.5 * delta))
+    ctx.out(op, 'Residual', r)
+    ctx.out(op, 'Out', loss)
+
+
+@register_op('hinge_loss')
+def _hinge_loss(ctx, op):
+    logits = ctx.in1(op, 'Logits')
+    labels = ctx.in1(op, 'Labels')
+    ctx.out(op, 'Loss',
+            jnp.maximum(0.0, 1.0 - (2.0 * labels - 1.0) * logits))
+
+
+@register_op('rank_loss')
+def _rank_loss(ctx, op):
+    label = ctx.in1(op, 'Label')
+    left = ctx.in1(op, 'Left')
+    right = ctx.in1(op, 'Right')
+    d = left - right
+    out = jnp.logaddexp(0.0, d) - label * d
+    ctx.out(op, 'Out', out)
+
+
+@register_op('margin_rank_loss')
+def _margin_rank_loss(ctx, op):
+    label = ctx.in1(op, 'Label')
+    x1 = ctx.in1(op, 'X1')
+    x2 = ctx.in1(op, 'X2')
+    margin = op.attr('margin', 0.0)
+    out = jnp.maximum(0.0, -label * (x1 - x2) + margin)
+    ctx.out(op, 'Out', out)
+    ctx.out(op, 'Activated', (out > 0).astype(x1.dtype))
+
+
+@register_op('smooth_l1_loss')
+def _smooth_l1_loss(ctx, op):
+    x = ctx.in1(op, 'X')
+    y = ctx.in1(op, 'Y')
+    iw = ctx.in1(op, 'InsideWeight')
+    ow = ctx.in1(op, 'OutsideWeight')
+    sigma = op.attr('sigma', 1.0)
+    s2 = sigma * sigma
+    d = x - y
+    if iw is not None:
+        d = d * iw
+    ad = jnp.abs(d)
+    loss = jnp.where(ad < 1.0 / s2, 0.5 * d * d * s2, ad - 0.5 / s2)
+    if ow is not None:
+        loss = loss * ow
+    ctx.out(op, 'Diff', d)
+    ctx.out(op, 'Out', jnp.sum(loss, axis=tuple(range(1, loss.ndim)),
+                               keepdims=True))
+
+
+@register_op('bpr_loss')
+def _bpr_loss(ctx, op):
+    x = ctx.in1(op, 'X')          # (N, C) logits
+    label = ctx.in1(op, 'Label')  # (N, 1)
+    lab = label.reshape(-1).astype(jnp.int32)
+    pos = jnp.take_along_axis(x, lab[:, None], axis=1)
+    diff = -(x - pos)
+    loss = -jnp.log(jnp.clip(1.0 / (1.0 + jnp.exp(diff)), 1e-20, 1.0))
+    n = x.shape[1]
+    mask = jnp.ones_like(loss).at[jnp.arange(x.shape[0]), lab].set(0.0)
+    out = jnp.sum(loss * mask, axis=1, keepdims=True) / (n - 1)
+    ctx.out(op, 'Y', out)
+
+
+@register_op('teacher_student_sigmoid_loss')
+def _ts_sigmoid_loss(ctx, op):
+    x = ctx.in1(op, 'X')
+    label = ctx.in1(op, 'Label')
+    soft_max_up = op.attr('soft_max_up_bound', 15.0)
+    soft_max_lo = op.attr('soft_max_lower_bound', -15.0)
+    z = jnp.clip(x, soft_max_lo, soft_max_up)
+    # teacher (soft) + student (hard) composite CE on sigmoid
+    out = jnp.logaddexp(0.0, z) - label * z
+    ctx.out(op, 'Y', out)

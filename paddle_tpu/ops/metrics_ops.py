@@ -1,0 +1,102 @@
+"""Metric ops (reference operators/metrics/accuracy_op.cc, auc_op.cc,
+precision_recall_op.cc; operators/positive_negative_pair_op.cc)."""
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+@register_op('accuracy')
+def _accuracy(ctx, op):
+    indices = ctx.in1(op, 'Indices')   # (N, k) from top_k
+    label = ctx.in1(op, 'Label')       # (N, 1)
+    correct = jnp.any(indices == label.astype(indices.dtype), axis=1)
+    num_correct = jnp.sum(correct.astype(jnp.int32))
+    total = indices.shape[0]
+    ctx.out(op, 'Accuracy',
+            (num_correct.astype(jnp.float32) / total).reshape(1))
+    ctx.out(op, 'Correct', num_correct.reshape(1))
+    ctx.out(op, 'Total', jnp.asarray([total], dtype=jnp.int32))
+
+
+@register_op('auc')
+def _auc(ctx, op):
+    # streaming AUC with histogram stats, like reference auc_op
+    preds = ctx.in1(op, 'Predict')     # (N, 2) [neg, pos] probs
+    label = ctx.in1(op, 'Label')       # (N, 1)
+    stat_pos_in = ctx.in1(op, 'StatPos')
+    stat_neg_in = ctx.in1(op, 'StatNeg')
+    num_thresholds = op.attr('num_thresholds', 4095)
+    pos_prob = preds[:, -1]
+    lab = label.reshape(-1).astype(jnp.int32)
+    bins = jnp.clip((pos_prob * num_thresholds).astype(jnp.int32), 0,
+                    num_thresholds)
+    one = jnp.ones_like(bins)
+    pos_hist = jnp.zeros(num_thresholds + 1, jnp.int64).at[bins].add(
+        (lab == 1).astype(jnp.int64))
+    neg_hist = jnp.zeros(num_thresholds + 1, jnp.int64).at[bins].add(
+        (lab == 0).astype(jnp.int64))
+    stat_pos = stat_pos_in.astype(jnp.int64) + pos_hist
+    stat_neg = stat_neg_in.astype(jnp.int64) + neg_hist
+    # AUC by trapezoid over thresholds (descending)
+    tp = jnp.cumsum(stat_pos[::-1])
+    fp = jnp.cumsum(stat_neg[::-1])
+    tot_pos = tp[-1].astype(jnp.float64)
+    tot_neg = fp[-1].astype(jnp.float64)
+    tpr = tp.astype(jnp.float64) / jnp.maximum(tot_pos, 1)
+    fpr = fp.astype(jnp.float64) / jnp.maximum(tot_neg, 1)
+    auc = jnp.trapezoid(tpr, fpr) if hasattr(jnp, 'trapezoid') else \
+        jnp.trapz(tpr, fpr)
+    ctx.out(op, 'AUC', auc.astype(jnp.float32).reshape(1))
+    ctx.out(op, 'StatPosOut', stat_pos)
+    ctx.out(op, 'StatNegOut', stat_neg)
+
+
+@register_op('precision_recall')
+def _precision_recall(ctx, op):
+    # macro/micro P/R/F1 over classes from max-prob predictions
+    preds = ctx.in1(op, 'MaxProbs')
+    indices = ctx.in1(op, 'Indices')
+    label = ctx.in1(op, 'Labels')
+    weights = ctx.in1(op, 'Weights')
+    states = ctx.in1(op, 'StatesInfo')
+    cls = op.attr('class_number')
+    idx = indices.reshape(-1).astype(jnp.int32)
+    lab = label.reshape(-1).astype(jnp.int32)
+    w = weights.reshape(-1) if weights is not None else jnp.ones_like(
+        idx, dtype=jnp.float32)
+    tp = jnp.zeros(cls).at[idx].add(jnp.where(idx == lab, w, 0.0))
+    fp = jnp.zeros(cls).at[idx].add(jnp.where(idx != lab, w, 0.0))
+    fn = jnp.zeros(cls).at[lab].add(jnp.where(idx != lab, w, 0.0))
+    new_states = states + jnp.stack(
+        [tp, fp, fn, jnp.zeros(cls)], axis=1)
+    stp, sfp, sfn = new_states[:, 0], new_states[:, 1], new_states[:, 2]
+    prec = stp / jnp.maximum(stp + sfp, 1e-12)
+    rec = stp / jnp.maximum(stp + sfn, 1e-12)
+    f1 = 2 * prec * rec / jnp.maximum(prec + rec, 1e-12)
+    macro = jnp.stack([jnp.mean(prec), jnp.mean(rec), jnp.mean(f1)])
+    mtp, mfp, mfn = jnp.sum(stp), jnp.sum(sfp), jnp.sum(sfn)
+    mprec = mtp / jnp.maximum(mtp + mfp, 1e-12)
+    mrec = mtp / jnp.maximum(mtp + mfn, 1e-12)
+    mf1 = 2 * mprec * mrec / jnp.maximum(mprec + mrec, 1e-12)
+    micro = jnp.stack([mprec, mrec, mf1])
+    ctx.out(op, 'BatchMetrics', jnp.concatenate([macro, micro]))
+    ctx.out(op, 'AccumMetrics', jnp.concatenate([macro, micro]))
+    ctx.out(op, 'AccumStatesInfo', new_states)
+
+
+@register_op('mean_iou')
+def _mean_iou(ctx, op):
+    pred = ctx.in1(op, 'Predictions').reshape(-1).astype(jnp.int32)
+    label = ctx.in1(op, 'Labels').reshape(-1).astype(jnp.int32)
+    num_classes = op.attr('num_classes')
+    inter = jnp.zeros(num_classes).at[pred].add(
+        (pred == label).astype(jnp.float32))
+    pred_cnt = jnp.zeros(num_classes).at[pred].add(1.0)
+    lab_cnt = jnp.zeros(num_classes).at[label].add(1.0)
+    union = pred_cnt + lab_cnt - inter
+    valid = union > 0
+    iou = jnp.where(valid, inter / jnp.maximum(union, 1e-12), 0.0)
+    miou = jnp.sum(iou) / jnp.maximum(jnp.sum(valid), 1)
+    ctx.out(op, 'OutMeanIou', miou.reshape(1))
+    ctx.out(op, 'OutWrong', (pred_cnt - inter).astype(jnp.int32))
+    ctx.out(op, 'OutCorrect', inter.astype(jnp.int32))

@@ -1,0 +1,523 @@
+"""NN ops: softmax/losses, convolutions, pooling, normalization, resize.
+
+Reference: operators/softmax_op.cc, cross_entropy_op.cc,
+softmax_with_cross_entropy_op.cc, sigmoid_cross_entropy_with_logits_op.cc,
+conv_op.cc (+conv_cudnn), conv_transpose_op.cc, pool_op.cc, batch_norm_op.cc,
+layer_norm_op.cc, group_norm_op.cc, data_norm_op.cc, lrn_op.cc,
+interpolate_op.cc, affine_channel_op.cc, nce_op.cc, hierarchical_sigmoid_op.cc.
+
+Convs/matmuls use lax.conv_general_dilated / dot so XLA tiles them on the MXU;
+bf16 inputs keep fp32 accumulation via preferred_element_type.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op
+
+
+@register_op('softmax')
+def _softmax(ctx, op):
+    x = ctx.in1(op, 'X')
+    ctx.out(op, 'Out', jax.nn.softmax(x, axis=-1))
+
+
+@register_op('sequence_softmax')
+def _sequence_softmax_placeholder(ctx, op):
+    # real ragged version lives in sequence_ops; dense fallback
+    x = ctx.in1(op, 'X')
+    ctx.out(op, 'Out', jax.nn.softmax(x, axis=-1))
+
+
+def _gather_label(x, label):
+    lab = label.reshape(-1).astype(jnp.int32)
+    return jnp.take_along_axis(x, lab[:, None], axis=-1), lab
+
+
+@register_op('cross_entropy')
+def _cross_entropy(ctx, op):
+    x = ctx.in1(op, 'X')           # (N, C) probabilities
+    label = ctx.in1(op, 'Label')
+    soft_label = op.attr('soft_label', False)
+    ignore_index = op.attr('ignore_index', -100)
+    xc = jnp.clip(x, 1e-20, 1.0)
+    if soft_label:
+        out = -jnp.sum(label * jnp.log(xc), axis=-1, keepdims=True)
+    else:
+        p, lab = _gather_label(xc, label)
+        out = -jnp.log(p)
+        mask = (lab != ignore_index)[:, None]
+        out = jnp.where(mask, out, 0.0)
+    ctx.out(op, 'Y', out)
+
+
+@register_op('softmax_with_cross_entropy')
+def _softmax_with_ce(ctx, op):
+    logits = ctx.in1(op, 'Logits')
+    label = ctx.in1(op, 'Label')
+    soft_label = op.attr('soft_label', False)
+    ignore_index = op.attr('ignore_index', -100)
+    log_sm = jax.nn.log_softmax(logits, axis=-1)
+    ctx.out(op, 'Softmax', jnp.exp(log_sm))
+    if soft_label:
+        loss = -jnp.sum(label * log_sm, axis=-1, keepdims=True)
+    else:
+        p, lab = _gather_label(log_sm, label)
+        loss = -p
+        loss = jnp.where((lab != ignore_index)[:, None], loss, 0.0)
+    ctx.out(op, 'Loss', loss)
+
+
+@register_op('sigmoid_cross_entropy_with_logits')
+def _sigmoid_ce(ctx, op):
+    x = ctx.in1(op, 'X')
+    label = ctx.in1(op, 'Label')
+    ignore_index = op.attr('ignore_index', -100)
+    loss = jnp.maximum(x, 0.0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    loss = jnp.where(label == ignore_index, 0.0, loss)
+    ctx.out(op, 'Out', loss)
+
+
+# ---------------------------------------------------------------------------
+# Convolution / pooling
+# ---------------------------------------------------------------------------
+
+def _pair(v, n=2):
+    if isinstance(v, (list, tuple)):
+        return tuple(v)
+    return (v,) * n
+
+
+@register_op('conv2d')
+def _conv2d(ctx, op):
+    x = ctx.in1(op, 'Input')       # NCHW
+    w = ctx.in1(op, 'Filter')      # OIHW (I = C/groups)
+    strides = _pair(op.attr('strides', [1, 1]))
+    pads = _pair(op.attr('paddings', [0, 0]))
+    dilations = _pair(op.attr('dilations', [1, 1]))
+    groups = op.attr('groups', 1) or 1
+    out = lax.conv_general_dilated(
+        x, w, window_strides=strides,
+        padding=[(pads[0], pads[0]), (pads[1], pads[1])],
+        rhs_dilation=dilations,
+        dimension_numbers=('NCHW', 'OIHW', 'NCHW'),
+        feature_group_count=groups,
+        preferred_element_type=jnp.float32)
+    ctx.out(op, 'Output', out.astype(x.dtype))
+
+
+@register_op('depthwise_conv2d')
+def _depthwise_conv2d(ctx, op):
+    _conv2d(ctx, op)
+
+
+@register_op('conv3d')
+def _conv3d(ctx, op):
+    x = ctx.in1(op, 'Input')       # NCDHW
+    w = ctx.in1(op, 'Filter')
+    strides = _pair(op.attr('strides', [1, 1, 1]), 3)
+    pads = _pair(op.attr('paddings', [0, 0, 0]), 3)
+    dilations = _pair(op.attr('dilations', [1, 1, 1]), 3)
+    groups = op.attr('groups', 1) or 1
+    out = lax.conv_general_dilated(
+        x, w, window_strides=strides,
+        padding=[(p, p) for p in pads], rhs_dilation=dilations,
+        dimension_numbers=('NCDHW', 'OIDHW', 'NCDHW'),
+        feature_group_count=groups,
+        preferred_element_type=jnp.float32)
+    ctx.out(op, 'Output', out.astype(x.dtype))
+
+
+@register_op('conv2d_transpose')
+def _conv2d_transpose(ctx, op):
+    x = ctx.in1(op, 'Input')       # NCHW
+    w = ctx.in1(op, 'Filter')      # (C_in, C_out/groups, kh, kw)
+    strides = _pair(op.attr('strides', [1, 1]))
+    pads = _pair(op.attr('paddings', [0, 0]))
+    dilations = _pair(op.attr('dilations', [1, 1]))
+    groups = op.attr('groups', 1) or 1
+    kh = (w.shape[2] - 1) * dilations[0] + 1
+    kw = (w.shape[3] - 1) * dilations[1] + 1
+    # gradient-of-conv formulation: lhs-dilate input by stride
+    out = lax.conv_general_dilated(
+        x, jnp.swapaxes(w, 0, 1)[:, :, ::-1, ::-1],
+        window_strides=(1, 1),
+        padding=[(kh - 1 - pads[0], kh - 1 - pads[0]),
+                 (kw - 1 - pads[1], kw - 1 - pads[1])],
+        lhs_dilation=strides, rhs_dilation=dilations,
+        dimension_numbers=('NCHW', 'OIHW', 'NCHW'),
+        feature_group_count=groups,
+        preferred_element_type=jnp.float32)
+    ctx.out(op, 'Output', out.astype(x.dtype))
+
+
+@register_op('depthwise_conv2d_transpose')
+def _depthwise_conv2d_transpose(ctx, op):
+    _conv2d_transpose(ctx, op)
+
+
+def _pool(x, ksize, strides, pads, ptype, exclusive, adaptive, global_pool,
+          ceil_mode):
+    n_sp = len(ksize)
+    if global_pool:
+        ksize = x.shape[-n_sp:]
+        pads = (0,) * n_sp
+        strides = (1,) * n_sp
+    if adaptive:
+        # adaptive: output size = ksize; use even splits
+        out_sz = ksize
+        in_sz = x.shape[-n_sp:]
+        strides = tuple(i // o for i, o in zip(in_sz, out_sz))
+        ksize = tuple(i - (o - 1) * s for i, o, s in
+                      zip(in_sz, out_sz, strides))
+        pads = (0,) * n_sp
+    window = (1, 1) + tuple(ksize)
+    strides_full = (1, 1) + tuple(strides)
+    pad_full = [(0, 0), (0, 0)] + [(p, p) for p in pads]
+    if ceil_mode:
+        new_pad = []
+        for i, (p, k, s) in enumerate(zip(pads, ksize, strides)):
+            in_dim = x.shape[2 + i]
+            out_dim = -(-(in_dim + 2 * p - k) // s) + 1  # ceil
+            needed = (out_dim - 1) * s + k - in_dim - p
+            new_pad.append((p, max(p, needed)))
+        pad_full = [(0, 0), (0, 0)] + new_pad
+    if ptype == 'max':
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
+            jnp.iinfo(x.dtype).min
+        return lax.reduce_window(x, init, lax.max, window, strides_full,
+                                 pad_full)
+    s = lax.reduce_window(x, 0.0, lax.add, window, strides_full, pad_full)
+    if exclusive:
+        cnt = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, window,
+                                strides_full, pad_full)
+        return s / cnt
+    return s / float(np.prod(ksize))
+
+
+@register_op('pool2d')
+def _pool2d(ctx, op):
+    x = ctx.in1(op, 'X')
+    out = _pool(x, _pair(op.attr('ksize')), _pair(op.attr('strides', [1, 1])),
+                _pair(op.attr('paddings', [0, 0])),
+                op.attr('pooling_type', 'max'),
+                op.attr('exclusive', True), op.attr('adaptive', False),
+                op.attr('global_pooling', False), op.attr('ceil_mode', False))
+    ctx.out(op, 'Out', out)
+
+
+@register_op('pool3d')
+def _pool3d(ctx, op):
+    x = ctx.in1(op, 'X')
+    out = _pool(x, _pair(op.attr('ksize'), 3),
+                _pair(op.attr('strides', [1, 1, 1]), 3),
+                _pair(op.attr('paddings', [0, 0, 0]), 3),
+                op.attr('pooling_type', 'max'),
+                op.attr('exclusive', True), op.attr('adaptive', False),
+                op.attr('global_pooling', False), op.attr('ceil_mode', False))
+    ctx.out(op, 'Out', out)
+
+
+@register_op('max_pool2d_with_index')
+def _max_pool2d_with_index(ctx, op):
+    x = ctx.in1(op, 'X')
+    ksize = _pair(op.attr('ksize'))
+    strides = _pair(op.attr('strides', [1, 1]))
+    pads = _pair(op.attr('paddings', [0, 0]))
+    out = _pool(x, ksize, strides, pads, 'max', True, False,
+                op.attr('global_pooling', False), False)
+    ctx.out(op, 'Out', out)
+    ctx.out(op, 'Mask', jnp.zeros_like(out, dtype=jnp.int32))
+
+
+# ---------------------------------------------------------------------------
+# Normalization
+# ---------------------------------------------------------------------------
+
+@register_op('batch_norm')
+def _batch_norm(ctx, op):
+    x = ctx.in1(op, 'X')
+    scale = ctx.in1(op, 'Scale')
+    bias = ctx.in1(op, 'Bias')
+    mean = ctx.in1(op, 'Mean')
+    var = ctx.in1(op, 'Variance')
+    momentum = op.attr('momentum', 0.9)
+    eps = op.attr('epsilon', 1e-5)
+    is_test = op.attr('is_test', False)
+    layout = op.attr('data_layout', 'NCHW')
+    use_global = op.attr('use_global_stats', False) or is_test
+
+    if layout == 'NCHW':
+        axes = (0,) + tuple(range(2, x.ndim))
+        bshape = (1, -1) + (1,) * (x.ndim - 2)
+    else:
+        axes = tuple(range(x.ndim - 1))
+        bshape = (1,) * (x.ndim - 1) + (-1,)
+
+    if use_global:
+        m, v = mean, var
+        ctx.out(op, 'MeanOut', mean)
+        ctx.out(op, 'VarianceOut', var)
+    else:
+        m = jnp.mean(x, axis=axes)
+        v = jnp.var(x, axis=axes)
+        ctx.out(op, 'MeanOut',
+                momentum * mean + (1.0 - momentum) * lax.stop_gradient(m))
+        ctx.out(op, 'VarianceOut',
+                momentum * var + (1.0 - momentum) * lax.stop_gradient(v))
+    ctx.out(op, 'SavedMean', m)
+    ctx.out(op, 'SavedVariance', 1.0 / jnp.sqrt(v + eps))
+    xn = (x - m.reshape(bshape)) / jnp.sqrt(v.reshape(bshape) + eps)
+    y = xn * scale.reshape(bshape) + bias.reshape(bshape)
+    ctx.out(op, 'Y', y.astype(x.dtype))
+
+
+@register_op('layer_norm')
+def _layer_norm(ctx, op):
+    x = ctx.in1(op, 'X')
+    scale = ctx.in1(op, 'Scale')
+    bias = ctx.in1(op, 'Bias')
+    eps = op.attr('epsilon', 1e-5)
+    bna = op.attr('begin_norm_axis', 1)
+    axes = tuple(range(bna, x.ndim))
+    m = jnp.mean(x, axis=axes, keepdims=True)
+    v = jnp.var(x, axis=axes, keepdims=True)
+    y = (x - m) / jnp.sqrt(v + eps)
+    tail = x.shape[bna:]
+    if scale is not None:
+        y = y * scale.reshape((1,) * bna + tail)
+    if bias is not None:
+        y = y + bias.reshape((1,) * bna + tail)
+    ctx.out(op, 'Y', y)
+    ctx.out(op, 'Mean', m.reshape(x.shape[:bna]).reshape(-1))
+    ctx.out(op, 'Variance', v.reshape(x.shape[:bna]).reshape(-1))
+
+
+@register_op('group_norm')
+def _group_norm(ctx, op):
+    x = ctx.in1(op, 'X')  # NCHW
+    scale = ctx.in1(op, 'Scale')
+    bias = ctx.in1(op, 'Bias')
+    eps = op.attr('epsilon', 1e-5)
+    groups = op.attr('groups')
+    n, c = x.shape[:2]
+    sp = x.shape[2:]
+    xg = x.reshape((n, groups, c // groups) + sp)
+    axes = tuple(range(2, xg.ndim))
+    m = jnp.mean(xg, axis=axes, keepdims=True)
+    v = jnp.var(xg, axis=axes, keepdims=True)
+    y = ((xg - m) / jnp.sqrt(v + eps)).reshape(x.shape)
+    bshape = (1, c) + (1,) * len(sp)
+    if scale is not None:
+        y = y * scale.reshape(bshape)
+    if bias is not None:
+        y = y + bias.reshape(bshape)
+    ctx.out(op, 'Y', y)
+    ctx.out(op, 'Mean', m.reshape(n, groups))
+    ctx.out(op, 'Variance', v.reshape(n, groups))
+
+
+@register_op('data_norm')
+def _data_norm(ctx, op):
+    x = ctx.in1(op, 'X')
+    sizes = ctx.in1(op, 'BatchSize')
+    sums = ctx.in1(op, 'BatchSum')
+    sqs = ctx.in1(op, 'BatchSquareSum')
+    means = sums / sizes
+    scales = jnp.sqrt(sizes / (sqs - sums * means + 1e-4))
+    ctx.out(op, 'Means', means)
+    ctx.out(op, 'Scales', scales)
+    ctx.out(op, 'Y', (x - means) * scales)
+
+
+@register_op('lrn')
+def _lrn(ctx, op):
+    x = ctx.in1(op, 'X')  # NCHW
+    n_ = op.attr('n', 5)
+    k = op.attr('k', 2.0)
+    alpha = op.attr('alpha', 1e-4)
+    beta = op.attr('beta', 0.75)
+    sq = x * x
+    half = n_ // 2
+    acc = lax.reduce_window(sq, 0.0, lax.add, (1, n_, 1, 1), (1, 1, 1, 1),
+                            [(0, 0), (half, n_ - 1 - half), (0, 0), (0, 0)])
+    mid = (k + alpha * acc) ** beta
+    ctx.out(op, 'MidOut', mid)
+    ctx.out(op, 'Out', x / mid)
+
+
+@register_op('affine_channel')
+def _affine_channel(ctx, op):
+    x = ctx.in1(op, 'X')
+    scale = ctx.in1(op, 'Scale')
+    bias = ctx.in1(op, 'Bias')
+    layout = op.attr('data_layout', 'NCHW')
+    if layout == 'NCHW':
+        bshape = (1, -1) + (1,) * (x.ndim - 2)
+    else:
+        bshape = (1,) * (x.ndim - 1) + (-1,)
+    ctx.out(op, 'Out', x * scale.reshape(bshape) + bias.reshape(bshape))
+
+
+# ---------------------------------------------------------------------------
+# Resize / interpolate
+# ---------------------------------------------------------------------------
+
+def _interp_sizes(op, x):
+    out_h = op.attr('out_h', -1)
+    out_w = op.attr('out_w', -1)
+    scale = op.attr('scale', 0.0)
+    if scale and (not out_h or out_h <= 0):
+        out_h = int(x.shape[2] * scale)
+        out_w = int(x.shape[3] * scale)
+    return out_h, out_w
+
+
+@register_op('bilinear_interp')
+def _bilinear_interp(ctx, op):
+    x = ctx.in1(op, 'X')  # NCHW
+    out_h, out_w = _interp_sizes(op, x)
+    align = op.attr('align_corners', True)
+    h, w = x.shape[2], x.shape[3]
+
+    def src_idx(out_sz, in_sz):
+        if align and out_sz > 1:
+            return jnp.arange(out_sz) * ((in_sz - 1.0) / (out_sz - 1.0))
+        ratio = in_sz / out_sz
+        return jnp.maximum((jnp.arange(out_sz) + 0.5) * ratio - 0.5, 0.0) \
+            if not align else jnp.zeros(out_sz)
+
+    ys = src_idx(out_h, h)
+    xs = src_idx(out_w, w)
+    y0 = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, h - 1)
+    x0 = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, w - 1)
+    y1 = jnp.clip(y0 + 1, 0, h - 1)
+    x1 = jnp.clip(x0 + 1, 0, w - 1)
+    wy = (ys - y0).reshape(1, 1, -1, 1)
+    wx = (xs - x0).reshape(1, 1, 1, -1)
+    g = lambda yy, xx: x[:, :, yy, :][:, :, :, xx]
+    out = (g(y0, x0) * (1 - wy) * (1 - wx) + g(y1, x0) * wy * (1 - wx) +
+           g(y0, x1) * (1 - wy) * wx + g(y1, x1) * wy * wx)
+    ctx.out(op, 'Out', out.astype(x.dtype))
+
+
+@register_op('nearest_interp')
+def _nearest_interp(ctx, op):
+    x = ctx.in1(op, 'X')
+    out_h, out_w = _interp_sizes(op, x)
+    align = op.attr('align_corners', True)
+    h, w = x.shape[2], x.shape[3]
+    if align and out_h > 1:
+        ys = jnp.round(jnp.arange(out_h) * ((h - 1.0) / (out_h - 1.0)))
+        xs = jnp.round(jnp.arange(out_w) * ((w - 1.0) / (out_w - 1.0)))
+    else:
+        ys = jnp.floor(jnp.arange(out_h) * (h / out_h))
+        xs = jnp.floor(jnp.arange(out_w) * (w / out_w))
+    ys = jnp.clip(ys.astype(jnp.int32), 0, h - 1)
+    xs = jnp.clip(xs.astype(jnp.int32), 0, w - 1)
+    ctx.out(op, 'Out', x[:, :, ys, :][:, :, :, xs])
+
+
+# ---------------------------------------------------------------------------
+# Sampled / hierarchical losses
+# ---------------------------------------------------------------------------
+
+@register_op('nce')
+def _nce(ctx, op):
+    # Noise-contrastive estimation: full-softmax equivalent computation on
+    # TPU (dense matmul beats gather-sampling on MXU for moderate vocab);
+    # sampling path kept for parity (reference operators/nce_op.cc).
+    x = ctx.in1(op, 'Input')          # (N, D)
+    label = ctx.in1(op, 'Label')      # (N, num_true)
+    w = ctx.in1(op, 'Weight')         # (V, D)
+    b = ctx.in1(op, 'Bias')           # (V,)
+    num_neg = op.attr('num_neg_samples', 10)
+    key = ctx.rng()
+    n = x.shape[0]
+    v = w.shape[0]
+    neg = jax.random.randint(key, (n, num_neg), 0, v)
+    lab = label[:, :1].reshape(-1).astype(jnp.int32)
+    ids = jnp.concatenate([lab[:, None], neg], axis=1)       # (N, 1+num_neg)
+    wg = w[ids]                                              # (N, S, D)
+    logits = jnp.einsum('nd,nsd->ns', x, wg)
+    if b is not None:
+        logits = logits + b[ids]
+    p_noise = 1.0 / v
+    logits = logits - jnp.log(num_neg * p_noise)
+    labels01 = jnp.concatenate(
+        [jnp.ones((n, 1)), jnp.zeros((n, num_neg))], axis=1)
+    loss = jnp.maximum(logits, 0) - logits * labels01 + \
+        jnp.log1p(jnp.exp(-jnp.abs(logits)))
+    ctx.out(op, 'Cost', jnp.sum(loss, axis=1, keepdims=True))
+    ctx.out(op, 'SampleLogits', logits)
+    ctx.out(op, 'SampleLabels', ids.astype(jnp.int64))
+
+
+@register_op('hierarchical_sigmoid')
+def _hsigmoid(ctx, op):
+    # Default (complete binary tree) mode of reference hsigmoid
+    # (operators/hierarchical_sigmoid_op.cc + math/matrix_bit_code.h).
+    x = ctx.in1(op, 'X')              # (N, D)
+    w = ctx.in1(op, 'W')              # (num_classes-1, D)
+    label = ctx.in1(op, 'Label')      # (N, 1)
+    bias = ctx.in1(op, 'Bias')
+    num_classes = op.attr('num_classes')
+    code_len = int(np.ceil(np.log2(num_classes)))
+    lab = label.reshape(-1).astype(jnp.int32) + num_classes  # leaf index
+    losses = []
+    node = lab
+    for _ in range(code_len):
+        parent = node // 2
+        sign = (node % 2).astype(x.dtype)          # 1 if right child
+        idx = jnp.clip(parent - 1, 0, w.shape[0] - 1)
+        valid = (parent >= 1) & (parent - 1 < w.shape[0])
+        logit = jnp.einsum('nd,nd->n', x, w[idx])
+        if bias is not None:
+            logit = logit + bias.reshape(-1)[idx]
+        l = jnp.maximum(logit, 0) - logit * sign + \
+            jnp.log1p(jnp.exp(-jnp.abs(logit)))
+        losses.append(jnp.where(valid, l, 0.0))
+        node = parent
+    ctx.out(op, 'Out', jnp.stack(losses, 1).sum(1, keepdims=True))
+    ctx.out(op, 'PreOut', jnp.zeros((x.shape[0], code_len), dtype=x.dtype))
+
+
+@register_op('sample_logits')
+def _sample_logits(ctx, op):
+    logits = ctx.in1(op, 'Logits')
+    labels = ctx.in1(op, 'Labels')
+    num_samples = op.attr('num_samples')
+    key = ctx.rng()
+    n, v = logits.shape
+    neg = jax.random.randint(key, (n, num_samples), 0, v)
+    ids = jnp.concatenate([labels.astype(jnp.int32), neg], axis=1)
+    out = jnp.take_along_axis(logits, ids, axis=1)
+    ctx.out(op, 'SampledLogits', out)
+    ctx.out(op, 'Samples', ids.astype(jnp.int64))
+    ctx.out(op, 'SampledLabels',
+            jnp.zeros((n, labels.shape[1]), dtype=jnp.int64))
+    ctx.out(op, 'Probabilities', jnp.full_like(out, 1.0 / v))
+
+
+@register_op('im2sequence')
+def _im2sequence(ctx, op):
+    x = ctx.in1(op, 'X')  # NCHW
+    kernels = op.attr('kernels')
+    strides = op.attr('strides', [1, 1])
+    paddings = op.attr('paddings', [0, 0, 0, 0])
+    n, c, h, w = x.shape
+    kh, kw = kernels
+    xp = jnp.pad(x, [(0, 0), (0, 0), (paddings[0], paddings[2]),
+                     (paddings[1], paddings[3])])
+    oh = (xp.shape[2] - kh) // strides[0] + 1
+    ow = (xp.shape[3] - kw) // strides[1] + 1
+    patches = []
+    for i in range(kh):
+        for j in range(kw):
+            patches.append(
+                xp[:, :, i:i + oh * strides[0]:strides[0],
+                   j:j + ow * strides[1]:strides[1]])
+    out = jnp.stack(patches, axis=2).reshape(n, c * kh * kw, oh * ow)
+    out = out.transpose(0, 2, 1).reshape(n * oh * ow, c * kh * kw)
+    ctx.out(op, 'Out', out)

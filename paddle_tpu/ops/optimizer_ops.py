@@ -1,0 +1,258 @@
+"""Optimizer ops: functional (param, grad, state) -> (param', state') updates.
+
+Reference: operators/optimizers/*.cc (sgd, momentum, lars_momentum, adagrad,
+adam, adamax, adadelta, decayed_adagrad, ftrl, rmsprop, proximal_gd,
+proximal_adagrad — each with dense + SelectedRows kernels). Here each is a pure
+jnp expression inside the compiled step; XLA buffer donation makes the update
+in-place (the SelectedRows sparse path becomes a dense scatter-add before
+apply, see optimizer.py).
+"""
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+def _lr(ctx, op):
+    lr = ctx.in1(op, 'LearningRate')
+    return lr.reshape(()) if lr.ndim else lr
+
+
+@register_op('sgd')
+def _sgd(ctx, op):
+    p = ctx.in1(op, 'Param')
+    g = ctx.in1(op, 'Grad')
+    lr = _lr(ctx, op)
+    ctx.out(op, 'ParamOut', p - lr.astype(p.dtype) * g.astype(p.dtype))
+
+
+@register_op('momentum')
+def _momentum(ctx, op):
+    p = ctx.in1(op, 'Param')
+    g = ctx.in1(op, 'Grad')
+    v = ctx.in1(op, 'Velocity')
+    lr = _lr(ctx, op)
+    mu = op.attr('mu')
+    nesterov = op.attr('use_nesterov', False)
+    v_out = mu * v + g
+    if nesterov:
+        p_out = p - (g + mu * v_out) * lr
+    else:
+        p_out = p - lr * v_out
+    ctx.out(op, 'ParamOut', p_out)
+    ctx.out(op, 'VelocityOut', v_out)
+
+
+@register_op('lars_momentum')
+def _lars_momentum(ctx, op):
+    p = ctx.in1(op, 'Param')
+    g = ctx.in1(op, 'Grad')
+    v = ctx.in1(op, 'Velocity')
+    lr = _lr(ctx, op)
+    mu = op.attr('mu')
+    coeff = op.attr('lars_coeff', 0.001)
+    decay = op.attr('lars_weight_decay', 0.0005)
+    pn = jnp.sqrt(jnp.sum(p * p))
+    gn = jnp.sqrt(jnp.sum(g * g))
+    local_lr = jnp.where(pn > 0, lr * coeff * pn / (gn + decay * pn + 1e-12),
+                         lr)
+    v_out = mu * v + local_lr * (g + decay * p)
+    ctx.out(op, 'ParamOut', p - v_out)
+    ctx.out(op, 'VelocityOut', v_out)
+
+
+@register_op('adam')
+def _adam(ctx, op):
+    p = ctx.in1(op, 'Param')
+    g = ctx.in1(op, 'Grad')
+    m1 = ctx.in1(op, 'Moment1')
+    m2 = ctx.in1(op, 'Moment2')
+    b1p = ctx.in1(op, 'Beta1Pow').reshape(())
+    b2p = ctx.in1(op, 'Beta2Pow').reshape(())
+    lr = _lr(ctx, op)
+    b1 = op.attr('beta1', 0.9)
+    b2 = op.attr('beta2', 0.999)
+    eps = op.attr('epsilon', 1e-8)
+    m1o = b1 * m1 + (1 - b1) * g
+    m2o = b2 * m2 + (1 - b2) * g * g
+    lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+    ctx.out(op, 'ParamOut', p - lr_t * m1o / (jnp.sqrt(m2o) + eps))
+    ctx.out(op, 'Moment1Out', m1o)
+    ctx.out(op, 'Moment2Out', m2o)
+    ctx.out(op, 'Beta1PowOut', (b1p * b1).reshape(1))
+    ctx.out(op, 'Beta2PowOut', (b2p * b2).reshape(1))
+
+
+@register_op('adamax')
+def _adamax(ctx, op):
+    p = ctx.in1(op, 'Param')
+    g = ctx.in1(op, 'Grad')
+    m = ctx.in1(op, 'Moment')
+    inf = ctx.in1(op, 'InfNorm')
+    b1p = ctx.in1(op, 'Beta1Pow').reshape(())
+    lr = _lr(ctx, op)
+    b1 = op.attr('beta1', 0.9)
+    b2 = op.attr('beta2', 0.999)
+    eps = op.attr('epsilon', 1e-8)
+    mo = b1 * m + (1 - b1) * g
+    info = jnp.maximum(b2 * inf, jnp.abs(g))
+    lr_t = lr / (1 - b1p)
+    ctx.out(op, 'ParamOut', p - lr_t * mo / (info + eps))
+    ctx.out(op, 'MomentOut', mo)
+    ctx.out(op, 'InfNormOut', info)
+
+
+@register_op('adagrad')
+def _adagrad(ctx, op):
+    p = ctx.in1(op, 'Param')
+    g = ctx.in1(op, 'Grad')
+    m = ctx.in1(op, 'Moment')
+    lr = _lr(ctx, op)
+    eps = op.attr('epsilon', 1e-6)
+    mo = m + g * g
+    ctx.out(op, 'ParamOut', p - lr * g / (jnp.sqrt(mo) + eps))
+    ctx.out(op, 'MomentOut', mo)
+
+
+@register_op('decayed_adagrad')
+def _decayed_adagrad(ctx, op):
+    p = ctx.in1(op, 'Param')
+    g = ctx.in1(op, 'Grad')
+    m = ctx.in1(op, 'Moment')
+    lr = _lr(ctx, op)
+    decay = op.attr('decay', 0.95)
+    eps = op.attr('epsilon', 1e-6)
+    mo = decay * m + (1 - decay) * g * g
+    ctx.out(op, 'ParamOut', p - lr * g / (jnp.sqrt(mo) + eps))
+    ctx.out(op, 'MomentOut', mo)
+
+
+@register_op('adadelta')
+def _adadelta(ctx, op):
+    p = ctx.in1(op, 'Param')
+    g = ctx.in1(op, 'Grad')
+    eg = ctx.in1(op, 'AvgSquaredGrad')
+    ex = ctx.in1(op, 'AvgSquaredUpdate')
+    rho = op.attr('rho', 0.95)
+    eps = op.attr('epsilon', 1e-6)
+    ego = rho * eg + (1 - rho) * g * g
+    update = -jnp.sqrt((ex + eps) / (ego + eps)) * g
+    exo = rho * ex + (1 - rho) * update * update
+    ctx.out(op, 'ParamOut', p + update)
+    ctx.out(op, 'AvgSquaredGradOut', ego)
+    ctx.out(op, 'AvgSquaredUpdateOut', exo)
+
+
+@register_op('rmsprop')
+def _rmsprop(ctx, op):
+    p = ctx.in1(op, 'Param')
+    g = ctx.in1(op, 'Grad')
+    ms = ctx.in1(op, 'MeanSquare')
+    mom = ctx.in1(op, 'Moment')
+    lr = _lr(ctx, op)
+    rho = op.attr('decay', 0.95)
+    eps = op.attr('epsilon', 1e-6)
+    momentum = op.attr('momentum', 0.0)
+    centered = op.attr('centered', False)
+    mso = rho * ms + (1 - rho) * g * g
+    ctx.out(op, 'MeanSquareOut', mso)
+    if centered:
+        mg = ctx.in1(op, 'MeanGrad')
+        mgo = rho * mg + (1 - rho) * g
+        denom = mso - mgo * mgo + eps
+        ctx.out(op, 'MeanGradOut', mgo)
+    else:
+        denom = mso + eps
+    momo = momentum * mom + lr * g / jnp.sqrt(denom)
+    ctx.out(op, 'MomentOut', momo)
+    ctx.out(op, 'ParamOut', p - momo)
+
+
+@register_op('ftrl')
+def _ftrl(ctx, op):
+    p = ctx.in1(op, 'Param')
+    g = ctx.in1(op, 'Grad')
+    sq = ctx.in1(op, 'SquaredAccumulator')
+    lin = ctx.in1(op, 'LinearAccumulator')
+    lr = _lr(ctx, op)
+    l1 = op.attr('l1', 0.0)
+    l2 = op.attr('l2', 0.0)
+    power = op.attr('lr_power', -0.5)
+    new_sq = sq + g * g
+    sigma = (new_sq ** -power - sq ** -power) / lr
+    lino = lin + g - sigma * p
+    y = new_sq ** -power / lr + 2 * l2
+    p_out = jnp.where(jnp.abs(lino) > l1,
+                      (jnp.sign(lino) * l1 - lino) / y, 0.0)
+    ctx.out(op, 'ParamOut', p_out)
+    ctx.out(op, 'SquaredAccumOut', new_sq)
+    ctx.out(op, 'LinearAccumOut', lino)
+
+
+@register_op('proximal_gd')
+def _proximal_gd(ctx, op):
+    p = ctx.in1(op, 'Param')
+    g = ctx.in1(op, 'Grad')
+    lr = _lr(ctx, op)
+    l1 = op.attr('l1', 0.0)
+    l2 = op.attr('l2', 0.0)
+    prox = p - lr * g
+    p_out = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0) / \
+        (1.0 + lr * l2)
+    ctx.out(op, 'ParamOut', p_out)
+
+
+@register_op('proximal_adagrad')
+def _proximal_adagrad(ctx, op):
+    p = ctx.in1(op, 'Param')
+    g = ctx.in1(op, 'Grad')
+    m = ctx.in1(op, 'Moment')
+    lr = _lr(ctx, op)
+    l1 = op.attr('l1', 0.0)
+    l2 = op.attr('l2', 0.0)
+    mo = m + g * g
+    lr_t = lr / jnp.sqrt(mo)
+    prox = p - lr_t * g
+    p_out = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr_t * l1, 0.0) / \
+        (1.0 + lr_t * l2)
+    ctx.out(op, 'ParamOut', p_out)
+    ctx.out(op, 'MomentOut', mo)
+
+
+@register_op('average_accumulates')
+def _average_accumulates(ctx, op):
+    # ModelAverage support (reference optimizer.py:1484 + operators/
+    # average_accumulates_op.cc): accumulate sums of params over windows.
+    p = ctx.in1(op, 'param')
+    sum1 = ctx.in1(op, 'in_sum_1')
+    sum2 = ctx.in1(op, 'in_sum_2')
+    sum3 = ctx.in1(op, 'in_sum_3')
+    num_acc = ctx.in1(op, 'in_num_accumulates').reshape(())
+    old_num = ctx.in1(op, 'in_old_num_accumulates').reshape(())
+    num_upd = ctx.in1(op, 'in_num_updates').reshape(())
+    avg_window = op.attr('average_window', 10000.0)
+    max_avg = op.attr('max_average_window', 10000)
+    min_avg = op.attr('min_average_window', 10000)
+    k_max_num_accumulates = 16384  # reference average_accumulates_op.h
+    num_acc = num_acc + 1
+    num_upd = num_upd + 1
+    sum1 = sum1 + p
+    # periodic fold of sum1 into sum2 to bound fp error
+    fold = (num_upd % k_max_num_accumulates) == 0
+    sum2 = jnp.where(fold, sum2 + sum1, sum2)
+    sum1 = jnp.where(fold, jnp.zeros_like(sum1), sum1)
+    # window shift: reference condition uses min(max_window, updates*rate)
+    window = jnp.minimum(jnp.asarray(float(max_avg)),
+                         num_upd.astype(jnp.float32) * avg_window)
+    do_shift = (num_acc >= min_avg) & \
+        (num_acc.astype(jnp.float32) >= window)
+    sum3o = jnp.where(do_shift, sum1 + sum2, sum3)
+    sum1o = jnp.where(do_shift, jnp.zeros_like(sum1), sum1)
+    sum2o = jnp.where(do_shift, jnp.zeros_like(sum2), sum2)
+    old_o = jnp.where(do_shift, num_acc, old_num)
+    acc_o = jnp.where(do_shift, jnp.zeros_like(num_acc), num_acc)
+    ctx.out(op, 'out_sum_1', sum1o)
+    ctx.out(op, 'out_sum_2', sum2o)
+    ctx.out(op, 'out_sum_3', sum3o)
+    ctx.out(op, 'out_num_accumulates', acc_o.reshape(1))
+    ctx.out(op, 'out_old_num_accumulates', old_o.reshape(1))
+    ctx.out(op, 'out_num_updates', num_upd.reshape(1))

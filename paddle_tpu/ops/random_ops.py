@@ -1,0 +1,112 @@
+"""Random ops: init distributions + dropout + random_crop.
+
+Reference: operators/uniform_random_op.cc, gaussian_random_op.cc,
+truncated_gaussian_random_op.cc, dropout_op.cc, random_crop_op.cc.
+Keys derive deterministically from the run key + op index (core/lowering.py),
+so dropout masks are reproducible given program.random_seed, matching the
+reference's seeded-philox behavior.
+"""
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from .common import np_dtype
+
+
+def _maybe_seeded_key(ctx, op):
+    seed = op.attr('seed', 0)
+    key = ctx.rng()
+    if seed:
+        key = jax.random.PRNGKey(seed)
+        key = jax.random.fold_in(key, ctx.op_index)
+    return key
+
+
+@register_op('uniform_random', needs_rng=True)
+def _uniform_random(ctx, op):
+    dtype = np_dtype(op.attr('dtype'))
+    shape = tuple(op.attr('shape'))
+    lo = op.attr('min', -1.0)
+    hi = op.attr('max', 1.0)
+    out = jax.random.uniform(_maybe_seeded_key(ctx, op), shape,
+                             dtype=jnp.float32, minval=lo, maxval=hi)
+    ctx.out(op, 'Out', out.astype(dtype))
+
+
+@register_op('uniform_random_batch_size_like', needs_rng=True)
+def _uniform_random_bsl(ctx, op):
+    x = ctx.in1(op, 'Input')
+    dtype = np_dtype(op.attr('dtype'))
+    shape = list(op.attr('shape'))
+    shape[op.attr('output_dim_idx', 0)] = x.shape[op.attr('input_dim_idx', 0)]
+    out = jax.random.uniform(_maybe_seeded_key(ctx, op), tuple(shape),
+                             dtype=jnp.float32,
+                             minval=op.attr('min', -1.0),
+                             maxval=op.attr('max', 1.0))
+    ctx.out(op, 'Out', out.astype(dtype))
+
+
+@register_op('gaussian_random', needs_rng=True)
+def _gaussian_random(ctx, op):
+    dtype = np_dtype(op.attr('dtype'))
+    shape = tuple(op.attr('shape'))
+    mean = op.attr('mean', 0.0)
+    std = op.attr('std', 1.0)
+    out = mean + std * jax.random.normal(_maybe_seeded_key(ctx, op), shape,
+                                         dtype=jnp.float32)
+    ctx.out(op, 'Out', out.astype(dtype))
+
+
+@register_op('truncated_gaussian_random', needs_rng=True)
+def _truncated_gaussian_random(ctx, op):
+    dtype = np_dtype(op.attr('dtype'))
+    shape = tuple(op.attr('shape'))
+    mean = op.attr('mean', 0.0)
+    std = op.attr('std', 1.0)
+    out = mean + std * jax.random.truncated_normal(
+        _maybe_seeded_key(ctx, op), -2.0, 2.0, shape, dtype=jnp.float32)
+    ctx.out(op, 'Out', out.astype(dtype))
+
+
+@register_op('dropout', needs_rng=True)
+def _dropout(ctx, op):
+    x = ctx.in1(op, 'X')
+    prob = op.attr('dropout_prob', 0.5)
+    is_test = op.attr('is_test', False)
+    impl = op.attr('dropout_implementation', 'downgrade_in_infer')
+    if is_test:
+        if impl == 'downgrade_in_infer':
+            out = x * (1.0 - prob)
+        else:
+            out = x
+        ctx.out(op, 'Out', out)
+        ctx.out(op, 'Mask', jnp.ones_like(x))
+        return
+    keep = jax.random.bernoulli(_maybe_seeded_key(ctx, op), 1.0 - prob,
+                                x.shape)
+    mask = keep.astype(x.dtype)
+    if impl == 'upscale_in_train':
+        out = jnp.where(prob < 1.0, x * mask / (1.0 - prob),
+                        jnp.zeros_like(x))
+    else:
+        out = x * mask
+    ctx.out(op, 'Out', out)
+    ctx.out(op, 'Mask', mask)
+
+
+@register_op('random_crop', needs_rng=True)
+def _random_crop(ctx, op):
+    x = ctx.in1(op, 'X')
+    shape = op.attr('shape')
+    key = _maybe_seeded_key(ctx, op)
+    n_crop = len(shape)
+    starts = []
+    for i, s in enumerate(shape):
+        dim = x.shape[x.ndim - n_crop + i]
+        key, sub = jax.random.split(key)
+        starts.append(jax.random.randint(sub, (), 0, dim - s + 1))
+    idx = [slice(None)] * (x.ndim - n_crop)
+    out = jax.lax.dynamic_slice(
+        x, [0] * (x.ndim - n_crop) + starts,
+        list(x.shape[:x.ndim - n_crop]) + list(shape))
+    ctx.out(op, 'Out', out)

@@ -1,0 +1,21 @@
+"""Sequence (ragged/LoD) ops — placeholder module; full segment-id based
+implementations land with the ragged tensor subsystem (stage 6).
+Reference: operators/sequence_ops/ (17 ops)."""
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+@register_op('sequence_mask')
+def _sequence_mask(ctx, op):
+    x = ctx.in1(op, 'X')
+    maxlen = op.attr('maxlen', -1)
+    from .common import np_dtype
+    dtype = np_dtype(op.attr('out_dtype', 'int64'))
+    if maxlen is None or maxlen < 0:
+        raise NotImplementedError(
+            "sequence_mask with dynamic maxlen requires static shapes on "
+            "TPU; pass maxlen explicitly")
+    lens = x.reshape(x.shape + (1,))
+    mask = jnp.arange(maxlen) < lens
+    ctx.out(op, 'Y', mask.astype(dtype))

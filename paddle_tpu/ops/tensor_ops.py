@@ -1,0 +1,411 @@
+"""Tensor manipulation ops: reshape/transpose/concat/split/gather/pad/...
+
+Reference: operators/reshape_op.cc (reshape2 carries XShape for grad — not
+needed under JAX AD but emitted for program parity), transpose_op.cc,
+concat_op.cc, split_op.cc, squeeze/unsqueeze/flatten/stack/unstack/expand/
+pad/slice/gather/scatter/lookup_table/top_k/arg_{max,min}/argsort ops.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op
+from .common import np_dtype
+
+
+def _infer_reshape(x, shape):
+    shape = list(shape)
+    # fluid semantics: 0 means copy input dim; -1 inferred
+    for i, s in enumerate(shape):
+        if s == 0:
+            shape[i] = x.shape[i]
+    if -1 in shape:
+        known = int(np.prod([s for s in shape if s != -1]))
+        shape[shape.index(-1)] = int(x.size) // max(known, 1)
+    return tuple(shape)
+
+
+@register_op('reshape')
+def _reshape(ctx, op):
+    x = ctx.in1(op, 'X')
+    ctx.out(op, 'Out', x.reshape(_infer_reshape(x, op.attr('shape'))))
+
+
+@register_op('reshape2')
+def _reshape2(ctx, op):
+    x = ctx.in1(op, 'X')
+    ctx.out(op, 'Out', x.reshape(_infer_reshape(x, op.attr('shape'))))
+    if op.output('XShape'):
+        ctx.out(op, 'XShape', jnp.zeros((0,) + x.shape, dtype=x.dtype))
+
+
+@register_op('transpose')
+def _transpose(ctx, op):
+    x = ctx.in1(op, 'X')
+    ctx.out(op, 'Out', jnp.transpose(x, op.attr('axis')))
+
+
+@register_op('transpose2')
+def _transpose2(ctx, op):
+    x = ctx.in1(op, 'X')
+    ctx.out(op, 'Out', jnp.transpose(x, op.attr('axis')))
+    if op.output('XShape'):
+        ctx.out(op, 'XShape', jnp.zeros((0,) + x.shape, dtype=x.dtype))
+
+
+@register_op('concat')
+def _concat(ctx, op):
+    xs = ctx.in_list(op, 'X')
+    ctx.out(op, 'Out', jnp.concatenate(xs, axis=op.attr('axis', 0)))
+
+
+@register_op('split')
+def _split(ctx, op):
+    x = ctx.in1(op, 'X')
+    axis = op.attr('axis', 0)
+    num = op.attr('num', 0)
+    sections = op.attr('sections', [])
+    outs = op.output('Out')
+    if sections:
+        idx = np.cumsum(sections)[:-1]
+        parts = jnp.split(x, idx, axis=axis)
+    else:
+        parts = jnp.split(x, num or len(outs), axis=axis)
+    for i, p in enumerate(parts):
+        ctx.out(op, 'Out', p, idx=i)
+
+
+def _register_shape_ops():
+    @register_op('squeeze')
+    def _squeeze(ctx, op):
+        x = ctx.in1(op, 'X')
+        axes = op.attr('axes', [])
+        if axes:
+            out = x.reshape(tuple(s for i, s in enumerate(x.shape)
+                                  if not (i in axes and s == 1)))
+        else:
+            out = jnp.squeeze(x)
+        ctx.out(op, 'Out', out)
+
+    @register_op('squeeze2')
+    def _squeeze2(ctx, op):
+        _squeeze(ctx, op)
+        if op.output('XShape'):
+            x = ctx.in1(op, 'X')
+            ctx.out(op, 'XShape', jnp.zeros((0,) + x.shape, dtype=x.dtype))
+
+    @register_op('unsqueeze')
+    def _unsqueeze(ctx, op):
+        x = ctx.in1(op, 'X')
+        out = x
+        for a in sorted(op.attr('axes')):
+            out = jnp.expand_dims(out, a)
+        ctx.out(op, 'Out', out)
+
+    @register_op('unsqueeze2')
+    def _unsqueeze2(ctx, op):
+        _unsqueeze(ctx, op)
+        if op.output('XShape'):
+            x = ctx.in1(op, 'X')
+            ctx.out(op, 'XShape', jnp.zeros((0,) + x.shape, dtype=x.dtype))
+
+    @register_op('flatten')
+    def _flatten(ctx, op):
+        x = ctx.in1(op, 'X')
+        axis = op.attr('axis', 1)
+        lead = int(np.prod(x.shape[:axis])) if axis > 0 else 1
+        ctx.out(op, 'Out', x.reshape(lead, -1))
+
+    @register_op('flatten2')
+    def _flatten2(ctx, op):
+        _flatten(ctx, op)
+        if op.output('XShape'):
+            x = ctx.in1(op, 'X')
+            ctx.out(op, 'XShape', jnp.zeros((0,) + x.shape, dtype=x.dtype))
+
+
+_register_shape_ops()
+
+
+@register_op('stack')
+def _stack(ctx, op):
+    xs = ctx.in_list(op, 'X')
+    ctx.out(op, 'Y', jnp.stack(xs, axis=op.attr('axis', 0)))
+
+
+@register_op('unstack')
+def _unstack(ctx, op):
+    x = ctx.in1(op, 'X')
+    axis = op.attr('axis', 0)
+    parts = jnp.split(x, x.shape[axis], axis=axis)
+    for i, p in enumerate(parts):
+        ctx.out(op, 'Y', jnp.squeeze(p, axis=axis), idx=i)
+
+
+@register_op('expand')
+def _expand(ctx, op):
+    x = ctx.in1(op, 'X')
+    times = op.attr('expand_times')
+    ctx.out(op, 'Out', jnp.tile(x, times))
+
+
+@register_op('tile')
+def _tile(ctx, op):
+    x = ctx.in1(op, 'X')
+    ctx.out(op, 'Out', jnp.tile(x, op.attr('repeat_times')))
+
+
+@register_op('pad')
+def _pad(ctx, op):
+    x = ctx.in1(op, 'X')
+    paddings = op.attr('paddings')
+    pad_value = op.attr('pad_value', 0.0)
+    cfg = [(paddings[2 * i], paddings[2 * i + 1]) for i in range(x.ndim)]
+    ctx.out(op, 'Out', jnp.pad(x, cfg, constant_values=pad_value))
+
+
+@register_op('pad2d')
+def _pad2d(ctx, op):
+    x = ctx.in1(op, 'X')  # NCHW
+    p = op.attr('paddings')  # [top, bottom, left, right]
+    mode = op.attr('mode', 'constant')
+    value = op.attr('pad_value', 0.0)
+    cfg = [(0, 0), (0, 0), (p[0], p[1]), (p[2], p[3])]
+    if mode == 'constant':
+        out = jnp.pad(x, cfg, constant_values=value)
+    elif mode == 'reflect':
+        out = jnp.pad(x, cfg, mode='reflect')
+    else:
+        out = jnp.pad(x, cfg, mode='edge')
+    ctx.out(op, 'Out', out)
+
+
+@register_op('pad_constant_like')
+def _pad_constant_like(ctx, op):
+    x = ctx.in1(op, 'X')
+    y = ctx.in1(op, 'Y')
+    value = op.attr('pad_value', 0.0)
+    cfg = [(0, xs - ys) for xs, ys in zip(x.shape, y.shape)]
+    ctx.out(op, 'Out', jnp.pad(y, cfg, constant_values=value))
+
+
+@register_op('slice')
+def _slice(ctx, op):
+    x = ctx.in1(op, 'Input')
+    axes = op.attr('axes')
+    starts = op.attr('starts')
+    ends = op.attr('ends')
+    idx = [slice(None)] * x.ndim
+    for a, s, e in zip(axes, starts, ends):
+        dim = x.shape[a]
+        s = max(s + dim, 0) if s < 0 else min(s, dim)
+        e = max(e + dim, 0) if e < 0 else min(e, dim)
+        idx[a] = slice(s, e)
+    ctx.out(op, 'Out', x[tuple(idx)])
+
+
+@register_op('strided_slice')
+def _strided_slice(ctx, op):
+    x = ctx.in1(op, 'Input')
+    axes = op.attr('axes')
+    starts = op.attr('starts')
+    ends = op.attr('ends')
+    strides = op.attr('strides')
+    idx = [slice(None)] * x.ndim
+    for a, s, e, st in zip(axes, starts, ends, strides):
+        idx[a] = slice(s, e, st)
+    ctx.out(op, 'Out', x[tuple(idx)])
+
+
+@register_op('crop')
+def _crop(ctx, op):
+    x = ctx.in1(op, 'X')
+    offsets = op.attr('offsets')
+    shape = op.attr('shape')
+    idx = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
+    ctx.out(op, 'Out', x[idx])
+
+
+@register_op('gather')
+def _gather(ctx, op):
+    x = ctx.in1(op, 'X')
+    index = ctx.in1(op, 'Index').reshape(-1).astype(jnp.int32)
+    ctx.out(op, 'Out', jnp.take(x, index, axis=0))
+
+
+@register_op('scatter')
+def _scatter(ctx, op):
+    x = ctx.in1(op, 'X')
+    ids = ctx.in1(op, 'Ids').reshape(-1).astype(jnp.int32)
+    updates = ctx.in1(op, 'Updates')
+    overwrite = op.attr('overwrite', True)
+    if overwrite:
+        out = x.at[ids].set(updates)
+    else:
+        out = x.at[ids].add(updates)
+    ctx.out(op, 'Out', out)
+
+
+@register_op('gather_nd')
+def _gather_nd(ctx, op):
+    x = ctx.in1(op, 'X')
+    index = ctx.in1(op, 'Index').astype(jnp.int32)
+    ctx.out(op, 'Out', x[tuple(jnp.moveaxis(index, -1, 0))])
+
+
+@register_op('lookup_table')
+def _lookup_table(ctx, op):
+    w = ctx.in1(op, 'W')
+    ids = ctx.in1(op, 'Ids')
+    padding_idx = op.attr('padding_idx', -1)
+    flat = ids.reshape(-1).astype(jnp.int32)
+    out = jnp.take(w, flat, axis=0)
+    if padding_idx is not None and padding_idx != -1:
+        pad = padding_idx if padding_idx >= 0 else padding_idx + w.shape[0]
+        out = jnp.where((flat == pad)[:, None], 0.0, out)
+    out_shape = ids.shape[:-1] + (w.shape[1],) if ids.shape and \
+        ids.shape[-1] == 1 else ids.shape + (w.shape[1],)
+    ctx.out(op, 'Out', out.reshape(out_shape))
+
+
+@register_op('top_k')
+def _top_k(ctx, op):
+    x = ctx.in1(op, 'X')
+    k = op.attr('k', 1)
+    vals, idx = lax.top_k(x, k)
+    ctx.out(op, 'Out', vals)
+    ctx.out(op, 'Indices', idx.astype(jnp.int64))
+
+
+@register_op('arg_max')
+def _arg_max(ctx, op):
+    x = ctx.in1(op, 'X')
+    axis = op.attr('axis', -1)
+    ctx.out(op, 'Out', jnp.argmax(x, axis=axis).astype(jnp.int64))
+
+
+@register_op('arg_min')
+def _arg_min(ctx, op):
+    x = ctx.in1(op, 'X')
+    axis = op.attr('axis', -1)
+    ctx.out(op, 'Out', jnp.argmin(x, axis=axis).astype(jnp.int64))
+
+
+@register_op('argsort')
+def _argsort(ctx, op):
+    x = ctx.in1(op, 'X')
+    axis = op.attr('axis', -1)
+    idx = jnp.argsort(x, axis=axis)
+    ctx.out(op, 'Indices', idx.astype(jnp.int64))
+    ctx.out(op, 'Out', jnp.sort(x, axis=axis))
+
+
+@register_op('reverse')
+def _reverse(ctx, op):
+    x = ctx.in1(op, 'X')
+    axes = op.attr('axis')
+    if not isinstance(axes, (list, tuple)):
+        axes = [axes]
+    ctx.out(op, 'Out', jnp.flip(x, axis=tuple(axes)))
+
+
+@register_op('multiplex')
+def _multiplex(ctx, op):
+    ids = ctx.in1(op, 'Ids').reshape(-1).astype(jnp.int32)
+    xs = jnp.stack(ctx.in_list(op, 'X'), axis=0)
+    ctx.out(op, 'Out', xs[ids, jnp.arange(xs.shape[1])])
+
+
+@register_op('where')
+def _where(ctx, op):
+    cond = ctx.in1(op, 'Condition')
+    x = ctx.in1(op, 'X')
+    y = ctx.in1(op, 'Y')
+    ctx.out(op, 'Out', jnp.where(cond, x, y))
+
+
+@register_op('space_to_depth')
+def _space_to_depth(ctx, op):
+    x = ctx.in1(op, 'X')  # NCHW
+    bs = op.attr('blocksize')
+    n, c, h, w = x.shape
+    out = x.reshape(n, c, h // bs, bs, w // bs, bs)
+    out = out.transpose(0, 3, 5, 1, 2, 4).reshape(n, c * bs * bs,
+                                                  h // bs, w // bs)
+    ctx.out(op, 'Out', out)
+
+
+@register_op('shuffle_channel')
+def _shuffle_channel(ctx, op):
+    x = ctx.in1(op, 'X')
+    group = op.attr('group')
+    n, c, h, w = x.shape
+    out = x.reshape(n, group, c // group, h, w).swapaxes(1, 2) \
+           .reshape(n, c, h, w)
+    ctx.out(op, 'Out', out)
+
+
+@register_op('label_smooth')
+def _label_smooth(ctx, op):
+    x = ctx.in1(op, 'X')
+    dist = ctx.in1(op, 'PriorDist')
+    eps = op.attr('epsilon', 0.0)
+    if dist is not None:
+        out = (1.0 - eps) * x + eps * dist
+    else:
+        out = (1.0 - eps) * x + eps / x.shape[-1]
+    ctx.out(op, 'Out', out)
+
+
+@register_op('add_position_encoding')
+def _add_position_encoding(ctx, op):
+    x = ctx.in1(op, 'X')  # (N, L, D)
+    alpha = op.attr('alpha', 1.0)
+    beta = op.attr('beta', 1.0)
+    n, l, d = x.shape
+    pos = np.arange(l)[:, None]
+    half = d // 2
+    freq = np.power(10000.0, -np.arange(half) / float(half))
+    enc = np.zeros((l, d), dtype=np.float32)
+    enc[:, :half] = np.sin(pos * freq)
+    enc[:, half:2 * half] = np.cos(pos * freq)
+    ctx.out(op, 'Out', alpha * x + beta * jnp.asarray(enc))
+
+
+@register_op('sampling_id')
+def _sampling_id(ctx, op):
+    x = ctx.in1(op, 'X')  # (N, C) probs
+    key = ctx.rng()
+    ids = jax.random.categorical(key, jnp.log(jnp.clip(x, 1e-20, 1.0)),
+                                 axis=-1)
+    ctx.out(op, 'Out', ids.astype(jnp.int64))
+
+
+@register_op('hash')
+def _hash(ctx, op):
+    x = ctx.in1(op, 'X').astype(jnp.uint32)
+    num_hash = op.attr('num_hash', 1)
+    mod_by = op.attr('mod_by', 100000)
+    outs = []
+    v = x.reshape(x.shape[0], -1)
+    for i in range(num_hash):
+        h = jnp.sum(v * jnp.uint32(2654435761 + i * 97), axis=-1)
+        outs.append((h % jnp.uint32(mod_by)).astype(jnp.int64))
+    ctx.out(op, 'Out', jnp.stack(outs, axis=-1)[:, :, None])
+
+
+@register_op('diag')
+def _diag(ctx, op):
+    d = ctx.in1(op, 'Diagonal')
+    ctx.out(op, 'Out', jnp.diag(d))
+
+
+@register_op('get_tensor_from_selected_rows')
+def _get_tensor_from_selected_rows(ctx, op):
+    ctx.out(op, 'Out', ctx.in1(op, 'X'))
+
+
+@register_op('merge_selected_rows')
+def _merge_selected_rows(ctx, op):
+    ctx.out(op, 'Out', ctx.in1(op, 'X'))

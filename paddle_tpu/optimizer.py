@@ -1,0 +1,568 @@
+"""Optimizers: build optimizer ops into the program.
+
+Capability parity with reference python/paddle/fluid/optimizer.py (Optimizer:44
+with backward:286 / apply_gradients:318 / minimize:357; 11 concrete classes at
+:410-1484 + ModelAverage). The optimizer appends per-parameter update ops that
+the whole-program lowering compiles into the same XLA executable as the
+forward+backward — one fused step on TPU, parameters updated in place via
+buffer donation.
+"""
+import numpy as np
+
+from . import unique_name
+from .framework import (Program, Variable, Parameter, default_main_program,
+                        default_startup_program, program_guard)
+from .backward import append_backward
+from .layer_helper import LayerHelper
+from .initializer import Constant
+from .clip import append_gradient_clip_ops, error_clip_callback
+from .regularizer import append_regularization_ops
+
+__all__ = [
+    'SGD', 'Momentum', 'Adagrad', 'Adam', 'Adamax', 'DecayedAdagrad',
+    'Ftrl', 'SGDOptimizer', 'MomentumOptimizer', 'AdagradOptimizer',
+    'AdamOptimizer', 'AdamaxOptimizer', 'DecayedAdagradOptimizer',
+    'RMSPropOptimizer', 'FtrlOptimizer', 'Adadelta', 'AdadeltaOptimizer',
+    'ModelAverage', 'LarsMomentum', 'LarsMomentumOptimizer',
+]
+
+
+class Optimizer(object):
+    def __init__(self, learning_rate, regularization=None, name=None):
+        self._learning_rate = learning_rate
+        self.regularization = regularization
+        self._name = name
+        self._learning_rate_map = {}
+        self._accumulators = {}
+        self.helper = None
+
+    # ------------------------------------------------------------------
+    def _create_global_learning_rate(self):
+        program = default_main_program()
+        lr = self._learning_rate_map.get(program)
+        if lr is not None:
+            return
+        if isinstance(self._learning_rate, Variable):
+            self._learning_rate_map[program] = self._learning_rate
+            return
+        if not isinstance(self._learning_rate, float):
+            raise TypeError("learning rate must be float or Variable")
+        helper = LayerHelper('learning_rate')
+        lr_name = unique_name.generate("learning_rate")
+        lr_var = helper.create_or_get_global_variable(
+            name=lr_name, dtype='float32', shape=(1,))
+        lr_var.persistable = True
+        lr_var.stop_gradient = True
+        helper.set_variable_initializer(
+            lr_var, Constant(float(self._learning_rate)))
+        self._learning_rate_map[program] = lr_var
+
+    @property
+    def _global_learning_rate(self):
+        return self._learning_rate_map[default_main_program()]
+
+    def _create_param_lr(self, param_and_grad):
+        param = param_and_grad[0]
+        param_lr = getattr(param, 'optimize_attr', {}).get(
+            'learning_rate', 1.0)
+        base = self._global_learning_rate
+        if param_lr == 1.0:
+            return base
+        helper = LayerHelper('param_lr')
+        out = helper.create_variable_for_type_inference('float32',
+                                                        shape=(1,))
+        helper.append_op(type='scale', inputs={'X': [base]},
+                         outputs={'Out': [out]},
+                         attrs={'scale': float(param_lr), 'bias': 0.0})
+        return out
+
+    # ------------------------------------------------------------------
+    def _add_accumulator(self, name, param, dtype=None, fill_value=0.0,
+                         shape=None):
+        if self._name is not None:
+            name = self._name + "_" + name
+        key = (name, param.name)
+        if key in self._accumulators:
+            return self._accumulators[key]
+        helper = LayerHelper(name)
+        var = helper.create_or_get_global_variable(
+            name=unique_name.generate(param.name + "_" + name),
+            dtype=dtype or param.dtype,
+            shape=tuple(shape) if shape is not None else param.shape)
+        var.persistable = True
+        var.stop_gradient = True
+        helper.set_variable_initializer(var, Constant(float(fill_value)))
+        self._accumulators[key] = var
+        return var
+
+    def _get_accumulator(self, name, param):
+        if self._name is not None:
+            name = self._name + "_" + name
+        return self._accumulators[(name, param.name)]
+
+    # ------------------------------------------------------------------
+    def _append_optimize_op(self, block, param_and_grad):
+        raise NotImplementedError
+
+    def _create_accumulators(self, block, parameters):
+        pass
+
+    def _finish_update(self, block, parameters_and_grads):
+        pass
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        return append_backward(loss, parameter_list, no_grad_set)
+
+    def apply_gradients(self, params_grads):
+        params_grads = sorted(params_grads, key=lambda pg: pg[0].name)
+        params_grads = append_gradient_clip_ops(params_grads)
+        params_grads = append_regularization_ops(params_grads,
+                                                 self.regularization)
+        self._create_global_learning_rate()
+        block = default_main_program().global_block()
+        self._create_accumulators(block, [pg[0] for pg in params_grads])
+        optimize_ops = []
+        for pg in params_grads:
+            optimize_ops.append(self._append_optimize_op(block, pg))
+        self._finish_update(block, params_grads)
+        return optimize_ops
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        params_grads = self.backward(loss, startup_program, parameter_list,
+                                     no_grad_set)
+        optimize_ops = self.apply_gradients(params_grads)
+        return optimize_ops, params_grads
+
+
+class SGDOptimizer(Optimizer):
+    def __init__(self, learning_rate, regularization=None, name=None):
+        super(SGDOptimizer, self).__init__(learning_rate, regularization,
+                                           name)
+        self.type = 'sgd'
+
+    def _append_optimize_op(self, block, param_and_grad):
+        return block.append_op(
+            type='sgd',
+            inputs={'Param': [param_and_grad[0]],
+                    'Grad': [param_and_grad[1]],
+                    'LearningRate': [self._create_param_lr(param_and_grad)]},
+            outputs={'ParamOut': [param_and_grad[0]]})
+
+
+class MomentumOptimizer(Optimizer):
+    _velocity_acc_str = "velocity"
+
+    def __init__(self, learning_rate, momentum, use_nesterov=False,
+                 regularization=None, name=None):
+        super(MomentumOptimizer, self).__init__(learning_rate,
+                                                regularization, name)
+        self.type = 'momentum'
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator(self._velocity_acc_str, p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        velocity = self._get_accumulator(self._velocity_acc_str,
+                                         param_and_grad[0])
+        return block.append_op(
+            type='momentum',
+            inputs={'Param': [param_and_grad[0]],
+                    'Grad': [param_and_grad[1]],
+                    'Velocity': [velocity],
+                    'LearningRate': [self._create_param_lr(param_and_grad)]},
+            outputs={'ParamOut': [param_and_grad[0]],
+                     'VelocityOut': [velocity]},
+            attrs={'mu': self._momentum,
+                   'use_nesterov': self._use_nesterov})
+
+
+class LarsMomentumOptimizer(MomentumOptimizer):
+    def __init__(self, learning_rate, momentum, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, regularization=None, name=None):
+        super(LarsMomentumOptimizer, self).__init__(
+            learning_rate, momentum, False, regularization, name)
+        self.type = 'lars_momentum'
+        self._lars_coeff = lars_coeff
+        self._lars_weight_decay = lars_weight_decay
+
+    def _append_optimize_op(self, block, param_and_grad):
+        velocity = self._get_accumulator(self._velocity_acc_str,
+                                         param_and_grad[0])
+        return block.append_op(
+            type='lars_momentum',
+            inputs={'Param': [param_and_grad[0]],
+                    'Grad': [param_and_grad[1]],
+                    'Velocity': [velocity],
+                    'LearningRate': [self._create_param_lr(param_and_grad)]},
+            outputs={'ParamOut': [param_and_grad[0]],
+                     'VelocityOut': [velocity]},
+            attrs={'mu': self._momentum, 'lars_coeff': self._lars_coeff,
+                   'lars_weight_decay': self._lars_weight_decay})
+
+
+class AdagradOptimizer(Optimizer):
+    _moment_acc_str = "moment"
+
+    def __init__(self, learning_rate, epsilon=1e-6, regularization=None,
+                 name=None, initial_accumulator_value=0.0):
+        super(AdagradOptimizer, self).__init__(learning_rate,
+                                               regularization, name)
+        self.type = 'adagrad'
+        self._epsilon = epsilon
+        self._initial = initial_accumulator_value
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator(self._moment_acc_str, p,
+                                  fill_value=self._initial)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        moment = self._get_accumulator(self._moment_acc_str,
+                                       param_and_grad[0])
+        return block.append_op(
+            type='adagrad',
+            inputs={'Param': [param_and_grad[0]],
+                    'Grad': [param_and_grad[1]],
+                    'Moment': [moment],
+                    'LearningRate': [self._create_param_lr(param_and_grad)]},
+            outputs={'ParamOut': [param_and_grad[0]],
+                     'MomentOut': [moment]},
+            attrs={'epsilon': self._epsilon})
+
+
+class AdamOptimizer(Optimizer):
+    _moment1_acc_str = "moment1"
+    _moment2_acc_str = "moment2"
+    _beta1_pow_acc_str = "beta1_pow_acc"
+    _beta2_pow_acc_str = "beta2_pow_acc"
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, regularization=None, name=None,
+                 lazy_mode=False):
+        super(AdamOptimizer, self).__init__(learning_rate, regularization,
+                                            name)
+        self.type = 'adam'
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._lazy_mode = lazy_mode
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator(self._moment1_acc_str, p)
+            self._add_accumulator(self._moment2_acc_str, p)
+            self._add_accumulator(self._beta1_pow_acc_str, p,
+                                  fill_value=self._beta1, shape=[1])
+            self._add_accumulator(self._beta2_pow_acc_str, p,
+                                  fill_value=self._beta2, shape=[1])
+
+    def _append_optimize_op(self, block, param_and_grad):
+        m1 = self._get_accumulator(self._moment1_acc_str, param_and_grad[0])
+        m2 = self._get_accumulator(self._moment2_acc_str, param_and_grad[0])
+        b1p = self._get_accumulator(self._beta1_pow_acc_str,
+                                    param_and_grad[0])
+        b2p = self._get_accumulator(self._beta2_pow_acc_str,
+                                    param_and_grad[0])
+        return block.append_op(
+            type='adam',
+            inputs={'Param': [param_and_grad[0]],
+                    'Grad': [param_and_grad[1]],
+                    'LearningRate': [self._create_param_lr(param_and_grad)],
+                    'Moment1': [m1], 'Moment2': [m2],
+                    'Beta1Pow': [b1p], 'Beta2Pow': [b2p]},
+            outputs={'ParamOut': [param_and_grad[0]],
+                     'Moment1Out': [m1], 'Moment2Out': [m2],
+                     'Beta1PowOut': [b1p], 'Beta2PowOut': [b2p]},
+            attrs={'beta1': self._beta1, 'beta2': self._beta2,
+                   'epsilon': self._epsilon, 'lazy_mode': self._lazy_mode})
+
+
+class AdamaxOptimizer(Optimizer):
+    _moment_acc_str = "moment"
+    _inf_norm_acc_str = "inf_norm"
+    _beta1_pow_acc_str = "beta1_pow_acc"
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, regularization=None, name=None):
+        super(AdamaxOptimizer, self).__init__(learning_rate, regularization,
+                                              name)
+        self.type = 'adamax'
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator(self._moment_acc_str, p)
+            self._add_accumulator(self._inf_norm_acc_str, p)
+            self._add_accumulator(self._beta1_pow_acc_str, p,
+                                  fill_value=self._beta1, shape=[1])
+
+    def _append_optimize_op(self, block, param_and_grad):
+        moment = self._get_accumulator(self._moment_acc_str,
+                                       param_and_grad[0])
+        inf_norm = self._get_accumulator(self._inf_norm_acc_str,
+                                         param_and_grad[0])
+        b1p = self._get_accumulator(self._beta1_pow_acc_str,
+                                    param_and_grad[0])
+        op = block.append_op(
+            type='adamax',
+            inputs={'Param': [param_and_grad[0]],
+                    'Grad': [param_and_grad[1]],
+                    'LearningRate': [self._create_param_lr(param_and_grad)],
+                    'Moment': [moment], 'InfNorm': [inf_norm],
+                    'Beta1Pow': [b1p]},
+            outputs={'ParamOut': [param_and_grad[0]],
+                     'MomentOut': [moment], 'InfNormOut': [inf_norm]},
+            attrs={'beta1': self._beta1, 'beta2': self._beta2,
+                   'epsilon': self._epsilon})
+        return op
+
+    def _finish_update(self, block, parameters_and_grads):
+        for param, _ in parameters_and_grads:
+            b1p = self._get_accumulator(self._beta1_pow_acc_str, param)
+            block.append_op(type='scale', inputs={'X': [b1p]},
+                            outputs={'Out': [b1p]},
+                            attrs={'scale': self._beta1, 'bias': 0.0})
+
+
+class DecayedAdagradOptimizer(Optimizer):
+    _moment_acc_str = "moment"
+
+    def __init__(self, learning_rate, decay=0.95, epsilon=1e-6,
+                 regularization=None, name=None):
+        super(DecayedAdagradOptimizer, self).__init__(
+            learning_rate, regularization, name)
+        self.type = 'decayed_adagrad'
+        self._decay = decay
+        self._epsilon = epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator(self._moment_acc_str, p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        moment = self._get_accumulator(self._moment_acc_str,
+                                       param_and_grad[0])
+        return block.append_op(
+            type='decayed_adagrad',
+            inputs={'Param': [param_and_grad[0]],
+                    'Grad': [param_and_grad[1]],
+                    'Moment': [moment],
+                    'LearningRate': [self._create_param_lr(param_and_grad)]},
+            outputs={'ParamOut': [param_and_grad[0]],
+                     'MomentOut': [moment]},
+            attrs={'decay': self._decay, 'epsilon': self._epsilon})
+
+
+class AdadeltaOptimizer(Optimizer):
+    _avg_squared_grad_acc_str = "_avg_squared_grad"
+    _avg_squared_update_acc_str = "_avg_squared_update"
+
+    def __init__(self, learning_rate, epsilon=1e-6, rho=0.95,
+                 regularization=None, name=None):
+        super(AdadeltaOptimizer, self).__init__(learning_rate,
+                                                regularization, name)
+        self.type = 'adadelta'
+        self._epsilon = epsilon
+        self._rho = rho
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator(self._avg_squared_grad_acc_str, p)
+            self._add_accumulator(self._avg_squared_update_acc_str, p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        eg = self._get_accumulator(self._avg_squared_grad_acc_str,
+                                   param_and_grad[0])
+        ex = self._get_accumulator(self._avg_squared_update_acc_str,
+                                   param_and_grad[0])
+        return block.append_op(
+            type='adadelta',
+            inputs={'Param': [param_and_grad[0]],
+                    'Grad': [param_and_grad[1]],
+                    'AvgSquaredGrad': [eg], 'AvgSquaredUpdate': [ex]},
+            outputs={'ParamOut': [param_and_grad[0]],
+                     'AvgSquaredGradOut': [eg],
+                     'AvgSquaredUpdateOut': [ex]},
+            attrs={'epsilon': self._epsilon, 'rho': self._rho})
+
+
+class RMSPropOptimizer(Optimizer):
+    _momentum_acc_str = "momentum"
+    _mean_square_acc_str = "mean_square"
+    _mean_grad_acc_str = "mean_grad"
+
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, regularization=None, name=None):
+        super(RMSPropOptimizer, self).__init__(learning_rate,
+                                               regularization, name)
+        self.type = 'rmsprop'
+        self._rho = rho
+        self._epsilon = epsilon
+        self._momentum = momentum
+        self._centered = centered
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator(self._momentum_acc_str, p)
+            self._add_accumulator(self._mean_square_acc_str, p)
+            self._add_accumulator(self._mean_grad_acc_str, p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        momentum = self._get_accumulator(self._momentum_acc_str,
+                                         param_and_grad[0])
+        mean_square = self._get_accumulator(self._mean_square_acc_str,
+                                            param_and_grad[0])
+        mean_grad = self._get_accumulator(self._mean_grad_acc_str,
+                                          param_and_grad[0])
+        return block.append_op(
+            type='rmsprop',
+            inputs={'Param': [param_and_grad[0]],
+                    'Grad': [param_and_grad[1]],
+                    'Moment': [momentum], 'MeanSquare': [mean_square],
+                    'MeanGrad': [mean_grad],
+                    'LearningRate': [self._create_param_lr(param_and_grad)]},
+            outputs={'ParamOut': [param_and_grad[0]],
+                     'MomentOut': [momentum],
+                     'MeanSquareOut': [mean_square],
+                     'MeanGradOut': [mean_grad]},
+            attrs={'epsilon': self._epsilon, 'decay': self._rho,
+                   'momentum': self._momentum, 'centered': self._centered})
+
+
+class FtrlOptimizer(Optimizer):
+    _squared_acc_str = "squared"
+    _linear_acc_str = "linear"
+
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5,
+                 regularization=None, name=None):
+        super(FtrlOptimizer, self).__init__(learning_rate, regularization,
+                                            name)
+        self.type = 'ftrl'
+        self._l1 = l1
+        self._l2 = l2
+        self._lr_power = lr_power
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator(self._squared_acc_str, p)
+            self._add_accumulator(self._linear_acc_str, p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        sq = self._get_accumulator(self._squared_acc_str, param_and_grad[0])
+        lin = self._get_accumulator(self._linear_acc_str, param_and_grad[0])
+        return block.append_op(
+            type='ftrl',
+            inputs={'Param': [param_and_grad[0]],
+                    'Grad': [param_and_grad[1]],
+                    'SquaredAccumulator': [sq],
+                    'LinearAccumulator': [lin],
+                    'LearningRate': [self._create_param_lr(param_and_grad)]},
+            outputs={'ParamOut': [param_and_grad[0]],
+                     'SquaredAccumOut': [sq], 'LinearAccumOut': [lin]},
+            attrs={'l1': self._l1, 'l2': self._l2,
+                   'lr_power': self._lr_power})
+
+
+class ModelAverage(Optimizer):
+    """Accumulate parameter averages over a sliding window
+    (reference optimizer.py:1484). apply()/restore() swap averaged params
+    into the scope."""
+
+    def __init__(self, average_window_rate, min_average_window=10000,
+                 max_average_window=10000, regularization=None, name=None):
+        super(ModelAverage, self).__init__(0.0, regularization, name)
+        self.average_window = average_window_rate
+        self.min_average_window = min_average_window
+        self.max_average_window = max_average_window
+        self.params_grads = []
+        program = default_main_program()
+        for param in program.all_parameters():
+            if getattr(param, 'do_model_average', None) is not False:
+                self.params_grads.append((param, None))
+        block = program.global_block()
+        for param, _ in self.params_grads:
+            self._append_average_accumulate_op(block, param)
+
+    def _append_average_accumulate_op(self, block, param):
+        sum_1 = self._add_accumulator('sum_1', param)
+        sum_2 = self._add_accumulator('sum_2', param)
+        sum_3 = self._add_accumulator('sum_3', param)
+        num_acc = self._add_accumulator('num_accumulates', param,
+                                        dtype='int64', shape=[1])
+        old_num = self._add_accumulator('old_num_accumulates', param,
+                                        dtype='int64', shape=[1])
+        num_upd = self._add_accumulator('num_updates', param,
+                                        dtype='int64', shape=[1])
+        block.append_op(
+            type='average_accumulates',
+            inputs={'param': [param], 'in_sum_1': [sum_1],
+                    'in_sum_2': [sum_2], 'in_sum_3': [sum_3],
+                    'in_num_accumulates': [num_acc],
+                    'in_old_num_accumulates': [old_num],
+                    'in_num_updates': [num_upd]},
+            outputs={'out_sum_1': [sum_1], 'out_sum_2': [sum_2],
+                     'out_sum_3': [sum_3],
+                     'out_num_accumulates': [num_acc],
+                     'out_old_num_accumulates': [old_num],
+                     'out_num_updates': [num_upd]},
+            attrs={'average_window': self.average_window,
+                   'min_average_window': self.min_average_window,
+                   'max_average_window': self.max_average_window})
+
+    def apply(self, executor, need_restore=True):
+        """Swap averaged values into params (host-side; scope arithmetic)."""
+        import contextlib
+        import numpy as np
+        from .executor import global_scope
+
+        @contextlib.contextmanager
+        def _ctx():
+            scope = global_scope()
+            self._backup = {}
+            for param, _ in self.params_grads:
+                s1 = self._get_accumulator('sum_1', param)
+                s2 = self._get_accumulator('sum_2', param)
+                s3 = self._get_accumulator('sum_3', param)
+                na = self._get_accumulator('num_accumulates', param)
+                ona = self._get_accumulator('old_num_accumulates', param)
+                total = (np.asarray(scope.get(na.name)).sum() +
+                         np.asarray(scope.get(ona.name)).sum())
+                acc = (np.asarray(scope.get(s1.name)) +
+                       np.asarray(scope.get(s2.name)) +
+                       np.asarray(scope.get(s3.name)))
+                self._backup[param.name] = np.asarray(
+                    scope.get(param.name)).copy()
+                if total > 0:
+                    scope.set(param.name, acc / float(total))
+            try:
+                yield
+            finally:
+                if need_restore:
+                    self.restore(executor)
+        return _ctx()
+
+    def restore(self, executor):
+        from .executor import global_scope
+        scope = global_scope()
+        for name, val in getattr(self, '_backup', {}).items():
+            scope.set(name, val)
+        self._backup = {}
+
+
+SGD = SGDOptimizer
+Momentum = MomentumOptimizer
+Adagrad = AdagradOptimizer
+Adam = AdamOptimizer
+Adamax = AdamaxOptimizer
+DecayedAdagrad = DecayedAdagradOptimizer
+Adadelta = AdadeltaOptimizer
+RMSProp = RMSPropOptimizer
+Ftrl = FtrlOptimizer
+LarsMomentum = LarsMomentumOptimizer

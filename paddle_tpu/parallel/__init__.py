@@ -1,0 +1,11 @@
+"""Parallelism: mesh, SPMD execution, collectives, multi-host bootstrap.
+
+This package is the TPU-native replacement for the reference's entire
+distributed stack (SURVEY §2.7): ParallelExecutor/NCCL op-handles →
+jax.sharding Mesh + SPMD partitioner; DistributeTranspiler/pserver → sharded
+parameters; gen_nccl_id gRPC bootstrap → jax.distributed.initialize.
+"""
+from . import mesh
+from . import spmd
+from . import collective
+from .mesh import default_device_count, make_mesh, data_mesh

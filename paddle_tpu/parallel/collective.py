@@ -1,0 +1,79 @@
+"""Collective primitives + multi-host bootstrap.
+
+Replaces the reference's NCCL layer (platform/nccl_helper.h NCCLContextMap,
+operators/nccl/nccl_op.cc, distributed_ops/gen_nccl_id_op.cc:31): inside SPMD
+programs the XLA partitioner emits collectives automatically; these wrappers
+are for explicit shard_map-style code (ring attention, expert dispatch) and
+for host-level coordination (jax.distributed replaces the gRPC unique-id
+bootstrap).
+"""
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ['allreduce', 'allgather', 'reduce_scatter', 'alltoall',
+           'ppermute_shift', 'barrier', 'init_distributed',
+           'global_device_count', 'local_device_count', 'process_index']
+
+
+def allreduce(x, axis_name, op='sum'):
+    if op == 'sum':
+        return lax.psum(x, axis_name)
+    if op == 'mean':
+        return lax.pmean(x, axis_name)
+    if op == 'max':
+        return lax.pmax(x, axis_name)
+    if op == 'min':
+        return lax.pmin(x, axis_name)
+    raise ValueError("unknown reduce op %r" % op)
+
+
+def allgather(x, axis_name, axis=0, tiled=True):
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis_name, scatter_dimension=0):
+    return lax.psum_scatter(x, axis_name,
+                            scatter_dimension=scatter_dimension,
+                            tiled=True)
+
+
+def alltoall(x, axis_name, split_axis, concat_axis):
+    return lax.all_to_all(x, axis_name, split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=True)
+
+
+def ppermute_shift(x, axis_name, shift=1):
+    """Ring shift (building block of ring attention / pipeline)."""
+    n = lax.psum(1, axis_name)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name, perm)
+
+
+def init_distributed(coordinator_address=None, num_processes=None,
+                     process_id=None):
+    """Multi-host bootstrap — replaces gen_nccl_id + PADDLE_TRAINER_ENDPOINTS
+    env plumbing (reference transpiler nccl2 mode)."""
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs.update(coordinator_address=coordinator_address,
+                      num_processes=num_processes, process_id=process_id)
+    jax.distributed.initialize(**kwargs)
+
+
+def barrier(name='barrier'):
+    # effectful host barrier via a tiny collective on every local device
+    x = jnp.ones((len(jax.local_devices()),))
+    jax.block_until_ready(x)
+
+
+def global_device_count():
+    return jax.device_count()
+
+
+def local_device_count():
+    return jax.local_device_count()
+
+
+def process_index():
+    return jax.process_index()

@@ -1,0 +1,44 @@
+"""Device mesh helpers.
+
+The Mesh replaces the reference's Place lists + NCCLContextMap
+(platform/nccl_helper.h:86): axes are logical ('data', 'model', 'pipe',
+'seq', 'expert'), laid out so the innermost axes ride ICI.
+"""
+import numpy as np
+import jax
+from jax.sharding import Mesh, PartitionSpec, NamedSharding
+
+__all__ = ['default_device_count', 'make_mesh', 'data_mesh',
+           'PartitionSpec', 'NamedSharding', 'Mesh']
+
+
+def default_device_count():
+    return len(jax.devices())
+
+
+def make_mesh(axis_shapes, devices=None):
+    """axis_shapes: dict or list of (name, size); size -1 = fill remaining."""
+    if isinstance(axis_shapes, dict):
+        items = list(axis_shapes.items())
+    else:
+        items = list(axis_shapes)
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    sizes = [s for _, s in items]
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        sizes[sizes.index(-1)] = n // known
+    names = tuple(name for name, _ in items)
+    total = int(np.prod(sizes))
+    if total > n:
+        raise ValueError("mesh %s needs %d devices, have %d"
+                         % (dict(zip(names, sizes)), total, n))
+    arr = np.asarray(devices[:total]).reshape(sizes)
+    return Mesh(arr, names)
+
+
+def data_mesh(num_devices=None, devices=None):
+    devices = devices if devices is not None else jax.devices()
+    if num_devices is not None:
+        devices = devices[:num_devices]
+    return make_mesh([('data', len(devices))], devices)

@@ -1,0 +1,41 @@
+"""ParallelExecutor: legacy multi-device API (reference
+python/paddle/fluid/parallel_executor.py:41, wrapping the C++ SSA-graph
+runtime at framework/parallel_executor.cc:184).
+
+TPU-native: a thin veneer over CompiledProgram.with_data_parallel — the SPMD
+mesh path. Kept because reference user scripts and tests construct it
+directly.
+"""
+from .compiler import CompiledProgram, BuildStrategy, ExecutionStrategy
+from .framework import default_main_program
+from .executor import Executor, global_scope
+
+__all__ = ['ParallelExecutor', 'ExecutionStrategy', 'BuildStrategy']
+
+
+class ParallelExecutor(object):
+    def __init__(self, use_cuda=True, loss_name=None, main_program=None,
+                 share_vars_from=None, exec_strategy=None,
+                 build_strategy=None, num_trainers=1, trainer_id=0,
+                 scope=None):
+        self._program = main_program if main_program is not None \
+            else default_main_program()
+        self._compiled = CompiledProgram(self._program).with_data_parallel(
+            loss_name=loss_name, build_strategy=build_strategy,
+            exec_strategy=exec_strategy,
+            share_vars_from=share_vars_from._compiled
+            if isinstance(share_vars_from, ParallelExecutor)
+            else share_vars_from)
+        self._executor = Executor()
+        self._scope = scope
+
+    def run(self, fetch_list, feed=None, feed_dict=None, return_numpy=True):
+        feed = feed if feed is not None else feed_dict
+        return self._executor.run(self._compiled, feed=feed,
+                                  fetch_list=fetch_list, scope=self._scope,
+                                  return_numpy=return_numpy)
+
+    @property
+    def device_count(self):
+        from .parallel.mesh import default_device_count
+        return default_device_count()

@@ -1,0 +1,87 @@
+"""Profiler (reference python/paddle/fluid/profiler.py + platform/profiler.*).
+
+TPU-native: wraps jax.profiler (xplane traces, viewable in TensorBoard /
+Perfetto — the chrome-trace analog of reference tools/timeline.py) plus a
+lightweight host-side span recorder mirroring RecordEvent RAII spans
+(platform/profiler.h:82).
+"""
+import contextlib
+import json
+import time
+
+__all__ = ['cuda_profiler', 'reset_profiler', 'profiler', 'start_profiler',
+           'stop_profiler', 'record_event', 'export_chrome_tracing']
+
+_events = []
+_active = False
+_trace_dir = None
+
+
+@contextlib.contextmanager
+def cuda_profiler(output_file, output_mode=None, config=None):
+    # name kept for API parity; on TPU this is the device trace
+    with profiler('All', 'total', output_file):
+        yield
+
+
+def reset_profiler():
+    global _events
+    _events = []
+
+
+def start_profiler(state='All', tracer_option=None, trace_dir=None):
+    global _active, _trace_dir
+    _active = True
+    _trace_dir = trace_dir
+    if trace_dir:
+        try:
+            import jax
+            jax.profiler.start_trace(trace_dir)
+        except Exception:
+            pass
+
+
+def stop_profiler(sorted_key=None, profile_path='/tmp/profile'):
+    global _active
+    _active = False
+    if _trace_dir:
+        try:
+            import jax
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+    export_chrome_tracing(profile_path)
+
+
+@contextlib.contextmanager
+def profiler(state='All', sorted_key=None, profile_path='/tmp/profile',
+             tracer_option=None):
+    start_profiler(state)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)
+
+
+@contextlib.contextmanager
+def record_event(name):
+    """RAII span (reference platform/profiler.h:82 RecordEvent)."""
+    t0 = time.time()
+    try:
+        yield
+    finally:
+        if _active:
+            _events.append({'name': name, 'ts': t0 * 1e6,
+                            'dur': (time.time() - t0) * 1e6})
+
+
+def export_chrome_tracing(path):
+    """chrome://tracing JSON of host spans (reference tools/timeline.py:115)."""
+    trace = {'traceEvents': [
+        {'name': e['name'], 'ph': 'X', 'ts': e['ts'], 'dur': e['dur'],
+         'pid': 0, 'tid': 0} for e in _events]}
+    try:
+        with open(path, 'w') as f:
+            json.dump(trace, f)
+    except OSError:
+        pass

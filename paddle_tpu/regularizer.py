@@ -1,0 +1,66 @@
+"""Weight-decay regularizers appended as ops (reference
+python/paddle/fluid/regularizer.py: L1DecayRegularizer, L2DecayRegularizer,
+append_regularization_ops)."""
+from .framework import Parameter
+
+__all__ = ['L1Decay', 'L2Decay', 'L1DecayRegularizer', 'L2DecayRegularizer',
+           'append_regularization_ops']
+
+
+class WeightDecayRegularizer(object):
+    def __call__(self, param, grad, block):
+        raise NotImplementedError
+
+
+class L2DecayRegularizer(WeightDecayRegularizer):
+    def __init__(self, regularization_coeff=0.0):
+        self._regularization_coeff = regularization_coeff
+
+    def __call__(self, param, grad, block):
+        decay = block.create_var(shape=param.shape, dtype=param.dtype)
+        block.append_op(type='scale', inputs={'X': [param]},
+                        outputs={'Out': [decay]},
+                        attrs={'scale': self._regularization_coeff,
+                               'bias': 0.0})
+        return decay
+
+
+class L1DecayRegularizer(WeightDecayRegularizer):
+    def __init__(self, regularization_coeff=0.0):
+        self._regularization_coeff = regularization_coeff
+
+    def __call__(self, param, grad, block):
+        sign = block.create_var(shape=param.shape, dtype=param.dtype)
+        block.append_op(type='sign', inputs={'X': [param]},
+                        outputs={'Out': [sign]})
+        decay = block.create_var(shape=param.shape, dtype=param.dtype)
+        block.append_op(type='scale', inputs={'X': [sign]},
+                        outputs={'Out': [decay]},
+                        attrs={'scale': self._regularization_coeff,
+                               'bias': 0.0})
+        return decay
+
+
+def append_regularization_ops(parameters_and_grads, regularization=None):
+    params_and_grads = []
+    for param, grad in parameters_and_grads:
+        regularization_term = None
+        if getattr(param, 'regularizer', None) is not None:
+            regularization_term = param.regularizer(param, grad, grad.block)
+        elif regularization is not None:
+            regularization_term = regularization(param, grad, grad.block)
+        if regularization_term is None:
+            params_and_grads.append((param, grad))
+            continue
+        block = grad.block
+        new_grad = block.create_var(shape=grad.shape, dtype=grad.dtype,
+                                    name=grad.name + '.reg')
+        block.append_op(type='sum',
+                        inputs={'X': [grad, regularization_term]},
+                        outputs={'Out': [new_grad]})
+        params_and_grads.append((param, new_grad))
+    return params_and_grads
+
+
+L1Decay = L1DecayRegularizer
+L2Decay = L2DecayRegularizer

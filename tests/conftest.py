@@ -1,0 +1,30 @@
+"""Test config: force an 8-device virtual CPU mesh so multi-chip sharding
+paths are exercised without TPU hardware (the driver separately dry-runs
+multichip via __graft_entry__.dryrun_multichip)."""
+import os
+
+os.environ['JAX_PLATFORMS'] = 'cpu'
+flags = os.environ.get('XLA_FLAGS', '')
+if '--xla_force_host_platform_device_count' not in flags:
+    os.environ['XLA_FLAGS'] = (
+        flags + ' --xla_force_host_platform_device_count=8').strip()
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _fresh_programs():
+    """Each test gets fresh default programs + scope + name generator,
+    mirroring the reference OpTest scratch-scope discipline."""
+    import paddle_tpu as fluid
+    from paddle_tpu import unique_name
+    main, startup = fluid.Program(), fluid.Program()
+    prev_main = fluid.framework.switch_main_program(main)
+    prev_start = fluid.framework.switch_startup_program(startup)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        with unique_name.guard():
+            yield
+    fluid.framework.switch_main_program(prev_main)
+    fluid.framework.switch_startup_program(prev_start)

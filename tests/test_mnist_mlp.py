@@ -1,0 +1,99 @@
+"""End-to-end slice: MNIST-style MLP — train, eval, save/load inference.
+
+Mirrors reference tests/book/test_recognize_digits.py:65-204 (mlp path) with
+synthetic data (no dataset downloads in CI).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _make_synthetic_mnist(n, seed=0):
+    rng = np.random.RandomState(seed)
+    # 4 gaussian blobs in 784-d -> 4 classes among 10
+    labels = rng.randint(0, 4, size=n).astype('int64')
+    centers = rng.randn(4, 784).astype('float32') * 2.0
+    images = centers[labels] + rng.randn(n, 784).astype('float32') * 0.5
+    return images.astype('float32'), labels.reshape(n, 1)
+
+
+def build_mlp(img, label):
+    hidden = fluid.layers.fc(input=img, size=64, act='relu')
+    hidden = fluid.layers.fc(input=hidden, size=64, act='relu')
+    prediction = fluid.layers.fc(input=hidden, size=10, act='softmax')
+    cost = fluid.layers.cross_entropy(input=prediction, label=label)
+    avg_cost = fluid.layers.mean(cost)
+    acc = fluid.layers.accuracy(input=prediction, label=label)
+    return prediction, avg_cost, acc
+
+
+def test_mnist_mlp_train_eval_save_load(tmp_path):
+    img = fluid.layers.data(name='img', shape=[784], dtype='float32')
+    label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+    prediction, avg_cost, acc = build_mlp(img, label)
+
+    test_program = fluid.default_main_program().clone(for_test=True)
+
+    opt = fluid.optimizer.Adam(learning_rate=0.01)
+    opt.minimize(avg_cost)
+
+    place = fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    exe.run(fluid.default_startup_program())
+
+    images, labels = _make_synthetic_mnist(512)
+    batch_size = 64
+    first_loss = last_loss = None
+    for epoch in range(3):
+        for i in range(0, len(images), batch_size):
+            loss_v, acc_v = exe.run(
+                fluid.default_main_program(),
+                feed={'img': images[i:i + batch_size],
+                      'label': labels[i:i + batch_size]},
+                fetch_list=[avg_cost, acc])
+            if first_loss is None:
+                first_loss = float(loss_v[0])
+            last_loss = float(loss_v[0])
+    assert np.isfinite(last_loss)
+    assert last_loss < first_loss * 0.5, \
+        "loss did not drop: %f -> %f" % (first_loss, last_loss)
+
+    # eval on the test-clone (no optimizer ops, dropout switched off)
+    loss_t, acc_t = exe.run(test_program,
+                            feed={'img': images[:128],
+                                  'label': labels[:128]},
+                            fetch_list=[avg_cost, acc])
+    assert acc_t[0] > 0.9, "train accuracy too low: %s" % acc_t
+
+    # save + load inference model, compare predictions
+    model_dir = str(tmp_path / "mnist_model")
+    fluid.save_inference_model(model_dir, ['img'], [prediction], exe)
+
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        infer_prog, feed_names, fetch_vars = fluid.load_inference_model(
+            model_dir, exe)
+        out = exe.run(infer_prog, feed={feed_names[0]: images[:8]},
+                      fetch_list=fetch_vars, scope=scope2)
+    ref = exe.run(test_program, feed={'img': images[:8],
+                                      'label': labels[:8]},
+                  fetch_list=[prediction])
+    np.testing.assert_allclose(out[0], ref[0], rtol=1e-4, atol=1e-5)
+
+
+def test_sgd_and_momentum_converge():
+    img = fluid.layers.data(name='img', shape=[784], dtype='float32')
+    label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+    _, avg_cost, _ = build_mlp(img, label)
+    fluid.optimizer.Momentum(learning_rate=0.05, momentum=0.9).minimize(
+        avg_cost)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    images, labels = _make_synthetic_mnist(256)
+    losses = []
+    for _ in range(20):
+        loss_v, = exe.run(feed={'img': images, 'label': labels},
+                          fetch_list=[avg_cost])
+        losses.append(float(loss_v[0]))
+    assert losses[-1] < losses[0] * 0.5
