@@ -1,0 +1,79 @@
+"""Benchmark: flagship Transformer-LM training throughput on one chip.
+
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+The reference publishes no in-tree numbers (BASELINE.md: published={}), so
+vs_baseline is reported against our own first-round recorded value when
+BENCH_r1.json exists, else 1.0.
+
+Metric: tokens/sec of full train steps (fwd+bwd+Adam, bf16 matmul inputs on
+TPU) on a GPT-style LM — the TPU analog of the reference's examples/sec
+(benchmark/fluid/fluid_benchmark.py:297-301).
+"""
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import paddle_tpu as fluid
+    from paddle_tpu.models.transformer import build_lm, LMConfig
+
+    on_tpu = any(d.platform == 'tpu' for d in jax.devices())
+    if on_tpu:
+        cfg = LMConfig(vocab_size=32000, seq_len=512, d_model=512, n_head=8,
+                       n_layer=6, d_ff=2048, dropout=0.1)
+        batch = 32
+        steps, warmup = 20, 3
+    else:  # CPU smoke config
+        cfg = LMConfig(vocab_size=1024, seq_len=64, d_model=128, n_head=4,
+                       n_layer=2, d_ff=256, dropout=0.1)
+        batch = 8
+        steps, warmup = 5, 1
+
+    main_p, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup):
+        tokens, labels, logits, avg_loss = build_lm(cfg)
+        fluid.optimizer.Adam(learning_rate=1e-4).minimize(avg_loss)
+
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    feed = {
+        'tokens': rng.randint(0, cfg.vocab_size,
+                              (batch, cfg.seq_len)).astype('int64'),
+        'labels': rng.randint(0, cfg.vocab_size,
+                              (batch, cfg.seq_len)).astype('int64'),
+    }
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        for _ in range(warmup):
+            exe.run(main_p, feed=feed, fetch_list=[avg_loss], scope=scope)
+        t0 = time.time()
+        for _ in range(steps):
+            out = exe.run(main_p, feed=feed, fetch_list=[avg_loss],
+                          scope=scope)
+        dt = time.time() - t0
+    tokens_per_sec = steps * batch * cfg.seq_len / dt
+
+    vs_baseline = 1.0
+    if os.path.exists('BENCH_r1.json'):
+        try:
+            with open('BENCH_r1.json') as f:
+                prev = json.load(f)
+            if prev.get('value'):
+                vs_baseline = tokens_per_sec / float(prev['value'])
+        except Exception:
+            pass
+    print(json.dumps({
+        'metric': 'transformer_lm_train_throughput',
+        'value': round(tokens_per_sec, 2),
+        'unit': 'tokens/sec',
+        'vs_baseline': round(vs_baseline, 4),
+    }))
+
+
+if __name__ == '__main__':
+    main()

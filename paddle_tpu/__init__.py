@@ -13,8 +13,16 @@ reference at /root/reference) designed TPU-first:
 """
 import os
 
-# Make CPU test meshes deterministic and deadlock-free before jax import.
-os.environ.setdefault('XLA_FLAGS', '')
+# Honor the JAX_PLATFORMS env var even when a sitecustomize hook has
+# programmatically overridden jax_platforms (e.g. the remote-TPU plugin sets
+# "axon,cpu"): a user/test asking for JAX_PLATFORMS=cpu must never block on a
+# TPU tunnel.
+if os.environ.get('JAX_PLATFORMS'):
+    import jax as _jax
+    try:
+        _jax.config.update('jax_platforms', os.environ['JAX_PLATFORMS'])
+    except Exception:
+        pass
 
 from . import core
 from . import ops  # registers all op lowerings
@@ -38,6 +46,11 @@ from .io import (save_vars, save_params, save_persistables, load_vars,
                  load_inference_model)
 from . import nets
 from . import metrics
+from . import reader
+from . import dataset
+from . import models
+from . import transpiler
+from . import parallel
 from . import profiler
 from .data_feeder import DataFeeder
 from . import compiler

@@ -1,0 +1,27 @@
+"""Datasets (reference python/paddle/dataset/, 14 loaders).
+
+The reference downloads real corpora at import time. This environment has no
+egress, so each module serves REAL data from a local cache dir when present
+(PADDLE_TPU_DATA_HOME, default ~/.cache/paddle_tpu/dataset) and otherwise
+falls back to a deterministic synthetic generator with the exact sample
+shapes/vocabularies of the real dataset — enough for models, tests and
+benchmarks to run unchanged.
+"""
+from . import common
+from . import mnist
+from . import cifar
+from . import uci_housing
+from . import imdb
+from . import imikolov
+from . import movielens
+from . import conll05
+from . import wmt14
+from . import wmt16
+from . import flowers
+from . import voc2012
+from . import sentiment
+from . import mq2007
+
+__all__ = ['mnist', 'cifar', 'uci_housing', 'imdb', 'imikolov', 'movielens',
+           'conll05', 'wmt14', 'wmt16', 'flowers', 'voc2012', 'sentiment',
+           'mq2007', 'common']

@@ -1,0 +1,63 @@
+"""CIFAR-10/100 (reference python/paddle/dataset/cifar.py: samples are
+(3072-float image in [0,1], int label))."""
+import os
+import pickle
+import tarfile
+
+import numpy as np
+
+from . import common
+
+__all__ = ['train10', 'test10', 'train100', 'test100']
+
+_TRAIN_N = 4096
+_TEST_N = 1024
+
+
+def _synthetic(n, num_classes, seed):
+    rng = np.random.RandomState(seed)
+    centers = rng.rand(num_classes, 3072).astype('float32')
+    labels = rng.randint(0, num_classes, n).astype('int64')
+    imgs = np.clip(centers[labels] * 0.6 +
+                   rng.rand(n, 3072).astype('float32') * 0.4, 0, 1)
+    return imgs.astype('float32'), labels
+
+
+def _tar_reader(tar_name, sub_name_filter, num_classes, kind):
+    path = os.path.join(common.DATA_HOME, 'cifar', tar_name)
+
+    def reader():
+        if os.path.exists(path):
+            with tarfile.open(path, mode='r') as f:
+                names = [n for n in f.getnames() if sub_name_filter in n]
+                for name in names:
+                    batch = pickle.load(f.extractfile(name),
+                                        encoding='latin1')
+                    data = batch['data'].astype('float32') / 255.0
+                    labels = batch.get('labels', batch.get('fine_labels'))
+                    for s, l in zip(data, labels):
+                        yield s, int(l)
+        else:
+            n = _TRAIN_N if 'train' in kind else _TEST_N
+            imgs, labels = _synthetic(
+                n, num_classes,
+                common.synthetic_seed('cifar%d-%s' % (num_classes, kind)))
+            for i in range(n):
+                yield imgs[i], int(labels[i])
+    return reader
+
+
+def train10():
+    return _tar_reader('cifar-10-python.tar.gz', 'data_batch', 10, 'train10')
+
+
+def test10():
+    return _tar_reader('cifar-10-python.tar.gz', 'test_batch', 10, 'test10')
+
+
+def train100():
+    return _tar_reader('cifar-100-python.tar.gz', 'train', 100, 'train100')
+
+
+def test100():
+    return _tar_reader('cifar-100-python.tar.gz', 'test', 100, 'test100')

@@ -1,0 +1,42 @@
+"""Shared dataset plumbing (reference python/paddle/dataset/common.py:
+DATA_HOME, download, md5file, split/cluster_files_reader)."""
+import hashlib
+import os
+
+import numpy as np
+
+__all__ = ['DATA_HOME', 'md5file', 'download', 'synthetic_seed']
+
+DATA_HOME = os.environ.get(
+    'PADDLE_TPU_DATA_HOME',
+    os.path.join(os.path.expanduser('~'), '.cache', 'paddle_tpu',
+                 'dataset'))
+
+
+def md5file(fname):
+    hash_md5 = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(4096), b""):
+            hash_md5.update(chunk)
+    return hash_md5.hexdigest()
+
+
+def download(url, module_name, md5sum, save_name=None):
+    """No-egress environment: resolve from the local cache only."""
+    dirname = os.path.join(DATA_HOME, module_name)
+    filename = os.path.join(
+        dirname, save_name if save_name else url.split('/')[-1])
+    if os.path.exists(filename):
+        return filename
+    raise RuntimeError(
+        "dataset file %s not in local cache %s and this environment has no "
+        "network egress; the loader will fall back to synthetic data"
+        % (url, dirname))
+
+
+def have_local(module_name, fname):
+    return os.path.exists(os.path.join(DATA_HOME, module_name, fname))
+
+
+def synthetic_seed(name):
+    return int(hashlib.md5(name.encode()).hexdigest()[:8], 16)

@@ -1,0 +1,39 @@
+"""CoNLL-2005 SRL (reference python/paddle/dataset/conll05.py: 8 feature
+sequences + label sequence; get_dict/get_embedding)."""
+import numpy as np
+
+from . import common
+
+__all__ = ['test', 'get_dict', 'get_embedding']
+
+_WORD_V = 44068
+_PRED_V = 3162
+_LABEL_V = 59
+_TEST_N = 500
+
+
+def get_dict():
+    word_dict = {('w%d' % i): i for i in range(_WORD_V)}
+    verb_dict = {('v%d' % i): i for i in range(_PRED_V)}
+    label_dict = {('l%d' % i): i for i in range(_LABEL_V)}
+    return word_dict, verb_dict, label_dict
+
+
+def get_embedding():
+    rng = np.random.RandomState(common.synthetic_seed('conll05-emb'))
+    return rng.randn(_WORD_V, 32).astype('float32')
+
+
+def test():
+    def reader():
+        rng = np.random.RandomState(common.synthetic_seed('conll05-test'))
+        for _ in range(_TEST_N):
+            length = int(rng.randint(5, 40))
+            words = list(map(int, rng.randint(0, _WORD_V, length)))
+            pred_idx = int(rng.randint(0, length))
+            predicate = [int(rng.randint(0, _PRED_V))] * length
+            ctx = [words[max(pred_idx - 2, 0)]] * length
+            marks = [1 if i == pred_idx else 0 for i in range(length)]
+            labels = list(map(int, rng.randint(0, _LABEL_V, length)))
+            yield (words, ctx, ctx, ctx, ctx, predicate, marks, labels)
+    return reader

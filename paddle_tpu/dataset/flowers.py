@@ -1,0 +1,37 @@
+"""Flowers-102 (reference python/paddle/dataset/flowers.py: 3x224x224 images,
+102 classes)."""
+import numpy as np
+
+from . import common
+
+__all__ = ['train', 'test', 'valid']
+
+_TRAIN_N = 512
+_TEST_N = 128
+_SHAPE = (3, 224, 224)
+
+
+def _mk(kind, n):
+    def reader():
+        rng = np.random.RandomState(common.synthetic_seed('flowers-' + kind))
+        centers = rng.rand(102, 8).astype('float32')
+        for _ in range(n):
+            label = int(rng.randint(0, 102))
+            base = np.zeros(_SHAPE, dtype='float32')
+            base += centers[label].mean()
+            img = np.clip(base + rng.rand(*_SHAPE).astype('float32') * 0.3,
+                          0, 1)
+            yield img.ravel(), label
+    return reader
+
+
+def train(mapper=None, buffered_size=1024, use_xmap=True, cycle=False):
+    return _mk('train', _TRAIN_N)
+
+
+def test(mapper=None, buffered_size=1024, use_xmap=True, cycle=False):
+    return _mk('test', _TEST_N)
+
+
+def valid(mapper=None, buffered_size=1024, use_xmap=True):
+    return _mk('valid', _TEST_N)

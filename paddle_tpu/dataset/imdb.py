@@ -1,0 +1,43 @@
+"""IMDB sentiment (reference python/paddle/dataset/imdb.py: word-id sequence,
+binary label; word_dict())."""
+import numpy as np
+
+from . import common
+
+__all__ = ['train', 'test', 'word_dict']
+
+_VOCAB = 5147      # reference dict size ballpark
+_TRAIN_N = 2000
+_TEST_N = 500
+_MAXLEN = 100
+
+
+def word_dict():
+    return {('w%d' % i).encode(): i for i in range(_VOCAB - 2)}
+
+
+def _synthetic(n, seed):
+    rng = np.random.RandomState(seed)
+    for _ in range(n):
+        label = int(rng.randint(0, 2))
+        length = int(rng.randint(8, _MAXLEN))
+        # sentiment signal: positive reviews draw from low ids
+        if label:
+            seq = rng.zipf(1.3, length) % (_VOCAB // 2)
+        else:
+            seq = (_VOCAB // 2) + rng.zipf(1.3, length) % (_VOCAB // 2)
+        yield list(map(int, seq)), label
+
+
+def train(word_idx=None):
+    def reader():
+        for s in _synthetic(_TRAIN_N, common.synthetic_seed('imdb-train')):
+            yield s
+    return reader
+
+
+def test(word_idx=None):
+    def reader():
+        for s in _synthetic(_TEST_N, common.synthetic_seed('imdb-test')):
+            yield s
+    return reader

@@ -1,0 +1,43 @@
+"""imikolov / PTB language model (reference python/paddle/dataset/
+imikolov.py: n-gram or sequence readers over a ~10k vocab)."""
+import numpy as np
+
+from . import common
+
+__all__ = ['train', 'test', 'build_dict']
+
+N_GRAM = 5
+_VOCAB = 2073
+_TRAIN_N = 4000
+_TEST_N = 800
+
+
+def build_dict(min_word_freq=50):
+    return {('w%d' % i): i for i in range(_VOCAB - 2)}
+
+
+def _synthetic(n, seed):
+    rng = np.random.RandomState(seed)
+    # markov-ish chain so n-gram prediction is learnable
+    state = int(rng.randint(_VOCAB))
+    for _ in range(n):
+        gram = []
+        for _ in range(N_GRAM):
+            state = int((state * 31 + rng.randint(5)) % _VOCAB)
+            gram.append(state)
+        yield tuple(gram)
+
+
+def train(word_idx=None, n=N_GRAM, data_type=1):
+    def reader():
+        for s in _synthetic(_TRAIN_N,
+                            common.synthetic_seed('imikolov-train')):
+            yield s[:n]
+    return reader
+
+
+def test(word_idx=None, n=N_GRAM, data_type=1):
+    def reader():
+        for s in _synthetic(_TEST_N, common.synthetic_seed('imikolov-test')):
+            yield s[:n]
+    return reader

@@ -1,0 +1,61 @@
+"""MNIST (reference python/paddle/dataset/mnist.py: train/test readers of
+(784-float image in [-1,1], int label)). Local idx files if cached, else
+synthetic blobs with the same shapes."""
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from . import common
+
+__all__ = ['train', 'test']
+
+_TRAIN_N = 8192   # synthetic sizes (real: 60000/10000)
+_TEST_N = 2048
+
+
+def _synthetic(n, seed):
+    rng = np.random.RandomState(seed)
+    centers = rng.randn(10, 784).astype('float32')
+    labels = rng.randint(0, 10, n).astype('int64')
+    imgs = np.clip(centers[labels] * 0.5 +
+                   rng.randn(n, 784).astype('float32') * 0.3, -1, 1)
+    return imgs.astype('float32'), labels
+
+
+def _read_idx(image_path, label_path):
+    with gzip.open(label_path, 'rb') as f:
+        magic, n = struct.unpack('>II', f.read(8))
+        labels = np.frombuffer(f.read(), dtype=np.uint8).astype('int64')
+    with gzip.open(image_path, 'rb') as f:
+        magic, n, rows, cols = struct.unpack('>IIII', f.read(16))
+        imgs = np.frombuffer(f.read(), dtype=np.uint8).reshape(n, 784)
+        imgs = imgs.astype('float32') / 127.5 - 1.0
+    return imgs, labels
+
+
+def _reader(kind):
+    img_f = '%s-images-idx3-ubyte.gz' % kind
+    lab_f = '%s-labels-idx1-ubyte.gz' % kind
+    base = os.path.join(common.DATA_HOME, 'mnist')
+
+    def reader():
+        if os.path.exists(os.path.join(base, img_f)):
+            imgs, labels = _read_idx(os.path.join(base, img_f),
+                                     os.path.join(base, lab_f))
+        else:
+            n = _TRAIN_N if kind == 'train' else _TEST_N
+            imgs, labels = _synthetic(
+                n, common.synthetic_seed('mnist-' + kind))
+        for i in range(len(labels)):
+            yield imgs[i], int(labels[i])
+    return reader
+
+
+def train():
+    return _reader('train')
+
+
+def test():
+    return _reader('t10k')

@@ -1,0 +1,65 @@
+"""MovieLens-1M (reference python/paddle/dataset/movielens.py: user/movie
+features + rating; max_user_id/max_movie_id/max_job_id helpers)."""
+import numpy as np
+
+from . import common
+
+__all__ = ['train', 'test', 'max_user_id', 'max_movie_id', 'max_job_id',
+           'age_table', 'movie_categories']
+
+_N_USER = 944
+_N_MOVIE = 1683
+_N_JOB = 21
+_TRAIN_N = 8000
+_TEST_N = 1000
+
+age_table = [1, 18, 25, 35, 45, 50, 56]
+
+
+def max_user_id():
+    return _N_USER - 1
+
+
+def max_movie_id():
+    return _N_MOVIE - 1
+
+
+def max_job_id():
+    return _N_JOB - 1
+
+
+def movie_categories():
+    return {('cat%d' % i): i for i in range(18)}
+
+
+def _synthetic(n, seed):
+    rng = np.random.RandomState(seed)
+    for _ in range(n):
+        user = int(rng.randint(1, _N_USER))
+        gender = int(rng.randint(0, 2))
+        age = int(rng.randint(0, len(age_table)))
+        job = int(rng.randint(0, _N_JOB))
+        movie = int(rng.randint(1, _N_MOVIE))
+        n_cat = int(rng.randint(1, 4))
+        cats = list(map(int, rng.randint(0, 18, n_cat)))
+        n_title = int(rng.randint(1, 6))
+        title = list(map(int, rng.randint(0, 5175, n_title)))
+        # learnable rating: hash of (user, movie) parity-ish
+        rating = float(((user * 7 + movie * 13) % 5) + 1)
+        yield [user, gender, age, job, movie, cats, title, [rating]]
+
+
+def train():
+    def reader():
+        for s in _synthetic(_TRAIN_N,
+                            common.synthetic_seed('movielens-train')):
+            yield s
+    return reader
+
+
+def test():
+    def reader():
+        for s in _synthetic(_TEST_N,
+                            common.synthetic_seed('movielens-test')):
+            yield s
+    return reader

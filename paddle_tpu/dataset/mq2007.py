@@ -1,0 +1,42 @@
+"""MQ2007 learning-to-rank (reference python/paddle/dataset/mq2007.py:
+pairwise/listwise/pointwise readers over 46-dim query-doc features)."""
+import numpy as np
+
+from . import common
+
+__all__ = ['train', 'test']
+
+_FDIM = 46
+
+
+def _mk(kind, n_queries):
+    def gen(format='pairwise'):
+        def reader():
+            rng = np.random.RandomState(
+                common.synthetic_seed('mq2007-' + kind))
+            w = rng.randn(_FDIM)
+            for _ in range(n_queries):
+                n_docs = int(rng.randint(5, 20))
+                feats = rng.randn(n_docs, _FDIM).astype('float32')
+                scores = feats @ w
+                rels = np.digitize(scores, np.percentile(scores, [33, 66]))
+                if format == 'pointwise':
+                    for f, r in zip(feats, rels):
+                        yield float(r), f
+                elif format == 'listwise':
+                    yield list(map(float, rels)), feats
+                else:
+                    for i in range(n_docs):
+                        for j in range(n_docs):
+                            if rels[i] > rels[j]:
+                                yield 1.0, feats[i], feats[j]
+        return reader
+    return gen
+
+
+def train(format='pairwise'):
+    return _mk('train', 120)(format)
+
+
+def test(format='pairwise'):
+    return _mk('test', 30)(format)

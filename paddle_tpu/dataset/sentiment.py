@@ -1,0 +1,32 @@
+"""Movie-review sentiment (reference python/paddle/dataset/sentiment.py)."""
+import numpy as np
+
+from . import common
+
+__all__ = ['train', 'test', 'get_word_dict']
+
+_VOCAB = 3000
+
+
+def get_word_dict():
+    return [('w%d' % i, i) for i in range(_VOCAB)]
+
+
+def _mk(kind, n):
+    def reader():
+        rng = np.random.RandomState(
+            common.synthetic_seed('sentiment-' + kind))
+        for _ in range(n):
+            label = int(rng.randint(0, 2))
+            length = int(rng.randint(5, 60))
+            lo, hi = (0, _VOCAB // 2) if label else (_VOCAB // 2, _VOCAB)
+            yield list(map(int, rng.randint(lo, hi, length))), label
+    return reader
+
+
+def train():
+    return _mk('train', 1600)
+
+
+def test():
+    return _mk('test', 400)

@@ -1,0 +1,47 @@
+"""UCI housing (reference python/paddle/dataset/uci_housing.py: 13 features,
+1 regression target, feature-normalized)."""
+import os
+
+import numpy as np
+
+from . import common
+
+__all__ = ['train', 'test']
+
+feature_names = ['CRIM', 'ZN', 'INDUS', 'CHAS', 'NOX', 'RM', 'AGE', 'DIS',
+                 'RAD', 'TAX', 'PTRATIO', 'B', 'LSTAT']
+
+_N = 506
+
+
+def _data():
+    path = os.path.join(common.DATA_HOME, 'uci_housing', 'housing.data')
+    if os.path.exists(path):
+        data = np.loadtxt(path)
+    else:
+        rng = np.random.RandomState(common.synthetic_seed('uci_housing'))
+        X = rng.randn(_N, 13)
+        w = rng.randn(13, 1)
+        y = X @ w + 0.1 * rng.randn(_N, 1)
+        data = np.concatenate([X, y], axis=1)
+    feats = data[:, :-1]
+    # feature normalization like the reference
+    maxs, mins, avgs = feats.max(0), feats.min(0), feats.mean(0)
+    feats = (feats - avgs) / (maxs - mins + 1e-12)
+    return np.concatenate([feats, data[:, -1:]], axis=1).astype('float32')
+
+
+def _reader(lo, hi):
+    def reader():
+        d = _data()
+        for row in d[int(lo * len(d)):int(hi * len(d))]:
+            yield row[:-1], row[-1:]
+    return reader
+
+
+def train():
+    return _reader(0.0, 0.8)
+
+
+def test():
+    return _reader(0.8, 1.0)

@@ -1,0 +1,30 @@
+"""VOC2012 segmentation (reference python/paddle/dataset/voc2012.py)."""
+import numpy as np
+
+from . import common
+
+__all__ = ['train', 'test', 'val']
+
+_SHAPE = (3, 128, 128)
+
+
+def _mk(kind, n):
+    def reader():
+        rng = np.random.RandomState(common.synthetic_seed('voc-' + kind))
+        for _ in range(n):
+            img = rng.rand(*_SHAPE).astype('float32')
+            seg = rng.randint(0, 21, _SHAPE[1:]).astype('int64')
+            yield img, seg
+    return reader
+
+
+def train():
+    return _mk('train', 256)
+
+
+def test():
+    return _mk('test', 64)
+
+
+def val():
+    return _mk('val', 64)

@@ -1,0 +1,46 @@
+"""WMT-14 FR-EN (reference python/paddle/dataset/wmt14.py: (src_ids,
+trg_ids, trg_next_ids) with <s>/<e>/<unk> conventions)."""
+import numpy as np
+
+from . import common
+
+__all__ = ['train', 'test', 'get_dict']
+
+dict_size = 30000
+_TRAIN_N = 2000
+_TEST_N = 400
+
+
+def get_dict(dict_size=dict_size, reverse=False):
+    d = {i: 'w%d' % i for i in range(dict_size)} if reverse else \
+        {('w%d' % i): i for i in range(dict_size)}
+    return d, d
+
+
+def _synthetic(n, seed, dict_sz):
+    rng = np.random.RandomState(seed)
+    for _ in range(n):
+        slen = int(rng.randint(4, 30))
+        src = list(map(int, rng.randint(3, dict_sz, slen)))
+        # "translation": deterministic transform of source (learnable)
+        trg = [(w * 2 + 1) % dict_sz for w in src[:max(2, slen - 2)]]
+        trg_in = [0] + trg           # <s> prefix
+        trg_next = trg + [1]         # <e> suffix
+        yield src, trg_in, trg_next
+    return
+
+
+def train(dict_size=dict_size):
+    def reader():
+        for s in _synthetic(_TRAIN_N, common.synthetic_seed('wmt14-train'),
+                            dict_size):
+            yield s
+    return reader
+
+
+def test(dict_size=dict_size):
+    def reader():
+        for s in _synthetic(_TEST_N, common.synthetic_seed('wmt14-test'),
+                            dict_size):
+            yield s
+    return reader

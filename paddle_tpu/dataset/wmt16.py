@@ -1,0 +1,37 @@
+"""WMT-16 EN-DE (reference python/paddle/dataset/wmt16.py)."""
+import numpy as np
+
+from . import common
+from . import wmt14 as _w14
+
+__all__ = ['train', 'test', 'validation', 'get_dict']
+
+
+def get_dict(lang, dict_size, reverse=False):
+    if reverse:
+        return {i: 'w%d' % i for i in range(dict_size)}
+    return {('w%d' % i): i for i in range(dict_size)}
+
+
+def _mk(kind, n, src_dict_size, trg_dict_size):
+    def reader():
+        rng = np.random.RandomState(common.synthetic_seed('wmt16-' + kind))
+        for _ in range(n):
+            slen = int(rng.randint(4, 30))
+            src = list(map(int, rng.randint(3, src_dict_size, slen)))
+            trg = [(w * 3 + 1) % trg_dict_size
+                   for w in src[:max(2, slen - 2)]]
+            yield src, [0] + trg, trg + [1]
+    return reader
+
+
+def train(src_dict_size=30000, trg_dict_size=30000, src_lang='en'):
+    return _mk('train', 2000, src_dict_size, trg_dict_size)
+
+
+def test(src_dict_size=30000, trg_dict_size=30000, src_lang='en'):
+    return _mk('test', 400, src_dict_size, trg_dict_size)
+
+
+def validation(src_dict_size=30000, trg_dict_size=30000, src_lang='en'):
+    return _mk('val', 400, src_dict_size, trg_dict_size)

@@ -102,6 +102,27 @@ class Scope(object):
         return Scope()
 
 
+def _run_key(random_seed, program_runs, global_counter):
+    """PRNG base key for one executor run.
+
+    Seeded program: key = f(seed, per-program run index) — deterministic
+    across executors/scopes (reference: fixed-seed programs reproduce init
+    exactly), while dropout still varies step to step. The run index lives
+    on the Program (not the compile-cache entry) so cache misses or
+    alternating fetch lists never restart the stream.
+    Unseeded: fresh key per run."""
+    if random_seed:
+        return jax.random.fold_in(jax.random.PRNGKey(random_seed),
+                                  program_runs)
+    return jax.random.PRNGKey(global_counter % (2 ** 31))
+
+
+def _next_program_run(program):
+    n = getattr(program, '_rng_run_counter', 0) + 1
+    program._rng_run_counter = n
+    return n
+
+
 _global_scope = Scope()
 _scope_stack = [_global_scope]
 
@@ -206,9 +227,8 @@ class Executor(object):
             rw_state[n] = self._state_value(scope, n, program)
 
         self._run_counter += 1
-        seed = program.random_seed or 0
-        key_arr = jax.random.PRNGKey(
-            (seed * 1000003 + self._run_counter) % (2 ** 31))
+        key_arr = _run_key(program.random_seed, _next_program_run(program),
+                           self._run_counter)
         fetches, new_state = entry.fn(feed, ro_state, rw_state, key_arr)
         scope.update(new_state)
         if return_numpy:

@@ -34,6 +34,7 @@ __all__ = [
     'sums_', 'logical_and', 'logical_or', 'logical_xor', 'logical_not',
     'where', 'sign', 'gather_nd', 'random_crop', 'mean_iou', 'hash',
     'grid_sampler', 'teacher_student_sigmoid_loss', 'selu', 'swish',
+    'sharding_constraint',
 ]
 
 
@@ -1414,6 +1415,15 @@ def hash(input, hash_size, num_hash=1, name=None):
                      outputs={'Out': [out]},
                      attrs={'num_hash': num_hash, 'mod_by': hash_size})
     return out
+
+
+def sharding_constraint(x, spec, name=None):
+    """Pin x's sharding to a PartitionSpec-like tuple, e.g.
+    ('data', None, 'model'). TPU-native activation-sharding primitive used
+    for sequence/tensor parallelism (see parallel/api.py)."""
+    helper = LayerHelper('sharding_constraint', name=name)
+    return _simple(helper, 'sharding_constraint', x,
+                   attrs={'spec': list(spec)})
 
 
 def grid_sampler(x, grid, name=None):

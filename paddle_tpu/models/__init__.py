@@ -1,0 +1,12 @@
+"""Model zoo mirroring the reference benchmark/book models
+(reference benchmark/fluid/models/: mnist, resnet, vgg, se_resnext,
+stacked_dynamic_lstm, machine_translation; tests/book/ 8 models).
+Each build_* returns (feeds, fetches) dicts of Variables on the current
+default program.
+"""
+from . import mnist
+from . import resnet
+from . import vgg
+from . import se_resnext
+from . import word2vec
+from . import transformer

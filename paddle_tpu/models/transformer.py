@@ -1,0 +1,120 @@
+"""Transformer models (reference benchmark/fluid/machine_translation.py +
+fluid Transformer configs; built here as the flagship TPU model).
+
+Decoder-only LM (GPT-style) with causal masking, plus an encoder stack for
+NMT. All ops are dense batched matmuls -> MXU-friendly; parameters carry
+naming conventions ('*.qkv*', '*.ffn1*', ...) that parallel/api.py's sharding
+rules match for tensor parallelism.
+"""
+import numpy as np
+
+from .. import layers
+from ..param_attr import ParamAttr
+
+__all__ = ['multi_head_attention', 'transformer_block', 'build_lm',
+           'LMConfig']
+
+
+class LMConfig(object):
+    def __init__(self, vocab_size=32000, seq_len=512, d_model=512,
+                 n_head=8, n_layer=6, d_ff=2048, dropout=0.1):
+        self.vocab_size = vocab_size
+        self.seq_len = seq_len
+        self.d_model = d_model
+        self.n_head = n_head
+        self.n_layer = n_layer
+        self.d_ff = d_ff
+        self.dropout = dropout
+
+
+def multi_head_attention(x, cfg, prefix, mask_var=None, is_test=False,
+                         seq_parallel=False):
+    """Fused-QKV multi-head self-attention: one (D, 3D) matmul for Q,K,V
+    (fewer, larger MXU matmuls than three separate projections)."""
+    d, h = cfg.d_model, cfg.n_head
+    dh = d // h
+    qkv = layers.fc(input=x, size=3 * d, num_flatten_dims=2,
+                    param_attr=ParamAttr(name=prefix + '.qkv.w'),
+                    bias_attr=ParamAttr(name=prefix + '.qkv.b'))
+    qkv = layers.reshape(qkv, shape=[0, cfg.seq_len, 3, h, dh])
+    qkv = layers.transpose(qkv, perm=[2, 0, 3, 1, 4])  # (3, B, H, L, dh)
+    q = layers.squeeze(layers.slice(qkv, axes=[0], starts=[0], ends=[1]),
+                       axes=[0])
+    k = layers.squeeze(layers.slice(qkv, axes=[0], starts=[1], ends=[2]),
+                       axes=[0])
+    v = layers.squeeze(layers.slice(qkv, axes=[0], starts=[2], ends=[3]),
+                       axes=[0])
+    logits = layers.matmul(q, k, transpose_y=True, alpha=dh ** -0.5)
+    if mask_var is not None:
+        logits = layers.elementwise_add(logits, mask_var)
+    weights = layers.softmax(logits)
+    if cfg.dropout and not is_test:
+        weights = layers.dropout(weights, dropout_prob=cfg.dropout,
+                                 is_test=is_test,
+                                 dropout_implementation='upscale_in_train')
+    ctx = layers.matmul(weights, v)                    # (B, H, L, dh)
+    ctx = layers.transpose(ctx, perm=[0, 2, 1, 3])
+    ctx = layers.reshape(ctx, shape=[0, cfg.seq_len, d])
+    out = layers.fc(input=ctx, size=d, num_flatten_dims=2,
+                    param_attr=ParamAttr(name=prefix + '.proj.w'),
+                    bias_attr=ParamAttr(name=prefix + '.proj.b'))
+    return out
+
+
+def transformer_block(x, cfg, prefix, mask_var=None, is_test=False):
+    # pre-norm residual blocks
+    ln1 = layers.layer_norm(x, begin_norm_axis=2,
+                            param_attr=ParamAttr(name=prefix + '.ln1.w'),
+                            bias_attr=ParamAttr(name=prefix + '.ln1.b'))
+    attn = multi_head_attention(ln1, cfg, prefix + '.attn',
+                                mask_var=mask_var, is_test=is_test)
+    x = layers.elementwise_add(x, attn)
+    ln2 = layers.layer_norm(x, begin_norm_axis=2,
+                            param_attr=ParamAttr(name=prefix + '.ln2.w'),
+                            bias_attr=ParamAttr(name=prefix + '.ln2.b'))
+    ff1 = layers.fc(input=ln2, size=cfg.d_ff, num_flatten_dims=2,
+                    act='gelu',
+                    param_attr=ParamAttr(name=prefix + '.ffn1.w'),
+                    bias_attr=ParamAttr(name=prefix + '.ffn1.b'))
+    ff2 = layers.fc(input=ff1, size=cfg.d_model, num_flatten_dims=2,
+                    param_attr=ParamAttr(name=prefix + '.ffn2.w'),
+                    bias_attr=ParamAttr(name=prefix + '.ffn2.b'))
+    if cfg.dropout and not is_test:
+        ff2 = layers.dropout(ff2, dropout_prob=cfg.dropout, is_test=is_test,
+                             dropout_implementation='upscale_in_train')
+    return layers.elementwise_add(x, ff2)
+
+
+def build_lm(cfg=None, is_test=False):
+    """Causal LM: feeds {'tokens', 'labels'} of shape (B, L) int64; returns
+    (tokens, labels, logits, avg_loss)."""
+    cfg = cfg or LMConfig()
+    tokens = layers.data(name='tokens', shape=[cfg.seq_len], dtype='int64')
+    labels = layers.data(name='labels', shape=[cfg.seq_len], dtype='int64')
+
+    emb = layers.embedding(
+        tokens, size=[cfg.vocab_size, cfg.d_model], dtype='float32',
+        param_attr=ParamAttr(name='tok_emb.w'))
+    x = layers.add_position_encoding(emb, alpha=1.0, beta=1.0)
+    if cfg.dropout and not is_test:
+        x = layers.dropout(x, dropout_prob=cfg.dropout, is_test=is_test,
+                           dropout_implementation='upscale_in_train')
+
+    causal = np.triu(np.full((cfg.seq_len, cfg.seq_len), -1e9,
+                             dtype='float32'), k=1)
+    mask_var = layers.assign(causal)
+
+    for i in range(cfg.n_layer):
+        x = transformer_block(x, cfg, 'layer_%d' % i, mask_var=mask_var,
+                              is_test=is_test)
+    x = layers.layer_norm(x, begin_norm_axis=2,
+                          param_attr=ParamAttr(name='final_ln.w'),
+                          bias_attr=ParamAttr(name='final_ln.b'))
+    logits = layers.fc(input=x, size=cfg.vocab_size, num_flatten_dims=2,
+                       param_attr=ParamAttr(name='lm_head.w'),
+                       bias_attr=False)
+    flat_logits = layers.reshape(logits, shape=[-1, cfg.vocab_size])
+    flat_labels = layers.reshape(labels, shape=[-1, 1])
+    loss = layers.softmax_with_cross_entropy(flat_logits, flat_labels)
+    avg_loss = layers.mean(loss)
+    return tokens, labels, logits, avg_loss

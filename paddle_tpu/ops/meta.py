@@ -8,7 +8,7 @@ fill_zeros_like_op.cc, shape_op.cc, print_op.cc.
 import numpy as np
 import jax
 import jax.numpy as jnp
-from jax import lax
+from jax import lax  # noqa: F401
 
 from ..core.registry import register_op
 from .common import np_dtype
@@ -144,6 +144,31 @@ def _one_hot(ctx, op):
     ids = x.reshape(x.shape[:-1]) if x.shape and x.shape[-1] == 1 else x
     out = jax.nn.one_hot(ids, depth, dtype=jnp.float32)
     ctx.out(op, 'Out', out)
+
+
+@register_op('sharding_constraint')
+def _sharding_constraint(ctx, op):
+    """Pin an activation's sharding (TPU-native primitive; no reference
+    analog — this is how sequence/activation parallelism is expressed).
+    No-op when traced outside a mesh context."""
+    x = ctx.in1(op, 'X')
+    spec = tuple(op.attr('spec', ()))
+    try:
+        from jax.sharding import PartitionSpec, NamedSharding
+        from ..parallel import api as _papi
+        mesh = _papi.get_active_mesh()
+        if mesh is not None:
+            axes = set(mesh.axis_names)
+            ok = all((a is None or
+                      (a in axes if isinstance(a, str)
+                       else all(s in axes for s in a)))
+                     for a in spec)
+            if ok:
+                x = jax.lax.with_sharding_constraint(
+                    x, NamedSharding(mesh, PartitionSpec(*spec)))
+    except Exception:
+        pass
+    ctx.out(op, 'Out', x)
 
 
 @register_op('is_empty')

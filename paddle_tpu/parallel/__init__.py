@@ -8,4 +8,6 @@ parameters; gen_nccl_id gRPC bootstrap → jax.distributed.initialize.
 from . import mesh
 from . import spmd
 from . import collective
+from . import api
 from .mesh import default_device_count, make_mesh, data_mesh
+from .api import MeshRunner, ShardingRules

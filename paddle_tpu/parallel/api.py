@@ -1,0 +1,138 @@
+"""MeshRunner: run a Program SPMD over an arbitrary mesh with sharding rules.
+
+This is the TPU-native replacement for the reference DistributeTranspiler
+(python/paddle/fluid/transpiler/distribute_transpiler.py:161): instead of
+rewriting the program with send/recv/pserver ops, you declare
+- a mesh (axes like data/model/seq/expert),
+- regex rules mapping parameter names -> PartitionSpec (tensor parallel /
+  sharded "parameter server" placement),
+- feed specs mapping feed names -> PartitionSpec (data/sequence parallel),
+and the SAME program compiles to one SPMD executable; the XLA partitioner
+inserts all collectives (psum/all_gather/reduce_scatter/all_to_all) over ICI.
+
+`sharding_constraint` ops inside the program (layers.nn.sharding_constraint)
+pin intermediate activations to specs — the mechanism for sequence
+parallelism and megatron-style activation sharding.
+"""
+import re
+
+import numpy as np
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core import lowering
+from ..framework import Variable
+
+__all__ = ['ShardingRules', 'MeshRunner', 'get_active_mesh']
+
+# Mesh visible to op lowerings while a MeshRunner traces its program
+# (sharding_constraint ops resolve PartitionSpecs against it).
+_ACTIVE_MESH = None
+
+
+def get_active_mesh():
+    return _ACTIVE_MESH
+
+
+class ShardingRules(object):
+    """Ordered (regex, PartitionSpec) list; first match wins; default
+    replicated."""
+
+    def __init__(self, rules=None):
+        self._rules = [(re.compile(pat), spec) for pat, spec in
+                       (rules or [])]
+
+    def add(self, pattern, spec):
+        self._rules.append((re.compile(pattern), spec))
+        return self
+
+    def spec_for(self, name):
+        for pat, spec in self._rules:
+            if pat.search(name):
+                return spec
+        return P()
+
+
+class _MeshEntry(object):
+    __slots__ = ('fn', 'ro_names', 'rw_names')
+
+    def __init__(self, fn, ro_names, rw_names):
+        self.fn = fn
+        self.ro_names = ro_names
+        self.rw_names = rw_names
+
+
+class MeshRunner(object):
+    def __init__(self, program, mesh, param_rules=None, feed_specs=None,
+                 fetch_specs=None):
+        self._program = program
+        self._mesh = mesh
+        self._rules = param_rules if isinstance(param_rules, ShardingRules) \
+            else ShardingRules(param_rules)
+        self._feed_specs = dict(feed_specs or {})
+        self._cache = {}
+        self._run_counter = 0
+
+    def _sharding(self, spec):
+        return NamedSharding(self._mesh, spec)
+
+    def compile(self, feed_shapes, fetch_names, scope):
+        """feed_shapes: {name: (shape, dtype)}."""
+        program = self._program
+        read, written = lowering.analyze_state(program, fetch_names)
+        from ..executor import Executor
+        needed = Executor._read_before_write(
+            program, read, written, set(feed_shapes), fetch_names)
+        fn, ro_names, rw_names = lowering.build_fn(
+            program, fetch_names, needed, written)
+        in_shardings = (
+            {k: self._sharding(self._feed_specs.get(k, P()))
+             for k in feed_shapes},
+            {n: self._sharding(self._rules.spec_for(n)) for n in ro_names},
+            {n: self._sharding(self._rules.spec_for(n)) for n in rw_names},
+            self._sharding(P()),
+        )
+        out_shardings = (
+            None,
+            {n: self._sharding(self._rules.spec_for(n)) for n in written},
+        )
+        jitted = jax.jit(fn, in_shardings=in_shardings,
+                         out_shardings=out_shardings, donate_argnums=(2,))
+        return jitted, ro_names, rw_names
+
+    def run(self, feed, fetch_list, scope, return_numpy=True):
+        from ..executor import global_scope, Executor
+        if scope is None:
+            scope = global_scope()
+        program = self._program
+        exe = Executor()
+        feed = exe._prepare_feed(program, feed or {})
+        fetch_names = [v.name if isinstance(v, Variable) else v
+                       for v in (fetch_list or [])]
+        key = (program._version, exe._feed_signature(feed),
+               tuple(fetch_names))
+        entry = self._cache.get(key)
+        if entry is None:
+            fn_, ro_, rw_ = self.compile(
+                {k: (v.shape, v.dtype) for k, v in feed.items()},
+                fetch_names, scope)
+            entry = _MeshEntry(fn_, ro_, rw_)
+            self._cache[key] = entry
+        fn, ro_names, rw_names = entry.fn, entry.ro_names, entry.rw_names
+        ro = {n: exe._state_value(scope, n, program) for n in ro_names}
+        rw = {n: exe._state_value(scope, n, program) for n in rw_names}
+        self._run_counter += 1
+        from ..executor import _run_key, _next_program_run
+        key_arr = _run_key(program.random_seed, _next_program_run(program),
+                           self._run_counter)
+        global _ACTIVE_MESH
+        prev, _ACTIVE_MESH = _ACTIVE_MESH, self._mesh
+        try:
+            with self._mesh:
+                fetches, new_state = fn(feed, ro, rw, key_arr)
+        finally:
+            _ACTIVE_MESH = prev
+        scope.update(new_state)
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return list(fetches)

@@ -96,11 +96,17 @@ class DataParallelRunner(object):
         rw_state = {n: executor._state_value(scope, n, program)
                     for n in entry.rw_names}
         self._run_counter += 1
-        seed = program.random_seed or 0
-        key_arr = jax.random.PRNGKey(
-            (seed * 1000003 + self._run_counter) % (2 ** 31))
-        with self._mesh:
-            fetches, new_state = entry.fn(feed, ro_state, rw_state, key_arr)
+        from ..executor import _run_key, _next_program_run
+        key_arr = _run_key(program.random_seed, _next_program_run(program),
+                           self._run_counter)
+        from . import api as _papi
+        prev, _papi._ACTIVE_MESH = _papi._ACTIVE_MESH, self._mesh
+        try:
+            with self._mesh:
+                fetches, new_state = entry.fn(feed, ro_state, rw_state,
+                                              key_arr)
+        finally:
+            _papi._ACTIVE_MESH = prev
         scope.update(new_state)
         if return_numpy:
             return [np.asarray(f) for f in fetches]

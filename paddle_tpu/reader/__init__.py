@@ -1,0 +1,8 @@
+from .decorator import (cache, map_readers, buffered, compose, chain,
+                        shuffle, firstn, xmap_readers, multiprocess_reader)
+from .batch import batch
+from .prefetch import DevicePrefetcher, PyReader
+
+__all__ = ['cache', 'map_readers', 'buffered', 'compose', 'chain', 'shuffle',
+           'firstn', 'xmap_readers', 'multiprocess_reader', 'batch',
+           'DevicePrefetcher', 'PyReader']

@@ -1,0 +1,20 @@
+"""batch reader decorator (reference python/paddle/batch.py:18)."""
+
+__all__ = ['batch']
+
+
+def batch(reader, batch_size, drop_last=False):
+    def batch_reader():
+        r = reader()
+        b = []
+        for instance in r:
+            b.append(instance)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if drop_last is False and len(b) != 0:
+            yield b
+    if batch_size <= 0:
+        raise ValueError("batch_size should be a positive integer, "
+                         "got %d" % batch_size)
+    return batch_reader

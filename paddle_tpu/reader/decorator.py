@@ -1,0 +1,259 @@
+"""Reader decorators (reference python/paddle/reader/decorator.py:36-338:
+map_readers, buffered, compose, chain, shuffle, firstn, xmap_readers,
+multiprocess_reader, cache). Readers are argless callables returning sample
+iterators — identical contract to the reference.
+"""
+import itertools
+import random
+import multiprocessing
+import queue as _queue
+import threading
+
+__all__ = ['cache', 'map_readers', 'buffered', 'compose', 'chain', 'shuffle',
+           'firstn', 'xmap_readers', 'multiprocess_reader']
+
+
+def cache(reader):
+    all_data = tuple(reader())
+
+    def cache_reader():
+        return iter(all_data)
+    return cache_reader
+
+
+def map_readers(func, *readers):
+    def reader():
+        rs = [r() for r in readers]
+        for e in map(func, *rs):
+            yield e
+    return reader
+
+
+def shuffle(reader, buf_size):
+    def data_reader():
+        buf = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                random.shuffle(buf)
+                for b in buf:
+                    yield b
+                buf = []
+        if buf:
+            random.shuffle(buf)
+            for b in buf:
+                yield b
+    return data_reader
+
+
+def chain(*readers):
+    def reader():
+        rs = [r() for r in readers]
+        for e in itertools.chain(*rs):
+            yield e
+    return reader
+
+
+class ComposeNotAligned(ValueError):
+    pass
+
+
+def compose(*readers, **kwargs):
+    check_alignment = kwargs.pop('check_alignment', True)
+
+    def make_tuple(x):
+        if isinstance(x, tuple):
+            return x
+        return (x,)
+
+    def reader():
+        rs = [r() for r in readers]
+        if not check_alignment:
+            for outputs in zip(*rs):
+                yield sum(list(map(make_tuple, outputs)), ())
+        else:
+            for outputs in itertools.zip_longest(*rs):
+                for o in outputs:
+                    if o is None:
+                        raise ComposeNotAligned(
+                            "outputs of readers are not aligned")
+                yield sum(list(map(make_tuple, outputs)), ())
+    return reader
+
+
+class _EndSignal(object):
+    """Queue sentinel; carries a worker exception to re-raise in the
+    consumer so a failing reader never looks like a clean exhaustion."""
+
+    def __init__(self, error=None):
+        self.error = error
+
+
+def buffered(reader, size):
+    def read_worker(r, q):
+        try:
+            for d in r:
+                q.put(d)
+        except BaseException as e:
+            q.put(_EndSignal(e))
+        else:
+            q.put(_EndSignal())
+
+    def data_reader():
+        r = reader()
+        q = _queue.Queue(maxsize=size)
+        t = threading.Thread(target=read_worker, args=(r, q))
+        t.daemon = True
+        t.start()
+        while True:
+            e = q.get()
+            if isinstance(e, _EndSignal):
+                if e.error is not None:
+                    raise e.error
+                return
+            yield e
+    return data_reader
+
+
+def firstn(reader, n):
+    def firstn_reader():
+        for i, item in enumerate(reader()):
+            if i == n:
+                break
+            yield item
+    return firstn_reader
+
+
+class XmapEndSignal(object):
+    def __init__(self, error=None):
+        self.error = error
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size,
+                 order=False):
+    """Parallel map over a reader with worker threads
+    (reference decorator.py xmap_readers)."""
+    end = XmapEndSignal()
+
+    def read_worker(r, in_q):
+        try:
+            for i in r():
+                in_q.put(i)
+        except BaseException as e:
+            in_q.put(XmapEndSignal(e))
+        else:
+            in_q.put(end)
+
+    def order_read_worker(r, in_q):
+        try:
+            for order_id, i in enumerate(r()):
+                in_q.put((order_id, i))
+        except BaseException as e:
+            in_q.put(XmapEndSignal(e))
+        else:
+            in_q.put(end)
+
+    def handle_worker(in_q, out_q, m):
+        sample = in_q.get()
+        while not isinstance(sample, XmapEndSignal):
+            try:
+                out_q.put(m(sample))
+            except BaseException as e:
+                in_q.put(end)
+                out_q.put(XmapEndSignal(e))
+                return
+            sample = in_q.get()
+        in_q.put(sample)
+        out_q.put(sample)
+
+    def order_handle_worker(in_q, out_q, m, out_order):
+        lock, cond = out_order[1], out_order[2]
+        ins = in_q.get()
+        while not isinstance(ins, XmapEndSignal):
+            order_id, sample = ins
+            try:
+                result = m(sample)
+            except BaseException as e:
+                in_q.put(end)
+                out_q.put(XmapEndSignal(e))
+                return
+            with cond:
+                while order_id != out_order[0]:
+                    cond.wait()
+                out_q.put(result)
+                out_order[0] += 1
+                cond.notify_all()
+            ins = in_q.get()
+        in_q.put(ins)
+        out_q.put(ins)
+
+    def xreader():
+        in_q = _queue.Queue(buffer_size)
+        out_q = _queue.Queue(buffer_size)
+        lock = threading.Lock()
+        out_order = [0, lock, threading.Condition(lock)]
+        target = order_read_worker if order else read_worker
+        t = threading.Thread(target=target, args=(reader, in_q))
+        t.daemon = True
+        t.start()
+        workers = []
+        for i in range(process_num):
+            worker = threading.Thread(
+                target=order_handle_worker if order else handle_worker,
+                args=(in_q, out_q, mapper, out_order) if order else
+                (in_q, out_q, mapper))
+            worker.daemon = True
+            workers.append(worker)
+            worker.start()
+        finish = 0
+        while finish < process_num:
+            sample = out_q.get()
+            if isinstance(sample, XmapEndSignal):
+                if sample.error is not None:
+                    raise sample.error
+                finish += 1
+            else:
+                yield sample
+    return xreader
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """Fan-in multiple readers via subprocesses (reference
+    decorator.py multiprocess_reader). Uses fork-based workers feeding a
+    multiprocessing queue."""
+    if len(readers) < 1:
+        raise ValueError("multiprocess_reader needs at least one reader")
+
+    def queue_reader():
+        q = multiprocessing.Queue(queue_size)
+
+        def _read_into_queue(r, q):
+            try:
+                for sample in r():
+                    if sample is None:
+                        raise ValueError("sample has None")
+                    q.put(sample)
+            except BaseException as e:
+                q.put(('__reader_error__', repr(e)))
+            else:
+                q.put(None)
+
+        procs = []
+        for r in readers:
+            p = multiprocessing.Process(target=_read_into_queue,
+                                        args=(r, q))
+            p.daemon = True
+            p.start()
+            procs.append(p)
+        finish_num = 0
+        while finish_num < len(readers):
+            sample = q.get()
+            if sample is None:
+                finish_num += 1
+            elif isinstance(sample, tuple) and len(sample) == 2 and \
+                    sample[0] == '__reader_error__':
+                raise RuntimeError("multiprocess reader failed: %s"
+                                   % sample[1])
+            else:
+                yield sample
+    return queue_reader

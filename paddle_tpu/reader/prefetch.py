@@ -1,0 +1,106 @@
+"""Host→device prefetch pipeline: the TPU-native replacement for reader ops.
+
+Reference counterparts: operators/reader/create_py_reader_op.cc +
+LoDTensorBlockingQueue (lod_tensor_blocking_queue.h:31) and buffered_reader
+(buffered_reader.h:30, double-buffer to GPU). Here: a background thread pulls
+numpy batches from a python reader into a bounded queue and eagerly
+device_puts them, so the accelerator never waits on host input — the same
+double-buffering contract, without graph-visible reader ops.
+"""
+import queue as _queue
+import threading
+
+import numpy as np
+
+__all__ = ['DevicePrefetcher', 'PyReader']
+
+
+class _End(object):
+    def __init__(self, error=None):
+        self.error = error
+
+
+class DevicePrefetcher(object):
+    """Iterate device-resident feed dicts from a batch reader."""
+
+    def __init__(self, reader, feed_names=None, capacity=2, device=None,
+                 feeder=None):
+        self._reader = reader
+        self._feed_names = feed_names
+        self._capacity = capacity
+        self._device = device
+        self._feeder = feeder
+
+    def __iter__(self):
+        import jax
+        q = _queue.Queue(maxsize=self._capacity)
+
+        def worker():
+            try:
+                for batch in self._reader():
+                    if self._feeder is not None:
+                        feed = self._feeder.feed(batch)
+                    elif isinstance(batch, dict):
+                        feed = batch
+                    else:
+                        feed = dict(zip(self._feed_names, batch))
+                    # eager device_put = transfer overlaps with compute
+                    feed = {k: jax.device_put(np.asarray(v), self._device)
+                            for k, v in feed.items()}
+                    q.put(feed)
+            except BaseException as e:
+                q.put(_End(e))
+            else:
+                q.put(_End())
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if isinstance(item, _End):
+                if item.error is not None:
+                    raise item.error
+                break
+            yield item
+
+
+class PyReader(object):
+    """API-parity shim for fluid.layers.py_reader usage patterns
+    (reference layers/io.py:636): decorate with a paddle reader, then
+    iterate feed dicts."""
+
+    def __init__(self, feed_list=None, capacity=2, use_double_buffer=True,
+                 iterable=True):
+        from ..framework import Variable
+        # keep the Variables themselves: resolving bare names later against
+        # default_main_program would break when another program is current
+        self._feed_vars = [v for v in (feed_list or [])
+                           if isinstance(v, Variable)]
+        self._feed_names = [v.name if isinstance(v, Variable) else v
+                            for v in (feed_list or [])]
+        self._capacity = capacity
+        self._reader = None
+
+    def decorate_sample_list_generator(self, reader, places=None):
+        from ..data_feeder import DataFeeder
+        feeder = DataFeeder(self._feed_vars or self._feed_names)
+        self._prefetcher = DevicePrefetcher(reader, capacity=self._capacity,
+                                            feeder=feeder)
+        return self
+
+    def decorate_batch_generator(self, reader, places=None):
+        self._prefetcher = DevicePrefetcher(reader,
+                                            feed_names=self._feed_names,
+                                            capacity=self._capacity)
+        return self
+
+    decorate_paddle_reader = decorate_sample_list_generator
+
+    def __iter__(self):
+        return iter(self._prefetcher)
+
+    def start(self):
+        self._iter = iter(self._prefetcher)
+
+    def reset(self):
+        self._iter = None

@@ -1,0 +1,9 @@
+from .distribute_transpiler import (DistributeTranspiler,
+                                    DistributeTranspilerConfig)
+from .memory_optimization_transpiler import memory_optimize, release_memory
+from .inference_transpiler import InferenceTranspiler
+from .ps_dispatcher import RoundRobin, HashName, PSDispatcher
+
+__all__ = ['DistributeTranspiler', 'DistributeTranspilerConfig',
+           'memory_optimize', 'release_memory', 'InferenceTranspiler',
+           'RoundRobin', 'HashName', 'PSDispatcher']

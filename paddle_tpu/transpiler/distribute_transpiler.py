@@ -1,0 +1,126 @@
+"""DistributeTranspiler: distributed-training program planning.
+
+Reference python/paddle/fluid/transpiler/distribute_transpiler.py:161,280 —
+there, the transpiler rewrites the program into trainer/pserver halves with
+send/recv/barrier ops over gRPC. On TPU there are no parameter servers: the
+two reference modes map to SPMD plans (SURVEY §2.7):
+
+- pserver mode  -> sharded-parameter SPMD: each "pserver shard" becomes a
+  slice of the parameter along mesh axis 'model' (round-robin/size-balanced,
+  mirroring slice_var_up/min_block_size), updated in place by the same
+  compiled step; the gather/scatter the pserver RPC performed becomes XLA
+  all_gather/reduce_scatter over ICI.
+- nccl2 mode    -> plain data-parallel SPMD over all trainers
+  (jax.distributed handles the multi-host bootstrap that gen_nccl_id did).
+
+The transpile() API is kept; the result is a ShardingPlan (mesh axes + rules)
+consumable by parallel.MeshRunner, plus trainer/pserver program getters that
+return the SAME program (SPMD is single-program) with the plan attached.
+"""
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..framework import default_main_program, Parameter
+from ..parallel.api import ShardingRules
+
+__all__ = ['DistributeTranspiler', 'DistributeTranspilerConfig']
+
+
+class DistributeTranspilerConfig(object):
+    """Reference distribute_transpiler.py:130: slice_var_up, split_method,
+    min_block_size (+ mode)."""
+
+    slice_var_up = True
+    split_method = None
+    min_block_size = 8192
+    mode = "pserver"
+    print_log = False
+
+
+class ShardingPlan(object):
+    def __init__(self, rules, feed_axis='data', num_shards=1):
+        self.rules = rules
+        self.feed_axis = feed_axis
+        self.num_shards = num_shards
+
+    def mesh_axes(self, num_devices):
+        if self.num_shards <= 1:
+            return [('data', num_devices)]
+        model = int(np.gcd(self.num_shards, num_devices))
+        return [('data', num_devices // model), ('model', model)]
+
+
+class DistributeTranspiler(object):
+    def __init__(self, config=None):
+        self.config = config or DistributeTranspilerConfig()
+        self._plan = None
+        self._program = None
+
+    def transpile(self, trainer_id, program=None, pservers="127.0.0.1:6174",
+                  trainers=1, sync_mode=True, startup_program=None,
+                  current_endpoint="127.0.0.1:6174"):
+        if program is None:
+            program = default_main_program()
+        self._program = program
+        self.trainer_id = trainer_id
+        self.trainers = trainers
+        self.sync_mode = sync_mode
+        if isinstance(pservers, str):
+            eplist = [e for e in pservers.split(",") if e]
+        else:
+            eplist = list(pservers)
+        self.pserver_endpoints = eplist
+
+        if self.config.mode == "nccl2" or not eplist:
+            # pure data parallel; params replicated
+            self._plan = ShardingPlan(ShardingRules([]), num_shards=1)
+            return
+
+        # pserver mode: shard large parameters along their largest dim over
+        # the 'model' axis — one rule per parameter above min_block_size
+        rules = []
+        for p in program.all_parameters():
+            if not isinstance(p, Parameter) or p.shape is None:
+                continue
+            size = int(np.prod(p.shape))
+            if self.config.slice_var_up and \
+                    size >= self.config.min_block_size and len(eplist) > 1:
+                axis = int(np.argmax(p.shape))
+                spec = [None] * len(p.shape)
+                spec[axis] = 'model'
+                rules.append((r'^%s$' % _re_escape(p.name), P(*spec)))
+        self._plan = ShardingPlan(ShardingRules(rules),
+                                  num_shards=len(eplist))
+
+    # ------------------------------------------------------------------
+    def get_trainer_program(self, wait_port=True):
+        """SPMD: the trainer program IS the original program; the plan rides
+        along for MeshRunner (reference returned a rewritten program with
+        send/recv ops)."""
+        self._program._sharding_plan = self._plan
+        return self._program
+
+    def get_pserver_program(self, endpoint):
+        """No pserver process exists on TPU; kept for API parity."""
+        raise NotImplementedError(
+            "TPU-native training has no parameter-server role: parameters "
+            "are sharded over the mesh ('model' axis) inside one SPMD "
+            "program. Run get_trainer_program() on every host; "
+            "jax.distributed.initialize() replaces the pserver bootstrap.")
+
+    def get_pserver_programs(self, endpoint):
+        return self.get_pserver_program(endpoint)
+
+    def get_startup_program(self, endpoint=None, pserver_program=None,
+                            startup_program=None):
+        from ..framework import default_startup_program
+        return startup_program or default_startup_program()
+
+    @property
+    def sharding_plan(self):
+        return self._plan
+
+
+def _re_escape(s):
+    import re
+    return re.escape(s)

@@ -1,0 +1,77 @@
+"""Inference transpiler (reference transpiler/inference_transpiler.py:24 —
+folds BN into conv weights, fuses relu, for faster inference).
+
+TPU-native: XLA fuses conv+bn+relu at compile time, so runtime perf needs no
+rewrite; we still implement constant-folding of batch_norm into conv2d
+weights as a program-level pass because it (a) shrinks the program and
+(b) removes the BN state vars from the inference checkpoint — same observable
+contract as the reference pass.
+"""
+import numpy as np
+
+from ..executor import global_scope
+
+__all__ = ['InferenceTranspiler']
+
+
+class InferenceTranspiler(object):
+    def transpile(self, program, place=None, scope=None):
+        if scope is None:
+            scope = global_scope()
+        block = program.global_block()
+        i = 0
+        while i < len(block.ops) - 1:
+            op = block.ops[i]
+            nxt = block.ops[i + 1]
+            if op.type == 'conv2d' and nxt.type == 'batch_norm' and \
+                    nxt.attr('is_test', False) and \
+                    op.output('Output') and nxt.input('X') and \
+                    op.output('Output')[0] == nxt.input('X')[0]:
+                if self._fold_bn(block, i, op, nxt, scope):
+                    continue
+            i += 1
+        program._bump_version()
+        return program
+
+    def _fold_bn(self, block, idx, conv_op, bn_op, scope):
+        w_name = conv_op.input('Filter')[0]
+        names = {s: bn_op.input(s)[0] for s in
+                 ('Scale', 'Bias', 'Mean', 'Variance')}
+        vals = {}
+        for s, n in names.items():
+            v = scope.get(n)
+            if v is None:
+                return False
+            vals[s] = np.asarray(v, dtype='float64')
+        w = scope.get(w_name)
+        if w is None:
+            return False
+        w = np.asarray(w, dtype='float64')
+        eps = bn_op.attr('epsilon', 1e-5)
+        inv_std = 1.0 / np.sqrt(vals['Variance'] + eps)
+        alpha = vals['Scale'] * inv_std                      # per out-channel
+        scope.set(w_name, (w * alpha[:, None, None, None]).astype('float32'))
+        bias = (vals['Bias'] - vals['Mean'] * alpha).astype('float32')
+        bias_name = w_name + '.bn_folded_bias'
+        bvar = block.create_var(name=bias_name, shape=(w.shape[0],),
+                                dtype='float32', persistable=True)
+        scope.set(bias_name, bias)
+        y_name = bn_op.output('Y')[0]
+        # replace bn with an axis-1 bias add producing the bn output var
+        from ..framework import Operator
+        add_op = Operator(block, 'elementwise_add',
+                          inputs={'X': conv_op.output('Output'),
+                                  'Y': [bias_name]},
+                          outputs={'Out': [y_name]},
+                          attrs={'axis': 1})
+        block.ops[idx + 1] = add_op
+        # drop the folded BN state from block and scope so the inference
+        # checkpoint shrinks (the folding's point, beyond program size)
+        for n in names.values():
+            still_used = any(n in op.input_arg_names or
+                             n in op.output_arg_names
+                             for op in block.ops)
+            if not still_used:
+                block.vars.pop(n, None)
+                scope.drop(n)
+        return True

@@ -1,0 +1,23 @@
+"""Memory optimization (reference transpiler/
+memory_optimization_transpiler.py:113,495 — liveness-based var reuse).
+
+TPU-native: XLA buffer assignment + our rw-state donation already provide
+in-place reuse (core/lowering.py build_callable), so these are no-op
+API-parity passes. Rematerialization (the real TPU memory lever) is exposed
+via the `checkpoints` argument of append_backward -> jax.checkpoint.
+"""
+
+__all__ = ['memory_optimize', 'release_memory']
+
+
+def memory_optimize(input_program, skip_opt_set=None, print_log=False,
+                    level=0, skip_grads=False):
+    if print_log:
+        print("memory_optimize: no-op on TPU — XLA buffer assignment + "
+              "donation handle reuse; use append_backward(checkpoints=...) "
+              "for rematerialization")
+    return input_program
+
+
+def release_memory(input_program, skip_opt_set=None):
+    return input_program

@@ -1,0 +1,43 @@
+"""Parameter placement dispatchers (reference transpiler/ps_dispatcher.py:
+18,46,70 RoundRobin / HashName). On TPU these choose which mesh-shard index
+a parameter block maps to; kept primarily for API/test parity."""
+
+__all__ = ['PSDispatcher', 'RoundRobin', 'HashName']
+
+
+class PSDispatcher(object):
+    def __init__(self, pserver_endpoints):
+        self._eps = list(pserver_endpoints)
+        self._step = 0
+
+    @property
+    def eps(self):
+        return self._eps
+
+    def reset(self):
+        self._step = 0
+
+    def dispatch(self, varlist):
+        raise NotImplementedError
+
+
+class RoundRobin(PSDispatcher):
+    def dispatch(self, varlist):
+        out = []
+        for v in varlist:
+            out.append(self._eps[self._step])
+            self._step = (self._step + 1) % len(self._eps)
+        return out
+
+
+class HashName(PSDispatcher):
+    @staticmethod
+    def _hash_block(block_str, total):
+        return hash(block_str) % total
+
+    def dispatch(self, varlist):
+        out = []
+        for v in varlist:
+            name = v.name if hasattr(v, 'name') else str(v)
+            out.append(self._eps[self._hash_block(name, len(self._eps))])
+        return out

@@ -12,6 +12,13 @@ if '--xla_force_host_platform_device_count' not in flags:
 import numpy as np
 import pytest
 
+# The environment's sitecustomize registers the remote-TPU (axon) backend and
+# programmatically sets jax_platforms="axon,cpu", which overrides the env var
+# above and makes every test process initialize the TPU tunnel. Force it back:
+# tests run on the 8-device virtual CPU mesh only.
+import jax
+jax.config.update('jax_platforms', 'cpu')
+
 
 @pytest.fixture(autouse=True)
 def _fresh_programs():

@@ -1,0 +1,173 @@
+"""OpTest harness: per-op unit tests against numpy references + numeric grads.
+
+Port of the reference contract (python/paddle/fluid/tests/unittests/
+op_test.py:133): a test declares `self.op_type / self.inputs / self.outputs /
+self.attrs`; `check_output` runs the single op through the real executor and
+compares against the numpy expectation computed in the test;
+`check_grad` compares analytic gradients (via the backward machinery) against
+central-difference numeric gradients (reference get_numeric_gradient:44,
+delta=0.005).
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.framework import Program, program_guard
+
+
+def _as_list(v):
+    return v if isinstance(v, (list, tuple)) else [v]
+
+
+class OpTest(object):
+    """Subclass contract: implement setup() setting op_type/inputs/outputs/
+    attrs (dict values are numpy arrays, or lists of (name, array) for
+    multi-var slots)."""
+
+    op_type = None
+    inputs = {}
+    outputs = {}
+    attrs = {}
+
+    # -- program construction ------------------------------------------
+    def _entries(self, d):
+        for slot, val in d.items():
+            if isinstance(val, list) and val and isinstance(val[0], tuple):
+                yield slot, list(val)
+            else:
+                yield slot, [(slot, val)]
+
+    def _build(self):
+        prog, startup = Program(), Program()
+        feed = {}
+        out_names = {}
+        with program_guard(prog, startup):
+            block = prog.global_block()
+            in_map = {}
+            for slot, entries in self._entries(self.inputs):
+                vs = []
+                for name, arr in entries:
+                    arr = np.asarray(arr)
+                    v = block.create_var(name=name, shape=arr.shape,
+                                         dtype=arr.dtype,
+                                         stop_gradient=False)
+                    feed[name] = arr
+                    vs.append(v)
+                in_map[slot] = vs
+            out_map = {}
+            for slot, entries in self._entries(self.outputs):
+                vs = []
+                names = []
+                for name, arr in entries:
+                    v = block.create_var(name=name, dtype='float32',
+                                         stop_gradient=False)
+                    vs.append(v)
+                    names.append(name)
+                out_map[slot] = vs
+                out_names[slot] = names
+            block.append_op(type=self.op_type, inputs=in_map,
+                            outputs=out_map, attrs=dict(self.attrs))
+        return prog, feed, out_names
+
+    # -- checks ---------------------------------------------------------
+    def check_output(self, atol=1e-5, rtol=1e-4, no_check_set=None):
+        self.setup()
+        prog, feed, out_names = self._build()
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        fetch = []
+        expect = []
+        for slot, entries in self._entries(self.outputs):
+            if no_check_set and slot in no_check_set:
+                continue
+            for (name, arr), fetch_name in zip(entries, out_names[slot]):
+                fetch.append(fetch_name)
+                expect.append(np.asarray(arr))
+        got = exe.run(prog, feed=feed, fetch_list=fetch, scope=scope)
+        for name, e, g in zip(fetch, expect, got):
+            if e.dtype == np.bool_:
+                np.testing.assert_array_equal(
+                    g.astype(np.bool_), e,
+                    err_msg="output %s mismatch (%s)" % (name, self.op_type))
+            else:
+                np.testing.assert_allclose(
+                    g.astype(np.float64), e.astype(np.float64),
+                    rtol=rtol, atol=atol,
+                    err_msg="output %s mismatch (%s)" % (name, self.op_type))
+
+    def _loss_and_program(self):
+        prog, feed, out_names = self._build()
+        return prog, feed, out_names
+
+    def check_grad(self, inputs_to_check, output_names,
+                   max_relative_error=0.005, no_grad_set=None,
+                   numeric_grad_delta=0.005, atol=1e-4):
+        self.setup()
+        output_names = _as_list(output_names)
+        prog, feed, _ = self._build()
+        exe = fluid.Executor(fluid.CPUPlace())
+
+        # scalar target = sum of means of the checked outputs (matches the
+        # reference _get_gradient which appends mean over outputs)
+        with program_guard(prog):
+            means = []
+            gb = prog.global_block()
+            for oname in output_names:
+                means.append(fluid.layers.mean(gb.var(oname)))
+            if len(means) == 1:
+                loss = means[0]
+            else:
+                loss = fluid.layers.sums_(means)
+            grad_vars = fluid.calc_gradient(
+                loss, [gb.var(n) for n in inputs_to_check],
+                no_grad_set=no_grad_set)
+
+        scope = fluid.Scope()
+        analytic = exe.run(prog, feed=feed, fetch_list=grad_vars,
+                           scope=scope)
+
+        # numeric: central difference on the same loss
+        fwd_prog, fwd_feed, _ = self._build()
+        with program_guard(fwd_prog):
+            means = []
+            gb = fwd_prog.global_block()
+            for oname in output_names:
+                means.append(fluid.layers.mean(gb.var(oname)))
+            loss_fwd = means[0] if len(means) == 1 else \
+                fluid.layers.sums_(means)
+
+        scope2 = fluid.Scope()
+
+        def eval_loss(f):
+            out, = exe.run(fwd_prog, feed=f, fetch_list=[loss_fwd],
+                           scope=scope2)
+            return float(np.asarray(out).reshape(-1)[0])
+
+        for name, a_grad in zip(inputs_to_check, analytic):
+            base = np.asarray(feed[name], dtype=np.float64)
+            num = np.zeros_like(base, dtype=np.float64)
+            flat = base.reshape(-1)
+            delta = numeric_grad_delta
+            for i in range(flat.size):
+                orig = flat[i]
+                f2 = dict(feed)
+                pos = base.copy().reshape(-1)
+                pos[i] = orig + delta
+                f2[name] = pos.reshape(base.shape).astype(feed[name].dtype)
+                l_pos = eval_loss(f2)
+                neg = base.copy().reshape(-1)
+                neg[i] = orig - delta
+                f2[name] = neg.reshape(base.shape).astype(feed[name].dtype)
+                l_neg = eval_loss(f2)
+                num.reshape(-1)[i] = (l_pos - l_neg) / (2 * delta)
+            a = np.asarray(a_grad, dtype=np.float64)
+            abs_a = np.abs(a).max()
+            denom = max(abs_a, np.abs(num).max(), 1e-3)
+            max_diff = np.abs(a - num).max()
+            rel = max_diff / denom
+            assert rel <= max_relative_error or max_diff <= atol, (
+                "gradient of %s wrt %s: max diff %g rel %g (analytic %s "
+                "numeric %s)" % (self.op_type, name, max_diff, rel,
+                                 a.reshape(-1)[:5], num.reshape(-1)[:5]))
+
+    def setup(self):
+        raise NotImplementedError

@@ -1,0 +1,89 @@
+"""Activation op tests vs numpy (reference test_activation_op.py)."""
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+
+def _x(shape=(4, 6), lo=-2.0, hi=2.0, seed=0, kinks=(0.0,)):
+    rng = np.random.RandomState(seed)
+    x = rng.uniform(lo, hi, shape).astype('float32')
+    # keep away from non-differentiable kinks for finite-difference checks
+    for k in kinks:
+        near = np.abs(x - k) < 0.05
+        x[near] = k + 0.1
+    return x
+
+
+ACTS = {
+    'sigmoid': (lambda x: 1 / (1 + np.exp(-x)), {}, {}),
+    'logsigmoid': (lambda x: np.log(1 / (1 + np.exp(-x))), {}, {}),
+    'exp': (np.exp, {}, {}),
+    'relu': (lambda x: np.maximum(x, 0), {}, {}),
+    'tanh': (np.tanh, {}, {}),
+    'sqrt': (np.sqrt, {}, {'lo': 0.1, 'hi': 3.0}),
+    'abs': (np.abs, {}, {}),
+    'ceil': (np.ceil, {}, {'grad': False}),
+    'floor': (np.floor, {}, {'grad': False}),
+    'cos': (np.cos, {}, {}),
+    'sin': (np.sin, {}, {}),
+    'round': (np.round, {}, {'grad': False}),
+    'reciprocal': (lambda x: 1 / x, {}, {'lo': 0.5, 'hi': 3.0}),
+    'log': (np.log, {}, {'lo': 0.1, 'hi': 3.0}),
+    'square': (np.square, {}, {}),
+    'softplus': (lambda x: np.log(1 + np.exp(x)), {}, {}),
+    'softsign': (lambda x: x / (1 + np.abs(x)), {}, {}),
+    'tanh_shrink': (lambda x: x - np.tanh(x), {}, {}),
+    'softshrink': (lambda x: np.where(x > 0.5, x - 0.5,
+                                      np.where(x < -0.5, x + 0.5, 0.0)),
+                   {'lambda_': 0.5}, {}),
+    'brelu': (lambda x: np.clip(x, 0.2, 1.0),
+              {'t_min': 0.2, 't_max': 1.0}, {'kinks': (0.2, 1.0)}),
+    'soft_relu': (lambda x: np.log(1 + np.exp(np.clip(x, -2.0, 2.0))),
+                  {'threshold': 2.0}, {}),
+    'pow': (lambda x: np.power(x, 3.0), {'factor': 3.0}, {}),
+    'stanh': (lambda x: 1.7159 * np.tanh(0.67 * x),
+              {'scale_a': 0.67, 'scale_b': 1.7159}, {}),
+    'relu6': (lambda x: np.clip(x, 0, 6.0), {'threshold': 6.0}, {}),
+    'leaky_relu': (lambda x: np.where(x >= 0, x, 0.1 * x),
+                   {'alpha': 0.1}, {}),
+    'elu': (lambda x: np.where(x >= 0, x, 0.5 * (np.exp(x) - 1)),
+            {'alpha': 0.5}, {}),
+    'hard_shrink': (lambda x: np.where(np.abs(x) > 0.5, x, 0.0),
+                    {'threshold': 0.5}, {}),
+    'hard_sigmoid': (lambda x: np.clip(0.2 * x + 0.5, 0, 1), {}, {}),
+    'swish': (lambda x: x / (1 + np.exp(-2.0 * x)), {'beta': 2.0}, {}),
+    'thresholded_relu': (lambda x: np.where(x > 1.0, x, 0.0),
+                         {'threshold': 1.0}, {}),
+    'gelu': (lambda x: 0.5 * x * (1 + np.vectorize(__import__('math').erf)(
+        x / np.sqrt(2))), {}, {}),
+}
+
+
+class _ActTest(OpTest):
+    def __init__(self, op_type, ref, attrs, opts):
+        self.op_type = op_type
+        self._ref = ref
+        self.attrs = attrs
+        self._opts = opts
+
+    def setup(self):
+        x = _x(lo=self._opts.get('lo', -2.0), hi=self._opts.get('hi', 2.0),
+               kinks=self._opts.get('kinks', (0.0,)))
+        self.inputs = {'X': x}
+        self.outputs = {'Out': self._ref(x).astype('float32')}
+
+
+@pytest.mark.parametrize('op_type', sorted(ACTS))
+def test_activation_output(op_type):
+    ref, attrs, opts = ACTS[op_type]
+    t = _ActTest(op_type, ref, attrs, opts)
+    t.check_output(atol=1e-5)
+
+
+@pytest.mark.parametrize('op_type', sorted(
+    [k for k, v in ACTS.items() if v[2].get('grad', True)]))
+def test_activation_grad(op_type):
+    ref, attrs, opts = ACTS[op_type]
+    t = _ActTest(op_type, ref, attrs, opts)
+    t.check_grad(['X'], 'Out', max_relative_error=0.01)

@@ -1,0 +1,94 @@
+"""Reader decorators, batch, DataFeeder, datasets (reference
+python/paddle/reader/tests + dataset smoke)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import reader as preader
+from paddle_tpu import dataset
+
+
+def test_reader_decorators():
+    def r():
+        return iter(range(10))
+
+    assert list(preader.firstn(r, 3)()) == [0, 1, 2]
+    assert sorted(preader.shuffle(r, 5)()) == list(range(10))
+    assert list(preader.chain(r, r)()) == list(range(10)) * 2
+    assert list(preader.map_readers(lambda x: x * 2, r)()) == \
+        [x * 2 for x in range(10)]
+    assert list(preader.buffered(r, 2)()) == list(range(10))
+    assert list(preader.cache(r)()) == list(range(10))
+    composed = preader.compose(r, r)
+    assert list(composed())[0] == (0, 0)
+
+
+def test_batch():
+    def r():
+        return iter(range(7))
+    batches = list(preader.batch(r, 3)())
+    assert batches == [[0, 1, 2], [3, 4, 5], [6]]
+    batches = list(preader.batch(r, 3, drop_last=True)())
+    assert batches == [[0, 1, 2], [3, 4, 5]]
+
+
+def test_xmap_readers():
+    def r():
+        return iter(range(20))
+    out = sorted(preader.xmap_readers(lambda x: x + 1, r, 4, 8)())
+    assert out == [x + 1 for x in range(20)]
+
+
+def test_data_feeder():
+    img = fluid.layers.data(name='dimg', shape=[4], dtype='float32')
+    lab = fluid.layers.data(name='dlab', shape=[1], dtype='int64')
+    feeder = fluid.DataFeeder(feed_list=[img, lab])
+    feed = feeder.feed([(np.ones(4), 1), (np.zeros(4), 0)])
+    assert feed['dimg'].shape == (2, 4)
+    assert feed['dlab'].shape == (2, 1)
+    assert feed['dlab'].dtype == np.int64
+
+
+def test_datasets_smoke():
+    x, y = next(dataset.mnist.train()())
+    assert x.shape == (784,) and isinstance(y, int)
+    x, y = next(dataset.cifar.train10()())
+    assert x.shape == (3072,)
+    feats, target = next(dataset.uci_housing.train()())
+    assert feats.shape == (13,) and target.shape == (1,)
+    seq, lab = next(dataset.imdb.train()())
+    assert isinstance(seq, list) and lab in (0, 1)
+    gram = next(dataset.imikolov.train()())
+    assert len(gram) == 5
+    src, tin, tnext = next(dataset.wmt14.train()())
+    assert tin[0] == 0 and tnext[-1] == 1
+    row = next(dataset.movielens.train()())
+    assert len(row) == 8
+
+
+def test_prefetcher():
+    def batches():
+        for i in range(3):
+            yield {'x': np.full((2, 2), i, 'float32')}
+    got = list(preader.DevicePrefetcher(batches))
+    assert len(got) == 3
+    assert float(np.asarray(got[2]['x'])[0, 0]) == 2.0
+
+
+def test_inference_transpiler_bn_fold():
+    from paddle_tpu.transpiler import InferenceTranspiler
+    img = fluid.layers.data(name='timg', shape=[3, 8, 8], dtype='float32')
+    conv = fluid.layers.conv2d(img, num_filters=4, filter_size=3, padding=1,
+                               bias_attr=False)
+    bn = fluid.layers.batch_norm(conv, is_test=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    x = np.random.RandomState(0).randn(2, 3, 8, 8).astype('float32')
+
+    infer_prog = fluid.default_main_program().clone(for_test=True)
+    ref, = exe.run(infer_prog, feed={'timg': x}, fetch_list=[bn])
+
+    folded = InferenceTranspiler().transpile(infer_prog)
+    out, = exe.run(folded, feed={'timg': x}, fetch_list=[bn])
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+    types = [op.type for op in folded.global_block().ops]
+    assert 'batch_norm' not in types

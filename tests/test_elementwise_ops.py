@@ -1,0 +1,67 @@
+"""Elementwise + broadcast-axis tests (reference
+test_elementwise_add_op.py etc.)."""
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+OPS = {
+    'elementwise_add': np.add,
+    'elementwise_sub': np.subtract,
+    'elementwise_mul': np.multiply,
+    'elementwise_div': np.divide,
+    'elementwise_max': np.maximum,
+    'elementwise_min': np.minimum,
+    'elementwise_pow': np.power,
+}
+
+
+class _ElemTest(OpTest):
+    def __init__(self, op_type, x, y, axis=-1):
+        self.op_type = op_type
+        self._x, self._y, self._axis = x, y, axis
+
+    def setup(self):
+        x, y, axis = self._x, self._y, self._axis
+        yb = y
+        if y.ndim < x.ndim and axis != -1:
+            target = [1] * x.ndim
+            for i, s in enumerate(y.shape):
+                target[axis + i] = s
+            yb = y.reshape(target)
+        self.inputs = {'X': x, 'Y': y}
+        self.attrs = {'axis': axis}
+        self.outputs = {'Out': OPS[self.op_type](x, yb).astype('float32')}
+
+
+def _rand(shape, lo=0.5, hi=2.0, seed=0):
+    return np.random.RandomState(seed).uniform(lo, hi,
+                                               shape).astype('float32')
+
+
+@pytest.mark.parametrize('op_type', sorted(OPS))
+def test_same_shape(op_type):
+    t = _ElemTest(op_type, _rand((3, 4)), _rand((3, 4), seed=1))
+    t.check_output()
+    if op_type != 'elementwise_pow':
+        t.check_grad(['X', 'Y'], 'Out', max_relative_error=0.01)
+
+
+@pytest.mark.parametrize('op_type', ['elementwise_add', 'elementwise_mul'])
+def test_broadcast_axis1(op_type):
+    # x: (2, 3, 4); y: (3,) broadcast at axis=1 — the fluid fc-bias pattern
+    t = _ElemTest(op_type, _rand((2, 3, 4)), _rand((3,), seed=2), axis=1)
+    t.check_output()
+    t.check_grad(['X', 'Y'], 'Out', max_relative_error=0.01)
+
+
+def test_broadcast_trailing():
+    t = _ElemTest('elementwise_add', _rand((2, 3, 4)),
+                  _rand((4,), seed=3), axis=-1)
+    t.check_output()
+
+
+def test_scalar_broadcast():
+    t = _ElemTest('elementwise_mul', _rand((3, 4)),
+                  _rand((1,), seed=4), axis=-1)
+    t.check_output()

@@ -1,0 +1,377 @@
+"""NN op tests: softmax/losses/conv/pool/norms vs numpy references."""
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+
+def _rand(shape, seed=0, lo=-1.0, hi=1.0):
+    return np.random.RandomState(seed).uniform(lo, hi,
+                                               shape).astype('float32')
+
+
+def _np_softmax(x):
+    e = np.exp(x - x.max(axis=-1, keepdims=True))
+    return e / e.sum(axis=-1, keepdims=True)
+
+
+def test_softmax():
+    class T(OpTest):
+        op_type = 'softmax'
+
+        def setup(self):
+            x = _rand((4, 7))
+            self.inputs = {'X': x}
+            self.attrs = {}
+            self.outputs = {'Out': _np_softmax(x)}
+    t = T()
+    t.check_output()
+    t.check_grad(['X'], 'Out', max_relative_error=0.01)
+
+
+def test_cross_entropy():
+    class T(OpTest):
+        op_type = 'cross_entropy'
+
+        def setup(self):
+            p = _np_softmax(_rand((4, 5), 1))
+            lab = np.array([[0], [2], [4], [1]], dtype='int64')
+            out = -np.log(p[np.arange(4), lab.reshape(-1)]).reshape(4, 1)
+            self.inputs = {'X': p.astype('float32'), 'Label': lab}
+            self.attrs = {'soft_label': False}
+            self.outputs = {'Y': out.astype('float32')}
+    t = T()
+    t.check_output()
+    t.check_grad(['X'], 'Y', max_relative_error=0.01)
+
+
+def test_cross_entropy_soft():
+    class T(OpTest):
+        op_type = 'cross_entropy'
+
+        def setup(self):
+            p = _np_softmax(_rand((3, 4), 2))
+            lab = _np_softmax(_rand((3, 4), 3))
+            out = (-lab * np.log(p)).sum(-1, keepdims=True)
+            self.inputs = {'X': p.astype('float32'),
+                           'Label': lab.astype('float32')}
+            self.attrs = {'soft_label': True}
+            self.outputs = {'Y': out.astype('float32')}
+    T().check_output()
+
+
+def test_softmax_with_cross_entropy():
+    class T(OpTest):
+        op_type = 'softmax_with_cross_entropy'
+
+        def setup(self):
+            logits = _rand((4, 6), 4, -2, 2)
+            lab = np.array([[0], [5], [2], [2]], dtype='int64')
+            sm = _np_softmax(logits)
+            loss = -np.log(sm[np.arange(4), lab.reshape(-1)]).reshape(4, 1)
+            self.inputs = {'Logits': logits, 'Label': lab}
+            self.attrs = {}
+            self.outputs = {'Softmax': sm.astype('float32'),
+                            'Loss': loss.astype('float32')}
+    t = T()
+    t.check_output()
+    t.check_grad(['Logits'], 'Loss', max_relative_error=0.01)
+
+
+def test_sigmoid_cross_entropy_with_logits():
+    class T(OpTest):
+        op_type = 'sigmoid_cross_entropy_with_logits'
+
+        def setup(self):
+            x = _rand((4, 3), 5, -2, 2)
+            lab = np.random.RandomState(6).randint(
+                0, 2, (4, 3)).astype('float32')
+            out = np.maximum(x, 0) - x * lab + np.log1p(np.exp(-np.abs(x)))
+            self.inputs = {'X': x, 'Label': lab}
+            self.attrs = {}
+            self.outputs = {'Out': out.astype('float32')}
+    t = T()
+    t.check_output()
+    t.check_grad(['X'], 'Out', max_relative_error=0.01)
+
+
+def _np_conv2d(x, w, stride, pad, dilation=(1, 1), groups=1):
+    n, c, h, wd = x.shape
+    oc, icg, kh, kw = w.shape
+    xp = np.pad(x, [(0, 0), (0, 0), (pad[0], pad[0]), (pad[1], pad[1])])
+    dkh = (kh - 1) * dilation[0] + 1
+    dkw = (kw - 1) * dilation[1] + 1
+    oh = (h + 2 * pad[0] - dkh) // stride[0] + 1
+    ow = (wd + 2 * pad[1] - dkw) // stride[1] + 1
+    out = np.zeros((n, oc, oh, ow), dtype='float64')
+    cpg = c // groups
+    opg = oc // groups
+    for g in range(groups):
+        for o in range(opg):
+            oo = g * opg + o
+            for i in range(oh):
+                for j in range(ow):
+                    patch = xp[:, g * cpg:(g + 1) * cpg,
+                               i * stride[0]:i * stride[0] + dkh:dilation[0],
+                               j * stride[1]:j * stride[1] + dkw:dilation[1]]
+                    out[:, oo, i, j] = np.einsum('nchw,chw->n', patch,
+                                                 w[oo])
+    return out.astype('float32')
+
+
+@pytest.mark.parametrize('stride,pad,dilation,groups', [
+    ((1, 1), (0, 0), (1, 1), 1),
+    ((2, 2), (1, 1), (1, 1), 1),
+    ((1, 1), (1, 1), (2, 2), 1),
+    ((1, 1), (1, 1), (1, 1), 2),
+])
+def test_conv2d(stride, pad, dilation, groups):
+    class T(OpTest):
+        op_type = 'conv2d'
+
+        def setup(self):
+            x = _rand((2, 4, 7, 7), 7)
+            w = _rand((4, 4 // groups, 3, 3), 8)
+            self.inputs = {'Input': x, 'Filter': w}
+            self.attrs = {'strides': list(stride), 'paddings': list(pad),
+                          'dilations': list(dilation), 'groups': groups}
+            self.outputs = {'Output': _np_conv2d(x, w, stride, pad,
+                                                 dilation, groups)}
+    T().check_output(atol=1e-4)
+
+
+def test_conv2d_grad():
+    class T(OpTest):
+        op_type = 'conv2d'
+
+        def setup(self):
+            x = _rand((1, 2, 5, 5), 9)
+            w = _rand((3, 2, 3, 3), 10)
+            self.inputs = {'Input': x, 'Filter': w}
+            self.attrs = {'strides': [1, 1], 'paddings': [1, 1],
+                          'dilations': [1, 1], 'groups': 1}
+            self.outputs = {'Output': _np_conv2d(x, w, (1, 1), (1, 1))}
+    T().check_grad(['Input', 'Filter'], 'Output', max_relative_error=0.02)
+
+
+def _np_pool2d(x, ksize, stride, pad, ptype='max', exclusive=True):
+    n, c, h, w = x.shape
+    oh = (h + 2 * pad[0] - ksize[0]) // stride[0] + 1
+    ow = (w + 2 * pad[1] - ksize[1]) // stride[1] + 1
+    out = np.zeros((n, c, oh, ow), dtype='float64')
+    for i in range(oh):
+        for j in range(ow):
+            hs = i * stride[0] - pad[0]
+            ws = j * stride[1] - pad[1]
+            he = min(hs + ksize[0], h)
+            we = min(ws + ksize[1], w)
+            hs2, ws2 = max(hs, 0), max(ws, 0)
+            patch = x[:, :, hs2:he, ws2:we]
+            if ptype == 'max':
+                out[:, :, i, j] = patch.max(axis=(2, 3))
+            else:
+                s = patch.sum(axis=(2, 3))
+                if exclusive:
+                    out[:, :, i, j] = s / ((he - hs2) * (we - ws2))
+                else:
+                    out[:, :, i, j] = s / (ksize[0] * ksize[1])
+    return out.astype('float32')
+
+
+@pytest.mark.parametrize('ptype,ksize,stride,pad', [
+    ('max', (2, 2), (2, 2), (0, 0)),
+    ('avg', (2, 2), (2, 2), (0, 0)),
+    ('max', (3, 3), (1, 1), (1, 1)),
+    ('avg', (3, 3), (2, 2), (1, 1)),
+])
+def test_pool2d(ptype, ksize, stride, pad):
+    class T(OpTest):
+        op_type = 'pool2d'
+
+        def setup(self):
+            x = _rand((2, 3, 6, 6), 11)
+            self.inputs = {'X': x}
+            self.attrs = {'pooling_type': ptype, 'ksize': list(ksize),
+                          'strides': list(stride), 'paddings': list(pad),
+                          'exclusive': True, 'global_pooling': False,
+                          'ceil_mode': False}
+            self.outputs = {'Out': _np_pool2d(x, ksize, stride, pad, ptype)}
+    T().check_output(atol=1e-5)
+
+
+def test_pool2d_global():
+    class T(OpTest):
+        op_type = 'pool2d'
+
+        def setup(self):
+            x = _rand((2, 3, 5, 5), 12)
+            self.inputs = {'X': x}
+            self.attrs = {'pooling_type': 'avg', 'ksize': [1, 1],
+                          'strides': [1, 1], 'paddings': [0, 0],
+                          'global_pooling': True, 'exclusive': True,
+                          'ceil_mode': False}
+            self.outputs = {'Out': x.mean(axis=(2, 3), keepdims=True)}
+    T().check_output()
+
+
+def test_batch_norm_inference():
+    class T(OpTest):
+        op_type = 'batch_norm'
+
+        def setup(self):
+            x = _rand((2, 3, 4, 4), 13)
+            scale = _rand((3,), 14, 0.5, 1.5)
+            bias = _rand((3,), 15)
+            mean = _rand((3,), 16)
+            var = _rand((3,), 17, 0.5, 1.5)
+            eps = 1e-5
+            y = (x - mean.reshape(1, 3, 1, 1)) / np.sqrt(
+                var.reshape(1, 3, 1, 1) + eps) * scale.reshape(
+                1, 3, 1, 1) + bias.reshape(1, 3, 1, 1)
+            self.inputs = {'X': x, 'Scale': scale, 'Bias': bias,
+                           'Mean': mean, 'Variance': var}
+            self.attrs = {'is_test': True, 'epsilon': eps}
+            self.outputs = {'Y': y.astype('float32')}
+    T().check_output(no_check_set={'MeanOut', 'VarianceOut', 'SavedMean',
+                                   'SavedVariance'}, atol=1e-4)
+
+
+def test_batch_norm_training_stats():
+    class T(OpTest):
+        op_type = 'batch_norm'
+
+        def setup(self):
+            x = _rand((4, 2, 3, 3), 18)
+            scale = np.ones((2,), 'float32')
+            bias = np.zeros((2,), 'float32')
+            mean = np.zeros((2,), 'float32')
+            var = np.ones((2,), 'float32')
+            m = x.mean(axis=(0, 2, 3))
+            v = x.var(axis=(0, 2, 3))
+            y = (x - m.reshape(1, 2, 1, 1)) / np.sqrt(
+                v.reshape(1, 2, 1, 1) + 1e-5)
+            self.inputs = {'X': x, 'Scale': scale, 'Bias': bias,
+                           'Mean': mean, 'Variance': var}
+            self.attrs = {'is_test': False, 'momentum': 0.9,
+                          'epsilon': 1e-5}
+            self.outputs = {'Y': y.astype('float32'),
+                            'MeanOut': (0.9 * mean + 0.1 * m).astype(
+                                'float32'),
+                            'VarianceOut': (0.9 * var + 0.1 * v).astype(
+                                'float32')}
+    T().check_output(no_check_set={'SavedMean', 'SavedVariance'}, atol=1e-4)
+
+
+def test_layer_norm():
+    class T(OpTest):
+        op_type = 'layer_norm'
+
+        def setup(self):
+            x = _rand((3, 4, 5), 19)
+            scale = _rand((20,), 20, 0.5, 1.5)
+            bias = _rand((20,), 21)
+            flat = x.reshape(3, 20)
+            m = flat.mean(-1, keepdims=True)
+            v = flat.var(-1, keepdims=True)
+            y = ((flat - m) / np.sqrt(v + 1e-5) * scale + bias).reshape(
+                x.shape)
+            self.inputs = {'X': x, 'Scale': scale, 'Bias': bias}
+            self.attrs = {'begin_norm_axis': 1, 'epsilon': 1e-5}
+            self.outputs = {'Y': y.astype('float32')}
+    t = T()
+    t.check_output(no_check_set={'Mean', 'Variance'}, atol=1e-4)
+    t.check_grad(['X', 'Scale', 'Bias'], 'Y', max_relative_error=0.02)
+
+
+def test_dropout_is_test():
+    class T(OpTest):
+        op_type = 'dropout'
+
+        def setup(self):
+            x = _rand((4, 5), 22)
+            self.inputs = {'X': x}
+            self.attrs = {'dropout_prob': 0.3, 'is_test': True,
+                          'dropout_implementation': 'downgrade_in_infer'}
+            self.outputs = {'Out': x * 0.7}
+    T().check_output(no_check_set={'Mask'})
+
+
+def test_dropout_upscale_is_test():
+    class T(OpTest):
+        op_type = 'dropout'
+
+        def setup(self):
+            x = _rand((4, 5), 23)
+            self.inputs = {'X': x}
+            self.attrs = {'dropout_prob': 0.3, 'is_test': True,
+                          'dropout_implementation': 'upscale_in_train'}
+            self.outputs = {'Out': x}
+    T().check_output(no_check_set={'Mask'})
+
+
+def test_lrn():
+    class T(OpTest):
+        op_type = 'lrn'
+
+        def setup(self):
+            x = _rand((2, 6, 3, 3), 24, 0.1, 1.0)
+            n_, k, alpha, beta = 5, 2.0, 1e-4, 0.75
+            sq = x * x
+            acc = np.zeros_like(x)
+            half = n_ // 2
+            for c in range(6):
+                lo = max(0, c - half)
+                hi = min(6, c + n_ - half)
+                acc[:, c] = sq[:, lo:hi].sum(axis=1)
+            out = x / (k + alpha * acc) ** beta
+            self.inputs = {'X': x}
+            self.attrs = {'n': n_, 'k': k, 'alpha': alpha, 'beta': beta}
+            self.outputs = {'Out': out.astype('float32')}
+    T().check_output(no_check_set={'MidOut'}, atol=1e-4)
+
+
+def test_huber_and_logloss():
+    class H(OpTest):
+        op_type = 'huber_loss'
+
+        def setup(self):
+            x = _rand((5, 1), 25)
+            y = _rand((5, 1), 26)
+            d = 0.5
+            r = y - x
+            ar = np.abs(r)
+            out = np.where(ar <= d, 0.5 * r * r, d * (ar - 0.5 * d))
+            self.inputs = {'X': x, 'Y': y}
+            self.attrs = {'delta': d}
+            self.outputs = {'Out': out.astype('float32')}
+    H().check_output(no_check_set={'Residual'})
+
+    class L(OpTest):
+        op_type = 'log_loss'
+
+        def setup(self):
+            p = _rand((5, 1), 27, 0.1, 0.9)
+            y = np.random.RandomState(28).randint(
+                0, 2, (5, 1)).astype('float32')
+            eps = 1e-4
+            out = -y * np.log(p + eps) - (1 - y) * np.log(1 - p + eps)
+            self.inputs = {'Predicted': p, 'Labels': y}
+            self.attrs = {'epsilon': eps}
+            self.outputs = {'Loss': out.astype('float32')}
+    L().check_output()
+
+
+def test_accuracy_op():
+    class T(OpTest):
+        op_type = 'accuracy'
+
+        def setup(self):
+            idx = np.array([[0, 2], [1, 3], [2, 0]], dtype='int64')
+            lab = np.array([[2], [0], [2]], dtype='int64')
+            self.inputs = {'Out': idx.astype('float32'), 'Indices': idx,
+                           'Label': lab}
+            self.attrs = {}
+            self.outputs = {'Accuracy': np.array([2.0 / 3], 'float32'),
+                            'Correct': np.array([2], 'float32'),
+                            'Total': np.array([3], 'float32')}
+    T().check_output()

@@ -1,0 +1,196 @@
+"""Reductions, mul/matmul, sums, norms (reference test_reduce_op.py,
+test_mul_op.py, test_matmul_op.py, test_sum_op.py ...)."""
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+
+def _rand(shape, seed=0, lo=-1.0, hi=1.0):
+    return np.random.RandomState(seed).uniform(lo, hi,
+                                               shape).astype('float32')
+
+
+class _ReduceTest(OpTest):
+    def __init__(self, op_type, np_fn, dim, keep_dim=False,
+                 reduce_all=False):
+        self.op_type = op_type
+        self._fn, self._dim, self._keep, self._all = (np_fn, dim, keep_dim,
+                                                      reduce_all)
+
+    def setup(self):
+        x = _rand((3, 4, 5), lo=0.5, hi=1.5)
+        self.inputs = {'X': x}
+        self.attrs = {'dim': self._dim, 'keep_dim': self._keep,
+                      'reduce_all': self._all}
+        if self._all:
+            out = self._fn(x)
+            out = np.asarray(out, dtype='float32')
+        else:
+            out = self._fn(x, axis=tuple(self._dim),
+                           keepdims=self._keep).astype('float32')
+        self.outputs = {'Out': out}
+
+
+@pytest.mark.parametrize('op_type,np_fn', [
+    ('reduce_sum', np.sum), ('reduce_mean', np.mean),
+    ('reduce_max', np.max), ('reduce_min', np.min),
+    ('reduce_prod', np.prod)])
+def test_reduce_output(op_type, np_fn):
+    _ReduceTest(op_type, np_fn, [1]).check_output(atol=1e-4)
+    _ReduceTest(op_type, np_fn, [0, 2], keep_dim=True).check_output(
+        atol=1e-4)
+    _ReduceTest(op_type, np_fn, [0], reduce_all=True).check_output(atol=1e-4)
+
+
+def test_reduce_grads():
+    _ReduceTest('reduce_sum', np.sum, [1]).check_grad(['X'], 'Out')
+    _ReduceTest('reduce_mean', np.mean, [1]).check_grad(['X'], 'Out')
+
+
+class _MulTest(OpTest):
+    def __init__(self, xnc=1, ync=1, xs=(4, 5), ys=(5, 3)):
+        self.op_type = 'mul'
+        self._args = (xnc, ync, xs, ys)
+
+    def setup(self):
+        xnc, ync, xs, ys = self._args
+        x = _rand(xs, seed=1)
+        y = _rand(ys, seed=2)
+        x2 = x.reshape(int(np.prod(xs[:xnc])), -1)
+        y2 = y.reshape(int(np.prod(ys[:ync])), -1)
+        out = (x2 @ y2).reshape(xs[:xnc] + ys[ync:])
+        self.inputs = {'X': x, 'Y': y}
+        self.attrs = {'x_num_col_dims': xnc, 'y_num_col_dims': ync}
+        self.outputs = {'Out': out.astype('float32')}
+
+
+def test_mul():
+    t = _MulTest()
+    t.check_output(atol=1e-4)
+    t.check_grad(['X', 'Y'], 'Out', max_relative_error=0.01)
+
+
+def test_mul_high_rank():
+    t = _MulTest(xnc=2, ync=1, xs=(2, 3, 4), ys=(4, 5))
+    t.check_output(atol=1e-4)
+    t.check_grad(['X', 'Y'], 'Out', max_relative_error=0.01)
+
+
+class _MatmulTest(OpTest):
+    def __init__(self, xs, ys, tx=False, ty=False):
+        self.op_type = 'matmul'
+        self._args = (xs, ys, tx, ty)
+
+    def setup(self):
+        xs, ys, tx, ty = self._args
+        x = _rand(xs, seed=3)
+        y = _rand(ys, seed=4)
+        xm = np.swapaxes(x, -1, -2) if tx else x
+        ym = np.swapaxes(y, -1, -2) if ty else y
+        self.inputs = {'X': x, 'Y': y}
+        self.attrs = {'transpose_X': tx, 'transpose_Y': ty}
+        self.outputs = {'Out': np.matmul(xm, ym).astype('float32')}
+
+
+@pytest.mark.parametrize('xs,ys,tx,ty', [
+    ((4, 5), (5, 3), False, False),
+    ((5, 4), (5, 3), True, False),
+    ((4, 5), (3, 5), False, True),
+    ((2, 4, 5), (2, 5, 3), False, False),
+])
+def test_matmul(xs, ys, tx, ty):
+    t = _MatmulTest(xs, ys, tx, ty)
+    t.check_output(atol=1e-4)
+    t.check_grad(['X', 'Y'], 'Out', max_relative_error=0.01)
+
+
+class _SumTest(OpTest):
+    op_type = 'sum'
+
+    def setup(self):
+        xs = [_rand((3, 4), seed=i) for i in range(3)]
+        self.inputs = {'X': [('x%d' % i, x) for i, x in enumerate(xs)]}
+        self.attrs = {}
+        self.outputs = {'Out': (xs[0] + xs[1] + xs[2]).astype('float32')}
+
+
+def test_sum():
+    t = _SumTest()
+    t.check_output()
+    t.check_grad(['x0', 'x1'], 'Out')
+
+
+class _MeanTest(OpTest):
+    op_type = 'mean'
+
+    def setup(self):
+        x = _rand((5, 7), seed=5)
+        self.inputs = {'X': x}
+        self.attrs = {}
+        self.outputs = {'Out': np.asarray([np.mean(x)], dtype='float32')}
+
+
+def test_mean():
+    t = _MeanTest()
+    t.check_output()
+    t.check_grad(['X'], 'Out')
+
+
+class _ScaleTest(OpTest):
+    op_type = 'scale'
+
+    def setup(self):
+        x = _rand((3, 4), seed=6)
+        self.inputs = {'X': x}
+        self.attrs = {'scale': 2.5, 'bias': 0.7, 'bias_after_scale': True}
+        self.outputs = {'Out': (x * 2.5 + 0.7).astype('float32')}
+
+
+def test_scale():
+    t = _ScaleTest()
+    t.check_output()
+    t.check_grad(['X'], 'Out')
+
+
+class _ClipTest(OpTest):
+    op_type = 'clip'
+
+    def setup(self):
+        x = _rand((4, 4), seed=7, lo=-2, hi=2)
+        x[np.abs(np.abs(x) - 1.0) < 0.05] = 0.5
+        self.inputs = {'X': x}
+        self.attrs = {'min': -1.0, 'max': 1.0}
+        self.outputs = {'Out': np.clip(x, -1, 1)}
+
+
+def test_clip():
+    t = _ClipTest()
+    t.check_output()
+    t.check_grad(['X'], 'Out')
+
+
+def test_squared_l2_norm():
+    class T(OpTest):
+        op_type = 'squared_l2_norm'
+
+        def setup(self):
+            x = _rand((4, 3), seed=8)
+            self.inputs = {'X': x}
+            self.attrs = {}
+            self.outputs = {'Out': np.asarray([np.sum(x * x)], 'float32')}
+    T().check_output(atol=1e-4)
+
+
+def test_cumsum():
+    class T(OpTest):
+        op_type = 'cumsum'
+
+        def setup(self):
+            x = _rand((3, 5), seed=9)
+            self.inputs = {'X': x}
+            self.attrs = {'axis': 1}
+            self.outputs = {'Out': np.cumsum(x, axis=1).astype('float32')}
+    t = T()
+    t.check_output(atol=1e-4)
+    t.check_grad(['X'], 'Out')

@@ -1,0 +1,331 @@
+"""Tensor-manipulation op tests (reshape/transpose/concat/split/gather/
+scatter/one_hot/lookup_table/top_k/slice/pad/expand/stack...)."""
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+
+def _rand(shape, seed=0):
+    return np.random.RandomState(seed).uniform(-1, 1, shape).astype(
+        'float32')
+
+
+def test_reshape2():
+    class T(OpTest):
+        op_type = 'reshape2'
+
+        def setup(self):
+            x = _rand((2, 3, 4))
+            self.inputs = {'X': x}
+            self.attrs = {'shape': [2, -1]}
+            self.outputs = {'Out': x.reshape(2, 12)}
+    t = T()
+    t.check_output(no_check_set={'XShape'})
+    t.check_grad(['X'], 'Out')
+
+
+def test_reshape_zero_dim():
+    class T(OpTest):
+        op_type = 'reshape'
+
+        def setup(self):
+            x = _rand((2, 3, 4))
+            self.inputs = {'X': x}
+            self.attrs = {'shape': [0, 12]}   # 0 = copy dim 0
+            self.outputs = {'Out': x.reshape(2, 12)}
+    T().check_output()
+
+
+def test_transpose2():
+    class T(OpTest):
+        op_type = 'transpose2'
+
+        def setup(self):
+            x = _rand((2, 3, 4))
+            self.inputs = {'X': x}
+            self.attrs = {'axis': [1, 0, 2]}
+            self.outputs = {'Out': x.transpose(1, 0, 2)}
+    t = T()
+    t.check_output(no_check_set={'XShape'})
+    t.check_grad(['X'], 'Out')
+
+
+def test_concat():
+    class T(OpTest):
+        op_type = 'concat'
+
+        def setup(self):
+            a, b = _rand((2, 3), 1), _rand((2, 5), 2)
+            self.inputs = {'X': [('a', a), ('b', b)]}
+            self.attrs = {'axis': 1}
+            self.outputs = {'Out': np.concatenate([a, b], axis=1)}
+    t = T()
+    t.check_output()
+    t.check_grad(['a', 'b'], 'Out')
+
+
+def test_split():
+    class T(OpTest):
+        op_type = 'split'
+
+        def setup(self):
+            x = _rand((4, 6))
+            self.inputs = {'X': x}
+            self.attrs = {'axis': 1, 'sections': [2, 4], 'num': 0}
+            self.outputs = {'Out': [('o0', x[:, :2]), ('o1', x[:, 2:])]}
+    T().check_output()
+
+
+def test_squeeze_unsqueeze():
+    class S(OpTest):
+        op_type = 'squeeze2'
+
+        def setup(self):
+            x = _rand((3, 1, 4, 1))
+            self.inputs = {'X': x}
+            self.attrs = {'axes': [1, 3]}
+            self.outputs = {'Out': x.reshape(3, 4)}
+    S().check_output(no_check_set={'XShape'})
+
+    class U(OpTest):
+        op_type = 'unsqueeze2'
+
+        def setup(self):
+            x = _rand((3, 4))
+            self.inputs = {'X': x}
+            self.attrs = {'axes': [0, 2]}
+            self.outputs = {'Out': x.reshape(1, 3, 1, 4)}
+    U().check_output(no_check_set={'XShape'})
+
+
+def test_flatten():
+    class T(OpTest):
+        op_type = 'flatten2'
+
+        def setup(self):
+            x = _rand((2, 3, 4))
+            self.inputs = {'X': x}
+            self.attrs = {'axis': 2}
+            self.outputs = {'Out': x.reshape(6, 4)}
+    T().check_output(no_check_set={'XShape'})
+
+
+def test_stack_unstack():
+    class T(OpTest):
+        op_type = 'stack'
+
+        def setup(self):
+            xs = [_rand((3, 4), i) for i in range(3)]
+            self.inputs = {'X': [('s%d' % i, x) for i, x in enumerate(xs)]}
+            self.attrs = {'axis': 1}
+            self.outputs = {'Y': np.stack(xs, axis=1)}
+    t = T()
+    t.check_output()
+    t.check_grad(['s0', 's2'], 'Y')
+
+
+def test_expand():
+    class T(OpTest):
+        op_type = 'expand'
+
+        def setup(self):
+            x = _rand((2, 3))
+            self.inputs = {'X': x}
+            self.attrs = {'expand_times': [2, 3]}
+            self.outputs = {'Out': np.tile(x, (2, 3))}
+    t = T()
+    t.check_output()
+    t.check_grad(['X'], 'Out')
+
+
+def test_pad():
+    class T(OpTest):
+        op_type = 'pad'
+
+        def setup(self):
+            x = _rand((2, 3))
+            self.inputs = {'X': x}
+            self.attrs = {'paddings': [1, 2, 0, 1], 'pad_value': 0.5}
+            self.outputs = {'Out': np.pad(
+                x, [(1, 2), (0, 1)], constant_values=0.5)}
+    t = T()
+    t.check_output()
+    t.check_grad(['X'], 'Out')
+
+
+def test_slice():
+    class T(OpTest):
+        op_type = 'slice'
+
+        def setup(self):
+            x = _rand((4, 5, 6))
+            self.inputs = {'Input': x}
+            self.attrs = {'axes': [0, 2], 'starts': [1, -3], 'ends': [3, 6]}
+            self.outputs = {'Out': x[1:3, :, -3:]}
+    t = T()
+    t.check_output()
+    t.check_grad(['Input'], 'Out')
+
+
+def test_gather():
+    class T(OpTest):
+        op_type = 'gather'
+
+        def setup(self):
+            x = _rand((5, 3))
+            idx = np.array([0, 2, 4], dtype='int64')
+            self.inputs = {'X': x, 'Index': idx}
+            self.attrs = {}
+            self.outputs = {'Out': x[idx]}
+    t = T()
+    t.check_output()
+    t.check_grad(['X'], 'Out')
+
+
+def test_scatter():
+    class T(OpTest):
+        op_type = 'scatter'
+
+        def setup(self):
+            x = _rand((5, 3))
+            ids = np.array([1, 3], dtype='int64')
+            upd = _rand((2, 3), 9)
+            out = x.copy()
+            out[ids] = upd
+            self.inputs = {'X': x, 'Ids': ids, 'Updates': upd}
+            self.attrs = {'overwrite': True}
+            self.outputs = {'Out': out}
+    T().check_output()
+
+
+def test_lookup_table():
+    class T(OpTest):
+        op_type = 'lookup_table'
+
+        def setup(self):
+            w = _rand((10, 4))
+            ids = np.array([[1], [3], [7]], dtype='int64')
+            self.inputs = {'W': w, 'Ids': ids}
+            self.attrs = {'padding_idx': -1}
+            self.outputs = {'Out': w[ids.reshape(-1)]}
+    t = T()
+    t.check_output()
+    t.check_grad(['W'], 'Out')
+
+
+def test_lookup_table_padding_idx():
+    class T(OpTest):
+        op_type = 'lookup_table'
+
+        def setup(self):
+            w = _rand((10, 4))
+            ids = np.array([[1], [2], [7]], dtype='int64')
+            out = w[ids.reshape(-1)].copy()
+            out[1] = 0.0
+            self.inputs = {'W': w, 'Ids': ids}
+            self.attrs = {'padding_idx': 2}
+            self.outputs = {'Out': out}
+    T().check_output()
+
+
+def test_one_hot():
+    class T(OpTest):
+        op_type = 'one_hot'
+
+        def setup(self):
+            ids = np.array([[1], [0], [3]], dtype='int64')
+            out = np.zeros((3, 4), dtype='float32')
+            out[np.arange(3), ids.reshape(-1)] = 1.0
+            self.inputs = {'X': ids}
+            self.attrs = {'depth': 4}
+            self.outputs = {'Out': out}
+    T().check_output()
+
+
+def test_top_k():
+    class T(OpTest):
+        op_type = 'top_k'
+
+        def setup(self):
+            x = np.array([[1.0, 5.0, 3.0], [4.0, 2.0, 6.0]], dtype='float32')
+            self.inputs = {'X': x}
+            self.attrs = {'k': 2}
+            self.outputs = {
+                'Out': np.array([[5.0, 3.0], [6.0, 4.0]], 'float32'),
+                'Indices': np.array([[1, 2], [2, 0]], 'float32')}
+    T().check_output()
+
+
+def test_arg_max_argsort():
+    class A(OpTest):
+        op_type = 'arg_max'
+
+        def setup(self):
+            x = _rand((3, 5))
+            self.inputs = {'X': x}
+            self.attrs = {'axis': 1}
+            self.outputs = {'Out': np.argmax(x, 1).astype('float32')}
+    A().check_output()
+
+    class S(OpTest):
+        op_type = 'argsort'
+
+        def setup(self):
+            x = _rand((3, 5))
+            self.inputs = {'X': x}
+            self.attrs = {'axis': -1}
+            self.outputs = {'Out': np.sort(x, -1),
+                            'Indices': np.argsort(x, -1).astype('float32')}
+    S().check_output()
+
+
+def test_cast():
+    class T(OpTest):
+        op_type = 'cast'
+
+        def setup(self):
+            x = _rand((3, 4))
+            self.inputs = {'X': x}
+            self.attrs = {'out_dtype': 'int32'}
+            self.outputs = {'Out': x.astype('int32').astype('float32')}
+    T().check_output()
+
+
+def test_where_and_sign():
+    class W(OpTest):
+        op_type = 'where'
+
+        def setup(self):
+            c = np.array([[True, False], [False, True]])
+            x = _rand((2, 2), 1)
+            y = _rand((2, 2), 2)
+            self.inputs = {'Condition': c, 'X': x, 'Y': y}
+            self.attrs = {}
+            self.outputs = {'Out': np.where(c, x, y)}
+    W().check_output()
+
+    class S(OpTest):
+        op_type = 'sign'
+
+        def setup(self):
+            x = _rand((3, 3), 3)
+            self.inputs = {'X': x}
+            self.attrs = {}
+            self.outputs = {'Out': np.sign(x)}
+    S().check_output()
+
+
+def test_multiplex():
+    class T(OpTest):
+        op_type = 'multiplex'
+
+        def setup(self):
+            xs = [_rand((4, 3), i) for i in range(3)]
+            ids = np.array([[0], [2], [1], [0]], dtype='int32')
+            out = np.stack([xs[ids[i, 0]][i] for i in range(4)])
+            self.inputs = {'X': [('m%d' % i, x) for i, x in enumerate(xs)],
+                           'Ids': ids}
+            self.attrs = {}
+            self.outputs = {'Out': out}
+    T().check_output()
