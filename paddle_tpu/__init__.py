@@ -8,7 +8,7 @@ reference at /root/reference) designed TPU-first:
 - autodiff by JAX reverse-mode AD behind the reference append_backward API;
 - data/model parallelism via jax.sharding Mesh + SPMD partitioner (parallel/)
   instead of NCCL op-handles and transpilers;
-- ragged sequences via segment ids (ragged in stage 6) instead of LoD;
+- ragged sequences via static LoD + segment ops (core/lod.py, ops/sequence_ops.py);
 - host-side input pipeline (reader/) instead of reader ops.
 """
 import os
@@ -46,6 +46,9 @@ from .io import (save_vars, save_params, save_persistables, load_vars,
                  load_inference_model)
 from . import nets
 from . import metrics
+from . import lod_tensor
+from .lod_tensor import (LoDTensor, create_lod_tensor,
+                         create_random_int_lodtensor)
 from . import reader
 from . import dataset
 from . import models

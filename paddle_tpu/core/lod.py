@@ -1,0 +1,109 @@
+"""Static-LoD machinery: ragged ("level-of-detail") sequence metadata.
+
+The reference attaches `LoD` (a list of levels, each a monotone offset vector)
+to tensors at *runtime* (framework/lod_tensor.h:58,110) and ~20 sequence ops
+consume it dynamically. Under XLA every shape must be static at trace time, so
+the TPU-native design treats LoD as **static compile-time metadata**:
+
+- tensor *values* are traced jax arrays (dynamic),
+- the LoD offsets are concrete Python tuples bound at program-compile time
+  (part of the executor's program-cache key, like feed shapes already are).
+
+This gives exact reference semantics (every sequence op knows its real ragged
+row layout, no padding), at the cost of a re-compile when the ragged *pattern*
+changes. Readers mitigate this with bucketing/padding policies — the standard
+TPU recipe. Index maps between ragged layouts are computed with numpy at trace
+time and become constant gather/scatter indices inside the XLA program, which
+is both exact and fast (no dynamic shapes, MXU-friendly downstream).
+"""
+import numpy as np
+
+__all__ = [
+    'normalize_lod', 'lod_from_lengths', 'lengths_from_offsets',
+    'segment_ids', 'check_lod', 'LoD',
+]
+
+
+def normalize_lod(lod):
+    """Canonicalize a user LoD into a tuple of tuples of int offsets.
+
+    Accepts either offset-based levels ([[0, 2, 5]]) or, when a level does not
+    start with 0, length-based levels ([[2, 3]]) like the reference's
+    `recursive_sequence_lengths` API — converted to offsets."""
+    if lod is None:
+        return ()
+    out = []
+    for level in lod:
+        level = [int(x) for x in level]
+        if not level:
+            continue
+        if level[0] != 0:
+            level = _offsets_from_lengths(level)
+        out.append(tuple(level))
+    return tuple(out)
+
+
+def _offsets_from_lengths(lengths):
+    off = [0]
+    for n in lengths:
+        off.append(off[-1] + int(n))
+    return off
+
+
+def lod_from_lengths(lengths_levels):
+    return tuple(tuple(_offsets_from_lengths(l)) for l in lengths_levels)
+
+
+def lengths_from_offsets(offsets):
+    return tuple(int(offsets[i + 1] - offsets[i])
+                 for i in range(len(offsets) - 1))
+
+
+def segment_ids(offsets, total=None):
+    """Row -> sequence-index map for one offset level, as a numpy int32 array.
+
+    Static (numpy) on purpose: downstream jax.ops.segment_sum gets concrete
+    ids + num_segments, so XLA sees a fully static scatter."""
+    offsets = list(offsets)
+    if total is None:
+        total = offsets[-1]
+    ids = np.zeros(int(total), dtype=np.int32)
+    for i in range(len(offsets) - 1):
+        ids[offsets[i]:offsets[i + 1]] = i
+    return ids
+
+
+def check_lod(lod, first_dim=None):
+    """Validate monotone offsets and (optionally) that the last level covers
+    the tensor's leading dim (reference lod_tensor.cc CheckLoD)."""
+    lod = normalize_lod(lod)
+    for level in lod:
+        if level[0] != 0:
+            raise ValueError("LoD level must start at 0: %s" % (level,))
+        for a, b in zip(level, level[1:]):
+            if b < a:
+                raise ValueError("LoD offsets must be monotone: %s" % (level,))
+    for upper, lower in zip(lod, lod[1:]):
+        if upper[-1] != len(lower) - 1:
+            raise ValueError(
+                "LoD level %s does not index into next level %s"
+                % (upper, lower))
+    if first_dim is not None and lod and lod[-1][-1] != first_dim:
+        raise ValueError(
+            "last LoD level ends at %d but tensor's first dim is %d"
+            % (lod[-1][-1], first_dim))
+    return lod
+
+
+class LoD(tuple):
+    """Immutable normalized LoD (tuple of offset tuples)."""
+
+    def __new__(cls, lod=()):
+        return super(LoD, cls).__new__(cls, normalize_lod(lod))
+
+    @property
+    def last_level(self):
+        return self[-1]
+
+    def lengths(self):
+        return [list(lengths_from_offsets(l)) for l in self]

@@ -28,7 +28,8 @@ from .registry import get_op
 class LowerContext(object):
     """Mutable environment while tracing one block: var name -> jax value."""
 
-    def __init__(self, program, block, env, base_key, wrt=(), params=None):
+    def __init__(self, program, block, env, base_key, wrt=(), params=None,
+                 lods=None, statics=None):
         self.program = program
         self.block = block
         self.env = env
@@ -37,6 +38,15 @@ class LowerContext(object):
         self.wrt = set(wrt)
         # extra knobs lowerings may consult
         self.params = params or {}
+        # static LoD metadata: var name -> tuple of offset tuples. Shared
+        # (same dict object) across child contexts — lods are compile-time
+        # constants, so mutation at trace time is idempotent per cache entry.
+        self.lods = lods if lods is not None else {}
+        # names whose lod was set (or cleared) explicitly by an op lowering —
+        # exempt from default ShareLoD propagation
+        self.lod_explicit = set()
+        # compile-time-constant feed values (numpy) for shape-bearing inputs
+        self.statics = statics if statics is not None else {}
 
     # ---- reading inputs --------------------------------------------------
     def has(self, name):
@@ -76,6 +86,52 @@ class LowerContext(object):
     def var(self, name):
         return self.block._find_var_recursive(name)
 
+    # ---- static LoD / static values --------------------------------------
+    def lod_of(self, name):
+        """The (static) LoD of a variable, or () if it is dense."""
+        return self.lods.get(name, ())
+
+    def set_lod(self, name, lod):
+        from .lod import normalize_lod
+        lod = normalize_lod(lod)
+        self.lod_explicit.add(name)
+        if lod:
+            self.lods[name] = lod
+        else:
+            self.lods.pop(name, None)
+
+    def in1_lod(self, op, slot):
+        names = op.input(slot)
+        return self.lods.get(names[0], ()) if names else ()
+
+    def set_static(self, name, value):
+        """Record a trace-time-constant value for a produced output (e.g.
+        sequence_pad's Length, a pure function of the static LoD), so
+        static_inputs consumers downstream can bind it."""
+        self.statics[name] = np.asarray(value)
+
+    def static_value(self, name):
+        """Concrete numpy value of a shape-bearing input. Available for feeds
+        declared via the op's `static_inputs`, or when the producing op
+        recorded it via set_static."""
+        if name in self.statics:
+            return self.statics[name]
+        if name in self.env:
+            v = self.env[name]
+            if not isinstance(v, jax.core.Tracer):
+                return np.asarray(v)
+        raise ValueError(
+            "op #%d (%s) needs the concrete value of %r at trace time "
+            "(its output layout depends on it, like dynamic shapes under "
+            "XLA). Feed it so the executor can bind it statically."
+            % (self.op_index, self.block.ops[self.op_index].type, name))
+
+    def in1_static(self, op, slot, default=None):
+        names = op.input(slot)
+        if not names:
+            return default
+        return self.static_value(names[0])
+
     # ---- rng -------------------------------------------------------------
     def rng(self):
         key = jax.random.fold_in(self.base_key, self.op_index)
@@ -84,10 +140,13 @@ class LowerContext(object):
             key = jax.random.fold_in(key, seed)
         return key
 
-    def child(self, env, wrt=None):
-        c = LowerContext(self.program, self.block, env, self.base_key,
+    def child(self, env, wrt=None, block=None):
+        c = LowerContext(self.program,
+                         self.block if block is None else block,
+                         env, self.base_key,
                          wrt=self.wrt if wrt is None else wrt,
-                         params=self.params)
+                         params=self.params, lods=self.lods,
+                         statics=self.statics)
         return c
 
 
@@ -96,6 +155,32 @@ def lower_ops(ctx, ops, lo, hi):
         ctx.op_index = i
         op = ops[i]
         get_op(op.type).lower(ctx, op)
+        _share_lod(ctx, op)
+
+
+def _share_lod(ctx, op):
+    """Default LoD propagation (reference InferShapeContext::ShareLoD: most
+    elementwise-ish ops share their first input's LoD with outputs). An op
+    that set (or cleared) an output's lod explicitly wins; otherwise any
+    output whose leading dim matches a lod-carrying input's leading dim
+    inherits that input's lod."""
+    in_lod = None
+    lead = None
+    for n in op.input_arg_names:
+        lod = ctx.lods.get(n)
+        if lod and ctx.has(n):
+            v = ctx.env[n]
+            if getattr(v, 'ndim', 0) >= 1:
+                in_lod, lead = lod, v.shape[0]
+                break
+    if in_lod is None:
+        return
+    for n in op.output_arg_names:
+        if n in ctx.lods or n in ctx.lod_explicit or not ctx.has(n):
+            continue
+        v = ctx.env[n]
+        if getattr(v, 'ndim', 0) >= 1 and v.shape[0] == lead:
+            ctx.lods[n] = in_lod
 
 
 def lower_block(ctx, lo=0):
@@ -190,9 +275,16 @@ def analyze_state(program, fetch_names=()):
     return read, written
 
 
-def build_fn(program, fetch_names, read_names, written_names):
+def build_fn(program, fetch_names, read_names, written_names,
+             static_lods=None, static_feed=None, lod_out=None):
     """Build the raw (unjitted) whole-program function
-    fn(feed, ro_state, rw_state, key) -> (fetches, new_state)."""
+    fn(feed, ro_state, rw_state, key) -> (fetches, new_state).
+
+    static_lods: var name -> LoD offsets bound at compile time (feeds & state).
+    static_feed: shape-bearing feed values bound as trace-time constants.
+    lod_out: optional dict the trace fills with every var's produced LoD —
+    read by the executor after first compile to attach LoD to fetches."""
+
     written_set = set(written_names)
     rw_names = [n for n in read_names if n in written_set]
     ro_names = [n for n in read_names if n not in written_set]
@@ -202,9 +294,14 @@ def build_fn(program, fetch_names, read_names, written_names):
         env.update(feed)
         env.update(ro_state)
         env.update(rw_state)
-        ctx = LowerContext(program, program.global_block(), env, key)
+        ctx = LowerContext(program, program.global_block(), env, key,
+                           lods=dict(static_lods or {}),
+                           statics=dict(static_feed or {}))
         lower_block(ctx)
         env = ctx.env
+        if lod_out is not None:
+            lod_out.clear()
+            lod_out.update(ctx.lods)
         fetches = [env[n] for n in fetch_names]
         new_state = {n: env[n] for n in written_names if n in env}
         return fetches, new_state
@@ -212,7 +309,8 @@ def build_fn(program, fetch_names, read_names, written_names):
     return fn, ro_names, rw_names
 
 
-def build_callable(program, fetch_names, read_names, written_names):
+def build_callable(program, fetch_names, read_names, written_names,
+                   static_lods=None, static_feed=None, lod_out=None):
     """Single-device compile of build_fn.
 
     rw_state (read-and-written persistables, e.g. params being optimized) is
@@ -220,6 +318,8 @@ def build_callable(program, fetch_names, read_names, written_names):
     equivalent of the reference's in-place optimizer kernels + memory passes
     (details/inplace_op_pass.cc), for free via buffer donation."""
     fn, ro_names, rw_names = build_fn(program, fetch_names, read_names,
-                                      written_names)
+                                      written_names, static_lods=static_lods,
+                                      static_feed=static_feed,
+                                      lod_out=lod_out)
     jitted = jax.jit(fn, donate_argnums=(2,))
     return jitted, ro_names, rw_names

@@ -14,15 +14,21 @@ a custom rule use jax.custom_vjp inside their lowering.
 
 
 class OpDef(object):
-    __slots__ = ('type', 'lower', 'infer_shape', 'stateful', 'needs_rng')
+    __slots__ = ('type', 'lower', 'infer_shape', 'stateful', 'needs_rng',
+                 'static_inputs')
 
     def __init__(self, type, lower, infer_shape=None, stateful=False,
-                 needs_rng=False):
+                 needs_rng=False, static_inputs=()):
         self.type = type
         self.lower = lower
         self.infer_shape = infer_shape
         self.stateful = stateful
         self.needs_rng = needs_rng
+        # input slots whose concrete *values* determine output shapes/layout
+        # (e.g. sequence_unpad's Length). The executor binds these feeds as
+        # compile-time constants (part of the program-cache key), the way XLA
+        # requires shape-bearing values to be static.
+        self.static_inputs = tuple(static_inputs)
 
 
 class OpRegistry(object):
@@ -51,11 +57,13 @@ class OpRegistry(object):
 _registry = OpRegistry()
 
 
-def register_op(type, infer_shape=None, stateful=False, needs_rng=False):
+def register_op(type, infer_shape=None, stateful=False, needs_rng=False,
+                static_inputs=()):
     """Decorator: register `fn(ctx, op)` as the lowering for op `type`."""
     def deco(fn):
         _registry.register(type, fn, infer_shape=infer_shape,
-                           stateful=stateful, needs_rng=needs_rng)
+                           stateful=stateful, needs_rng=needs_rng,
+                           static_inputs=static_inputs)
         return fn
     return deco
 

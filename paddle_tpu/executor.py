@@ -21,6 +21,8 @@ import jax.numpy as jnp
 from .framework import (Program, Variable, default_main_program, CPUPlace,
                         TPUPlace)
 from .core import lowering
+from .core.lod import normalize_lod
+from .core.registry import get_op, has_op
 from .core.types import convert_np_dtype_to_dtype_
 
 __all__ = ['Executor', 'Scope', 'global_scope', 'scope_guard']
@@ -146,16 +148,36 @@ class _CompiledEntry(object):
     # holds a strong ref to the program so id(program) cache keys can never
     # alias a garbage-collected program's address
     __slots__ = ('fn', 'fetch_names', 'ro_names', 'rw_names', 'written',
-                 'program')
+                 'program', 'lod_out')
 
     def __init__(self, fn, fetch_names, ro_names, rw_names, written,
-                 program):
+                 program, lod_out=None):
         self.fn = fn
         self.fetch_names = fetch_names
         self.ro_names = ro_names
         self.rw_names = rw_names
         self.written = written
         self.program = program
+        self.lod_out = lod_out if lod_out is not None else {}
+
+
+class FetchedTensor(np.ndarray):
+    """Numpy array + LoD — what fetch returns for ragged results (the
+    LoDTensor view the reference's as_numpy path loses, executor.py:72)."""
+
+    def lod(self):
+        return [list(l) for l in getattr(self, '_lod', ())]
+
+    def recursive_sequence_lengths(self):
+        from .core.lod import lengths_from_offsets
+        return [list(lengths_from_offsets(l))
+                for l in getattr(self, '_lod', ())]
+
+
+def _fetched(arr, lod):
+    out = np.asarray(arr).view(FetchedTensor)
+    out._lod = normalize_lod(lod)
+    return out
 
 
 class Executor(object):
@@ -168,14 +190,35 @@ class Executor(object):
         self._cache.clear()
 
     # ------------------------------------------------------------------
-    def _feed_signature(self, feed):
-        return tuple(sorted((k, tuple(np.shape(v)), str(np.asarray(v).dtype))
-                            for k, v in feed.items()))
+    def _feed_signature(self, feed, feed_lods=(), static_feed=()):
+        feed_lods = dict(feed_lods) if feed_lods else {}
+        static_feed = dict(static_feed) if static_feed else {}
+        sig = tuple(sorted((k, tuple(np.shape(v)), str(np.asarray(v).dtype))
+                           for k, v in feed.items()))
+        lod_sig = tuple(sorted(feed_lods.items()))
+        static_sig = tuple(sorted(
+            (k, v.tobytes()) for k, v in static_feed.items()))
+        return sig, lod_sig, static_sig
+
+    @staticmethod
+    def _split_lod_feed(value):
+        """A feed value may be array-like, (array, lod) like the reference's
+        OpTest/DataFeeder convention, or a LoDTensor from create_lod_tensor."""
+        if isinstance(value, tuple) and len(value) == 2 and \
+                isinstance(value[1], (list, tuple)):
+            return value[0], normalize_lod(value[1])
+        lod_m = getattr(value, 'lod', None)
+        if callable(lod_m) and not isinstance(value, np.ndarray):
+            return np.asarray(value), normalize_lod(lod_m())
+        if isinstance(value, FetchedTensor):
+            return np.asarray(value), normalize_lod(value.lod())
+        return value, ()
 
     def _prepare_feed(self, program, feed):
-        out = {}
+        out, lods = {}, {}
         gb = program.global_block()
         for name, value in feed.items():
+            value, lod = self._split_lod_feed(value)
             var = gb._find_var_recursive(name)
             arr = np.asarray(value)
             if var is not None and var.dtype is not None and \
@@ -188,7 +231,33 @@ class Executor(object):
                 elif arr.dtype == np.float64:
                     arr = arr.astype(var.dtype)
             out[name] = arr
-        return out
+            if lod:
+                if lod[-1][-1] != arr.shape[0]:
+                    raise ValueError(
+                        "feed %r: LoD %s does not cover the array's leading "
+                        "dim %d — offsets' last entry must equal it (pass "
+                        "lengths via create_lod_tensor / "
+                        "recursive_sequence_lengths)"
+                        % (name, [list(l) for l in lod], arr.shape[0]))
+                lods[name] = lod
+        return out, lods
+
+    @staticmethod
+    def _static_feed_names(program):
+        """Feed names consumed through a `static_inputs` slot of any op —
+        their values are compile-time constants (shape-bearing)."""
+        cached = getattr(program, '_static_names_cache', None)
+        if cached is not None and cached[0] == program._version:
+            return cached[1]
+        names = set()
+        for block in program.blocks:
+            for op in block.ops:
+                if not has_op(op.type):
+                    continue
+                for slot in get_op(op.type).static_inputs:
+                    names.update(op.input(slot))
+        program._static_names_cache = (program._version, names)
+        return names
 
     def run(self, program=None, feed=None, fetch_list=None, feed_var_name='feed',
             fetch_var_name='fetch', scope=None, return_numpy=True,
@@ -201,11 +270,20 @@ class Executor(object):
                                          return_numpy)
         if scope is None:
             scope = global_scope()
-        feed = self._prepare_feed(program, feed or {})
+        feed, feed_lods = self._prepare_feed(program, feed or {})
         fetch_names = [v.name if isinstance(v, Variable) else v
                        for v in (fetch_list or [])]
 
-        key = (id(program), program._version, self._feed_signature(feed),
+        static_names = self._static_feed_names(program)
+        static_feed = {n: np.asarray(feed[n]) for n in static_names
+                       if n in feed}
+        scope_lods = {n: normalize_lod(l)
+                      for n, l in getattr(scope, '_lods', {}).items() if l}
+        static_lods = dict(scope_lods)
+        static_lods.update(feed_lods)
+
+        key = (id(program), program._version,
+               self._feed_signature(feed, static_lods, static_feed),
                tuple(fetch_names))
         entry = self._cache.get(key) if use_program_cache else None
         if entry is None:
@@ -213,10 +291,13 @@ class Executor(object):
             # only require state that is read before being written this run
             needed = self._read_before_write(program, read, written,
                                              set(feed), fetch_names)
+            lod_out = {}
             fn, ro_names, rw_names = lowering.build_callable(
-                program, fetch_names, needed, written)
+                program, fetch_names, needed, written,
+                static_lods=static_lods, static_feed=static_feed,
+                lod_out=lod_out)
             entry = _CompiledEntry(fn, fetch_names, ro_names, rw_names,
-                                   written, program)
+                                   written, program, lod_out)
             if use_program_cache:
                 self._cache[key] = entry
 
@@ -231,9 +312,27 @@ class Executor(object):
                            self._run_counter)
         fetches, new_state = entry.fn(feed, ro_state, rw_state, key_arr)
         scope.update(new_state)
+        # propagate LoD of written persistables into the scope, and of
+        # fetches into the returned tensors
+        for n in entry.written:
+            lod = entry.lod_out.get(n)
+            if lod:
+                scope._lods[n] = lod
+            else:
+                scope._lods.pop(n, None)
         if return_numpy:
-            return [np.asarray(f) for f in fetches]
-        return list(fetches)
+            return [
+                _fetched(f, entry.lod_out[n])
+                if entry.lod_out.get(n) else np.asarray(f)
+                for n, f in zip(entry.fetch_names, fetches)
+            ]
+        # return_numpy=False keeps fetches device-resident (no host sync);
+        # only lod-carrying results are wrapped, since the LoD metadata is
+        # the point of asking for them
+        return [
+            _fetched(f, entry.lod_out[n]) if entry.lod_out.get(n) else f
+            for n, f in zip(entry.fetch_names, fetches)
+        ]
 
     # ------------------------------------------------------------------
     def _state_value(self, scope, name, program):

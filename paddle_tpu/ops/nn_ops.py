@@ -23,13 +23,6 @@ def _softmax(ctx, op):
     ctx.out(op, 'Out', jax.nn.softmax(x, axis=-1))
 
 
-@register_op('sequence_softmax')
-def _sequence_softmax_placeholder(ctx, op):
-    # real ragged version lives in sequence_ops; dense fallback
-    x = ctx.in1(op, 'X')
-    ctx.out(op, 'Out', jax.nn.softmax(x, axis=-1))
-
-
 def _gather_label(x, label):
     lab = label.reshape(-1).astype(jnp.int32)
     return jnp.take_along_axis(x, lab[:, None], axis=-1), lab

@@ -1,16 +1,519 @@
-"""Sequence (ragged/LoD) ops — placeholder module; full segment-id based
-implementations land with the ragged tensor subsystem (stage 6).
-Reference: operators/sequence_ops/ (17 ops)."""
+"""Sequence (ragged/LoD) ops — the reference's variable-length no-padding
+differentiator (operators/sequence_ops/, 17 ops; LoD defined at
+framework/lod_tensor.h:58), rebuilt for XLA static shapes.
+
+Design (see core/lod.py): LoD offsets are compile-time constants; values are
+traced arrays. Each op computes its ragged index maps with numpy at trace
+time, so the emitted XLA program contains only static gathers/scatters and
+segment reductions — exact reference semantics, no padding waste, and
+MXU-friendly downstream shapes.
+"""
+import numpy as np
+import jax
 import jax.numpy as jnp
 
 from ..core.registry import register_op
+from ..core.lod import (normalize_lod, lengths_from_offsets, segment_ids,
+                        lod_from_lengths)
+from .common import np_dtype
 
+
+def _last_level(lod):
+    if not lod:
+        return None
+    return lod[-1]
+
+
+def _require_lod(ctx, op, slot='X'):
+    lod = ctx.in1_lod(op, slot)
+    if not lod:
+        raise ValueError(
+            "op %s requires a LoD (ragged) input in slot %s — feed it as "
+            "(array, lod) or create_lod_tensor" % (op.type, slot))
+    return lod
+
+
+# ---------------------------------------------------------------------------
+# sequence_pool (+ first/last steps) — reference sequence_pool_op.cc
+# ---------------------------------------------------------------------------
+
+@register_op('sequence_pool')
+def _sequence_pool(ctx, op):
+    x = ctx.in1(op, 'X')
+    lod = _require_lod(ctx, op)
+    offsets = _last_level(lod)
+    n = len(offsets) - 1
+    pooltype = op.attr('pooltype', 'AVERAGE').upper()
+    ids = jnp.asarray(segment_ids(offsets))
+    lens = np.asarray(lengths_from_offsets(offsets), dtype=np.float32)
+
+    if pooltype in ('SUM', 'AVERAGE', 'SQRT'):
+        out = jax.ops.segment_sum(x, ids, num_segments=n)
+        if pooltype == 'AVERAGE':
+            out = out / jnp.maximum(jnp.asarray(lens), 1.0).reshape(
+                (-1,) + (1,) * (out.ndim - 1))
+        elif pooltype == 'SQRT':
+            out = out / jnp.sqrt(jnp.maximum(jnp.asarray(lens), 1.0)).reshape(
+                (-1,) + (1,) * (out.ndim - 1))
+    elif pooltype == 'MAX':
+        out = jax.ops.segment_max(x, ids, num_segments=n)
+        # empty sequences: segment_max yields -inf; reference leaves 0
+        out = _zero_empty(out, lens)
+    elif pooltype == 'LAST':
+        idx = np.maximum(np.asarray(offsets[1:]) - 1, 0)
+        out = jnp.take(x, jnp.asarray(idx.astype(np.int32)), axis=0)
+        out = _zero_empty(out, lens)
+    elif pooltype == 'FIRST':
+        idx = np.minimum(np.asarray(offsets[:-1]), max(offsets[-1] - 1, 0))
+        out = jnp.take(x, jnp.asarray(idx.astype(np.int32)), axis=0)
+        out = _zero_empty(out, lens)
+    else:
+        raise NotImplementedError("sequence_pool pooltype %r" % pooltype)
+
+    ctx.out(op, 'Out', out)
+    # pooling consumes the last lod level (reference: out lod = lod[:-1])
+    ctx.set_lod(op.output('Out')[0], lod[:-1])
+    if op.output('MaxIndex'):
+        if pooltype == 'MAX':
+            # first row (within x) attaining the per-segment max — the
+            # reference's MaxIndex used by its grad kernel
+            rows = jnp.arange(x.shape[0]).reshape(
+                (-1,) + (1,) * (x.ndim - 1))
+            cand = jnp.where(x == out[ids], rows, x.shape[0])
+            midx = jax.ops.segment_min(
+                jnp.broadcast_to(cand, x.shape), ids, num_segments=n)
+            midx = jnp.where(midx == x.shape[0], 0, midx)
+            ctx.out(op, 'MaxIndex', midx.astype(jnp.int32))
+        else:
+            ctx.out(op, 'MaxIndex',
+                    jnp.zeros((n,) + x.shape[1:], jnp.int32))
+
+
+def _zero_empty(out, lens):
+    empty = (lens == 0).reshape((-1,) + (1,) * (out.ndim - 1))
+    return jnp.where(jnp.asarray(empty), jnp.zeros_like(out), out)
+
+
+# ---------------------------------------------------------------------------
+# sequence_softmax — reference sequence_ops/sequence_softmax_op.cc
+# ---------------------------------------------------------------------------
+
+@register_op('sequence_softmax')
+def _sequence_softmax(ctx, op):
+    x = ctx.in1(op, 'X')
+    lod = _require_lod(ctx, op)
+    offsets = _last_level(lod)
+    n = len(offsets) - 1
+    ids = jnp.asarray(segment_ids(offsets))
+    # softmax over the rows of each sequence (per trailing feature); the
+    # reference restricts X to (T,) / (T,1), this generalizes to (T, ...)
+    seg_max = jax.ops.segment_max(x, ids, num_segments=n)
+    seg_max = jnp.where(jnp.isfinite(seg_max), seg_max,
+                        jnp.zeros_like(seg_max))
+    e = jnp.exp(x - seg_max[ids])
+    denom = jax.ops.segment_sum(e, ids, num_segments=n)
+    out = e / denom[ids]
+    ctx.out(op, 'Out', out)
+    ctx.set_lod(op.output('Out')[0], lod)
+
+
+# ---------------------------------------------------------------------------
+# sequence_expand / sequence_expand_as — reference sequence_expand_op.cc
+# ---------------------------------------------------------------------------
+
+@register_op('sequence_expand')
+def _sequence_expand(ctx, op):
+    x = ctx.in1(op, 'X')
+    x_lod = ctx.in1_lod(op, 'X')
+    y_lod = _require_lod(ctx, op, 'Y')
+    ref_level = op.attr('ref_level', -1)
+    if ref_level == -1:
+        ref_level = len(y_lod) - 1
+    ref = y_lod[ref_level]
+    reps = lengths_from_offsets(ref)
+
+    if x_lod:
+        x_off = x_lod[0]
+    else:
+        x_off = tuple(range(x.shape[0] + 1))
+    if len(x_off) - 1 != len(reps):
+        raise ValueError(
+            "sequence_expand: X has %d sequences but Y ref level has %d"
+            % (len(x_off) - 1, len(reps)))
+
+    idx = []
+    out_lens = []
+    for i, rep in enumerate(reps):
+        seq = list(range(x_off[i], x_off[i + 1]))
+        for _ in range(rep):
+            idx.extend(seq)
+            if x_lod:
+                out_lens.append(len(seq))
+    if not idx:
+        out = jnp.zeros((0,) + x.shape[1:], x.dtype)
+    else:
+        out = jnp.take(x, jnp.asarray(np.asarray(idx, np.int32)), axis=0)
+    ctx.out(op, 'Out', out)
+    if x_lod:
+        ctx.set_lod(op.output('Out')[0], lod_from_lengths([out_lens]))
+
+
+@register_op('sequence_expand_as')
+def _sequence_expand_as(ctx, op):
+    x = ctx.in1(op, 'X')
+    y_lod = _require_lod(ctx, op, 'Y')
+    reps = lengths_from_offsets(_last_level(y_lod))
+    if x.shape[0] != len(reps):
+        raise ValueError(
+            "sequence_expand_as: X rows (%d) != Y sequences (%d)"
+            % (x.shape[0], len(reps)))
+    idx = np.repeat(np.arange(len(reps), dtype=np.int32),
+                    np.asarray(reps, np.int32))
+    out = jnp.take(x, jnp.asarray(idx), axis=0)
+    ctx.out(op, 'Out', out)
+    ctx.set_lod(op.output('Out')[0], (tuple(_last_level(y_lod)),))
+
+
+# ---------------------------------------------------------------------------
+# sequence_concat — reference sequence_ops/sequence_concat_op.cc
+# ---------------------------------------------------------------------------
+
+@register_op('sequence_concat')
+def _sequence_concat(ctx, op):
+    names = op.input('X')
+    xs = [ctx.get(n) for n in names]
+    offs = []
+    for n in names:
+        lod = ctx.lods.get(n, ())
+        if not lod:
+            raise ValueError("sequence_concat input %r has no LoD" % n)
+        offs.append(_last_level(lod))
+    n_seq = len(offs[0]) - 1
+    if any(len(o) - 1 != n_seq for o in offs):
+        raise ValueError("sequence_concat inputs disagree on sequence count")
+
+    total = jnp.concatenate(xs, axis=0)
+    bases = np.cumsum([0] + [x.shape[0] for x in xs])
+    idx = []
+    out_lens = []
+    for i in range(n_seq):
+        ln = 0
+        for k, off in enumerate(offs):
+            idx.extend(range(bases[k] + off[i], bases[k] + off[i + 1]))
+            ln += off[i + 1] - off[i]
+        out_lens.append(ln)
+    out = jnp.take(total, jnp.asarray(np.asarray(idx, np.int32)), axis=0)
+    ctx.out(op, 'Out', out)
+    ctx.set_lod(op.output('Out')[0], lod_from_lengths([out_lens]))
+
+
+# ---------------------------------------------------------------------------
+# sequence_slice — reference sequence_ops/sequence_slice_op.cc
+# Offset/Length are shape-bearing: bound statically.
+# ---------------------------------------------------------------------------
+
+@register_op('sequence_slice', static_inputs=('Offset', 'Length'))
+def _sequence_slice(ctx, op):
+    x = ctx.in1(op, 'X')
+    lod = _require_lod(ctx, op)
+    offsets = _last_level(lod)
+    off = np.asarray(ctx.in1_static(op, 'Offset')).reshape(-1).astype(np.int64)
+    length = np.asarray(ctx.in1_static(op, 'Length')).reshape(-1) \
+        .astype(np.int64)
+    n = len(offsets) - 1
+    if off.size != n or length.size != n:
+        raise ValueError("sequence_slice: Offset/Length must have one entry "
+                         "per sequence")
+    idx = []
+    for i in range(n):
+        start = offsets[i] + int(off[i])
+        idx.extend(range(start, start + int(length[i])))
+    out = jnp.take(x, jnp.asarray(np.asarray(idx, np.int32)), axis=0) \
+        if idx else jnp.zeros((0,) + x.shape[1:], x.dtype)
+    ctx.out(op, 'Out', out)
+    ctx.set_lod(op.output('Out')[0],
+                lod_from_lengths([[int(l) for l in length]]))
+
+
+# ---------------------------------------------------------------------------
+# sequence_reshape — reference sequence_ops/sequence_reshape_op.cc
+# ---------------------------------------------------------------------------
+
+@register_op('sequence_reshape')
+def _sequence_reshape(ctx, op):
+    x = ctx.in1(op, 'X')
+    lod = _require_lod(ctx, op)
+    new_dim = int(op.attr('new_dim'))
+    dim = int(np.prod(x.shape[1:])) if x.ndim > 1 else 1
+    offsets = _last_level(lod)
+    out_lens = []
+    for ln in lengths_from_offsets(offsets):
+        total = ln * dim
+        if total % new_dim:
+            raise ValueError(
+                "sequence_reshape: sequence of %d elements not divisible by "
+                "new_dim %d" % (total, new_dim))
+        out_lens.append(total // new_dim)
+    out = x.reshape(-1, new_dim)
+    ctx.out(op, 'Out', out)
+    ctx.set_lod(op.output('Out')[0], lod_from_lengths([out_lens]))
+
+
+# ---------------------------------------------------------------------------
+# sequence_pad / sequence_unpad — reference sequence_pad_op.cc
+# ---------------------------------------------------------------------------
+
+@register_op('sequence_pad')
+def _sequence_pad(ctx, op):
+    x = ctx.in1(op, 'X')
+    pad_value = ctx.in1(op, 'PadValue')
+    lod = _require_lod(ctx, op)
+    offsets = _last_level(lod)
+    lens = lengths_from_offsets(offsets)
+    n = len(lens)
+    maxlen = max(lens) if lens else 0
+    padded_length = int(op.attr('padded_length', -1))
+    if padded_length == -1:
+        padded_length = maxlen
+    if padded_length < maxlen:
+        raise ValueError("sequence_pad: padded_length %d < longest sequence "
+                         "%d" % (padded_length, maxlen))
+    step_shape = x.shape[1:]
+
+    # gather map: (n, padded_length) row indices; invalid -> 0 + masked
+    idx = np.zeros((n, padded_length), dtype=np.int32)
+    mask = np.zeros((n, padded_length), dtype=bool)
+    for i in range(n):
+        ln = lens[i]
+        idx[i, :ln] = np.arange(offsets[i], offsets[i + 1])
+        mask[i, :ln] = True
+    gathered = jnp.take(x, jnp.asarray(idx.reshape(-1)), axis=0) \
+        .reshape((n, padded_length) + step_shape)
+    if pad_value.size > 0:
+        pv = jnp.broadcast_to(pad_value.astype(x.dtype),
+                              (n, padded_length) + step_shape)
+    else:
+        pv = jnp.zeros_like(gathered)
+    m = jnp.asarray(mask).reshape((n, padded_length) + (1,) * len(step_shape))
+    out = jnp.where(m, gathered, pv)
+    ctx.out(op, 'Out', out)
+    ctx.out(op, 'Length', jnp.asarray(np.asarray(lens, np.int64)))
+    if op.output('Length'):
+        # Length is a pure function of the static LoD: expose it statically
+        # so sequence_unpad (static_inputs=('Length',)) composes with pad
+        ctx.set_static(op.output('Length')[0], np.asarray(lens, np.int64))
+
+
+@register_op('sequence_unpad', static_inputs=('Length',))
+def _sequence_unpad(ctx, op):
+    x = ctx.in1(op, 'X')              # (n, pad_len, ...)
+    lens = np.asarray(ctx.in1_static(op, 'Length')).reshape(-1) \
+        .astype(np.int64)
+    n, pad_len = x.shape[0], x.shape[1]
+    idx = []
+    for i in range(int(n)):
+        ln = int(min(lens[i], pad_len))
+        idx.extend(i * pad_len + j for j in range(ln))
+    flat = x.reshape((n * pad_len,) + x.shape[2:])
+    out = jnp.take(flat, jnp.asarray(np.asarray(idx, np.int32)), axis=0) \
+        if idx else jnp.zeros((0,) + x.shape[2:], x.dtype)
+    ctx.out(op, 'Out', out)
+    ctx.set_lod(op.output('Out')[0],
+                lod_from_lengths([[int(l) for l in lens]]))
+
+
+# ---------------------------------------------------------------------------
+# sequence_reverse — reference sequence_ops/sequence_reverse_op.h
+# ---------------------------------------------------------------------------
+
+@register_op('sequence_reverse')
+def _sequence_reverse(ctx, op):
+    x = ctx.in1(op, 'X')
+    lod = _require_lod(ctx, op)
+    offsets = _last_level(lod)
+    idx = np.arange(x.shape[0], dtype=np.int32)
+    for i in range(len(offsets) - 1):
+        idx[offsets[i]:offsets[i + 1]] = \
+            idx[offsets[i]:offsets[i + 1]][::-1]
+    out = jnp.take(x, jnp.asarray(idx), axis=0)
+    ctx.out(op, 'Y', out)
+    ctx.set_lod(op.output('Y')[0], lod)
+
+
+# ---------------------------------------------------------------------------
+# sequence_enumerate — reference sequence_ops/sequence_enumerate_op.cc
+# ---------------------------------------------------------------------------
+
+@register_op('sequence_enumerate')
+def _sequence_enumerate(ctx, op):
+    x = ctx.in1(op, 'X')
+    lod = _require_lod(ctx, op)
+    offsets = _last_level(lod)
+    win = int(op.attr('win_size'))
+    pad = op.attr('pad_value', 0)
+    t = x.shape[0]
+    flat = x.reshape(-1)
+
+    idx = np.zeros((t, win), dtype=np.int32)
+    valid = np.zeros((t, win), dtype=bool)
+    for s in range(len(offsets) - 1):
+        for p in range(offsets[s], offsets[s + 1]):
+            for j in range(win):
+                if p + j < offsets[s + 1]:
+                    idx[p, j] = p + j
+                    valid[p, j] = True
+    vals = jnp.take(flat, jnp.asarray(idx.reshape(-1))).reshape(t, win)
+    out = jnp.where(jnp.asarray(valid), vals,
+                    jnp.full((t, win), pad, dtype=x.dtype))
+    ctx.out(op, 'Out', out)
+    ctx.set_lod(op.output('Out')[0], lod)
+
+
+# ---------------------------------------------------------------------------
+# sequence_erase — reference sequence_ops/sequence_erase_op.cc
+# output size depends on the *data*, so X is shape-bearing (static).
+# ---------------------------------------------------------------------------
+
+@register_op('sequence_erase', static_inputs=('X',))
+def _sequence_erase(ctx, op):
+    x_np = np.asarray(ctx.in1_static(op, 'X'))
+    lod = _require_lod(ctx, op)
+    offsets = _last_level(lod)
+    tokens = set(int(t) for t in op.attr('tokens', []))
+    flat = x_np.reshape(-1)
+    kept = []
+    out_lens = []
+    for i in range(len(offsets) - 1):
+        cnt = 0
+        for p in range(offsets[i], offsets[i + 1]):
+            if int(flat[p]) not in tokens:
+                kept.append(flat[p])
+                cnt += 1
+        out_lens.append(cnt)
+    out_np = np.asarray(kept, dtype=x_np.dtype).reshape(
+        (-1,) + x_np.shape[1:])
+    ctx.out(op, 'Out', jnp.asarray(out_np))
+    ctx.set_lod(op.output('Out')[0], lod_from_lengths([out_lens]))
+
+
+# ---------------------------------------------------------------------------
+# sequence_scatter — reference sequence_ops/sequence_scatter_op.cc
+# ---------------------------------------------------------------------------
+
+@register_op('sequence_scatter')
+def _sequence_scatter(ctx, op):
+    x = ctx.in1(op, 'X')          # (n, d)
+    ids = ctx.in1(op, 'Ids')      # lod (t, 1) int
+    upd = ctx.in1(op, 'Updates')  # lod (t,)
+    lod = _require_lod(ctx, op, 'Ids')
+    offsets = _last_level(lod)
+    n = len(offsets) - 1
+    if x.shape[0] != n:
+        raise ValueError("sequence_scatter: X rows must equal Ids sequences")
+    rows = jnp.asarray(segment_ids(offsets))      # (t,)
+    cols = ids.reshape(-1).astype(jnp.int32)
+    out = x.at[rows, cols].add(upd.reshape(-1).astype(x.dtype))
+    ctx.out(op, 'Out', out)
+
+
+# ---------------------------------------------------------------------------
+# sequence_conv — reference sequence_ops/sequence_conv_op.cc +
+# operators/math/context_project.h (im2col over ragged context windows)
+# ---------------------------------------------------------------------------
+
+@register_op('sequence_conv')
+def _sequence_conv(ctx, op):
+    x = ctx.in1(op, 'X')          # (t, d)
+    filt = ctx.in1(op, 'Filter')  # (context_length*d, out_d)
+    lod = _require_lod(ctx, op)
+    offsets = _last_level(lod)
+    ctx_len = int(op.attr('contextLength'))
+    ctx_start = int(op.attr('contextStart', -(ctx_len // 2)))
+    stride = int(op.attr('contextStride', 1))
+    if stride != 1:
+        raise NotImplementedError("sequence_conv contextStride must be 1 "
+                                  "(reference enforces the same)")
+    t, d = x.shape
+
+    idx = np.zeros((t, ctx_len), dtype=np.int32)
+    valid = np.zeros((t, ctx_len), dtype=bool)
+    for s in range(len(offsets) - 1):
+        lo, hi = offsets[s], offsets[s + 1]
+        for p in range(lo, hi):
+            for j in range(ctx_len):
+                q = p + ctx_start + j
+                if lo <= q < hi:
+                    idx[p, j] = q
+                    valid[p, j] = True
+    ctx_mat = jnp.take(x, jnp.asarray(idx.reshape(-1)), axis=0) \
+        .reshape(t, ctx_len, d)
+    ctx_mat = ctx_mat * jnp.asarray(valid)[:, :, None].astype(x.dtype)
+
+    pad_names = op.input('PaddingData')
+    if pad_names and op.attr('paddingTrainable', False):
+        pad_data = ctx.get(pad_names[0])   # (up+down, d)
+        up = max(0, -ctx_start)
+        down = max(0, ctx_start + ctx_len - 1)
+        rows, cols, pidx = [], [], []
+        for s in range(len(offsets) - 1):
+            lo, hi = offsets[s], offsets[s + 1]
+            for p in range(lo, hi):
+                for j in range(ctx_len):
+                    q = p + ctx_start + j
+                    if q < lo and up:
+                        rows.append(p); cols.append(j)
+                        pidx.append(q - lo + up)
+                    elif q >= hi and down:
+                        rows.append(p); cols.append(j)
+                        pidx.append(up + q - hi)
+        if rows:
+            pad_rows = jnp.take(pad_data,
+                                jnp.asarray(np.asarray(pidx, np.int32)),
+                                axis=0)
+            ctx_mat = ctx_mat.at[jnp.asarray(np.asarray(rows, np.int32)),
+                                 jnp.asarray(np.asarray(cols, np.int32))] \
+                .add(pad_rows.astype(x.dtype))
+
+    out = ctx_mat.reshape(t, ctx_len * d) @ filt
+    ctx.out(op, 'Out', out)
+    ctx.set_lod(op.output('Out')[0], lod)
+
+
+# ---------------------------------------------------------------------------
+# lod_reset — reference lod_reset_op.cc
+# ---------------------------------------------------------------------------
+
+@register_op('lod_reset')
+def _lod_reset(ctx, op):
+    # NOTE: Y is deliberately NOT in static_inputs — the common pattern is
+    # "copy Y's LoD", which needs only Y's static lod. Binding Y's data
+    # statically would key the program cache on the batch contents and
+    # recompile every step. The offsets-as-values form falls back to
+    # static_value, which works for trace-time constants.
+    x = ctx.in1(op, 'X')
+    y_names = op.input('Y')
+    if y_names:
+        y_lod = ctx.lods.get(y_names[0], ())
+        if y_lod:
+            new_lod = (y_lod[-1],)
+        else:
+            off = np.asarray(ctx.static_value(y_names[0])).reshape(-1)
+            new_lod = (tuple(int(v) for v in off),)
+    else:
+        target = op.attr('target_lod', [])
+        new_lod = normalize_lod([list(target)])
+    ctx.out(op, 'Out', x)
+    ctx.set_lod(op.output('Out')[0], new_lod)
+
+
+# ---------------------------------------------------------------------------
+# sequence_mask — reference sequence_ops/sequence_mask_op.cc (dense lengths)
+# ---------------------------------------------------------------------------
 
 @register_op('sequence_mask')
 def _sequence_mask(ctx, op):
     x = ctx.in1(op, 'X')
     maxlen = op.attr('maxlen', -1)
-    from .common import np_dtype
     dtype = np_dtype(op.attr('out_dtype', 'int64'))
     if maxlen is None or maxlen < 0:
         raise NotImplementedError(

@@ -106,7 +106,12 @@ class MeshRunner(object):
             scope = global_scope()
         program = self._program
         exe = Executor()
-        feed = exe._prepare_feed(program, feed or {})
+        feed, _feed_lods = exe._prepare_feed(program, feed or {})
+        if _feed_lods:
+            raise NotImplementedError(
+                "LoD (ragged) feeds are not supported by the mesh runners "
+                "yet — pad/bucket sequences (layers.sequence_pad) before "
+                "sharding them over the mesh")
         fetch_names = [v.name if isinstance(v, Variable) else v
                        for v in (fetch_list or [])]
         key = (program._version, exe._feed_signature(feed),

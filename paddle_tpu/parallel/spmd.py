@@ -75,7 +75,12 @@ class DataParallelRunner(object):
         if scope is None:
             scope = global_scope()
         program = self._program
-        feed = executor._prepare_feed(program, feed or {})
+        feed, _feed_lods = executor._prepare_feed(program, feed or {})
+        if _feed_lods:
+            raise NotImplementedError(
+                "LoD (ragged) feeds are not supported by the mesh runners "
+                "yet — pad/bucket sequences (layers.sequence_pad) before "
+                "sharding them over the mesh")
         fetch_names = [v.name if isinstance(v, Variable) else v
                        for v in (fetch_list or [])]
         ndev = self.num_devices

@@ -18,6 +18,15 @@ def _as_list(v):
     return v if isinstance(v, (list, tuple)) else [v]
 
 
+def _split_lod(val):
+    """OpTest convention (reference op_test.py): a value may be
+    (ndarray, lod) — lod is offset- or length-based nested lists."""
+    if isinstance(val, tuple) and len(val) == 2 and \
+            isinstance(val[1], (list, tuple)):
+        return np.asarray(val[0]), val[1]
+    return np.asarray(val), None
+
+
 class OpTest(object):
     """Subclass contract: implement setup() setting op_type/inputs/outputs/
     attrs (dict values are numpy arrays, or lists of (name, array) for
@@ -31,7 +40,8 @@ class OpTest(object):
     # -- program construction ------------------------------------------
     def _entries(self, d):
         for slot, val in d.items():
-            if isinstance(val, list) and val and isinstance(val[0], tuple):
+            if isinstance(val, list) and val and isinstance(val[0], tuple) \
+                    and isinstance(val[0][0], str):
                 yield slot, list(val)
             else:
                 yield slot, [(slot, val)]
@@ -45,12 +55,13 @@ class OpTest(object):
             in_map = {}
             for slot, entries in self._entries(self.inputs):
                 vs = []
-                for name, arr in entries:
-                    arr = np.asarray(arr)
+                for name, val in entries:
+                    arr, lod = _split_lod(val)
                     v = block.create_var(name=name, shape=arr.shape,
                                          dtype=arr.dtype,
-                                         stop_gradient=False)
-                    feed[name] = arr
+                                         stop_gradient=False,
+                                         lod_level=len(lod) if lod else 0)
+                    feed[name] = (arr, lod) if lod else arr
                     vs.append(v)
                 in_map[slot] = vs
             out_map = {}
@@ -79,11 +90,18 @@ class OpTest(object):
         for slot, entries in self._entries(self.outputs):
             if no_check_set and slot in no_check_set:
                 continue
-            for (name, arr), fetch_name in zip(entries, out_names[slot]):
+            for (name, val), fetch_name in zip(entries, out_names[slot]):
+                arr, lod = _split_lod(val)
                 fetch.append(fetch_name)
-                expect.append(np.asarray(arr))
+                expect.append((arr, lod))
         got = exe.run(prog, feed=feed, fetch_list=fetch, scope=scope)
-        for name, e, g in zip(fetch, expect, got):
+        for name, (e, elod), g in zip(fetch, expect, got):
+            if elod is not None:
+                from paddle_tpu.core.lod import normalize_lod
+                glod = normalize_lod(getattr(g, 'lod', lambda: [])())
+                assert glod == normalize_lod(elod), (
+                    "output %s lod mismatch (%s): got %s want %s"
+                    % (name, self.op_type, glod, normalize_lod(elod)))
             if e.dtype == np.bool_:
                 np.testing.assert_array_equal(
                     g.astype(np.bool_), e,
@@ -143,7 +161,8 @@ class OpTest(object):
             return float(np.asarray(out).reshape(-1)[0])
 
         for name, a_grad in zip(inputs_to_check, analytic):
-            base = np.asarray(feed[name], dtype=np.float64)
+            fval, flod = _split_lod(feed[name])
+            base = np.asarray(fval, dtype=np.float64)
             num = np.zeros_like(base, dtype=np.float64)
             flat = base.reshape(-1)
             delta = numeric_grad_delta
@@ -152,11 +171,13 @@ class OpTest(object):
                 f2 = dict(feed)
                 pos = base.copy().reshape(-1)
                 pos[i] = orig + delta
-                f2[name] = pos.reshape(base.shape).astype(feed[name].dtype)
+                pos_a = pos.reshape(base.shape).astype(fval.dtype)
+                f2[name] = (pos_a, flod) if flod else pos_a
                 l_pos = eval_loss(f2)
                 neg = base.copy().reshape(-1)
                 neg[i] = orig - delta
-                f2[name] = neg.reshape(base.shape).astype(feed[name].dtype)
+                neg_a = neg.reshape(base.shape).astype(fval.dtype)
+                f2[name] = (neg_a, flod) if flod else neg_a
                 l_neg = eval_loss(f2)
                 num.reshape(-1)[i] = (l_pos - l_neg) / (2 * delta)
             a = np.asarray(a_grad, dtype=np.float64)

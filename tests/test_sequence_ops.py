@@ -1,0 +1,389 @@
+"""Sequence (LoD) op tests — numpy references + finite-difference grads.
+
+Models the reference suites python/paddle/fluid/tests/unittests/
+test_sequence_{pool,expand,concat,slice,reshape,pad_op,unpad_op,reverse,
+enumerate,erase,scatter,conv}*.py under the static-LoD TPU design.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from op_test import OpTest
+
+
+def _seqs(x, offsets):
+    return [x[offsets[i]:offsets[i + 1]] for i in range(len(offsets) - 1)]
+
+
+LOD = [[0, 4, 5, 8]]
+T = 8
+
+
+def _x(d=23, t=T, seed=7):
+    rng = np.random.RandomState(seed)
+    return rng.uniform(0.1, 1, (t, d)).astype('float32')
+
+
+class _PoolBase(OpTest):
+    pooltype = 'SUM'
+
+    def expect(self, seqs):
+        raise NotImplementedError
+
+    def setup(self):
+        self.op_type = 'sequence_pool'
+        x = _x()
+        self.inputs = {'X': (x, LOD)}
+        out = np.stack([self.expect(s) for s in _seqs(x, LOD[0])])
+        self.outputs = {'Out': out}
+        self.attrs = {'pooltype': self.pooltype}
+
+
+class TestSeqSumPool(_PoolBase):
+    pooltype = 'SUM'
+    expect = staticmethod(lambda s: s.sum(0))
+
+
+class TestSeqAvgPool(_PoolBase):
+    pooltype = 'AVERAGE'
+    expect = staticmethod(lambda s: s.mean(0))
+
+
+class TestSeqSqrtPool(_PoolBase):
+    pooltype = 'SQRT'
+    expect = staticmethod(lambda s: s.sum(0) / np.sqrt(len(s)))
+
+
+class TestSeqMaxPool(_PoolBase):
+    pooltype = 'MAX'
+    expect = staticmethod(lambda s: s.max(0))
+
+
+class TestSeqLastPool(_PoolBase):
+    pooltype = 'LAST'
+    expect = staticmethod(lambda s: s[-1])
+
+
+class TestSeqFirstPool(_PoolBase):
+    pooltype = 'FIRST'
+    expect = staticmethod(lambda s: s[0])
+
+
+@pytest.mark.parametrize('cls', [TestSeqSumPool, TestSeqAvgPool,
+                                 TestSeqSqrtPool, TestSeqMaxPool,
+                                 TestSeqLastPool, TestSeqFirstPool])
+def test_sequence_pool_output(cls):
+    cls().check_output()
+
+
+@pytest.mark.parametrize('cls', [TestSeqSumPool, TestSeqAvgPool,
+                                 TestSeqSqrtPool])
+def test_sequence_pool_grad(cls):
+    t = cls()
+    t.inputs = {}
+    t.check_grad(['X'], ['Out'], max_relative_error=0.02)
+
+
+def test_sequence_softmax():
+    x = _x(d=1).reshape(-1, 1)
+
+    class C(OpTest):
+        def setup(self):
+            self.op_type = 'sequence_softmax'
+            self.inputs = {'X': (x, LOD)}
+            outs = []
+            for s in _seqs(x[:, 0], LOD[0]):
+                e = np.exp(s - s.max())
+                outs.append(e / e.sum())
+            self.outputs = {'Out': np.concatenate(outs).reshape(-1, 1)}
+            self.attrs = {}
+    C().check_output()
+    C().check_grad(['X'], ['Out'], max_relative_error=0.02)
+
+
+def test_sequence_expand():
+    x = _x(d=3, t=4, seed=1)
+    x_lod = [[0, 2, 4]]
+    y_lod = [[0, 2, 5]]   # repeats: 2, 3
+
+    class C(OpTest):
+        def setup(self):
+            self.op_type = 'sequence_expand'
+            y = np.zeros((5, 1), dtype='float32')
+            self.inputs = {'X': (x, x_lod), 'Y': (y, y_lod)}
+            out = np.concatenate([x[0:2]] * 2 + [x[2:4]] * 3)
+            self.outputs = {'Out': (out, [[0, 2, 4, 6, 8, 10]])}
+            self.attrs = {'ref_level': 0}
+    C().check_output()
+    C().check_grad(['X'], ['Out'], max_relative_error=0.02)
+
+
+def test_sequence_expand_dense_x():
+    x = _x(d=3, t=2, seed=2)
+    y_lod = [[0, 1, 4]]
+
+    class C(OpTest):
+        def setup(self):
+            self.op_type = 'sequence_expand'
+            y = np.zeros((4, 1), dtype='float32')
+            self.inputs = {'X': x, 'Y': (y, y_lod)}
+            out = np.concatenate([x[0:1], x[1:2], x[1:2], x[1:2]])
+            self.outputs = {'Out': out}
+            self.attrs = {}
+    C().check_output()
+
+
+def test_sequence_expand_as():
+    x = _x(d=3, t=3, seed=3)
+    y_lod = [[0, 2, 2, 5]]
+
+    class C(OpTest):
+        def setup(self):
+            self.op_type = 'sequence_expand_as'
+            y = np.zeros((5, 1), dtype='float32')
+            self.inputs = {'X': x, 'Y': (y, y_lod)}
+            out = np.concatenate([x[0:1], x[0:1], x[2:3], x[2:3], x[2:3]])
+            self.outputs = {'Out': (out, y_lod)}
+            self.attrs = {}
+    C().check_output()
+
+
+def test_sequence_concat():
+    a = _x(d=4, t=6, seed=4)
+    b = _x(d=4, t=5, seed=5)
+    a_lod = [[0, 2, 6]]
+    b_lod = [[0, 3, 5]]
+
+    class C(OpTest):
+        def setup(self):
+            self.op_type = 'sequence_concat'
+            self.inputs = {'X': [('a', (a, a_lod)), ('b', (b, b_lod))]}
+            out = np.concatenate([a[0:2], b[0:3], a[2:6], b[3:5]])
+            self.outputs = {'Out': (out, [[0, 5, 11]])}
+            self.attrs = {}
+    C().check_output()
+
+
+def test_sequence_slice():
+    x = _x(d=3)
+
+    class C(OpTest):
+        def setup(self):
+            self.op_type = 'sequence_slice'
+            off = np.array([[1], [0], [2]], dtype='int64')
+            ln = np.array([[2], [1], [1]], dtype='int64')
+            self.inputs = {'X': (x, LOD), 'Offset': off, 'Length': ln}
+            out = np.concatenate([x[1:3], x[4:5], x[7:8]])
+            self.outputs = {'Out': (out, [[0, 2, 3, 4]])}
+            self.attrs = {}
+    C().check_output()
+
+
+def test_sequence_reshape():
+    x = _x(d=4, t=6, seed=8)
+    lod = [[0, 2, 6]]
+
+    class C(OpTest):
+        def setup(self):
+            self.op_type = 'sequence_reshape'
+            self.inputs = {'X': (x, lod)}
+            self.outputs = {'Out': (x.reshape(-1, 2), [[0, 4, 12]])}
+            self.attrs = {'new_dim': 2}
+    C().check_output()
+
+
+def test_sequence_pad_unpad():
+    x = _x(d=3)
+    pad_value = np.zeros((1,), dtype='float32')
+
+    class Pad(OpTest):
+        def setup(self):
+            self.op_type = 'sequence_pad'
+            self.inputs = {'X': (x, LOD), 'PadValue': pad_value}
+            lens = [4, 1, 3]
+            out = np.zeros((3, 4, 3), dtype='float32')
+            for i, (a, b) in enumerate(zip(LOD[0][:-1], LOD[0][1:])):
+                out[i, :b - a] = x[a:b]
+            self.outputs = {'Out': out,
+                            'Length': np.array(lens, dtype='int64')}
+            self.attrs = {'padded_length': -1}
+    Pad().check_output()
+    p = Pad()
+    p.inputs = {}
+    p.check_grad(['X'], ['Out'], max_relative_error=0.02)
+
+    padded = np.arange(24, dtype='float32').reshape(2, 4, 3)
+
+    class Unpad(OpTest):
+        def setup(self):
+            self.op_type = 'sequence_unpad'
+            self.inputs = {'X': padded,
+                           'Length': np.array([2, 4], dtype='int64')}
+            out = np.concatenate([padded[0, :2], padded[1, :4]])
+            self.outputs = {'Out': (out, [[0, 2, 6]])}
+            self.attrs = {}
+    Unpad().check_output()
+
+
+def test_sequence_reverse():
+    x = _x(d=2)
+
+    class C(OpTest):
+        def setup(self):
+            self.op_type = 'sequence_reverse'
+            self.inputs = {'X': (x, LOD)}
+            out = np.concatenate([s[::-1] for s in _seqs(x, LOD[0])])
+            self.outputs = {'Y': (out, LOD)}
+            self.attrs = {}
+    C().check_output()
+    C().check_grad(['X'], ['Y'], max_relative_error=0.02)
+
+
+def test_sequence_enumerate():
+    x = np.array([1, 2, 3, 4, 5, 6, 7, 8], dtype='int64').reshape(-1, 1)
+
+    class C(OpTest):
+        def setup(self):
+            self.op_type = 'sequence_enumerate'
+            self.inputs = {'X': (x, LOD)}
+            out = np.array([
+                [1, 2], [2, 3], [3, 4], [4, 0],
+                [5, 0],
+                [6, 7], [7, 8], [8, 0]], dtype='int64')
+            self.outputs = {'Out': (out, LOD)}
+            self.attrs = {'win_size': 2, 'pad_value': 0}
+    C().check_output()
+
+
+def test_sequence_erase():
+    x = np.array([1, 2, 2, 3, 5, 2, 7, 2], dtype='int64').reshape(-1, 1)
+
+    class C(OpTest):
+        def setup(self):
+            self.op_type = 'sequence_erase'
+            self.inputs = {'X': (x, LOD)}
+            out = np.array([1, 3, 5, 7], dtype='int64').reshape(-1, 1)
+            self.outputs = {'Out': (out, [[0, 2, 3, 4]])}
+            self.attrs = {'tokens': [2]}
+    C().check_output()
+
+
+def test_sequence_scatter():
+    rng = np.random.RandomState(11)
+    x = rng.uniform(size=(3, 6)).astype('float32')
+    ids = np.array([1, 2, 0, 3, 5, 0, 1], dtype='int64').reshape(-1, 1)
+    upd = rng.uniform(size=(7,)).astype('float32')
+    lod = [[0, 3, 5, 7]]
+
+    class C(OpTest):
+        def setup(self):
+            self.op_type = 'sequence_scatter'
+            self.inputs = {'X': x, 'Ids': (ids, lod), 'Updates': (upd, lod)}
+            out = x.copy()
+            for i, (a, b) in enumerate(zip(lod[0][:-1], lod[0][1:])):
+                for j in range(a, b):
+                    out[i, ids[j, 0]] += upd[j]
+            self.outputs = {'Out': out}
+            self.attrs = {}
+    C().check_output()
+
+
+def test_sequence_conv():
+    x = _x(d=4)
+    ctx_len = 3
+    filt = np.random.RandomState(13).uniform(
+        -0.5, 0.5, (ctx_len * 4, 5)).astype('float32')
+
+    def ref():
+        t, d = x.shape
+        start = -(ctx_len // 2)
+        cm = np.zeros((t, ctx_len, d), dtype='float32')
+        for a, b in zip(LOD[0][:-1], LOD[0][1:]):
+            for p in range(a, b):
+                for j in range(ctx_len):
+                    q = p + start + j
+                    if a <= q < b:
+                        cm[p, j] = x[q]
+        return cm.reshape(t, -1) @ filt
+
+    class C(OpTest):
+        def setup(self):
+            self.op_type = 'sequence_conv'
+            self.inputs = {'X': (x, LOD), 'Filter': filt}
+            self.outputs = {'Out': (ref(), LOD)}
+            self.attrs = {'contextLength': ctx_len, 'contextStart': -1,
+                          'contextStride': 1}
+    C().check_output()
+    C().check_grad(['Filter'], ['Out'], max_relative_error=0.03)
+
+
+def test_lod_reset():
+    x = _x(d=2, t=6, seed=17)
+
+    class C(OpTest):
+        def setup(self):
+            self.op_type = 'lod_reset'
+            self.inputs = {'X': (x, [[0, 2, 6]])}
+            self.outputs = {'Out': (x, [[0, 3, 6]])}
+            self.attrs = {'target_lod': [0, 3, 6]}
+    C().check_output()
+
+
+def test_lod_propagates_through_elementwise():
+    """ShareLoD default: lod survives elementwise/activation chains."""
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data('x', shape=[3], dtype='float32', lod_level=1,
+                              append_batch_size=False)
+        y = fluid.layers.relu(x * 2.0 + 1.0)
+        p = fluid.layers.sequence_pool(y, 'max')
+    exe = fluid.Executor(fluid.CPUPlace())
+    sc = fluid.Scope()
+    xv = np.random.RandomState(0).randn(5, 3).astype('float32')
+    out, = exe.run(prog, feed={'x': (xv, [[0, 2, 5]])}, fetch_list=[p],
+                   scope=sc)
+    ref = np.stack([np.maximum(s * 2 + 1, 0).max(0)
+                    for s in (xv[0:2], xv[2:5])])
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+def test_create_lod_tensor_roundtrip():
+    t = fluid.create_lod_tensor(np.ones((5, 2), 'float32'), [[2, 3]], None)
+    assert t.lod() == [[0, 2, 5]]
+    assert t.recursive_sequence_lengths() == [[2, 3]]
+    assert t.has_valid_recursive_sequence_lengths()
+
+
+def test_pad_then_unpad_composition():
+    """sequence_pad's Length output feeds sequence_unpad as a trace-time
+    constant (static_value env fallback) — the reference's standard
+    pad -> dense RNN -> unpad pattern."""
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data('x', shape=[2], dtype='float32', lod_level=1,
+                              append_batch_size=False)
+        pv = fluid.layers.fill_constant(shape=[1], dtype='float32', value=0.0)
+        padded, length = fluid.layers.sequence_pad(x, pv)
+        doubled = padded * 2.0
+        back = fluid.layers.sequence_unpad(doubled, length)
+    exe = fluid.Executor(fluid.CPUPlace())
+    sc = fluid.Scope()
+    exe.run(startup, scope=sc)
+    xv = np.random.RandomState(3).randn(5, 2).astype('float32')
+    out, = exe.run(prog, feed={'x': (xv, [[0, 2, 5]])}, fetch_list=[back],
+                   scope=sc)
+    np.testing.assert_allclose(out, xv * 2, rtol=1e-5)
+    assert out.lod() == [[0, 2, 5]]
+
+
+def test_bad_lod_feed_raises():
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data('x', shape=[2], dtype='float32', lod_level=1,
+                              append_batch_size=False)
+        p = fluid.layers.sequence_pool(x, 'sum')
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = np.zeros((5, 2), 'float32')
+    with pytest.raises(ValueError, match="does not cover"):
+        exe.run(prog, feed={'x': (xv, [[0, 2, 4]])}, fetch_list=[p],
+                scope=fluid.Scope())
