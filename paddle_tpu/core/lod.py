@@ -20,8 +20,28 @@ import numpy as np
 
 __all__ = [
     'normalize_lod', 'lod_from_lengths', 'lengths_from_offsets',
-    'segment_ids', 'check_lod', 'LoD',
+    'segment_ids', 'check_lod', 'LoD', 'context_maps',
 ]
+
+
+def context_maps(offsets, ctx_len, ctx_start):
+    """Static per-row context-window gather maps for ragged sequences:
+    (idx (T, ctx_len), valid (T, ctx_len)). Row p's j-th context element is
+    row p+ctx_start+j when inside p's sequence, else masked. Shared by
+    sequence_conv (reference math/context_project.h) and row_conv
+    (ctx_start=0)."""
+    total = offsets[-1]
+    idx = np.zeros((total, ctx_len), dtype=np.int32)
+    valid = np.zeros((total, ctx_len), dtype=bool)
+    for s in range(len(offsets) - 1):
+        lo, hi = offsets[s], offsets[s + 1]
+        for p in range(lo, hi):
+            for j in range(ctx_len):
+                q = p + ctx_start + j
+                if lo <= q < hi:
+                    idx[p, j] = q
+                    valid[p, j] = True
+    return idx, valid
 
 
 def normalize_lod(lod):

@@ -2,6 +2,7 @@ from . import io
 from . import tensor
 from . import nn
 from . import sequence
+from . import rnn
 from . import ops
 from . import math_op_patch
 from . import metric_op
@@ -13,6 +14,7 @@ from .io import *
 from .tensor import *
 from .nn import *
 from .sequence import *
+from .rnn import *
 from .ops import *
 from .metric_op import *
 from .learning_rate_scheduler import *
@@ -23,6 +25,7 @@ __all__ += io.__all__
 __all__ += tensor.__all__
 __all__ += nn.__all__
 __all__ += sequence.__all__
+__all__ += rnn.__all__
 __all__ += ops.__all__
 __all__ += metric_op.__all__
 __all__ += learning_rate_scheduler.__all__

@@ -15,5 +15,6 @@ from . import compare_ops
 from . import random_ops
 from . import metrics_ops
 from . import sequence_ops
+from . import rnn_ops
 from . import control_flow_ops
 from . import detection_ops

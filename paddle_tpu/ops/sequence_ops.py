@@ -14,7 +14,7 @@ import jax.numpy as jnp
 
 from ..core.registry import register_op
 from ..core.lod import (normalize_lod, lengths_from_offsets, segment_ids,
-                        lod_from_lengths)
+                        lod_from_lengths, context_maps)
 from .common import np_dtype
 
 
@@ -435,16 +435,7 @@ def _sequence_conv(ctx, op):
                                   "(reference enforces the same)")
     t, d = x.shape
 
-    idx = np.zeros((t, ctx_len), dtype=np.int32)
-    valid = np.zeros((t, ctx_len), dtype=bool)
-    for s in range(len(offsets) - 1):
-        lo, hi = offsets[s], offsets[s + 1]
-        for p in range(lo, hi):
-            for j in range(ctx_len):
-                q = p + ctx_start + j
-                if lo <= q < hi:
-                    idx[p, j] = q
-                    valid[p, j] = True
+    idx, valid = context_maps(offsets, ctx_len, ctx_start)
     ctx_mat = jnp.take(x, jnp.asarray(idx.reshape(-1)), axis=0) \
         .reshape(t, ctx_len, d)
     ctx_mat = ctx_mat * jnp.asarray(valid)[:, :, None].astype(x.dtype)

@@ -1,0 +1,326 @@
+"""RNN op tests: lstm/lstmp/gru/gru_unit/lstm_unit/row_conv vs numpy
+step-by-step references (models reference test_lstm_op.py, test_gru_op.py,
+test_gru_unit_op.py, test_lstm_unit_op.py, test_row_conv_op.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from op_test import OpTest
+
+
+def sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+LOD = [[0, 3, 5, 9]]
+T, D = 9, 4
+
+
+def np_lstm_ref(x, w, b, lod, use_peepholes, is_reverse=False):
+    """Step-by-step LSTM over ragged sequences; gate order [c,i,f,o]."""
+    offsets = lod[0]
+    d = w.shape[0]
+    bg = b[0, :4 * d]
+    if use_peepholes:
+        w_ic, w_fc, w_oc = (b[0, 4 * d:5 * d], b[0, 5 * d:6 * d],
+                            b[0, 6 * d:7 * d])
+    else:
+        w_ic = w_fc = w_oc = np.zeros(d)
+    hidden = np.zeros((x.shape[0], d))
+    cell = np.zeros((x.shape[0], d))
+    for s in range(len(offsets) - 1):
+        rows = list(range(offsets[s], offsets[s + 1]))
+        if is_reverse:
+            rows = rows[::-1]
+        h = np.zeros(d)
+        c = np.zeros(d)
+        for p in rows:
+            g = x[p] + bg + h @ w
+            gc, gi, gf, go = g[:d], g[d:2*d], g[2*d:3*d], g[3*d:4*d]
+            cand = np.tanh(gc)
+            i = sigmoid(gi + c * w_ic)
+            f = sigmoid(gf + c * w_fc)
+            c = cand * i + c * f
+            o = sigmoid(go + c * w_oc)
+            h = o * np.tanh(c)
+            hidden[p] = h
+            cell[p] = c
+    return hidden, cell
+
+
+@pytest.mark.parametrize('use_peepholes', [False, True])
+@pytest.mark.parametrize('is_reverse', [False, True])
+def test_lstm_op(use_peepholes, is_reverse):
+    rng = np.random.RandomState(5)
+    x = rng.uniform(-0.5, 0.5, (T, 4 * D)).astype('float32')
+    w = rng.uniform(-0.5, 0.5, (D, 4 * D)).astype('float32')
+    bias_w = 7 * D if use_peepholes else 4 * D
+    b = rng.uniform(-0.5, 0.5, (1, bias_w)).astype('float32')
+    hid, cell = np_lstm_ref(x.astype('float64'), w.astype('float64'),
+                            b.astype('float64'), LOD, use_peepholes,
+                            is_reverse)
+
+    class C(OpTest):
+        def setup(self):
+            self.op_type = 'lstm'
+            self.inputs = {'Input': (x, LOD), 'Weight': w, 'Bias': b}
+            self.outputs = {'Hidden': (hid.astype('float32'), LOD),
+                            'Cell': (cell.astype('float32'), LOD)}
+            self.attrs = {'use_peepholes': use_peepholes,
+                          'is_reverse': is_reverse,
+                          'gate_activation': 'sigmoid',
+                          'cell_activation': 'tanh',
+                          'candidate_activation': 'tanh'}
+    C().check_output(atol=1e-4)
+
+
+def test_lstm_grad():
+    rng = np.random.RandomState(6)
+    x = rng.uniform(-0.3, 0.3, (5, 4 * 3)).astype('float32')
+    w = rng.uniform(-0.3, 0.3, (3, 4 * 3)).astype('float32')
+    b = rng.uniform(-0.3, 0.3, (1, 4 * 3)).astype('float32')
+    lod = [[0, 2, 5]]
+
+    class C(OpTest):
+        def setup(self):
+            self.op_type = 'lstm'
+            self.inputs = {'Input': (x, lod), 'Weight': w, 'Bias': b}
+            hid, cell = np_lstm_ref(x, w, b, lod, False)
+            self.outputs = {'Hidden': (hid.astype('float32'), lod)}
+            self.attrs = {'use_peepholes': False}
+    C().check_grad(['Input', 'Weight'], ['Hidden'],
+                   max_relative_error=0.02)
+
+
+def np_gru_ref(x, w, b, lod, origin_mode=False):
+    offsets = lod[0]
+    d = w.shape[0]
+    hidden = np.zeros((x.shape[0], d))
+    for s in range(len(offsets) - 1):
+        h = np.zeros(d)
+        for p in range(offsets[s], offsets[s + 1]):
+            xur = x[p, :2 * d] + b[0, :2 * d]
+            xc = x[p, 2 * d:] + b[0, 2 * d:]
+            ur = sigmoid(xur + h @ w[:, :2 * d])
+            u, r = ur[:d], ur[d:]
+            c = np.tanh(xc + (r * h) @ w[:, 2 * d:])
+            h = u * h + (1 - u) * c if origin_mode else (1 - u) * h + u * c
+            hidden[p] = h
+    return hidden
+
+
+@pytest.mark.parametrize('origin_mode', [False, True])
+def test_gru_op(origin_mode):
+    rng = np.random.RandomState(7)
+    x = rng.uniform(-0.5, 0.5, (T, 3 * D)).astype('float32')
+    w = rng.uniform(-0.5, 0.5, (D, 3 * D)).astype('float32')
+    b = rng.uniform(-0.5, 0.5, (1, 3 * D)).astype('float32')
+    hid = np_gru_ref(x.astype('float64'), w.astype('float64'),
+                     b.astype('float64'), LOD, origin_mode)
+
+    class C(OpTest):
+        def setup(self):
+            self.op_type = 'gru'
+            self.inputs = {'Input': (x, LOD), 'Weight': w, 'Bias': b}
+            self.outputs = {'Hidden': (hid.astype('float32'), LOD)}
+            self.attrs = {'origin_mode': origin_mode}
+    C().check_output(atol=1e-4)
+
+
+def test_gru_grad():
+    rng = np.random.RandomState(8)
+    x = rng.uniform(-0.3, 0.3, (5, 3 * 3)).astype('float32')
+    w = rng.uniform(-0.3, 0.3, (3, 3 * 3)).astype('float32')
+    b = rng.uniform(-0.3, 0.3, (1, 3 * 3)).astype('float32')
+    lod = [[0, 2, 5]]
+
+    class C(OpTest):
+        def setup(self):
+            self.op_type = 'gru'
+            self.inputs = {'Input': (x, lod), 'Weight': w, 'Bias': b}
+            self.outputs = {'Hidden': (np_gru_ref(x, w, b, lod)
+                                       .astype('float32'), lod)}
+            self.attrs = {}
+    C().check_grad(['Input', 'Weight'], ['Hidden'],
+                   max_relative_error=0.02)
+
+
+def test_gru_unit_op():
+    rng = np.random.RandomState(9)
+    n, d = 4, 5
+    x = rng.uniform(-0.5, 0.5, (n, 3 * d)).astype('float32')
+    hp = rng.uniform(-0.5, 0.5, (n, d)).astype('float32')
+    w = rng.uniform(-0.5, 0.5, (d, 3 * d)).astype('float32')
+    b = rng.uniform(-0.5, 0.5, (1, 3 * d)).astype('float32')
+
+    ur = sigmoid(x[:, :2*d] + b[0, :2*d] + hp @ w[:, :2*d])
+    u, r = ur[:, :d], ur[:, d:]
+    c = np.tanh(x[:, 2*d:] + b[0, 2*d:] + (r * hp) @ w[:, 2*d:])
+    h = (1 - u) * hp + u * c
+
+    class C(OpTest):
+        def setup(self):
+            self.op_type = 'gru_unit'
+            self.inputs = {'Input': x, 'HiddenPrev': hp, 'Weight': w,
+                           'Bias': b}
+            self.outputs = {'Hidden': h.astype('float32')}
+            self.attrs = {'activation': 2, 'gate_activation': 1}
+    C().check_output(atol=1e-5)
+    C().check_grad(['Input', 'HiddenPrev', 'Weight'], ['Hidden'],
+                   max_relative_error=0.02)
+
+
+def test_lstm_unit_op():
+    rng = np.random.RandomState(10)
+    n, d = 3, 4
+    x = rng.uniform(-0.5, 0.5, (n, 4 * d)).astype('float32')
+    cp = rng.uniform(-0.5, 0.5, (n, d)).astype('float32')
+    fb = 1.0
+    i, f, o, j = x[:, :d], x[:, d:2*d], x[:, 2*d:3*d], x[:, 3*d:]
+    c = cp * sigmoid(f + fb) + sigmoid(i) * np.tanh(j)
+    h = np.tanh(c) * sigmoid(o)
+
+    class C(OpTest):
+        def setup(self):
+            self.op_type = 'lstm_unit'
+            self.inputs = {'X': x, 'C_prev': cp}
+            self.outputs = {'C': c.astype('float32'),
+                            'H': h.astype('float32')}
+            self.attrs = {'forget_bias': fb}
+    C().check_output(atol=1e-5)
+    C().check_grad(['X', 'C_prev'], ['H'], max_relative_error=0.02)
+
+
+def test_row_conv_op():
+    rng = np.random.RandomState(11)
+    x = rng.uniform(-0.5, 0.5, (T, D)).astype('float32')
+    context = 3
+    filt = rng.uniform(-0.5, 0.5, (context, D)).astype('float32')
+    out = np.zeros_like(x)
+    for a, bnd in zip(LOD[0][:-1], LOD[0][1:]):
+        for p in range(a, bnd):
+            for j in range(context):
+                if p + j < bnd:
+                    out[p] += x[p + j] * filt[j]
+
+    class C(OpTest):
+        def setup(self):
+            self.op_type = 'row_conv'
+            self.inputs = {'X': (x, LOD), 'Filter': filt}
+            self.outputs = {'Out': (out, LOD)}
+            self.attrs = {}
+    C().check_output(atol=1e-5)
+    C().check_grad(['X', 'Filter'], ['Out'], max_relative_error=0.02)
+
+
+def test_dynamic_lstm_layer_trains():
+    """End-to-end: embedding -> fc -> dynamic_lstm -> last step -> fc,
+    loss decreases (the reference book sentiment-lstm shape)."""
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        words = fluid.layers.data('words', shape=[1], dtype='int64',
+                                  lod_level=1)
+        label = fluid.layers.data('label', shape=[1], dtype='int64')
+        emb = fluid.layers.embedding(words, size=[30, 8])
+        proj = fluid.layers.fc(emb, size=4 * 8)
+        hidden, cell = fluid.layers.dynamic_lstm(proj, size=4 * 8)
+        last = fluid.layers.sequence_last_step(hidden)
+        logits = fluid.layers.fc(last, size=2, act='softmax')
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(logits, label))
+        fluid.optimizer.Adam(0.05).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    sc = fluid.Scope()
+    exe.run(startup, scope=sc)
+    rng = np.random.RandomState(0)
+    lens = [3, 4, 2]
+    losses = []
+    for it in range(30):
+        toks = rng.randint(0, 29, (sum(lens), 1)).astype('int64')
+        # label = parity-ish of each sequence's LAST token: visible to the
+        # final hidden state without long memory
+        labs = np.array([int(toks[2, 0] < 15), int(toks[6, 0] < 15),
+                         int(toks[8, 0] < 15)], dtype='int64').reshape(-1, 1)
+        lv, = exe.run(prog, feed={'words': (toks, [lens]), 'label': labs},
+                      fetch_list=[loss], scope=sc)
+        losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    assert np.mean(losses[-5:]) < np.mean(losses[:5]), \
+        "lstm model did not learn"
+
+
+def test_dynamic_gru_layer_runs():
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data('x', shape=[6], dtype='float32', lod_level=1)
+        proj = fluid.layers.fc(x, size=3 * 5)
+        hidden = fluid.layers.dynamic_gru(proj, size=5)
+        pooled = fluid.layers.sequence_pool(hidden, 'average')
+    exe = fluid.Executor(fluid.CPUPlace())
+    sc = fluid.Scope()
+    exe.run(startup, scope=sc)
+    xv = np.random.RandomState(1).randn(7, 6).astype('float32')
+    out, = exe.run(prog, feed={'x': (xv, [[0, 3, 7]])},
+                   fetch_list=[pooled], scope=sc)
+    assert out.shape == (2, 5) and np.isfinite(out).all()
+
+
+def np_lstmp_ref(x, w, proj_w, b, lod):
+    """LSTMP: recurrent state is the projection (P); Weight is (P, 4D),
+    ProjWeight (D, P). No peepholes for the test."""
+    offsets = lod[0]
+    d = w.shape[1] // 4
+    p_dim = w.shape[0]
+    bg = b[0, :4 * d]
+    proj = np.zeros((x.shape[0], p_dim))
+    cell = np.zeros((x.shape[0], d))
+    for s in range(len(offsets) - 1):
+        h = np.zeros(p_dim)
+        c = np.zeros(d)
+        for t in range(offsets[s], offsets[s + 1]):
+            g = x[t] + bg + h @ w
+            gc, gi, gf, go = g[:d], g[d:2*d], g[2*d:3*d], g[3*d:4*d]
+            cand = np.tanh(gc)
+            i, f = sigmoid(gi), sigmoid(gf)
+            c = cand * i + c * f
+            o = sigmoid(go)
+            hd = o * np.tanh(c)
+            h = np.tanh(hd @ proj_w)
+            proj[t] = h
+            cell[t] = c
+    return proj, cell
+
+
+def test_lstmp_op():
+    rng = np.random.RandomState(21)
+    d, p = 4, 3
+    x = rng.uniform(-0.5, 0.5, (T, 4 * d)).astype('float32')
+    w = rng.uniform(-0.5, 0.5, (p, 4 * d)).astype('float32')
+    proj_w = rng.uniform(-0.5, 0.5, (d, p)).astype('float32')
+    b = rng.uniform(-0.5, 0.5, (1, 4 * d)).astype('float32')
+    proj, cell = np_lstmp_ref(x.astype('float64'), w.astype('float64'),
+                              proj_w.astype('float64'),
+                              b.astype('float64'), LOD)
+
+    class C(OpTest):
+        def setup(self):
+            self.op_type = 'lstmp'
+            self.inputs = {'Input': (x, LOD), 'Weight': w,
+                           'ProjWeight': proj_w, 'Bias': b}
+            self.outputs = {'Projection': (proj.astype('float32'), LOD),
+                            'Cell': (cell.astype('float32'), LOD)}
+            self.attrs = {'use_peepholes': False}
+    C().check_output(atol=1e-4)
+
+
+def test_dynamic_lstmp_layer_runs():
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data('x', shape=[6], dtype='float32', lod_level=1)
+        fcx = fluid.layers.fc(x, size=4 * 8)
+        proj, cell = fluid.layers.dynamic_lstmp(fcx, size=4 * 8, proj_size=3)
+    exe = fluid.Executor(fluid.CPUPlace())
+    sc = fluid.Scope()
+    exe.run(startup, scope=sc)
+    xv = np.random.RandomState(2).randn(7, 6).astype('float32')
+    out, = exe.run(prog, feed={'x': (xv, [[0, 3, 7]])}, fetch_list=[proj],
+                   scope=sc)
+    assert out.shape == (7, 3) and np.isfinite(out).all()
