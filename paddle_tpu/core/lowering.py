@@ -259,6 +259,11 @@ def analyze_state(program, fetch_names=()):
             names = list(op.input_arg_names)
             if op.type == 'backward':
                 names += list(op.attr('wrt_names'))
+            # a var written inside a control-flow sub-block is carried as
+            # read-modify-write state (the untaken branch / iteration 0
+            # keeps its prior value), so it counts as read too
+            if block.idx != 0:
+                names += list(op.output_arg_names)
             for n in names:
                 if _persistable(block, n) and n not in read_set:
                     read_set.add(n)

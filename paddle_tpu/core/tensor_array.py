@@ -1,0 +1,90 @@
+"""TensorArray: the TPU-native LOD_TENSOR_ARRAY (reference
+framework/lod_tensor_array.h — a std::vector<LoDTensor> variable written by
+write_to_array / read by read_from_array inside While loops).
+
+Under XLA every shape must be static, so a TensorArray is a fixed-capacity
+stacked buffer plus a dynamic length counter, registered as a JAX pytree so
+it can ride through lax.while_loop / lax.scan carries unchanged. This is the
+standard trace-friendly TensorArray design (cf. lax.dynamic_update_index and
+scan-stacked carries), replacing the reference's grow-on-write vector
+(operators/controlflow/tensor_array_read_write_op.cc).
+"""
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@jax.tree_util.register_pytree_node_class
+class TensorArray(object):
+    """Fixed-capacity stacked array of same-shaped tensors.
+
+    buffer: [capacity, *elem_shape]; length: int32 scalar (may be traced).
+    """
+
+    __slots__ = ('buffer', 'length')
+
+    def __init__(self, buffer, length):
+        self.buffer = buffer
+        self.length = length
+
+    # -- pytree protocol ---------------------------------------------------
+    def tree_flatten(self):
+        return (self.buffer, self.length), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    # -- construction ------------------------------------------------------
+    @classmethod
+    def empty(cls, capacity, elem_shape, dtype='float32'):
+        buf = jnp.zeros((int(capacity),) + tuple(int(d) for d in elem_shape),
+                        dtype=dtype)
+        return cls(buf, jnp.asarray(0, jnp.int32))
+
+    @classmethod
+    def from_list(cls, tensors, capacity=None):
+        stacked = jnp.stack(tensors, axis=0)
+        n = stacked.shape[0]
+        if capacity is not None and int(capacity) > n:
+            pad = [(0, int(capacity) - n)] + [(0, 0)] * (stacked.ndim - 1)
+            stacked = jnp.pad(stacked, pad)
+        return cls(stacked, jnp.asarray(n, jnp.int32))
+
+    # -- ops ---------------------------------------------------------------
+    @property
+    def capacity(self):
+        return self.buffer.shape[0]
+
+    @property
+    def elem_shape(self):
+        return self.buffer.shape[1:]
+
+    def write(self, i, value):
+        """Write value at index i (int or traced scalar); length becomes
+        max(length, i+1) — reference write_to_array appends/overwrites."""
+        i = jnp.asarray(i, jnp.int32).reshape(())
+        value = jnp.asarray(value, self.buffer.dtype)
+        buf = lax.dynamic_update_index_in_dim(
+            self.buffer, value, i, axis=0)
+        new_len = jnp.maximum(self.length, i + 1)
+        return TensorArray(buf, new_len)
+
+    def read(self, i):
+        i = jnp.asarray(i, jnp.int32).reshape(())
+        return lax.dynamic_index_in_dim(self.buffer, i, axis=0,
+                                        keepdims=False)
+
+    def stack(self):
+        """[capacity, ...] buffer; valid prefix is [:length]."""
+        return self.buffer
+
+    def masked_stack(self, fill=0):
+        idx = jnp.arange(self.capacity)
+        mask = (idx < self.length).reshape(
+            (self.capacity,) + (1,) * (self.buffer.ndim - 1))
+        return jnp.where(mask, self.buffer, fill)
+
+
+def is_tensor_array(x):
+    return isinstance(x, TensorArray)

@@ -353,17 +353,33 @@ class Executor(object):
         from the scope."""
         first_write = {}
         first_read = {}
-        idx = 0
-        for block in program.blocks:
+        # walk ops in EXECUTION order: sub-block ops are visited at their
+        # parent control-flow op's position (a later top-level op must get
+        # a later index than reads inside an earlier while/cond body)
+        counter = [0]
+
+        def _walk(block, in_sub):
             for op in block.ops:
+                idx = counter[0]
+                counter[0] += 1
                 names_in = list(op.input_arg_names)
                 if op.type == 'backward':
                     names_in += list(op.attr('wrt_names'))
+                # writes inside control-flow sub-blocks are conditional:
+                # the var's prior value may survive (untaken branch /
+                # zero-trip loop), so they count as reads as well
+                if in_sub:
+                    names_in += list(op.output_arg_names)
                 for n in names_in:
                     first_read.setdefault(n, idx)
                 for n in op.output_arg_names:
                     first_write.setdefault(n, idx)
-                idx += 1
+                sub = op.attr('sub_block', None)
+                if sub is not None:
+                    _walk(program.block(int(sub)), True)
+
+        _walk(program.global_block(), False)
+        idx = counter[0]
         for n in fetch_names:
             first_read.setdefault(n, idx)
         needed = []

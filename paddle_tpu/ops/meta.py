@@ -133,7 +133,14 @@ def _clip_by_norm(ctx, op):
 def _print(ctx, op):
     x = ctx.in1(op, 'X')
     message = op.attr('message', '')
-    jax.debug.print(message + " {}", x)
+    # jax.debug.print needs host-callback support; backends without it
+    # (e.g. the axon PJRT tunnel) get a passthrough instead of a crash
+    try:
+        supports_cb = jax.default_backend() in ('cpu', 'tpu', 'gpu')
+    except Exception:
+        supports_cb = False
+    if supports_cb:
+        jax.debug.print(message + " {}", x)
     ctx.out(op, 'Out', x)
 
 

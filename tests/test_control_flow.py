@@ -1,0 +1,331 @@
+"""Control-flow subsystem tests: While/TensorArray, StaticRNN, DynamicRNN,
+ConditionalBlock/Switch, IfElse, beam search (+ grad flow through scan).
+
+Mirrors reference tests test_while_op.py, test_recurrent_op.py,
+test_dyn_rnn.py, test_switch.py, test_ifelse.py, test_beam_search_op.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _exe():
+    return fluid.Executor()
+
+
+def test_while_counter_and_array():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        i = layers.fill_constant(shape=[1], dtype='int64', value=0)
+        n = layers.fill_constant(shape=[1], dtype='int64', value=5)
+        acc = layers.fill_constant(shape=[1], dtype='float32', value=0.0)
+        arr = layers.create_array('float32', capacity=8)
+        cond = layers.less_than(i, n)
+        w = layers.While(cond)
+        with w.block():
+            nxt = layers.elementwise_add(
+                acc, layers.fill_constant([1], 'float32', 2.0))
+            layers.assign(nxt, acc)
+            arr = layers.array_write(acc, i, array=arr)
+            layers.increment(i, value=1, in_place=True)
+            layers.less_than(i, n, cond=cond)
+        length = layers.array_length(arr)
+        third = layers.array_read(arr, layers.fill_constant([], 'int32', 2))
+    exe = _exe()
+    exe.run(startup)
+    acc_v, len_v, third_v = exe.run(
+        main, fetch_list=[acc, length, third])
+    assert np.allclose(acc_v, 10.0)
+    assert len_v[0] == 5
+    assert np.allclose(third_v, 6.0)     # writes: 2,4,6,8,10
+
+
+def test_while_nested_in_program_grads_not_required():
+    # while in inference-style program alongside other ops
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name='x', shape=[4], append_batch_size=False)
+        i = layers.fill_constant(shape=[1], dtype='int64', value=0)
+        n = layers.fill_constant(shape=[1], dtype='int64', value=3)
+        s = layers.fill_constant(shape=[4], dtype='float32', value=0.0)
+        cond = layers.less_than(i, n)
+        w = layers.While(cond)
+        with w.block():
+            layers.assign(layers.elementwise_add(s, x), s)
+            layers.increment(i, value=1, in_place=True)
+            layers.less_than(i, n, cond=cond)
+    exe = _exe()
+    exe.run(startup)
+    xv = np.arange(4).astype('float32')
+    s_v, = exe.run(main, feed={'x': xv}, fetch_list=[s])
+    assert np.allclose(s_v, 3 * xv)
+
+
+def test_static_rnn_forward():
+    T, N, D = 3, 2, 4
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name='x', shape=[T, N, D], append_batch_size=False)
+        h0 = layers.data(name='h0', shape=[N, D], append_batch_size=False)
+        rnn = layers.StaticRNN()
+        with rnn.step():
+            x_t = rnn.step_input(x)
+            h_prev = rnn.memory(init=h0)
+            h = layers.elementwise_add(layers.scale(h_prev, scale=2.0), x_t)
+            rnn.update_memory(h_prev, h)
+            rnn.step_output(h)
+        out = rnn()
+    exe = _exe()
+    exe.run(startup)
+    xv = np.random.RandomState(0).rand(T, N, D).astype('float32')
+    h0v = np.random.RandomState(1).rand(N, D).astype('float32')
+    o, = exe.run(main, feed={'x': xv, 'h0': h0v}, fetch_list=[out])
+    h, ref = h0v, []
+    for t in range(T):
+        h = h * 2 + xv[t]
+        ref.append(h)
+    assert np.allclose(o, np.stack(ref), atol=1e-5)
+
+
+def test_static_rnn_memory_batch_ref():
+    T, N, D = 4, 3, 5
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name='x', shape=[T, N, D], append_batch_size=False)
+        rnn = layers.StaticRNN()
+        with rnn.step():
+            x_t = rnn.step_input(x)
+            h_prev = rnn.memory(shape=[D], batch_ref=x, value=0.0,
+                                ref_batch_dim_idx=1)
+            h = layers.elementwise_add(h_prev, x_t)
+            rnn.update_memory(h_prev, h)
+            rnn.step_output(h)
+        out = rnn()
+    exe = _exe()
+    exe.run(startup)
+    xv = np.random.rand(T, N, D).astype('float32')
+    o, = exe.run(main, feed={'x': xv}, fetch_list=[out])
+    assert np.allclose(o, np.cumsum(xv, axis=0), atol=1e-5)
+
+
+def test_static_rnn_trains():
+    """Gradients flow through lax.scan: loss decreases over SGD steps."""
+    T, N, D = 5, 4, 8
+    rng = np.random.RandomState(42)
+    xv = rng.rand(T, N, D).astype('float32')
+    yv = rng.rand(N, D).astype('float32')
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name='x', shape=[T, N, D], append_batch_size=False)
+        y = layers.data(name='y', shape=[N, D], append_batch_size=False)
+        rnn = layers.StaticRNN()
+        with rnn.step():
+            x_t = rnn.step_input(x)
+            h_prev = rnn.memory(shape=[D], batch_ref=x, value=0.0,
+                                ref_batch_dim_idx=1)
+            h = layers.fc(input=[x_t, h_prev], size=D, act='tanh')
+            rnn.update_memory(h_prev, h)
+            rnn.step_output(h)
+        seq = rnn()
+        last = layers.slice(seq, axes=[0], starts=[T - 1], ends=[T])
+        last = layers.reshape(last, shape=[N, D])
+        loss = layers.reduce_mean(layers.square_error_cost(last, y))
+        fluid.optimizer.SGD(learning_rate=0.5).minimize(loss)
+    exe = _exe()
+    exe.run(startup)
+    losses = []
+    for _ in range(15):
+        l, = exe.run(main, feed={'x': xv, 'y': yv}, fetch_list=[loss])
+        losses.append(float(l))
+    assert losses[-1] < losses[0] * 0.7, losses
+
+
+def test_dynamic_rnn_ragged_cumsum():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name='x', shape=[4], lod_level=1)
+        drnn = layers.DynamicRNN()
+        with drnn.block():
+            x_t = drnn.step_input(x)
+            mem = drnn.memory(shape=[4], value=0.0)
+            h = layers.elementwise_add(mem, x_t)
+            drnn.update_memory(mem, h)
+            drnn.output(h)
+        out = drnn()
+    exe = _exe()
+    exe.run(startup)
+    xv = np.random.rand(6, 4).astype('float32')
+    lod = [[0, 3, 4, 6]]
+    o, = exe.run(main, feed={'x': (xv, lod)}, fetch_list=[out])
+    ref = np.concatenate([np.cumsum(xv[0:3], 0),
+                          np.cumsum(xv[3:4], 0),
+                          np.cumsum(xv[4:6], 0)])
+    assert np.allclose(o, ref, atol=1e-5)
+    assert list(o.lod()[0]) == [0, 3, 4, 6]
+
+
+def test_dynamic_rnn_with_fc_trains():
+    rng = np.random.RandomState(7)
+    xv = rng.rand(7, 6).astype('float32')
+    lod = [[0, 2, 5, 7]]
+    yv = rng.rand(3, 8).astype('float32')
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name='x', shape=[6], lod_level=1)
+        y = layers.data(name='y', shape=[3, 8], append_batch_size=False)
+        drnn = layers.DynamicRNN()
+        with drnn.block():
+            x_t = drnn.step_input(x)
+            mem = drnn.memory(shape=[8], value=0.0)
+            h = layers.fc(input=[x_t, mem], size=8, act='tanh')
+            drnn.update_memory(mem, h)
+            drnn.output(h)
+        out = drnn()
+        last = layers.sequence_last_step(out)
+        loss = layers.reduce_mean(layers.square_error_cost(last, y))
+        fluid.optimizer.SGD(learning_rate=0.5).minimize(loss)
+    exe = _exe()
+    exe.run(startup)
+    losses = []
+    for _ in range(12):
+        l, = exe.run(main, feed={'x': (xv, lod), 'y': yv},
+                     fetch_list=[loss])
+        losses.append(float(l))
+    assert losses[-1] < losses[0] * 0.8, losses
+
+
+def test_switch_piecewise():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        step = layers.data(name='step', shape=[1], append_batch_size=False)
+        lr = layers.create_global_var(shape=[1], value=0.0, dtype='float32',
+                                      persistable=True, name='lr_sw')
+        b1 = layers.fill_constant([1], 'float32', 5.0)
+        b2 = layers.fill_constant([1], 'float32', 10.0)
+        with layers.Switch() as switch:
+            with switch.case(layers.less_than(step, b1)):
+                layers.assign(layers.fill_constant([1], 'float32', 1.0), lr)
+            with switch.case(layers.less_than(step, b2)):
+                layers.assign(layers.fill_constant([1], 'float32', 0.5), lr)
+            with switch.default():
+                layers.assign(layers.fill_constant([1], 'float32', 0.1), lr)
+    exe = _exe()
+    exe.run(startup)
+    for sv, expect in [(3.0, 1.0), (7.0, 0.5), (20.0, 0.1)]:
+        o, = exe.run(main, feed={'step': np.array([sv], 'float32')},
+                     fetch_list=[lr])
+        assert np.allclose(o, expect), (sv, o)
+
+
+def test_conditional_block_scalar():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        flag = layers.data(name='flag', shape=[1], dtype='bool',
+                           append_batch_size=False)
+        out = layers.create_global_var(shape=[2], value=-1.0,
+                                       dtype='float32', persistable=True,
+                                       name='cb_out')
+        cb = layers.ConditionalBlock([flag], is_scalar_condition=True)
+        with cb.block():
+            layers.assign(layers.fill_constant([2], 'float32', 7.0), out)
+    exe = _exe()
+    exe.run(startup)
+    o, = exe.run(main, feed={'flag': np.array([True])}, fetch_list=[out])
+    assert np.allclose(o, 7.0)
+    # reset then false branch keeps value
+    exe.run(startup)
+    o, = exe.run(main, feed={'flag': np.array([False])}, fetch_list=[out])
+    assert np.allclose(o, -1.0)
+
+
+def test_ifelse_rowwise():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name='x', shape=[4, 1], append_batch_size=False)
+        cond = layers.greater_than(
+            x, layers.fill_constant([4, 1], 'float32', 0.0))
+        ie = layers.IfElse(cond)
+        with ie.true_block():
+            d = ie.input(x)
+            ie.output(layers.scale(d, scale=2.0))
+        with ie.false_block():
+            d = ie.input(x)
+            ie.output(layers.scale(d, scale=-1.0))
+        out = ie()
+    exe = _exe()
+    exe.run(startup)
+    xv = np.array([[1.], [-2.], [3.], [-4.]], 'float32')
+    o, = exe.run(main, feed={'x': xv}, fetch_list=[out])
+    assert np.allclose(o, np.where(xv > 0, xv * 2, -xv))
+
+
+def test_beam_search_step():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        pre_ids = layers.data(name='pre_ids', shape=[4, 1],
+                              append_batch_size=False, dtype='int64')
+        pre_scores = layers.data(name='pre_scores', shape=[4, 1],
+                                 append_batch_size=False)
+        ids = layers.data(name='ids', shape=[4, 3],
+                          append_batch_size=False, dtype='int64')
+        scores = layers.data(name='scores', shape=[4, 3],
+                             append_batch_size=False)
+        sid, ssc, par = layers.beam_search(
+            pre_ids, pre_scores, ids, scores, beam_size=2, end_id=0)
+    exe = _exe()
+    exe.run(startup)
+    # batch=2, beam=2; batch 1's beam 1 is finished (pre_id==0)
+    o = exe.run(main, feed={
+        'pre_ids': np.array([[5], [6], [7], [0]], 'int64'),
+        'pre_scores': np.array([[-1.], [-2.], [-1.], [-0.5]], 'float32'),
+        'ids': np.tile(np.array([[1, 2, 3]], 'int64'), (4, 1)),
+        'scores': np.array([[-1.5, -2.5, -9.], [-2.1, -2.2, -9.],
+                            [-3.0, -1.2, -9.], [-4.0, -4.1, -9.]],
+                           'float32'),
+    }, fetch_list=[sid, ssc, par])
+    sel_ids, sel_scores, parents = o
+    # batch 0: best two are -1.5 (beam0,tok1), -2.1 (beam1,tok1)
+    assert list(sel_ids.ravel()[:2]) == [1, 1]
+    assert list(parents[:2]) == [0, 1]
+    # batch 1: finished beam survives with end_id and its pre_score -0.5,
+    # then beam0's best candidate -1.2 (tok 2)
+    assert list(sel_ids.ravel()[2:]) == [0, 2]
+    assert np.allclose(sel_scores.ravel()[2:], [-0.5, -1.2])
+    assert list(parents[2:]) == [3, 2]
+
+
+def test_beam_search_decode_backtrack():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids_arr = layers.create_array('int64', capacity=4)
+        par_arr = layers.create_array('int32', capacity=4)
+        sc_arr = layers.create_array('float32', capacity=4)
+        # two steps, batch=1 beam=2:
+        # step0 picks tokens [3, 4]; step1 tokens [5, 6] with parents [1, 0]
+        i0 = layers.fill_constant([], 'int32', 0)
+        i1 = layers.fill_constant([], 'int32', 1)
+        t0 = layers.assign(np.array([[3], [4]], 'int64'))
+        t1 = layers.assign(np.array([[5], [6]], 'int64'))
+        p0 = layers.assign(np.array([0, 1], 'int32'))
+        p1 = layers.assign(np.array([1, 0], 'int32'))
+        s0 = layers.assign(np.array([[-1.], [-2.]], 'float32'))
+        s1 = layers.assign(np.array([[-3.], [-4.]], 'float32'))
+        ids_arr = layers.array_write(t0, i0, ids_arr)
+        ids_arr = layers.array_write(t1, i1, ids_arr)
+        par_arr = layers.array_write(p0, i0, par_arr)
+        par_arr = layers.array_write(p1, i1, par_arr)
+        sc_arr = layers.array_write(s0, i0, sc_arr)
+        sc_arr = layers.array_write(s1, i1, sc_arr)
+        sent_ids, sent_scores = layers.beam_search_decode(
+            ids_arr, sc_arr, par_arr, beam_size=2, end_id=0)
+    exe = _exe()
+    exe.run(startup)
+    si, ss = exe.run(main, fetch_list=[sent_ids, sent_scores])
+    # beam 0 at step1 came from parent 1 -> tokens [4, 5]
+    # beam 1 at step1 came from parent 0 -> tokens [3, 6]
+    assert list(si[0, 0, :2]) == [4, 5]
+    assert list(si[0, 1, :2]) == [3, 6]
+    assert np.allclose(ss[0], [-3., -4.])
