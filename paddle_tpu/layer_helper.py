@@ -122,6 +122,14 @@ class LayerHelper(object):
             init(sp, start_gb)
         return param
 
+    def get_parameter(self, name):
+        """Look up an existing parameter by name in the main program's
+        global block (reference layer_helper get_parameter)."""
+        param = self.main_program.global_block().var(name)
+        if param is None:
+            raise ValueError("parameter %r not found" % name)
+        return param
+
     def create_variable_for_type_inference(self, dtype, shape=None,
                                            stop_gradient=False):
         return self.main_block.create_var(

@@ -2,7 +2,7 @@
 from ..layer_helper import LayerHelper
 from ..initializer import Constant
 
-__all__ = ['accuracy', 'auc']
+__all__ = ['accuracy', 'auc', 'chunk_eval']
 
 
 def accuracy(input, label, k=1, correct=None, total=None):
@@ -55,3 +55,32 @@ def auc(input, label, curve='ROC', num_thresholds=2 ** 12 - 1, topk=1,
                  'StatNegOut': [stat_neg]},
         attrs={'curve': curve, 'num_thresholds': num_thresholds})
     return auc_out, [stat_pos, stat_neg]
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,
+               excluded_chunk_types=None):
+    """Chunk-level precision/recall/F1 for sequence labeling (reference
+    layers/metric_op.py chunk_eval / chunk_eval_op.cc). Schemes: IOB, IOE,
+    IOBES, plain; tag id = chunk_type * num_tag_types + tag_type, O is
+    num_chunk_types * num_tag_types. Returns (precision, recall, f1,
+    num_infer_chunks, num_label_chunks, num_correct_chunks)."""
+    helper = LayerHelper('chunk_eval')
+    precision = helper.create_variable_for_type_inference(dtype='float32')
+    recall = helper.create_variable_for_type_inference(dtype='float32')
+    f1_score = helper.create_variable_for_type_inference(dtype='float32')
+    num_infer_chunks = helper.create_variable_for_type_inference('int64')
+    num_label_chunks = helper.create_variable_for_type_inference('int64')
+    num_correct_chunks = helper.create_variable_for_type_inference('int64')
+    helper.append_op(
+        type='chunk_eval',
+        inputs={'Inference': [input], 'Label': [label]},
+        outputs={'Precision': [precision], 'Recall': [recall],
+                 'F1-Score': [f1_score],
+                 'NumInferChunks': [num_infer_chunks],
+                 'NumLabelChunks': [num_label_chunks],
+                 'NumCorrectChunks': [num_correct_chunks]},
+        attrs={'num_chunk_types': num_chunk_types,
+               'chunk_scheme': chunk_scheme,
+               'excluded_chunk_types': excluded_chunk_types or []})
+    return (precision, recall, f1_score, num_infer_chunks,
+            num_label_chunks, num_correct_chunks)

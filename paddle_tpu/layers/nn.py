@@ -34,7 +34,8 @@ __all__ = [
     'sums_', 'logical_and', 'logical_or', 'logical_xor', 'logical_not',
     'where', 'sign', 'gather_nd', 'random_crop', 'mean_iou', 'hash',
     'grid_sampler', 'teacher_student_sigmoid_loss', 'selu', 'swish',
-    'sharding_constraint',
+    'sharding_constraint', 'linear_chain_crf', 'crf_decoding', 'warpctc',
+    'ctc_greedy_decoder', 'edit_distance',
 ]
 
 
@@ -1430,3 +1431,97 @@ def grid_sampler(x, grid, name=None):
     raise NotImplementedError(
         "grid_sampler: planned for the detection wave "
         "(reference operators/grid_sampler_op.cc)")
+
+
+def linear_chain_crf(input, label, param_attr=None, name=None):
+    """Linear-chain CRF negative log-likelihood (reference layers/nn.py
+    linear_chain_crf / linear_chain_crf_op.cc). `input` is the ragged
+    emission [total, n_tags] with LoD; creates the Transition parameter
+    [n_tags + 2, n_tags] (rows: start, end, transition matrix). Returns
+    the per-sequence cost [num_seqs, 1]; minimize its mean."""
+    helper = LayerHelper('linear_chain_crf', param_attr=param_attr,
+                         name=name)
+    size = input.shape[-1]
+    transition = helper.create_parameter(
+        attr=helper.param_attr, shape=[size + 2, size], dtype=input.dtype)
+    alpha = helper.create_variable_for_type_inference(dtype=input.dtype)
+    emission_exps = helper.create_variable_for_type_inference(
+        dtype=input.dtype)
+    transition_exps = helper.create_variable_for_type_inference(
+        dtype=input.dtype)
+    log_likelihood = helper.create_variable_for_type_inference(
+        dtype=input.dtype)
+    helper.append_op(
+        type='linear_chain_crf',
+        inputs={'Emission': [input], 'Transition': [transition],
+                'Label': [label]},
+        outputs={'Alpha': [alpha], 'EmissionExps': [emission_exps],
+                 'TransitionExps': [transition_exps],
+                 'LogLikelihood': [log_likelihood]})
+    return log_likelihood
+
+
+def crf_decoding(input, param_attr, label=None, name=None):
+    """Viterbi decode with a trained CRF Transition parameter (reference
+    crf_decoding_op.cc). With `label`, returns the 0/1 correctness mask."""
+    helper = LayerHelper('crf_decoding', param_attr=param_attr, name=name)
+    transition = helper.get_parameter(helper.param_attr.name)
+    viterbi_path = helper.create_variable_for_type_inference(dtype='int64')
+    inputs = {'Emission': [input], 'Transition': [transition]}
+    if label is not None:
+        inputs['Label'] = [label]
+    helper.append_op(type='crf_decoding', inputs=inputs,
+                     outputs={'ViterbiPath': [viterbi_path]})
+    return viterbi_path
+
+
+def warpctc(input, label, blank=0, norm_by_times=False):
+    """CTC loss on unnormalized ragged logits (reference warpctc_op.cc —
+    softmax applied internally). Returns per-sequence loss [num_seqs, 1]."""
+    helper = LayerHelper('warpctc')
+    loss_out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(
+        type='warpctc', inputs={'Logits': [input], 'Label': [label]},
+        outputs={'Loss': [loss_out]},
+        attrs={'blank': blank, 'norm_by_times': norm_by_times})
+    return loss_out
+
+
+def ctc_greedy_decoder(input, blank, name=None):
+    """Greedy CTC decode: per-step argmax then merge-repeats/strip-blanks
+    (reference layers/nn.py ctc_greedy_decoder = top_k + ctc_align). Output
+    keeps the input LoD; each sequence is left-justified with -1 padding
+    (static-shape adaptation of ctc_align_op.cc's shrinking output)."""
+    helper = LayerHelper('ctc_greedy_decoder', name=name)
+    _, topk_indices = topk(input, k=1)
+    out = helper.create_variable_for_type_inference(dtype='int64')
+    helper.append_op(type='ctc_align', inputs={'Input': [topk_indices]},
+                     outputs={'Output': [out]},
+                     attrs={'blank': blank, 'merge_repeated': True})
+    return out
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None):
+    """Levenshtein distance between ragged hyp/ref id sequences (reference
+    edit_distance_op.cc). Returns (distance [num_seqs, 1], seq_num)."""
+    helper = LayerHelper('edit_distance')
+    if ignored_tokens:
+        erased_in = helper.create_variable_for_type_inference(
+            dtype=input.dtype)
+        helper.append_op(type='sequence_erase', inputs={'X': [input]},
+                         outputs={'Out': [erased_in]},
+                         attrs={'tokens': list(ignored_tokens)})
+        input = erased_in
+        erased_lab = helper.create_variable_for_type_inference(
+            dtype=label.dtype)
+        helper.append_op(type='sequence_erase', inputs={'X': [label]},
+                         outputs={'Out': [erased_lab]},
+                         attrs={'tokens': list(ignored_tokens)})
+        label = erased_lab
+    out = helper.create_variable_for_type_inference(dtype='float32')
+    seq_num = helper.create_variable_for_type_inference(dtype='int64')
+    helper.append_op(type='edit_distance',
+                     inputs={'Hyps': [input], 'Refs': [label]},
+                     outputs={'Out': [out], 'SequenceNum': [seq_num]},
+                     attrs={'normalized': normalized})
+    return out, seq_num

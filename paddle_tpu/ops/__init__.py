@@ -17,4 +17,5 @@ from . import metrics_ops
 from . import sequence_ops
 from . import rnn_ops
 from . import control_flow_ops
+from . import crf_ctc_ops
 from . import detection_ops

@@ -1,0 +1,462 @@
+"""Structured-prediction op family: linear-chain CRF, Viterbi decoding,
+CTC loss/greedy decode, edit distance, chunk evaluation.
+
+Reference semantics (studied from the op definitions, not ported):
+- linear_chain_crf_op.cc/.h — Transition parameter [n+2, n]: row 0 start
+  weights, row 1 end weights, rows 2.. the [n, n] transition matrix
+  (from-tag major). Output LogLikelihood is the per-sequence NEGATIVE log
+  conditional likelihood (book label_semantic_roles minimizes its mean).
+  Reference runs a normalized linear-space forward pass; we run the same
+  recursion in log space with a lax.scan over padded [N, maxT] batches —
+  numerically safer and XLA-friendly — and let JAX AD produce the exact
+  marginal-difference gradient the reference hand-codes.
+- crf_decoding_op.cc — Viterbi; with Label given, emits the 0/1
+  per-position correctness mask instead of the path.
+- warpctc_op.cc — CTC loss on unnormalized logits (softmax inside);
+  per-sequence loss [num_seqs, 1]; norm_by_times divides by length. The
+  reference dynloads Baidu warp-ctc; we implement the standard log-space
+  alpha recursion (blank-extended labels) under lax.scan, gradient via AD
+  through log-softmax (identical to warp-ctc's analytic gradient).
+- ctc_align_op.cc — collapse repeats then drop blanks. The reference
+  shrinks the tensor (dynamic shape); under XLA the output keeps the input
+  LoD with each sequence left-justified and -1 padding (same information,
+  static shape) — consumers read tokens until the first -1.
+- edit_distance_op.cc — Levenshtein DP, optional normalization by ref len.
+- chunk_eval_op.cc — precision/recall/F1 over IOB/IOE/IOBES/plain chunk
+  schemes; id = chunk_type * num_tag_types + tag_type, O = num_chunk_types
+  * num_tag_types.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op
+from ..core.lod import lengths_from_offsets
+from .rnn_ops import _padded_maps, _to_padded, _to_ragged
+
+NEG = -1e9
+
+
+def _padded_from_lod(ctx, op, slot):
+    lod = ctx.in1_lod(op, slot)
+    if not lod:
+        raise ValueError("op %s input %s needs LoD (ragged sequences)"
+                         % (op.type, slot))
+    offsets = lod[-1]
+    gidx, sidx, n, maxt = _padded_maps(offsets)
+    lens = np.asarray(lengths_from_offsets(offsets), np.int32)
+    return offsets, gidx, sidx, n, maxt, lens
+
+
+# ---------------------------------------------------------------------------
+# linear_chain_crf
+# ---------------------------------------------------------------------------
+
+def _crf_unpack(transition):
+    return transition[0], transition[1], transition[2:]
+
+
+@register_op('linear_chain_crf')
+def _linear_chain_crf(ctx, op):
+    emission = ctx.in1(op, 'Emission')          # [total, n] ragged
+    transition = ctx.in1(op, 'Transition')      # [n+2, n]
+    label = ctx.in1(op, 'Label')                # [total, 1] ragged
+    offsets, gidx, sidx, n_seq, maxt, lens = _padded_from_lod(
+        ctx, op, 'Emission')
+    n_tag = emission.shape[-1]
+
+    e = _to_padded(emission, gidx, n_seq, maxt)             # [N, T, n]
+    y = _to_padded(label.reshape(-1), gidx, n_seq, maxt)    # [N, T]
+    y = y.astype('int32')
+    lens_j = jnp.asarray(lens)
+    w_start, w_end, w_trans = _crf_unpack(transition)
+
+    tm = e.swapaxes(0, 1)                                    # [T, N, n]
+    ym = y.swapaxes(0, 1)                                    # [T, N]
+    step_idx = jnp.arange(maxt)
+
+    # --- partition function: log-space forward recursion ----------------
+    alpha0 = w_start[None, :] + tm[0]                        # [N, n]
+
+    def fwd(alpha, xt):
+        e_t, t = xt
+        nxt = e_t + jax.scipy.special.logsumexp(
+            alpha[:, :, None] + w_trans[None, :, :], axis=1)
+        valid = (t < lens_j)[:, None]
+        alpha = jnp.where(valid, nxt, alpha)
+        return alpha, alpha
+
+    alpha_last, alphas = lax.scan(fwd, alpha0, (tm[1:], step_idx[1:]))
+    log_z = jax.scipy.special.logsumexp(alpha_last + w_end[None, :], axis=1)
+
+    # --- gold path score -------------------------------------------------
+    batch = jnp.arange(n_seq)
+    em_gold = jnp.take_along_axis(e, y[:, :, None], axis=2)[:, :, 0]  # [N,T]
+    t_mask = step_idx[None, :] < lens_j[:, None]
+    em_score = jnp.sum(jnp.where(t_mask, em_gold, 0.0), axis=1)
+    start_score = w_start[y[:, 0]]
+    last_y = y[batch, jnp.maximum(lens_j - 1, 0)]
+    end_score = w_end[last_y]
+    trans_pairs = w_trans[y[:, :-1], y[:, 1:]]               # [N, T-1]
+    pair_mask = step_idx[None, 1:] < lens_j[:, None]
+    trans_score = jnp.sum(jnp.where(pair_mask, trans_pairs, 0.0), axis=1)
+    gold = em_score + start_score + end_score + trans_score
+
+    nll = (log_z - gold).reshape(n_seq, 1)
+    ctx.out(op, 'LogLikelihood', nll)
+
+    # caches for reference-API parity
+    all_alphas = jnp.concatenate([alpha0[None], alphas], axis=0)  # [T, N, n]
+    ctx.out(op, 'Alpha', _to_ragged(all_alphas.swapaxes(0, 1), sidx))
+    ctx.set_lod(op.output('Alpha')[0], (offsets,))
+    ctx.out(op, 'EmissionExps', jnp.exp(emission))
+    ctx.out(op, 'TransitionExps', jnp.exp(transition))
+    ctx.lod_explicit.add(op.output('LogLikelihood')[0])
+
+
+# ---------------------------------------------------------------------------
+# crf_decoding (Viterbi)
+# ---------------------------------------------------------------------------
+
+@register_op('crf_decoding')
+def _crf_decoding(ctx, op):
+    emission = ctx.in1(op, 'Emission')
+    transition = ctx.in1(op, 'Transition')
+    label = ctx.in1(op, 'Label', None)
+    offsets, gidx, sidx, n_seq, maxt, lens = _padded_from_lod(
+        ctx, op, 'Emission')
+    lens_j = jnp.asarray(lens)
+    w_start, w_end, w_trans = _crf_unpack(transition)
+
+    e = _to_padded(emission, gidx, n_seq, maxt)
+    tm = e.swapaxes(0, 1)                                    # [T, N, n]
+    step_idx = jnp.arange(maxt)
+
+    delta0 = w_start[None, :] + tm[0]
+
+    def fwd(delta, xt):
+        e_t, t = xt
+        scores = delta[:, :, None] + w_trans[None, :, :]     # [N, from, to]
+        best_from = jnp.argmax(scores, axis=1)               # [N, n]
+        nxt = e_t + jnp.max(scores, axis=1)
+        valid = (t < lens_j)[:, None]
+        delta = jnp.where(valid, nxt, delta)
+        return delta, best_from
+
+    delta_last, bps = lax.scan(fwd, delta0, (tm[1:], step_idx[1:]))
+    # bps[t-1]: best predecessor for step t
+    last_tag = jnp.argmax(delta_last + w_end[None, :], axis=1)  # [N]
+
+    batch = jnp.arange(n_seq)
+
+    if maxt == 1:
+        path = last_tag[:, None]
+    else:
+        def back(tag, xt):
+            bp_t, t = xt                                     # bp for step t+1
+            prev = bp_t[batch, tag]
+            # only follow the pointer if step t+1 is within the sequence
+            tag_out = jnp.where(t + 1 < lens_j, prev, tag)
+            return tag_out, tag_out
+
+        # walk t = maxt-2 .. 0 emitting the tag at position t
+        _, tags_rev = lax.scan(back, last_tag,
+                               (bps[::-1], step_idx[maxt - 2::-1]))
+        path = jnp.concatenate([tags_rev[::-1].T,
+                                last_tag[:, None]], axis=1)  # [N, T]
+        # position len-1 of each sequence holds its final tag
+        pos = step_idx[None, :]
+        path = jnp.where(pos == (lens_j[:, None] - 1),
+                         last_tag[:, None], path)
+
+    ragged = _to_ragged(path[:, :, None], sidx).reshape(-1, 1).astype('int64')
+    if label is not None:
+        correct = (ragged == label.astype('int64')).astype('int64')
+        ctx.out(op, 'ViterbiPath', correct)
+    else:
+        ctx.out(op, 'ViterbiPath', ragged)
+    ctx.set_lod(op.output('ViterbiPath')[0], (offsets,))
+
+
+# ---------------------------------------------------------------------------
+# warpctc
+# ---------------------------------------------------------------------------
+
+@register_op('warpctc')
+def _warpctc(ctx, op):
+    logits = ctx.in1(op, 'Logits')              # [totalT, C] ragged
+    label = ctx.in1(op, 'Label')                # [totalL, 1] ragged
+    blank = int(op.attr('blank', 0))
+    norm_by_times = bool(op.attr('norm_by_times', False))
+
+    t_off, t_gidx, _, n_seq, maxt, t_lens = _padded_from_lod(
+        ctx, op, 'Logits')
+    l_lod = ctx.in1_lod(op, 'Label')
+    if not l_lod:
+        raise ValueError("warpctc Label needs LoD")
+    l_offsets = l_lod[-1]
+    l_gidx, _, _, maxl = _padded_maps(l_offsets)
+    l_lens = np.asarray(lengths_from_offsets(l_offsets), np.int32)
+
+    lp = jax.nn.log_softmax(
+        _to_padded(logits, t_gidx, n_seq, maxt), axis=-1)    # [N, T, C]
+    y = _to_padded(label.reshape(-1), l_gidx, n_seq, maxl)   # [N, L]
+    y = y.astype('int32')
+
+    t_lens_j = jnp.asarray(t_lens)
+    l_lens_j = jnp.asarray(l_lens)
+
+    # blank-extended labels l' of length S = 2*maxl + 1
+    S = 2 * maxl + 1
+    ext = jnp.full((n_seq, S), blank, dtype='int32')
+    ext = ext.at[:, 1::2].set(y)                             # [N, S]
+    s_idx = jnp.arange(S)
+    s_valid = s_idx[None, :] < (2 * l_lens_j[:, None] + 1)
+
+    # allow skip from s-2 when l'_s != blank and l'_s != l'_{s-2}
+    ext_m2 = jnp.concatenate(
+        [jnp.full((n_seq, 2), -1, 'int32'), ext[:, :-2]], axis=1)
+    can_skip = (ext != blank) & (ext != ext_m2)
+
+    lp_tm = lp.swapaxes(0, 1)                                # [T, N, C]
+    batch = jnp.arange(n_seq)
+
+    def emit(lp_t):
+        return lp_t[batch[:, None], ext]                     # [N, S]
+
+    alpha0 = jnp.full((n_seq, S), NEG)
+    alpha0 = alpha0.at[:, 0].set(emit(lp_tm[0])[:, 0])
+    if maxl > 0:
+        alpha0 = alpha0.at[:, 1].set(
+            jnp.where(l_lens_j > 0, emit(lp_tm[0])[:, 1], NEG))
+    alpha0 = jnp.where(s_valid, alpha0, NEG)
+
+    def step(alpha, xt):
+        lp_t, t = xt
+        a_m1 = jnp.concatenate(
+            [jnp.full((n_seq, 1), NEG), alpha[:, :-1]], axis=1)
+        a_m2 = jnp.concatenate(
+            [jnp.full((n_seq, 2), NEG), alpha[:, :-2]], axis=1)
+        paths = jnp.logaddexp(alpha, a_m1)
+        paths = jnp.where(can_skip, jnp.logaddexp(paths, a_m2), paths)
+        nxt = emit(lp_t) + paths
+        nxt = jnp.where(s_valid, nxt, NEG)
+        valid_t = (t < t_lens_j)[:, None]
+        return jnp.where(valid_t, nxt, alpha), None
+
+    step_idx = jnp.arange(1, maxt)
+    alpha_last, _ = lax.scan(step, alpha0, (lp_tm[1:], step_idx))
+
+    end1 = alpha_last[batch, 2 * l_lens_j]                   # final blank
+    end2 = jnp.where(l_lens_j > 0,
+                     alpha_last[batch, jnp.maximum(2 * l_lens_j - 1, 0)],
+                     NEG)
+    loss = -jnp.logaddexp(end1, end2)
+    if norm_by_times:
+        loss = loss / jnp.maximum(t_lens_j.astype(loss.dtype), 1.0)
+    ctx.out(op, 'Loss', loss.reshape(n_seq, 1))
+    ctx.lod_explicit.add(op.output('Loss')[0])
+
+
+# ---------------------------------------------------------------------------
+# ctc_align
+# ---------------------------------------------------------------------------
+
+@register_op('ctc_align')
+def _ctc_align(ctx, op):
+    x = ctx.in1(op, 'Input')                    # [total, 1] ragged ids
+    blank = int(op.attr('blank', 0))
+    offsets, gidx, sidx, n_seq, maxt, lens = _padded_from_lod(
+        ctx, op, 'Input')
+    ids = _to_padded(x.reshape(-1), gidx, n_seq, maxt).astype('int32')
+    valid = jnp.arange(maxt)[None, :] < jnp.asarray(lens)[:, None]
+
+    prev = jnp.concatenate(
+        [jnp.full((n_seq, 1), -1, 'int32'), ids[:, :-1]], axis=1)
+    keep = valid & (ids != blank) & (ids != prev)
+    # left-justify kept tokens; dropped slots -> -1 padding
+    pos = jnp.cumsum(keep.astype('int32'), axis=1) - 1
+    out = jnp.full((n_seq, maxt + 1), -1, dtype='int32')
+    rows = jnp.arange(n_seq)[:, None].repeat(maxt, 1)
+    cols = jnp.where(keep, pos, maxt)           # dump dropped into col maxt
+    out = out.at[rows.reshape(-1), cols.reshape(-1)].set(
+        jnp.where(keep, ids, -1).reshape(-1))
+    out = out[:, :maxt]
+    ragged = _to_ragged(out[:, :, None], sidx)
+    ctx.out(op, 'Output', ragged.reshape(-1, 1).astype('int64'))
+    ctx.set_lod(op.output('Output')[0], (offsets,))
+
+
+# ---------------------------------------------------------------------------
+# edit_distance
+# ---------------------------------------------------------------------------
+
+@register_op('edit_distance')
+def _edit_distance(ctx, op):
+    hyp = ctx.in1(op, 'Hyps')                   # [totalH, 1] ragged
+    ref = ctx.in1(op, 'Refs')                   # [totalR, 1] ragged
+    normalized = bool(op.attr('normalized', False))
+
+    h_off, h_gidx, _, n_seq, maxh, h_lens = _padded_from_lod(
+        ctx, op, 'Hyps')
+    r_lod = ctx.in1_lod(op, 'Refs')
+    r_gidx, _, r_n, maxr = _padded_maps(r_lod[-1])
+    r_lens = np.asarray(lengths_from_offsets(r_lod[-1]), np.int32)
+
+    H = _to_padded(hyp.reshape(-1), h_gidx, n_seq, maxh).astype('int32')
+    R = _to_padded(ref.reshape(-1), r_gidx, n_seq, maxr).astype('int32')
+    h_lens_j = jnp.asarray(h_lens)
+    r_lens_j = jnp.asarray(r_lens)
+
+    # DP rows over hypothesis positions; vectorized over batch and ref cols
+    j_idx = jnp.arange(maxr + 1)
+    row0 = jnp.broadcast_to(j_idx[None, :].astype('float32'),
+                            (n_seq, maxr + 1))
+
+    def dp(prev_row, xt):
+        h_tok, i = xt                                        # h_tok: [N]
+        sub_cost = (H[:, i][:, None] != R).astype('float32')  # [N, maxr]
+        # new_row[0] = i+1
+        def col_step(left, cols):
+            prev_j, prev_jm1, sub = cols                     # [N] each
+            val = jnp.minimum(jnp.minimum(prev_j + 1.0, left + 1.0),
+                              prev_jm1 + sub)
+            return val, val
+
+        init = jnp.full((n_seq,), i + 1, dtype='float32')
+        _, cols = lax.scan(
+            col_step, init,
+            (prev_row[:, 1:].T, prev_row[:, :-1].T, sub_cost.T))
+        new_row = jnp.concatenate([init[:, None], cols.T], axis=1)
+        valid = (i < h_lens_j)[:, None]
+        row = jnp.where(valid, new_row, prev_row)
+        return row, None
+
+    i_idx = jnp.arange(maxh)
+    final_row, _ = lax.scan(dp, row0, (H.T, i_idx))
+    dist = final_row[jnp.arange(n_seq), r_lens_j]
+    if normalized:
+        dist = dist / jnp.maximum(r_lens_j.astype('float32'), 1.0)
+    ctx.out(op, 'Out', dist.reshape(n_seq, 1))
+    ctx.out(op, 'SequenceNum', jnp.asarray([n_seq], dtype='int64'))
+    ctx.lod_explicit.add(op.output('Out')[0])
+
+
+# ---------------------------------------------------------------------------
+# chunk_eval
+# ---------------------------------------------------------------------------
+
+_SCHEMES = {'IOB': 2, 'IOE': 2, 'IOBES': 4, 'plain': 1}
+
+
+def _chunk_masks(ids, scheme, num_chunk_types, first, last, nxt_first,
+                 excluded):
+    """begin/end/inside masks + per-position chunk type for one scheme.
+    ids: [T] padded flat; first/last: sequence-boundary masks."""
+    tag_num = _SCHEMES[scheme]
+    o_id = num_chunk_types * tag_num
+    is_o = ids >= o_id
+    ctype = jnp.where(is_o, -1, ids // tag_num)
+    tag = jnp.where(is_o, -1, ids % tag_num)
+    if excluded:
+        excl = jnp.zeros_like(is_o)
+        for e in excluded:
+            excl = excl | (ctype == int(e))
+        is_o = is_o | excl
+        ctype = jnp.where(is_o, -1, ctype)
+        tag = jnp.where(is_o, -1, tag)
+
+    inside = ~is_o
+    prev_inside = jnp.concatenate([jnp.array([False]), inside[:-1]])
+    prev_inside = prev_inside & ~first
+    prev_type = jnp.concatenate([jnp.array([-1]), ctype[:-1]])
+    prev_tag = jnp.concatenate([jnp.array([-1]), tag[:-1]])
+    next_inside = jnp.concatenate([inside[1:], jnp.array([False])])
+    next_inside = next_inside & ~nxt_first
+    next_type = jnp.concatenate([ctype[1:], jnp.array([-1])])
+    next_tag = jnp.concatenate([tag[1:], jnp.array([-1])])
+    diff_prev = ~prev_inside | (prev_type != ctype)
+    diff_next = ~next_inside | (next_type != ctype)
+
+    if scheme == 'plain':
+        begin = inside & diff_prev
+        end = inside & diff_next
+    elif scheme == 'IOB':        # B=0, I=1
+        begin = inside & ((tag == 0) | diff_prev)
+        end = inside & (diff_next | (next_tag == 0))
+    elif scheme == 'IOE':        # I=0, E=1
+        begin = inside & (diff_prev | (prev_tag == 1))
+        end = inside & ((tag == 1) | diff_next)
+    else:                        # IOBES: B=0, I=1, E=2, S=3
+        begin = inside & ((tag == 0) | (tag == 3) |
+                          (diff_prev | (prev_tag == 2) | (prev_tag == 3)))
+        end = inside & ((tag == 2) | (tag == 3) |
+                        (diff_next | (next_tag == 0) | (next_tag == 3)))
+    return begin, end, inside, ctype
+
+
+@register_op('chunk_eval')
+def _chunk_eval(ctx, op):
+    inference = ctx.in1(op, 'Inference')        # [total, 1] ragged int
+    label = ctx.in1(op, 'Label')
+    scheme = op.attr('chunk_scheme', 'IOB')
+    num_chunk_types = int(op.attr('num_chunk_types'))
+    excluded = list(op.attr('excluded_chunk_types', []) or [])
+
+    lod = ctx.in1_lod(op, 'Inference')
+    if not lod:
+        raise ValueError("chunk_eval needs LoD input")
+    offsets = lod[-1]
+    total = offsets[-1]
+    firsts = np.zeros(total, bool)
+    firsts[np.asarray(offsets[:-1], np.int64)] = True
+    first = jnp.asarray(firsts)
+    nxt_first = jnp.concatenate([first[1:], jnp.array([True])])
+    last = nxt_first
+
+    inf = inference.reshape(-1).astype('int32')
+    lab = label.reshape(-1).astype('int32')
+    b_i, e_i, in_i, t_i = _chunk_masks(inf, scheme, num_chunk_types,
+                                       first, last, nxt_first, excluded)
+    b_l, e_l, in_l, t_l = _chunk_masks(lab, scheme, num_chunk_types,
+                                       first, last, nxt_first, excluded)
+
+    idx = jnp.arange(total)
+
+    def starts_of(begin, inside):
+        # start index of the chunk covering each position (-1 outside)
+        def step(cur, xt):
+            b, ins, i = xt
+            cur = jnp.where(b, i, jnp.where(ins, cur, -1))
+            return cur, cur
+        _, s = lax.scan(step, jnp.asarray(-1, 'int32'),
+                        (begin, inside, idx.astype('int32')))
+        return s
+
+    s_i = starts_of(b_i, in_i)
+    s_l = starts_of(b_l, in_l)
+
+    match = (e_i & e_l & (s_i == s_l) & (s_i >= 0) &
+             (t_i == t_l))
+    num_correct = jnp.sum(match).astype('int64')
+    num_inf = jnp.sum(b_i).astype('int64')
+    num_lab = jnp.sum(b_l).astype('int64')
+
+    prec = num_correct / jnp.maximum(num_inf, 1).astype('float32')
+    rec = num_correct / jnp.maximum(num_lab, 1).astype('float32')
+    f1 = jnp.where(prec + rec > 0, 2 * prec * rec / (prec + rec), 0.0)
+    prec = jnp.where(num_inf > 0, prec, 0.0)
+    rec = jnp.where(num_lab > 0, rec, 0.0)
+
+    ctx.out(op, 'Precision', prec.reshape(1))
+    ctx.out(op, 'Recall', rec.reshape(1))
+    ctx.out(op, 'F1-Score', f1.reshape(1))
+    ctx.out(op, 'NumInferChunks', num_inf.reshape(1))
+    ctx.out(op, 'NumLabelChunks', num_lab.reshape(1))
+    ctx.out(op, 'NumCorrectChunks', num_correct.reshape(1))
+    for slot in ('Precision', 'Recall', 'F1-Score', 'NumInferChunks',
+                 'NumLabelChunks', 'NumCorrectChunks'):
+        names = op.output(slot)
+        if names:
+            ctx.lod_explicit.add(names[0])

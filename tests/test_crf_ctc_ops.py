@@ -1,0 +1,400 @@
+"""CRF / CTC / edit-distance / chunk-eval tests against numpy references
+(brute-force enumeration for CRF partition function, standard DP for CTC
+and Levenshtein). Mirrors reference tests test_linear_chain_crf_op.py,
+test_crf_decoding_op.py, test_warpctc_op.py, test_edit_distance_op.py,
+test_chunk_eval_op.py, test_ctc_align_op.py.
+"""
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _exe():
+    return fluid.Executor()
+
+
+# ---------------------------------------------------------------------------
+# numpy references
+# ---------------------------------------------------------------------------
+
+def crf_nll_bruteforce(emission, transition):
+    """NLL of the best... no: logZ via brute-force path enumeration and
+    gold score; emission [T, n], transition [n+2, n]."""
+    T, n = emission.shape
+    w_start, w_end, w = transition[0], transition[1], transition[2:]
+
+    def path_score(path):
+        s = w_start[path[0]] + w_end[path[-1]]
+        s += sum(emission[t, path[t]] for t in range(T))
+        s += sum(w[path[t - 1], path[t]] for t in range(1, T))
+        return s
+
+    scores = [path_score(p) for p in itertools.product(range(n), repeat=T)]
+    m = max(scores)
+    log_z = m + np.log(sum(np.exp(s - m) for s in scores))
+    return log_z, path_score
+
+
+def viterbi_bruteforce(emission, transition):
+    T, n = emission.shape
+    _, path_score = crf_nll_bruteforce(emission, transition)
+    best, best_s = None, -1e30
+    for p in itertools.product(range(n), repeat=T):
+        s = path_score(p)
+        if s > best_s:
+            best, best_s = p, s
+    return list(best)
+
+
+def ctc_loss_ref(logits, labels, blank=0):
+    """log-space CTC forward, single sequence. logits [T, C] raw."""
+    lp = logits - logits.max(1, keepdims=True)
+    lp = lp - np.log(np.exp(lp).sum(1, keepdims=True))
+    L = len(labels)
+    ext = [blank]
+    for l in labels:
+        ext += [l, blank]
+    S = len(ext)
+    NEG = -1e30
+    alpha = np.full(S, NEG)
+    alpha[0] = lp[0][blank]
+    if S > 1:
+        alpha[1] = lp[0][ext[1]]
+    for t in range(1, len(lp)):
+        new = np.full(S, NEG)
+        for s in range(S):
+            cands = [alpha[s]]
+            if s >= 1:
+                cands.append(alpha[s - 1])
+            if s >= 2 and ext[s] != blank and ext[s] != ext[s - 2]:
+                cands.append(alpha[s - 2])
+            m = max(cands)
+            if m > NEG / 2:
+                new[s] = lp[t][ext[s]] + m + np.log(
+                    sum(np.exp(c - m) for c in cands))
+        alpha = new
+    ends = [alpha[S - 1]]
+    if S > 1:
+        ends.append(alpha[S - 2])
+    m = max(ends)
+    return -(m + np.log(sum(np.exp(e - m) for e in ends)))
+
+
+def levenshtein(a, b):
+    d = np.zeros((len(a) + 1, len(b) + 1))
+    d[:, 0] = np.arange(len(a) + 1)
+    d[0, :] = np.arange(len(b) + 1)
+    for i in range(1, len(a) + 1):
+        for j in range(1, len(b) + 1):
+            d[i][j] = min(d[i - 1][j] + 1, d[i][j - 1] + 1,
+                          d[i - 1][j - 1] + (a[i - 1] != b[j - 1]))
+    return d[len(a)][len(b)]
+
+
+# ---------------------------------------------------------------------------
+# linear_chain_crf / crf_decoding
+# ---------------------------------------------------------------------------
+
+def test_linear_chain_crf_matches_bruteforce():
+    rng = np.random.RandomState(3)
+    n_tags = 4
+    lens = [3, 2, 4]
+    total = sum(lens)
+    em = rng.randn(total, n_tags).astype('float32')
+    trans = (rng.randn(n_tags + 2, n_tags) * 0.5).astype('float32')
+    lab = rng.randint(0, n_tags, size=(total, 1)).astype('int64')
+    off = np.concatenate([[0], np.cumsum(lens)])
+    lod = [list(off)]
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        e = layers.data(name='e', shape=[n_tags], lod_level=1)
+        l = layers.data(name='l', shape=[1], dtype='int64', lod_level=1)
+        crf = layers.linear_chain_crf(
+            input=e, label=l,
+            param_attr=fluid.ParamAttr(name='crf_w'))
+    exe = _exe()
+    exe.run(startup)
+    scope = fluid.global_scope()
+    scope.set('crf_w', trans)
+    nll, = exe.run(main, feed={'e': (em, lod), 'l': (lab, lod)},
+                   fetch_list=[crf])
+    for i in range(len(lens)):
+        seq_em = em[off[i]:off[i + 1]]
+        seq_lab = lab[off[i]:off[i + 1], 0]
+        log_z, path_score = crf_nll_bruteforce(seq_em, trans)
+        expect = log_z - path_score(list(seq_lab))
+        assert np.allclose(nll[i, 0], expect, atol=1e-3), (i, nll[i], expect)
+
+
+def test_linear_chain_crf_trains():
+    rng = np.random.RandomState(0)
+    n_tags = 3
+    lens = [4, 3]
+    total = sum(lens)
+    off = np.concatenate([[0], np.cumsum(lens)])
+    lod = [list(off)]
+    feats = rng.rand(total, 6).astype('float32')
+    lab = rng.randint(0, n_tags, size=(total, 1)).astype('int64')
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name='x', shape=[6], lod_level=1)
+        l = layers.data(name='l', shape=[1], dtype='int64', lod_level=1)
+        em = layers.fc(input=x, size=n_tags)
+        crf = layers.linear_chain_crf(
+            input=em, label=l, param_attr=fluid.ParamAttr(name='crfw'))
+        loss = layers.mean(crf)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = _exe()
+    exe.run(startup)
+    losses = []
+    for _ in range(25):
+        lv, = exe.run(main, feed={'x': (feats, lod), 'l': (lab, lod)},
+                      fetch_list=[loss])
+        losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.5, losses
+
+
+def test_crf_decoding_matches_bruteforce():
+    rng = np.random.RandomState(5)
+    n_tags = 3
+    lens = [3, 4]
+    total = sum(lens)
+    em = rng.randn(total, n_tags).astype('float32')
+    trans = (rng.randn(n_tags + 2, n_tags) * 0.7).astype('float32')
+    off = np.concatenate([[0], np.cumsum(lens)])
+    lod = [list(off)]
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        e = layers.data(name='e', shape=[n_tags], lod_level=1)
+        # parameter must exist: create via a dummy crf layer sharing name
+        l = layers.data(name='l', shape=[1], dtype='int64', lod_level=1)
+        layers.linear_chain_crf(
+            input=e, label=l, param_attr=fluid.ParamAttr(name='crfw2'))
+        path = layers.crf_decoding(
+            input=e, param_attr=fluid.ParamAttr(name='crfw2'))
+    exe = _exe()
+    exe.run(startup)
+    fluid.global_scope().set('crfw2', trans)
+    lab = np.zeros((total, 1), 'int64')
+    p, = exe.run(main, feed={'e': (em, lod), 'l': (lab, lod)},
+                 fetch_list=[path])
+    for i in range(len(lens)):
+        seq_em = em[off[i]:off[i + 1]]
+        expect = viterbi_bruteforce(seq_em, trans)
+        got = list(p[off[i]:off[i + 1], 0])
+        assert got == expect, (i, got, expect)
+
+
+def test_crf_decoding_with_label_gives_correct_mask():
+    rng = np.random.RandomState(9)
+    n_tags = 3
+    lens = [3]
+    em = rng.randn(3, n_tags).astype('float32')
+    trans = rng.randn(n_tags + 2, n_tags).astype('float32')
+    lod = [[0, 3]]
+    best = viterbi_bruteforce(em, trans)
+    lab = np.array(best, 'int64').reshape(-1, 1)
+    lab[1, 0] = (lab[1, 0] + 1) % n_tags        # corrupt one position
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        e = layers.data(name='e', shape=[n_tags], lod_level=1)
+        l = layers.data(name='l', shape=[1], dtype='int64', lod_level=1)
+        layers.linear_chain_crf(
+            input=e, label=l, param_attr=fluid.ParamAttr(name='crfw3'))
+        mask = layers.crf_decoding(
+            input=e, param_attr=fluid.ParamAttr(name='crfw3'), label=l)
+    exe = _exe()
+    exe.run(startup)
+    fluid.global_scope().set('crfw3', trans)
+    m, = exe.run(main, feed={'e': (em, lod), 'l': (lab, lod)},
+                 fetch_list=[mask])
+    assert list(m[:, 0]) == [1, 0, 1]
+
+
+# ---------------------------------------------------------------------------
+# warpctc / ctc_align
+# ---------------------------------------------------------------------------
+
+def test_warpctc_matches_reference_dp():
+    rng = np.random.RandomState(11)
+    C = 5
+    t_lens = [6, 4]
+    l_lens = [2, 3]
+    t_off = np.concatenate([[0], np.cumsum(t_lens)])
+    l_off = np.concatenate([[0], np.cumsum(l_lens)])
+    logits = rng.randn(sum(t_lens), C).astype('float32')
+    label = rng.randint(1, C, size=(sum(l_lens), 1)).astype('int64')
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        lg = layers.data(name='lg', shape=[C], lod_level=1)
+        lb = layers.data(name='lb', shape=[1], dtype='int64', lod_level=1)
+        loss = layers.warpctc(input=lg, label=lb, blank=0)
+    exe = _exe()
+    exe.run(startup)
+    o, = exe.run(main, feed={'lg': (logits, [list(t_off)]),
+                             'lb': (label, [list(l_off)])},
+                 fetch_list=[loss])
+    for i in range(2):
+        ref = ctc_loss_ref(logits[t_off[i]:t_off[i + 1]],
+                           list(label[l_off[i]:l_off[i + 1], 0]), blank=0)
+        assert np.allclose(o[i, 0], ref, atol=1e-3), (i, o[i], ref)
+
+
+def test_warpctc_trains():
+    rng = np.random.RandomState(2)
+    C, T = 6, 8
+    feats = rng.rand(T, 10).astype('float32')
+    t_lod = [[0, T]]
+    label = np.array([[1], [2], [3]], 'int64')
+    l_lod = [[0, 3]]
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name='x', shape=[10], lod_level=1)
+        lb = layers.data(name='lb', shape=[1], dtype='int64', lod_level=1)
+        logit = layers.fc(input=x, size=C)
+        loss = layers.mean(layers.warpctc(input=logit, label=lb))
+        fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+    exe = _exe()
+    exe.run(startup)
+    losses = []
+    for _ in range(30):
+        lv, = exe.run(main, feed={'x': (feats, t_lod),
+                                  'lb': (label, l_lod)}, fetch_list=[loss])
+        losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.5, losses
+
+
+def test_ctc_align_merge_and_blank():
+    ids = np.array([[0], [1], [1], [0], [2], [2], [0],     # seq1: 1,2
+                    [3], [3], [0], [0], [4]], 'int64')     # seq2: 3,4
+    lod = [[0, 7, 12]]
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name='x', shape=[1], dtype='int64', lod_level=1)
+        # drive the ctc_align op directly
+        from paddle_tpu.layer_helper import LayerHelper
+        helper = LayerHelper('ctc_align_t')
+        o = helper.create_variable_for_type_inference(dtype='int64')
+        helper.append_op(type='ctc_align', inputs={'Input': [x]},
+                         outputs={'Output': [o]}, attrs={'blank': 0})
+    exe = _exe()
+    exe.run(startup)
+    r, = exe.run(main, feed={'x': (ids, lod)}, fetch_list=[o])
+    s1 = [v for v in r[0:7, 0] if v >= 0]
+    s2 = [v for v in r[7:12, 0] if v >= 0]
+    assert s1 == [1, 2], s1
+    assert s2 == [3, 4], s2
+
+
+def test_ctc_greedy_decoder():
+    # logits argmax: [blank, 1, 1, 2] -> decode [1, 2]
+    probs = np.array([
+        [0.9, 0.05, 0.05],
+        [0.1, 0.8, 0.1],
+        [0.1, 0.8, 0.1],
+        [0.1, 0.1, 0.8]], 'float32')
+    lod = [[0, 4]]
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name='x', shape=[3], lod_level=1)
+        out = layers.ctc_greedy_decoder(x, blank=0)
+    exe = _exe()
+    exe.run(startup)
+    r, = exe.run(main, feed={'x': (probs, lod)}, fetch_list=[out])
+    toks = [v for v in r[:, 0] if v >= 0]
+    assert toks == [1, 2], r
+
+
+# ---------------------------------------------------------------------------
+# edit_distance
+# ---------------------------------------------------------------------------
+
+def test_edit_distance():
+    hyp_seqs = [[1, 2, 3], [4, 5]]
+    ref_seqs = [[1, 3, 3, 7], [4, 5]]
+    hyp = np.array(sum(hyp_seqs, []), 'int64').reshape(-1, 1)
+    ref = np.array(sum(ref_seqs, []), 'int64').reshape(-1, 1)
+    h_lod = [[0, 3, 5]]
+    r_lod = [[0, 4, 6]]
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        h = layers.data(name='h', shape=[1], dtype='int64', lod_level=1)
+        r = layers.data(name='r', shape=[1], dtype='int64', lod_level=1)
+        dist, seq_num = layers.edit_distance(h, r, normalized=False)
+    exe = _exe()
+    exe.run(startup)
+    d, sn = exe.run(main, feed={'h': (hyp, h_lod), 'r': (ref, r_lod)},
+                    fetch_list=[dist, seq_num])
+    for i in range(2):
+        expect = levenshtein(hyp_seqs[i], ref_seqs[i])
+        assert np.allclose(d[i, 0], expect), (i, d[i], expect)
+    assert sn[0] == 2
+
+
+def test_edit_distance_normalized():
+    hyp = np.array([[1], [2]], 'int64')
+    ref = np.array([[1], [3], [4], [5]], 'int64')
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        h = layers.data(name='h', shape=[1], dtype='int64', lod_level=1)
+        r = layers.data(name='r', shape=[1], dtype='int64', lod_level=1)
+        dist, _ = layers.edit_distance(h, r, normalized=True)
+    exe = _exe()
+    exe.run(startup)
+    d, = exe.run(main, feed={'h': (hyp, [[0, 2]]), 'r': (ref, [[0, 4]])},
+                 fetch_list=[dist])
+    assert np.allclose(d[0, 0], levenshtein([1, 2], [1, 3, 4, 5]) / 4.0)
+
+
+# ---------------------------------------------------------------------------
+# chunk_eval
+# ---------------------------------------------------------------------------
+
+def test_chunk_eval_iob():
+    # IOB, 2 chunk types: ids = type*2 + tag (B=0, I=1); O = 4
+    # label:  [B0 I0 O  B1 I1]  chunks: (0-1, t0), (3-4, t1)
+    # infer:  [B0 I0 O  B1 O ]  chunks: (0-1, t0), (3-3, t1)
+    lab = np.array([[0], [1], [4], [2], [3]], 'int64')
+    inf = np.array([[0], [1], [4], [2], [4]], 'int64')
+    lod = [[0, 5]]
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        i = layers.data(name='i', shape=[1], dtype='int64', lod_level=1)
+        l = layers.data(name='l', shape=[1], dtype='int64', lod_level=1)
+        (prec, rec, f1, n_inf, n_lab, n_cor) = layers.chunk_eval(
+            input=i, label=l, chunk_scheme='IOB', num_chunk_types=2)
+    exe = _exe()
+    exe.run(startup)
+    o = exe.run(main, feed={'i': (inf, lod), 'l': (lab, lod)},
+                fetch_list=[prec, rec, f1, n_inf, n_lab, n_cor])
+    assert o[3][0] == 2 and o[4][0] == 2
+    assert o[5][0] == 1                        # only the t0 chunk matches
+    assert np.allclose(o[0][0], 0.5) and np.allclose(o[1][0], 0.5)
+    assert np.allclose(o[2][0], 0.5)
+
+
+def test_chunk_eval_perfect_and_plain():
+    # plain scheme: each run of the same type is a chunk; O = num_types
+    lab = np.array([[0], [0], [2], [1], [1]], 'int64')
+    lod = [[0, 5]]
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        i = layers.data(name='i', shape=[1], dtype='int64', lod_level=1)
+        l = layers.data(name='l', shape=[1], dtype='int64', lod_level=1)
+        outs = layers.chunk_eval(input=i, label=l, chunk_scheme='plain',
+                                 num_chunk_types=2)
+    exe = _exe()
+    exe.run(startup)
+    o = exe.run(main, feed={'i': (lab, lod), 'l': (lab, lod)},
+                fetch_list=list(outs))
+    assert o[3][0] == 2 and o[4][0] == 2 and o[5][0] == 2
+    assert np.allclose(o[0][0], 1.0) and np.allclose(o[2][0], 1.0)
