@@ -60,5 +60,6 @@ from . import compiler
 from .compiler import CompiledProgram
 from .parallel_executor import ParallelExecutor
 from .parallel_executor import ExecutionStrategy, BuildStrategy
+from . import contrib
 
 __version__ = '0.1.0'

@@ -1,0 +1,6 @@
+"""Contrib subpackage (reference python/paddle/fluid/contrib/).
+
+Currently: mixed_precision (the TPU bf16 analog of
+reference paddle/contrib/float16/float16_transpiler.py), slim quantization.
+"""
+from . import mixed_precision  # noqa: F401

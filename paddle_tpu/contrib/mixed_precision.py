@@ -1,0 +1,125 @@
+"""Automatic mixed precision (bf16) — TPU analog of the reference fp16 path.
+
+Reference: paddle/contrib/float16/float16_transpiler.py:66 (program rewrite
+casting ops to fp16) and the fluid AMP design (white/black op lists + a
+decorated optimizer). TPU redesign:
+
+- dtype policy is **bf16**, which shares fp32's exponent range — so no loss
+  scaling machinery is required (the reference's fp16 needs it; bf16 doesn't).
+- instead of splicing cast ops into the program (which would materialize
+  bf16 copies), `rewrite_program_bf16` marks MXU-heavy ops with an attr that
+  their lowering consults (core/amp.py): inputs are cast inside the traced
+  function and XLA fuses the casts into the surrounding HLO, accumulation
+  stays fp32 via preferred_element_type.
+- parameters remain fp32 in the Scope: master weights for free.
+
+Usage::
+
+    opt = fluid.optimizer.Adam(1e-4)
+    opt = fluid.contrib.mixed_precision.decorate(opt)
+    opt.minimize(avg_loss)           # rewrites the program + appends backward
+
+or rewrite an existing (inference) program in place::
+
+    fluid.contrib.mixed_precision.rewrite_program_bf16(main_program)
+"""
+from ..core.amp import AMP_ATTR
+
+__all__ = ['AutoMixedPrecisionLists', 'rewrite_program_bf16', 'decorate',
+           'OptimizerWithMixedPrecision']
+
+# Ops whose FLOPs dominate and that are numerically safe in bf16 with fp32
+# accumulation: they run on the MXU.
+WHITE_LIST = {
+    'mul', 'matmul',
+    'conv2d', 'depthwise_conv2d', 'conv2d_transpose',
+    'depthwise_conv2d_transpose', 'conv3d',
+}
+
+# Numerically sensitive ops that must stay fp32 (kept for API parity /
+# custom-list validation; nothing ever casts them in this design).
+BLACK_LIST = {
+    'softmax', 'softmax_with_cross_entropy', 'cross_entropy',
+    'sigmoid_cross_entropy_with_logits', 'layer_norm', 'batch_norm',
+    'group_norm', 'mean', 'reduce_mean', 'reduce_sum', 'sum', 'exp', 'log',
+}
+
+
+class AutoMixedPrecisionLists(object):
+    """White/black op-type lists controlling which ops compute in bf16
+    (reference fluid AMP AutoMixedPrecisionLists)."""
+
+    def __init__(self, custom_white_list=None, custom_black_list=None):
+        self.white_list = set(WHITE_LIST)
+        self.black_list = set(BLACK_LIST)
+        for t in (custom_white_list or []):
+            if t in self.black_list:
+                raise ValueError(
+                    "op %r is in both custom white list and black list" % t)
+            self.white_list.add(t)
+        for t in (custom_black_list or []):
+            self.white_list.discard(t)
+            self.black_list.add(t)
+
+
+def rewrite_program_bf16(program, amp_lists=None, dtype='bfloat16'):
+    """Mark every white-listed op in `program` to compute in `dtype`.
+
+    The mark (core/amp.py AMP_ATTR) makes the op's lowering cast its fp32
+    compute inputs to bf16; accumulation and outputs stay fp32.
+    """
+    amp_lists = amp_lists or AutoMixedPrecisionLists()
+    n = 0
+    for block in program.blocks:
+        for op in block.ops:
+            if op.type in amp_lists.white_list:
+                op.attrs[AMP_ATTR] = dtype
+                n += 1
+    program._bump_version()
+    return n
+
+
+class OptimizerWithMixedPrecision(object):
+    """Optimizer wrapper: rewrites the program for bf16 compute, then runs
+    the wrapped optimizer on the (fp32 master) parameters.
+
+    bf16 needs no loss scaling; `init_loss_scaling` other than 1.0 is
+    rejected rather than silently mis-applied (scaling the loss without an
+    unscale step would multiply the effective learning rate).
+    """
+
+    def __init__(self, optimizer, amp_lists=None, init_loss_scaling=1.0,
+                 use_dynamic_loss_scaling=False, dtype='bfloat16'):
+        self._optimizer = optimizer
+        self._amp_lists = amp_lists or AutoMixedPrecisionLists()
+        if use_dynamic_loss_scaling or float(init_loss_scaling) != 1.0:
+            # bf16 has fp32's exponent range; loss scaling is an fp16
+            # artifact. Accept-and-ignore would hide a config error.
+            raise ValueError(
+                "loss scaling is unnecessary for bf16 (same exponent range "
+                "as fp32); use init_loss_scaling=1.0 and "
+                "use_dynamic_loss_scaling=False")
+        self._dtype = dtype
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        program = loss.block.program
+        rewrite_program_bf16(program, self._amp_lists, self._dtype)
+        return self._optimizer.minimize(
+            loss, startup_program=startup_program,
+            parameter_list=parameter_list, no_grad_set=no_grad_set)
+
+    def backward(self, *args, **kwargs):
+        return self._optimizer.backward(*args, **kwargs)
+
+    def apply_gradients(self, *args, **kwargs):
+        return self._optimizer.apply_gradients(*args, **kwargs)
+
+
+def decorate(optimizer, amp_lists=None, init_loss_scaling=1.0,
+             use_dynamic_loss_scaling=False):
+    """Wrap `optimizer` for bf16 mixed-precision training (reference
+    fluid.contrib.mixed_precision.decorate)."""
+    return OptimizerWithMixedPrecision(
+        optimizer, amp_lists=amp_lists, init_loss_scaling=init_loss_scaling,
+        use_dynamic_loss_scaling=use_dynamic_loss_scaling)

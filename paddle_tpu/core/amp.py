@@ -1,0 +1,49 @@
+"""Lowering-time mixed-precision support.
+
+The program pass (contrib/mixed_precision.py, the TPU analog of the reference
+float16 transpiler, paddle/contrib/float16/float16_transpiler.py:66) marks
+MXU-heavy ops with AMP_ATTR. At lowering time the op casts its *compute
+inputs* to the policy dtype (bf16 on TPU) while:
+
+- parameters stay fp32 in the Scope (master weights — the cast is traced and
+  fused by XLA into the weight load),
+- the dot/conv accumulates in fp32 (`preferred_element_type=jnp.float32`),
+- the op's output is cast back to the variable dtype (fp32), so the rest of
+  the program (softmax, norms, reductions, the optimizer) runs full precision.
+
+This is the compiler-friendly TPU version of fp16 training: no loss scaling
+is needed because bf16 keeps fp32's exponent range.
+"""
+import jax.numpy as jnp
+
+AMP_ATTR = '__amp_dtype__'
+
+
+def accum_dtype(x):
+    """preferred_element_type for a conv given its (possibly AMP-cast) input.
+
+    fp32 inputs keep explicit fp32 accumulation. bf16 (AMP) inputs return
+    None — conv's AD transpose rule requires cotangent and operand dtypes to
+    match, so the output stays bf16 in HLO while the MXU still accumulates
+    fp32 internally; the lowering upcasts the result right after.
+    """
+    if getattr(x, 'dtype', None) == jnp.dtype(jnp.bfloat16):
+        return None
+    return jnp.float32
+
+
+def cast_compute(op, *vals):
+    """Cast float32 compute inputs of an AMP-marked op to the policy dtype.
+
+    Non-float32 inputs (ints, already-cast values) pass through unchanged.
+    Returns the inputs unchanged when the op carries no AMP mark.
+    """
+    dt = op.attr(AMP_ATTR, None)
+    if not dt:
+        return vals if len(vals) != 1 else vals[0]
+    jdt = jnp.dtype(dt)
+    out = tuple(
+        v.astype(jdt)
+        if getattr(v, 'dtype', None) == jnp.dtype(jnp.float32) else v
+        for v in vals)
+    return out if len(out) != 1 else out[0]

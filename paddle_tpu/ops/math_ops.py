@@ -11,6 +11,7 @@ import numpy as np
 import jax.numpy as jnp
 from jax import lax
 
+from ..core import amp
 from ..core.registry import register_op
 from .common import broadcast_y_to, flatten_to_2d
 
@@ -23,6 +24,7 @@ def _mul(ctx, op):
     ynk = op.attr('y_num_col_dims', 1)
     x2 = flatten_to_2d(x, xnc)
     y2 = flatten_to_2d(y, ynk)
+    x2, y2 = amp.cast_compute(op, x2, y2)
     out = jnp.dot(x2, y2, preferred_element_type=jnp.float32)
     out = out.astype(x.dtype)
     out_shape = x.shape[:xnc] + y.shape[ynk:]
@@ -44,7 +46,9 @@ def _matmul(ctx, op):
         x = jnp.swapaxes(x, -1, -2)
     if ty:
         y = jnp.swapaxes(y, -1, -2)
-    out = jnp.matmul(x, y, preferred_element_type=jnp.float32).astype(x.dtype)
+    out_dtype = x.dtype
+    x, y = amp.cast_compute(op, x, y)
+    out = jnp.matmul(x, y, preferred_element_type=jnp.float32).astype(out_dtype)
     if alpha != 1.0:
         out = out * jnp.asarray(alpha, dtype=out.dtype)
     ctx.out(op, 'Out', out)

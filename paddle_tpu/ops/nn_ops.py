@@ -14,6 +14,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..core import amp
 from ..core.registry import register_op
 
 
@@ -90,14 +91,16 @@ def _conv2d(ctx, op):
     pads = _pair(op.attr('paddings', [0, 0]))
     dilations = _pair(op.attr('dilations', [1, 1]))
     groups = op.attr('groups', 1) or 1
+    out_dtype = x.dtype
+    x, w = amp.cast_compute(op, x, w)
     out = lax.conv_general_dilated(
         x, w, window_strides=strides,
         padding=[(pads[0], pads[0]), (pads[1], pads[1])],
         rhs_dilation=dilations,
         dimension_numbers=('NCHW', 'OIHW', 'NCHW'),
         feature_group_count=groups,
-        preferred_element_type=jnp.float32)
-    ctx.out(op, 'Output', out.astype(x.dtype))
+        preferred_element_type=amp.accum_dtype(x))
+    ctx.out(op, 'Output', out.astype(out_dtype))
 
 
 @register_op('depthwise_conv2d')
@@ -113,13 +116,15 @@ def _conv3d(ctx, op):
     pads = _pair(op.attr('paddings', [0, 0, 0]), 3)
     dilations = _pair(op.attr('dilations', [1, 1, 1]), 3)
     groups = op.attr('groups', 1) or 1
+    out_dtype = x.dtype
+    x, w = amp.cast_compute(op, x, w)
     out = lax.conv_general_dilated(
         x, w, window_strides=strides,
         padding=[(p, p) for p in pads], rhs_dilation=dilations,
         dimension_numbers=('NCDHW', 'OIDHW', 'NCDHW'),
         feature_group_count=groups,
-        preferred_element_type=jnp.float32)
-    ctx.out(op, 'Output', out.astype(x.dtype))
+        preferred_element_type=amp.accum_dtype(x))
+    ctx.out(op, 'Output', out.astype(out_dtype))
 
 
 @register_op('conv2d_transpose')
@@ -132,6 +137,8 @@ def _conv2d_transpose(ctx, op):
     groups = op.attr('groups', 1) or 1
     kh = (w.shape[2] - 1) * dilations[0] + 1
     kw = (w.shape[3] - 1) * dilations[1] + 1
+    out_dtype = x.dtype
+    x, w = amp.cast_compute(op, x, w)
     # gradient-of-conv formulation: lhs-dilate input by stride
     out = lax.conv_general_dilated(
         x, jnp.swapaxes(w, 0, 1)[:, :, ::-1, ::-1],
@@ -141,8 +148,8 @@ def _conv2d_transpose(ctx, op):
         lhs_dilation=strides, rhs_dilation=dilations,
         dimension_numbers=('NCHW', 'OIHW', 'NCHW'),
         feature_group_count=groups,
-        preferred_element_type=jnp.float32)
-    ctx.out(op, 'Output', out.astype(x.dtype))
+        preferred_element_type=amp.accum_dtype(x))
+    ctx.out(op, 'Output', out.astype(out_dtype))
 
 
 @register_op('depthwise_conv2d_transpose')
