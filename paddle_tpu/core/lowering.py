@@ -47,6 +47,10 @@ class LowerContext(object):
         self.lod_explicit = set()
         # compile-time-constant feed values (numpy) for shape-bearing inputs
         self.statics = statics if statics is not None else {}
+        # statics recorded by the op currently lowering (lower_ops drops
+        # stale statics for outputs the op did NOT re-declare — e.g. an
+        # increment overwriting a fill_constant's recorded value)
+        self._static_written = set()
 
     # ---- reading inputs --------------------------------------------------
     def has(self, name):
@@ -109,6 +113,7 @@ class LowerContext(object):
         sequence_pad's Length, a pure function of the static LoD), so
         static_inputs consumers downstream can bind it."""
         self.statics[name] = np.asarray(value)
+        self._static_written.add(name)
 
     def static_value(self, name):
         """Concrete numpy value of a shape-bearing input. Available for feeds
@@ -154,7 +159,11 @@ def lower_ops(ctx, ops, lo, hi):
     for i in range(lo, hi):
         ctx.op_index = i
         op = ops[i]
+        ctx._static_written = set()
         get_op(op.type).lower(ctx, op)
+        for n in op.output_arg_names:
+            if n not in ctx._static_written:
+                ctx.statics.pop(n, None)
         _share_lod(ctx, op)
 
 

@@ -19,28 +19,34 @@ class TensorArray(object):
     """Fixed-capacity stacked array of same-shaped tensors.
 
     buffer: [capacity, *elem_shape]; length: int32 scalar (may be traced).
+    static_length: Python int when every write so far used a trace-time-
+    constant index (tracked via the executor's statics), else None. Lets
+    tensor_array_to_tensor emit exactly the written prefix with a static
+    shape. It is pytree AUX data: arrays riding a lax.while_loop/cond carry
+    must have it cleared (clear_static) so both branches/iterations agree.
     """
 
-    __slots__ = ('buffer', 'length')
+    __slots__ = ('buffer', 'length', 'static_length')
 
-    def __init__(self, buffer, length):
+    def __init__(self, buffer, length, static_length=None):
         self.buffer = buffer
         self.length = length
+        self.static_length = static_length
 
     # -- pytree protocol ---------------------------------------------------
     def tree_flatten(self):
-        return (self.buffer, self.length), None
+        return (self.buffer, self.length), self.static_length
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        return cls(*children)
+        return cls(children[0], children[1], aux)
 
     # -- construction ------------------------------------------------------
     @classmethod
     def empty(cls, capacity, elem_shape, dtype='float32'):
         buf = jnp.zeros((int(capacity),) + tuple(int(d) for d in elem_shape),
                         dtype=dtype)
-        return cls(buf, jnp.asarray(0, jnp.int32))
+        return cls(buf, jnp.asarray(0, jnp.int32), 0)
 
     @classmethod
     def from_list(cls, tensors, capacity=None):
@@ -49,7 +55,7 @@ class TensorArray(object):
         if capacity is not None and int(capacity) > n:
             pad = [(0, int(capacity) - n)] + [(0, 0)] * (stacked.ndim - 1)
             stacked = jnp.pad(stacked, pad)
-        return cls(stacked, jnp.asarray(n, jnp.int32))
+        return cls(stacked, jnp.asarray(n, jnp.int32), n)
 
     # -- ops ---------------------------------------------------------------
     @property
@@ -60,15 +66,24 @@ class TensorArray(object):
     def elem_shape(self):
         return self.buffer.shape[1:]
 
-    def write(self, i, value):
+    def write(self, i, value, static_i=None):
         """Write value at index i (int or traced scalar); length becomes
-        max(length, i+1) — reference write_to_array appends/overwrites."""
+        max(length, i+1) — reference write_to_array appends/overwrites.
+        static_i: the index's trace-time-constant value when known."""
         i = jnp.asarray(i, jnp.int32).reshape(())
         value = jnp.asarray(value, self.buffer.dtype)
         buf = lax.dynamic_update_index_in_dim(
             self.buffer, value, i, axis=0)
         new_len = jnp.maximum(self.length, i + 1)
-        return TensorArray(buf, new_len)
+        new_static = (max(self.static_length, int(static_i) + 1)
+                      if self.static_length is not None and
+                      static_i is not None else None)
+        return TensorArray(buf, new_len, new_static)
+
+    def clear_static(self):
+        """Drop the static length (before riding a loop/cond carry, where
+        pytree aux must be iteration-invariant)."""
+        return TensorArray(self.buffer, self.length, None)
 
     def read(self, i):
         i = jnp.asarray(i, jnp.int32).reshape(())

@@ -241,9 +241,13 @@ class While(object):
             layers.less_than(i, n, cond=cond)
     """
 
-    def __init__(self, cond, is_test=False, name=None):
+    def __init__(self, cond, is_test=False, name=None, max_trip_count=None):
         self.helper = LayerHelper('while', name=name)
         self.cond_var = cond
+        # when set, the loop can be differentiated: it lowers to a bounded
+        # lax.scan with an active-mask instead of lax.while_loop (which has
+        # no reverse-mode rule)
+        self.max_trip_count = max_trip_count
 
     @contextlib.contextmanager
     def block(self):
@@ -255,11 +259,14 @@ class While(object):
             yield
         finally:
             main._rollback()
+        attrs = {'sub_block': sub.idx}
+        if self.max_trip_count is not None:
+            attrs['max_trip_count'] = int(self.max_trip_count)
         parent.append_op(
             type='while',
             inputs={'Condition': [self.cond_var]},
             outputs={},
-            attrs={'sub_block': sub.idx})
+            attrs=attrs)
 
 
 # ---------------------------------------------------------------------------

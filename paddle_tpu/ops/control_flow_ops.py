@@ -67,6 +67,30 @@ def _sub_block(ctx, op, attr='sub_block'):
     return ctx.program.block(int(op.attr(attr)))
 
 
+def _bind_parent_declared(ctx, written):
+    """Vars a block writes that are declared in the parent block but not yet
+    bound in the env: materialize a zero init from the declared shape/dtype
+    so the write is carried (reference: create var in parent, first assign
+    inside the block). Unknowable shapes raise instead of silently dropping
+    the write (ADVICE round 1)."""
+    for n in sorted(written):
+        if ctx.has(n):
+            continue
+        var = ctx.block._find_var_recursive(n)
+        if var is None or getattr(var, 'persistable', False):
+            continue  # block-local temporary (declared in sub-block) or state
+        shape = getattr(var, 'shape', None)
+        dtype = getattr(var, 'dtype', None)
+        if shape is None or dtype is None or any(
+                d is None or int(d) < 0 for d in shape):
+            raise ValueError(
+                "variable %r is declared in the parent block and first "
+                "written inside a control-flow block, but its shape/dtype "
+                "(%s, %s) is not fully known — assign it an initial value "
+                "in the parent block first" % (n, shape, dtype))
+        ctx.env[n] = jnp.zeros(tuple(int(d) for d in shape), dtype=dtype)
+
+
 def _written_names(program, block, acc=None):
     """All var names any op in `block` (or nested sub-blocks) writes."""
     if acc is None:
@@ -131,6 +155,7 @@ def _while(ctx, op):
     cond_name = op.input('Condition')[0]
 
     written = _written_names(ctx.program, block)
+    _bind_parent_declared(ctx, written)
     carried = sorted(n for n in written if ctx.has(n))
     carried += sorted(_touched_arrays(ctx, block) - set(carried))
     if cond_name not in carried:
@@ -154,13 +179,42 @@ def _while(ctx, op):
     # and out of the body agree (e.g. python-int increments promoting)
     out_shapes = jax.eval_shape(run_body, init)
     init = {n: jnp.asarray(v, out_shapes[n].dtype)
-            if not isinstance(v, TensorArray) else v
+            if not isinstance(v, TensorArray) else v.clear_static()
             for n, v in init.items()}
 
     def cond_fn(carry):
         return jnp.reshape(jnp.asarray(carry[cond_name], bool), ())
 
-    final = lax.while_loop(cond_fn, run_body, init)
+    # Under the backward meta-op (ctx.wrt nonempty) lax.while_loop has no
+    # reverse-mode rule (reference supports while_grad, while_op.cc:125);
+    # lower to a bounded lax.scan with an active-mask instead. The bound
+    # comes from While(max_trip_count=...) or, failing that, the smallest
+    # capacity of a carried TensorArray (loops that write one slot per
+    # iteration cannot exceed it).
+    bound = op.attr('max_trip_count', None)
+    if ctx.wrt:
+        if bound is None:
+            # infer only from arrays the body WRITES (a read-only array's
+            # capacity says nothing about the trip count); loops appending
+            # one slot per iteration cannot exceed the capacity. Loops that
+            # overwrite a fixed slot should pass max_trip_count explicitly.
+            caps = [v.capacity for n, v in init.items()
+                    if isinstance(v, TensorArray) and n in written]
+            bound = min(caps) if caps else None
+        if bound is None:
+            raise ValueError(
+                "while inside a differentiated (training) program needs a "
+                "static trip-count bound for reverse-mode AD: pass "
+                "layers.While(cond, max_trip_count=N) or carry a "
+                "TensorArray whose capacity bounds the loop")
+
+        def scan_step(carry, _):
+            new = lax.cond(cond_fn(carry), run_body, lambda c: c, carry)
+            return new, None
+
+        final, _ = lax.scan(scan_step, init, None, length=int(bound))
+    else:
+        final = lax.while_loop(cond_fn, run_body, init)
     for n in carried:
         ctx.set(n, final[n])
 
@@ -176,13 +230,24 @@ def _conditional_block(ctx, op):
     cond_names = op.input('Cond') or op.input('Condition')
     is_scalar = bool(op.attr('is_scalar_condition', True))
     cond_vals = [ctx.get(n) for n in cond_names]
-    if is_scalar:
-        pred = jnp.reshape(jnp.asarray(cond_vals[0], bool), ())
-    else:
-        pred = jnp.all(jnp.stack(
-            [jnp.all(jnp.asarray(c, bool)) for c in cond_vals]))
-
     written = _written_names(ctx.program, block)
+    _bind_parent_declared(ctx, written)
+    if not is_scalar:
+        # reference semantics (conditional_block_op.cc:72): non-scalar mode
+        # runs the block iff the Input tensors are non-empty (numel != 0) —
+        # a STATIC property under XLA, so the branch resolves at trace time
+        # and the block is inlined (or skipped) with no lax.cond round-trip
+        if all(int(np.prod(np.shape(c))) != 0 for c in cond_vals):
+            exported = {n for n in written if ctx.has(n)}
+            exported |= _touched_arrays(ctx, block)
+            sub = ctx.child(dict(ctx.env), block=block)
+            lower_ops(sub, block.ops, 0, len(block.ops))
+            for n in exported:
+                if n in sub.env:
+                    ctx.set(n, sub.env[n])
+        return
+    pred = jnp.reshape(jnp.asarray(cond_vals[0], bool), ())
+
     carried = sorted(n for n in written if ctx.has(n))
     carried += sorted(_touched_arrays(ctx, block) - set(carried))
 
@@ -200,7 +265,7 @@ def _conditional_block(ctx, op):
     init = {n: ctx.env[n] for n in carried}
     out_shapes = jax.eval_shape(run_body, init)
     init = {n: jnp.asarray(v, out_shapes[n].dtype)
-            if not isinstance(v, TensorArray) else v
+            if not isinstance(v, TensorArray) else v.clear_static()
             for n, v in init.items()}
 
     final = lax.cond(pred, run_body, lambda c: c, init)
@@ -328,7 +393,11 @@ def _write_to_array(ctx, op):
         ph = EmptyTensorArray(int(op.attr('capacity', 128)))
         ph.record(x)
         arr = ph.materialize()
-    ctx.set(out_name, arr.write(i, x))
+    i_name = op.input('I')[0]
+    static_i = ctx.statics.get(i_name)
+    if static_i is not None:
+        static_i = int(np.asarray(static_i).reshape(-1)[0])
+    ctx.set(out_name, arr.write(i, x, static_i=static_i))
 
 
 @register_op('read_from_array')
@@ -349,21 +418,41 @@ def _lod_array_length(ctx, op):
 
 @register_op('tensor_array_to_tensor')
 def _tensor_array_to_tensor(ctx, op):
+    """Concatenate/stack exactly the WRITTEN elements (reference
+    tensor_array_to_tensor_op.cc concatenates size() tensors, not the
+    backing capacity). With a static length the buffer is sliced to it. A
+    traced length (array written under a lax.while_loop) cannot produce a
+    dynamic output shape under XLA: the documented deviation is a
+    capacity-sized output with unwritten slots masked to zero — consumers
+    needing the exact extent read OutIndex[0] (= length) at runtime."""
     arr = ctx.in1(op, 'X')
     axis = int(op.attr('axis', 0))
     use_stack = bool(op.attr('use_stack', False))
     if isinstance(arr, EmptyTensorArray):
         arr = arr.materialize()
-    buf = arr.stack()                              # [cap, ...]
+    static_len = arr.static_length is not None
+    if static_len:
+        length = int(arr.static_length)
+        buf = arr.stack()[:length]                 # [len, ...]
+    else:
+        length = arr.capacity
+        buf = arr.masked_stack()                   # [cap, ...], zeros beyond
     if use_stack:
         out = buf if axis == 0 else jnp.moveaxis(buf, 0, axis)
     else:
-        parts = [buf[i] for i in range(buf.shape[0])]
-        out = jnp.concatenate(parts, axis=axis)
+        parts = [buf[i] for i in range(length)]
+        out = jnp.concatenate(parts, axis=axis) if parts else buf
+    # per-element extent along the concat axis, one entry per written element
+    extent = buf.shape[1 + axis] if buf.ndim > 1 + axis else 1
+    if static_len:
+        idx = jnp.full((max(length, 1),), extent, dtype='int32')
+    else:
+        # dynamic: [length, extent, extent, ...] — OutIndex[0] carries the
+        # true element count so downstream can mask
+        idx = jnp.full((length,), extent, dtype='int32').at[0].set(
+            arr.length.astype('int32'))
     ctx.out(op, 'Out', out)
-    ctx.out(op, 'OutIndex', jnp.full((buf.shape[0],),
-                                     buf.shape[1] if buf.ndim > 1 else 1,
-                                     dtype='int32'))
+    ctx.out(op, 'OutIndex', idx)
 
 
 # -- LoD <-> array glue (static-LoD versions) -------------------------------

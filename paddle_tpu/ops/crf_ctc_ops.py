@@ -292,6 +292,18 @@ def _ctc_align(ctx, op):
 # edit_distance
 # ---------------------------------------------------------------------------
 
+def _trim_sentinel(toks, lens):
+    """Effective lengths ignoring the -1 padding ctc_align leaves in its
+    left-justified static-shape output (tokens after the first -1 are
+    padding, not hypothesis tokens) — so ctc_greedy_decoder output composes
+    with edit_distance exactly like the reference's shrunk tensors."""
+    is_pad = (toks == -1)
+    first_pad = jnp.where(is_pad.any(axis=1),
+                          jnp.argmax(is_pad, axis=1),
+                          toks.shape[1]).astype(lens.dtype)
+    return jnp.minimum(lens, first_pad)
+
+
 @register_op('edit_distance')
 def _edit_distance(ctx, op):
     hyp = ctx.in1(op, 'Hyps')                   # [totalH, 1] ragged
@@ -306,8 +318,8 @@ def _edit_distance(ctx, op):
 
     H = _to_padded(hyp.reshape(-1), h_gidx, n_seq, maxh).astype('int32')
     R = _to_padded(ref.reshape(-1), r_gidx, n_seq, maxr).astype('int32')
-    h_lens_j = jnp.asarray(h_lens)
-    r_lens_j = jnp.asarray(r_lens)
+    h_lens_j = _trim_sentinel(H, jnp.asarray(h_lens))
+    r_lens_j = _trim_sentinel(R, jnp.asarray(r_lens))
 
     # DP rows over hypothesis positions; vectorized over batch and ref cols
     j_idx = jnp.arange(maxr + 1)

@@ -38,6 +38,12 @@ def _fill_constant(ctx, op):
     shape = tuple(op.attr('shape', ()))
     value = op.attr('value', 0.0)
     ctx.out(op, 'Out', jnp.full(shape, value, dtype=dtype))
+    # the value is a trace-time constant; record it so shape-bearing
+    # consumers (TensorArray write indices etc.) can stay static. Only
+    # small constants — the consumers need scalars, not zeroed buffers.
+    if int(np.prod(shape or (1,))) <= 16:
+        ctx.set_static(op.output('Out')[0],
+                       np.full(shape, value, dtype=dtype))
 
 
 @register_op('fill_constant_batch_size_like')

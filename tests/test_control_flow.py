@@ -329,3 +329,113 @@ def test_beam_search_decode_backtrack():
     assert list(si[0, 0, :2]) == [4, 5]
     assert list(si[0, 1, :2]) == [3, 6]
     assert np.allclose(ss[0], [-3., -4.])
+
+
+def test_while_differentiable_with_max_trip_count():
+    # ADVICE r1: a While feeding a loss must be trainable (reference
+    # while_grad). Bounded-scan lowering under the backward meta-op.
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name='x', shape=[4], append_batch_size=False)
+        w = layers.create_parameter([4], 'float32', name='w',
+                                    default_initializer=fluid.initializer.
+                                    ConstantInitializer(0.5))
+        i = layers.fill_constant(shape=[1], dtype='int64', value=0)
+        n = layers.fill_constant(shape=[1], dtype='int64', value=3)
+        s = layers.fill_constant(shape=[4], dtype='float32', value=0.0)
+        s.stop_gradient = False   # grads must flow through the accumulator
+        cond = layers.less_than(i, n)
+        loop = layers.While(cond, max_trip_count=8)
+        with loop.block():
+            layers.assign(layers.elementwise_add(
+                s, layers.elementwise_mul(x, w)), s)
+            layers.increment(i, value=1, in_place=True)
+            layers.less_than(i, n, cond=cond)
+        loss = layers.reduce_sum(s)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = _exe()
+    scope = fluid.Scope()
+    xv = np.ones(4, 'float32')
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        l0, = exe.run(main, feed={'x': xv}, fetch_list=[loss], scope=scope)
+        w1 = np.array(scope.get('w'))
+    # loss = sum(3 * x * w) = 3*4*0.5 = 6; dL/dw = 3*x = 3
+    assert np.allclose(l0, 6.0)
+    assert np.allclose(w1, 0.5 - 0.1 * 3.0)
+
+
+def test_while_in_training_without_bound_raises():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name='x', shape=[4], append_batch_size=False)
+        w = layers.create_parameter([4], 'float32', name='w2')
+        i = layers.fill_constant(shape=[1], dtype='int64', value=0)
+        n = layers.fill_constant(shape=[1], dtype='int64', value=3)
+        s = layers.fill_constant(shape=[4], dtype='float32', value=0.0)
+        cond = layers.less_than(i, n)
+        loop = layers.While(cond)          # no max_trip_count, no array
+        with loop.block():
+            layers.assign(layers.elementwise_add(
+                s, layers.elementwise_mul(x, w)), s)
+            layers.increment(i, value=1, in_place=True)
+            layers.less_than(i, n, cond=cond)
+        loss = layers.reduce_sum(s)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = _exe()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        with pytest.raises(Exception, match='trip-count bound'):
+            exe.run(main, feed={'x': np.ones(4, 'float32')},
+                    fetch_list=[loss], scope=scope)
+
+
+def test_tensor_array_to_tensor_written_length_only():
+    # ADVICE r1: concatenates the 3 written elements, not capacity=8 slots
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        arr = layers.create_array('float32', capacity=8)
+        for k in range(3):
+            v = layers.fill_constant([2], 'float32', float(k + 1))
+            arr = layers.array_write(
+                v, layers.fill_constant([], 'int32', k), array=arr)
+        out, out_index = layers.tensor_array_to_tensor(arr, axis=0)
+    exe = _exe()
+    exe.run(startup)
+    o, oi = exe.run(main, fetch_list=[out, out_index])
+    assert o.shape == (6,)
+    assert np.allclose(o, [1, 1, 2, 2, 3, 3])
+    assert oi.shape == (3,)
+    assert np.all(oi == 2)
+
+
+def test_var_first_written_inside_block_is_carried():
+    # ADVICE r1: var declared in parent, first assigned inside the block
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        flag = layers.fill_constant([1], 'bool', True)
+        out = main.current_block().create_var(
+            name='cb_out', shape=[2], dtype='float32')
+        cb = layers.ConditionalBlock([flag], is_scalar_condition=True)
+        with cb.block():
+            layers.assign(layers.fill_constant([2], 'float32', 7.0), out)
+    exe = _exe()
+    exe.run(startup)
+    o, = exe.run(main, fetch_list=['cb_out'])
+    assert np.allclose(o, 7.0)
+
+
+def test_conditional_block_nonscalar_numel_semantics():
+    # reference: non-scalar mode runs iff Input tensors are non-empty
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xs = layers.fill_constant([2], 'float32', 0.0)  # all-false values,
+        acc = layers.fill_constant([1], 'float32', 0.0)  # but numel != 0
+        cb = layers.ConditionalBlock([xs], is_scalar_condition=False)
+        with cb.block():
+            layers.assign(layers.fill_constant([1], 'float32', 5.0), acc)
+    exe = _exe()
+    exe.run(startup)
+    a, = exe.run(main, fetch_list=[acc])
+    assert np.allclose(a, 5.0)     # ran despite values being zero/false

@@ -398,3 +398,31 @@ def test_chunk_eval_perfect_and_plain():
                 fetch_list=list(outs))
     assert o[3][0] == 2 and o[4][0] == 2 and o[5][0] == 2
     assert np.allclose(o[0][0], 1.0) and np.allclose(o[2][0], 1.0)
+
+
+def test_ctc_decoder_composes_with_edit_distance():
+    # ADVICE r1: the -1 padding ctc_align leaves must not count as
+    # hypothesis tokens when fed into edit_distance (the standard CTC
+    # eval pipeline: ctc_greedy_decoder -> edit_distance).
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = layers.data(name='ids', shape=[1], dtype='int64',
+                          lod_level=1)
+        ref = layers.data(name='ref', shape=[1], dtype='int64',
+                          lod_level=1)
+        helper = fluid.layer_helper.LayerHelper('ctc_align')
+        out = helper.create_variable_for_type_inference('int64')
+        helper.append_op(type='ctc_align', inputs={'Input': [ids]},
+                         outputs={'Output': [out]}, attrs={'blank': 0})
+        dist = layers.edit_distance(out, ref, normalized=False)
+        dist = dist[0] if isinstance(dist, (tuple, list)) else dist
+    exe = fluid.Executor()
+    exe.run(startup)
+    # seq1 raw: [1 1 0 2] -> aligned [1 2] ; seq2 raw: [0 3 3] -> [3]
+    ids_v = (np.array([[1], [1], [0], [2], [0], [3], [3]], 'int64'),
+             [[0, 4, 7]])
+    # refs: [1 2] (exact) and [3 4] (one deletion)
+    ref_v = (np.array([[1], [2], [3], [4]], 'int64'), [[0, 2, 4]])
+    d, = exe.run(main, feed={'ids': ids_v, 'ref': ref_v},
+                 fetch_list=[dist])
+    assert np.allclose(np.asarray(d).reshape(-1), [0.0, 1.0])
