@@ -61,5 +61,7 @@ from .compiler import CompiledProgram
 from .parallel_executor import ParallelExecutor
 from .parallel_executor import ExecutionStrategy, BuildStrategy
 from . import contrib
+from . import inference
+from .inference import Predictor, PredictorConfig, create_predictor
 
 __version__ = '0.1.0'

@@ -386,28 +386,64 @@ class Program(object):
     def _prune(self, targets):
         """Keep only ops needed to compute `targets` (names or Variables).
         Reference framework/prune.cc via Program._prune. Used by
-        save_inference_model."""
+        save_inference_model.
+
+        Control-flow ops (while/conditional_block/...) declare no data
+        outputs in their op desc — their effect is the vars their sub-block
+        writes. They are kept whenever the sub-block (transitively) writes a
+        needed var, and the sub-block's reads become needed in turn
+        (reference prune.cc walks sub-block descs the same way). Sub-blocks
+        themselves are kept whole: their internal ops are the loop/branch
+        body, not dead code."""
         names = set()
         for t in targets:
             names.add(t.name if isinstance(t, Variable) else t)
         p = self.clone()
-        for block in p.blocks:
-            needed = set(names)
-            kept = []
-            for op in reversed(block.ops):
-                if any(n in needed for n in op.output_arg_names) or \
-                        op.type in ('feed',):
-                    kept.append(op)
-                    needed.update(op.input_arg_names)
-            kept.reverse()
-            block.ops = kept
-            used = set()
-            for op in block.ops:
-                used.update(op.input_arg_names)
-                used.update(op.output_arg_names)
-            block.vars = collections.OrderedDict(
-                (k, v) for k, v in block.vars.items()
-                if k in used or k in names or v.persistable)
+
+        def _block_io(bidx, seen):
+            """(reads, writes) of a block including nested sub-blocks."""
+            if bidx in seen:
+                return set(), set()
+            seen.add(bidx)
+            reads, writes = set(), set()
+            for op in p.block(bidx).ops:
+                reads.update(op.input_arg_names)
+                writes.update(op.output_arg_names)
+                sb = op.attrs.get('sub_block')
+                if isinstance(sb, int):
+                    r, w = _block_io(sb, seen)
+                    reads |= r
+                    writes |= w
+            return reads, writes
+
+        gb = p.global_block()
+        needed = set(names)
+        kept = []
+        for op in reversed(gb.ops):
+            out_names = set(op.output_arg_names)
+            extra_reads = set()
+            sb = op.attrs.get('sub_block')
+            if isinstance(sb, int):
+                r, w = _block_io(sb, set())
+                out_names |= w
+                extra_reads = r
+            if (out_names & needed) or op.type == 'feed':
+                kept.append(op)
+                needed.update(op.input_arg_names)
+                needed.update(extra_reads)
+        kept.reverse()
+        gb.ops = kept
+        used = set()
+        for op in gb.ops:
+            used.update(op.input_arg_names)
+            used.update(op.output_arg_names)
+            sb = op.attrs.get('sub_block')
+            if isinstance(sb, int):
+                r, w = _block_io(sb, set())
+                used |= r | w
+        gb.vars = collections.OrderedDict(
+            (k, v) for k, v in gb.vars.items()
+            if k in used or k in names or v.persistable)
         p._bump_version()
         return p
 

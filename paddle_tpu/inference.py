@@ -1,0 +1,71 @@
+"""Inference predictor facade: load a saved model -> compiled callable.
+
+Reference counterpart: AnalysisPredictor (paddle/fluid/inference/api/
+analysis_predictor.cc:183 Run; api_impl.cc NativePredictor). TPU-native
+redesign: the predictor owns a private Scope + Executor; the first run jits
+the pruned inference program for the feed signature and XLA caches the
+compiled executable, which IS the "analysis + optimization" stage (fusion,
+layout, memory planning all happen in XLA rather than hand-written passes).
+"""
+import numpy as np
+
+from .executor import Executor, Scope, scope_guard
+from . import io as _io
+
+__all__ = ['PredictorConfig', 'Predictor', 'create_predictor']
+
+
+class PredictorConfig(object):
+    """Analog of AnalysisConfig (contrib/inference AnalysisConfig)."""
+
+    def __init__(self, model_dir=None, model_filename=None,
+                 params_filename=None):
+        self.model_dir = model_dir
+        self.model_filename = model_filename
+        self.params_filename = params_filename
+
+
+class Predictor(object):
+    def __init__(self, config):
+        if isinstance(config, str):
+            config = PredictorConfig(model_dir=config)
+        self.config = config
+        self.scope = Scope()
+        self.executor = Executor()
+        with scope_guard(self.scope):
+            prog, feed_names, fetch_vars = _io.load_inference_model(
+                config.model_dir, self.executor,
+                model_filename=config.model_filename,
+                params_filename=config.params_filename)
+        self.program = prog
+        self.feed_names = list(feed_names)
+        self.fetch_vars = fetch_vars
+
+    def get_input_names(self):
+        return list(self.feed_names)
+
+    def get_output_names(self):
+        return [v.name for v in self.fetch_vars]
+
+    def run(self, feed):
+        """feed: dict name->array, or list of arrays in feed_names order.
+        Returns list of numpy arrays in fetch order
+        (AnalysisPredictor::Run analog)."""
+        if not isinstance(feed, dict):
+            arrays = list(feed)
+            if len(arrays) != len(self.feed_names):
+                raise ValueError(
+                    "expected %d inputs %s, got %d"
+                    % (len(self.feed_names), self.feed_names, len(arrays)))
+            feed = dict(zip(self.feed_names, arrays))
+        missing = [n for n in self.feed_names if n not in feed]
+        if missing:
+            raise ValueError("missing feeds: %s" % missing)
+        with scope_guard(self.scope):
+            outs = self.executor.run(self.program, feed=feed,
+                                     fetch_list=self.fetch_vars)
+        return [np.asarray(o) for o in outs]
+
+
+def create_predictor(config):
+    return Predictor(config)

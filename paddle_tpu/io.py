@@ -8,16 +8,18 @@ TPU-native redesign: the Scope IS the checkpoint ("everything persistable is
 the checkpoint", reference operators/save_op.cc raw serialization) — we
 serialize scope entries with numpy .npz (single-file, save_combine-style) or
 one .npy per var (per-var files, save-op style). Inference models serialize
-the pruned Program via pickle of its IR + params, the analog of the
-reference's `__model__` ProgramDesc proto + param files.
+the pruned Program via a durable versioned JSON schema (core/serialization.py)
++ params, the analog of the reference's `__model__` ProgramDesc proto + param
+files — no pickle, so saved models survive refactors and load cross-process.
 """
+import json
 import os
-import pickle
 
 import numpy as np
 
 from .framework import Program, Parameter, Variable, default_main_program
 from .executor import global_scope
+from .core import serialization as _ser
 
 __all__ = [
     'save_vars', 'save_params', 'save_persistables', 'load_vars',
@@ -135,10 +137,11 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
 
     os.makedirs(dirname, exist_ok=True)
     model_path = os.path.join(dirname, model_filename or MODEL_FILENAME)
-    with open(model_path, 'wb') as f:
-        pickle.dump({'program': pruned,
-                     'feed_names': list(feeded_var_names),
-                     'fetch_names': target_names}, f)
+    blob = _ser.program_to_dict(pruned)
+    blob['feed_names'] = list(feeded_var_names)
+    blob['fetch_names'] = target_names
+    with open(model_path, 'w') as f:
+        json.dump(blob, f)
     # save ALL persistables, not just Parameters: batch-norm moving stats etc.
     # are persistable plain Variables (reference io.py:1011 does the same)
     save_persistables(executor, dirname, pruned,
@@ -150,9 +153,9 @@ def load_inference_model(dirname, executor, model_filename=None,
                          params_filename=None, pserver_endpoints=None):
     """Returns (program, feed_names, fetch_names) (reference io.py:1014)."""
     model_path = os.path.join(dirname, model_filename or MODEL_FILENAME)
-    with open(model_path, 'rb') as f:
-        blob = pickle.load(f)
-    program = blob['program']
+    with open(model_path, 'r') as f:
+        blob = json.load(f)
+    program = _ser.program_from_dict(blob)
     load_persistables(executor, dirname, program,
                       filename=params_filename or PARAMS_FILENAME)
     fetch_vars = [program.global_block().var(n)

@@ -212,7 +212,29 @@ def _while(ctx, op):
             new = lax.cond(cond_fn(carry), run_body, lambda c: c, carry)
             return new, None
 
+        inferred = op.attr('max_trip_count', None) is None
         final, _ = lax.scan(scan_step, init, None, length=int(bound))
+        if inferred:
+            # An inferred bound (TensorArray capacity) is a heuristic: loops
+            # that overwrite a fixed slot, or append past capacity, iterate
+            # more times than it. Silent truncation would train on wrong
+            # numbers — check the condition actually went false. (A
+            # user-passed max_trip_count is an explicit contract and is not
+            # checked.) debug.callback needs host-callback support.
+            def _check_exhausted(c, _bound=int(bound)):
+                if bool(np.any(np.asarray(c))):
+                    raise RuntimeError(
+                        "while: inferred trip-count bound %d (from TensorArray "
+                        "capacity) was too small — the loop condition is still "
+                        "true after %d iterations. Pass layers.While(cond, "
+                        "max_trip_count=N) with the real bound." %
+                        (_bound, _bound))
+            try:
+                supports_cb = jax.default_backend() in ('cpu', 'tpu', 'gpu')
+            except Exception:
+                supports_cb = False
+            if supports_cb:
+                jax.debug.callback(_check_exhausted, final[cond_name])
     else:
         final = lax.while_loop(cond_fn, run_body, init)
     for n in carried:

@@ -318,8 +318,12 @@ def _edit_distance(ctx, op):
 
     H = _to_padded(hyp.reshape(-1), h_gidx, n_seq, maxh).astype('int32')
     R = _to_padded(ref.reshape(-1), r_gidx, n_seq, maxr).astype('int32')
+    # Only Hyps get sentinel trimming: hypotheses come from ctc_align, whose
+    # static-shape output left-justifies tokens and pads with -1. Refs are
+    # user labels; the reference implementation has no sentinel semantics for
+    # them, and -1 must stay a legitimate (mismatching) token there.
     h_lens_j = _trim_sentinel(H, jnp.asarray(h_lens))
-    r_lens_j = _trim_sentinel(R, jnp.asarray(r_lens))
+    r_lens_j = jnp.asarray(r_lens)
 
     # DP rows over hypothesis positions; vectorized over batch and ref cols
     j_idx = jnp.arange(maxr + 1)

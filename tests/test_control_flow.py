@@ -439,3 +439,36 @@ def test_conditional_block_nonscalar_numel_semantics():
     exe.run(startup)
     a, = exe.run(main, fetch_list=[acc])
     assert np.allclose(a, 5.0)     # ran despite values being zero/false
+
+
+def test_while_inferred_bound_too_small_errors():
+    """code-review r2: a trip-count bound inferred from TensorArray capacity
+    that is smaller than the real trip count must error loudly, not silently
+    truncate the loop (wrong loss/gradients)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name='x', shape=[4], append_batch_size=False)
+        w = layers.create_parameter([4], 'float32', name='w3')
+        i = layers.fill_constant(shape=[1], dtype='int64', value=0)
+        n = layers.fill_constant(shape=[1], dtype='int64', value=5)
+        s = layers.fill_constant(shape=[4], dtype='float32', value=0.0)
+        s.stop_gradient = False
+        arr = layers.create_array('float32', capacity=2)  # cap < 5 trips
+        zero = layers.fill_constant([], 'int32', 0)
+        cond = layers.less_than(i, n)
+        loop = layers.While(cond)            # bound inferred from capacity
+        with loop.block():
+            layers.assign(layers.elementwise_add(
+                s, layers.elementwise_mul(x, w)), s)
+            layers.array_write(s, zero, array=arr)   # overwrites slot 0
+            layers.increment(i, value=1, in_place=True)
+            layers.less_than(i, n, cond=cond)
+        loss = layers.reduce_sum(s)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = _exe()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        with pytest.raises(Exception, match='too small'):
+            exe.run(main, feed={'x': np.ones(4, 'float32')},
+                    fetch_list=[loss], scope=scope)

@@ -426,3 +426,41 @@ def test_ctc_decoder_composes_with_edit_distance():
     d, = exe.run(main, feed={'ids': ids_v, 'ref': ref_v},
                  fetch_list=[dist])
     assert np.allclose(np.asarray(d).reshape(-1), [0.0, 1.0])
+
+
+def test_edit_distance_minus_one_in_refs_is_a_token():
+    """code-review r2: only Hyps (ctc_align output) get -1 sentinel trimming;
+    a -1 inside a reference label sequence is a real (mismatching) token,
+    exactly like the reference implementation treats it."""
+    hyp_seqs = [[1, 2]]
+    ref_seqs = [[1, -1, 2]]              # -1 is a legitimate ref token
+    hyp = np.array(sum(hyp_seqs, []), 'int64').reshape(-1, 1)
+    ref = np.array(sum(ref_seqs, []), 'int64').reshape(-1, 1)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        h = layers.data(name='h', shape=[1], dtype='int64', lod_level=1)
+        r = layers.data(name='r', shape=[1], dtype='int64', lod_level=1)
+        dist, _ = layers.edit_distance(h, r, normalized=False)
+    exe = _exe()
+    exe.run(startup)
+    d, = exe.run(main, feed={'h': (hyp, [[0, 2]]), 'r': (ref, [[0, 3]])},
+                 fetch_list=[dist])
+    # trimming refs at -1 would give distance([1,2],[1]) = 1; correct is
+    # distance([1,2],[1,-1,2]) = 1 insertion = 1 ... pick a case that differs:
+    assert np.allclose(d[0, 0], levenshtein([1, 2], [1, -1, 2]))
+
+
+def test_edit_distance_ref_trailing_minus_one_counts():
+    # distinguishing case: trimming refs at the first -1 changes the answer
+    hyp = np.array([[1]], 'int64')
+    ref = np.array([[1], [-1], [-1]], 'int64')
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        h = layers.data(name='h', shape=[1], dtype='int64', lod_level=1)
+        r = layers.data(name='r', shape=[1], dtype='int64', lod_level=1)
+        dist, _ = layers.edit_distance(h, r, normalized=False)
+    exe = _exe()
+    exe.run(startup)
+    d, = exe.run(main, feed={'h': (hyp, [[0, 1]]), 'r': (ref, [[0, 3]])},
+                 fetch_list=[dist])
+    assert np.allclose(d[0, 0], 2.0)     # two deletions, NOT 0

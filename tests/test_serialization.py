@@ -1,0 +1,175 @@
+"""Durable model format + Predictor tests.
+
+Reference behavior being matched: __model__ is a durable on-disk artifact
+(inference/io.cc:1, python io.py:862) decoupled from the Python classes, and
+AnalysisPredictor loads it and serves feed->run->fetch
+(analysis_predictor.cc:183).
+"""
+import json
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.core import serialization as ser
+
+
+def _build_and_train(tmp_path, model_dir_name='model'):
+    x = fluid.layers.data(name='x', shape=[8], dtype='float32')
+    y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+    h = fluid.layers.fc(input=x, size=16, act='relu')
+    pred = fluid.layers.fc(input=h, size=1)
+    loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    xs = rng.randn(64, 8).astype('float32')
+    ys = (xs.sum(axis=1, keepdims=True) * 0.1).astype('float32')
+    for _ in range(5):
+        exe.run(feed={'x': xs, 'y': ys}, fetch_list=[loss])
+    model_dir = str(tmp_path / model_dir_name)
+    fluid.save_inference_model(model_dir, ['x'], [pred], exe)
+    ref_out = exe.run(fluid.default_main_program(), feed={'x': xs[:4],
+                                                          'y': ys[:4]},
+                      fetch_list=[pred])[0]
+    return model_dir, xs, np.asarray(ref_out)
+
+
+def test_model_file_is_json_not_pickle(tmp_path):
+    model_dir, _, _ = _build_and_train(tmp_path)
+    # the model file must be plain JSON: loadable by any process/version,
+    # no pickle opcodes, no class references
+    with open(model_dir + '/__model__') as f:
+        blob = json.load(f)
+    assert blob['format'] == 'paddle_tpu.program'
+    assert blob['version'] == 1
+    assert blob['feed_names'] == ['x']
+    txt = json.dumps(blob)
+    assert 'paddle_tpu.framework' not in txt  # no class paths anywhere
+
+
+def test_save_load_roundtrip_outputs_match(tmp_path):
+    model_dir, xs, ref_out = _build_and_train(tmp_path)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        prog, feed_names, fetch_vars = fluid.load_inference_model(
+            model_dir, exe)
+        out = exe.run(prog, feed={feed_names[0]: xs[:4]},
+                      fetch_list=fetch_vars, scope=scope2)
+    np.testing.assert_allclose(np.asarray(out[0]), ref_out, rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_predictor_feed_run_fetch(tmp_path):
+    model_dir, xs, ref_out = _build_and_train(tmp_path)
+    pred = fluid.create_predictor(fluid.PredictorConfig(model_dir=model_dir))
+    assert pred.get_input_names() == ['x']
+    # dict feed
+    out = pred.run({'x': xs[:4]})
+    np.testing.assert_allclose(out[0], ref_out, rtol=1e-5, atol=1e-5)
+    # positional feed
+    out2 = pred.run([xs[:4]])
+    np.testing.assert_allclose(out2[0], ref_out, rtol=1e-5, atol=1e-5)
+    # two predictors coexist without clobbering each other's scopes
+    pred2 = fluid.Predictor(model_dir)
+    out3 = pred2.run({'x': xs[:4]})
+    np.testing.assert_allclose(out3[0], ref_out, rtol=1e-5, atol=1e-5)
+
+
+def test_attr_codec_roundtrip():
+    cases = [
+        1, 1.5, True, None, 'abc', [1, 2, 3], [1.0, 'x'],
+        np.dtype('float32'), np.dtype('int64'),
+        np.int64(7), np.float32(0.5),
+        np.arange(6, dtype=np.int32).reshape(2, 3),
+        np.linspace(0, 1, 4).astype('float32'),
+        {'lr': 1.0, 'nested': [1, 2]},
+    ]
+    for v in cases:
+        enc = ser.encode_attr(v)
+        json.dumps(enc)  # must be JSON-clean
+        dec = ser.decode_attr(enc)
+        if isinstance(v, np.ndarray):
+            np.testing.assert_array_equal(dec, v)
+            assert dec.dtype == v.dtype
+        elif isinstance(v, np.dtype):
+            assert dec == v
+        elif isinstance(v, (np.integer, np.floating)):
+            assert dec == v
+        elif isinstance(v, tuple):
+            assert list(dec) == list(v)
+        else:
+            assert dec == v
+
+
+def test_unserializable_attr_raises_at_save():
+    class Weird(object):
+        pass
+    try:
+        ser.encode_attr(Weird())
+    except TypeError as e:
+        assert 'not serializable' in str(e)
+    else:
+        raise AssertionError('expected TypeError')
+
+
+def test_multiblock_program_roundtrips():
+    """Control-flow programs (sub-blocks) must survive the durable format."""
+    i = fluid.layers.fill_constant(shape=[1], dtype='int64', value=0)
+    ten = fluid.layers.fill_constant(shape=[1], dtype='int64', value=10)
+    acc = fluid.layers.fill_constant(shape=[1], dtype='float32', value=0.0)
+    cond = fluid.layers.less_than(i, ten)
+    w = fluid.layers.While(cond, max_trip_count=10)
+    with w.block():
+        fluid.layers.assign(acc + 1.0, acc)
+        fluid.layers.increment(i, value=1, in_place=True)
+        fluid.layers.less_than(i, ten, cond=cond)
+    prog = fluid.default_main_program()
+    assert prog.num_blocks > 1
+
+    d = ser.program_to_dict(prog)
+    json.dumps(d)
+    prog2 = ser.program_from_dict(d)
+    assert prog2.num_blocks == prog.num_blocks
+    assert [len(b.ops) for b in prog2.blocks] == \
+        [len(b.ops) for b in prog.blocks]
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    ref = exe.run(prog, fetch_list=[acc.name])[0]
+    scope2 = fluid.Scope()
+    out = exe.run(prog2, fetch_list=[acc.name], scope=scope2)[0]
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref))
+    assert float(np.asarray(out)[0]) == 10.0
+
+
+def test_save_inference_model_keeps_while(tmp_path):
+    """Regression: _prune must keep control-flow ops whose SUB-BLOCK writes
+    the target (they declare no outputs themselves) — a pruned-away While
+    silently returned the loop vars' init values."""
+    x = fluid.layers.data(name='x', shape=[4], append_batch_size=False)
+    i = fluid.layers.fill_constant(shape=[1], dtype='int64', value=0)
+    n = fluid.layers.fill_constant(shape=[1], dtype='int64', value=3)
+    s = fluid.layers.fill_constant(shape=[4], dtype='float32', value=0.0)
+    cond = fluid.layers.less_than(i, n)
+    w = fluid.layers.While(cond, max_trip_count=3)
+    with w.block():
+        fluid.layers.assign(fluid.layers.elementwise_add(s, x), s)
+        fluid.layers.increment(i, value=1, in_place=True)
+        fluid.layers.less_than(i, n, cond=cond)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    xs = np.ones((4,), dtype='float32')
+    direct = np.asarray(exe.run(feed={'x': xs}, fetch_list=[s])[0])
+    np.testing.assert_allclose(direct, [3, 3, 3, 3])
+
+    model_dir = str(tmp_path / 'while_model')
+    fluid.save_inference_model(model_dir, ['x'], [s], exe)
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        prog, feeds, fetches = fluid.load_inference_model(model_dir, exe)
+        assert any(op.type == 'while' for op in prog.global_block().ops)
+        out = exe.run(prog, feed={feeds[0]: xs}, fetch_list=fetches,
+                      scope=scope2)[0]
+    np.testing.assert_allclose(np.asarray(out), direct)
