@@ -12,8 +12,38 @@ the AD system and stop_gradient wrapping in the lowering.
 """
 from .framework import (Program, Parameter, Variable, grad_var_name,
                         default_main_program)
+from .core.types import VarType
 
 __all__ = ['append_backward', 'calc_gradient', 'gradients']
+
+
+def _find_sparse_params(program, param_names):
+    """Parameters whose gradient stays sparse (a SelectedRows), the analog of
+    the reference lookup_table_op is_sparse grad path
+    (operators/lookup_table_op.cc LookupTableGradOpDescMaker: grad var type
+    SELECTED_ROWS when Attr("is_sparse")).
+
+    A param qualifies iff every op that reads it (at append_backward time,
+    i.e. the forward segment) is a main-block `lookup_table` with
+    is_sparse=True consuming it as W. Sub-block consumers (while/cond bodies)
+    disqualify — carried loop state must stay dense."""
+    candidates = set(param_names)
+    consumed = set()
+    for block in program.blocks:
+        for op in block.ops:
+            if op.type == 'backward':
+                continue
+            for n in op.input_arg_names:
+                if n not in candidates:
+                    continue
+                ok = (block.idx == 0 and op.type == 'lookup_table'
+                      and op.attr('is_sparse', False)
+                      and n in op.input('W'))
+                if ok:
+                    consumed.add(n)
+                else:
+                    candidates.discard(n)
+    return candidates & consumed
 
 
 def _resolve_no_grad(no_grad_set):
@@ -44,14 +74,18 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
     if not params:
         raise ValueError("append_backward: no trainable parameters found")
 
+    sparse_names = _find_sparse_params(program, [p.name for p in params])
     grad_vars = []
     for p in params:
         g = block.create_var(
             name=grad_var_name(p.name), shape=p.shape, dtype=p.dtype,
-            persistable=False, stop_gradient=False)
+            persistable=False, stop_gradient=False,
+            type=(VarType.SELECTED_ROWS if p.name in sparse_names
+                  else VarType.LOD_TENSOR))
         grad_vars.append(g)
 
-    attrs = {'wrt_names': [p.name for p in params]}
+    attrs = {'wrt_names': [p.name for p in params],
+             'sparse_wrt': sorted(sparse_names)}
     if checkpoints:
         attrs['checkpoints'] = [c.name if isinstance(c, Variable) else c
                                 for c in checkpoints]

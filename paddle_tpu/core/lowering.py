@@ -210,6 +210,8 @@ def lower_block(ctx, lo=0):
     bop = ops[b]
     loss_name = bop.input('Loss')[0]
     wrt_names = list(bop.attr('wrt_names'))
+    sparse_set = set(bop.attr('sparse_wrt') or ())
+    dense_wrt = [n for n in wrt_names if n not in sparse_set]
     base_env = dict(ctx.env)
 
     missing = [n for n in wrt_names if n not in base_env]
@@ -218,23 +220,64 @@ def lower_block(ctx, lo=0):
             "backward: cannot differentiate w.r.t. %s — they are neither fed "
             "nor in scope state (only leaf variables are supported)" % missing)
 
+    # Sparse-embedding grads (reference lookup_table_op.cc is_sparse path):
+    # the table never enters the vjp wrt set, so AD never materializes a
+    # dense [vocab, dim] gradient. A scout lowering of the forward segment
+    # records each sparse lookup site's flattened ids (pure functions of the
+    # feeds — XLA DCEs the scout's dead outputs); the real forward then adds
+    # a zero-valued "dummy" of the gathered-rows shape at each site, and the
+    # pullback's dummy cotangents ARE the per-row gradients.
+    sites = []
+    if sparse_set:
+        sctx = ctx.child(dict(base_env))
+        sctx.sparse_tables = sparse_set
+        sctx.sparse_mode = 'scout'
+        sctx.sparse_sites = sites
+        lower_ops(sctx, ops, lo, b)
+
+    wrt_vals = {n: base_env[n] for n in dense_wrt}
+    for k, (tbl, flat_ids, dim, dtype) in enumerate(sites):
+        wrt_vals['@sparse%d' % k] = jnp.zeros((flat_ids.shape[0], dim), dtype)
+
     def fwd(wrt_vals):
         env2 = dict(base_env)
         env2.update(wrt_vals)
         sub = ctx.child(env2, wrt=set(wrt_names))
+        if sparse_set:
+            sub.sparse_tables = sparse_set
+            sub.sparse_mode = 'apply'
+            sub.sparse_counter = [0]
         lower_ops(sub, ops, lo, b)
         return env2[loss_name], env2
 
-    wrt_vals = {n: base_env[n] for n in wrt_names}
     (loss_val, env2), pullback = _vjp_with_aux(fwd, wrt_vals)
     grads = pullback(jnp.ones_like(loss_val))
 
+    per_table = {}
+    for k, (tbl, flat_ids, dim, dtype) in enumerate(sites):
+        per_table.setdefault(tbl, []).append(
+            (flat_ids, grads['@sparse%d' % k]))
+
     ctx.env = env2
     from ..framework import grad_var_name
+    from .selected_rows import SelectedRows
     grad_outs = bop.output('Grads')
     for i, n in enumerate(wrt_names):
         gname = grad_outs[i] if i < len(grad_outs) else grad_var_name(n)
-        g = grads[n]
+        if n in sparse_set:
+            pairs = per_table.get(n, [])
+            height = base_env[n].shape[0]
+            if not pairs:
+                dim = base_env[n].shape[1]
+                g = SelectedRows(jnp.full((1,), height, jnp.int32),
+                                 jnp.zeros((1, dim), base_env[n].dtype),
+                                 height)
+            else:
+                rows = jnp.concatenate([p[0] for p in pairs])
+                vals = jnp.concatenate([p[1] for p in pairs])
+                g = SelectedRows(rows, vals, height)
+        else:
+            g = grads[n]
         ctx.env[gname] = g
     lower_block(ctx, b + 1)
 

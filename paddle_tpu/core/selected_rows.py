@@ -1,0 +1,82 @@
+"""SelectedRows: the sparse (rows, values) gradient container, TPU-style.
+
+Reference framework/selected_rows.h stores {rows, value tensor, height}; the
+lookup_table grad kernel (operators/lookup_table_op.cc, is_sparse path) emits
+one instead of a dense table-sized gradient, and the optimizer ops
+(operators/optimizers/*.h SelectedRows kernels) apply it row-wise.
+
+TPU-native redesign: a JAX pytree of fixed-shape arrays — `rows` (int32 [n])
+and `values` ([n, d]) — with the table height as static aux data, so the whole
+thing flows through jit/vjp/pjit without dynamic shapes. Duplicate rows are
+allowed and mean accumulation (the reference's un-merged state); `merged()`
+combines duplicates with static shapes by parking the freed slots on an
+out-of-range sentinel row that scatter `mode='drop'` ignores.
+"""
+import jax
+import jax.numpy as jnp
+
+
+class SelectedRows(object):
+    """Sparse rows of a [height, d] tensor. rows: int32 [n]; values: [n, d]."""
+
+    def __init__(self, rows, values, height):
+        self.rows = rows
+        self.values = values
+        self.height = int(height)
+
+    def __repr__(self):
+        return "SelectedRows(n=%s, height=%d)" % (self.rows.shape, self.height)
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    def to_dense(self):
+        """Dense [height, d] gradient: scatter-add (duplicates accumulate,
+        sentinel rows drop)."""
+        z = jnp.zeros((self.height,) + tuple(self.values.shape[1:]),
+                      self.values.dtype)
+        return z.at[self.rows].add(self.values, mode='drop')
+
+    def merged(self):
+        """(rows, values) with duplicate rows summed (reference
+        math/selected_rows_functor.h MergeAdd), all shapes static.
+
+        Output has the same length n; slots freed by merging carry
+        row == height (out of range) and zero values, which downstream
+        gathers clamp harmlessly and scatters with mode='drop' ignore.
+        """
+        n = self.rows.shape[0]
+        order = jnp.argsort(self.rows)
+        r = self.rows[order]
+        v = self.values[order]
+        is_first = jnp.concatenate(
+            [jnp.ones((1,), bool), r[1:] != r[:-1]]) if n > 1 else \
+            jnp.ones((n,), bool)
+        seg = jnp.cumsum(is_first.astype(jnp.int32)) - 1
+        summed = jax.ops.segment_sum(v, seg, num_segments=n)
+        rows_m = jax.ops.segment_max(r, seg, num_segments=n)
+        k = jnp.sum(is_first.astype(jnp.int32))
+        valid = jnp.arange(n) < k
+        rows_m = jnp.where(valid, rows_m, self.height).astype(jnp.int32)
+        summed = jnp.where(valid[:, None], summed, 0)
+        return rows_m, summed
+
+    def scale(self, s):
+        return SelectedRows(self.rows, self.values * s, self.height)
+
+
+def _flatten(sr):
+    return (sr.rows, sr.values), sr.height
+
+
+def _unflatten(height, children):
+    rows, values = children
+    return SelectedRows(rows, values, height)
+
+
+jax.tree_util.register_pytree_node(SelectedRows, _flatten, _unflatten)
+
+
+def is_selected_rows(x):
+    return isinstance(x, SelectedRows)

@@ -282,7 +282,7 @@ class Executor(object):
         static_lods = dict(scope_lods)
         static_lods.update(feed_lods)
 
-        key = (id(program), program._version,
+        key = (program._uid, program._version,
                self._feed_signature(feed, static_lods, static_feed),
                tuple(fetch_names))
         entry = self._cache.get(key) if use_program_cache else None
@@ -320,6 +320,10 @@ class Executor(object):
                 scope._lods[n] = lod
             else:
                 scope._lods.pop(n, None)
+        from .core.selected_rows import SelectedRows
+        fetches = [f.to_dense() if isinstance(f, SelectedRows) else f
+                   for f in fetches]  # fetched sparse grads densify, like
+        # the reference's fetch of a SelectedRows var materializing a tensor
         if return_numpy:
             return [
                 _fetched(f, entry.lod_out[n])

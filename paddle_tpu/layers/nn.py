@@ -99,9 +99,11 @@ def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
 
 def embedding(input, size, is_sparse=False, is_distributed=False,
               padding_idx=None, param_attr=None, dtype='float32'):
-    """Embedding lookup (reference lookup_table_op). On TPU the sparse-grad
-    SelectedRows path becomes a dense scatter-add inside AD; is_sparse is
-    accepted for API parity."""
+    """Embedding lookup (reference lookup_table_op). With is_sparse=True the
+    gradient is a SelectedRows (rows, values) pair — the dense [vocab, dim]
+    cotangent is never materialized (see core/lowering.py backward handling)
+    and sgd/momentum/adam/adagrad apply it with row-wise scatter updates,
+    matching the reference's SelectedRows kernels."""
     helper = LayerHelper('embedding', param_attr=param_attr)
     w = helper.create_parameter(attr=helper.param_attr, shape=size,
                                 dtype=dtype, is_bias=False)

@@ -61,6 +61,20 @@ def _register_elementwise(name, fn):
     def _lower(ctx, op, _fn=fn):
         x = ctx.in1(op, 'X')
         y = ctx.in1(op, 'Y')
+        from ..core.selected_rows import SelectedRows
+        if isinstance(x, SelectedRows):
+            # Only mul/div distribute over the implicit zero rows; anything
+            # else (add/sub/max/pow/...) must see the dense tensor or the
+            # untouched rows silently miss the operation.
+            if name in ('elementwise_mul', 'elementwise_div') \
+                    and getattr(y, 'size', 0) == 1:
+                # e.g. global-norm clip's grad * factor (reference
+                # elementwise_mul SelectedRows kernel)
+                ctx.out(op, 'Out',
+                        SelectedRows(x.rows, _fn(x.values, y.reshape(())),
+                                     x.height))
+                return
+            x = x.to_dense()
         y = broadcast_y_to(x, y, op.attr('axis', -1))
         ctx.out(op, 'Out', _fn(x, y))
 
@@ -83,7 +97,11 @@ def _minus(ctx, op):
 
 @register_op('sum')
 def _sum(ctx, op):
-    xs = ctx.in_list(op, 'X')
+    """reference sum_op: mixing a SelectedRows input with dense inputs
+    densifies (used by append_regularization_ops on sparse grads)."""
+    from ..core.selected_rows import SelectedRows
+    xs = [x.to_dense() if isinstance(x, SelectedRows) else x
+          for x in ctx.in_list(op, 'X')]
     out = xs[0]
     for x in xs[1:]:
         out = out + x
@@ -156,6 +174,13 @@ def _l1_norm(ctx, op):
 @register_op('squared_l2_norm')
 def _squared_l2_norm(ctx, op):
     x = ctx.in1(op, 'X')
+    from ..core.selected_rows import SelectedRows
+    if isinstance(x, SelectedRows):
+        # merge first so duplicate rows accumulate before squaring, matching
+        # the norm of the equivalent dense gradient (GradientClipByGlobalNorm
+        # over sparse grads, reference clip.py:275-277)
+        _, vals = x.merged()
+        x = vals
     ctx.out(op, 'Out', jnp.sum(x * x).reshape(1))
 
 

@@ -105,6 +105,15 @@ def _scale(ctx, op):
     scale = op.attr('scale', 1.0)
     bias = op.attr('bias', 0.0)
     bias_after_scale = op.attr('bias_after_scale', True)
+    from ..core.selected_rows import SelectedRows
+    if isinstance(x, SelectedRows):
+        # reference scale_op SelectedRows kernel: scale values, keep rows.
+        # A bias would have to touch every implicit zero row too -> densify.
+        if bias != 0.0:
+            x = x.to_dense()
+        else:
+            ctx.out(op, 'Out', x.scale(scale))
+            return
     if bias_after_scale:
         out = x * scale + bias
     else:
@@ -122,13 +131,33 @@ def _increment(ctx, op):
 @register_op('clip')
 def _clip(ctx, op):
     x = ctx.in1(op, 'X')
+    from ..core.selected_rows import SelectedRows
+    if isinstance(x, SelectedRows):
+        # merge duplicates first: clip does not distribute over addition,
+        # so clipping per-occurrence values would diverge from the dense
+        # equivalent when an id repeats in the batch
+        rows, vals = x.merged()
+        ctx.out(op, 'Out', SelectedRows(
+            rows, jnp.clip(vals, op.attr('min'), op.attr('max')), x.height))
+        return
     ctx.out(op, 'Out', jnp.clip(x, op.attr('min'), op.attr('max')))
 
 
 @register_op('clip_by_norm')
 def _clip_by_norm(ctx, op):
+    """reference clip_by_norm_op.h (dense + SelectedRows kernel: merge rows,
+    then clip values by the merged norm)."""
     x = ctx.in1(op, 'X')
     max_norm = op.attr('max_norm')
+    from ..core.selected_rows import SelectedRows
+    if isinstance(x, SelectedRows):
+        rows, vals = x.merged()
+        norm = jnp.sqrt(jnp.sum(vals.astype(jnp.float32) ** 2))
+        factor = jnp.where(norm > max_norm,
+                           max_norm / jnp.maximum(norm, 1e-12), 1.0)
+        ctx.out(op, 'Out', SelectedRows(
+            rows, vals * factor.astype(vals.dtype), x.height))
+        return
     norm = jnp.sqrt(jnp.sum(x.astype(jnp.float32) ** 2))
     factor = jnp.where(norm > max_norm, max_norm / jnp.maximum(norm, 1e-12),
                        1.0)

@@ -4,12 +4,15 @@ Reference: operators/optimizers/*.cc (sgd, momentum, lars_momentum, adagrad,
 adam, adamax, adadelta, decayed_adagrad, ftrl, rmsprop, proximal_gd,
 proximal_adagrad — each with dense + SelectedRows kernels). Here each is a pure
 jnp expression inside the compiled step; XLA buffer donation makes the update
-in-place (the SelectedRows sparse path becomes a dense scatter-add before
-apply, see optimizer.py).
+in-place. sgd/momentum/adam/adagrad additionally handle SelectedRows sparse
+grads row-wise (scatter updates touch only the looked-up embedding rows);
+the rest densify via _dense_grad like reference ops without a SelectedRows
+kernel.
 """
 import jax.numpy as jnp
 
 from ..core.registry import register_op
+from ..core.selected_rows import SelectedRows
 
 
 def _lr(ctx, op):
@@ -17,22 +20,51 @@ def _lr(ctx, op):
     return lr.reshape(()) if lr.ndim else lr
 
 
+def _dense_grad(ctx, op):
+    """Grad input, densified if sparse (for optimizers without a row-wise
+    kernel — the analog of ops lacking a SelectedRows kernel in the
+    reference, which would densify via scatter first)."""
+    g = ctx.in1(op, 'Grad')
+    return g.to_dense() if isinstance(g, SelectedRows) else g
+
+
 @register_op('sgd')
 def _sgd(ctx, op):
+    """reference operators/optimizers/sgd_op.h: dense kernel + SelectedRows
+    kernel (row-wise axpy). Sparse: scatter-add touches only the looked-up
+    rows; duplicate rows accumulate, exactly matching the dense result."""
     p = ctx.in1(op, 'Param')
     g = ctx.in1(op, 'Grad')
     lr = _lr(ctx, op)
+    if isinstance(g, SelectedRows):
+        upd = (-lr).astype(p.dtype) * g.values.astype(p.dtype)
+        ctx.out(op, 'ParamOut', p.at[g.rows].add(upd, mode='drop'))
+        return
     ctx.out(op, 'ParamOut', p - lr.astype(p.dtype) * g.astype(p.dtype))
 
 
 @register_op('momentum')
 def _momentum(ctx, op):
+    """reference operators/optimizers/momentum_op.h (dense +
+    SparseMomentumFunctor: merged rows, velocity/param updated row-wise;
+    untouched rows keep stale velocity — 'lazy' semantics)."""
     p = ctx.in1(op, 'Param')
     g = ctx.in1(op, 'Grad')
     v = ctx.in1(op, 'Velocity')
     lr = _lr(ctx, op)
     mu = op.attr('mu')
     nesterov = op.attr('use_nesterov', False)
+    if isinstance(g, SelectedRows):
+        rows, gv = g.merged()
+        gv = gv.astype(p.dtype)
+        v_r = mu * v[rows] + gv
+        if nesterov:
+            p_r = p[rows] - (gv + mu * v_r) * lr
+        else:
+            p_r = p[rows] - lr * v_r
+        ctx.out(op, 'ParamOut', p.at[rows].set(p_r, mode='drop'))
+        ctx.out(op, 'VelocityOut', v.at[rows].set(v_r, mode='drop'))
+        return
     v_out = mu * v + g
     if nesterov:
         p_out = p - (g + mu * v_out) * lr
@@ -45,7 +77,7 @@ def _momentum(ctx, op):
 @register_op('lars_momentum')
 def _lars_momentum(ctx, op):
     p = ctx.in1(op, 'Param')
-    g = ctx.in1(op, 'Grad')
+    g = _dense_grad(ctx, op)
     v = ctx.in1(op, 'Velocity')
     lr = _lr(ctx, op)
     mu = op.attr('mu')
@@ -62,6 +94,9 @@ def _lars_momentum(ctx, op):
 
 @register_op('adam')
 def _adam(ctx, op):
+    """reference operators/optimizers/adam_op.h: dense + SparseAdamFunctor
+    over merged grad rows (lazy semantics: only touched rows advance their
+    moments; BetaPow still advances globally)."""
     p = ctx.in1(op, 'Param')
     g = ctx.in1(op, 'Grad')
     m1 = ctx.in1(op, 'Moment1')
@@ -72,12 +107,22 @@ def _adam(ctx, op):
     b1 = op.attr('beta1', 0.9)
     b2 = op.attr('beta2', 0.999)
     eps = op.attr('epsilon', 1e-8)
-    m1o = b1 * m1 + (1 - b1) * g
-    m2o = b2 * m2 + (1 - b2) * g * g
     lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
-    ctx.out(op, 'ParamOut', p - lr_t * m1o / (jnp.sqrt(m2o) + eps))
-    ctx.out(op, 'Moment1Out', m1o)
-    ctx.out(op, 'Moment2Out', m2o)
+    if isinstance(g, SelectedRows):
+        rows, gv = g.merged()
+        gv = gv.astype(p.dtype)
+        m1r = b1 * m1[rows] + (1 - b1) * gv
+        m2r = b2 * m2[rows] + (1 - b2) * gv * gv
+        p_r = p[rows] - lr_t * m1r / (jnp.sqrt(m2r) + eps)
+        ctx.out(op, 'ParamOut', p.at[rows].set(p_r, mode='drop'))
+        ctx.out(op, 'Moment1Out', m1.at[rows].set(m1r, mode='drop'))
+        ctx.out(op, 'Moment2Out', m2.at[rows].set(m2r, mode='drop'))
+    else:
+        m1o = b1 * m1 + (1 - b1) * g
+        m2o = b2 * m2 + (1 - b2) * g * g
+        ctx.out(op, 'ParamOut', p - lr_t * m1o / (jnp.sqrt(m2o) + eps))
+        ctx.out(op, 'Moment1Out', m1o)
+        ctx.out(op, 'Moment2Out', m2o)
     ctx.out(op, 'Beta1PowOut', (b1p * b1).reshape(1))
     ctx.out(op, 'Beta2PowOut', (b2p * b2).reshape(1))
 
@@ -85,7 +130,7 @@ def _adam(ctx, op):
 @register_op('adamax')
 def _adamax(ctx, op):
     p = ctx.in1(op, 'Param')
-    g = ctx.in1(op, 'Grad')
+    g = _dense_grad(ctx, op)
     m = ctx.in1(op, 'Moment')
     inf = ctx.in1(op, 'InfNorm')
     b1p = ctx.in1(op, 'Beta1Pow').reshape(())
@@ -103,11 +148,21 @@ def _adamax(ctx, op):
 
 @register_op('adagrad')
 def _adagrad(ctx, op):
+    """reference operators/optimizers/adagrad_op.h (dense + SparseAdagrad:
+    merged rows, moment/param updated row-wise)."""
     p = ctx.in1(op, 'Param')
     g = ctx.in1(op, 'Grad')
     m = ctx.in1(op, 'Moment')
     lr = _lr(ctx, op)
     eps = op.attr('epsilon', 1e-6)
+    if isinstance(g, SelectedRows):
+        rows, gv = g.merged()
+        gv = gv.astype(p.dtype)
+        m_r = m[rows] + gv * gv
+        p_r = p[rows] - lr * gv / (jnp.sqrt(m_r) + eps)
+        ctx.out(op, 'ParamOut', p.at[rows].set(p_r, mode='drop'))
+        ctx.out(op, 'MomentOut', m.at[rows].set(m_r, mode='drop'))
+        return
     mo = m + g * g
     ctx.out(op, 'ParamOut', p - lr * g / (jnp.sqrt(mo) + eps))
     ctx.out(op, 'MomentOut', mo)
@@ -116,7 +171,7 @@ def _adagrad(ctx, op):
 @register_op('decayed_adagrad')
 def _decayed_adagrad(ctx, op):
     p = ctx.in1(op, 'Param')
-    g = ctx.in1(op, 'Grad')
+    g = _dense_grad(ctx, op)
     m = ctx.in1(op, 'Moment')
     lr = _lr(ctx, op)
     decay = op.attr('decay', 0.95)
@@ -129,7 +184,7 @@ def _decayed_adagrad(ctx, op):
 @register_op('adadelta')
 def _adadelta(ctx, op):
     p = ctx.in1(op, 'Param')
-    g = ctx.in1(op, 'Grad')
+    g = _dense_grad(ctx, op)
     eg = ctx.in1(op, 'AvgSquaredGrad')
     ex = ctx.in1(op, 'AvgSquaredUpdate')
     rho = op.attr('rho', 0.95)
@@ -145,7 +200,7 @@ def _adadelta(ctx, op):
 @register_op('rmsprop')
 def _rmsprop(ctx, op):
     p = ctx.in1(op, 'Param')
-    g = ctx.in1(op, 'Grad')
+    g = _dense_grad(ctx, op)
     ms = ctx.in1(op, 'MeanSquare')
     mom = ctx.in1(op, 'Moment')
     lr = _lr(ctx, op)
@@ -170,7 +225,7 @@ def _rmsprop(ctx, op):
 @register_op('ftrl')
 def _ftrl(ctx, op):
     p = ctx.in1(op, 'Param')
-    g = ctx.in1(op, 'Grad')
+    g = _dense_grad(ctx, op)
     sq = ctx.in1(op, 'SquaredAccumulator')
     lin = ctx.in1(op, 'LinearAccumulator')
     lr = _lr(ctx, op)
@@ -191,7 +246,7 @@ def _ftrl(ctx, op):
 @register_op('proximal_gd')
 def _proximal_gd(ctx, op):
     p = ctx.in1(op, 'Param')
-    g = ctx.in1(op, 'Grad')
+    g = _dense_grad(ctx, op)
     lr = _lr(ctx, op)
     l1 = op.attr('l1', 0.0)
     l2 = op.attr('l2', 0.0)
@@ -204,7 +259,7 @@ def _proximal_gd(ctx, op):
 @register_op('proximal_adagrad')
 def _proximal_adagrad(ctx, op):
     p = ctx.in1(op, 'Param')
-    g = ctx.in1(op, 'Grad')
+    g = _dense_grad(ctx, op)
     m = ctx.in1(op, 'Moment')
     lr = _lr(ctx, op)
     l1 = op.attr('l1', 0.0)

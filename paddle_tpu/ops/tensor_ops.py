@@ -256,11 +256,29 @@ def _gather_nd(ctx, op):
 
 @register_op('lookup_table')
 def _lookup_table(ctx, op):
+    """Embedding gather (reference operators/lookup_table_op.cc). The
+    is_sparse SelectedRows grad path is realized by the backward lowering
+    (core/lowering.py): in 'scout' mode we record this site's ids; in 'apply'
+    mode the table is held out of AD and a zero dummy of the gathered-rows
+    shape carries the gradient instead, so no dense [vocab, dim] cotangent is
+    ever built."""
     w = ctx.in1(op, 'W')
     ids = ctx.in1(op, 'Ids')
     padding_idx = op.attr('padding_idx', -1)
     flat = ids.reshape(-1).astype(jnp.int32)
-    out = jnp.take(w, flat, axis=0)
+
+    w_name = op.input('W')[0]
+    sparse = w_name in getattr(ctx, 'sparse_tables', ())
+    mode = getattr(ctx, 'sparse_mode', None)
+    if sparse and mode == 'scout':
+        ctx.sparse_sites.append((w_name, flat, w.shape[1], w.dtype))
+    if sparse and mode == 'apply':
+        k = ctx.sparse_counter[0]
+        ctx.sparse_counter[0] += 1
+        out = jnp.take(lax.stop_gradient(w), flat, axis=0) \
+            + ctx.env['@sparse%d' % k]
+    else:
+        out = jnp.take(w, flat, axis=0)
     if padding_idx is not None and padding_idx != -1:
         pad = padding_idx if padding_idx >= 0 else padding_idx + w.shape[0]
         out = jnp.where((flat == pad)[:, None], 0.0, out)
@@ -403,9 +421,19 @@ def _diag(ctx, op):
 
 @register_op('get_tensor_from_selected_rows')
 def _get_tensor_from_selected_rows(ctx, op):
-    ctx.out(op, 'Out', ctx.in1(op, 'X'))
+    """reference get_tensor_from_selected_rows_op.cc: the values tensor."""
+    from ..core.selected_rows import SelectedRows
+    x = ctx.in1(op, 'X')
+    ctx.out(op, 'Out', x.values if isinstance(x, SelectedRows) else x)
 
 
 @register_op('merge_selected_rows')
 def _merge_selected_rows(ctx, op):
-    ctx.out(op, 'Out', ctx.in1(op, 'X'))
+    """reference merge_selected_rows_op.cc (MergeAdd: sum duplicate rows).
+    Static-shape version: freed slots park on an out-of-range sentinel row."""
+    from ..core.selected_rows import SelectedRows
+    x = ctx.in1(op, 'X')
+    if isinstance(x, SelectedRows):
+        rows, vals = x.merged()
+        x = SelectedRows(rows, vals, x.height)
+    ctx.out(op, 'Out', x)

@@ -1,0 +1,246 @@
+"""Sparse (SelectedRows) embedding gradients.
+
+Reference: lookup_table_op.cc is_sparse grad path producing SelectedRows,
+optimizer SelectedRows kernels (sgd_op.h, adam_op.h SparseAdamFunctor,
+adagrad_op.h SparseAdagrad), merge_selected_rows_op.cc, and
+GradientClipByGlobalNorm over sparse grads (clip.py:275-277).
+"""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as fluid
+from paddle_tpu.core.selected_rows import SelectedRows
+from paddle_tpu.core.types import VarType
+
+
+def _merged_np(rows, values, height):
+    out = {}
+    for r, v in zip(rows, values):
+        out[r] = out.get(r, 0) + v
+    return out
+
+
+class TestSelectedRows(object):
+    def test_to_dense_accumulates_duplicates(self):
+        rows = jnp.array([1, 3, 1], jnp.int32)
+        vals = jnp.array([[1., 2.], [3., 4.], [10., 20.]])
+        sr = SelectedRows(rows, vals, 5)
+        d = np.asarray(sr.to_dense())
+        assert d.shape == (5, 2)
+        np.testing.assert_allclose(d[1], [11., 22.])
+        np.testing.assert_allclose(d[3], [3., 4.])
+        assert np.all(d[[0, 2, 4]] == 0)
+
+    def test_merged_static_shapes(self):
+        rows = jnp.array([4, 1, 4, 1, 2], jnp.int32)
+        vals = jnp.arange(10, dtype=jnp.float32).reshape(5, 2)
+        sr = SelectedRows(rows, vals, 6)
+        mr, mv = jax.jit(lambda s: s.merged())(sr)
+        mr, mv = np.asarray(mr), np.asarray(mv)
+        assert mr.shape == (5,)
+        ref = _merged_np(np.asarray(rows), np.asarray(vals), 6)
+        got = {int(r): mv[i] for i, r in enumerate(mr) if r < 6}
+        assert set(got) == set(ref)
+        for r in ref:
+            np.testing.assert_allclose(got[r], ref[r])
+        # freed slots are parked out of range with zero values
+        assert np.all(mv[mr >= 6] == 0)
+
+    def test_sentinel_dropped_by_scatter(self):
+        rows = jnp.array([0, 3], jnp.int32)  # 3 == height -> sentinel
+        vals = jnp.array([[1.], [99.]])
+        sr = SelectedRows(rows, vals, 3)
+        d = np.asarray(sr.to_dense())
+        assert d.shape == (3, 1)
+        np.testing.assert_allclose(d[:, 0], [1., 0., 0.])
+
+
+def _word2vec_program(vocab, dim, is_sparse, optimizer):
+    prog = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(prog, startup):
+        words = fluid.layers.data('words', shape=(-1, 2), dtype='int64')
+        label = fluid.layers.data('label', shape=(-1, 1), dtype='int64')
+        emb = fluid.layers.embedding(
+            words, size=(vocab, dim), is_sparse=is_sparse,
+            param_attr=fluid.ParamAttr(
+                name='emb_w',
+                initializer=fluid.initializer.NormalInitializer(seed=7)))
+        flat = fluid.layers.reshape(emb, shape=(-1, 2 * dim))
+        logits = fluid.layers.fc(
+            flat, size=vocab,
+            param_attr=fluid.ParamAttr(
+                name='fc_w',
+                initializer=fluid.initializer.NormalInitializer(seed=9)))
+        probs = fluid.layers.softmax(logits)
+        loss = fluid.layers.mean(
+            fluid.layers.cross_entropy(probs, label))
+        optimizer().minimize(loss)
+    return prog, startup, loss
+
+
+VOCAB, DIM = 50, 8
+
+
+def _train(is_sparse, optimizer, steps=5, seed=3):
+    prog, startup, loss = _word2vec_program(VOCAB, DIM, is_sparse, optimizer)
+    place = fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    exe.run(startup)
+    rng = np.random.RandomState(seed)
+    losses = []
+    for _ in range(steps):
+        w = rng.randint(0, VOCAB, size=(16, 2)).astype(np.int64)
+        y = rng.randint(0, VOCAB, size=(16, 1)).astype(np.int64)
+        l, = exe.run(prog, feed={'words': w, 'label': y},
+                     fetch_list=[loss])
+        losses.append(float(np.asarray(l)))
+    emb_w = np.asarray(fluid.global_scope().get('emb_w'))
+    return losses, emb_w
+
+
+class TestSparseGradTraining(object):
+    def test_grad_var_marked_selected_rows(self):
+        prog, _, _ = _word2vec_program(
+            VOCAB, DIM, True, lambda: fluid.optimizer.SGD(0.1))
+        gb = prog.global_block()
+        g = gb.var('emb_w@GRAD')
+        assert g.type == VarType.SELECTED_ROWS
+        bw = [op for op in gb.ops if op.type == 'backward'][0]
+        assert list(bw.attr('sparse_wrt')) == ['emb_w']
+        # the dense fc param stays dense
+        assert gb.var('fc_w@GRAD').type == VarType.LOD_TENSOR
+
+    def test_dense_param_not_marked(self):
+        prog, _, _ = _word2vec_program(
+            VOCAB, DIM, False, lambda: fluid.optimizer.SGD(0.1))
+        bw = [op for op in prog.global_block().ops
+              if op.type == 'backward'][0]
+        assert list(bw.attr('sparse_wrt')) == []
+
+    def test_sgd_sparse_matches_dense(self):
+        """SGD scatter-add over looked-up rows is numerically identical to
+        the dense update (duplicates accumulate)."""
+        dense_l, dense_w = _train(False, lambda: fluid.optimizer.SGD(0.2))
+        sparse_l, sparse_w = _train(True, lambda: fluid.optimizer.SGD(0.2))
+        np.testing.assert_allclose(sparse_l, dense_l, rtol=1e-5)
+        np.testing.assert_allclose(sparse_w, dense_w, rtol=1e-5, atol=1e-6)
+
+    def test_adam_sparse_trains(self):
+        losses, _ = _train(True, lambda: fluid.optimizer.Adam(0.05),
+                           steps=10)
+        assert losses[-1] < losses[0]
+
+    def test_adam_sparse_is_lazy(self):
+        """Untouched rows keep zero moments (reference SparseAdamFunctor
+        updates only merged grad rows)."""
+        prog, startup, loss = _word2vec_program(
+            VOCAB, DIM, True, lambda: fluid.optimizer.Adam(0.01))
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        w = np.array([[1, 2], [1, 3]], np.int64)
+        y = np.array([[4], [5]], np.int64)
+        exe.run(prog, feed={'words': w, 'label': y}, fetch_list=[loss])
+        m1 = None
+        for name in fluid.global_scope().names():
+            if name.startswith('emb_w_moment1'):
+                m1 = np.asarray(fluid.global_scope().get(name))
+        assert m1 is not None
+        touched = sorted(set(w.reshape(-1).tolist()))
+        untouched = [i for i in range(VOCAB) if i not in touched]
+        assert np.all(m1[untouched] == 0)
+        assert np.any(m1[touched] != 0)
+
+    def test_momentum_and_adagrad_sparse_train(self):
+        for opt in (lambda: fluid.optimizer.Momentum(0.1, momentum=0.9),
+                    lambda: fluid.optimizer.Adagrad(0.1)):
+            losses, _ = _train(True, opt, steps=8)
+            assert losses[-1] < losses[0]
+
+    def test_global_norm_clip_on_sparse(self):
+        """Global-norm clip path over a SelectedRows grad (squared_l2_norm
+        on merged values + elementwise_mul by the scalar factor)."""
+        def opt():
+            o = fluid.optimizer.SGD(0.2)
+            return o
+        prog = fluid.Program()
+        startup = fluid.Program()
+        with fluid.program_guard(prog, startup):
+            words = fluid.layers.data('words', shape=(-1, 2), dtype='int64')
+            label = fluid.layers.data('label', shape=(-1, 1), dtype='int64')
+            emb = fluid.layers.embedding(words, size=(VOCAB, DIM),
+                                         is_sparse=True)
+            flat = fluid.layers.reshape(emb, shape=(-1, 2 * DIM))
+            logits = fluid.layers.fc(flat, size=VOCAB)
+            loss = fluid.layers.mean(fluid.layers.cross_entropy(
+                fluid.layers.softmax(logits), label))
+            fluid.clip.set_gradient_clip(
+                fluid.clip.GradientClipByGlobalNorm(clip_norm=0.5))
+            fluid.optimizer.SGD(0.2).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        prev = None
+        for _ in range(3):
+            w = rng.randint(0, VOCAB, size=(8, 2)).astype(np.int64)
+            y = rng.randint(0, VOCAB, size=(8, 1)).astype(np.int64)
+            l, = exe.run(prog, feed={'words': w, 'label': y},
+                         fetch_list=[loss])
+            assert np.isfinite(float(np.asarray(l)))
+
+    def test_l2_regularizer_densifies_sparse_grad(self):
+        """Reference behavior: sum(sparse grad, decay term) -> dense grad."""
+        losses, _ = _train(
+            True,
+            lambda: fluid.optimizer.SGD(
+                0.1, regularization=fluid.regularizer.L2Decay(1e-4)),
+            steps=5)
+        assert losses[-1] < losses[0]
+
+
+class TestShardedEmbedding(object):
+    def test_vocab_sharded_sparse_embedding_matches_serial(self):
+        """CTR-style giant-embedding config (reference distributed lookup
+        table, operators/distributed/parameter_prefetch.cc): table rows
+        sharded over the 'model' mesh axis, batch over 'data', sparse grads.
+        XLA SPMD partitions the gather (all-to-all style lookup) and the
+        row-wise scatter update; trajectory must match the serial run."""
+        from jax.sharding import PartitionSpec as P
+        from paddle_tpu.parallel import make_mesh, MeshRunner
+
+        exe = fluid.Executor()
+        rng = np.random.RandomState(11)
+        W = rng.randint(0, VOCAB, size=(16, 2)).astype(np.int64)
+        Y = rng.randint(0, VOCAB, size=(16, 1)).astype(np.int64)
+
+        SV = 64  # divisible by the 4-way 'model' axis
+
+        def build():
+            return _word2vec_program(SV, DIM, True,
+                                     lambda: fluid.optimizer.SGD(0.2))
+
+        prog, startup, loss = build()
+        s1 = fluid.Scope()
+        with fluid.scope_guard(s1):
+            exe.run(startup, scope=s1)
+            ref = [float(np.asarray(exe.run(
+                prog, feed={'words': W, 'label': Y},
+                fetch_list=[loss], scope=s1)[0]).reshape(()))
+                for _ in range(4)]
+
+        prog2, startup2, loss2 = build()
+        mesh = make_mesh([('data', 2), ('model', 4)])
+        runner = MeshRunner(
+            prog2, mesh,
+            param_rules=[(r'emb_w', P('model', None)),
+                         (r'fc_w', P(None, 'model'))],
+            feed_specs={'words': P('data'), 'label': P('data')})
+        s2 = fluid.Scope()
+        with fluid.scope_guard(s2):
+            exe.run(startup2, scope=s2)
+            sharded = [float(np.asarray(runner.run(
+                {'words': W, 'label': Y}, [loss2.name], s2)[0]).reshape(()))
+                for _ in range(4)]
+        np.testing.assert_allclose(ref, sharded, rtol=1e-5, atol=1e-6)
