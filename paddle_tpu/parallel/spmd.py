@@ -89,7 +89,7 @@ class DataParallelRunner(object):
                 raise ValueError(
                     "feed %r batch %d not divisible by %d mesh devices"
                     % (k, v.shape[0], ndev))
-        key = (id(program), program._version,
+        key = (program._uid, program._version,
                executor._feed_signature(feed), tuple(fetch_names))
         entry = self._cache.get(key)
         if entry is None:
