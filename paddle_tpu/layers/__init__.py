@@ -19,6 +19,7 @@ from .ops import *
 from .metric_op import *
 from .learning_rate_scheduler import *
 from .control_flow import *
+from .detection import *
 
 __all__ = []
 __all__ += io.__all__
@@ -30,3 +31,4 @@ __all__ += ops.__all__
 __all__ += metric_op.__all__
 __all__ += learning_rate_scheduler.__all__
 __all__ += control_flow.__all__
+__all__ += detection.__all__

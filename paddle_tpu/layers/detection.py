@@ -1,4 +1,562 @@
-"""Detection layers (reference python/paddle/fluid/layers/detection.py:33-54,
-20 layers) — stage 7 wave."""
+"""Detection layers (reference python/paddle/fluid/layers/detection.py:33-54).
 
-__all__ = []
+Layer builders over the detection op family (ops/detection_ops.py). The
+compositions mirror the reference exactly (ssd_loss's 5-step pipeline,
+detection_output = decode + nms, multi_box_head's conv heads + priors); the
+underlying ops are TPU-native (static shapes, -1 sentinel padding for
+data-dependent-length outputs — see ops/detection_ops.py docstring).
+"""
+import math
+
+from ..layer_helper import LayerHelper
+from ..framework import Variable
+from ..param_attr import ParamAttr
+from . import nn
+from . import tensor
+
+__all__ = [
+    'prior_box',
+    'density_prior_box',
+    'multi_box_head',
+    'bipartite_match',
+    'target_assign',
+    'detection_output',
+    'ssd_loss',
+    'rpn_target_assign',
+    'anchor_generator',
+    'generate_proposals',
+    'iou_similarity',
+    'box_coder',
+    'polygon_box_transform',
+    'yolov3_loss',
+    'box_clip',
+    'multiclass_nms',
+    'roi_perspective_transform',
+    'generate_proposal_labels',
+    'generate_mask_labels',
+    'detection_map',
+]
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=[1.],
+              variance=[0.1, 0.1, 0.2, 0.2], flip=False, clip=False,
+              steps=[0.0, 0.0], offset=0.5, name=None,
+              min_max_aspect_ratios_order=False):
+    """reference layers/detection.py prior_box."""
+    helper = LayerHelper('prior_box')
+    if not isinstance(min_sizes, (list, tuple)):
+        min_sizes = [min_sizes]
+    if max_sizes is not None and not isinstance(max_sizes, (list, tuple)):
+        max_sizes = [max_sizes]
+    ars = _expanded_ar_count(aspect_ratios, flip)
+    num_priors = ars * len(min_sizes) + (len(max_sizes) if max_sizes else 0)
+    fh, fw = input.shape[-2], input.shape[-1]
+    boxes = helper.create_variable_for_type_inference(
+        'float32', shape=(fh, fw, num_priors, 4))
+    variances = helper.create_variable_for_type_inference(
+        'float32', shape=(fh, fw, num_priors, 4))
+    helper.append_op(
+        type='prior_box', inputs={'Input': [input], 'Image': [image]},
+        outputs={'Boxes': [boxes], 'Variances': [variances]},
+        attrs={'min_sizes': list(min_sizes),
+               'max_sizes': list(max_sizes) if max_sizes else [],
+               'aspect_ratios': list(aspect_ratios),
+               'variances': list(variance), 'flip': flip, 'clip': clip,
+               'step_w': steps[0], 'step_h': steps[1], 'offset': offset,
+               'min_max_aspect_ratios_order': min_max_aspect_ratios_order})
+    boxes.stop_gradient = True
+    variances.stop_gradient = True
+    return boxes, variances
+
+
+def _expanded_ar_count(aspect_ratios, flip):
+    from ..ops.detection_ops import _expand_aspect_ratios
+    return len(_expand_aspect_ratios(aspect_ratios, flip))
+
+
+def density_prior_box(input, image, densities=None, fixed_sizes=None,
+                      fixed_ratios=None, variance=[0.1, 0.1, 0.2, 0.2],
+                      clip=False, steps=[0.0, 0.0], offset=0.5, name=None):
+    """reference layers/detection.py density_prior_box."""
+    helper = LayerHelper('density_prior_box')
+    num_priors = sum(len(fixed_ratios) * (d ** 2) for d in densities)
+    fh, fw = input.shape[-2], input.shape[-1]
+    boxes = helper.create_variable_for_type_inference(
+        'float32', shape=(fh, fw, num_priors, 4))
+    variances = helper.create_variable_for_type_inference(
+        'float32', shape=(fh, fw, num_priors, 4))
+    helper.append_op(
+        type='density_prior_box',
+        inputs={'Input': [input], 'Image': [image]},
+        outputs={'Boxes': [boxes], 'Variances': [variances]},
+        attrs={'densities': list(densities),
+               'fixed_sizes': list(fixed_sizes),
+               'fixed_ratios': list(fixed_ratios),
+               'variances': list(variance), 'clip': clip,
+               'step_w': steps[0], 'step_h': steps[1], 'offset': offset})
+    boxes.stop_gradient = True
+    variances.stop_gradient = True
+    return boxes, variances
+
+
+def anchor_generator(input, anchor_sizes=None, aspect_ratios=None,
+                     variance=[0.1, 0.1, 0.2, 0.2], stride=None, offset=0.5,
+                     name=None):
+    """reference layers/detection.py anchor_generator."""
+    helper = LayerHelper('anchor_generator')
+    num_anchors = len(aspect_ratios) * len(anchor_sizes)
+    fh, fw = input.shape[-2], input.shape[-1]
+    anchors = helper.create_variable_for_type_inference(
+        'float32', shape=(fh, fw, num_anchors, 4))
+    var = helper.create_variable_for_type_inference(
+        'float32', shape=(fh, fw, num_anchors, 4))
+    helper.append_op(
+        type='anchor_generator', inputs={'Input': [input]},
+        outputs={'Anchors': [anchors], 'Variances': [var]},
+        attrs={'anchor_sizes': list(anchor_sizes),
+               'aspect_ratios': list(aspect_ratios),
+               'variances': list(variance), 'stride': list(stride),
+               'offset': offset})
+    anchors.stop_gradient = True
+    var.stop_gradient = True
+    return anchors, var
+
+
+def iou_similarity(x, y, box_normalized=True, name=None):
+    helper = LayerHelper('iou_similarity')
+    out = helper.create_variable_for_type_inference(
+        x.dtype, shape=(x.shape[0], y.shape[0]))
+    helper.append_op(type='iou_similarity', inputs={'X': [x], 'Y': [y]},
+                     outputs={'Out': [out]},
+                     attrs={'box_normalized': box_normalized})
+    return out
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type='encode_center_size', box_normalized=True, name=None,
+              axis=0):
+    helper = LayerHelper('box_coder')
+    inputs = {'PriorBox': [prior_box], 'TargetBox': [target_box]}
+    attrs = {'code_type': code_type, 'box_normalized': box_normalized,
+             'axis': axis}
+    if isinstance(prior_box_var, Variable):
+        inputs['PriorBoxVar'] = [prior_box_var]
+    elif isinstance(prior_box_var, (list, tuple)):
+        attrs['variance'] = [float(v) for v in prior_box_var]
+    out = helper.create_variable_for_type_inference(prior_box.dtype)
+    helper.append_op(type='box_coder', inputs=inputs,
+                     outputs={'OutputBox': [out]}, attrs=attrs)
+    return out
+
+
+def box_clip(input, im_info, name=None):
+    helper = LayerHelper('box_clip')
+    out = helper.create_variable_for_type_inference(
+        input.dtype, shape=input.shape)
+    helper.append_op(type='box_clip',
+                     inputs={'Input': [input], 'ImInfo': [im_info]},
+                     outputs={'Output': [out]})
+    return out
+
+
+def polygon_box_transform(input, name=None):
+    helper = LayerHelper('polygon_box_transform')
+    out = helper.create_variable_for_type_inference(
+        input.dtype, shape=input.shape)
+    helper.append_op(type='polygon_box_transform', inputs={'Input': [input]},
+                     outputs={'Output': [out]})
+    return out
+
+
+def bipartite_match(dist_matrix, match_type=None, dist_threshold=None,
+                    name=None):
+    """reference layers/detection.py bipartite_match."""
+    helper = LayerHelper('bipartite_match')
+    ncol = dist_matrix.shape[-1] if dist_matrix.shape else -1
+    match_indices = helper.create_variable_for_type_inference(
+        'int32', shape=(-1, ncol))
+    match_distance = helper.create_variable_for_type_inference(
+        dist_matrix.dtype, shape=(-1, ncol))
+    helper.append_op(
+        type='bipartite_match', inputs={'DistMat': [dist_matrix]},
+        outputs={'ColToRowMatchIndices': [match_indices],
+                 'ColToRowMatchDist': [match_distance]},
+        attrs={'match_type': 'bipartite' if match_type is None
+               else match_type,
+               'dist_threshold': 0.5 if dist_threshold is None
+               else dist_threshold})
+    return match_indices, match_distance
+
+
+def target_assign(input, matched_indices, negative_indices=None,
+                  mismatch_value=None, name=None):
+    """reference layers/detection.py target_assign."""
+    helper = LayerHelper('target_assign')
+    mshape = matched_indices.shape or (-1, -1)
+    n, np_ = mshape[0], mshape[1]
+    k = input.shape[-1] if input.shape else 1
+    out = helper.create_variable_for_type_inference(
+        input.dtype, shape=(n, np_, k))
+    out_weight = helper.create_variable_for_type_inference(
+        'float32', shape=(n, np_, 1))
+    inputs = {'X': [input], 'MatchIndices': [matched_indices]}
+    if negative_indices is not None:
+        inputs['NegIndices'] = [negative_indices]
+    helper.append_op(
+        type='target_assign', inputs=inputs,
+        outputs={'Out': [out], 'OutWeight': [out_weight]},
+        attrs={'mismatch_value': 0 if mismatch_value is None
+               else mismatch_value})
+    return out, out_weight
+
+
+def multiclass_nms(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
+                   nms_threshold=0.3, normalized=True, nms_eta=1.0,
+                   background_label=0, name=None):
+    """reference layers/detection.py multiclass_nms. Output is
+    [N * keep_top_k, 6] with -1-labeled padding rows (static-shape TPU
+    deviation, see ops/detection_ops.py)."""
+    helper = LayerHelper('multiclass_nms')
+    output = helper.create_variable_for_type_inference(bboxes.dtype)
+    helper.append_op(
+        type='multiclass_nms',
+        inputs={'BBoxes': [bboxes], 'Scores': [scores]},
+        outputs={'Out': [output]},
+        attrs={'background_label': background_label,
+               'score_threshold': score_threshold,
+               'nms_top_k': nms_top_k, 'nms_threshold': nms_threshold,
+               'nms_eta': nms_eta, 'keep_top_k': keep_top_k,
+               'normalized': normalized})
+    output.stop_gradient = True
+    return output
+
+
+def detection_output(loc, scores, prior_box, prior_box_var,
+                     background_label=0, nms_threshold=0.3, nms_top_k=400,
+                     keep_top_k=200, score_threshold=0.01, nms_eta=1.0):
+    """reference layers/detection.py detection_output: decode predictions
+    against priors then run multiclass NMS."""
+    helper = LayerHelper('detection_output')
+    decoded_box = box_coder(
+        prior_box=prior_box, prior_box_var=prior_box_var, target_box=loc,
+        code_type='decode_center_size')
+    scores = nn.softmax(scores)
+    scores = nn.transpose(scores, perm=[0, 2, 1])
+    scores.stop_gradient = True
+    decoded_box.stop_gradient = True
+    return multiclass_nms(
+        bboxes=decoded_box, scores=scores, score_threshold=score_threshold,
+        nms_top_k=nms_top_k, keep_top_k=keep_top_k,
+        nms_threshold=nms_threshold, normalized=False, nms_eta=nms_eta,
+        background_label=background_label)
+
+
+def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
+             prior_box_var=None, background_label=0, overlap_threshold=0.5,
+             neg_pos_ratio=3.0, neg_overlap=0.5, loc_loss_weight=1.0,
+             conf_loss_weight=1.0, match_type='per_prediction',
+             mining_type='max_negative', normalize=True, sample_size=None):
+    """reference layers/detection.py ssd_loss:874 — the 5-step SSD multibox
+    loss (match, conf loss, hard mining, target assign, weighted sum)."""
+    helper = LayerHelper('ssd_loss')
+    if mining_type != 'max_negative':
+        raise ValueError("Only support mining_type == max_negative now.")
+
+    num, num_prior, num_class = confidence.shape
+
+    def __reshape_to_2d(var):
+        return nn.flatten(x=var, axis=2)
+
+    # 1. IoU + bipartite match
+    iou = iou_similarity(x=gt_box, y=prior_box)
+    matched_indices, matched_dist = bipartite_match(iou, match_type,
+                                                    overlap_threshold)
+
+    # 2. confidence loss for mining
+    gt_label = nn.reshape(x=gt_label, shape=(-1, 1))
+    gt_label.stop_gradient = True
+    target_label, _ = target_assign(gt_label, matched_indices,
+                                    mismatch_value=background_label)
+    confidence2d = __reshape_to_2d(confidence)
+    target_label = tensor.cast(x=target_label, dtype='int64')
+    target_label = __reshape_to_2d(target_label)
+    target_label.stop_gradient = True
+    conf_loss = nn.softmax_with_cross_entropy(confidence2d, target_label)
+    conf_loss = nn.reshape(x=conf_loss, shape=(num, num_prior))
+    conf_loss.stop_gradient = True
+
+    # 3. hard example mining
+    neg_indices = helper.create_variable_for_type_inference('int32')
+    updated_matched_indices = helper.create_variable_for_type_inference(
+        'int32')
+    helper.append_op(
+        type='mine_hard_examples',
+        inputs={'ClsLoss': [conf_loss], 'MatchIndices': [matched_indices],
+                'MatchDist': [matched_dist]},
+        outputs={'NegIndices': [neg_indices],
+                 'UpdatedMatchIndices': [updated_matched_indices]},
+        attrs={'neg_pos_ratio': neg_pos_ratio,
+               'neg_dist_threshold': neg_overlap,
+               'mining_type': mining_type,
+               'sample_size': sample_size or 0})
+
+    # 4. assign targets
+    encoded_bbox = box_coder(
+        prior_box=prior_box, prior_box_var=prior_box_var,
+        target_box=gt_box, code_type='encode_center_size')
+    target_bbox, target_loc_weight = target_assign(
+        encoded_bbox, updated_matched_indices,
+        mismatch_value=background_label)
+    target_label, target_conf_weight = target_assign(
+        gt_label, updated_matched_indices, negative_indices=neg_indices,
+        mismatch_value=background_label)
+
+    # 5. weighted loss
+    target_label = __reshape_to_2d(target_label)
+    target_label = tensor.cast(x=target_label, dtype='int64')
+    conf_loss = nn.softmax_with_cross_entropy(confidence2d, target_label)
+    target_conf_weight = __reshape_to_2d(target_conf_weight)
+    conf_loss = conf_loss * target_conf_weight
+    target_label.stop_gradient = True
+    target_conf_weight.stop_gradient = True
+
+    location2d = __reshape_to_2d(location)
+    target_bbox = __reshape_to_2d(target_bbox)
+    loc_loss = nn.smooth_l1(location2d, target_bbox)
+    target_loc_weight2d = __reshape_to_2d(target_loc_weight)
+    loc_loss = loc_loss * target_loc_weight2d
+    target_bbox.stop_gradient = True
+    target_loc_weight.stop_gradient = True
+
+    loss = conf_loss_weight * conf_loss + loc_loss_weight * loc_loss
+    loss = nn.reshape(x=loss, shape=(num, num_prior))
+    loss = nn.reduce_sum(loss, dim=1, keep_dim=True)
+    if normalize:
+        normalizer = nn.reduce_sum(target_loc_weight2d)
+        loss = loss / normalizer
+    return loss
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=[0.1, 0.1, 0.2, 0.2], flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1, name=None,
+                   min_max_aspect_ratios_order=False):
+    """reference layers/detection.py multi_box_head: conv loc/conf heads +
+    prior boxes over a pyramid of feature maps (SSD)."""
+    def _reshape_with_axis_(input, axis=1):
+        return nn.flatten(x=input, axis=axis)
+
+    def _is_list_or_tuple_(data):
+        return isinstance(data, (list, tuple))
+
+    if not _is_list_or_tuple_(inputs):
+        raise ValueError('inputs should be a list of Variable')
+
+    num_layer = len(inputs)
+    if num_layer <= 2:
+        assert min_sizes is not None and max_sizes is not None
+        assert len(min_sizes) == num_layer and len(max_sizes) == num_layer
+    elif min_sizes is None and max_sizes is None:
+        min_sizes = []
+        max_sizes = []
+        step = int(math.floor((max_ratio - min_ratio) / (num_layer - 2)))
+        for ratio in range(min_ratio, max_ratio + 1, step):
+            min_sizes.append(base_size * ratio / 100.)
+            max_sizes.append(base_size * (ratio + step) / 100.)
+        min_sizes = [base_size * .10] + min_sizes
+        max_sizes = [base_size * .20] + max_sizes
+
+    if aspect_ratios:
+        if not _is_list_or_tuple_(aspect_ratios) or \
+                len(aspect_ratios) != num_layer:
+            raise ValueError(
+                'aspect_ratios should be list|tuple, with the same length '
+                'as inputs')
+    if steps is not None:
+        if not _is_list_or_tuple_(steps) or len(steps) != num_layer:
+            raise ValueError(
+                'steps should be list|tuple, with the same length as inputs')
+
+    mbox_locs = []
+    mbox_confs = []
+    box_results = []
+    var_results = []
+    for i, input in enumerate(inputs):
+        min_size = min_sizes[i]
+        max_size = max_sizes[i]
+        if not _is_list_or_tuple_(min_size):
+            min_size = [min_size]
+        if not _is_list_or_tuple_(max_size):
+            max_size = [max_size]
+        aspect_ratio = []
+        if aspect_ratios is not None:
+            aspect_ratio = aspect_ratios[i]
+            if not _is_list_or_tuple_(aspect_ratio):
+                aspect_ratio = [aspect_ratio]
+        step = [step_w[i] if step_w else 0.0,
+                step_h[i] if step_h else 0.0] if steps is None else \
+            [steps[i]] * 2 if not _is_list_or_tuple_(steps[i]) else steps[i]
+
+        box, var = prior_box(input, image, min_size, max_size, aspect_ratio,
+                             variance, flip, clip, step, offset, None,
+                             min_max_aspect_ratios_order)
+        box_results.append(nn.reshape(box, shape=(-1, 4)))
+        var_results.append(nn.reshape(var, shape=(-1, 4)))
+        num_boxes = box.shape[2]   # priors per spatial location
+
+        # locations: conv head with num_boxes * 4 filters
+        mbox_loc = nn.conv2d(input, num_filters=num_boxes * 4,
+                             filter_size=kernel_size, padding=pad,
+                             stride=stride)
+        mbox_loc = nn.transpose(mbox_loc, perm=[0, 2, 3, 1])
+        mbox_loc_flatten = nn.flatten(mbox_loc, axis=1)
+        mbox_locs.append(mbox_loc_flatten)
+
+        # confidences
+        conf_loc = nn.conv2d(input, num_filters=num_boxes * num_classes,
+                             filter_size=kernel_size, padding=pad,
+                             stride=stride)
+        conf_loc = nn.transpose(conf_loc, perm=[0, 2, 3, 1])
+        conf_loc_flatten = nn.flatten(conf_loc, axis=1)
+        mbox_confs.append(conf_loc_flatten)
+
+    if len(box_results) == 1:
+        box = box_results[0]
+        var = var_results[0]
+        mbox_locs_concat = mbox_locs[0]
+        mbox_confs_concat = mbox_confs[0]
+    else:
+        box = tensor.concat(box_results, axis=0)
+        var = tensor.concat(var_results, axis=0)
+        mbox_locs_concat = tensor.concat(mbox_locs, axis=1)
+        mbox_confs_concat = tensor.concat(mbox_confs, axis=1)
+    mbox_locs_concat = nn.reshape(mbox_locs_concat, shape=(0, -1, 4))
+    mbox_confs_concat = nn.reshape(mbox_confs_concat,
+                                   shape=(0, -1, num_classes))
+    box.stop_gradient = True
+    var.stop_gradient = True
+    return mbox_locs_concat, mbox_confs_concat, box, var
+
+
+# ---------------------------------------------------------------------------
+# RCNN / YOLO family — wave B (ops land with ops/detection_ops.py wave B)
+# ---------------------------------------------------------------------------
+
+def yolov3_loss(x, gtbox, gtlabel, anchors, anchor_mask, class_num,
+                ignore_thresh, downsample_ratio, name=None):
+    """reference layers/detection.py yolov3_loss."""
+    helper = LayerHelper('yolov3_loss')
+    loss = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type='yolov3_loss',
+        inputs={'X': [x], 'GTBox': [gtbox], 'GTLabel': [gtlabel]},
+        outputs={'Loss': [loss]},
+        attrs={'anchors': list(anchors), 'anchor_mask': list(anchor_mask),
+               'class_num': class_num, 'ignore_thresh': ignore_thresh,
+               'downsample_ratio': downsample_ratio})
+    return loss
+
+
+def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                      gt_boxes, is_crowd=None, im_info=None,
+                      rpn_batch_size_per_im=256, rpn_straddle_thresh=0.0,
+                      rpn_fg_fraction=0.5, rpn_positive_overlap=0.7,
+                      rpn_negative_overlap=0.3, use_random=True):
+    """reference layers/detection.py rpn_target_assign."""
+    helper = LayerHelper('rpn_target_assign')
+    loc_index = helper.create_variable_for_type_inference('int32')
+    score_index = helper.create_variable_for_type_inference('int32')
+    target_label = helper.create_variable_for_type_inference('int32')
+    target_bbox = helper.create_variable_for_type_inference(
+        anchor_box.dtype)
+    bbox_inside_weight = helper.create_variable_for_type_inference(
+        anchor_box.dtype)
+    inputs = {'Anchor': [anchor_box], 'GtBoxes': [gt_boxes],
+              'ImInfo': [im_info]}
+    if is_crowd is not None:
+        inputs['IsCrowd'] = [is_crowd]
+    helper.append_op(
+        type='rpn_target_assign', inputs=inputs,
+        outputs={'LocationIndex': [loc_index],
+                 'ScoreIndex': [score_index],
+                 'TargetLabel': [target_label],
+                 'TargetBBox': [target_bbox],
+                 'BBoxInsideWeight': [bbox_inside_weight]},
+        attrs={'rpn_batch_size_per_im': rpn_batch_size_per_im,
+               'rpn_straddle_thresh': rpn_straddle_thresh,
+               'rpn_positive_overlap': rpn_positive_overlap,
+               'rpn_negative_overlap': rpn_negative_overlap,
+               'rpn_fg_fraction': rpn_fg_fraction,
+               'use_random': use_random})
+    loc_index.stop_gradient = True
+    score_index.stop_gradient = True
+    target_label.stop_gradient = True
+    target_bbox.stop_gradient = True
+    bbox_inside_weight.stop_gradient = True
+
+    cls_logits = nn.reshape(x=cls_logits, shape=(-1, 1))
+    bbox_pred = nn.reshape(x=bbox_pred, shape=(-1, 4))
+    predicted_cls_logits = nn.gather(cls_logits, score_index)
+    predicted_bbox_pred = nn.gather(bbox_pred, loc_index)
+    return (predicted_cls_logits, predicted_bbox_pred, target_label,
+            target_bbox, bbox_inside_weight)
+
+
+def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0, name=None):
+    """reference layers/detection.py generate_proposals."""
+    helper = LayerHelper('generate_proposals')
+    rpn_rois = helper.create_variable_for_type_inference(bbox_deltas.dtype)
+    rpn_roi_probs = helper.create_variable_for_type_inference(scores.dtype)
+    helper.append_op(
+        type='generate_proposals',
+        inputs={'Scores': [scores], 'BboxDeltas': [bbox_deltas],
+                'ImInfo': [im_info], 'Anchors': [anchors],
+                'Variances': [variances]},
+        outputs={'RpnRois': [rpn_rois], 'RpnRoiProbs': [rpn_roi_probs]},
+        attrs={'pre_nms_topN': pre_nms_top_n,
+               'post_nms_topN': post_nms_top_n,
+               'nms_thresh': nms_thresh, 'min_size': min_size, 'eta': eta})
+    rpn_rois.stop_gradient = True
+    rpn_roi_probs.stop_gradient = True
+    return rpn_rois, rpn_roi_probs
+
+
+def roi_perspective_transform(input, rois, transformed_height,
+                              transformed_width, spatial_scale=1.0):
+    raise NotImplementedError(
+        "roi_perspective_transform (reference "
+        "operators/detection/roi_perspective_transform_op.cc) is not "
+        "implemented in the TPU build; use roi_align/roi_pool for "
+        "rectangular RoI extraction")
+
+
+def generate_proposal_labels(*args, **kwargs):
+    raise NotImplementedError(
+        "generate_proposal_labels (reference "
+        "operators/detection/generate_proposal_labels_op.cc) requires "
+        "dynamic subsampling of proposals; planned with the Mask-RCNN wave")
+
+
+def generate_mask_labels(*args, **kwargs):
+    raise NotImplementedError(
+        "generate_mask_labels (reference "
+        "operators/detection/generate_mask_labels_op.cc) requires polygon "
+        "rasterization on host; planned with the Mask-RCNN wave")
+
+
+def detection_map(detect_res, label, class_num, background_label=0,
+                  overlap_threshold=0.3, evaluate_difficult=True,
+                  has_state=None, input_states=None, out_states=None,
+                  ap_version='integral'):
+    """Detection mAP (reference operators/metrics (detection_map_op.cc) via
+    layers/detection.py detection_map). Computed host-side by
+    metrics.DetectionMAP over fetched detections — the streaming-state op
+    form is not jit-compilable (ragged inputs); use the metric class."""
+    raise NotImplementedError(
+        "detection_map: use paddle_tpu.metrics.DetectionMAP on fetched "
+        "detection results (host-side metric, reference fluid/metrics.py "
+        "DetectionMAP)")

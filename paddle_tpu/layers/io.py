@@ -14,7 +14,9 @@ def data(name, shape, append_batch_size=True, dtype='float32', lod_level=0,
          type=VarType.LOD_TENSOR, stop_gradient=True):
     helper_block = default_main_program().current_block()
     shape = list(shape)
-    if append_batch_size:
+    # reference layers/io.py data(): a -1 anywhere in shape means the user
+    # gave the full batched shape; append_batch_size is enforced off
+    if append_batch_size and not any(d == -1 for d in shape):
         shape = [-1] + shape
     return helper_block.create_var(
         name=name, shape=tuple(shape), dtype=dtype, lod_level=lod_level,

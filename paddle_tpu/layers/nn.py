@@ -33,7 +33,8 @@ __all__ = [
     'gaussian_random', 'sampling_id', 'gaussian_random_batch_size_like',
     'sums_', 'logical_and', 'logical_or', 'logical_xor', 'logical_not',
     'where', 'sign', 'gather_nd', 'random_crop', 'mean_iou', 'hash',
-    'grid_sampler', 'teacher_student_sigmoid_loss', 'selu', 'swish',
+    'grid_sampler', 'affine_grid', 'roi_pool', 'roi_align', 'psroi_pool',
+    'teacher_student_sigmoid_loss', 'selu', 'swish',
     'sharding_constraint', 'linear_chain_crf', 'crf_decoding', 'warpctc',
     'ctc_greedy_decoder', 'edit_distance',
 ]
@@ -1430,9 +1431,87 @@ def sharding_constraint(x, spec, name=None):
 
 
 def grid_sampler(x, grid, name=None):
-    raise NotImplementedError(
-        "grid_sampler: planned for the detection wave "
-        "(reference operators/grid_sampler_op.cc)")
+    """Bilinear sampling of x at grid coords in [-1, 1] (reference
+    operators/grid_sampler_op.cc)."""
+    helper = LayerHelper('grid_sampler', name=name)
+    out = helper.create_variable_for_type_inference(x.dtype, shape=x.shape)
+    helper.append_op(type='grid_sampler', inputs={'X': [x], 'Grid': [grid]},
+                     outputs={'Output': [out]})
+    return out
+
+
+def affine_grid(theta, out_shape=None, name=None):
+    """Affine sampling grid from Theta [N,2,3] (reference
+    operators/affine_grid_op.cc). out_shape: list/tuple NCHW or a Variable
+    fed with it (bound statically)."""
+    helper = LayerHelper('affine_grid', name=name)
+    from .. import framework as _fw
+    inputs = {'Theta': [theta]}
+    attrs = {}
+    if isinstance(out_shape, _fw.Variable):
+        inputs['OutputShape'] = [out_shape]
+    else:
+        attrs['output_shape'] = [int(v) for v in out_shape]
+    h = attrs.get('output_shape', [0, 0, -1, -1])[2]
+    w = attrs.get('output_shape', [0, 0, -1, -1])[3]
+    out = helper.create_variable_for_type_inference(
+        theta.dtype, shape=(theta.shape[0], h, w, 2))
+    helper.append_op(type='affine_grid', inputs=inputs,
+                     outputs={'Output': [out]}, attrs=attrs)
+    return out
+
+
+def roi_pool(input, rois, pooled_height=1, pooled_width=1,
+             spatial_scale=1.0):
+    """Max RoI pooling (reference operators/roi_pool_op.cc)."""
+    helper = LayerHelper('roi_pool')
+    c = input.shape[1] if input.shape else -1
+    out = helper.create_variable_for_type_inference(
+        input.dtype, shape=(-1, c, pooled_height, pooled_width))
+    argmax = helper.create_variable_for_type_inference(
+        'int64', shape=(-1, c, pooled_height, pooled_width))
+    helper.append_op(type='roi_pool',
+                     inputs={'X': [input], 'ROIs': [rois]},
+                     outputs={'Out': [out], 'Argmax': [argmax]},
+                     attrs={'pooled_height': pooled_height,
+                            'pooled_width': pooled_width,
+                            'spatial_scale': spatial_scale})
+    return out
+
+
+def roi_align(input, rois, pooled_height=1, pooled_width=1,
+              spatial_scale=1.0, sampling_ratio=-1, name=None):
+    """RoI align (reference operators/roi_align_op.cc). On TPU
+    sampling_ratio must be > 0 (static sample grid)."""
+    helper = LayerHelper('roi_align', name=name)
+    c = input.shape[1] if input.shape else -1
+    out = helper.create_variable_for_type_inference(
+        input.dtype, shape=(-1, c, pooled_height, pooled_width))
+    helper.append_op(type='roi_align',
+                     inputs={'X': [input], 'ROIs': [rois]},
+                     outputs={'Out': [out]},
+                     attrs={'pooled_height': pooled_height,
+                            'pooled_width': pooled_width,
+                            'spatial_scale': spatial_scale,
+                            'sampling_ratio': sampling_ratio})
+    return out
+
+
+def psroi_pool(input, rois, output_channels, spatial_scale, pooled_height,
+               pooled_width, name=None):
+    """Position-sensitive RoI pooling (reference operators/psroi_pool_op.cc)."""
+    helper = LayerHelper('psroi_pool', name=name)
+    out = helper.create_variable_for_type_inference(
+        input.dtype, shape=(-1, output_channels, pooled_height,
+                            pooled_width))
+    helper.append_op(type='psroi_pool',
+                     inputs={'X': [input], 'ROIs': [rois]},
+                     outputs={'Out': [out]},
+                     attrs={'output_channels': output_channels,
+                            'spatial_scale': spatial_scale,
+                            'pooled_height': pooled_height,
+                            'pooled_width': pooled_width})
+    return out
 
 
 def linear_chain_crf(input, label, param_attr=None, name=None):

@@ -19,3 +19,4 @@ from . import rnn_ops
 from . import control_flow_ops
 from . import crf_ctc_ops
 from . import detection_ops
+from . import vision_ops

@@ -1,3 +1,952 @@
-"""Detection ops (reference operators/detection/, ~25 ops) — stage 7."""
+"""Detection op family (reference paddle/fluid/operators/detection/, ~25 ops).
+
+TPU-native redesign principles:
+- Box generators (prior_box / density_prior_box / anchor_generator) depend
+  only on static shapes + attrs, so they are computed with numpy at trace
+  time and enter the XLA program as constants (zero FLOPs per step).
+- Ragged ground-truth boxes ride the static-LoD subsystem (core/lod.py):
+  per-instance slices have static extents, so matching/assignment vectorize
+  into gathers with no dynamic shapes.
+- Data-dependent-length outputs (multiclass_nms detections, mined negative
+  indices) cannot carry a runtime LoD under XLA; they are emitted as
+  fixed-capacity arrays padded with -1 sentinels (same policy as ctc_align).
+  Consumers in this module (target_assign) understand the sentinel.
+- Sequential-by-nature algorithms (greedy bipartite match, NMS suppression)
+  run as lax.fori_loop over a precomputed similarity/IoU matrix: the matrix
+  is one MXU-friendly batched op, the loop body is O(capacity) cheap vector
+  work.
+"""
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
 
 from ..core.registry import register_op
+
+_EPS = 1e-6
+
+
+# ---------------------------------------------------------------------------
+# Box generators: trace-time numpy constants
+# ---------------------------------------------------------------------------
+
+def _expand_aspect_ratios(aspect_ratios, flip):
+    """reference prior_box_op.h ExpandAspectRatios."""
+    out = [1.0]
+    for ar in aspect_ratios:
+        if any(abs(ar - o) < 1e-6 for o in out):
+            continue
+        out.append(float(ar))
+        if flip:
+            out.append(1.0 / ar)
+    return out
+
+
+@register_op('prior_box')
+def _prior_box(ctx, op):
+    """reference operators/detection/prior_box_op.{cc,h}: SSD prior boxes for
+    one feature map. Output Boxes/Variances [H, W, num_priors, 4], a pure
+    function of static shapes and attrs -> numpy constant."""
+    feat = ctx.in1(op, 'Input')
+    image = ctx.in1(op, 'Image')
+    fh, fw = feat.shape[2], feat.shape[3]
+    ih, iw = image.shape[2], image.shape[3]
+
+    min_sizes = [float(s) for s in op.attr('min_sizes')]
+    max_sizes = [float(s) for s in (op.attr('max_sizes') or [])]
+    if max_sizes and len(max_sizes) != len(min_sizes):
+        raise ValueError(
+            "prior_box: max_sizes (%d) must have the same length as "
+            "min_sizes (%d)" % (len(max_sizes), len(min_sizes)))
+    ars = _expand_aspect_ratios(op.attr('aspect_ratios', [1.0]),
+                                op.attr('flip', False))
+    variances = [float(v) for v in op.attr('variances',
+                                           [0.1, 0.1, 0.2, 0.2])]
+    clip = op.attr('clip', False)
+    step_w = op.attr('step_w', 0.0)
+    step_h = op.attr('step_h', 0.0)
+    offset = op.attr('offset', 0.5)
+    mmo = op.attr('min_max_aspect_ratios_order', False)
+
+    sw = step_w if step_w else float(iw) / fw
+    sh = step_h if step_h else float(ih) / fh
+
+    # per-center list of (half_w, half_h), reference enumeration order
+    halves = []
+    for s, ms in enumerate(min_sizes):
+        if mmo:
+            halves.append((ms / 2., ms / 2.))
+            if max_sizes:
+                m = math.sqrt(ms * max_sizes[s]) / 2.
+                halves.append((m, m))
+            for ar in ars:
+                if abs(ar - 1.) < 1e-6:
+                    continue
+                halves.append((ms * math.sqrt(ar) / 2.,
+                               ms / math.sqrt(ar) / 2.))
+        else:
+            for ar in ars:
+                halves.append((ms * math.sqrt(ar) / 2.,
+                               ms / math.sqrt(ar) / 2.))
+            if max_sizes:
+                m = math.sqrt(ms * max_sizes[s]) / 2.
+                halves.append((m, m))
+    halves = np.asarray(halves, np.float32)            # [P, 2]
+    num_priors = halves.shape[0]
+
+    cx = (np.arange(fw, dtype=np.float32) + offset) * sw   # [W]
+    cy = (np.arange(fh, dtype=np.float32) + offset) * sh   # [H]
+    cxg, cyg = np.meshgrid(cx, cy)                         # [H, W]
+    c = np.stack([cxg, cyg], -1)[:, :, None, :]            # [H, W, 1, 2]
+    mins = (c - halves[None, None]) / np.array([iw, ih], np.float32)
+    maxs = (c + halves[None, None]) / np.array([iw, ih], np.float32)
+    boxes = np.concatenate([mins, maxs], -1)               # [H, W, P, 4]
+    if clip:
+        boxes = np.clip(boxes, 0., 1.)
+    var = np.broadcast_to(np.asarray(variances, np.float32),
+                          (fh, fw, num_priors, 4)).copy()
+    ctx.out(op, 'Boxes', jnp.asarray(boxes.astype(np.float32)))
+    ctx.out(op, 'Variances', jnp.asarray(var))
+
+
+@register_op('density_prior_box')
+def _density_prior_box(ctx, op):
+    """reference operators/detection/density_prior_box_op.h."""
+    feat = ctx.in1(op, 'Input')
+    image = ctx.in1(op, 'Image')
+    fh, fw = feat.shape[2], feat.shape[3]
+    ih, iw = image.shape[2], image.shape[3]
+
+    fixed_sizes = [float(s) for s in op.attr('fixed_sizes', [])]
+    fixed_ratios = [float(r) for r in op.attr('fixed_ratios', [])]
+    densities = [int(d) for d in op.attr('densities', [])]
+    variances = [float(v) for v in op.attr('variances',
+                                           [0.1, 0.1, 0.2, 0.2])]
+    clip = op.attr('clip', False)
+    step_w = op.attr('step_w', 0.0)
+    step_h = op.attr('step_h', 0.0)
+    offset = op.attr('offset', 0.5)
+
+    sw = step_w if step_w else float(iw) / fw
+    sh = step_h if step_h else float(ih) / fh
+    step_average = int((sw + sh) * 0.5)
+
+    # per-center offsets/sizes of all priors (numpy-vectorized: constant
+    # evaluation must stay O(ms) even on 200x200 RPN maps)
+    doff, dhalf = [], []          # center offset (dx, dy), half size (w, h)
+    for s, fixed_size in enumerate(fixed_sizes):
+        density = densities[s]
+        shift = step_average // density
+        base = -step_average / 2. + shift / 2.
+        for r in fixed_ratios:
+            bwr = fixed_size * math.sqrt(r) / 2.
+            bhr = fixed_size / math.sqrt(r) / 2.
+            for di in range(density):
+                for dj in range(density):
+                    doff.append((base + dj * shift, base + di * shift))
+                    dhalf.append((bwr, bhr))
+    doff = np.asarray(doff, np.float32)          # [P, 2]
+    dhalf = np.asarray(dhalf, np.float32)        # [P, 2]
+    num_priors = doff.shape[0]
+
+    cx = (np.arange(fw, dtype=np.float32) + offset) * sw
+    cy = (np.arange(fh, dtype=np.float32) + offset) * sh
+    cxg, cyg = np.meshgrid(cx, cy)                           # [H, W]
+    centers = np.stack([cxg, cyg], -1)[:, :, None, :] + doff[None, None]
+    dims = np.array([iw, ih], np.float32)
+    mins = np.maximum((centers - dhalf[None, None]) / dims, 0.)
+    maxs = np.minimum((centers + dhalf[None, None]) / dims, 1.)
+    boxes = np.concatenate([mins, maxs], -1)                 # [H, W, P, 4]
+    if clip:
+        boxes = np.clip(boxes, 0., 1.)
+    var = np.broadcast_to(np.asarray(variances, np.float32),
+                          (fh, fw, num_priors, 4)).copy()
+    ctx.out(op, 'Boxes', jnp.asarray(boxes))
+    ctx.out(op, 'Variances', jnp.asarray(var))
+
+
+@register_op('anchor_generator')
+def _anchor_generator(ctx, op):
+    """reference operators/detection/anchor_generator_op.h (Faster-RCNN
+    anchors). Output Anchors/Variances [H, W, num_anchors, 4]."""
+    feat = ctx.in1(op, 'Input')
+    fh, fw = feat.shape[2], feat.shape[3]
+    anchor_sizes = [float(s) for s in op.attr('anchor_sizes')]
+    aspect_ratios = [float(r) for r in op.attr('aspect_ratios')]
+    stride = [float(s) for s in op.attr('stride')]
+    variances = [float(v) for v in op.attr('variances',
+                                           [0.1, 0.1, 0.2, 0.2])]
+    offset = op.attr('offset', 0.5)
+    sw, sh = stride[0], stride[1]
+
+    # per-center anchor half-extents (numpy-vectorized over the grid)
+    halves = []
+    for ar in aspect_ratios:
+        for size in anchor_sizes:
+            area = sw * sh
+            base_w = round(math.sqrt(area / ar))
+            base_h = round(base_w * ar)
+            halves.append((0.5 * ((size / sw) * base_w - 1),
+                           0.5 * ((size / sh) * base_h - 1)))
+    halves = np.asarray(halves, np.float32)                  # [A, 2]
+    num_anchors = halves.shape[0]
+
+    xc = np.arange(fw, dtype=np.float32) * sw + offset * (sw - 1)
+    yc = np.arange(fh, dtype=np.float32) * sh + offset * (sh - 1)
+    xg, yg = np.meshgrid(xc, yc)                             # [H, W]
+    ctr = np.stack([xg, yg], -1)[:, :, None, :]              # [H, W, 1, 2]
+    anchors = np.concatenate([ctr - halves[None, None],
+                              ctr + halves[None, None]], -1)
+    var = np.broadcast_to(np.asarray(variances, np.float32),
+                          (fh, fw, num_anchors, 4)).copy()
+    ctx.out(op, 'Anchors', jnp.asarray(anchors))
+    ctx.out(op, 'Variances', jnp.asarray(var))
+
+
+# ---------------------------------------------------------------------------
+# Box arithmetic
+# ---------------------------------------------------------------------------
+
+def _center_size(box, normalized):
+    """(cx, cy, w, h) of corner-format boxes [..., 4]."""
+    un = 0.0 if normalized else 1.0
+    w = box[..., 2] - box[..., 0] + un
+    h = box[..., 3] - box[..., 1] + un
+    cx = box[..., 0] + w / 2
+    cy = box[..., 1] + h / 2
+    return cx, cy, w, h
+
+
+@register_op('box_coder')
+def _box_coder(ctx, op):
+    """reference operators/detection/box_coder_op.h.
+    encode_center_size: TargetBox [M,4] x PriorBox [P,4] -> [M,P,4].
+    decode_center_size: TargetBox [M,P,4] with PriorBox broadcast along
+    `axis` -> [M,P,4]."""
+    prior = ctx.in1(op, 'PriorBox')
+    prior_var = ctx.in1(op, 'PriorBoxVar')
+    target = ctx.in1(op, 'TargetBox')
+    code_type = op.attr('code_type', 'encode_center_size')
+    normalized = op.attr('box_normalized', True)
+    axis = op.attr('axis', 0)
+    var_attr = op.attr('variance', [])
+
+    pcx, pcy, pw, ph = _center_size(prior, normalized)
+
+    if code_type == 'encode_center_size':
+        tcx = (target[..., 2] + target[..., 0]) / 2
+        tcy = (target[..., 3] + target[..., 1]) / 2
+        un = 0.0 if normalized else 1.0
+        tw = target[..., 2] - target[..., 0] + un
+        th = target[..., 3] - target[..., 1] + un
+        # [M, 1] x [1, P]
+        ox = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+        oy = (tcy[:, None] - pcy[None, :]) / ph[None, :]
+        ow = jnp.log(jnp.abs(tw[:, None] / pw[None, :]))
+        oh = jnp.log(jnp.abs(th[:, None] / ph[None, :]))
+        out = jnp.stack([ox, oy, ow, oh], -1)          # [M, P, 4]
+        if prior_var is not None:
+            out = out / prior_var[None, :, :]
+        elif var_attr:
+            out = out / jnp.asarray(var_attr, out.dtype)
+        ctx.out(op, 'OutputBox', out)
+        ctx.set_lod(op.output('OutputBox')[0], ctx.in1_lod(op, 'TargetBox'))
+        return
+
+    # decode_center_size: prior broadcast along `axis` of target [M, P, 4]
+    if target.ndim == 2:
+        target = target[:, None, :]
+    if prior_var is not None:
+        var = prior_var
+    elif var_attr:
+        var = jnp.broadcast_to(jnp.asarray(var_attr, target.dtype),
+                               prior.shape)
+    else:
+        var = jnp.ones_like(prior)
+    if axis == 0:
+        # prior indexed by target dim 1
+        pcx, pcy, pw, ph = pcx[None, :], pcy[None, :], pw[None, :], ph[None, :]
+        var = var[None, :, :]
+    else:
+        pcx, pcy, pw, ph = pcx[:, None], pcy[:, None], pw[:, None], ph[:, None]
+        var = var[:, None, :]
+    dcx = var[..., 0] * target[..., 0] * pw + pcx
+    dcy = var[..., 1] * target[..., 1] * ph + pcy
+    dw = jnp.exp(var[..., 2] * target[..., 2]) * pw
+    dh = jnp.exp(var[..., 3] * target[..., 3]) * ph
+    un = 0.0 if normalized else 1.0
+    out = jnp.stack([dcx - dw / 2, dcy - dh / 2,
+                     dcx + dw / 2 - un, dcy + dh / 2 - un], -1)
+    ctx.out(op, 'OutputBox', out)
+
+
+def _iou_matrix(x, y, normalized=True):
+    """Pairwise IoU of corner boxes x [N,4], y [M,4] -> [N,M]."""
+    un = 0.0 if normalized else 1.0
+    area_x = (x[:, 2] - x[:, 0] + un) * (x[:, 3] - x[:, 1] + un)
+    area_y = (y[:, 2] - y[:, 0] + un) * (y[:, 3] - y[:, 1] + un)
+    ix1 = jnp.maximum(x[:, None, 0], y[None, :, 0])
+    iy1 = jnp.maximum(x[:, None, 1], y[None, :, 1])
+    ix2 = jnp.minimum(x[:, None, 2], y[None, :, 2])
+    iy2 = jnp.minimum(x[:, None, 3], y[None, :, 3])
+    iw = jnp.maximum(ix2 - ix1 + un, 0.0)
+    ih = jnp.maximum(iy2 - iy1 + un, 0.0)
+    inter = iw * ih
+    union = area_x[:, None] + area_y[None, :] - inter
+    return jnp.where(inter > 0, inter / union, 0.0)
+
+
+@register_op('iou_similarity')
+def _iou_similarity(ctx, op):
+    """reference operators/detection/iou_similarity_op.h: IoU matrix between
+    X [N,4] (LoD-capable) and Y [M,4]."""
+    x = ctx.in1(op, 'X')
+    y = ctx.in1(op, 'Y')
+    normalized = op.attr('box_normalized', True)
+    ctx.out(op, 'Out', _iou_matrix(x, y, normalized))
+    ctx.set_lod(op.output('Out')[0], ctx.in1_lod(op, 'X'))
+
+
+@register_op('box_clip')
+def _box_clip(ctx, op):
+    """reference operators/detection/box_clip_op.h ClipTiledBoxes: clip boxes
+    to the original image extent im_info=(h, w, scale)."""
+    boxes = ctx.in1(op, 'Input')
+    im_info = ctx.in1(op, 'ImInfo')
+    lod = ctx.in1_lod(op, 'Input')
+    offsets = lod[-1] if lod else (0, boxes.shape[0])
+    outs = []
+    for i in range(len(offsets) - 1):
+        seg = boxes[offsets[i]:offsets[i + 1]]
+        im_w = jnp.round(im_info[i, 1] / im_info[i, 2])
+        im_h = jnp.round(im_info[i, 0] / im_info[i, 2])
+        hi = jnp.stack([im_w - 1, im_h - 1, im_w - 1, im_h - 1])
+        clipped = jnp.clip(seg.reshape(-1, 4), 0.0, hi)
+        outs.append(clipped.reshape(seg.shape))
+    out = jnp.concatenate(outs, 0) if len(outs) > 1 else outs[0]
+    ctx.out(op, 'Output', out)
+    ctx.set_lod(op.output('Output')[0], lod)
+
+
+@register_op('polygon_box_transform')
+def _polygon_box_transform(ctx, op):
+    """reference operators/detection/polygon_box_transform_op.cc (EAST text
+    detection geometry): out = 4 * pixel coordinate - in, even channels use
+    the column index, odd channels the row index."""
+    x = ctx.in1(op, 'Input')
+    n, c, h, w = x.shape
+    col = jnp.broadcast_to(jnp.arange(w, dtype=x.dtype)[None, :], (h, w))
+    row = jnp.broadcast_to(jnp.arange(h, dtype=x.dtype)[:, None], (h, w))
+    grid = jnp.where((jnp.arange(c) % 2 == 0)[:, None, None],
+                     col[None], row[None])          # [C, H, W]
+    ctx.out(op, 'Output', grid[None] * 4 - x)
+
+
+# ---------------------------------------------------------------------------
+# Matching / assignment
+# ---------------------------------------------------------------------------
+
+def _bipartite_greedy(dist):
+    """Greedy bipartite match of one instance's [R, C] similarity matrix
+    (reference bipartite_match_op.cc BipartiteMatch): repeatedly take the
+    globally largest remaining entry (> 0) among unmatched rows/cols.
+    Returns (match [C] int32 row-index-or--1, match_dist [C])."""
+    r, c = dist.shape
+    match0 = jnp.full((c,), -1, jnp.int32)
+    mdist0 = jnp.zeros((c,), dist.dtype)
+    rowfree0 = jnp.ones((r,), bool)
+
+    def body(_, state):
+        match, mdist, rowfree = state
+        masked = jnp.where(rowfree[:, None] & (match == -1)[None, :],
+                           dist, -1.0)
+        k = jnp.argmax(masked)
+        i, j = k // c, k % c
+        ok = masked.reshape(-1)[k] > _EPS
+        match = jnp.where(ok, match.at[j].set(i.astype(jnp.int32)), match)
+        mdist = jnp.where(ok, mdist.at[j].set(dist[i, j]), mdist)
+        rowfree = jnp.where(ok, rowfree.at[i].set(False), rowfree)
+        return match, mdist, rowfree
+
+    match, mdist, _ = lax.fori_loop(0, min(r, c), body,
+                                    (match0, mdist0, rowfree0))
+    return match, mdist
+
+
+def _argmax_match(dist, match, mdist, threshold):
+    """reference bipartite_match_op.cc ArgMaxMatch: for still-unmatched
+    columns, match the row with max dist if >= threshold."""
+    col_max = jnp.max(dist, 0)
+    col_arg = jnp.argmax(dist, 0).astype(jnp.int32)
+    extra = (match == -1) & (col_max >= threshold) & (col_max > _EPS)
+    return (jnp.where(extra, col_arg, match),
+            jnp.where(extra, col_max, mdist))
+
+
+@register_op('bipartite_match')
+def _bipartite_match(ctx, op):
+    """reference operators/detection/bipartite_match_op.cc. DistMat is
+    [sum_rows, C] with LoD over instances (or a single instance without);
+    outputs ColToRowMatchIndices / ColToRowMatchDist [n, C]."""
+    dist = ctx.in1(op, 'DistMat')
+    lod = ctx.in1_lod(op, 'DistMat')
+    match_type = op.attr('match_type', 'bipartite')
+    threshold = op.attr('dist_threshold', 0.5)
+
+    offsets = lod[-1] if lod else (0, dist.shape[0])
+    matches, dists = [], []
+    for i in range(len(offsets) - 1):
+        seg = dist[offsets[i]:offsets[i + 1]]
+        m, d = _bipartite_greedy(seg)
+        if match_type == 'per_prediction':
+            m, d = _argmax_match(seg, m, d, threshold)
+        matches.append(m)
+        dists.append(d)
+    ctx.out(op, 'ColToRowMatchIndices', jnp.stack(matches))
+    ctx.out(op, 'ColToRowMatchDist', jnp.stack(dists))
+    ctx.set_lod(op.output('ColToRowMatchIndices')[0], ())
+    ctx.set_lod(op.output('ColToRowMatchDist')[0], ())
+
+
+@register_op('target_assign')
+def _target_assign(ctx, op):
+    """reference operators/detection/target_assign_op.{cc,h}: gather targets
+    X [sum_M, P, K] (LoD over instances) by MatchIndices [N, Np];
+    Out[i][j] = X[lod[i] + match[i][j]][j % P], weight 1 where matched,
+    else mismatch_value / weight 0. NegIndices marks negatives: target
+    mismatch_value with weight 1.
+
+    TPU deviation: NegIndices is the fixed-shape [N, Q] -1-padded array
+    emitted by mine_hard_examples (not a ragged LoD tensor)."""
+    x = ctx.in1(op, 'X')
+    match = ctx.in1(op, 'MatchIndices')
+    neg = ctx.in1(op, 'NegIndices')
+    mismatch_value = op.attr('mismatch_value', 0)
+    lod = ctx.in1_lod(op, 'X')
+    n, np_ = match.shape
+    if x.ndim == 2:
+        x = x[:, None, :]
+    p = x.shape[1]
+    offsets = (lod[-1] if lod else (0, x.shape[0]))
+    if len(offsets) - 1 != n:
+        raise ValueError(
+            "target_assign: X has %d instances (lod) but MatchIndices has "
+            "batch %d" % (len(offsets) - 1, n))
+
+    cols = jnp.arange(np_) % p
+    outs, weights = [], []
+    for i in range(n):
+        xi = x[offsets[i]:offsets[i + 1]]       # [Mi, P, K]
+        mi = match[i]                            # [Np]
+        valid = mi > -1
+        idx = jnp.clip(mi, 0, max(xi.shape[0] - 1, 0))
+        gathered = xi[idx, cols]                 # [Np, K]
+        out_i = jnp.where(valid[:, None], gathered,
+                          jnp.asarray(mismatch_value, x.dtype))
+        w_i = valid.astype(jnp.float32)
+        if neg is not None:
+            neg_i = neg[i].reshape(-1).astype(jnp.int32)
+            sent = jnp.where(neg_i < 0, np_, neg_i)   # -1 -> dropped
+            out_i = out_i.at[sent].set(
+                jnp.asarray(mismatch_value, x.dtype), mode='drop')
+            w_i = w_i.at[sent].set(1.0, mode='drop')
+        outs.append(out_i)
+        weights.append(w_i)
+    ctx.out(op, 'Out', jnp.stack(outs))
+    ctx.out(op, 'OutWeight', jnp.stack(weights)[:, :, None])
+    ctx.set_lod(op.output('Out')[0], ())
+
+
+@register_op('mine_hard_examples')
+def _mine_hard_examples(ctx, op):
+    """reference operators/detection/mine_hard_examples_op.cc. Selects hard
+    negative priors by descending loss.
+
+    max_negative: eligible = unmatched & match_dist < neg_dist_threshold;
+    select min(neg_pos_ratio * num_pos, num_eligible) largest-loss ones.
+    hard_example: eligible = all; select min(sample_size, Np); positives not
+    selected are demoted to -1 in UpdatedMatchIndices.
+
+    TPU deviation: NegIndices is [N, Np] int32, the selected prior indices in
+    descending-loss order, -1-padded (the reference emits a ragged LoD
+    tensor; fixed capacity keeps shapes static under XLA)."""
+    cls_loss = ctx.in1(op, 'ClsLoss')
+    loc_loss = ctx.in1(op, 'LocLoss')
+    match = ctx.in1(op, 'MatchIndices')
+    mdist = ctx.in1(op, 'MatchDist')
+    ratio = op.attr('neg_pos_ratio', 3.0)
+    thr = op.attr('neg_dist_threshold', 0.5)
+    sample_size = op.attr('sample_size', 0) or 0
+    mining_type = op.attr('mining_type', 'max_negative')
+
+    n, np_ = match.shape
+    loss = cls_loss.reshape(n, np_)
+    if mining_type == 'hard_example' and loc_loss is not None:
+        loss = loss + loc_loss.reshape(n, np_)
+
+    if mining_type == 'max_negative':
+        eligible = (match == -1) & (mdist < thr)
+        num_pos = jnp.sum((match != -1).astype(jnp.int32), 1)       # [N]
+        quota = (num_pos.astype(jnp.float32) * ratio).astype(jnp.int32)
+    elif mining_type == 'hard_example':
+        eligible = jnp.ones_like(match, bool)
+        quota = jnp.full((n,), int(sample_size), jnp.int32)
+    else:
+        raise ValueError("mine_hard_examples: unknown mining_type %r"
+                         % mining_type)
+
+    masked_loss = jnp.where(eligible, loss, -jnp.inf)
+    order = jnp.argsort(-masked_loss, 1)                  # [N, Np] desc
+    n_eligible = jnp.sum(eligible.astype(jnp.int32), 1)
+    n_sel = jnp.minimum(quota, n_eligible)                # [N]
+    rank = jnp.arange(np_)[None, :]
+    sel_sorted = rank < n_sel[:, None]                    # positions kept
+    neg_indices = jnp.where(sel_sorted, order, -1).astype(jnp.int32)
+
+    if mining_type == 'hard_example':
+        # scatter selection flags back to prior positions
+        sel = jnp.zeros((n, np_), bool)
+        sel = jax.vmap(
+            lambda s, o, f: s.at[o].set(f))(sel, order, sel_sorted)
+        updated = jnp.where((match > -1) & ~sel, -1, match)
+        # positives selected keep their match; drop them from the neg list
+        is_neg = jax.vmap(lambda m, o: m[o] == -1)(match, order)
+        neg_indices = jnp.where(sel_sorted & is_neg, order, -1).astype(
+            jnp.int32)
+        ctx.out(op, 'UpdatedMatchIndices', updated)
+    else:
+        ctx.out(op, 'UpdatedMatchIndices', match)
+    ctx.out(op, 'NegIndices', neg_indices)
+    ctx.set_lod(op.output('NegIndices')[0], ())
+
+
+# ---------------------------------------------------------------------------
+# NMS
+# ---------------------------------------------------------------------------
+
+def _greedy_suppress(iou, valid, nms_threshold, nms_eta):
+    """Greedy NMS keep-mask over score-sorted candidates (reference
+    multiclass_nms_op.cc NMSFast's adaptive-threshold state machine).
+    iou [K,K] of the sorted candidates; valid [K] candidate mask."""
+    k = valid.shape[0]
+
+    def body(i, state):
+        keep, thr = state
+        sup = jnp.max(jnp.where(keep & (jnp.arange(k) < i), iou[:, i], 0.0))
+        ok = valid[i] & (sup <= thr)
+        keep = keep.at[i].set(ok)
+        thr = jnp.where(ok & (nms_eta < 1.0) & (thr > 0.5), thr * nms_eta,
+                        thr)
+        return keep, thr
+
+    keep, _ = lax.fori_loop(
+        0, k, body, (jnp.zeros((k,), bool),
+                     jnp.asarray(nms_threshold, jnp.float32)))
+    return keep
+
+
+def _nms_class(boxes, scores, score_threshold, nms_top_k, nms_threshold,
+               nms_eta, normalized):
+    """Greedy NMS for one class (reference multiclass_nms_op.cc NMSFast).
+    boxes [M,4], scores [M] -> (keep mask over top-K candidates, their
+    indices into the original M, their scores). Static capacity K."""
+    m = boxes.shape[0]
+    k = m if nms_top_k < 0 else min(int(nms_top_k), m)
+    neg_inf = jnp.asarray(-jnp.inf, scores.dtype)
+    cand_scores = jnp.where(scores > score_threshold, scores, neg_inf)
+    top_scores, top_idx = lax.top_k(cand_scores, k)
+    top_boxes = boxes[top_idx]
+    iou = _iou_matrix(top_boxes, top_boxes, normalized)
+    keep = _greedy_suppress(iou, top_scores > neg_inf, nms_threshold,
+                            nms_eta)
+    return keep, top_idx, top_scores
+
+
+@register_op('multiclass_nms')
+def _multiclass_nms(ctx, op):
+    """reference operators/detection/multiclass_nms_op.cc. BBoxes [N, M, 4],
+    Scores [N, C, M] -> Out [N * keep_top_k, 6] rows (label, score, x1, y1,
+    x2, y2).
+
+    TPU deviation: the reference output is ragged (LoD over images, length =
+    per-image detection count). Here every image occupies exactly keep_top_k
+    rows; slots beyond the real detections carry label -1 (the ctc_align
+    sentinel policy). keep_top_k must be >= 0 for a static capacity."""
+    bboxes = ctx.in1(op, 'BBoxes')
+    scores = ctx.in1(op, 'Scores')
+    background = op.attr('background_label', 0)
+    score_threshold = op.attr('score_threshold')
+    nms_top_k = op.attr('nms_top_k')
+    nms_threshold = op.attr('nms_threshold', 0.3)
+    nms_eta = op.attr('nms_eta', 1.0)
+    keep_top_k = op.attr('keep_top_k')
+    normalized = op.attr('normalized', True)
+    if keep_top_k is None or keep_top_k < 0:
+        raise ValueError(
+            "multiclass_nms: keep_top_k must be a non-negative static "
+            "capacity on TPU (the ragged reference output would need "
+            "dynamic shapes)")
+
+    n, m, _ = bboxes.shape
+    c = scores.shape[1]
+
+    def per_image(boxes, sc):
+        sel_scores, sel_labels, sel_pos = [], [], []
+        for cls in range(c):
+            if cls == background:
+                continue
+            keep, top_idx, top_scores = _nms_class(
+                boxes, sc[cls], score_threshold, nms_top_k, nms_threshold,
+                nms_eta, normalized)
+            sel_scores.append(jnp.where(keep, top_scores, -jnp.inf))
+            sel_labels.append(jnp.full(keep.shape, cls, jnp.int32))
+            sel_pos.append(top_idx)
+        all_scores = jnp.concatenate(sel_scores)
+        all_labels = jnp.concatenate(sel_labels)
+        all_pos = jnp.concatenate(sel_pos)
+        kk = min(int(keep_top_k), all_scores.shape[0])
+        final_scores, fi = lax.top_k(all_scores, kk)
+        ok = final_scores > -jnp.inf
+        labels = jnp.where(ok, all_labels[fi], -1)
+        fboxes = boxes[all_pos[fi]]
+        row = jnp.concatenate(
+            [labels[:, None].astype(boxes.dtype),
+             jnp.where(ok, final_scores, 0.0)[:, None].astype(boxes.dtype),
+             jnp.where(ok[:, None], fboxes, -1.0)], 1)
+        if kk < keep_top_k:
+            pad = jnp.full((int(keep_top_k) - kk, 6), -1.0, boxes.dtype)
+            row = jnp.concatenate([row, pad], 0)
+        return row
+
+    out = jax.vmap(per_image)(bboxes, scores)     # [N, keep_top_k, 6]
+    ctx.out(op, 'Out', out.reshape(n * int(keep_top_k), 6))
+    ctx.set_lod(op.output('Out')[0], ())
+
+
+# ---------------------------------------------------------------------------
+# YOLO / RCNN family
+# ---------------------------------------------------------------------------
+
+def _sce(x, label):
+    """Numerically-stable sigmoid cross entropy (reference yolov3_loss_op.h
+    SigmoidCrossEntropy)."""
+    return jnp.maximum(x, 0.0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+
+
+def _cxcywh_iou(b1, b2):
+    """IoU of center-size boxes (reference yolov3_loss_op.h CalcBoxIoU).
+    b1 [..., 4], b2 [..., 4] broadcastable."""
+    l = jnp.maximum(b1[..., 0] - b1[..., 2] / 2, b2[..., 0] - b2[..., 2] / 2)
+    r = jnp.minimum(b1[..., 0] + b1[..., 2] / 2, b2[..., 0] + b2[..., 2] / 2)
+    t = jnp.maximum(b1[..., 1] - b1[..., 3] / 2, b2[..., 1] - b2[..., 3] / 2)
+    b = jnp.minimum(b1[..., 1] + b1[..., 3] / 2, b2[..., 1] + b2[..., 3] / 2)
+    iw = jnp.maximum(r - l, 0.0)
+    ih = jnp.maximum(b - t, 0.0)
+    inter = iw * ih
+    union = b1[..., 2] * b1[..., 3] + b2[..., 2] * b2[..., 3] - inter
+    return inter / jnp.maximum(union, 1e-10)
+
+
+@register_op('yolov3_loss')
+def _yolov3_loss(ctx, op):
+    """reference operators/detection/yolov3_loss_op.{cc,h}. X is
+    [N, mask_num*(5+C), H, W]; GTBox [N, B, 4] center-size relative coords;
+    GTLabel [N, B] int. Loss [N] per image, fully vectorized:
+    - location/class loss at each gt's best-anchor cell,
+    - objectness loss: 1-target at matched cells, 0-target elsewhere except
+      cells whose best pred-gt IoU exceeds ignore_thresh (masked out)."""
+    x = ctx.in1(op, 'X')
+    gtbox = ctx.in1(op, 'GTBox')
+    gtlabel = ctx.in1(op, 'GTLabel')
+    anchors = [int(a) for a in op.attr('anchors')]
+    anchor_mask = [int(a) for a in op.attr('anchor_mask')]
+    class_num = op.attr('class_num')
+    ignore_thresh = op.attr('ignore_thresh')
+    downsample = op.attr('downsample_ratio', 32)
+
+    n, _, h, w = x.shape
+    b = gtbox.shape[1]
+    mask_num = len(anchor_mask)
+    an_num = len(anchors) // 2
+    input_size = downsample * h
+    xr = x.reshape(n, mask_num, 5 + class_num, h, w)
+    gtlabel = gtlabel.astype(jnp.int32)
+
+    anchors_np = np.asarray(anchors, np.float32).reshape(an_num, 2)
+    mask_anchors = anchors_np[np.asarray(anchor_mask)]       # [mask, 2]
+
+    # --- predicted boxes per (mask, cell) for the ignore rule ------------
+    gi = jnp.arange(w, dtype=jnp.float32)[None, :]
+    gj = jnp.arange(h, dtype=jnp.float32)[:, None]
+    px = (gi + jax.nn.sigmoid(xr[:, :, 0])) / w              # [n,mask,h,w]
+    py = (gj + jax.nn.sigmoid(xr[:, :, 1])) / h
+    pw_ = jnp.exp(xr[:, :, 2]) * \
+        jnp.asarray(mask_anchors[:, 0])[None, :, None, None] / input_size
+    ph_ = jnp.exp(xr[:, :, 3]) * \
+        jnp.asarray(mask_anchors[:, 1])[None, :, None, None] / input_size
+    pred = jnp.stack([px, py, pw_, ph_], -1)                 # [n,mask,h,w,4]
+
+    gt_valid = (gtbox[..., 2] > 1e-6) & (gtbox[..., 3] > 1e-6)   # [n,b]
+    iou_pg = _cxcywh_iou(pred[:, :, :, :, None, :],
+                         gtbox[:, None, None, None, :, :])   # [n,mask,h,w,b]
+    iou_pg = jnp.where(gt_valid[:, None, None, None, :], iou_pg, 0.0)
+    best_iou = jnp.max(iou_pg, -1) if b else jnp.zeros_like(px)
+    ignore = best_iou > ignore_thresh                        # obj = -1
+
+    # --- per-gt best anchor (over ALL anchors, centered at origin) -------
+    an_wh = jnp.asarray(anchors_np) / input_size             # [an, 2]
+    zeros2 = jnp.zeros((an_num, 2))
+    an_boxes = jnp.concatenate([zeros2, an_wh], -1)          # [an, 4]
+    gt_shift = gtbox.at[..., 0:2].set(0.0)                   # [n, b, 4]
+    iou_ga = _cxcywh_iou(gt_shift[:, :, None, :],
+                         an_boxes[None, None, :, :])         # [n, b, an]
+    best_n = jnp.argmax(iou_ga, -1)                          # [n, b]
+    # map anchor index -> position in anchor_mask (or -1)
+    mask_lookup = np.full((an_num,), -1, np.int32)
+    for mi, av in enumerate(anchor_mask):
+        mask_lookup[av] = mi
+    mask_idx = jnp.asarray(mask_lookup)[best_n]              # [n, b]
+    matched = gt_valid & (mask_idx >= 0)
+
+    gx_cell = jnp.clip((gtbox[..., 0] * w).astype(jnp.int32), 0, w - 1)
+    gy_cell = jnp.clip((gtbox[..., 1] * h).astype(jnp.int32), 0, h - 1)
+
+    def per_image(xi, gt, lab, m_idx, gxc, gyc, ok, bn):
+        """xi [mask,5+C,h,w]; loop over B gts (B static)."""
+        loss = 0.0
+        obj_pos = jnp.zeros((mask_num, h, w), bool)
+        for t in range(b):
+            mi = jnp.clip(m_idx[t], 0, mask_num - 1)
+            cell = xi[mi, :, gyc[t], gxc[t]]                 # [5+C]
+            tx = gt[t, 0] * w - gxc[t]
+            ty = gt[t, 1] * h - gyc[t]
+            anc = jnp.asarray(anchors_np)[jnp.clip(bn[t], 0, an_num - 1)]
+            tw = jnp.log(jnp.maximum(gt[t, 2] * input_size / anc[0], 1e-9))
+            th = jnp.log(jnp.maximum(gt[t, 3] * input_size / anc[1], 1e-9))
+            scale = 2.0 - gt[t, 2] * gt[t, 3]
+            loc = (_sce(cell[0], tx) + _sce(cell[1], ty)) * scale + \
+                0.5 * ((cell[2] - tw) ** 2 + (cell[3] - th) ** 2) * scale
+            onehot = jax.nn.one_hot(lab[t], class_num)
+            cls = jnp.sum(_sce(cell[5:], onehot))
+            loss = loss + jnp.where(ok[t], loc + cls, 0.0)
+            obj_pos = jnp.where(
+                ok[t], obj_pos.at[mi, gyc[t], gxc[t]].set(True), obj_pos)
+        return loss, obj_pos
+
+    loc_cls_loss, obj_pos = jax.vmap(per_image)(
+        xr, gtbox, gtlabel, mask_idx, gx_cell, gy_cell, matched, best_n)
+
+    obj_logit = xr[:, :, 4]                                  # [n,mask,h,w]
+    pos_loss = jnp.where(obj_pos, _sce(obj_logit, 1.0), 0.0)
+    neg_loss = jnp.where((~obj_pos) & (~ignore),
+                         _sce(obj_logit, 0.0), 0.0)
+    obj_loss = jnp.sum(pos_loss + neg_loss, axis=(1, 2, 3))
+    loss = loc_cls_loss + obj_loss
+
+    ctx.out(op, 'Loss', loss)
+    objness = jnp.where(obj_pos, 1.0, jnp.where(ignore, -1.0, 0.0))
+    ctx.out(op, 'ObjectnessMask', objness)
+    ctx.out(op, 'GTMatchMask', jnp.where(matched, mask_idx, -1))
+
+
+@register_op('generate_proposals')
+def _generate_proposals(ctx, op):
+    """reference operators/detection/generate_proposals_op.cc: decode RPN
+    deltas against anchors, clip, filter small, NMS.
+
+    TPU deviation: RpnRois is [N * post_nms_topN, 4] with a uniform static
+    LoD (post_nms_topN rows per image); empty slots carry zeros with
+    probability 0 (the reference emits ragged counts)."""
+    scores = ctx.in1(op, 'Scores')          # [N, A, H, W]
+    deltas = ctx.in1(op, 'BboxDeltas')      # [N, 4A, H, W]
+    im_info = ctx.in1(op, 'ImInfo')         # [N, 3]
+    anchors = ctx.in1(op, 'Anchors')        # [H, W, A, 4]
+    variances = ctx.in1(op, 'Variances')
+    pre_n = op.attr('pre_nms_topN', 6000)
+    post_n = op.attr('post_nms_topN', 1000)
+    nms_thresh = op.attr('nms_thresh', 0.5)
+    min_size = op.attr('min_size', 0.1)
+    eta = op.attr('eta', 1.0)
+
+    n, a, h, w = scores.shape
+    total = h * w * a
+    anc = anchors.reshape(-1, 4)
+    var = variances.reshape(-1, 4)
+
+    def per_image(sc, dl, info):
+        # scores laid out [A, H, W] -> hwa order to match anchors [H,W,A]
+        s = sc.transpose(1, 2, 0).reshape(-1)            # [total]
+        d = dl.reshape(a, 4, h, w).transpose(2, 3, 0, 1).reshape(-1, 4)
+        k = min(int(pre_n), total) if pre_n > 0 else total
+        top_s, idx = lax.top_k(s, k)
+        anc_k = anc[idx]
+        var_k = var[idx]
+        d_k = d[idx]
+        # decode (reference BoxCoder in generate_proposals: variances
+        # multiply deltas; exp clamped)
+        aw = anc_k[:, 2] - anc_k[:, 0] + 1.0
+        ah = anc_k[:, 3] - anc_k[:, 1] + 1.0
+        acx = anc_k[:, 0] + aw / 2
+        acy = anc_k[:, 1] + ah / 2
+        cx = var_k[:, 0] * d_k[:, 0] * aw + acx
+        cy = var_k[:, 1] * d_k[:, 1] * ah + acy
+        bw = jnp.exp(jnp.minimum(var_k[:, 2] * d_k[:, 2],
+                                 math.log(1000. / 16.))) * aw
+        bh = jnp.exp(jnp.minimum(var_k[:, 3] * d_k[:, 3],
+                                 math.log(1000. / 16.))) * ah
+        props = jnp.stack([cx - bw / 2, cy - bh / 2,
+                           cx + bw / 2 - 1, cy + bh / 2 - 1], -1)
+        # clip to image
+        im_h, im_w = info[0], info[1]
+        hi = jnp.stack([im_w - 1, im_h - 1, im_w - 1, im_h - 1])
+        props = jnp.clip(props, 0.0, hi)
+        # filter small (reference FilterBoxes: size in original image space)
+        ws = props[:, 2] - props[:, 0] + 1
+        hs = props[:, 3] - props[:, 1] + 1
+        ms = min_size * info[2]
+        keep_size = (ws >= ms) & (hs >= ms)
+        s_f = jnp.where(keep_size, top_s, -jnp.inf)
+        # NMS over k candidates (scores already sorted desc)
+        iou = _iou_matrix(props, props, normalized=False)
+        keep = _greedy_suppress(iou, s_f > -jnp.inf, nms_thresh, eta)
+        kept_scores = jnp.where(keep, s_f, -jnp.inf)
+        pk = min(int(post_n), k)
+        fin_s, fi = lax.top_k(kept_scores, pk)
+        ok = fin_s > -jnp.inf
+        rois = jnp.where(ok[:, None], props[fi], 0.0)
+        probs = jnp.where(ok, fin_s, 0.0)
+        if pk < post_n:
+            rois = jnp.concatenate(
+                [rois, jnp.zeros((int(post_n) - pk, 4), rois.dtype)], 0)
+            probs = jnp.concatenate(
+                [probs, jnp.zeros((int(post_n) - pk,), probs.dtype)], 0)
+        return rois, probs
+
+    rois, probs = jax.vmap(per_image)(scores, deltas, im_info)
+    out_rois = rois.reshape(n * int(post_n), 4)
+    out_probs = probs.reshape(n * int(post_n), 1)
+    ctx.out(op, 'RpnRois', out_rois)
+    ctx.out(op, 'RpnRoiProbs', out_probs)
+    uniform = tuple(int(post_n) * i for i in range(n + 1))
+    ctx.set_lod(op.output('RpnRois')[0], (uniform,))
+    if op.output('RpnRoiProbs'):
+        ctx.set_lod(op.output('RpnRoiProbs')[0], (uniform,))
+
+
+@register_op('rpn_target_assign', needs_rng=True)
+def _rpn_target_assign(ctx, op):
+    """reference operators/detection/rpn_target_assign_op.cc: sample
+    rpn_batch_size_per_im anchors per image (fg by IoU >= positive_overlap
+    or best-per-gt, bg by IoU < negative_overlap), random subsampling.
+
+    TPU deviation: fixed capacities — LocationIndex is
+    [N * fg_quota] (-1-padded via clamp + zero BBoxInsideWeight),
+    ScoreIndex is [N * rpn_batch_size_per_im]; indices are into the
+    flattened [N * A] anchor-score array, matching the reference's use
+    after reshape(cls_logits, [-1, 1])."""
+    anchor = ctx.in1(op, 'Anchor')          # [A, 4] (or [H,W,A,4])
+    gt_boxes = ctx.in1(op, 'GtBoxes')       # LoD [sum_g, 4]
+    is_crowd = ctx.in1(op, 'IsCrowd')       # optional LoD [sum_g] int
+    im_info = ctx.in1(op, 'ImInfo')
+    lod = ctx.in1_lod(op, 'GtBoxes')
+    batch_per_im = op.attr('rpn_batch_size_per_im', 256)
+    straddle_thresh = op.attr('rpn_straddle_thresh', 0.0)
+    pos_overlap = op.attr('rpn_positive_overlap', 0.7)
+    neg_overlap = op.attr('rpn_negative_overlap', 0.3)
+    fg_frac = op.attr('rpn_fg_fraction', 0.5)
+    use_random = op.attr('use_random', True)
+
+    anc = anchor.reshape(-1, 4)
+    a = anc.shape[0]
+    offsets = lod[-1] if lod else (0, gt_boxes.shape[0])
+    n = len(offsets) - 1
+    fg_quota = int(batch_per_im * fg_frac)
+
+    key = ctx.rng()
+
+    loc_idx, score_idx, tgt_label, tgt_bbox, inside_w = [], [], [], [], []
+    for i in range(n):
+        gt = gt_boxes[offsets[i]:offsets[i + 1]]
+        iou = _iou_matrix(anc, gt, normalized=False)     # [A, G]
+        if is_crowd is not None:
+            # crowd gt boxes never produce positives (reference
+            # rpn_target_assign_op.cc FilterCrowdGt)
+            crowd = is_crowd[offsets[i]:offsets[i + 1]].reshape(-1) > 0
+            iou = jnp.where(crowd[None, :], 0.0, iou)
+        # anchors straddling the image border beyond the threshold are
+        # excluded entirely (reference: inds_inside when straddle >= 0)
+        if straddle_thresh >= 0:
+            im_h, im_w = im_info[i, 0], im_info[i, 1]
+            inside = ((anc[:, 0] >= -straddle_thresh) &
+                      (anc[:, 1] >= -straddle_thresh) &
+                      (anc[:, 2] < im_w + straddle_thresh) &
+                      (anc[:, 3] < im_h + straddle_thresh))
+        else:
+            inside = jnp.ones((a,), bool)
+        amax = jnp.max(iou, 1)
+        agt = jnp.argmax(iou, 1)
+        # best anchor for each gt is fg too
+        best_per_gt = jnp.max(iou, 0)                    # [G]
+        is_best = jnp.any(iou == jnp.maximum(best_per_gt[None, :], 1e-12),
+                          1) & (amax > 0)
+        fg = ((amax >= pos_overlap) | is_best) & inside
+        bg = (~fg) & (amax < neg_overlap) & inside
+
+        ki = jax.random.fold_in(key, i)
+        rand = jax.random.uniform(ki, (a,)) if use_random else \
+            jnp.arange(a, dtype=jnp.float32) / a
+        # rank fg anchors randomly, keep fg_quota
+        fg_rank = jnp.argsort(jnp.argsort(
+            jnp.where(fg, rand, 2.0)))                   # stable rank
+        fg_keep = fg & (fg_rank < fg_quota)
+        n_fg = jnp.sum(fg_keep.astype(jnp.int32))
+        bg_quota = batch_per_im - n_fg
+        bg_rank = jnp.argsort(jnp.argsort(jnp.where(bg, rand, 2.0)))
+        bg_keep = bg & (bg_rank < bg_quota)
+
+        # fixed-capacity index lists: order anchors by (fg_keep desc, rank)
+        fg_order = jnp.argsort(jnp.where(fg_keep, fg_rank, a + 1))
+        fg_sel = fg_order[:fg_quota]                     # [fg_quota]
+        fg_valid = fg_keep[fg_sel]
+        sel_priority = jnp.where(fg_keep, fg_rank,
+                                 jnp.where(bg_keep, fg_quota + bg_rank,
+                                           2 * a + 1))
+        all_order = jnp.argsort(sel_priority)
+        sc_sel = all_order[:batch_per_im]
+        sc_valid = (fg_keep | bg_keep)[sc_sel]
+
+        # targets
+        gt_of = jnp.clip(agt[fg_sel], 0, max(gt.shape[0] - 1, 0))
+        gtb = gt[gt_of]
+        ab = anc[fg_sel]
+        aw = ab[:, 2] - ab[:, 0] + 1.0
+        ah = ab[:, 3] - ab[:, 1] + 1.0
+        acx = ab[:, 0] + aw / 2
+        acy = ab[:, 1] + ah / 2
+        gw = gtb[:, 2] - gtb[:, 0] + 1.0
+        gh = gtb[:, 3] - gtb[:, 1] + 1.0
+        gcx = gtb[:, 0] + gw / 2
+        gcy = gtb[:, 1] + gh / 2
+        tb = jnp.stack([(gcx - acx) / aw, (gcy - acy) / ah,
+                        jnp.log(gw / aw), jnp.log(gh / ah)], -1)
+
+        loc_idx.append(jnp.where(fg_valid, fg_sel + i * a, 0))
+        score_idx.append(jnp.where(sc_valid, sc_sel + i * a, 0))
+        tgt_label.append(fg_keep[sc_sel].astype(jnp.int32))
+        tgt_bbox.append(jnp.where(fg_valid[:, None], tb, 0.0))
+        inside_w.append(jnp.where(fg_valid[:, None],
+                                  jnp.ones_like(tb), 0.0))
+
+    ctx.out(op, 'LocationIndex',
+            jnp.concatenate(loc_idx).astype(jnp.int32))
+    ctx.out(op, 'ScoreIndex', jnp.concatenate(score_idx).astype(jnp.int32))
+    ctx.out(op, 'TargetLabel',
+            jnp.concatenate(tgt_label).reshape(-1, 1))
+    ctx.out(op, 'TargetBBox', jnp.concatenate(tgt_bbox))
+    ctx.out(op, 'BBoxInsideWeight', jnp.concatenate(inside_w))
+    for slot in ('LocationIndex', 'ScoreIndex', 'TargetLabel',
+                 'TargetBBox', 'BBoxInsideWeight'):
+        if op.output(slot):
+            ctx.set_lod(op.output(slot)[0], ())

@@ -1,0 +1,274 @@
+"""Vision ops: RoI pooling family + spatial sampling.
+
+Reference: operators/roi_pool_op.{cc,h}, roi_align_op.{cc,h},
+psroi_pool_op.{cc,h}, grid_sampler_op.cc, affine_grid_op.cc.
+
+TPU-native design: bins with data-dependent extents (roi_pool / psroi_pool)
+are evaluated as masked reductions over the full static H x W plane — a
+dense, MXU/VPU-friendly formulation with no dynamic slicing; roi_align's
+sample grid is static once sampling_ratio > 0 and lowers to batched bilinear
+gathers. All are differentiable through JAX AD (gather <-> scatter-add
+transposition reproduces the reference's hand-written grad kernels).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op
+
+
+def _roi_batch_ids(lod, rois_num):
+    """Static batch id per RoI from the LoD (reference roi_pool_op.h
+    'calculate batch id index for each roi according to LoD')."""
+    if not lod:
+        return np.zeros((rois_num,), np.int32), 1
+    offsets = lod[-1]
+    ids = np.zeros((rois_num,), np.int32)
+    for i in range(len(offsets) - 1):
+        ids[offsets[i]:offsets[i + 1]] = i
+    return ids, len(offsets) - 1
+
+
+@register_op('roi_pool')
+def _roi_pool(ctx, op):
+    """reference operators/roi_pool_op.h: max pool over adaptive bins.
+    Bin extents are data dependent -> masked max over the full plane."""
+    x = ctx.in1(op, 'X')
+    rois = ctx.in1(op, 'ROIs')
+    lod = ctx.in1_lod(op, 'ROIs')
+    ph = op.attr('pooled_height')
+    pw = op.attr('pooled_width')
+    scale = op.attr('spatial_scale', 1.0)
+
+    n, c, h, w = x.shape
+    r = rois.shape[0]
+    batch_ids, _ = _roi_batch_ids(lod, r)
+
+    def one_roi(roi, feat):
+        # integer roi extents (reference: round then +1, min size 1)
+        x1 = jnp.round(roi[0] * scale)
+        y1 = jnp.round(roi[1] * scale)
+        x2 = jnp.round(roi[2] * scale)
+        y2 = jnp.round(roi[3] * scale)
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+        bsh = rh / ph
+        bsw = rw / pw
+        pi = jnp.arange(ph, dtype=jnp.float32)
+        pj = jnp.arange(pw, dtype=jnp.float32)
+        hstart = jnp.clip(jnp.floor(pi * bsh) + y1, 0, h)      # [ph]
+        hend = jnp.clip(jnp.ceil((pi + 1) * bsh) + y1, 0, h)
+        wstart = jnp.clip(jnp.floor(pj * bsw) + x1, 0, w)
+        wend = jnp.clip(jnp.ceil((pj + 1) * bsw) + x1, 0, w)
+        hh = jnp.arange(h, dtype=jnp.float32)
+        ww = jnp.arange(w, dtype=jnp.float32)
+        hmask = (hh[None, :] >= hstart[:, None]) & \
+                (hh[None, :] < hend[:, None])                   # [ph, h]
+        wmask = (ww[None, :] >= wstart[:, None]) & \
+                (ww[None, :] < wend[:, None])                   # [pw, w]
+        mask = hmask[:, None, :, None] & wmask[None, :, None, :]
+        # [ph, pw, h, w]; bins with empty extent -> all-False -> output 0
+        neg = jnp.asarray(-jnp.inf, x.dtype)
+        masked = jnp.where(mask[None], feat[:, None, None, :, :], neg)
+        out = jnp.max(masked, axis=(3, 4))                       # [c, ph, pw]
+        return jnp.where(jnp.isfinite(out), out, 0.0)
+
+    feats = x[jnp.asarray(batch_ids)]          # [R, c, h, w]
+    out = jax.vmap(one_roi)(rois, feats)
+    ctx.out(op, 'Out', out)
+    argm = op.output('Argmax')
+    if argm:
+        ctx.set(argm[0], jnp.zeros(out.shape, jnp.int32))
+    ctx.set_lod(op.output('Out')[0], ())
+
+
+@register_op('roi_align')
+def _roi_align(ctx, op):
+    """reference operators/roi_align_op.h: average of bilinear samples on a
+    fixed sub-grid per bin. sampling_ratio must be > 0 on TPU (the reference
+    falls back to ceil(roi_size/pooled) which is data dependent -> dynamic
+    shape)."""
+    x = ctx.in1(op, 'X')
+    rois = ctx.in1(op, 'ROIs')
+    lod = ctx.in1_lod(op, 'ROIs')
+    ph = op.attr('pooled_height')
+    pw = op.attr('pooled_width')
+    scale = op.attr('spatial_scale', 1.0)
+    sampling_ratio = op.attr('sampling_ratio', -1)
+    if sampling_ratio <= 0:
+        raise ValueError(
+            "roi_align on TPU needs sampling_ratio > 0 (a static sample "
+            "grid); the reference's adaptive ceil(roi/pooled) grid is data "
+            "dependent and cannot be compiled to static shapes")
+    s = int(sampling_ratio)
+
+    n, c, h, w = x.shape
+    r = rois.shape[0]
+    batch_ids, _ = _roi_batch_ids(lod, r)
+
+    def bilinear(feat, y, xq):
+        """feat [c,h,w]; y/xq scalars; reference bilinear_interpolate with
+        zero outside [-1, dim] and edge clamping."""
+        oob = (y < -1.0) | (y > h) | (xq < -1.0) | (xq > w)
+        y = jnp.clip(y, 0.0, None)
+        xq = jnp.clip(xq, 0.0, None)
+        y0 = jnp.clip(jnp.floor(y), 0, h - 1)
+        x0 = jnp.clip(jnp.floor(xq), 0, w - 1)
+        y1 = jnp.minimum(y0 + 1, h - 1)
+        x1 = jnp.minimum(x0 + 1, w - 1)
+        yy = jnp.where(y0 >= h - 1, jnp.asarray(h - 1, y.dtype), y)
+        xx = jnp.where(x0 >= w - 1, jnp.asarray(w - 1, xq.dtype), xq)
+        ly, lx = yy - y0, xx - x0
+        hy, hx = 1.0 - ly, 1.0 - lx
+        y0i, x0i, y1i, x1i = (y0.astype(jnp.int32), x0.astype(jnp.int32),
+                              y1.astype(jnp.int32), x1.astype(jnp.int32))
+        v = (feat[:, y0i, x0i] * hy * hx + feat[:, y0i, x1i] * hy * lx +
+             feat[:, y1i, x0i] * ly * hx + feat[:, y1i, x1i] * ly * lx)
+        return jnp.where(oob, 0.0, v)
+
+    def one_roi(roi, feat):
+        x1 = roi[0] * scale
+        y1 = roi[1] * scale
+        x2 = roi[2] * scale
+        y2 = roi[3] * scale
+        rh = jnp.maximum(y2 - y1, 1.0)
+        rw = jnp.maximum(x2 - x1, 1.0)
+        bsh = rh / ph
+        bsw = rw / pw
+        pi = jnp.arange(ph, dtype=jnp.float32)[:, None]         # [ph,1]
+        pj = jnp.arange(pw, dtype=jnp.float32)[:, None]
+        iy = jnp.arange(s, dtype=jnp.float32)[None, :]          # [1,s]
+        ys = y1 + pi * bsh + (iy + 0.5) * bsh / s               # [ph,s]
+        xs = x1 + pj * bsw + (iy + 0.5) * bsw / s               # [pw,s]
+        # all sample points [ph,s,pw,s]
+        yy = ys[:, :, None, None]
+        xx = xs[None, None, :, :]
+        samp = jax.vmap(jax.vmap(jax.vmap(jax.vmap(
+            lambda a, b: bilinear(feat, a, b)))))(
+                jnp.broadcast_to(yy, (ph, s, pw, s)),
+                jnp.broadcast_to(xx, (ph, s, pw, s)))
+        # samp [ph,s,pw,s,c] -> avg over sample grid
+        return jnp.mean(samp, axis=(1, 3)).transpose(2, 0, 1)   # [c,ph,pw]
+
+    feats = x[jnp.asarray(batch_ids)]
+    out = jax.vmap(one_roi)(rois, feats)
+    ctx.out(op, 'Out', out)
+    ctx.set_lod(op.output('Out')[0], ())
+
+
+@register_op('psroi_pool')
+def _psroi_pool(ctx, op):
+    """reference operators/psroi_pool_op.h: position-sensitive RoI average
+    pooling — output channel c's bin (ph, pw) averages input channel
+    (c * pooled_h + ph) * pooled_w + pw over the bin extent."""
+    x = ctx.in1(op, 'X')
+    rois = ctx.in1(op, 'ROIs')
+    lod = ctx.in1_lod(op, 'ROIs')
+    ph = op.attr('pooled_height')
+    pw = op.attr('pooled_width')
+    oc = op.attr('output_channels')
+    scale = op.attr('spatial_scale', 1.0)
+
+    n, c, h, w = x.shape
+    if c != oc * ph * pw:
+        raise ValueError(
+            "psroi_pool: input channels (%d) must equal output_channels * "
+            "pooled_height * pooled_width (%d)" % (c, oc * ph * pw))
+    r = rois.shape[0]
+    batch_ids, _ = _roi_batch_ids(lod, r)
+
+    def one_roi(roi, feat):
+        x1 = jnp.round(roi[0]) * scale
+        y1 = jnp.round(roi[1]) * scale
+        x2 = (jnp.round(roi[2]) + 1.0) * scale
+        y2 = (jnp.round(roi[3]) + 1.0) * scale
+        rh = jnp.maximum(y2 - y1, 0.1)
+        rw = jnp.maximum(x2 - x1, 0.1)
+        bsh = rh / ph
+        bsw = rw / pw
+        pi = jnp.arange(ph, dtype=jnp.float32)
+        pj = jnp.arange(pw, dtype=jnp.float32)
+        hstart = jnp.clip(jnp.floor(pi * bsh + y1), 0, h)
+        hend = jnp.clip(jnp.ceil((pi + 1) * bsh + y1), 0, h)
+        wstart = jnp.clip(jnp.floor(pj * bsw + x1), 0, w)
+        wend = jnp.clip(jnp.ceil((pj + 1) * bsw + x1), 0, w)
+        hh = jnp.arange(h, dtype=jnp.float32)
+        ww = jnp.arange(w, dtype=jnp.float32)
+        hmask = (hh[None, :] >= hstart[:, None]) & \
+                (hh[None, :] < hend[:, None])
+        wmask = (ww[None, :] >= wstart[:, None]) & \
+                (ww[None, :] < wend[:, None])
+        mask = (hmask[:, None, :, None] & wmask[None, :, None, :]
+                ).astype(x.dtype)                     # [ph, pw, h, w]
+        fmap = feat.reshape(oc, ph, pw, h, w)
+        sums = jnp.einsum('cpqhw,pqhw->cpq', fmap, mask)
+        counts = jnp.sum(mask, axis=(2, 3))           # [ph, pw]
+        return jnp.where(counts[None] > 0, sums / jnp.maximum(counts, 1.0),
+                         0.0)
+
+    feats = x[jnp.asarray(batch_ids)]
+    out = jax.vmap(one_roi)(rois, feats)
+    ctx.out(op, 'Out', out)
+    ctx.set_lod(op.output('Out')[0], ())
+
+
+@register_op('affine_grid', static_inputs=('OutputShape',))
+def _affine_grid(ctx, op):
+    """reference operators/affine_grid_op.cc: Theta [N,2,3] -> sampling grid
+    [N, H, W, 2] over normalized coords linspace(-1, 1, dim)."""
+    theta = ctx.in1(op, 'Theta')
+    shape_attr = op.attr('output_shape', [])
+    if shape_attr:
+        n, c, h, w = [int(v) for v in shape_attr]
+    else:
+        out_shape = ctx.in1_static(op, 'OutputShape')
+        n, c, h, w = [int(v) for v in np.asarray(out_shape).reshape(-1)]
+    xs = jnp.linspace(-1.0, 1.0, w)
+    ys = jnp.linspace(-1.0, 1.0, h)
+    xg, yg = jnp.meshgrid(xs, ys)                     # [h, w]
+    ones = jnp.ones_like(xg)
+    base = jnp.stack([xg, yg, ones], -1)              # [h, w, 3]
+    out = jnp.einsum('hwk,njk->nhwj', base, theta)    # [n, h, w, 2]
+    ctx.out(op, 'Output', out)
+
+
+@register_op('grid_sampler')
+def _grid_sampler(ctx, op):
+    """reference operators/grid_sampler_op.cc: bilinear sampling of X
+    [N,C,H,W] at Grid [N,H,W,2] coords in [-1,1] (zero padding outside)."""
+    x = ctx.in1(op, 'X')
+    grid = ctx.in1(op, 'Grid')
+    n, c, h, w = x.shape
+
+    gx = (grid[..., 0] + 1.0) * (w - 1) / 2.0         # [n, gh, gw]
+    gy = (grid[..., 1] + 1.0) * (h - 1) / 2.0
+
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    x1 = x0 + 1
+    y1 = y0 + 1
+
+    def gather(feat, yy, xx):
+        """feat [c,h,w]; indices may be out of range -> contribute 0."""
+        inb = (yy >= 0) & (yy < h) & (xx >= 0) & (xx < w)
+        yc = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+        xc = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+        v = feat[:, yc, xc]                            # [c, gh, gw]
+        return jnp.where(inb[None], v, 0.0)
+
+    wa = (x1 - gx) * (y1 - gy)
+    wb = (x1 - gx) * (gy - y0)
+    wc = (gx - x0) * (y1 - gy)
+    wd = (gx - x0) * (gy - y0)
+
+    def one(feat, x0i, y0i, x1i, y1i, wa_, wb_, wc_, wd_):
+        va = gather(feat, y0i, x0i)
+        vb = gather(feat, y1i, x0i)
+        vc = gather(feat, y0i, x1i)
+        vd = gather(feat, y1i, x1i)
+        return va * wa_[None] + vb * wb_[None] + vc * wc_[None] + \
+            vd * wd_[None]
+
+    out = jax.vmap(one)(x, x0, y0, x1, y1, wa, wb, wc, wd)
+    ctx.out(op, 'Output', out)

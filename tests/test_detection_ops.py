@@ -1,0 +1,505 @@
+"""Detection op + layer tests (reference unittests/test_prior_box_op.py,
+test_bipartite_match_op.py, test_multiclass_nms_op.py, test_target_assign_op.py,
+test_ssd_loss.py patterns: numpy reference computed in the test)."""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.framework import Program, program_guard
+
+
+def _run_single_op(op_type, inputs, outputs, attrs, lods=None):
+    """Build a one-op program and run it. inputs: name -> array or
+    (array, lod). outputs: slot -> [names]."""
+    prog, startup = Program(), Program()
+    feed = {}
+    with program_guard(prog, startup):
+        block = prog.global_block()
+        in_map = {}
+        for slot, val in inputs.items():
+            arr = val[0] if isinstance(val, tuple) else val
+            v = block.create_var(name=slot, shape=np.asarray(arr).shape,
+                                 dtype=np.asarray(arr).dtype,
+                                 lod_level=1 if isinstance(val, tuple) else 0)
+            feed[slot] = val
+            in_map[slot] = [v]
+        out_map = {}
+        fetch = []
+        for slot, names in outputs.items():
+            vs = []
+            for nm in names:
+                vs.append(block.create_var(name=nm, dtype='float32'))
+                fetch.append(nm)
+            out_map[slot] = vs
+        block.append_op(type=op_type, inputs=in_map, outputs=out_map,
+                        attrs=attrs)
+    exe = fluid.Executor()
+    return exe.run(prog, feed=feed, fetch_list=fetch)
+
+
+# ---------------------------------------------------------------------------
+# box generators
+# ---------------------------------------------------------------------------
+
+def _expand_ar(ars, flip):
+    out = [1.0]
+    for ar in ars:
+        if any(abs(ar - o) < 1e-6 for o in out):
+            continue
+        out.append(ar)
+        if flip:
+            out.append(1.0 / ar)
+    return out
+
+
+def _prior_box_ref(fh, fw, ih, iw, min_sizes, max_sizes, ars, flip, clip,
+                   offset=0.5):
+    """Independent numpy mirror of reference prior_box_op.h enumeration
+    (min_max_aspect_ratios_order=False)."""
+    ars = _expand_ar(ars, flip)
+    sw, sh = iw / fw, ih / fh
+    num = len(ars) * len(min_sizes) + len(max_sizes)
+    boxes = np.zeros((fh, fw, num, 4), np.float32)
+    for h in range(fh):
+        for w in range(fw):
+            cx, cy = (w + offset) * sw, (h + offset) * sh
+            k = 0
+            for s, ms in enumerate(min_sizes):
+                for ar in ars:
+                    bw, bh = ms * math.sqrt(ar) / 2, ms / math.sqrt(ar) / 2
+                    boxes[h, w, k] = [(cx - bw) / iw, (cy - bh) / ih,
+                                      (cx + bw) / iw, (cy + bh) / ih]
+                    k += 1
+                if max_sizes:
+                    m = math.sqrt(ms * max_sizes[s]) / 2
+                    boxes[h, w, k] = [(cx - m) / iw, (cy - m) / ih,
+                                      (cx + m) / iw, (cy + m) / ih]
+                    k += 1
+    if clip:
+        boxes = np.clip(boxes, 0, 1)
+    return boxes
+
+
+class TestPriorBox(object):
+    def test_matches_reference_enumeration(self):
+        feat = np.zeros((1, 8, 4, 6), np.float32)
+        img = np.zeros((1, 3, 32, 48), np.float32)
+        min_sizes, max_sizes, ars = [8.0, 16.0], [16.0, 32.0], [2.0]
+        boxes, var = _run_single_op(
+            'prior_box', {'Input': feat, 'Image': img},
+            {'Boxes': ['boxes'], 'Variances': ['vars']},
+            {'min_sizes': min_sizes, 'max_sizes': max_sizes,
+             'aspect_ratios': ars, 'flip': True, 'clip': True,
+             'variances': [0.1, 0.1, 0.2, 0.2], 'step_w': 0.0,
+             'step_h': 0.0, 'offset': 0.5,
+             'min_max_aspect_ratios_order': False})
+        ref = _prior_box_ref(4, 6, 32, 48, min_sizes, max_sizes, ars,
+                             True, True)
+        assert boxes.shape == ref.shape
+        np.testing.assert_allclose(boxes, ref, rtol=1e-5, atol=1e-6)
+        assert var.shape == ref.shape
+        np.testing.assert_allclose(var[0, 0, 0], [0.1, 0.1, 0.2, 0.2])
+
+    def test_layer(self):
+        prog, startup = Program(), Program()
+        with program_guard(prog, startup):
+            feat = fluid.layers.data('feat', shape=(-1, 8, 4, 4),
+                                     dtype='float32')
+            img = fluid.layers.data('img', shape=(-1, 3, 32, 32),
+                                    dtype='float32')
+            boxes, var = fluid.layers.detection.prior_box(
+                feat, img, min_sizes=[4.0], aspect_ratios=[1.0])
+        assert boxes.shape == (4, 4, 1, 4)
+
+
+class TestAnchorGenerator(object):
+    def test_spot_values(self):
+        feat = np.zeros((1, 8, 2, 2), np.float32)
+        anchors, var = _run_single_op(
+            'anchor_generator', {'Input': feat},
+            {'Anchors': ['anchors'], 'Variances': ['avars']},
+            {'anchor_sizes': [64.0], 'aspect_ratios': [1.0],
+             'stride': [16.0, 16.0], 'offset': 0.5,
+             'variances': [0.1, 0.1, 0.2, 0.2]})
+        assert anchors.shape == (2, 2, 1, 4)
+        # reference formula at (0,0): ctr = 0.5*(16-1) = 7.5;
+        # base_w = round(sqrt(256)) = 16, scale = 64/16 = 4 -> w = 64
+        np.testing.assert_allclose(
+            anchors[0, 0, 0], [7.5 - 31.5, 7.5 - 31.5, 7.5 + 31.5,
+                               7.5 + 31.5])
+
+
+class TestDensityPriorBox(object):
+    def test_shapes_and_range(self):
+        feat = np.zeros((1, 8, 2, 2), np.float32)
+        img = np.zeros((1, 3, 32, 32), np.float32)
+        boxes, var = _run_single_op(
+            'density_prior_box', {'Input': feat, 'Image': img},
+            {'Boxes': ['dboxes'], 'Variances': ['dvars']},
+            {'fixed_sizes': [4.0], 'fixed_ratios': [1.0],
+             'densities': [2], 'clip': True,
+             'variances': [0.1, 0.1, 0.2, 0.2], 'step_w': 0.0,
+             'step_h': 0.0, 'offset': 0.5})
+        assert boxes.shape == (2, 2, 4, 4)
+        assert (boxes >= 0).all() and (boxes <= 1).all()
+
+
+# ---------------------------------------------------------------------------
+# box arithmetic
+# ---------------------------------------------------------------------------
+
+def _iou_ref(x, y):
+    n, m = x.shape[0], y.shape[0]
+    out = np.zeros((n, m), np.float32)
+    for i in range(n):
+        for j in range(m):
+            ix1, iy1 = max(x[i, 0], y[j, 0]), max(x[i, 1], y[j, 1])
+            ix2, iy2 = min(x[i, 2], y[j, 2]), min(x[i, 3], y[j, 3])
+            iw, ih = max(ix2 - ix1, 0), max(iy2 - iy1, 0)
+            inter = iw * ih
+            if inter > 0:
+                ax = (x[i, 2] - x[i, 0]) * (x[i, 3] - x[i, 1])
+                ay = (y[j, 2] - y[j, 0]) * (y[j, 3] - y[j, 1])
+                out[i, j] = inter / (ax + ay - inter)
+    return out
+
+
+class TestIouSimilarity(object):
+    def test_matches_numpy(self):
+        rng = np.random.RandomState(0)
+        x = rng.rand(5, 4).astype(np.float32)
+        x[:, 2:] += x[:, :2]
+        y = rng.rand(7, 4).astype(np.float32)
+        y[:, 2:] += y[:, :2]
+        out, = _run_single_op('iou_similarity', {'X': x, 'Y': y},
+                              {'Out': ['iou']}, {'box_normalized': True})
+        np.testing.assert_allclose(out, _iou_ref(x, y), rtol=1e-5, atol=1e-6)
+
+
+class TestBoxCoder(object):
+    def test_encode_decode_roundtrip(self):
+        rng = np.random.RandomState(1)
+        prior = rng.rand(6, 4).astype(np.float32)
+        prior[:, 2:] += prior[:, :2] + 0.1
+        pvar = np.full((6, 4), 0.5, np.float32)
+        gt = rng.rand(3, 4).astype(np.float32)
+        gt[:, 2:] += gt[:, :2] + 0.1
+        enc, = _run_single_op(
+            'box_coder',
+            {'PriorBox': prior, 'PriorBoxVar': pvar, 'TargetBox': gt},
+            {'OutputBox': ['enc']},
+            {'code_type': 'encode_center_size', 'box_normalized': True,
+             'axis': 0})
+        assert enc.shape == (3, 6, 4)
+        # decode the encoding of gt box i against all priors: row i must
+        # reproduce gt box i
+        dec, = _run_single_op(
+            'box_coder',
+            {'PriorBox': prior, 'PriorBoxVar': pvar, 'TargetBox': enc},
+            {'OutputBox': ['dec']},
+            {'code_type': 'decode_center_size', 'box_normalized': True,
+             'axis': 0})
+        for i in range(3):
+            for j in range(6):
+                np.testing.assert_allclose(dec[i, j], gt[i], rtol=1e-4,
+                                           atol=1e-4)
+
+    def test_encode_manual(self):
+        prior = np.array([[0., 0., 2., 2.]], np.float32)
+        gt = np.array([[1., 1., 3., 3.]], np.float32)
+        enc, = _run_single_op(
+            'box_coder', {'PriorBox': prior, 'TargetBox': gt},
+            {'OutputBox': ['enc2']},
+            {'code_type': 'encode_center_size', 'box_normalized': True,
+             'axis': 0})
+        # centers: prior (1,1) w=h=2; gt (2,2) w=h=2
+        np.testing.assert_allclose(enc[0, 0], [0.5, 0.5, 0.0, 0.0],
+                                   atol=1e-6)
+
+
+class TestBoxClip(object):
+    def test_clips_to_image(self):
+        boxes = np.array([[-5., -5., 100., 50.], [1., 2., 3., 4.]],
+                         np.float32)
+        im_info = np.array([[40., 60., 1.]], np.float32)  # h=40, w=60
+        out, = _run_single_op(
+            'box_clip', {'Input': (boxes, [[0, 2]]), 'ImInfo': im_info},
+            {'Output': ['clipped']}, {})
+        np.testing.assert_allclose(out[0], [0., 0., 59., 39.])
+        np.testing.assert_allclose(out[1], [1., 2., 3., 4.])
+
+
+# ---------------------------------------------------------------------------
+# matching
+# ---------------------------------------------------------------------------
+
+class TestBipartiteMatch(object):
+    def test_greedy_known(self):
+        # rows = gt, cols = priors
+        dist = np.array([[0.9, 0.2, 0.1],
+                         [0.8, 0.7, 0.3]], np.float32)
+        idx, d = _run_single_op(
+            'bipartite_match', {'DistMat': dist},
+            {'ColToRowMatchIndices': ['mi'], 'ColToRowMatchDist': ['md']},
+            {'match_type': 'bipartite', 'dist_threshold': 0.5})
+        # greedy: (0,0)=0.9 first, then row1's best remaining col: (1,1)=0.7
+        np.testing.assert_array_equal(idx[0], [0, 1, -1])
+        np.testing.assert_allclose(d[0], [0.9, 0.7, 0.0], atol=1e-6)
+
+    def test_per_prediction_extra(self):
+        dist = np.array([[0.9, 0.6, 0.1],
+                         [0.8, 0.7, 0.3]], np.float32)
+        idx, d = _run_single_op(
+            'bipartite_match', {'DistMat': dist},
+            {'ColToRowMatchIndices': ['mi2'], 'ColToRowMatchDist': ['md2']},
+            {'match_type': 'per_prediction', 'dist_threshold': 0.5})
+        # bipartite: col0->row0 (0.9), col1->row1 (0.7); col2 max 0.3 < 0.5
+        np.testing.assert_array_equal(idx[0], [0, 1, -1])
+
+    def test_lod_instances(self):
+        d1 = np.array([[0.9, 0.1]], np.float32)
+        d2 = np.array([[0.2, 0.8], [0.7, 0.3]], np.float32)
+        dist = np.concatenate([d1, d2], 0)
+        idx, d = _run_single_op(
+            'bipartite_match', {'DistMat': (dist, [[0, 1, 3]])},
+            {'ColToRowMatchIndices': ['mi3'], 'ColToRowMatchDist': ['md3']},
+            {'match_type': 'bipartite', 'dist_threshold': 0.5})
+        assert idx.shape == (2, 2)
+        np.testing.assert_array_equal(idx[0], [0, -1])
+        # instance 2 greedy: (1,0)=0.7? no: global max 0.8 at (0,1) first,
+        # then (1,0)=0.7
+        np.testing.assert_array_equal(idx[1], [1, 0])
+
+
+class TestTargetAssign(object):
+    def test_gather_and_negatives(self):
+        # X: 2 instances with 2/1 gt rows, P=1, K=1
+        x = np.array([[10.], [20.], [30.]], np.float32)
+        match = np.array([[1, -1, 0], [-1, 0, -1]], np.int32)
+        neg = np.array([[1, -1, -1], [0, 2, -1]], np.int32)
+        out, w = _run_single_op(
+            'target_assign',
+            {'X': (x, [[0, 2, 3]]), 'MatchIndices': match,
+             'NegIndices': neg},
+            {'Out': ['ta_out'], 'OutWeight': ['ta_w']},
+            {'mismatch_value': 7})
+        # instance 0: j0 -> x[1]=20, j1 -> mismatch, j2 -> x[0]=10
+        np.testing.assert_allclose(out[0].reshape(-1), [20., 7., 10.])
+        # neg index 1 -> weight 1 at j1
+        np.testing.assert_allclose(w[0].reshape(-1), [1., 1., 1.])
+        # instance 1: j1 -> x[2]=30 (lod offset 2)
+        np.testing.assert_allclose(out[1].reshape(-1), [7., 30., 7.])
+        np.testing.assert_allclose(w[1].reshape(-1), [1., 1., 1.])
+
+    def test_weights_without_negatives(self):
+        x = np.array([[5.]], np.float32)
+        match = np.array([[0, -1]], np.int32)
+        out, w = _run_single_op(
+            'target_assign', {'X': (x, [[0, 1]]), 'MatchIndices': match},
+            {'Out': ['ta2_out'], 'OutWeight': ['ta2_w']},
+            {'mismatch_value': 0})
+        np.testing.assert_allclose(w[0].reshape(-1), [1., 0.])
+
+
+class TestMineHardExamples(object):
+    def test_max_negative_selection(self):
+        cls_loss = np.array([[5., 1., 4., 3., 2.]], np.float32)
+        match = np.array([[0, -1, -1, -1, -1]], np.int32)   # 1 positive
+        mdist = np.array([[0.9, 0.1, 0.2, 0.6, 0.3]], np.float32)
+        neg, upd = _run_single_op(
+            'mine_hard_examples',
+            {'ClsLoss': cls_loss, 'MatchIndices': match,
+             'MatchDist': mdist},
+            {'NegIndices': ['neg'], 'UpdatedMatchIndices': ['upd']},
+            {'neg_pos_ratio': 2.0, 'neg_dist_threshold': 0.5,
+             'mining_type': 'max_negative', 'sample_size': 0})
+        # eligible: cols 1,2,4 (unmatched & dist<0.5); quota = 1*2 = 2
+        # by loss desc: col2 (4.0), col4 (2.0)
+        got = sorted(int(v) for v in neg.reshape(-1) if v >= 0)
+        assert got == [2, 4]
+        np.testing.assert_array_equal(upd, match)
+
+
+def _nms_ref(boxes, scores, score_thr, nms_thr, top_k):
+    """Plain greedy NMS for one class."""
+    idx = np.argsort(-scores)
+    if top_k > 0:
+        idx = idx[:top_k]
+    keep = []
+    for i in idx:
+        if scores[i] <= score_thr:
+            continue
+        ok = True
+        for j in keep:
+            if _iou_ref(boxes[i:i + 1], boxes[j:j + 1])[0, 0] > nms_thr:
+                ok = False
+                break
+        if ok:
+            keep.append(i)
+    return keep
+
+
+class TestMulticlassNMS(object):
+    def test_single_class(self):
+        boxes = np.array([[0., 0., 10., 10.],
+                          [1., 1., 11., 11.],
+                          [20., 20., 30., 30.],
+                          [20.5, 20.5, 30.5, 30.5]], np.float32)[None]
+        scores = np.array([[0.9, 0.8, 0.7, 0.95]], np.float32)[None]
+        # Scores layout [N, C, M]: one class (background_label=-1)
+        out, = _run_single_op(
+            'multiclass_nms', {'BBoxes': boxes, 'Scores': scores},
+            {'Out': ['nms_out']},
+            {'background_label': -1, 'score_threshold': 0.1,
+             'nms_top_k': 4, 'nms_threshold': 0.5, 'nms_eta': 1.0,
+             'keep_top_k': 4, 'normalized': True})
+        out = out.reshape(-1, 6)
+        kept = out[out[:, 0] >= 0]
+        ref_keep = _nms_ref(boxes[0], scores[0, 0], 0.1, 0.5, 4)
+        assert len(kept) == len(ref_keep) == 2
+        # highest score first
+        np.testing.assert_allclose(kept[0, 1], 0.95, atol=1e-6)
+        np.testing.assert_allclose(kept[0, 2:], boxes[0, 3], atol=1e-5)
+        np.testing.assert_allclose(kept[1, 1], 0.9, atol=1e-6)
+
+    def test_multiclass_and_padding(self):
+        rng = np.random.RandomState(3)
+        m = 12
+        boxes = rng.rand(2, m, 4).astype(np.float32)
+        boxes[..., 2:] += boxes[..., :2]
+        scores = rng.rand(2, 3, m).astype(np.float32)
+        out, = _run_single_op(
+            'multiclass_nms', {'BBoxes': boxes, 'Scores': scores},
+            {'Out': ['nms_out2']},
+            {'background_label': 0, 'score_threshold': 0.3,
+             'nms_top_k': 8, 'nms_threshold': 0.4, 'nms_eta': 1.0,
+             'keep_top_k': 10, 'normalized': True})
+        out = out.reshape(2, 10, 6)
+        for i in range(2):
+            ref_count = 0
+            for cls in (1, 2):
+                ref_count += len(_nms_ref(boxes[i], scores[i, cls], 0.3,
+                                          0.4, 8))
+            ref_count = min(ref_count, 10)
+            got = int((out[i, :, 0] >= 0).sum())
+            assert got == ref_count
+            # labels never background (0) or out of range
+            labels = out[i][out[i, :, 0] >= 0][:, 0]
+            assert ((labels == 1) | (labels == 2)).all()
+
+
+# ---------------------------------------------------------------------------
+# layer-level: ssd_loss + detection_output train/infer
+# ---------------------------------------------------------------------------
+
+class TestSSDPipeline(object):
+    def _build_ssd(self, np_priors=8, num_class=4):
+        prog, startup = Program(), Program()
+        with program_guard(prog, startup):
+            feat = fluid.layers.data('feat', shape=(-1, 8, 2, 2),
+                                     dtype='float32')
+            img = fluid.layers.data('img', shape=(-1, 3, 16, 16),
+                                    dtype='float32')
+            gt_box = fluid.layers.data('gt_box', shape=(-1, 4),
+                                       dtype='float32', lod_level=1)
+            gt_label = fluid.layers.data('gt_label', shape=(-1, 1),
+                                         dtype='int32', lod_level=1)
+            pb, pbv = fluid.layers.detection.prior_box(
+                feat, img, min_sizes=[4.0], aspect_ratios=[1.0, 2.0])
+            pb2 = fluid.layers.reshape(pb, shape=(-1, 4))
+            pbv2 = fluid.layers.reshape(pbv, shape=(-1, 4))
+            np_prior = int(np.prod(pb.shape[:3]))
+            loc = fluid.layers.fc(fluid.layers.flatten(feat, axis=1),
+                                  size=np_prior * 4)
+            loc = fluid.layers.reshape(loc, shape=(-1, np_prior, 4))
+            conf = fluid.layers.fc(fluid.layers.flatten(feat, axis=1),
+                                   size=np_prior * num_class)
+            conf = fluid.layers.reshape(conf,
+                                        shape=(-1, np_prior, num_class))
+            loss = fluid.layers.detection.ssd_loss(
+                loc, conf, gt_box, gt_label, pb2, pbv2,
+                background_label=0)
+            loss = fluid.layers.mean(loss)
+            fluid.optimizer.SGD(0.01).minimize(loss)
+        return prog, startup, loss
+
+    def test_ssd_loss_trains(self):
+        prog, startup, loss = self._build_ssd()
+        exe = fluid.Executor()
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        feat = rng.randn(2, 8, 2, 2).astype(np.float32)
+        img = rng.randn(2, 3, 16, 16).astype(np.float32)
+        # 2 + 1 gt boxes (normalized corners)
+        gt = rng.rand(3, 4).astype(np.float32) * 0.4
+        gt[:, 2:] += gt[:, :2] + 0.2
+        gl = rng.randint(1, 4, (3, 1)).astype(np.int32)
+        losses = []
+        for _ in range(6):
+            l, = exe.run(prog, feed={
+                'feat': feat, 'img': img,
+                'gt_box': (gt, [[0, 2, 3]]),
+                'gt_label': (gl, [[0, 2, 3]])}, fetch_list=[loss])
+            val = float(np.asarray(l).reshape(()))
+            assert np.isfinite(val)
+            losses.append(val)
+        assert losses[-1] < losses[0]
+
+    def test_detection_output_infer(self):
+        prog, startup = Program(), Program()
+        with program_guard(prog, startup):
+            feat = fluid.layers.data('feat', shape=(-1, 8, 2, 2),
+                                     dtype='float32')
+            img = fluid.layers.data('img', shape=(-1, 3, 16, 16),
+                                    dtype='float32')
+            pb, pbv = fluid.layers.detection.prior_box(
+                feat, img, min_sizes=[4.0], aspect_ratios=[1.0])
+            pb2 = fluid.layers.reshape(pb, shape=(-1, 4))
+            pbv2 = fluid.layers.reshape(pbv, shape=(-1, 4))
+            npr = int(np.prod(pb.shape[:3]))
+            loc = fluid.layers.data('loc', shape=(-1, npr, 4),
+                                    dtype='float32')
+            conf = fluid.layers.data('conf', shape=(-1, npr, 3),
+                                     dtype='float32')
+            det = fluid.layers.detection.detection_output(
+                loc, conf, pb2, pbv2, keep_top_k=5, score_threshold=0.01)
+        exe = fluid.Executor()
+        rng = np.random.RandomState(1)
+        out, = exe.run(prog, feed={
+            'feat': rng.randn(1, 8, 2, 2).astype(np.float32),
+            'img': rng.randn(1, 3, 16, 16).astype(np.float32),
+            'loc': (rng.randn(1, 4, 4) * 0.1).astype(np.float32),
+            'conf': rng.randn(1, 4, 3).astype(np.float32)},
+            fetch_list=[det])
+        out = np.asarray(out).reshape(-1, 6)
+        assert out.shape == (5, 6)
+        kept = out[out[:, 0] >= 0]
+        assert (kept[:, 0] >= 1).all()  # background label 0 excluded
+
+    def test_multi_box_head(self):
+        prog, startup = Program(), Program()
+        with program_guard(prog, startup):
+            img = fluid.layers.data('img', shape=(-1, 3, 32, 32),
+                                    dtype='float32')
+            f1 = fluid.layers.data('f1', shape=(-1, 8, 4, 4),
+                                   dtype='float32')
+            f2 = fluid.layers.data('f2', shape=(-1, 8, 2, 2),
+                                   dtype='float32')
+            locs, confs, box, var = fluid.layers.detection.multi_box_head(
+                inputs=[f1, f2], image=img, base_size=32, num_classes=3,
+                aspect_ratios=[[2.], [2.]], min_sizes=[8.0, 16.0],
+                max_sizes=[16.0, 32.0])
+        exe = fluid.Executor()
+        rng = np.random.RandomState(0)
+        exe.run(startup)
+        l, c, b, v = exe.run(prog, feed={
+            'img': rng.randn(2, 3, 32, 32).astype(np.float32),
+            'f1': rng.randn(2, 8, 4, 4).astype(np.float32),
+            'f2': rng.randn(2, 8, 2, 2).astype(np.float32)},
+            fetch_list=[locs, confs, box, var])
+        num_priors = b.shape[0]
+        assert l.shape == (2, num_priors, 4)
+        assert c.shape == (2, num_priors, 3)
+        assert v.shape == (num_priors, 4)

@@ -1,0 +1,335 @@
+"""RoI pooling family + spatial sampling + RCNN/YOLO op tests
+(reference unittests/test_roi_pool_op.py, test_roi_align_op.py,
+test_psroi_pool_op.py, test_grid_sampler_op.py, test_affine_grid_op.py,
+test_yolov3_loss_op.py, test_generate_proposals_op.py patterns)."""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.framework import Program, program_guard
+
+from test_detection_ops import _run_single_op, _iou_ref
+
+
+def _roi_pool_ref(x, rois, batch_ids, ph, pw, scale):
+    r = rois.shape[0]
+    c, h, w = x.shape[1], x.shape[2], x.shape[3]
+    out = np.zeros((r, c, ph, pw), x.dtype)
+    for n in range(r):
+        bid = batch_ids[n]
+        x1 = int(round(rois[n, 0] * scale))
+        y1 = int(round(rois[n, 1] * scale))
+        x2 = int(round(rois[n, 2] * scale))
+        y2 = int(round(rois[n, 3] * scale))
+        rh = max(y2 - y1 + 1, 1)
+        rw = max(x2 - x1 + 1, 1)
+        bsh, bsw = rh / ph, rw / pw
+        for i in range(ph):
+            for j in range(pw):
+                hs = min(max(int(math.floor(i * bsh)) + y1, 0), h)
+                he = min(max(int(math.ceil((i + 1) * bsh)) + y1, 0), h)
+                ws = min(max(int(math.floor(j * bsw)) + x1, 0), w)
+                we = min(max(int(math.ceil((j + 1) * bsw)) + x1, 0), w)
+                if he <= hs or we <= ws:
+                    continue
+                out[n, :, i, j] = x[bid, :, hs:he, ws:we].max(axis=(1, 2))
+    return out
+
+
+class TestRoiPool(object):
+    def test_matches_numpy(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 3, 8, 8).astype(np.float32)
+        rois = np.array([[0., 0., 7., 7.],
+                         [2., 2., 6., 6.],
+                         [1., 0., 5., 3.]], np.float32)
+        lod = [[0, 2, 3]]
+        out, = _run_single_op(
+            'roi_pool', {'X': x, 'ROIs': (rois, lod)}, {'Out': ['rp_out']},
+            {'pooled_height': 2, 'pooled_width': 2, 'spatial_scale': 1.0})
+        ref = _roi_pool_ref(x, rois, [0, 0, 1], 2, 2, 1.0)
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+    def test_grad_flows(self):
+        """RoI pooling is differentiable: train one step through it."""
+        prog, startup = Program(), Program()
+        with program_guard(prog, startup):
+            x = fluid.layers.data('x', shape=(-1, 2, 4, 4), dtype='float32')
+            rois = fluid.layers.data('rois', shape=(-1, 4), dtype='float32',
+                                     lod_level=1)
+            feat = fluid.layers.conv2d(x, num_filters=2, filter_size=1)
+            pooled = fluid.layers.roi_pool(feat, rois, pooled_height=2,
+                                           pooled_width=2)
+            loss = fluid.layers.mean(pooled)
+            fluid.optimizer.SGD(0.1).minimize(loss)
+        exe = fluid.Executor()
+        exe.run(startup)
+        rng = np.random.RandomState(1)
+        l, = exe.run(prog, feed={
+            'x': rng.randn(1, 2, 4, 4).astype(np.float32),
+            'rois': (np.array([[0., 0., 3., 3.]], np.float32), [[0, 1]])},
+            fetch_list=[loss])
+        assert np.isfinite(float(np.asarray(l).reshape(())))
+
+
+def _bilinear_ref(feat, y, x, h, w):
+    if y < -1.0 or y > h or x < -1.0 or x > w:
+        return np.zeros(feat.shape[0], feat.dtype)
+    y = max(y, 0.0)
+    x = max(x, 0.0)
+    y0, x0 = int(y), int(x)
+    if y0 >= h - 1:
+        y0 = y1 = h - 1
+        y = float(y0)
+    else:
+        y1 = y0 + 1
+    if x0 >= w - 1:
+        x0 = x1 = w - 1
+        x = float(x0)
+    else:
+        x1 = x0 + 1
+    ly, lx = y - y0, x - x0
+    hy, hx = 1 - ly, 1 - lx
+    return (feat[:, y0, x0] * hy * hx + feat[:, y0, x1] * hy * lx +
+            feat[:, y1, x0] * ly * hx + feat[:, y1, x1] * ly * lx)
+
+
+def _roi_align_ref(x, rois, batch_ids, ph, pw, scale, s):
+    r = rois.shape[0]
+    c, h, w = x.shape[1], x.shape[2], x.shape[3]
+    out = np.zeros((r, c, ph, pw), np.float32)
+    for n in range(r):
+        bid = batch_ids[n]
+        x1, y1 = rois[n, 0] * scale, rois[n, 1] * scale
+        x2, y2 = rois[n, 2] * scale, rois[n, 3] * scale
+        rh = max(y2 - y1, 1.0)
+        rw = max(x2 - x1, 1.0)
+        bsh, bsw = rh / ph, rw / pw
+        for i in range(ph):
+            for j in range(pw):
+                acc = np.zeros(c, np.float32)
+                for iy in range(s):
+                    yq = y1 + i * bsh + (iy + 0.5) * bsh / s
+                    for ix in range(s):
+                        xq = x1 + j * bsw + (ix + 0.5) * bsw / s
+                        acc += _bilinear_ref(x[bid], yq, xq, h, w)
+                out[n, :, i, j] = acc / (s * s)
+    return out
+
+
+class TestRoiAlign(object):
+    def test_matches_numpy(self):
+        rng = np.random.RandomState(2)
+        x = rng.randn(2, 3, 6, 6).astype(np.float32)
+        rois = np.array([[0.5, 0.5, 4.5, 4.5],
+                         [1., 1., 5., 3.]], np.float32)
+        lod = [[0, 1, 2]]
+        out, = _run_single_op(
+            'roi_align', {'X': x, 'ROIs': (rois, lod)},
+            {'Out': ['ra_out']},
+            {'pooled_height': 2, 'pooled_width': 2, 'spatial_scale': 1.0,
+             'sampling_ratio': 2})
+        ref = _roi_align_ref(x, rois, [0, 1], 2, 2, 1.0, 2)
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_requires_static_sampling_ratio(self):
+        x = np.zeros((1, 2, 4, 4), np.float32)
+        rois = np.zeros((1, 4), np.float32)
+        with pytest.raises(Exception, match="sampling_ratio"):
+            _run_single_op(
+                'roi_align', {'X': x, 'ROIs': (rois, [[0, 1]])},
+                {'Out': ['ra2_out']},
+                {'pooled_height': 2, 'pooled_width': 2,
+                 'spatial_scale': 1.0, 'sampling_ratio': -1})
+
+
+class TestPsRoiPool(object):
+    def test_uniform_plane_average(self):
+        # input channels = oc * ph * pw = 2 * 2 * 2 = 8; each channel k
+        # constant k -> output bin value equals its source channel index
+        oc, ph, pw = 2, 2, 2
+        x = np.zeros((1, 8, 6, 6), np.float32)
+        for k in range(8):
+            x[0, k] = k
+        rois = np.array([[0., 0., 5., 5.]], np.float32)
+        out, = _run_single_op(
+            'psroi_pool', {'X': x, 'ROIs': (rois, [[0, 1]])},
+            {'Out': ['ps_out']},
+            {'pooled_height': ph, 'pooled_width': pw, 'output_channels': oc,
+             'spatial_scale': 1.0})
+        assert out.shape == (1, oc, ph, pw)
+        for c in range(oc):
+            for i in range(ph):
+                for j in range(pw):
+                    src = (c * ph + i) * pw + j
+                    np.testing.assert_allclose(out[0, c, i, j], src,
+                                               atol=1e-5)
+
+
+class TestAffineGridSampler(object):
+    def test_identity_affine_grid(self):
+        theta = np.tile(np.array([[[1., 0., 0.], [0., 1., 0.]]],
+                                 np.float32), (1, 1, 1))
+        grid, = _run_single_op(
+            'affine_grid', {'Theta': theta}, {'Output': ['ag_out']},
+            {'output_shape': [1, 1, 3, 3]})
+        assert grid.shape == (1, 3, 3, 2)
+        np.testing.assert_allclose(grid[0, 0, 0], [-1., -1.], atol=1e-6)
+        np.testing.assert_allclose(grid[0, 2, 2], [1., 1.], atol=1e-6)
+        np.testing.assert_allclose(grid[0, 1, 1], [0., 0.], atol=1e-6)
+
+    def test_identity_sampling_roundtrip(self):
+        """Identity affine grid + grid_sampler == identity on the image."""
+        rng = np.random.RandomState(3)
+        x = rng.randn(1, 2, 5, 5).astype(np.float32)
+        theta = np.array([[[1., 0., 0.], [0., 1., 0.]]], np.float32)
+        prog, startup = Program(), Program()
+        with program_guard(prog, startup):
+            xv = fluid.layers.data('x', shape=(-1, 2, 5, 5),
+                                   dtype='float32')
+            tv = fluid.layers.data('theta', shape=(-1, 2, 3),
+                                   dtype='float32')
+            grid = fluid.layers.affine_grid(tv, out_shape=[1, 2, 5, 5])
+            out = fluid.layers.grid_sampler(xv, grid)
+        exe = fluid.Executor()
+        o, = exe.run(prog, feed={'x': x, 'theta': theta},
+                     fetch_list=[out])
+        np.testing.assert_allclose(o, x, rtol=1e-4, atol=1e-5)
+
+    def test_grid_sampler_zero_outside(self):
+        x = np.ones((1, 1, 4, 4), np.float32)
+        # grid points far outside [-1, 1] sample zeros
+        grid = np.full((1, 2, 2, 2), 5.0, np.float32)
+        out, = _run_single_op(
+            'grid_sampler', {'X': x, 'Grid': grid}, {'Output': ['gs_out']},
+            {})
+        np.testing.assert_allclose(out, 0.0, atol=1e-6)
+
+
+class TestYolov3Loss(object):
+    def _inputs(self, seed=0):
+        rng = np.random.RandomState(seed)
+        n, h, w, cls = 1, 4, 4, 3
+        mask = [0, 1]
+        anchors = [10, 13, 16, 30, 33, 23]
+        x = rng.randn(n, len(mask) * (5 + cls), h, w).astype(np.float32)
+        gtbox = np.array([[[0.4, 0.4, 0.3, 0.4],
+                           [0., 0., 0., 0.]]], np.float32)  # 1 valid gt
+        gtlabel = np.array([[1, 0]], np.int32)
+        return x, gtbox, gtlabel, anchors, mask, cls
+
+    def test_loss_finite_and_outputs(self):
+        x, gtbox, gtlabel, anchors, mask, cls = self._inputs()
+        loss, obj, match = _run_single_op(
+            'yolov3_loss',
+            {'X': x, 'GTBox': gtbox, 'GTLabel': gtlabel},
+            {'Loss': ['yl'], 'ObjectnessMask': ['yobj'],
+             'GTMatchMask': ['ymatch']},
+            {'anchors': anchors, 'anchor_mask': mask, 'class_num': cls,
+             'ignore_thresh': 0.7, 'downsample_ratio': 32})
+        assert loss.shape == (1,)
+        assert np.isfinite(loss).all() and loss[0] > 0
+        assert obj.shape == (1, 2, 4, 4)
+        # the single valid gt matched some anchor in the mask or none
+        assert match.shape == (1, 2)
+        assert match[0, 1] == -1          # invalid gt never matches
+
+    def test_trains(self):
+        prog, startup = Program(), Program()
+        with program_guard(prog, startup):
+            feat = fluid.layers.data('feat', shape=(-1, 8, 4, 4),
+                                     dtype='float32')
+            gtb = fluid.layers.data('gtb', shape=(-1, 2, 4),
+                                    dtype='float32')
+            gtl = fluid.layers.data('gtl', shape=(-1, 2), dtype='int32')
+            head = fluid.layers.conv2d(feat, num_filters=2 * (5 + 3),
+                                       filter_size=1)
+            loss = fluid.layers.detection.yolov3_loss(
+                head, gtb, gtl, anchors=[10, 13, 16, 30, 33, 23],
+                anchor_mask=[0, 1], class_num=3, ignore_thresh=0.7,
+                downsample_ratio=32)
+            loss = fluid.layers.mean(loss)
+            fluid.optimizer.SGD(0.01).minimize(loss)
+        exe = fluid.Executor()
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        feat = rng.randn(2, 8, 4, 4).astype(np.float32)
+        gtb = np.array([[[0.5, 0.5, 0.3, 0.3], [0.2, 0.2, 0.1, 0.2]]] * 2,
+                       np.float32)
+        gtl = np.array([[1, 2]] * 2, np.int32)
+        losses = []
+        for _ in range(8):
+            l, = exe.run(prog, feed={'feat': feat, 'gtb': gtb, 'gtl': gtl},
+                         fetch_list=[loss])
+            losses.append(float(np.asarray(l).reshape(())))
+        assert all(np.isfinite(v) for v in losses)
+        assert losses[-1] < losses[0]
+
+
+class TestGenerateProposals(object):
+    def test_shapes_and_validity(self):
+        rng = np.random.RandomState(4)
+        n, a, h, w = 1, 3, 4, 4
+        scores = rng.rand(n, a, h, w).astype(np.float32)
+        deltas = (rng.randn(n, 4 * a, h, w) * 0.1).astype(np.float32)
+        im_info = np.array([[32., 32., 1.]], np.float32)
+        anchors = np.zeros((h, w, a, 4), np.float32)
+        for i in range(h):
+            for j in range(w):
+                for k in range(a):
+                    cx, cy = j * 8 + 4, i * 8 + 4
+                    sz = 4 * (k + 1)
+                    anchors[i, j, k] = [cx - sz, cy - sz, cx + sz, cy + sz]
+        variances = np.ones((h, w, a, 4), np.float32)
+        rois, probs = _run_single_op(
+            'generate_proposals',
+            {'Scores': scores, 'BboxDeltas': deltas, 'ImInfo': im_info,
+             'Anchors': anchors, 'Variances': variances},
+            {'RpnRois': ['gp_rois'], 'RpnRoiProbs': ['gp_probs']},
+            {'pre_nms_topN': 20, 'post_nms_topN': 8, 'nms_thresh': 0.7,
+             'min_size': 1.0, 'eta': 1.0})
+        assert rois.shape == (8, 4)
+        assert probs.shape == (8, 1)
+        valid = probs.reshape(-1) > 0
+        assert valid.any()
+        # valid rois inside the image
+        vr = rois[valid]
+        assert (vr[:, 0] >= 0).all() and (vr[:, 2] <= 31).all()
+        assert (vr[:, 1] >= 0).all() and (vr[:, 3] <= 31).all()
+        # probs sorted descending among valid
+        pv = probs.reshape(-1)[valid]
+        assert (np.diff(pv) <= 1e-6).all()
+
+
+class TestRpnTargetAssign(object):
+    def test_sampling_quotas(self):
+        rng = np.random.RandomState(5)
+        a = 32
+        anchors = np.zeros((a, 4), np.float32)
+        for i in range(a):
+            cx, cy = (i % 8) * 8 + 4, (i // 8) * 8 + 4
+            anchors[i] = [cx - 6, cy - 6, cx + 6, cy + 6]
+        gt = np.array([[0., 0., 14., 14.], [40., 24., 60., 40.]],
+                      np.float32)
+        im_info = np.array([[64., 64., 1.]], np.float32)
+        loc_i, score_i, label, tbox, biw = _run_single_op(
+            'rpn_target_assign',
+            {'Anchor': anchors, 'GtBoxes': (gt, [[0, 2]]),
+             'ImInfo': im_info},
+            {'LocationIndex': ['rta_loc'], 'ScoreIndex': ['rta_score'],
+             'TargetLabel': ['rta_lab'], 'TargetBBox': ['rta_tb'],
+             'BBoxInsideWeight': ['rta_biw']},
+            {'rpn_batch_size_per_im': 16, 'rpn_positive_overlap': 0.5,
+             'rpn_negative_overlap': 0.3, 'rpn_fg_fraction': 0.5,
+             'use_random': False})
+        assert score_i.shape == (16,)
+        assert label.shape == (16, 1)
+        assert loc_i.shape == (8,)          # fg quota = 16 * 0.5
+        assert tbox.shape == (8, 4)
+        assert biw.shape == (8, 4)
+        n_fg = int(label.sum())
+        assert 1 <= n_fg <= 8
+        # fg rows have weight 1, padding rows 0
+        assert int((biw[:, 0] > 0).sum()) == n_fg
