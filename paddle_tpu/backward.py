@@ -89,11 +89,12 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
     if checkpoints:
         attrs['checkpoints'] = [c.name if isinstance(c, Variable) else c
                                 for c in checkpoints]
-    block.append_op(
-        type='backward',
-        inputs={'Loss': [loss]},
-        outputs={'Grads': grad_vars},
-        attrs=attrs)
+    with program._role_guard('Backward'):
+        block.append_op(
+            type='backward',
+            inputs={'Loss': [loss]},
+            outputs={'Grads': grad_vars},
+            attrs=attrs)
     return list(zip(params, grad_vars))
 
 
@@ -114,11 +115,12 @@ def calc_gradient(targets, inputs, target_gradients=None, no_grad_set=None):
             name=grad_var_name(v.name), shape=v.shape, dtype=v.dtype,
             persistable=False, stop_gradient=False)
         grad_vars.append(g)
-    block.append_op(
-        type='backward',
-        inputs={'Loss': [loss]},
-        outputs={'Grads': grad_vars},
-        attrs={'wrt_names': [v.name for v in wrt]})
+    with block.program._role_guard('Backward'):
+        block.append_op(
+            type='backward',
+            inputs={'Loss': [loss]},
+            outputs={'Grads': grad_vars},
+            attrs={'wrt_names': [v.name for v in wrt]})
     return grad_vars
 
 

@@ -185,6 +185,11 @@ class Operator(object):
         self.block = block
         self.type = type
         self.attrs = dict(attrs or {})
+        # op role (reference op_proto_maker.h:26-36 Forward/Backward/
+        # Optimize/LRSched...): set from the program's current role so
+        # inference export can strip training-only ops (reference
+        # clone(for_test) + role-aware pruning)
+        self.role = block.program._current_role
 
         def _canon(d):
             out = collections.OrderedDict()
@@ -353,6 +358,16 @@ class Program(object):
         self._is_test = False
         # op-role bookkeeping kept for API parity (op_proto_maker.h:26-36)
         self._current_role = 'Forward'
+
+    @contextlib.contextmanager
+    def _role_guard(self, role):
+        """Ops appended inside get `role` (reference
+        _optimized_guard/_backward_role_guard)."""
+        prev, self._current_role = self._current_role, role
+        try:
+            yield
+        finally:
+            self._current_role = prev
 
     # -- structure ---------------------------------------------------------
     def global_block(self):

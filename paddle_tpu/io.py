@@ -133,7 +133,33 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
     target_names = [t.name for t in target_vars]
 
     inference_program = main_program.clone(for_test=True)
+    # strip training-only ops BEFORE pruning: optimizer ops write ParamOut
+    # under the parameter's own name, so dependency-based pruning alone
+    # would drag the whole backward+optimizer graph into the export
+    # (reference strips by op role, op_proto_maker.h:26-36)
+    gb = inference_program.global_block()
+    gb.ops = [op for op in gb.ops
+              if getattr(op, 'role', 'Forward') not in
+              ('Backward', 'Optimize')]
+    inference_program._bump_version()
     pruned = inference_program._prune(target_names)
+    # _prune keeps all persistables; drop the ones no remaining op touches
+    # (optimizer accumulators, learning rate) so the export carries only
+    # the weights the model actually reads
+    pg = pruned.global_block()
+    used = set(target_names)
+    for op in pruned.blocks[0].ops:
+        used.update(op.input_arg_names)
+        used.update(op.output_arg_names)
+    for block in pruned.blocks[1:]:
+        for op in block.ops:
+            used.update(op.input_arg_names)
+            used.update(op.output_arg_names)
+    import collections as _c
+    pg.vars = _c.OrderedDict(
+        (k, v) for k, v in pg.vars.items()
+        if k in used or not v.persistable)
+    pruned._bump_version()
 
     os.makedirs(dirname, exist_ok=True)
     model_path = os.path.join(dirname, model_filename or MODEL_FILENAME)

@@ -10,7 +10,6 @@ import math
 
 from ..layer_helper import LayerHelper
 from ..framework import Variable
-from ..param_attr import ParamAttr
 from . import nn
 from . import tensor
 

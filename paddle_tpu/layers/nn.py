@@ -1434,7 +1434,12 @@ def grid_sampler(x, grid, name=None):
     """Bilinear sampling of x at grid coords in [-1, 1] (reference
     operators/grid_sampler_op.cc)."""
     helper = LayerHelper('grid_sampler', name=name)
-    out = helper.create_variable_for_type_inference(x.dtype, shape=x.shape)
+    # output spatial dims follow the grid, not the input
+    gshape = grid.shape or (None, -1, -1, 2)
+    oshape = None
+    if x.shape:
+        oshape = (x.shape[0], x.shape[1], gshape[1], gshape[2])
+    out = helper.create_variable_for_type_inference(x.dtype, shape=oshape)
     helper.append_op(type='grid_sampler', inputs={'X': [x], 'Grid': [grid]},
                      outputs={'Output': [out]})
     return out

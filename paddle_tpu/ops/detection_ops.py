@@ -397,8 +397,15 @@ def _bipartite_match(ctx, op):
 
     offsets = lod[-1] if lod else (0, dist.shape[0])
     matches, dists = [], []
+    c = dist.shape[1]
     for i in range(len(offsets) - 1):
         seg = dist[offsets[i]:offsets[i + 1]]
+        if seg.shape[0] == 0:
+            # image with no ground-truth rows: nothing to match
+            # (reference CPU op leaves the -1/0 initialization)
+            matches.append(jnp.full((c,), -1, jnp.int32))
+            dists.append(jnp.zeros((c,), dist.dtype))
+            continue
         m, d = _bipartite_greedy(seg)
         if match_type == 'per_prediction':
             m, d = _argmax_match(seg, m, d, threshold)
@@ -869,8 +876,13 @@ def _rpn_target_assign(ctx, op):
     loc_idx, score_idx, tgt_label, tgt_bbox, inside_w = [], [], [], [], []
     for i in range(n):
         gt = gt_boxes[offsets[i]:offsets[i + 1]]
+        empty_gt = gt.shape[0] == 0
+        if empty_gt:
+            # no ground truth: every anchor is background-eligible
+            # (reference samples only negatives for such images)
+            gt = jnp.full((1, 4), -1e4, gt_boxes.dtype)
         iou = _iou_matrix(anc, gt, normalized=False)     # [A, G]
-        if is_crowd is not None:
+        if is_crowd is not None and not empty_gt:
             # crowd gt boxes never produce positives (reference
             # rpn_target_assign_op.cc FilterCrowdGt)
             crowd = is_crowd[offsets[i]:offsets[i + 1]].reshape(-1) > 0
@@ -932,10 +944,19 @@ def _rpn_target_assign(ctx, op):
         tb = jnp.stack([(gcx - acx) / aw, (gcy - acy) / ah,
                         jnp.log(gw / aw), jnp.log(gh / ah)], -1)
 
+        # pad unfilled score slots by repeating the last valid sample (so
+        # padding never trains an arbitrary anchor; duplicates only occur
+        # when fewer than batch_per_im anchors are eligible)
+        last_valid = jnp.maximum(
+            jnp.max(jnp.where(sc_valid,
+                              jnp.arange(sc_sel.shape[0]), -1)), 0)
+        fill = sc_sel[last_valid]
+        sc_final = jnp.where(sc_valid, sc_sel, fill)
         loc_idx.append(jnp.where(fg_valid, fg_sel + i * a, 0))
-        score_idx.append(jnp.where(sc_valid, sc_sel + i * a, 0))
-        tgt_label.append(fg_keep[sc_sel].astype(jnp.int32))
-        tgt_bbox.append(jnp.where(fg_valid[:, None], tb, 0.0))
+        score_idx.append(sc_final + i * a)
+        tgt_label.append(fg_keep[sc_final].astype(jnp.int32))
+        tgt_bbox.append(jnp.where(fg_valid[:, None],
+                                  jnp.nan_to_num(tb), 0.0))
         inside_w.append(jnp.where(fg_valid[:, None],
                                   jnp.ones_like(tb), 0.0))
 

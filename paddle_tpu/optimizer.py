@@ -115,17 +115,21 @@ class Optimizer(object):
         return append_backward(loss, parameter_list, no_grad_set)
 
     def apply_gradients(self, params_grads):
-        params_grads = sorted(params_grads, key=lambda pg: pg[0].name)
-        params_grads = append_gradient_clip_ops(params_grads)
-        params_grads = append_regularization_ops(params_grads,
-                                                 self.regularization)
-        self._create_global_learning_rate()
-        block = default_main_program().global_block()
-        self._create_accumulators(block, [pg[0] for pg in params_grads])
-        optimize_ops = []
-        for pg in params_grads:
-            optimize_ops.append(self._append_optimize_op(block, pg))
-        self._finish_update(block, params_grads)
+        program = default_main_program()
+        # everything appended here is training-only: mark with the Optimize
+        # role so inference export strips it (reference _optimized_guard)
+        with program._role_guard('Optimize'):
+            params_grads = sorted(params_grads, key=lambda pg: pg[0].name)
+            params_grads = append_gradient_clip_ops(params_grads)
+            params_grads = append_regularization_ops(params_grads,
+                                                     self.regularization)
+            self._create_global_learning_rate()
+            block = program.global_block()
+            self._create_accumulators(block, [pg[0] for pg in params_grads])
+            optimize_ops = []
+            for pg in params_grads:
+                optimize_ops.append(self._append_optimize_op(block, pg))
+            self._finish_update(block, params_grads)
         return optimize_ops
 
     def minimize(self, loss, startup_program=None, parameter_list=None,

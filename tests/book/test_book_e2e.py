@@ -1,0 +1,347 @@
+"""Book end-to-end suite (reference python/paddle/fluid/tests/book/):
+each model runs the full train -> save_inference_model -> load -> infer
+cycle on synthetic data, mirroring test_recognize_digits.py:65-204's
+pattern. 8 models: fit_a_line, recognize_digits (conv), image_classification
+(resnet + vgg), word2vec, recommender_system, machine_translation,
+label_semantic_roles, understand_sentiment (lstm)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _train_save_load_infer(exe, main, startup, loss, feed_fn, feed_names,
+                           targets, tmp_path, steps=15, min_drop=None,
+                           infer_feed_names=None):
+    """The book contract: train until loss drops, export pruned inference
+    program, reload it in a fresh scope, compare predictions."""
+    exe.run(startup)
+    losses = []
+    for i in range(steps):
+        vals = exe.run(main, feed=feed_fn(i), fetch_list=[loss])
+        losses.append(float(np.asarray(vals[0]).reshape(())))
+    assert all(np.isfinite(v) for v in losses), losses
+    if min_drop is not None:
+        assert losses[-1] < losses[0] * min_drop, \
+            "loss did not drop enough: %s" % losses
+    else:
+        assert losses[-1] < losses[0], losses
+
+    model_dir = str(tmp_path / "model")
+    infer_feed_names = infer_feed_names or feed_names
+    fluid.save_inference_model(model_dir, infer_feed_names, targets, exe,
+                               main_program=main)
+    feed = feed_fn(0)
+    ref = exe.run(main, feed=feed, fetch_list=targets)
+
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        prog2, names2, fetch2 = fluid.load_inference_model(model_dir, exe)
+        assert set(names2) == set(infer_feed_names)
+        out = exe.run(prog2, feed={n: feed[n] for n in names2},
+                      fetch_list=fetch2, scope=scope2)
+    for o, r in zip(out, ref):
+        np.testing.assert_allclose(np.asarray(o), np.asarray(r),
+                                   rtol=1e-4, atol=1e-4)
+    return losses
+
+
+def test_fit_a_line(tmp_path):
+    """reference tests/book/test_fit_a_line.py: linear regression."""
+    x = fluid.layers.data(name='x', shape=[13], dtype='float32')
+    y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+    y_predict = fluid.layers.fc(input=x, size=1, act=None)
+    cost = fluid.layers.square_error_cost(input=y_predict, label=y)
+    avg_cost = fluid.layers.mean(cost)
+    fluid.optimizer.SGD(learning_rate=0.01).minimize(avg_cost)
+
+    rng = np.random.RandomState(0)
+    w_true = rng.randn(13, 1).astype('float32')
+    X = rng.randn(256, 13).astype('float32')
+    Y = X @ w_true + 0.01 * rng.randn(256, 1).astype('float32')
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    _train_save_load_infer(
+        exe, fluid.default_main_program(), fluid.default_startup_program(),
+        avg_cost, lambda i: {'x': X, 'y': Y}, ['x', 'y'], [y_predict],
+        tmp_path, steps=30, min_drop=0.5, infer_feed_names=['x'])
+
+
+def test_recognize_digits_conv(tmp_path):
+    """reference tests/book/test_recognize_digits.py conv path
+    (simple_img_conv_pool x2)."""
+    img = fluid.layers.data(name='img', shape=[1, 28, 28], dtype='float32')
+    label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+    conv1 = fluid.nets.simple_img_conv_pool(
+        input=img, filter_size=5, num_filters=8, pool_size=2,
+        pool_stride=2, act="relu")
+    conv2 = fluid.nets.simple_img_conv_pool(
+        input=conv1, filter_size=5, num_filters=16, pool_size=2,
+        pool_stride=2, act="relu")
+    prediction = fluid.layers.fc(input=conv2, size=10, act='softmax')
+    avg_cost = fluid.layers.mean(
+        fluid.layers.cross_entropy(input=prediction, label=label))
+    fluid.optimizer.Adam(learning_rate=0.01).minimize(avg_cost)
+
+    rng = np.random.RandomState(1)
+    lab = rng.randint(0, 4, 64).astype('int64')
+    centers = rng.randn(4, 1, 28, 28).astype('float32')
+    X = (centers[lab] + 0.3 * rng.randn(64, 1, 28, 28)).astype('float32')
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    _train_save_load_infer(
+        exe, fluid.default_main_program(), fluid.default_startup_program(),
+        avg_cost, lambda i: {'img': X, 'label': lab.reshape(-1, 1)},
+        ['img', 'label'], [prediction], tmp_path, steps=15, min_drop=0.7,
+        infer_feed_names=['img'])
+
+
+@pytest.mark.parametrize('net', ['resnet', 'vgg'])
+def test_image_classification(tmp_path, net):
+    """reference tests/book/test_image_classification.py: resnet_cifar10 /
+    vgg16 on cifar shapes (tiny 16x16 inputs here)."""
+    from paddle_tpu.models import resnet as resnet_m
+    images = fluid.layers.data(name='pixel', shape=[3, 16, 16],
+                               dtype='float32')
+    label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+    if net == 'resnet':
+        logits = resnet_m.resnet_cifar10(images, class_dim=4, depth=14)
+        predict = fluid.layers.softmax(logits)
+    else:
+        from paddle_tpu.models.vgg import vgg16_bn_drop
+        feat = vgg16_bn_drop(images)
+        predict = fluid.layers.fc(input=feat, size=4, act='softmax')
+    avg_cost = fluid.layers.mean(
+        fluid.layers.cross_entropy(input=predict, label=label))
+    test_prog = fluid.default_main_program().clone(for_test=True)
+    fluid.optimizer.Adam(learning_rate=0.01).minimize(avg_cost)
+
+    rng = np.random.RandomState(2)
+    lab = rng.randint(0, 4, 32).astype('int64')
+    centers = rng.randn(4, 3, 16, 16).astype('float32')
+    X = (centers[lab] + 0.3 * rng.randn(32, 3, 16, 16)).astype('float32')
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    losses = []
+    for _ in range(10):
+        l, = exe.run(feed={'pixel': X, 'label': lab.reshape(-1, 1)},
+                     fetch_list=[avg_cost])
+        losses.append(float(np.asarray(l).reshape(())))
+    assert all(np.isfinite(v) for v in losses)
+    assert losses[-1] < losses[0]
+
+    # save/load of the is_test clone (batch-norm in inference mode)
+    model_dir = str(tmp_path / "model")
+    fluid.save_inference_model(model_dir, ['pixel'], [predict], exe,
+                               main_program=test_prog)
+    ref, = exe.run(test_prog, feed={'pixel': X[:4],
+                                    'label': lab[:4].reshape(-1, 1)},
+                   fetch_list=[predict])
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        prog2, names2, fetch2 = fluid.load_inference_model(model_dir, exe)
+        out, = exe.run(prog2, feed={names2[0]: X[:4]}, fetch_list=fetch2,
+                       scope=scope2)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_word2vec(tmp_path):
+    """reference tests/book/test_word2vec.py: N-gram skip model with a
+    shared embedding table (is_sparse exercising SelectedRows grads)."""
+    dict_size = 60
+    emb_dim = 16
+    words = []
+    for name in ('firstw', 'secondw', 'thirdw', 'fourthw'):
+        words.append(fluid.layers.data(name=name, shape=[1], dtype='int64'))
+    nextw = fluid.layers.data(name='nextw', shape=[1], dtype='int64')
+    embs = []
+    for w in words:
+        embs.append(fluid.layers.embedding(
+            input=w, size=[dict_size, emb_dim], is_sparse=True,
+            param_attr='shared_w'))
+    concat = fluid.layers.concat(input=embs, axis=1)
+    hidden = fluid.layers.fc(input=concat, size=64, act='sigmoid')
+    predict = fluid.layers.fc(input=hidden, size=dict_size, act='softmax')
+    avg_cost = fluid.layers.mean(
+        fluid.layers.cross_entropy(input=predict, label=nextw))
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(avg_cost)
+
+    rng = np.random.RandomState(3)
+    data = rng.randint(0, dict_size, size=(128, 5)).astype('int64')
+    feed = {n: data[:, i:i + 1] for i, n in
+            enumerate(('firstw', 'secondw', 'thirdw', 'fourthw', 'nextw'))}
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    _train_save_load_infer(
+        exe, fluid.default_main_program(), fluid.default_startup_program(),
+        avg_cost, lambda i: feed,
+        ['firstw', 'secondw', 'thirdw', 'fourthw', 'nextw'], [predict],
+        tmp_path, steps=20,
+        infer_feed_names=['firstw', 'secondw', 'thirdw', 'fourthw'])
+
+
+def test_recommender_system(tmp_path):
+    """reference tests/book/test_recommender_system.py: dual-tower
+    usr/mov features -> cos_sim -> square error regression."""
+    usr = fluid.layers.data(name='usr', shape=[1], dtype='int64')
+    usr_age = fluid.layers.data(name='usr_age', shape=[1], dtype='int64')
+    mov = fluid.layers.data(name='mov', shape=[1], dtype='int64')
+    score = fluid.layers.data(name='score', shape=[1], dtype='float32')
+
+    usr_emb = fluid.layers.embedding(usr, size=[40, 16],
+                                     param_attr='usr_table')
+    age_emb = fluid.layers.embedding(usr_age, size=[8, 8],
+                                     param_attr='age_table')
+    usr_feat = fluid.layers.fc(
+        fluid.layers.concat([usr_emb, age_emb], axis=1), size=32,
+        act='tanh')
+    mov_emb = fluid.layers.embedding(mov, size=[50, 16],
+                                     param_attr='mov_table')
+    mov_feat = fluid.layers.fc(mov_emb, size=32, act='tanh')
+    sim = fluid.layers.cos_sim(X=usr_feat, Y=mov_feat)
+    predict = fluid.layers.scale(sim, scale=5.0)
+    avg_cost = fluid.layers.mean(
+        fluid.layers.square_error_cost(input=predict, label=score))
+    fluid.optimizer.Adam(learning_rate=0.01).minimize(avg_cost)
+
+    rng = np.random.RandomState(4)
+    n = 128
+    U = rng.randint(0, 40, (n, 1)).astype('int64')
+    A = rng.randint(0, 8, (n, 1)).astype('int64')
+    M = rng.randint(0, 50, (n, 1)).astype('int64')
+    S = ((U.astype('float32') % 5) + (M.astype('float32') % 3)) / 2.0
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    _train_save_load_infer(
+        exe, fluid.default_main_program(), fluid.default_startup_program(),
+        avg_cost,
+        lambda i: {'usr': U, 'usr_age': A, 'mov': M, 'score': S},
+        ['usr', 'usr_age', 'mov', 'score'], [predict], tmp_path, steps=25,
+        infer_feed_names=['usr', 'usr_age', 'mov'])
+
+
+def test_machine_translation(tmp_path):
+    """reference tests/book/test_machine_translation.py: seq2seq encoder +
+    teacher-forced decoder over ragged (LoD) sequences."""
+    dict_size = 30
+    word_dim = 16
+    hidden_dim = 32
+
+    src = fluid.layers.data(name='src_word', shape=[1], dtype='int64',
+                            lod_level=1)
+    trg = fluid.layers.data(name='trg_word', shape=[1], dtype='int64',
+                            lod_level=1)
+    label = fluid.layers.data(name='trg_next', shape=[1], dtype='int64',
+                              lod_level=1)
+
+    src_emb = fluid.layers.embedding(src, size=[dict_size, word_dim])
+    fc1 = fluid.layers.fc(src_emb, size=hidden_dim * 3)
+    enc = fluid.layers.dynamic_gru(input=fc1, size=hidden_dim)
+    enc_last = fluid.layers.sequence_last_step(enc)
+
+    trg_emb = fluid.layers.embedding(trg, size=[dict_size, word_dim])
+    # decoder init state from encoder; teacher forcing via ragged gru
+    dec_fc = fluid.layers.fc(trg_emb, size=hidden_dim * 3)
+    dec = fluid.layers.dynamic_gru(input=dec_fc, size=hidden_dim,
+                                   h_0=enc_last)
+    predict = fluid.layers.fc(dec, size=dict_size, act='softmax')
+    avg_cost = fluid.layers.mean(
+        fluid.layers.cross_entropy(input=predict, label=label))
+    fluid.optimizer.Adam(learning_rate=0.02).minimize(avg_cost)
+
+    rng = np.random.RandomState(5)
+    src_lod = [[0, 4, 9]]
+    trg_lod = [[0, 5, 8]]
+    SW = rng.randint(1, dict_size, (9, 1)).astype('int64')
+    TW = rng.randint(1, dict_size, (8, 1)).astype('int64')
+    NX = rng.randint(1, dict_size, (8, 1)).astype('int64')
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    _train_save_load_infer(
+        exe, fluid.default_main_program(), fluid.default_startup_program(),
+        avg_cost,
+        lambda i: {'src_word': (SW, src_lod), 'trg_word': (TW, trg_lod),
+                   'trg_next': (NX, trg_lod)},
+        ['src_word', 'trg_word', 'trg_next'], [predict], tmp_path,
+        steps=20, infer_feed_names=['src_word', 'trg_word'])
+
+
+def test_label_semantic_roles(tmp_path):
+    """reference tests/book/test_label_semantic_roles.py: embeddings ->
+    linear-chain CRF training + crf_decoding inference."""
+    word_dict_len = 40
+    label_dict_len = 6
+    word = fluid.layers.data(name='word_data', shape=[1], dtype='int64',
+                             lod_level=1)
+    target = fluid.layers.data(name='target', shape=[1], dtype='int64',
+                               lod_level=1)
+    emb = fluid.layers.embedding(word, size=[word_dict_len, 16])
+    hidden = fluid.layers.fc(emb, size=24, act='tanh')
+    feature_out = fluid.layers.fc(hidden, size=label_dict_len)
+    crf_cost = fluid.layers.linear_chain_crf(
+        input=feature_out, label=target,
+        param_attr=fluid.ParamAttr(name='crfw'))
+    avg_cost = fluid.layers.mean(crf_cost)
+    fluid.optimizer.SGD(learning_rate=0.05).minimize(avg_cost)
+
+    crf_decode = fluid.layers.crf_decoding(
+        input=feature_out, param_attr=fluid.ParamAttr(name='crfw'))
+
+    rng = np.random.RandomState(6)
+    lod = [[0, 5, 11]]
+    W = rng.randint(0, word_dict_len, (11, 1)).astype('int64')
+    T = rng.randint(0, label_dict_len, (11, 1)).astype('int64')
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    losses = []
+    for _ in range(20):
+        l, = exe.run(feed={'word_data': (W, lod), 'target': (T, lod)},
+                     fetch_list=[avg_cost])
+        losses.append(float(np.asarray(l).reshape(())))
+    assert losses[-1] < losses[0]
+
+    # decode path end-to-end (save/load with crf transition param)
+    model_dir = str(tmp_path / "model")
+    fluid.save_inference_model(model_dir, ['word_data'], [crf_decode], exe)
+    ref, = exe.run(fluid.default_main_program(),
+                   feed={'word_data': (W, lod), 'target': (T, lod)},
+                   fetch_list=[crf_decode])
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        prog2, names2, fetch2 = fluid.load_inference_model(model_dir, exe)
+        out, = exe.run(prog2, feed={'word_data': (W, lod)},
+                       fetch_list=fetch2, scope=scope2)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_understand_sentiment_lstm(tmp_path):
+    """reference tests/book/test_understand_sentiment.py (stacked lstm
+    path): embedding -> dynamic_lstm -> sequence_pool -> classifier."""
+    dict_dim = 50
+    emb_dim = 16
+    hid_dim = 32
+    data = fluid.layers.data(name='words', shape=[1], dtype='int64',
+                             lod_level=1)
+    label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+    emb = fluid.layers.embedding(input=data, size=[dict_dim, emb_dim])
+    fc1 = fluid.layers.fc(input=emb, size=hid_dim * 4)
+    lstm1, _ = fluid.layers.dynamic_lstm(input=fc1, size=hid_dim * 4)
+    lstm_pool = fluid.layers.sequence_pool(input=lstm1, pool_type='max')
+    prediction = fluid.layers.fc(input=lstm_pool, size=2, act='softmax')
+    avg_cost = fluid.layers.mean(
+        fluid.layers.cross_entropy(input=prediction, label=label))
+    fluid.optimizer.Adam(learning_rate=0.02).minimize(avg_cost)
+
+    rng = np.random.RandomState(7)
+    lod = [[0, 6, 10, 17]]
+    W = rng.randint(0, dict_dim, (17, 1)).astype('int64')
+    L = np.array([[0], [1], [0]], dtype='int64')
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    _train_save_load_infer(
+        exe, fluid.default_main_program(), fluid.default_startup_program(),
+        avg_cost, lambda i: {'words': (W, lod), 'label': L},
+        ['words', 'label'], [prediction], tmp_path, steps=20,
+        infer_feed_names=['words'])

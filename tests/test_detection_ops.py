@@ -503,3 +503,36 @@ class TestSSDPipeline(object):
         assert l.shape == (2, num_priors, 4)
         assert c.shape == (2, num_priors, 3)
         assert v.shape == (num_priors, 4)
+
+
+class TestEmptyGroundTruth(object):
+    def test_bipartite_match_empty_segment(self):
+        """An image with zero gt boxes yields all -1 matches (reference CPU
+        op leaves the -1/0 init for empty instances)."""
+        d2 = np.array([[0.2, 0.8], [0.7, 0.3]], np.float32)
+        idx, d = _run_single_op(
+            'bipartite_match', {'DistMat': (d2, [[0, 0, 2]])},
+            {'ColToRowMatchIndices': ['mi_e'], 'ColToRowMatchDist': ['md_e']},
+            {'match_type': 'bipartite', 'dist_threshold': 0.5})
+        np.testing.assert_array_equal(idx[0], [-1, -1])
+        np.testing.assert_allclose(d[0], [0.0, 0.0])
+        np.testing.assert_array_equal(idx[1], [1, 0])
+
+    def test_rpn_target_assign_empty_gt(self):
+        anchors = np.array([[0., 0., 10., 10.], [20., 20., 30., 30.]],
+                           np.float32)
+        gt = np.zeros((0, 4), np.float32)
+        im_info = np.array([[40., 40., 1.]], np.float32)
+        loc_i, score_i, label, tbox, biw = _run_single_op(
+            'rpn_target_assign',
+            {'Anchor': anchors, 'GtBoxes': (gt, [[0, 0]]),
+             'ImInfo': im_info},
+            {'LocationIndex': ['rte_loc'], 'ScoreIndex': ['rte_score'],
+             'TargetLabel': ['rte_lab'], 'TargetBBox': ['rte_tb'],
+             'BBoxInsideWeight': ['rte_biw']},
+            {'rpn_batch_size_per_im': 4, 'rpn_positive_overlap': 0.5,
+             'rpn_negative_overlap': 0.3, 'rpn_fg_fraction': 0.5,
+             'use_random': False})
+        # only background sampled, loc branch fully masked
+        assert int(label.sum()) == 0
+        assert (biw == 0).all()
