@@ -251,7 +251,13 @@ def lower_block(ctx, lo=0):
         return env2[loss_name], env2
 
     (loss_val, env2), pullback = _vjp_with_aux(fwd, wrt_vals)
-    grads = pullback(jnp.ones_like(loss_val))
+    # loss-cotangent seed: 1 by default; the DP runner sets
+    # loss_grad_scale=num_devices for BuildStrategy.GradientScaleStrategy.One
+    # (reference details/scale_loss_grad_op_handle.cc seeds 1/N per device
+    # under CoeffNumDevice; our global-batch mean already folds in 1/N, so
+    # One re-scales by N)
+    seed_scale = ctx.params.get('loss_grad_scale', 1.0)
+    grads = pullback(jnp.full_like(loss_val, seed_scale))
 
     per_table = {}
     for k, (tbl, flat_ids, dim, dtype) in enumerate(sites):
@@ -333,14 +339,17 @@ def analyze_state(program, fetch_names=()):
 
 
 def build_fn(program, fetch_names, read_names, written_names,
-             static_lods=None, static_feed=None, lod_out=None):
+             static_lods=None, static_feed=None, lod_out=None,
+             lower_params=None):
     """Build the raw (unjitted) whole-program function
     fn(feed, ro_state, rw_state, key) -> (fetches, new_state).
 
     static_lods: var name -> LoD offsets bound at compile time (feeds & state).
     static_feed: shape-bearing feed values bound as trace-time constants.
     lod_out: optional dict the trace fills with every var's produced LoD —
-    read by the executor after first compile to attach LoD to fetches."""
+    read by the executor after first compile to attach LoD to fetches.
+    lower_params: extra knobs op lowerings consult via ctx.params
+    (e.g. loss_grad_scale)."""
 
     written_set = set(written_names)
     rw_names = [n for n in read_names if n in written_set]
@@ -352,6 +361,7 @@ def build_fn(program, fetch_names, read_names, written_names,
         env.update(ro_state)
         env.update(rw_state)
         ctx = LowerContext(program, program.global_block(), env, key,
+                           params=lower_params,
                            lods=dict(static_lods or {}),
                            statics=dict(static_feed or {}))
         lower_block(ctx)

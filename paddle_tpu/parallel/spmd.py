@@ -23,14 +23,17 @@ __all__ = ['DataParallelRunner']
 
 
 class _Entry(object):
-    __slots__ = ('fn', 'ro_names', 'rw_names', 'written', 'feed_shardings')
+    __slots__ = ('fn', 'ro_names', 'rw_names', 'written', 'feed_shardings',
+                 'state_shardings')
 
-    def __init__(self, fn, ro_names, rw_names, written, feed_shardings):
+    def __init__(self, fn, ro_names, rw_names, written, feed_shardings,
+                 state_shardings):
         self.fn = fn
         self.ro_names = ro_names
         self.rw_names = rw_names
         self.written = written
         self.feed_shardings = feed_shardings
+        self.state_shardings = state_shardings
 
 
 class DataParallelRunner(object):
@@ -48,27 +51,75 @@ class DataParallelRunner(object):
     def num_devices(self):
         return int(np.prod(list(self._mesh.shape.values())))
 
+    def _strategy_knobs(self):
+        """Map BuildStrategy onto the SPMD compile (reference
+        details/build_strategy.h:34-96). Unsupported combinations error
+        loudly instead of being silently ignored."""
+        from ..compiler import BuildStrategy
+        bs = self._build_strategy
+        lower_params = {}
+        reduce_mode = False
+        if bs is not None:
+            gss = bs.gradient_scale_strategy
+            if gss == BuildStrategy.GradientScaleStrategy.One:
+                # reference: loss grad seeded with 1 per device instead of
+                # 1/N; with our global-batch-mean formulation that is a
+                # factor of num_devices on every gradient
+                lower_params['loss_grad_scale'] = float(self.num_devices)
+            elif gss == BuildStrategy.GradientScaleStrategy.Customized:
+                raise NotImplementedError(
+                    "BuildStrategy.GradientScaleStrategy.Customized needs a "
+                    "user-provided loss@GRAD feed, which the SPMD runner "
+                    "does not support — scale the loss in the program "
+                    "instead")
+            reduce_mode = (bs.reduce_strategy ==
+                           BuildStrategy.ReduceStrategy.Reduce)
+        return lower_params, reduce_mode
+
+    def _state_sharding(self, program, name, reduce_mode, mesh):
+        """Reduce mode = parameters/optimizer state sharded over 'data'
+        (the ZeRO-style TPU analog of reference ReduceSSAGraphBuilder:
+        each grad reduced to one owner + param updated there; XLA inserts
+        reduce_scatter for the grads and all_gathers for the forward)."""
+        if not reduce_mode:
+            return NamedSharding(mesh, P())
+        v = program.global_block()._find_var_recursive(name)
+        ndev = self.num_devices
+        if v is not None and v.shape and len(v.shape) >= 1 and \
+                v.shape[0] is not None and v.shape[0] > 0 and \
+                v.shape[0] % ndev == 0:
+            return NamedSharding(mesh, P('data'))
+        return NamedSharding(mesh, P())
+
     def _compile(self, feed, fetch_names):
         program = self._program
         read, written = lowering.analyze_state(program, fetch_names)
         from ..executor import Executor
         needed = Executor._read_before_write(program, read, written,
                                              set(feed), fetch_names)
+        lower_params, reduce_mode = self._strategy_knobs()
         fn, ro_names, rw_names = lowering.build_fn(
-            program, fetch_names, needed, written)
+            program, fetch_names, needed, written,
+            lower_params=lower_params)
         mesh = self._mesh
         repl = NamedSharding(mesh, P())
         batch_sharded = NamedSharding(mesh, P('data'))
         feed_shardings = {k: batch_sharded for k in feed}
+        state_shard = {n: self._state_sharding(program, n, reduce_mode,
+                                               mesh)
+                       for n in set(ro_names) | set(rw_names) | set(written)}
         in_shardings = (
             feed_shardings,
-            {n: repl for n in ro_names},
-            {n: repl for n in rw_names},
+            {n: state_shard[n] for n in ro_names},
+            {n: state_shard[n] for n in rw_names},
             repl,
         )
+        out_shardings = (None, {n: state_shard[n] for n in written})
         jitted = jax.jit(fn, in_shardings=in_shardings,
+                         out_shardings=out_shardings,
                          donate_argnums=(2,))
-        return _Entry(jitted, ro_names, rw_names, written, feed_shardings)
+        return _Entry(jitted, ro_names, rw_names, written, feed_shardings,
+                      state_shard)
 
     def run(self, executor, feed, fetch_list, scope, return_numpy):
         from ..executor import global_scope
@@ -83,9 +134,13 @@ class DataParallelRunner(object):
                 "sharding them over the mesh")
         fetch_names = [v.name if isinstance(v, Variable) else v
                        for v in (fetch_list or [])]
-        ndev = self.num_devices
+        nproc = jax.process_count()
+        # under multi-host, each process feeds its LOCAL batch shard
+        # (reference: each trainer reads its own data slice); divisibility
+        # is per local device count
+        ndev = self.num_devices // nproc if nproc > 1 else self.num_devices
         for k, v in feed.items():
-            if v.shape and v.shape[0] % ndev != 0:
+            if v.shape and v.shape[0] % max(ndev, 1) != 0:
                 raise ValueError(
                     "feed %r batch %d not divisible by %d mesh devices"
                     % (k, v.shape[0], ndev))
@@ -100,10 +155,41 @@ class DataParallelRunner(object):
                     for n in entry.ro_names}
         rw_state = {n: executor._state_value(scope, n, program)
                     for n in entry.rw_names}
+        if nproc > 1:
+            # assemble global arrays from per-process host-local data
+            # (feeds: local batch shard; state: every process holds the
+            # full value — identical init from the same seed)
+            def _globalize_feed(sharding, v):
+                if isinstance(v, jax.Array) and not v.is_fully_addressable:
+                    return v
+                return jax.make_array_from_process_local_data(
+                    sharding, np.asarray(v))
+
+            def _globalize_state(sharding, v):
+                if isinstance(v, jax.Array) and not v.is_fully_addressable:
+                    return v          # already a global array from last step
+                arr = np.asarray(v)
+                return jax.make_array_from_callback(
+                    arr.shape, sharding, lambda idx: arr[idx])
+
+            feed = {k: _globalize_feed(entry.feed_shardings[k], v)
+                    for k, v in feed.items()}
+            ro_state = {n: _globalize_state(entry.state_shardings[n], v)
+                        for n, v in ro_state.items()}
+            rw_state = {n: _globalize_state(entry.state_shardings[n], v)
+                        for n, v in rw_state.items()}
         self._run_counter += 1
         from ..executor import _run_key, _next_program_run
         key_arr = _run_key(program.random_seed, _next_program_run(program),
                            self._run_counter)
+        if nproc > 1:
+            # the PRNG key must be a global replicated array too (every
+            # process derives the identical value from the shared seed /
+            # run counters)
+            karr = np.asarray(key_arr)
+            key_arr = jax.make_array_from_callback(
+                karr.shape, NamedSharding(self._mesh, P()),
+                lambda idx: karr[idx])
         from . import api as _papi
         prev, _papi._ACTIVE_MESH = _papi._ACTIVE_MESH, self._mesh
         try:
@@ -114,5 +200,34 @@ class DataParallelRunner(object):
             _papi._ACTIVE_MESH = prev
         scope.update(new_state)
         if return_numpy:
-            return [np.asarray(f) for f in fetches]
+            return [self._fetch_to_host(f) for f in fetches]
         return list(fetches)
+
+    @staticmethod
+    def _fetch_to_host(f):
+        """Host view of a fetch. Multi-host: replicated fetches (losses,
+        metrics) give the full value; batch-sharded fetches give this
+        process's local rows, like each reference trainer seeing its own
+        split (parallel_executor.cc FeedAndSplitTensorIntoLocalScopes)."""
+        if not isinstance(f, jax.Array) or f.is_fully_addressable:
+            return np.asarray(f)
+        uniq = {}
+        for s in f.addressable_shards:      # dedupe replicas by index
+            uniq.setdefault(s.index, s.data)
+        if len(uniq) == 1:
+            data = next(iter(uniq.values()))
+            if data.shape == f.shape:       # replicated
+                return np.asarray(data)
+            return np.asarray(data)         # single local shard
+        idxs = list(uniq)
+        varying = [d for d in range(len(f.shape))
+                   if len({(ix[d].start, ix[d].stop) for ix in idxs}) > 1]
+        if len(varying) != 1:
+            raise ValueError(
+                "multi-host fetch is sharded over %d axes; fetch a "
+                "replicated value (e.g. the mean loss) or keep outputs "
+                "sharded with return_numpy=False" % len(varying))
+        ax = varying[0]
+        ordered = sorted(uniq.items(),
+                         key=lambda kv: kv[0][ax].start or 0)
+        return np.concatenate([np.asarray(v) for _, v in ordered], ax)

@@ -1,0 +1,65 @@
+"""Worker for the 2-process multi-host DP test (the reference
+unittests/test_dist_base.py trainer-subprocess pattern, nccl2 mode).
+
+Run as: python multihost_worker.py <coordinator> <nproc> <pid>
+Each process owns 2 virtual CPU devices; the global mesh spans 4 devices
+across both processes. Prints per-step losses as JSON on the last line.
+"""
+import json
+import os
+import sys
+
+os.environ['JAX_PLATFORMS'] = 'cpu'
+flags = os.environ.get('XLA_FLAGS', '')
+if '--xla_force_host_platform_device_count' not in flags:
+    os.environ['XLA_FLAGS'] = (
+        flags + ' --xla_force_host_platform_device_count=2').strip()
+
+import jax
+jax.config.update('jax_platforms', 'cpu')
+
+import numpy as np
+
+
+def main():
+    coordinator, nproc, pid = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+    import paddle_tpu as fluid
+    from paddle_tpu.parallel import collective
+
+    collective.init_distributed(coordinator_address=coordinator,
+                                num_processes=nproc, process_id=pid)
+    assert jax.process_count() == nproc
+    assert jax.device_count() == 2 * nproc
+
+    main_p, startup = fluid.Program(), fluid.Program()
+    main_p.random_seed = startup.random_seed = 23
+    with fluid.program_guard(main_p, startup):
+        x = fluid.layers.data(name='x', shape=[8], dtype='float32')
+        y = fluid.layers.data(name='y', shape=[1], dtype='int64')
+        h = fluid.layers.fc(x, size=16, act='relu')
+        p = fluid.layers.fc(h, size=3, act='softmax')
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(p, y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+
+    exe = fluid.Executor()
+    exe.run(startup)
+
+    # deterministic global batch, split by process (reference: each
+    # trainer reads its own slice)
+    rng = np.random.RandomState(5)
+    X = rng.randn(16, 8).astype('float32')
+    Y = rng.randint(0, 3, (16, 1)).astype('int64')
+    lo, hi = pid * 8, (pid + 1) * 8
+
+    compiled = fluid.CompiledProgram(main_p).with_data_parallel(
+        loss_name=loss.name)
+    losses = []
+    for _ in range(4):
+        l, = exe.run(compiled, feed={'x': X[lo:hi], 'y': Y[lo:hi]},
+                     fetch_list=[loss])
+        losses.append(float(np.asarray(l).reshape(())))
+    print("LOSSES:" + json.dumps(losses))
+
+
+if __name__ == '__main__':
+    main()

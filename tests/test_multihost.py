@@ -1,0 +1,87 @@
+"""2-process multi-host data parallelism over localhost (reference
+unittests/test_dist_base.py: spawn trainer subprocesses, compare losses
+against the single-process run)."""
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(('127.0.0.1', 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _single_process_reference():
+    """Same model/data on one process with 4 virtual devices."""
+    main_p, startup = fluid.Program(), fluid.Program()
+    main_p.random_seed = startup.random_seed = 23
+    with fluid.program_guard(main_p, startup):
+        x = fluid.layers.data(name='x', shape=[8], dtype='float32')
+        y = fluid.layers.data(name='y', shape=[1], dtype='int64')
+        h = fluid.layers.fc(x, size=16, act='relu')
+        p = fluid.layers.fc(h, size=3, act='softmax')
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(p, y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    rng = np.random.RandomState(5)
+    X = rng.randn(16, 8).astype('float32')
+    Y = rng.randint(0, 3, (16, 1)).astype('int64')
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        losses = []
+        for _ in range(4):
+            l, = exe.run(main_p, feed={'x': X, 'y': Y},
+                         fetch_list=[loss], scope=scope)
+            losses.append(float(np.asarray(l).reshape(())))
+    return losses
+
+
+def test_two_process_dp_matches_single():
+    port = _free_port()
+    coordinator = '127.0.0.1:%d' % port
+    worker = os.path.join(os.path.dirname(__file__), 'multihost_worker.py')
+    env = dict(os.environ)
+    env.pop('XLA_FLAGS', None)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(worker)))
+    env['PYTHONPATH'] = repo + os.pathsep + env.get('PYTHONPATH', '')
+
+    procs = [subprocess.Popen(
+        [sys.executable, worker, coordinator, '2', str(i)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env,
+        text=True) for i in range(2)]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("multihost worker timed out")
+        outs.append(out)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, \
+            "worker %d failed:\n%s" % (i, out[-3000:])
+
+    loss_lines = []
+    for out in outs:
+        line = [l for l in out.splitlines() if l.startswith('LOSSES:')]
+        assert line, out[-2000:]
+        loss_lines.append(json.loads(line[-1][len('LOSSES:'):]))
+
+    # both processes observe the same (global) loss trajectory
+    np.testing.assert_allclose(loss_lines[0], loss_lines[1],
+                               rtol=1e-5, atol=1e-6)
+    # and it matches the single-process run on the full batch
+    ref = _single_process_reference()
+    np.testing.assert_allclose(loss_lines[0], ref, rtol=1e-4, atol=1e-5)

@@ -1,0 +1,74 @@
+"""BuildStrategy fidelity under the SPMD data-parallel runner (reference
+unittests/test_parallel_executor_* reduce-vs-allreduce / gradient-scale
+comparisons, details/build_strategy.h:34-96)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _build(seed=11, lr=0.1):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = seed
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[16], dtype='float32')
+        y = fluid.layers.data(name='y', shape=[1], dtype='int64')
+        h = fluid.layers.fc(x, size=32, act='relu')
+        p = fluid.layers.fc(h, size=4, act='softmax')
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(p, y))
+        fluid.optimizer.SGD(lr).minimize(loss)
+    return main, startup, loss
+
+
+def _data():
+    rng = np.random.RandomState(0)
+    X = rng.randn(64, 16).astype('float32')
+    Y = rng.randint(0, 4, (64, 1)).astype('int64')
+    return X, Y
+
+
+def _run(build_strategy, seed=11, lr=0.1, steps=4):
+    X, Y = _data()
+    main, startup, loss = _build(seed=seed, lr=lr)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        compiled = fluid.CompiledProgram(main).with_data_parallel(
+            loss_name=loss.name, build_strategy=build_strategy)
+        return [float(np.asarray(exe.run(
+            compiled, feed={'x': X, 'y': Y}, fetch_list=[loss],
+            scope=scope)[0]).reshape(())) for _ in range(steps)]
+
+
+def test_reduce_matches_allreduce():
+    """Reduce mode (params sharded over 'data', reference
+    ReduceSSAGraphBuilder) must be numerically identical to AllReduce."""
+    bs_all = fluid.BuildStrategy()
+    bs_red = fluid.BuildStrategy()
+    bs_red.reduce_strategy = fluid.BuildStrategy.ReduceStrategy.Reduce
+    ref = _run(bs_all)
+    red = _run(bs_red)
+    np.testing.assert_allclose(red, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_gradient_scale_one_equals_lr_times_ndev():
+    """GradientScaleStrategy.One seeds the loss grad with 1 per device
+    (vs 1/N): every gradient is num_devices times larger, so training with
+    One at lr == training with CoeffNumDevice at lr * ndev."""
+    import jax
+    ndev = len(jax.devices())
+    bs_one = fluid.BuildStrategy()
+    bs_one.gradient_scale_strategy = \
+        fluid.BuildStrategy.GradientScaleStrategy.One
+    one = _run(bs_one, lr=0.01)
+    coeff = _run(fluid.BuildStrategy(), lr=0.01 * ndev)
+    np.testing.assert_allclose(one, coeff, rtol=1e-4, atol=1e-5)
+
+
+def test_customized_scale_errors_loudly():
+    bs = fluid.BuildStrategy()
+    bs.gradient_scale_strategy = \
+        fluid.BuildStrategy.GradientScaleStrategy.Customized
+    with pytest.raises(NotImplementedError, match="Customized"):
+        _run(bs)
