@@ -55,6 +55,9 @@ from . import models
 from . import transpiler
 from . import parallel
 from . import profiler
+from . import flags
+from .flags import get_flags, set_flags
+from . import debugger
 from .data_feeder import DataFeeder
 from . import compiler
 from .compiler import CompiledProgram

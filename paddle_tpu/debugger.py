@@ -1,0 +1,74 @@
+"""Program visualization (reference python/paddle/fluid/debugger.py
+draw_block_graphviz + framework/ir/graph_viz_pass.cc).
+
+Emits Graphviz DOT text: ops as boxes, variables as ellipses (parameters
+shaded), edges for reads/writes. No graphviz binary needed — the DOT file
+renders with any standard tool.
+"""
+from .framework import Parameter
+
+__all__ = ['draw_block_graphviz', 'program_to_dot']
+
+
+def _esc(s):
+    return str(s).replace('"', '\\"')
+
+
+def program_to_dot(program, max_vars=500):
+    """DOT source for the whole program (block 0 + sub-blocks as
+    clusters)."""
+    lines = ['digraph Program {', '  rankdir=TB;',
+             '  node [fontsize=10];']
+    emitted_vars = set()
+
+    def emit_var(block, name, indent):
+        key = 'var_%d_%s' % (block.idx, name)
+        if key in emitted_vars:
+            return key
+        emitted_vars.add(key)
+        v = block._find_var_recursive(name)
+        if isinstance(v, Parameter):
+            style = 'style=filled fillcolor=lightblue shape=ellipse'
+        elif v is not None and v.persistable:
+            style = 'style=filled fillcolor=lightgrey shape=ellipse'
+        else:
+            style = 'shape=ellipse'
+        shape = ' %s' % (v.shape,) if v is not None and v.shape else ''
+        lines.append('%s"%s" [label="%s%s" %s];'
+                     % (indent, key, _esc(name), _esc(shape), style))
+        return key
+
+    def emit_block(block, indent='  '):
+        for i, op in enumerate(block.ops):
+            op_key = 'op_%d_%d' % (block.idx, i)
+            lines.append('%s"%s" [label="%s" shape=box style=filled '
+                         'fillcolor=wheat];' % (indent, op_key,
+                                                _esc(op.type)))
+            for name in op.input_arg_names:
+                vk = emit_var(block, name, indent)
+                lines.append('%s"%s" -> "%s";' % (indent, vk, op_key))
+            for name in op.output_arg_names:
+                vk = emit_var(block, name, indent)
+                lines.append('%s"%s" -> "%s";' % (indent, op_key, vk))
+            sb = op.attrs.get('sub_block')
+            if isinstance(sb, int):
+                lines.append('%ssubgraph cluster_%d {' % (indent, sb))
+                lines.append('%s  label="block %d (%s)";'
+                             % (indent, sb, _esc(op.type)))
+                emit_block(program.block(sb), indent + '  ')
+                lines.append('%s}' % indent)
+
+    emit_block(program.global_block())
+    lines.append('}')
+    return '\n'.join(lines)
+
+
+def draw_block_graphviz(block_or_program, path='program.dot',
+                        highlights=None):
+    """Write the DOT file (reference debugger.draw_block_graphviz). Accepts
+    a Program or a Block (the block's program is drawn)."""
+    program = getattr(block_or_program, 'program', block_or_program)
+    dot = program_to_dot(program)
+    with open(path, 'w') as f:
+        f.write(dot)
+    return path

@@ -104,6 +104,27 @@ class Scope(object):
         return Scope()
 
 
+def _check_nan_inf(new_state, fetches):
+    """FLAGS_check_nan_inf: scan run outputs for NaN/Inf and raise naming
+    the variable (reference framework/operator.cc:973 checks every op
+    output; whole-program XLA means we check at the program boundary —
+    use FLAGS_debug_nans to trap at the producing op instead)."""
+    import numpy as np
+    from .core.selected_rows import SelectedRows
+    bad = []
+    for group in (new_state, fetches):
+        for name, v in group.items():
+            if isinstance(v, SelectedRows):
+                v = v.values
+            arr = np.asarray(v)
+            if arr.dtype.kind == 'f' and not np.isfinite(arr).all():
+                bad.append(name)
+    if bad:
+        raise RuntimeError(
+            "FLAGS_check_nan_inf: NaN/Inf detected in %s after executor "
+            "run" % sorted(set(bad)))
+
+
 def _run_key(random_seed, program_runs, global_counter):
     """PRNG base key for one executor run.
 
@@ -311,6 +332,12 @@ class Executor(object):
         key_arr = _run_key(program.random_seed, _next_program_run(program),
                            self._run_counter)
         fetches, new_state = entry.fn(feed, ro_state, rw_state, key_arr)
+        from . import flags as _flags
+        if _flags.get_flags('check_nan_inf'):
+            _check_nan_inf(new_state, dict(zip(entry.fetch_names, fetches)))
+        if _flags.get_flags('benchmark'):
+            import jax
+            jax.block_until_ready(fetches)
         scope.update(new_state)
         # propagate LoD of written persistables into the scope, and of
         # fetches into the returned tensors

@@ -1,0 +1,83 @@
+"""Env-var flag tier (reference python/paddle/fluid/__init__.py:127-167:
+~30 gflags surfaced via FLAGS_* environment variables read at import,
+core.init_gflags pybind.cc:845).
+
+TPU-native set: the GPU/MKL allocator and cuDNN knobs have no analog (XLA
+owns memory and kernels); what remains is the debugging/determinism tier:
+
+- FLAGS_check_nan_inf      scan every run's outputs/state for NaN/Inf and
+                           raise naming the variable (operator.cc:973 analog)
+- FLAGS_debug_nans         enable jax debug_nans (trap at the producing op
+                           inside the compiled program)
+- FLAGS_cpu_deterministic  accepted for API parity (XLA:TPU/CPU reductions
+                           are already run-to-run deterministic for a fixed
+                           compiled program; there is no runtime knob to set)
+- FLAGS_benchmark          sync after every executor run (honest timings)
+- FLAGS_eager_delete_tensor_gb accepted for API parity (XLA buffer liveness
+                           subsumes eager deletion)
+- FLAGS_paddle_num_threads accepted for API parity (host threading is
+                           XLA-managed)
+"""
+import os
+
+__all__ = ['get_flags', 'set_flags']
+
+_BOOL = ('check_nan_inf', 'debug_nans', 'cpu_deterministic', 'benchmark')
+_FLOAT = ('eager_delete_tensor_gb',)
+_INT = ('paddle_num_threads',)
+
+_flags = {}
+
+
+def _parse_bool(s):
+    return str(s).strip().lower() in ('1', 'true', 'yes', 'on')
+
+
+def _load_env():
+    for name in _BOOL:
+        v = os.environ.get('FLAGS_' + name)
+        _flags[name] = _parse_bool(v) if v is not None else False
+    for name in _FLOAT:
+        v = os.environ.get('FLAGS_' + name)
+        _flags[name] = float(v) if v else 0.0
+    for name in _INT:
+        v = os.environ.get('FLAGS_' + name)
+        _flags[name] = int(v) if v else 0
+    _apply_side_effects()
+
+
+def _apply_side_effects():
+    import jax
+    jax.config.update('jax_debug_nans', bool(_flags.get('debug_nans')))
+
+
+def get_flags(name=None):
+    """Value of one flag, or a copy of the whole flag dict."""
+    if name is None:
+        return dict(_flags)
+    name = name[6:] if name.startswith('FLAGS_') else name
+    if name not in _flags:
+        raise KeyError("unknown flag %r (known: %s)"
+                       % (name, sorted(_flags)))
+    return _flags[name]
+
+
+def set_flags(flags_or_name, value=None):
+    """set_flags({'FLAGS_check_nan_inf': True}) or
+    set_flags('check_nan_inf', True)."""
+    if isinstance(flags_or_name, dict):
+        items = flags_or_name.items()
+    else:
+        items = [(flags_or_name, value)]
+    for name, v in items:
+        name = name[6:] if name.startswith('FLAGS_') else name
+        if name not in _flags:
+            raise KeyError("unknown flag %r (known: %s)"
+                           % (name, sorted(_flags)))
+        if name in _BOOL:
+            v = _parse_bool(v) if not isinstance(v, bool) else v
+        _flags[name] = v
+    _apply_side_effects()
+
+
+_load_env()

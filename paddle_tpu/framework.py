@@ -147,6 +147,7 @@ class Variable(object):
     def __rtruediv__(self, o): return self._binary(o, 'elementwise_div', True)
     __div__ = __truediv__
     def __pow__(self, o): return self._binary(o, 'elementwise_pow')
+    def __rpow__(self, o): return self._binary(o, 'elementwise_pow', True)
     def __neg__(self): return self._binary(-1.0, 'elementwise_mul')
     def __lt__(self, o): return self._binary(o, 'less_than')
     def __le__(self, o): return self._binary(o, 'less_equal')

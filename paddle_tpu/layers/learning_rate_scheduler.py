@@ -98,16 +98,37 @@ def cosine_decay(learning_rate, step_each_epoch, epochs):
 
 
 def linear_lr_warmup(learning_rate, warmup_steps, start_lr, end_lr):
+    """Linear warmup into a base rate (reference
+    learning_rate_scheduler.py linear_lr_warmup). `learning_rate` may be a
+    float or another schedule's Variable (e.g. noam/exponential decay)."""
     step = _decay_step_counter()
-    if not isinstance(learning_rate, float):
-        raise NotImplementedError(
-            "linear_lr_warmup over a schedule variable lands with "
-            "control-flow stage")
     before = tensor.cast(step < float(warmup_steps), 'float32')
     warm = start_lr + (end_lr - start_lr) * step / float(warmup_steps)
     return before * warm + (1.0 - before) * learning_rate
 
 
 def append_LARS(params_grads, learning_rate, weight_decay):
-    raise NotImplementedError(
-        "use optimizer.LarsMomentumOptimizer (lars_momentum op) instead")
+    """Layer-wise adaptive rate scaling appended as ops (reference
+    learning_rate_scheduler.py:310): replaces each parameter's local lr with
+    lr * ||p|| / (||g|| + weight_decay * ||p||). The decayed lr Variable is
+    stored in param.optimize_attr['learning_rate'], which the optimizer's
+    _create_param_lr consumes."""
+    from . import nn as _nn
+    from . import ops as _lops
+
+    def _balanced_weight(param_norm, grad_norm):
+        if weight_decay == 1.0:
+            return grad_norm + param_norm
+        return grad_norm + weight_decay * param_norm
+
+    for param, grad in params_grads:
+        param_lr = param.optimize_attr.get('learning_rate', 1.0)
+        param_norm = _lops.sqrt(_nn.reduce_sum(_lops.square(param)))
+        grad_norm = _lops.sqrt(_nn.reduce_sum(_lops.square(grad)))
+        if isinstance(param_lr, float) and param_lr == 1.0:
+            decayed_lr = learning_rate * param_norm / \
+                _balanced_weight(param_norm, grad_norm)
+        else:
+            decayed_lr = learning_rate * param_lr * param_norm / \
+                _balanced_weight(param_norm, grad_norm)
+        param.optimize_attr['learning_rate'] = decayed_lr

@@ -65,6 +65,9 @@ class Optimizer(object):
         param = param_and_grad[0]
         param_lr = getattr(param, 'optimize_attr', {}).get(
             'learning_rate', 1.0)
+        if isinstance(param_lr, Variable):
+            # per-param lr Variable installed by e.g. append_LARS
+            return param_lr
         base = self._global_learning_rate
         if param_lr == 1.0:
             return base

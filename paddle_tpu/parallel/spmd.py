@@ -98,6 +98,10 @@ class DataParallelRunner(object):
         needed = Executor._read_before_write(program, read, written,
                                              set(feed), fetch_names)
         lower_params, reduce_mode = self._strategy_knobs()
+        bs = self._build_strategy
+        if bs is not None and getattr(bs, 'debug_graphviz_path', ''):
+            from ..debugger import draw_block_graphviz
+            draw_block_graphviz(program, bs.debug_graphviz_path)
         fn, ro_names, rw_names = lowering.build_fn(
             program, fetch_names, needed, written,
             lower_params=lower_params)
@@ -198,6 +202,15 @@ class DataParallelRunner(object):
                                               key_arr)
         finally:
             _papi._ACTIVE_MESH = prev
+        from .. import flags as _flags
+        if _flags.get_flags('check_nan_inf'):
+            from ..executor import _check_nan_inf
+            _check_nan_inf(
+                {n: self._fetch_to_host(v) for n, v in new_state.items()},
+                dict(zip(fetch_names,
+                         [self._fetch_to_host(f) for f in fetches])))
+        if _flags.get_flags('benchmark'):
+            jax.block_until_ready(fetches)
         scope.update(new_state)
         if return_numpy:
             return [self._fetch_to_host(f) for f in fetches]
@@ -215,10 +228,8 @@ class DataParallelRunner(object):
         for s in f.addressable_shards:      # dedupe replicas by index
             uniq.setdefault(s.index, s.data)
         if len(uniq) == 1:
-            data = next(iter(uniq.values()))
-            if data.shape == f.shape:       # replicated
-                return np.asarray(data)
-            return np.asarray(data)         # single local shard
+            # replicated value, or the single shard this process owns
+            return np.asarray(next(iter(uniq.values())))
         idxs = list(uniq)
         varying = [d for d in range(len(f.shape))
                    if len({(ix[d].start, ix[d].stop) for ix in idxs}) > 1]

@@ -30,26 +30,25 @@ def reset_profiler():
 
 
 def start_profiler(state='All', tracer_option=None, trace_dir=None):
+    """Errors from the device tracer propagate — a typo'd trace dir must
+    fail loudly, not produce a silently empty profile."""
     global _active, _trace_dir
     _active = True
-    _trace_dir = trace_dir
     if trace_dir:
-        try:
-            import jax
-            jax.profiler.start_trace(trace_dir)
-        except Exception:
-            pass
+        import jax
+        jax.profiler.start_trace(trace_dir)
+    # record only after a successful start so a failed start doesn't make
+    # stop_profiler call stop_trace on a trace that never began
+    _trace_dir = trace_dir
 
 
 def stop_profiler(sorted_key=None, profile_path='/tmp/profile'):
-    global _active
+    global _active, _trace_dir
     _active = False
     if _trace_dir:
-        try:
-            import jax
-            jax.profiler.stop_trace()
-        except Exception:
-            pass
+        import jax
+        _trace_dir = None
+        jax.profiler.stop_trace()
     export_chrome_tracing(profile_path)
 
 

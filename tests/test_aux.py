@@ -1,0 +1,196 @@
+"""Aux hardening: flags tier, NaN/Inf check, graphviz dump, profiler,
+LR scheduler completions (reference __init__.py:127-167 env flags,
+operator.cc:973 check_nan_inf, ir/graph_viz_pass.cc, profiler.py,
+learning_rate_scheduler.py linear_lr_warmup/append_LARS)."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+class TestFlags(object):
+    def test_get_set_roundtrip(self):
+        assert fluid.get_flags('check_nan_inf') is False
+        fluid.set_flags('FLAGS_check_nan_inf', True)
+        try:
+            assert fluid.get_flags('check_nan_inf') is True
+        finally:
+            fluid.set_flags('check_nan_inf', False)
+
+    def test_unknown_flag_raises(self):
+        with pytest.raises(KeyError, match="unknown flag"):
+            fluid.get_flags('no_such_flag')
+        with pytest.raises(KeyError):
+            fluid.set_flags({'FLAGS_bogus': 1})
+
+    def test_env_parsing(self):
+        from paddle_tpu import flags as F
+        assert F._parse_bool('1') and F._parse_bool('True') \
+            and F._parse_bool('on')
+        assert not F._parse_bool('0') and not F._parse_bool('false')
+
+
+class TestCheckNanInf(object):
+    def test_nan_detected_and_named(self):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        z = fluid.layers.elementwise_div(
+            x, fluid.layers.fill_constant([4], 'float32', 0.0))
+        out = fluid.layers.reduce_sum(z)
+        exe = fluid.Executor(fluid.CPUPlace())
+        fluid.set_flags('check_nan_inf', True)
+        try:
+            with pytest.raises(RuntimeError, match="NaN/Inf"):
+                exe.run(feed={'x': np.ones((1, 4), np.float32)},
+                        fetch_list=[out])
+        finally:
+            fluid.set_flags('check_nan_inf', False)
+
+    def test_clean_run_passes(self):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        out = fluid.layers.reduce_sum(x)
+        exe = fluid.Executor(fluid.CPUPlace())
+        fluid.set_flags('check_nan_inf', True)
+        try:
+            r, = exe.run(feed={'x': np.ones((1, 4), np.float32)},
+                         fetch_list=[out])
+            assert float(np.asarray(r).reshape(())) == 4.0
+        finally:
+            fluid.set_flags('check_nan_inf', False)
+
+
+class TestGraphviz(object):
+    def test_dot_dump(self, tmp_path):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        y = fluid.layers.fc(x, size=3, act='relu')
+        loss = fluid.layers.mean(y)
+        fluid.optimizer.SGD(0.1).minimize(loss)
+        path = str(tmp_path / "prog.dot")
+        out = fluid.debugger.draw_block_graphviz(
+            fluid.default_main_program(), path)
+        assert out == path
+        dot = open(path).read()
+        assert dot.startswith('digraph')
+        for op_name in ('mul', 'relu', 'mean', 'backward', 'sgd'):
+            assert op_name in dot, "missing op %s in dot" % op_name
+        # parameters shaded
+        assert 'lightblue' in dot
+
+    def test_sub_block_cluster(self, tmp_path):
+        from paddle_tpu.layers import control_flow
+        i = fluid.layers.fill_constant([1], 'int64', 0)
+        n = fluid.layers.fill_constant([1], 'int64', 3)
+        arr = fluid.layers.create_array('float32')
+        w = control_flow.While(cond=fluid.layers.less_than(i, n))
+        with w.block():
+            fluid.layers.array_write(
+                fluid.layers.cast(i, 'float32'), i=i, array=arr)
+            fluid.layers.increment(i, in_place=True)
+            control_flow.less_than(i, n, cond=w.cond_var)
+        dot = fluid.debugger.program_to_dot(fluid.default_main_program())
+        assert 'cluster_' in dot and 'while' in dot
+
+
+class TestProfiler(object):
+    def test_host_spans_and_chrome_trace(self, tmp_path):
+        x = fluid.layers.data(name='x', shape=[8], dtype='float32')
+        out = fluid.layers.reduce_sum(fluid.layers.fc(x, size=4))
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        path = str(tmp_path / "profile")
+        with fluid.profiler.profiler('All', profile_path=path):
+            with fluid.profiler.record_event('custom_span'):
+                exe.run(feed={'x': np.ones((2, 8), np.float32)},
+                        fetch_list=[out])
+        data = json.load(open(path))
+        names = [e.get('name') for e in data.get('traceEvents', data)]
+        assert any('custom_span' in str(n) for n in names)
+
+    def test_tracer_errors_propagate(self, tmp_path):
+        """Device-tracer errors must not be swallowed (double-start is
+        illegal in jax.profiler)."""
+        d = str(tmp_path / "t1")
+        fluid.profiler.start_profiler(trace_dir=d)
+        try:
+            with pytest.raises(Exception):
+                fluid.profiler.start_profiler(trace_dir=d)
+        finally:
+            fluid.profiler.stop_profiler(
+                profile_path=str(tmp_path / "p.json"))
+
+
+class TestLRSchedulerCompletions(object):
+    def test_linear_warmup_over_schedule_variable(self):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        loss = fluid.layers.mean(fluid.layers.fc(x, size=2))
+        base = fluid.layers.exponential_decay(
+            learning_rate=0.1, decay_steps=10, decay_rate=0.5,
+            staircase=True)
+        lr = fluid.layers.linear_lr_warmup(
+            base, warmup_steps=5, start_lr=0.0, end_lr=0.1)
+        opt = fluid.optimizer.SGD(learning_rate=lr)
+        opt.minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        lrs = []
+        for _ in range(8):
+            v, = exe.run(feed={'x': np.ones((2, 4), np.float32)},
+                         fetch_list=[lr])
+            lrs.append(float(np.asarray(v).reshape(())))
+        # warmup phase is linear from 0
+        np.testing.assert_allclose(lrs[:5],
+                                   [0.0, 0.02, 0.04, 0.06, 0.08],
+                                   atol=1e-6)
+        # after warmup: the base schedule value
+        assert abs(lrs[6] - 0.1) < 1e-6
+
+    def test_append_lars(self):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+        pred = fluid.layers.fc(x, size=1)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(pred, y))
+        opt = fluid.optimizer.SGD(learning_rate=0.1)
+        params_grads = opt.backward(loss)
+        fluid.layers.append_LARS(params_grads, learning_rate=0.1,
+                                 weight_decay=0.01)
+        opt.apply_gradients(params_grads)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        rng = np.random.RandomState(0)
+        X = rng.randn(16, 4).astype('float32')
+        Y = (X.sum(1, keepdims=True) * 0.5).astype('float32')
+        losses = []
+        for _ in range(10):
+            l, = exe.run(feed={'x': X, 'y': Y}, fetch_list=[loss])
+            losses.append(float(np.asarray(l).reshape(())))
+        assert all(np.isfinite(v) for v in losses)
+        assert losses[-1] < losses[0]
+
+
+class TestFlagsUnderDataParallel(object):
+    def test_check_nan_inf_in_dp_runner(self):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data(name='x', shape=[8], dtype='float32')
+            z = fluid.layers.elementwise_div(
+                x, fluid.layers.fill_constant([8], 'float32', 0.0))
+            out = fluid.layers.mean(z)
+        exe = fluid.Executor(fluid.CPUPlace())
+        compiled = fluid.CompiledProgram(main).with_data_parallel()
+        fluid.set_flags('check_nan_inf', True)
+        try:
+            with pytest.raises(RuntimeError, match="NaN/Inf"):
+                exe.run(compiled, feed={'x': np.ones((8, 8), np.float32)},
+                        fetch_list=[out])
+        finally:
+            fluid.set_flags('check_nan_inf', False)
+
+    def test_debug_nans_flag_toggles_jax_config(self):
+        import jax
+        fluid.set_flags('debug_nans', True)
+        assert jax.config.jax_debug_nans
+        fluid.set_flags('debug_nans', False)
+        assert not jax.config.jax_debug_nans
