@@ -4,3 +4,5 @@ Currently: mixed_precision (the TPU bf16 analog of
 reference paddle/contrib/float16/float16_transpiler.py), slim quantization.
 """
 from . import mixed_precision  # noqa: F401
+from . import quantize  # noqa: F401
+from .quantize import QuantizeTranspiler  # noqa: F401

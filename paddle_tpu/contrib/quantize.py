@@ -1,0 +1,230 @@
+"""Quantization-aware training transpiler (reference
+python/paddle/fluid/contrib/quantize/quantize_transpiler.py
+QuantizeTranspiler + contrib/slim/quantization/quantization_pass.py).
+
+Program rewrite: before every quantizable op (mul / conv2d /
+depthwise_conv2d), each input is routed through fake_quantize ->
+fake_dequantize, simulating int-N precision while training stays fp32.
+Gradients flow via the straight-through estimator inside the quant ops
+(ops/quant_ops.py), so the fp32 master weights keep training — the same
+net effect as the reference routing grad ops around the quant pair.
+"""
+import numpy as np
+
+from ..framework import default_main_program, default_startup_program
+from ..core.types import VarType
+
+__all__ = ['QuantizeTranspiler']
+
+_QUANTIZABLE_OP_TYPES = ('mul', 'conv2d', 'depthwise_conv2d')
+
+
+def _quantized_var_name(name):
+    return "%s.quantized" % name
+
+
+def _dequantized_var_name(name):
+    return "%s.dequantized" % name
+
+
+def _scale_name(name):
+    return "%s.scale" % name
+
+
+class QuantizeTranspiler(object):
+    def __init__(self, weight_bits=8, activation_bits=8,
+                 activation_quantize_type='abs_max',
+                 weight_quantize_type='abs_max', window_size=10000):
+        quant_types = ('abs_max', 'range_abs_max')
+        if weight_quantize_type not in quant_types:
+            raise ValueError("Unknown weight_quantize_type: %r"
+                             % (weight_quantize_type,))
+        if activation_quantize_type not in quant_types:
+            raise ValueError("Unknown activation_quantize_type: %r"
+                             % (activation_quantize_type,))
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.weight_quantize_type = weight_quantize_type
+        self.activation_quantize_type = activation_quantize_type
+        self.window_size = window_size
+        self.is_test = False
+
+    # ------------------------------------------------------------------
+    def training_transpile(self, program=None, startup_program=None):
+        """Insert fake quant/dequant pairs in front of quantizable ops
+        (reference training_transpile). Must run BEFORE
+        optimizer.minimize: the backward meta-op then differentiates the
+        rewritten forward, giving STE gradients to the fp32 weights."""
+        self.is_test = False
+        program = program if program is not None else \
+            default_main_program()
+        startup = startup_program if startup_program is not None else \
+            default_startup_program()
+
+        if any(op.type == 'backward'
+               for block in program.blocks for op in block.ops):
+            raise ValueError(
+                "QuantizeTranspiler.training_transpile must be applied "
+                "before optimizer.minimize()/append_backward()")
+
+        params = set(p.name for p in program.all_parameters())
+        for block in program.blocks:   # sub-blocks too (While/cond bodies)
+            dequanted = {}
+            i = 0
+            while i < len(block.ops):
+                op = block.ops[i]
+                if op.type in _QUANTIZABLE_OP_TYPES:
+                    for name in list(op.input_arg_names):
+                        if name not in dequanted:
+                            is_w = name in params
+                            bits = self.weight_bits if is_w else \
+                                self.activation_bits
+                            qtype = self.weight_quantize_type if is_w \
+                                else self.activation_quantize_type
+                            n_ins = self._insert_quant_dequant(
+                                program, startup, block, i, name, bits,
+                                qtype)
+                            dequanted[name] = _dequantized_var_name(name)
+                            i += n_ins
+                        op._rename_input(name, dequanted[name])
+                i += 1
+        program._bump_version()
+        return program
+
+    def _insert_quant_dequant(self, program, startup, block, idx, name,
+                              bits, qtype):
+        """Insert the pair at block.ops[idx]; returns #ops inserted."""
+        src = block._find_var_recursive(name)
+        qname = _quantized_var_name(name)
+        dqname = _dequantized_var_name(name)
+        sname = _scale_name(name)
+        qv = block.create_var(name=qname, dtype=src.dtype,
+                              shape=src.shape)
+        sv = block.create_var(name=sname, dtype=src.dtype, shape=(1,))
+        dqv = block.create_var(name=dqname, dtype=src.dtype,
+                               shape=src.shape)
+        bin_cnt = (1 << (bits - 1)) - 1
+        n = 0
+        if qtype == 'abs_max':
+            block._insert_op(
+                idx, type='fake_quantize_abs_max', inputs={'X': [name]},
+                outputs={'Out': [qname], 'OutScale': [sname]},
+                attrs={'bit_length': bits})
+            n += 1
+        else:
+            n += self._insert_range_quant(program, startup, block, idx,
+                                          name, qname, sname, bits)
+        block._insert_op(
+            idx + n, type='fake_dequantize_max_abs',
+            inputs={'X': [qname], 'Scale': [sname]},
+            outputs={'Out': [dqname]},
+            attrs={'max_range': float(bin_cnt)})
+        return n + 1
+
+    def _insert_range_quant(self, program, startup, block, idx, name,
+                            qname, sname, bits):
+        """range_abs_max needs persistable scale state + a step counter
+        (reference _create_global_step + InScale/OutScales plumbing)."""
+        from ..layer_helper import LayerHelper
+        from ..initializer import Constant
+        in_scale = block.create_var(
+            name="%s.in_scale" % name, dtype='float32', shape=(1,),
+            persistable=True)
+        scales = block.create_var(
+            name="%s.scales" % name, dtype='float32',
+            shape=(self.window_size,), persistable=True)
+        it = block.create_var(
+            name="%s.iter" % name, dtype='int64', shape=(1,),
+            persistable=True)
+        # init state in the startup program
+        sgb = startup.global_block()
+        for v, value, dtype, shape in (
+                (in_scale, 1e-8, 'float32', (1,)),
+                (scales, 0.0, 'float32', (self.window_size,)),
+                (it, 0, 'int64', (1,))):
+            sgb.create_var(name=v.name, dtype=dtype, shape=shape,
+                           persistable=True)
+            sgb.append_op(type='fill_constant', outputs={'Out': [v.name]},
+                          attrs={'shape': list(shape), 'dtype': dtype,
+                                 'value': value})
+        # advance the counter, then quantize (reads pre-increment value)
+        block._insert_op(
+            idx, type='increment', inputs={'X': [it.name]},
+            outputs={'Out': [it.name]}, attrs={'step': 1.0})
+        block._insert_op(
+            idx + 1, type='fake_quantize_range_abs_max',
+            inputs={'X': [name], 'InScale': [in_scale.name],
+                    'Iter': [it.name], 'OutScales': [scales.name]},
+            outputs={'Out': [qname], 'OutScale': [in_scale.name],
+                     'OutScales': [scales.name]},
+            attrs={'bit_length': bits, 'window_size': self.window_size,
+                   'is_test': False})
+        # expose the fresh scale under the dequant's expected name
+        block._insert_op(
+            idx + 2, type='assign', inputs={'X': [in_scale.name]},
+            outputs={'Out': [sname]})
+        return 3
+
+    # ------------------------------------------------------------------
+    def freeze_program(self, program, place=None, scope=None):
+        """Inference rewrite (reference freeze_program, simplified for the
+        static-LoD/XLA design): switch range_abs_max quant ops to is_test
+        (use the learned running scale, no state updates) and strip the
+        training-only state machinery — the step-counter increments and
+        window buffers — so inference is idempotent. The quant/dequant
+        simulation stays in the graph, so the exported model reproduces
+        quantized numerics exactly."""
+        iter_names = set()
+        for block in program.blocks:
+            for op in block.ops:
+                if op.type == 'fake_quantize_range_abs_max':
+                    op.set_attr('is_test', True)
+                    # is_test reads InScale only
+                    op.outputs.pop('OutScales', None)
+                    op.inputs.pop('OutScales', None)
+                    for n in op.inputs.pop('Iter', []):
+                        iter_names.add(n)
+        for block in program.blocks:
+            block.ops = [
+                op for op in block.ops
+                if not (op.type == 'increment'
+                        and op.output_arg_names
+                        and op.output_arg_names[0] in iter_names)]
+        program._is_test = True
+        program._bump_version()
+        return program
+
+    def convert_to_int8(self, program, place=None, scope=None):
+        """Quantize the weights of quantizable ops to int8 (reference
+        convert_to_int8): w_int8 = round(w / scale * bin_cnt). Returns
+        {param_name: (int8 ndarray, float scale)} — the scale travels with
+        the blob so consumers can reconstruct w ≈ int8 * scale / bin_cnt.
+        Biases and params of non-quantizable ops are left fp32 (training
+        never simulated their quantization)."""
+        from ..executor import global_scope
+        scope = scope if scope is not None else global_scope()
+        # only params consumed by quantizable ops (their quant pair was
+        # trained); note the transpiled program feeds them via the
+        # '.dequantized' alias, so match on the original name
+        quantized_params = set()
+        params = set(p.name for p in program.all_parameters())
+        for block in program.blocks:
+            for op in block.ops:
+                if op.type in _QUANTIZABLE_OP_TYPES:
+                    for n in op.input_arg_names:
+                        base = n[:-len('.dequantized')] \
+                            if n.endswith('.dequantized') else n
+                        if base in params:
+                            quantized_params.add(base)
+        out = {}
+        bin_cnt = (1 << (self.weight_bits - 1)) - 1
+        for name in sorted(quantized_params):
+            w = scope.get(name)
+            if w is None:
+                continue
+            w = np.asarray(w)
+            scale = float(np.max(np.abs(w))) or 1.0
+            blob = np.clip(np.round(w / scale * bin_cnt),
+                           -bin_cnt - 1, bin_cnt).astype(np.int8)
+            out[name] = (blob, scale)
+        return out

@@ -14,20 +14,29 @@ def _esc(s):
     return str(s).replace('"', '\\"')
 
 
-def program_to_dot(program, max_vars=500):
+def program_to_dot(program, max_vars=500, highlights=None):
     """DOT source for the whole program (block 0 + sub-blocks as
-    clusters)."""
+    clusters). At most max_vars variable nodes are emitted (edges to
+    elided vars are dropped, with a truncation note); names in
+    `highlights` are filled red."""
+    highlights = set(highlights or ())
     lines = ['digraph Program {', '  rankdir=TB;',
              '  node [fontsize=10];']
     emitted_vars = set()
+    truncated = [False]
 
     def emit_var(block, name, indent):
         key = 'var_%d_%s' % (block.idx, name)
         if key in emitted_vars:
             return key
+        if len(emitted_vars) >= max_vars:
+            truncated[0] = True
+            return None
         emitted_vars.add(key)
         v = block._find_var_recursive(name)
-        if isinstance(v, Parameter):
+        if name in highlights:
+            style = 'style=filled fillcolor=red shape=ellipse'
+        elif isinstance(v, Parameter):
             style = 'style=filled fillcolor=lightblue shape=ellipse'
         elif v is not None and v.persistable:
             style = 'style=filled fillcolor=lightgrey shape=ellipse'
@@ -46,10 +55,12 @@ def program_to_dot(program, max_vars=500):
                                                 _esc(op.type)))
             for name in op.input_arg_names:
                 vk = emit_var(block, name, indent)
-                lines.append('%s"%s" -> "%s";' % (indent, vk, op_key))
+                if vk is not None:
+                    lines.append('%s"%s" -> "%s";' % (indent, vk, op_key))
             for name in op.output_arg_names:
                 vk = emit_var(block, name, indent)
-                lines.append('%s"%s" -> "%s";' % (indent, op_key, vk))
+                if vk is not None:
+                    lines.append('%s"%s" -> "%s";' % (indent, op_key, vk))
             sb = op.attrs.get('sub_block')
             if isinstance(sb, int):
                 lines.append('%ssubgraph cluster_%d {' % (indent, sb))
@@ -59,6 +70,9 @@ def program_to_dot(program, max_vars=500):
                 lines.append('%s}' % indent)
 
     emit_block(program.global_block())
+    if truncated[0]:
+        lines.append('  "truncated" [label="... %d-var limit reached" '
+                     'shape=note];' % max_vars)
     lines.append('}')
     return '\n'.join(lines)
 
@@ -68,7 +82,7 @@ def draw_block_graphviz(block_or_program, path='program.dot',
     """Write the DOT file (reference debugger.draw_block_graphviz). Accepts
     a Program or a Block (the block's program is drawn)."""
     program = getattr(block_or_program, 'program', block_or_program)
-    dot = program_to_dot(program)
+    dot = program_to_dot(program, highlights=highlights)
     with open(path, 'w') as f:
         f.write(dot)
     return path

@@ -46,9 +46,17 @@ def _load_env():
     _apply_side_effects()
 
 
+_debug_nans_touched = False
+
+
 def _apply_side_effects():
-    import jax
-    jax.config.update('jax_debug_nans', bool(_flags.get('debug_nans')))
+    # only drive jax_debug_nans when the user actually used the flag —
+    # never clobber a JAX_DEBUG_NANS / jax.config setting made outside
+    # this flag tier
+    global _debug_nans_touched
+    if _debug_nans_touched or 'FLAGS_debug_nans' in os.environ:
+        import jax
+        jax.config.update('jax_debug_nans', bool(_flags.get('debug_nans')))
 
 
 def get_flags(name=None):
@@ -69,6 +77,7 @@ def set_flags(flags_or_name, value=None):
         items = flags_or_name.items()
     else:
         items = [(flags_or_name, value)]
+    global _debug_nans_touched
     for name, v in items:
         name = name[6:] if name.startswith('FLAGS_') else name
         if name not in _flags:
@@ -76,6 +85,8 @@ def set_flags(flags_or_name, value=None):
                            % (name, sorted(_flags)))
         if name in _BOOL:
             v = _parse_bool(v) if not isinstance(v, bool) else v
+        if name == 'debug_nans':
+            _debug_nans_touched = True
         _flags[name] = v
     _apply_side_effects()
 

@@ -228,6 +228,18 @@ class Operator(object):
         self.attrs[name] = val
         self.block.program._bump_version()
 
+    def _rename_input(self, old, new):
+        """Replace input var name `old` with `new` in every slot
+        (reference Operator.rename_input; used by transpilers)."""
+        for slot, names in self.inputs.items():
+            self.inputs[slot] = [new if n == old else n for n in names]
+        self.block.program._bump_version()
+
+    def _rename_output(self, old, new):
+        for slot, names in self.outputs.items():
+            self.outputs[slot] = [new if n == old else n for n in names]
+        self.block.program._bump_version()
+
     has_attr = lambda self, name: name in self.attrs
 
     def to_string(self):

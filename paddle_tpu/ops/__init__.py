@@ -20,3 +20,4 @@ from . import control_flow_ops
 from . import crf_ctc_ops
 from . import detection_ops
 from . import vision_ops
+from . import quant_ops

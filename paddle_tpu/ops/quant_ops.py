@@ -1,0 +1,102 @@
+"""Fake-quantization ops for QAT (reference operators/fake_quantize_op.{cc,h}
+fake_quantize_abs_max / fake_quantize_range_abs_max /
+fake_dequantize_max_abs).
+
+TPU-native notes:
+- `round` has a zero gradient, so quantization uses a straight-through
+  estimator (round_ste: y + stop_grad(round(y) - y)) — exactly the training
+  semantics the reference achieves by routing grad ops around the quant ops
+  (quantize_transpiler.py _transpile_backward).
+- Scales are stop_gradient (the reference computes them outside AD).
+- range_abs_max's sliding scale window is functional state: InScale /
+  OutScales / Iter are persistable vars updated in the compiled step.
+"""
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op
+
+
+def _round_ste(x):
+    return x + lax.stop_gradient(jnp.round(x) - x)
+
+
+@register_op('fake_quantize_abs_max')
+def _fake_quantize_abs_max(ctx, op):
+    """Out = round(X / max|X| * bin_cnt) (integer-valued float), OutScale =
+    max|X| (reference FakeQuantizeAbsMaxKernel)."""
+    x = ctx.in1(op, 'X')
+    bit_length = op.attr('bit_length', 8)
+    bin_cnt = (1 << (bit_length - 1)) - 1
+    scale = lax.stop_gradient(jnp.max(jnp.abs(x)))
+    scale = jnp.maximum(scale, 1e-8)
+    out = _round_ste(x / scale * bin_cnt)
+    ctx.out(op, 'Out', out)
+    ctx.out(op, 'OutScale', scale.reshape(1))
+
+
+@register_op('fake_quantize_range_abs_max')
+def _fake_quantize_range_abs_max(ctx, op):
+    """Sliding-window max-abs scale (reference FindRangeAbsMaxFunctor):
+    scales_arr[iter % window] = cur; running max updated incrementally,
+    recomputed over the window when the evicted entry was the max."""
+    x = ctx.in1(op, 'X')
+    in_scale = ctx.in1(op, 'InScale').reshape(())
+    it = ctx.in1(op, 'Iter')
+    bit_length = op.attr('bit_length', 8)
+    window = op.attr('window_size', 10000)
+    is_test = op.attr('is_test', False)
+    bin_cnt = (1 << (bit_length - 1)) - 1
+
+    if is_test:
+        scale = lax.stop_gradient(in_scale)
+        out = _round_ste(jnp.clip(x, -scale, scale) / scale * bin_cnt)
+        ctx.out(op, 'Out', out)
+        ctx.out(op, 'OutScale', scale.reshape(1))
+        return
+
+    scales_arr = ctx.in1(op, 'OutScales')
+    cur = lax.stop_gradient(jnp.max(jnp.abs(x)))
+    it0 = (it.reshape(()) if it is not None else jnp.asarray(0)).astype(
+        jnp.int32)
+    idx = jnp.mod(it0, window)
+    removed = scales_arr.reshape(-1)[idx]
+    new_arr = scales_arr.reshape(-1).at[idx].set(cur)
+    size = jnp.minimum(jnp.maximum(it0, 1), window)
+    in_window = jnp.arange(new_arr.shape[0]) < size
+    window_max = jnp.max(jnp.where(in_window, new_arr, 0.0))
+    scale = jnp.where(
+        in_scale < cur, cur,
+        jnp.where(jnp.abs(removed - in_scale) < 1e-6, window_max, in_scale))
+    scale = jnp.maximum(lax.stop_gradient(scale), 1e-8)
+
+    out = _round_ste(jnp.clip(x, -scale, scale) / scale * bin_cnt)
+    ctx.out(op, 'Out', out)
+    ctx.out(op, 'OutScale', scale.reshape(1))
+    ctx.out(op, 'OutScales', new_arr.reshape(scales_arr.shape))
+
+
+@register_op('fake_dequantize_max_abs')
+def _fake_dequantize_max_abs(ctx, op):
+    """Out = X * Scale / max_range (reference FakeDequantizeMaxAbsKernel)."""
+    x = ctx.in1(op, 'X')
+    scale = ctx.in1(op, 'Scale').reshape(())
+    max_range = op.attr('max_range')
+    ctx.out(op, 'Out', x * lax.stop_gradient(scale) / max_range)
+
+
+@register_op('fake_channel_wise_quantize_abs_max')
+def _fake_channel_wise_quantize_abs_max(ctx, op):
+    """Per-output-channel (dim 0) abs-max quantization (reference
+    fake_channel_wise_quantize_abs_max, used for conv weights)."""
+    x = ctx.in1(op, 'X')
+    bit_length = op.attr('bit_length', 8)
+    bin_cnt = (1 << (bit_length - 1)) - 1
+    axes = tuple(range(1, x.ndim))
+    scale = lax.stop_gradient(jnp.max(jnp.abs(x), axis=axes))
+    scale = jnp.maximum(scale, 1e-8)
+    bshape = (-1,) + (1,) * (x.ndim - 1)
+    out = _round_ste(x / scale.reshape(bshape) * bin_cnt)
+    ctx.out(op, 'Out', out)
+    ctx.out(op, 'OutScale', scale)

@@ -33,13 +33,17 @@ def start_profiler(state='All', tracer_option=None, trace_dir=None):
     """Errors from the device tracer propagate — a typo'd trace dir must
     fail loudly, not produce a silently empty profile."""
     global _active, _trace_dir
-    _active = True
+    if _active:
+        # already profiling (reference start_profiler returns early when
+        # enabled) — don't clobber a running device trace
+        return
     if trace_dir:
         import jax
         jax.profiler.start_trace(trace_dir)
-    # record only after a successful start so a failed start doesn't make
-    # stop_profiler call stop_trace on a trace that never began
-    _trace_dir = trace_dir
+        # record only after a successful start so a failed start doesn't
+        # make stop_profiler call stop_trace on a trace that never began
+        _trace_dir = trace_dir
+    _active = True
 
 
 def stop_profiler(sorted_key=None, profile_path='/tmp/profile'):

@@ -108,17 +108,20 @@ class TestProfiler(object):
         names = [e.get('name') for e in data.get('traceEvents', data)]
         assert any('custom_span' in str(n) for n in names)
 
-    def test_tracer_errors_propagate(self, tmp_path):
-        """Device-tracer errors must not be swallowed (double-start is
-        illegal in jax.profiler)."""
+    def test_double_start_is_guarded(self, tmp_path):
+        """Reference start_profiler returns early when already enabled; the
+        running device trace must survive a second start and finalize."""
         d = str(tmp_path / "t1")
         fluid.profiler.start_profiler(trace_dir=d)
         try:
-            with pytest.raises(Exception):
-                fluid.profiler.start_profiler(trace_dir=d)
+            fluid.profiler.start_profiler()     # no-op, keeps the trace
+            assert fluid.profiler._trace_dir == d
         finally:
             fluid.profiler.stop_profiler(
                 profile_path=str(tmp_path / "p.json"))
+        assert fluid.profiler._trace_dir is None
+        import os as _os
+        assert _os.path.isdir(d)    # trace finalized on disk
 
 
 class TestLRSchedulerCompletions(object):

@@ -1,0 +1,153 @@
+"""QAT: fake-quant ops + QuantizeTranspiler (reference
+unittests test_fake_quantize_op.py + contrib test_quantize_transpiler.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.framework import Program, program_guard
+
+from test_detection_ops import _run_single_op
+
+
+class TestFakeQuantOps(object):
+    def test_abs_max_matches_numpy(self):
+        rng = np.random.RandomState(0)
+        x = (rng.randn(8, 6) * 3).astype(np.float32)
+        out, scale = _run_single_op(
+            'fake_quantize_abs_max', {'X': x},
+            {'Out': ['fq_out'], 'OutScale': ['fq_scale']},
+            {'bit_length': 8})
+        ref_scale = np.abs(x).max()
+        np.testing.assert_allclose(scale, [ref_scale], rtol=1e-6)
+        np.testing.assert_allclose(out, np.round(x / ref_scale * 127),
+                                   atol=1e-4)
+
+    def test_dequantize(self):
+        x = np.array([[-127., 0., 64.]], np.float32)
+        scale = np.array([2.0], np.float32)
+        out, = _run_single_op(
+            'fake_dequantize_max_abs', {'X': x, 'Scale': scale},
+            {'Out': ['fdq_out']}, {'max_range': 127.0})
+        np.testing.assert_allclose(out, x * 2.0 / 127.0, rtol=1e-6)
+
+    def test_quant_dequant_roundtrip_error_bound(self):
+        rng = np.random.RandomState(1)
+        x = rng.randn(32).astype(np.float32)
+        out, scale = _run_single_op(
+            'fake_quantize_abs_max', {'X': x},
+            {'Out': ['fq2_out'], 'OutScale': ['fq2_scale']},
+            {'bit_length': 8})
+        deq = out * scale[0] / 127.0
+        assert np.abs(deq - x).max() <= scale[0] / 127.0 / 2 + 1e-6
+
+    def test_channel_wise(self):
+        rng = np.random.RandomState(2)
+        x = rng.randn(4, 3, 2, 2).astype(np.float32)
+        out, scale = _run_single_op(
+            'fake_channel_wise_quantize_abs_max', {'X': x},
+            {'Out': ['fcq_out'], 'OutScale': ['fcq_scale']},
+            {'bit_length': 8})
+        ref_scale = np.abs(x.reshape(4, -1)).max(1)
+        np.testing.assert_allclose(scale, ref_scale, rtol=1e-6)
+
+
+def _qat_mnist(quant_type, steps=25):
+    prog, startup = Program(), Program()
+    prog.random_seed = startup.random_seed = 5
+    with program_guard(prog, startup):
+        img = fluid.layers.data(name='img', shape=[64], dtype='float32')
+        label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+        h = fluid.layers.fc(img, size=32, act='relu')
+        pred = fluid.layers.fc(h, size=4, act='softmax')
+        loss = fluid.layers.mean(
+            fluid.layers.cross_entropy(pred, label))
+        t = fluid.contrib.QuantizeTranspiler(
+            activation_quantize_type=quant_type,
+            weight_quantize_type='abs_max', window_size=16)
+        t.training_transpile(prog, startup)
+        fluid.optimizer.Adam(0.01).minimize(loss)
+
+    rng = np.random.RandomState(0)
+    lab = rng.randint(0, 4, 128).astype('int64')
+    centers = rng.randn(4, 64).astype('float32') * 2
+    X = (centers[lab] + 0.5 * rng.randn(128, 64)).astype('float32')
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    losses = []
+    for _ in range(steps):
+        l, = exe.run(prog, feed={'img': X, 'label': lab.reshape(-1, 1)},
+                     fetch_list=[loss])
+        losses.append(float(np.asarray(l).reshape(())))
+    return prog, startup, losses, (X, lab), pred, exe, t, loss
+
+
+class TestQuantizeTranspiler(object):
+    def test_rewrite_inserts_pairs(self):
+        prog, startup = Program(), Program()
+        with program_guard(prog, startup):
+            img = fluid.layers.data(name='img', shape=[8], dtype='float32')
+            fluid.layers.fc(img, size=4)
+        fluid.contrib.QuantizeTranspiler().training_transpile(prog, startup)
+        types = [op.type for op in prog.global_block().ops]
+        assert types.count('fake_quantize_abs_max') == 2   # input + weight
+        assert types.count('fake_dequantize_max_abs') == 2
+        mul = [op for op in prog.global_block().ops
+               if op.type == 'mul'][0]
+        for n in mul.input_arg_names:
+            assert n.endswith('.dequantized')
+
+    def test_transpile_after_minimize_rejected(self):
+        prog, startup = Program(), Program()
+        with program_guard(prog, startup):
+            img = fluid.layers.data(name='img', shape=[8], dtype='float32')
+            loss = fluid.layers.mean(fluid.layers.fc(img, size=4))
+            fluid.optimizer.SGD(0.1).minimize(loss)
+        with pytest.raises(ValueError, match="before optimizer"):
+            fluid.contrib.QuantizeTranspiler().training_transpile(
+                prog, startup)
+
+    def test_qat_abs_max_converges(self):
+        _, _, losses, _, _, _, _, _ = _qat_mnist('abs_max')
+        assert losses[-1] < losses[0] * 0.5, losses
+
+    def test_qat_range_abs_max_converges_and_freezes(self, tmp_path):
+        prog, startup, losses, (X, lab), pred, exe, t, loss = \
+            _qat_mnist('range_abs_max')
+        assert losses[-1] < losses[0] * 0.5, losses
+        # learned running scale is positive
+        scale = None
+        for n in fluid.global_scope().names():
+            if n.endswith('.in_scale'):
+                scale = float(np.asarray(fluid.global_scope().get(n))[0])
+        assert scale is not None and scale > 0
+
+        # freeze: is_test quant ops use the running scale; export + reload
+        infer = prog.clone(for_test=True)
+        t.freeze_program(infer)
+        model_dir = str(tmp_path / "qat")
+        fluid.save_inference_model(model_dir, ['img'], [pred], exe,
+                                   main_program=infer)
+        scope2 = fluid.Scope()
+        with fluid.scope_guard(scope2):
+            prog2, names2, fetch2 = fluid.load_inference_model(
+                model_dir, exe)
+            out, = exe.run(prog2, feed={'img': X[:8]}, fetch_list=fetch2,
+                           scope=scope2)
+        assert np.isfinite(np.asarray(out)).all()
+        acc = (np.asarray(out).argmax(1) == lab[:8]).mean()
+        assert acc >= 0.75, acc
+
+    def test_convert_to_int8(self):
+        prog, startup, losses, _, _, exe, t, _ = _qat_mnist('abs_max',
+                                                            steps=5)
+        blobs = t.convert_to_int8(prog)
+        assert blobs, "no parameters converted"
+        scope = fluid.global_scope()
+        for name, (w, scale) in blobs.items():
+            assert w.dtype == np.int8
+            assert scale > 0
+            # blob + scale reconstructs the fp32 weight within one level
+            orig = np.asarray(scope.get(name))
+            recon = w.astype(np.float32) * scale / 127.0
+            assert np.abs(recon - orig).max() <= scale / 127.0 + 1e-6
