@@ -125,8 +125,6 @@ class QuantizeTranspiler(object):
                             qname, sname, bits):
         """range_abs_max needs persistable scale state + a step counter
         (reference _create_global_step + InScale/OutScales plumbing)."""
-        from ..layer_helper import LayerHelper
-        from ..initializer import Constant
         in_scale = block.create_var(
             name="%s.in_scale" % name, dtype='float32', shape=(1,),
             persistable=True)
@@ -147,12 +145,9 @@ class QuantizeTranspiler(object):
             sgb.append_op(type='fill_constant', outputs={'Out': [v.name]},
                           attrs={'shape': list(shape), 'dtype': dtype,
                                  'value': value})
-        # advance the counter, then quantize (reads pre-increment value)
+        # quantize with the 0-based step, then advance the counter
         block._insert_op(
-            idx, type='increment', inputs={'X': [it.name]},
-            outputs={'Out': [it.name]}, attrs={'step': 1.0})
-        block._insert_op(
-            idx + 1, type='fake_quantize_range_abs_max',
+            idx, type='fake_quantize_range_abs_max',
             inputs={'X': [name], 'InScale': [in_scale.name],
                     'Iter': [it.name], 'OutScales': [scales.name]},
             outputs={'Out': [qname], 'OutScale': [in_scale.name],
@@ -161,8 +156,11 @@ class QuantizeTranspiler(object):
                    'is_test': False})
         # expose the fresh scale under the dequant's expected name
         block._insert_op(
-            idx + 2, type='assign', inputs={'X': [in_scale.name]},
+            idx + 1, type='assign', inputs={'X': [in_scale.name]},
             outputs={'Out': [sname]})
+        block._insert_op(
+            idx + 2, type='increment', inputs={'X': [it.name]},
+            outputs={'Out': [it.name]}, attrs={'step': 1.0})
         return 3
 
     # ------------------------------------------------------------------

@@ -58,12 +58,15 @@ def _fake_quantize_range_abs_max(ctx, op):
 
     scales_arr = ctx.in1(op, 'OutScales')
     cur = lax.stop_gradient(jnp.max(jnp.abs(x)))
+    # Iter is the 0-based step count (the transpiler increments AFTER this
+    # op): slot k holds step k's scale, the window covers steps
+    # max(0, it-window+1)..it, i.e. min(it+1, window) live slots
     it0 = (it.reshape(()) if it is not None else jnp.asarray(0)).astype(
         jnp.int32)
     idx = jnp.mod(it0, window)
     removed = scales_arr.reshape(-1)[idx]
     new_arr = scales_arr.reshape(-1).at[idx].set(cur)
-    size = jnp.minimum(jnp.maximum(it0, 1), window)
+    size = jnp.minimum(it0 + 1, window)
     in_window = jnp.arange(new_arr.shape[0]) < size
     window_max = jnp.max(jnp.where(in_window, new_arr, 0.0))
     scale = jnp.where(

@@ -54,12 +54,13 @@ class ShardingRules(object):
 
 
 class _MeshEntry(object):
-    __slots__ = ('fn', 'ro_names', 'rw_names')
+    __slots__ = ('fn', 'ro_names', 'rw_names', 'lod_out')
 
-    def __init__(self, fn, ro_names, rw_names):
+    def __init__(self, fn, ro_names, rw_names, lod_out=None):
         self.fn = fn
         self.ro_names = ro_names
         self.rw_names = rw_names
+        self.lod_out = lod_out if lod_out is not None else {}
 
 
 class MeshRunner(object):
@@ -76,17 +77,24 @@ class MeshRunner(object):
     def _sharding(self, spec):
         return NamedSharding(self._mesh, spec)
 
-    def compile(self, feed_shapes, fetch_names, scope):
+    def compile(self, feed_shapes, fetch_names, scope, feed_lods=None):
         """feed_shapes: {name: (shape, dtype)}."""
         program = self._program
         read, written = lowering.analyze_state(program, fetch_names)
         from ..executor import Executor
         needed = Executor._read_before_write(
             program, read, written, set(feed_shapes), fetch_names)
+        feed_lods = dict(feed_lods or {})
+        lod_out = {}
         fn, ro_names, rw_names = lowering.build_fn(
-            program, fetch_names, needed, written)
+            program, fetch_names, needed, written,
+            static_lods=feed_lods, lod_out=lod_out)
         in_shardings = (
-            {k: self._sharding(self._feed_specs.get(k, P()))
+            # ragged (LoD) feeds are replicated: their row counts are
+            # per-sequence, not per-device-splittable; bucket+pad to dense
+            # (reader/bucketing.py, layers.sequence_pad) to shard them
+            {k: self._sharding(P() if k in feed_lods
+                               else self._feed_specs.get(k, P()))
              for k in feed_shapes},
             {n: self._sharding(self._rules.spec_for(n)) for n in ro_names},
             {n: self._sharding(self._rules.spec_for(n)) for n in rw_names},
@@ -98,7 +106,7 @@ class MeshRunner(object):
         )
         jitted = jax.jit(fn, in_shardings=in_shardings,
                          out_shardings=out_shardings, donate_argnums=(2,))
-        return jitted, ro_names, rw_names
+        return jitted, ro_names, rw_names, lod_out
 
     def run(self, feed, fetch_list, scope, return_numpy=True):
         from ..executor import global_scope, Executor
@@ -106,22 +114,17 @@ class MeshRunner(object):
             scope = global_scope()
         program = self._program
         exe = Executor()
-        feed, _feed_lods = exe._prepare_feed(program, feed or {})
-        if _feed_lods:
-            raise NotImplementedError(
-                "LoD (ragged) feeds are not supported by the mesh runners "
-                "yet — pad/bucket sequences (layers.sequence_pad) before "
-                "sharding them over the mesh")
+        feed, feed_lods = exe._prepare_feed(program, feed or {})
         fetch_names = [v.name if isinstance(v, Variable) else v
                        for v in (fetch_list or [])]
-        key = (program._version, exe._feed_signature(feed),
+        key = (program._version, exe._feed_signature(feed, feed_lods),
                tuple(fetch_names))
         entry = self._cache.get(key)
         if entry is None:
-            fn_, ro_, rw_ = self.compile(
+            fn_, ro_, rw_, lod_out_ = self.compile(
                 {k: (v.shape, v.dtype) for k, v in feed.items()},
-                fetch_names, scope)
-            entry = _MeshEntry(fn_, ro_, rw_)
+                fetch_names, scope, feed_lods=feed_lods)
+            entry = _MeshEntry(fn_, ro_, rw_, lod_out_)
             self._cache[key] = entry
         fn, ro_names, rw_names = entry.fn, entry.ro_names, entry.rw_names
         ro = {n: exe._state_value(scope, n, program) for n in ro_names}
@@ -138,6 +141,10 @@ class MeshRunner(object):
         finally:
             _ACTIVE_MESH = prev
         scope.update(new_state)
+        from ..executor import _fetched
         if return_numpy:
-            return [np.asarray(f) for f in fetches]
+            return [
+                _fetched(f, entry.lod_out[n])
+                if entry.lod_out.get(n) else np.asarray(f)
+                for n, f in zip(fetch_names, fetches)]
         return list(fetches)

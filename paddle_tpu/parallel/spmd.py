@@ -24,16 +24,17 @@ __all__ = ['DataParallelRunner']
 
 class _Entry(object):
     __slots__ = ('fn', 'ro_names', 'rw_names', 'written', 'feed_shardings',
-                 'state_shardings')
+                 'state_shardings', 'lod_out')
 
     def __init__(self, fn, ro_names, rw_names, written, feed_shardings,
-                 state_shardings):
+                 state_shardings, lod_out=None):
         self.fn = fn
         self.ro_names = ro_names
         self.rw_names = rw_names
         self.written = written
         self.feed_shardings = feed_shardings
         self.state_shardings = state_shardings
+        self.lod_out = lod_out if lod_out is not None else {}
 
 
 class DataParallelRunner(object):
@@ -91,7 +92,7 @@ class DataParallelRunner(object):
             return NamedSharding(mesh, P('data'))
         return NamedSharding(mesh, P())
 
-    def _compile(self, feed, fetch_names):
+    def _compile(self, feed, fetch_names, feed_lods=None):
         program = self._program
         read, written = lowering.analyze_state(program, fetch_names)
         from ..executor import Executor
@@ -102,13 +103,21 @@ class DataParallelRunner(object):
         if bs is not None and getattr(bs, 'debug_graphviz_path', ''):
             from ..debugger import draw_block_graphviz
             draw_block_graphviz(program, bs.debug_graphviz_path)
+        feed_lods = dict(feed_lods or {})
+        lod_out = {}
         fn, ro_names, rw_names = lowering.build_fn(
             program, fetch_names, needed, written,
+            static_lods=feed_lods, lod_out=lod_out,
             lower_params=lower_params)
         mesh = self._mesh
         repl = NamedSharding(mesh, P())
         batch_sharded = NamedSharding(mesh, P('data'))
-        feed_shardings = {k: batch_sharded for k in feed}
+        # ragged (LoD) feeds replicate: rows are per-sequence, not evenly
+        # splittable over devices (reference SplitLoDTensor splits by
+        # instance at feed time; the TPU path is bucket+pad to dense —
+        # reader/bucketing.py — when scaling matters)
+        feed_shardings = {k: (repl if k in feed_lods else batch_sharded)
+                          for k in feed}
         state_shard = {n: self._state_sharding(program, n, reduce_mode,
                                                mesh)
                        for n in set(ro_names) | set(rw_names) | set(written)}
@@ -123,19 +132,14 @@ class DataParallelRunner(object):
                          out_shardings=out_shardings,
                          donate_argnums=(2,))
         return _Entry(jitted, ro_names, rw_names, written, feed_shardings,
-                      state_shard)
+                      state_shard, lod_out)
 
     def run(self, executor, feed, fetch_list, scope, return_numpy):
         from ..executor import global_scope
         if scope is None:
             scope = global_scope()
         program = self._program
-        feed, _feed_lods = executor._prepare_feed(program, feed or {})
-        if _feed_lods:
-            raise NotImplementedError(
-                "LoD (ragged) feeds are not supported by the mesh runners "
-                "yet — pad/bucket sequences (layers.sequence_pad) before "
-                "sharding them over the mesh")
+        feed, feed_lods = executor._prepare_feed(program, feed or {})
         fetch_names = [v.name if isinstance(v, Variable) else v
                        for v in (fetch_list or [])]
         nproc = jax.process_count()
@@ -144,15 +148,18 @@ class DataParallelRunner(object):
         # is per local device count
         ndev = self.num_devices // nproc if nproc > 1 else self.num_devices
         for k, v in feed.items():
+            if k in feed_lods:
+                continue          # ragged feeds replicate (see _compile)
             if v.shape and v.shape[0] % max(ndev, 1) != 0:
                 raise ValueError(
                     "feed %r batch %d not divisible by %d mesh devices"
                     % (k, v.shape[0], ndev))
         key = (program._uid, program._version,
-               executor._feed_signature(feed), tuple(fetch_names))
+               executor._feed_signature(feed, feed_lods),
+               tuple(fetch_names))
         entry = self._cache.get(key)
         if entry is None:
-            entry = self._compile(feed, fetch_names)
+            entry = self._compile(feed, fetch_names, feed_lods=feed_lods)
             self._cache[key] = entry
 
         ro_state = {n: executor._state_value(scope, n, program)
@@ -212,8 +219,14 @@ class DataParallelRunner(object):
         if _flags.get_flags('benchmark'):
             jax.block_until_ready(fetches)
         scope.update(new_state)
+        from ..executor import _fetched
         if return_numpy:
-            return [self._fetch_to_host(f) for f in fetches]
+            out = []
+            for n, f in zip(fetch_names, fetches):
+                host = self._fetch_to_host(f)
+                lod = entry.lod_out.get(n)
+                out.append(_fetched(host, lod) if lod else host)
+            return out
         return list(fetches)
 
     @staticmethod

@@ -15,6 +15,7 @@ __all__ = ['cuda_profiler', 'reset_profiler', 'profiler', 'start_profiler',
 _events = []
 _active = False
 _trace_dir = None
+_depth = 0
 
 
 @contextlib.contextmanager
@@ -32,10 +33,12 @@ def reset_profiler():
 def start_profiler(state='All', tracer_option=None, trace_dir=None):
     """Errors from the device tracer propagate — a typo'd trace dir must
     fail loudly, not produce a silently empty profile."""
-    global _active, _trace_dir
+    global _active, _trace_dir, _depth
     if _active:
         # already profiling (reference start_profiler returns early when
-        # enabled) — don't clobber a running device trace
+        # enabled) — don't clobber a running device trace; the matching
+        # stop becomes a no-op via the depth counter
+        _depth += 1
         return
     if trace_dir:
         import jax
@@ -44,10 +47,16 @@ def start_profiler(state='All', tracer_option=None, trace_dir=None):
         # make stop_profiler call stop_trace on a trace that never began
         _trace_dir = trace_dir
     _active = True
+    _depth = 1
 
 
 def stop_profiler(sorted_key=None, profile_path='/tmp/profile'):
-    global _active, _trace_dir
+    global _active, _trace_dir, _depth
+    if not _active:
+        return
+    _depth -= 1
+    if _depth > 0:
+        return          # inner stop of a nested start pair: keep tracing
     _active = False
     if _trace_dir:
         import jax

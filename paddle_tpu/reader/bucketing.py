@@ -1,0 +1,112 @@
+"""Bucketing for ragged (LoD) batches: bounded XLA compile count.
+
+The static-LoD design (core/lod.py) binds ragged offsets at compile time, so
+every distinct ragged pattern is a new XLA program. Left unchecked, a real
+variable-length epoch would thrash the compile cache (one compile per batch).
+
+The remedy is CANONICAL padding: every sequence in the batch is padded to
+the same bucketed length and the batch to a bucketed sequence count, so the
+resulting LoD offsets are the uniform grid (0, L, 2L, ...). Two batches that
+land in the same (length bucket, count bucket) cell produce bit-identical
+LoD metadata, hence the same compiled program: the number of compiles is
+bounded by len(length_buckets) * len(count_buckets) per feed signature.
+(This is the standard TPU bucketed-padding recipe; the reference gets
+unbounded raggedness for free from its dynamic LoD runtime,
+lod_tensor.h:58.)
+
+Padding is real data as far as sequence ops are concerned, so the returned
+masks must gate the loss:
+- token_mask [total_padded, 1]: 1 for real rows;
+- seq_mask  [n_seqs_padded, 1]: 1 for real sequences.
+Multiply per-token losses by token_mask (and/or per-sequence losses by
+seq_mask) and normalize by the mask sum. See tests/test_bucketing.py for
+the NMT pattern.
+"""
+import numpy as np
+
+from ..core.lod import normalize_lod
+
+__all__ = ['bucketize', 'bucket_lod_batch', 'BucketedFeeder']
+
+
+def bucketize(value, buckets):
+    """Smallest bucket >= value; raises if value exceeds the last bucket."""
+    for b in buckets:
+        if value <= b:
+            return b
+    raise ValueError(
+        "value %d exceeds the largest bucket %d — add a larger bucket or "
+        "trim over-long sequences" % (value, buckets[-1]))
+
+
+def bucket_lod_batch(arr, lod, length_buckets, count_buckets=None,
+                     pad_value=0):
+    """Canonically pad one ragged (arr [total, ...], lod) batch.
+
+    Every sequence is padded to L = bucketize(max_seq_len, length_buckets)
+    rows and the batch to C = bucketize(n_seqs, count_buckets) sequences,
+    giving the uniform LoD (0, L, 2L, ..., C*L).
+
+    Returns (padded_arr [C*L, ...], padded_lod, token_mask [C*L],
+    seq_mask [C])."""
+    arr = np.asarray(arr)
+    lod = normalize_lod(lod)
+    if len(lod) > 1:
+        raise ValueError(
+            "bucket_lod_batch supports single-level LoD only (got %d "
+            "levels); flatten the nesting or bucket the outer level "
+            "yourself" % len(lod))
+    offsets = list(lod[-1])
+    n_real = len(offsets) - 1
+    lens = [offsets[i + 1] - offsets[i] for i in range(n_real)]
+    L = bucketize(max(lens) if lens else 1, length_buckets)
+    C = bucketize(n_real, count_buckets) if count_buckets else n_real
+
+    out = np.full((C * L,) + arr.shape[1:], pad_value, arr.dtype)
+    token_mask = np.zeros((C * L,), np.float32)
+    for i in range(n_real):
+        lo, hi = offsets[i], offsets[i + 1]
+        out[i * L:i * L + (hi - lo)] = arr[lo:hi]
+        token_mask[i * L:i * L + (hi - lo)] = 1.0
+    seq_mask = np.zeros((C,), np.float32)
+    seq_mask[:n_real] = 1.0
+    uniform = [L * i for i in range(C + 1)]
+    return out, [uniform], token_mask, seq_mask
+
+
+class BucketedFeeder(object):
+    """Pads every ragged slot of a feed dict onto one shared bucket grid,
+    bounding the epoch's compile count at
+    len(length_buckets) * len(count_buckets) per feed signature.
+
+    feeder = BucketedFeeder(length_buckets=[8, 16], count_buckets=[4, 8])
+    feed, token_masks, seq_masks = feeder.pad(
+        {'src': (arr, lod), 'dense': x})
+    """
+
+    def __init__(self, length_buckets, count_buckets=None, pad_value=0):
+        self.length_buckets = sorted(length_buckets)
+        self.count_buckets = sorted(count_buckets) if count_buckets \
+            else None
+        self.pad_value = pad_value
+
+    def pad(self, feed):
+        """feed: {name: array | (array, lod)}. Returns
+        (new_feed, token_masks, seq_masks)."""
+        out, token_masks, seq_masks = {}, {}, {}
+        for name, value in feed.items():
+            # accept LoDTensor-style objects too (anything with .lod())
+            lod_m = getattr(value, 'lod', None)
+            if callable(lod_m) and not isinstance(value, np.ndarray):
+                value = (np.asarray(value), lod_m())
+            if isinstance(value, tuple) and len(value) == 2:
+                arr, lod = value
+                arr2, lod2, tm, sm = bucket_lod_batch(
+                    arr, lod, self.length_buckets, self.count_buckets,
+                    self.pad_value)
+                out[name] = (arr2, lod2)
+                token_masks[name] = tm
+                seq_masks[name] = sm
+            else:
+                out[name] = value
+        return out, token_masks, seq_masks

@@ -114,7 +114,11 @@ class TestProfiler(object):
         d = str(tmp_path / "t1")
         fluid.profiler.start_profiler(trace_dir=d)
         try:
-            fluid.profiler.start_profiler()     # no-op, keeps the trace
+            fluid.profiler.start_profiler()     # nested start
+            assert fluid.profiler._trace_dir == d
+            # the matching inner stop must NOT kill the outer trace
+            fluid.profiler.stop_profiler(
+                profile_path=str(tmp_path / "inner.json"))
             assert fluid.profiler._trace_dir == d
         finally:
             fluid.profiler.stop_profiler(

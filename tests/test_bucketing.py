@@ -1,0 +1,195 @@
+"""Ragged at scale: bucketing bounds the compile count, LoD feeds run
+under the DP mesh, and a variable-length NMT model trains + beam-decodes
+(the reference dist_transformer.py / machine_translation analog)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.framework import Program, program_guard
+from paddle_tpu.reader.bucketing import (bucketize, bucket_lod_batch,
+                                         BucketedFeeder)
+
+
+class TestBucketUtils(object):
+    def test_bucketize(self):
+        assert bucketize(3, [4, 8]) == 4
+        assert bucketize(4, [4, 8]) == 4
+        assert bucketize(5, [4, 8]) == 8
+        with pytest.raises(ValueError, match="largest bucket"):
+            bucketize(9, [4, 8])
+
+    def test_bucket_lod_batch_canonical_grid(self):
+        arr = np.arange(5, dtype=np.float32).reshape(5, 1)
+        out, lod, tmask, smask = bucket_lod_batch(
+            arr, [[0, 2, 5]], length_buckets=[4], count_buckets=[4])
+        # seq lengths 2 and 3 -> L=4; count 2 -> C=4
+        assert out.shape[0] == 16
+        np.testing.assert_array_equal(lod[0], [0, 4, 8, 12, 16])
+        np.testing.assert_array_equal(out[:2, 0], [0, 1])
+        np.testing.assert_array_equal(out[4:7, 0], [2, 3, 4])
+        np.testing.assert_array_equal(smask, [1, 1, 0, 0])
+        assert tmask.sum() == 5
+        np.testing.assert_array_equal(tmask[:2], [1, 1])
+        np.testing.assert_array_equal(tmask[4:7], [1, 1, 1])
+
+    def test_canonical_pattern_is_shared(self):
+        """Two different ragged batches in the same bucket cell produce
+        the SAME LoD — the whole point of the compile bound."""
+        a1 = np.ones((5, 1), np.float32)
+        a2 = np.ones((7, 1), np.float32)
+        _, lod1, _, _ = bucket_lod_batch(a1, [[0, 2, 5]], [4], [2])
+        _, lod2, _, _ = bucket_lod_batch(a2, [[0, 4, 7]], [4], [2])
+        assert lod1 == lod2
+
+
+def _nmt_program(dict_size=24, word_dim=12, hidden=16):
+    """Variable-length seq2seq with per-sequence masked loss."""
+    prog, startup = Program(), Program()
+    prog.random_seed = startup.random_seed = 9
+    with program_guard(prog, startup):
+        src = fluid.layers.data(name='src', shape=[1], dtype='int64',
+                                lod_level=1)
+        trg = fluid.layers.data(name='trg', shape=[1], dtype='int64',
+                                lod_level=1)
+        label = fluid.layers.data(name='label', shape=[1], dtype='int64',
+                                  lod_level=1)
+        tok_mask = fluid.layers.data(name='tok_mask', shape=[-1, 1],
+                                     dtype='float32')
+        src_emb = fluid.layers.embedding(src, size=[dict_size, word_dim])
+        enc = fluid.layers.dynamic_gru(
+            fluid.layers.fc(src_emb, size=hidden * 3), size=hidden)
+        enc_last = fluid.layers.sequence_last_step(enc)
+        trg_emb = fluid.layers.embedding(trg, size=[dict_size, word_dim])
+        dec = fluid.layers.dynamic_gru(
+            fluid.layers.fc(trg_emb, size=hidden * 3), size=hidden,
+            h_0=enc_last)
+        logits = fluid.layers.fc(dec, size=dict_size, act='softmax')
+        token_loss = fluid.layers.cross_entropy(logits, label)
+        # token mask gates padded rows (and whole dummy sequences)
+        masked = token_loss * tok_mask
+        loss = fluid.layers.reduce_sum(masked) / \
+            (fluid.layers.reduce_sum(tok_mask) + 1e-6)
+        fluid.optimizer.Adam(0.02).minimize(loss)
+    return prog, startup, loss, logits
+
+
+def _random_ragged_batch(rng, n_seqs, max_len, dict_size):
+    lens = rng.randint(2, max_len + 1, n_seqs)
+    offsets = np.concatenate([[0], np.cumsum(lens)])
+    total = int(offsets[-1])
+    toks = rng.randint(1, dict_size, (total, 1)).astype('int64')
+    return toks, [list(offsets)]
+
+
+class TestBucketedNMT(object):
+    def test_bounded_compiles_over_random_lengths(self):
+        """An epoch of random-length batches compiles at most
+        len(length_buckets) * len(count_buckets) programs (VERDICT item 5
+        contract), with finite decreasing loss."""
+        prog, startup, loss, _ = _nmt_program()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        feeder = BucketedFeeder(length_buckets=[4, 8],
+                                count_buckets=[4])
+        rng = np.random.RandomState(0)
+        losses = []
+        for step in range(12):
+            n = rng.randint(2, 5)
+            src, slod = _random_ragged_batch(rng, n, 8, 24)
+            trg, tlod = _random_ragged_batch(rng, n, 8, 24)
+            feed, tmasks, smasks = feeder.pad({'src': (src, slod),
+                                               'trg': (trg, tlod),
+                                               'label': (trg, tlod)})
+            feed['tok_mask'] = tmasks['trg'].reshape(-1, 1)
+            l, = exe.run(prog, feed=feed, fetch_list=[loss])
+            val = float(np.asarray(l).reshape(()))
+            assert np.isfinite(val)
+            losses.append(val)
+        # compile-count bound: each batch's (src, trg) LoDs land on the
+        # 2x1 grid => at most (2*1)^2 = 4 entries across 12 ragged batches
+        assert len(exe._cache) <= 4, len(exe._cache)
+        assert losses[-1] < losses[0]
+
+    def test_lod_feed_under_dp_mesh_matches_serial(self):
+        """Ragged feeds run under the DP mesh (replicated) with the same
+        numerics as the serial executor — the SplitLoDTensor capability
+        (reference parallel_executor.cc:439) realized TPU-style."""
+        rng = np.random.RandomState(1)
+        src, slod = _random_ragged_batch(rng, 3, 6, 24)
+        trg, tlod = _random_ragged_batch(rng, 3, 6, 24)
+        mask = np.ones((int(tlod[0][-1]), 1), np.float32)
+        # the mask rides the trg LoD so the mesh runner replicates it
+        # alongside the ragged feeds
+        feed = {'src': (src, slod), 'trg': (trg, tlod),
+                'label': (trg, tlod), 'tok_mask': (mask, tlod)}
+
+        prog, startup, loss, _ = _nmt_program()
+        exe = fluid.Executor()
+        s1 = fluid.Scope()
+        with fluid.scope_guard(s1):
+            exe.run(startup, scope=s1)
+            ref = [float(np.asarray(exe.run(
+                prog, feed=feed, fetch_list=[loss], scope=s1)[0]
+                ).reshape(())) for _ in range(3)]
+
+        prog2, startup2, loss2, _ = _nmt_program()
+        s2 = fluid.Scope()
+        with fluid.scope_guard(s2):
+            exe.run(startup2, scope=s2)
+            compiled = fluid.CompiledProgram(prog2).with_data_parallel(
+                loss_name=loss2.name)
+            par = [float(np.asarray(exe.run(
+                compiled, feed=feed, fetch_list=[loss2], scope=s2)[0]
+                ).reshape(())) for _ in range(3)]
+        np.testing.assert_allclose(ref, par, rtol=1e-5, atol=1e-6)
+
+    def test_beam_search_decode_e2e(self):
+        """Greedy-trained toy copy task decodes with beam search (the
+        machine_translation book decode path)."""
+        from paddle_tpu.layers import control_flow
+        dict_size = 8
+        # train a trivial next-token model: predict the same token
+        prog, startup = Program(), Program()
+        prog.random_seed = startup.random_seed = 3
+        with program_guard(prog, startup):
+            x = fluid.layers.data(name='x', shape=[1], dtype='int64')
+            y = fluid.layers.data(name='y', shape=[1], dtype='int64')
+            emb = fluid.layers.embedding(x, size=[dict_size, 16],
+                                         param_attr='bs_emb')
+            logits = fluid.layers.fc(emb, size=dict_size, act='softmax',
+                                     param_attr='bs_w', bias_attr='bs_b')
+            loss = fluid.layers.mean(
+                fluid.layers.cross_entropy(logits, y))
+            fluid.optimizer.Adam(0.05).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        X = rng.randint(0, dict_size, (64, 1)).astype('int64')
+        for _ in range(30):
+            exe.run(prog, feed={'x': X, 'y': X}, fetch_list=[loss])
+
+        # beam-search one step: top beams must contain the identity token
+        infer, s2 = Program(), Program()
+        with program_guard(infer, s2):
+            x = fluid.layers.data(name='x', shape=[1], dtype='int64')
+            emb = fluid.layers.embedding(x, size=[dict_size, 16],
+                                         param_attr='bs_emb')
+            probs = fluid.layers.fc(emb, size=dict_size, act='softmax',
+                                    param_attr='bs_w', bias_attr='bs_b')
+            topk_scores, topk_idx = fluid.layers.topk(probs, k=2)
+            pre_ids = fluid.layers.data(name='pre_ids', shape=[-1, 1],
+                                        dtype='int64')
+            pre_scores = fluid.layers.data(name='pre_scores',
+                                           shape=[-1, 1], dtype='float32')
+            sid, ssc, par = control_flow.beam_search(
+                pre_ids, pre_scores, topk_idx, topk_scores, beam_size=2,
+                end_id=0, level=0)
+        tok = int(X[0, 0])
+        # one instance with beam_size=2 -> 2 rows (reference beam layout)
+        out, = exe.run(infer, feed={
+            'x': np.array([[tok], [tok]], np.int64),
+            'pre_ids': np.array([[tok], [tok]], np.int64),
+            'pre_scores': np.array([[0.0], [-10.0]], np.float32)},
+            fetch_list=[sid])
+        ids = np.asarray(out).reshape(-1)
+        assert tok in ids.tolist(), (tok, ids)
