@@ -58,6 +58,7 @@ from . import profiler
 from . import flags
 from .flags import get_flags, set_flags
 from . import debugger
+from . import recordio
 from .data_feeder import DataFeeder
 from . import compiler
 from .compiler import CompiledProgram

@@ -1,0 +1,53 @@
+"""Native (C++) runtime components, built lazily with the system toolchain.
+
+The reference implements its IO/runtime tier in C++ (paddle/fluid/recordio/,
+framework/data_feed.cc); here the native pieces compile on first use into
+shared libraries loaded via ctypes — no pybind/pybind11 dependency.
+"""
+import ctypes
+import os
+import subprocess
+import threading
+
+_lock = threading.Lock()
+_libs = {}
+
+_SRC_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def _build_dir():
+    d = os.environ.get('PADDLE_TPU_NATIVE_CACHE')
+    if not d:
+        d = os.path.join(_SRC_DIR, '_build')
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def load_library(name, sources, extra_link=()):
+    """Compile (once) and dlopen lib<name>.so from `sources` (.cc files in
+    this directory). Recompiles when any source is newer than the .so."""
+    with _lock:
+        if name in _libs:
+            return _libs[name]
+        so_path = os.path.join(_build_dir(), 'lib%s.so' % name)
+        srcs = [os.path.join(_SRC_DIR, s) for s in sources]
+        stale = (not os.path.exists(so_path) or
+                 any(os.path.getmtime(s) > os.path.getmtime(so_path)
+                     for s in srcs))
+        if stale:
+            cmd = ['g++', '-O2', '-std=c++14', '-shared', '-fPIC',
+                   '-o', so_path] + srcs + list(extra_link)
+            try:
+                subprocess.run(cmd, check=True, capture_output=True,
+                               text=True)
+            except FileNotFoundError:
+                raise RuntimeError(
+                    "g++ not found: the native %s component needs a C++ "
+                    "toolchain (reference builds this tier with CMake)"
+                    % name)
+            except subprocess.CalledProcessError as e:
+                raise RuntimeError(
+                    "building native %s failed:\n%s" % (name, e.stderr))
+        lib = ctypes.CDLL(so_path)
+        _libs[name] = lib
+        return lib

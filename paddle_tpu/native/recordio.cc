@@ -1,0 +1,217 @@
+// Chunked record file format — the TPU-native analog of reference
+// paddle/fluid/recordio/ ({header,chunk,writer,scanner}.cc) feeding
+// create_recordio_file_reader_op. Fresh design, C ABI for ctypes:
+//
+//   file  := chunk*
+//   chunk := MAGIC(4) | flags(u8) | num_records(u32) | raw_len(u32)
+//            | stored_len(u32) | crc32(u32) | payload[stored_len]
+//   payload (after optional zlib inflate) := (rec_len(u32) | bytes)*
+//
+// flags bit 0: payload zlib-compressed. crc32 covers the STORED payload.
+// All integers little-endian. Records are opaque byte strings; the Python
+// layer (paddle_tpu/recordio.py) serializes tensors into them.
+//
+// Build: g++ -O2 -shared -fPIC recordio.cc -o librecordio.so -lz
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include <zlib.h>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x54505552;  // "RUPT"
+constexpr uint8_t kFlagCompressed = 1;
+
+struct Writer {
+  FILE* f = nullptr;
+  bool compress = false;
+  size_t chunk_records = 0;    // flush threshold
+  std::string buf;             // pending payload
+  uint32_t pending = 0;
+  std::string error;
+
+  bool FlushChunk() {
+    if (pending == 0) return true;
+    const std::string* payload = &buf;
+    std::string comp;
+    uint8_t flags = 0;
+    if (compress) {
+      uLongf bound = compressBound(buf.size());
+      comp.resize(bound);
+      if (compress2(reinterpret_cast<Bytef*>(&comp[0]), &bound,
+                    reinterpret_cast<const Bytef*>(buf.data()), buf.size(),
+                    Z_DEFAULT_COMPRESSION) != Z_OK) {
+        error = "zlib compress failed";
+        return false;
+      }
+      comp.resize(bound);
+      if (comp.size() < buf.size()) {
+        payload = &comp;
+        flags |= kFlagCompressed;
+      }
+    }
+    uint32_t raw_len = static_cast<uint32_t>(buf.size());
+    uint32_t stored_len = static_cast<uint32_t>(payload->size());
+    uint32_t crc = crc32(0L, reinterpret_cast<const Bytef*>(payload->data()),
+                         payload->size());
+    uint32_t head[1] = {kMagic};
+    if (fwrite(head, 4, 1, f) != 1 || fwrite(&flags, 1, 1, f) != 1 ||
+        fwrite(&pending, 4, 1, f) != 1 || fwrite(&raw_len, 4, 1, f) != 1 ||
+        fwrite(&stored_len, 4, 1, f) != 1 || fwrite(&crc, 4, 1, f) != 1 ||
+        (stored_len && fwrite(payload->data(), stored_len, 1, f) != 1)) {
+      error = "short write";
+      return false;
+    }
+    buf.clear();
+    pending = 0;
+    return true;
+  }
+};
+
+struct Scanner {
+  FILE* f = nullptr;
+  std::string chunk;           // decoded payload of the current chunk
+  size_t pos = 0;              // cursor into chunk
+  uint32_t remaining = 0;      // records left in the current chunk
+  std::string record;          // last record returned
+  std::string error;
+
+  bool LoadChunk() {
+    uint32_t magic = 0;
+    if (fread(&magic, 4, 1, f) != 1) return false;  // clean EOF
+    if (magic != kMagic) {
+      error = "bad chunk magic (corrupt or not a recordio file)";
+      return false;
+    }
+    uint8_t flags;
+    uint32_t num, raw_len, stored_len, crc;
+    if (fread(&flags, 1, 1, f) != 1 || fread(&num, 4, 1, f) != 1 ||
+        fread(&raw_len, 4, 1, f) != 1 || fread(&stored_len, 4, 1, f) != 1 ||
+        fread(&crc, 4, 1, f) != 1) {
+      error = "truncated chunk header";
+      return false;
+    }
+    std::string stored(stored_len, '\0');
+    if (stored_len && fread(&stored[0], stored_len, 1, f) != 1) {
+      error = "truncated chunk payload";
+      return false;
+    }
+    uint32_t got = crc32(0L, reinterpret_cast<const Bytef*>(stored.data()),
+                         stored.size());
+    if (got != crc) {
+      error = "chunk crc mismatch";
+      return false;
+    }
+    if (flags & kFlagCompressed) {
+      chunk.resize(raw_len);
+      uLongf out_len = raw_len;
+      if (uncompress(reinterpret_cast<Bytef*>(&chunk[0]), &out_len,
+                     reinterpret_cast<const Bytef*>(stored.data()),
+                     stored.size()) != Z_OK ||
+          out_len != raw_len) {
+        error = "zlib inflate failed";
+        return false;
+      }
+    } else {
+      chunk.swap(stored);
+    }
+    pos = 0;
+    remaining = num;
+    return true;
+  }
+
+  bool Next() {
+    while (remaining == 0) {
+      if (!LoadChunk()) return false;
+    }
+    if (pos + 4 > chunk.size()) {
+      error = "corrupt chunk: record header past payload";
+      return false;
+    }
+    uint32_t len;
+    memcpy(&len, chunk.data() + pos, 4);
+    pos += 4;
+    if (pos + len > chunk.size()) {
+      error = "corrupt chunk: record past payload";
+      return false;
+    }
+    record.assign(chunk, pos, len);
+    pos += len;
+    --remaining;
+    return true;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* recordio_writer_open(const char* path, int compress,
+                           int chunk_records) {
+  FILE* f = fopen(path, "wb");
+  if (!f) return nullptr;
+  Writer* w = new Writer();
+  w->f = f;
+  w->compress = compress != 0;
+  w->chunk_records = chunk_records > 0 ? chunk_records : 1000;
+  return w;
+}
+
+int recordio_writer_write(void* handle, const char* data, uint32_t len) {
+  Writer* w = static_cast<Writer*>(handle);
+  w->buf.append(reinterpret_cast<const char*>(&len), 4);
+  w->buf.append(data, len);
+  ++w->pending;
+  if (w->pending >= w->chunk_records) {
+    return w->FlushChunk() ? 0 : -1;
+  }
+  return 0;
+}
+
+int recordio_writer_close(void* handle) {
+  Writer* w = static_cast<Writer*>(handle);
+  int rc = w->FlushChunk() ? 0 : -1;
+  if (fclose(w->f) != 0) rc = -1;
+  delete w;
+  return rc;
+}
+
+const char* recordio_writer_error(void* handle) {
+  return static_cast<Writer*>(handle)->error.c_str();
+}
+
+void* recordio_scanner_open(const char* path) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return nullptr;
+  Scanner* s = new Scanner();
+  s->f = f;
+  return s;
+}
+
+// returns 1 with *data/*len set; 0 on clean EOF; -1 on error
+int recordio_scanner_next(void* handle, const char** data, uint32_t* len) {
+  Scanner* s = static_cast<Scanner*>(handle);
+  if (!s->Next()) {
+    return s->error.empty() ? 0 : -1;
+  }
+  *data = s->record.data();
+  *len = static_cast<uint32_t>(s->record.size());
+  return 1;
+}
+
+const char* recordio_scanner_error(void* handle) {
+  return static_cast<Scanner*>(handle)->error.c_str();
+}
+
+void recordio_scanner_close(void* handle) {
+  Scanner* s = static_cast<Scanner*>(handle);
+  fclose(s->f);
+  delete s;
+}
+
+}  // extern "C"

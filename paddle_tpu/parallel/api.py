@@ -117,13 +117,20 @@ class MeshRunner(object):
         feed, feed_lods = exe._prepare_feed(program, feed or {})
         fetch_names = [v.name if isinstance(v, Variable) else v
                        for v in (fetch_list or [])]
-        key = (program._version, exe._feed_signature(feed, feed_lods),
+        # LoD-carrying scope state binds statically, like the serial
+        # executor (executor.py scope_lods handling)
+        from ..core.lod import normalize_lod as _nl
+        scope_lods = {n: _nl(l) for n, l in
+                      getattr(scope, '_lods', {}).items() if l}
+        static_lods = dict(scope_lods)
+        static_lods.update(feed_lods)
+        key = (program._version, exe._feed_signature(feed, static_lods),
                tuple(fetch_names))
         entry = self._cache.get(key)
         if entry is None:
             fn_, ro_, rw_, lod_out_ = self.compile(
                 {k: (v.shape, v.dtype) for k, v in feed.items()},
-                fetch_names, scope, feed_lods=feed_lods)
+                fetch_names, scope, feed_lods=static_lods)
             entry = _MeshEntry(fn_, ro_, rw_, lod_out_)
             self._cache[key] = entry
         fn, ro_names, rw_names = entry.fn, entry.ro_names, entry.rw_names
@@ -141,6 +148,13 @@ class MeshRunner(object):
         finally:
             _ACTIVE_MESH = prev
         scope.update(new_state)
+        # propagate produced LoDs of written persistables into the scope
+        for n in new_state:
+            lod = entry.lod_out.get(n)
+            if lod:
+                scope._lods[n] = lod
+            else:
+                scope._lods.pop(n, None)
         from ..executor import _fetched
         if return_numpy:
             return [

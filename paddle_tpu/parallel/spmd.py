@@ -140,6 +140,13 @@ class DataParallelRunner(object):
             scope = global_scope()
         program = self._program
         feed, feed_lods = executor._prepare_feed(program, feed or {})
+        # LoD-carrying scope state binds statically, like the serial
+        # executor (executor.py scope_lods handling)
+        from ..core.lod import normalize_lod as _nl
+        scope_lods = {n: _nl(l) for n, l in
+                      getattr(scope, '_lods', {}).items() if l}
+        static_lods = dict(scope_lods)
+        static_lods.update(feed_lods)
         fetch_names = [v.name if isinstance(v, Variable) else v
                        for v in (fetch_list or [])]
         nproc = jax.process_count()
@@ -155,11 +162,12 @@ class DataParallelRunner(object):
                     "feed %r batch %d not divisible by %d mesh devices"
                     % (k, v.shape[0], ndev))
         key = (program._uid, program._version,
-               executor._feed_signature(feed, feed_lods),
+               executor._feed_signature(feed, static_lods),
                tuple(fetch_names))
         entry = self._cache.get(key)
         if entry is None:
-            entry = self._compile(feed, fetch_names, feed_lods=feed_lods)
+            entry = self._compile(feed, fetch_names,
+                                  feed_lods=static_lods)
             self._cache[key] = entry
 
         ro_state = {n: executor._state_value(scope, n, program)
@@ -219,6 +227,12 @@ class DataParallelRunner(object):
         if _flags.get_flags('benchmark'):
             jax.block_until_ready(fetches)
         scope.update(new_state)
+        for n in new_state:
+            lod = entry.lod_out.get(n)
+            if lod:
+                scope._lods[n] = lod
+            else:
+                scope._lods.pop(n, None)
         from ..executor import _fetched
         if return_numpy:
             out = []

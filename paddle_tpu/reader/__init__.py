@@ -5,7 +5,8 @@ from .prefetch import DevicePrefetcher, PyReader
 
 __all__ = ['cache', 'map_readers', 'buffered', 'compose', 'chain', 'shuffle',
            'firstn', 'xmap_readers', 'multiprocess_reader', 'batch',
-           'DevicePrefetcher', 'PyReader']
+           'DevicePrefetcher', 'PyReader', 'bucketize', 'bucket_lod_batch',
+           'BucketedFeeder']
 
 from . import bucketing
 from .bucketing import (bucketize, bucket_lod_batch, BucketedFeeder)

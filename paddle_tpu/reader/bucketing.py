@@ -93,14 +93,14 @@ class BucketedFeeder(object):
     def pad(self, feed):
         """feed: {name: array | (array, lod)}. Returns
         (new_feed, token_masks, seq_masks)."""
+        from ..executor import Executor
         out, token_masks, seq_masks = {}, {}, {}
         for name, value in feed.items():
-            # accept LoDTensor-style objects too (anything with .lod())
-            lod_m = getattr(value, 'lod', None)
-            if callable(lod_m) and not isinstance(value, np.ndarray):
-                value = (np.asarray(value), lod_m())
-            if isinstance(value, tuple) and len(value) == 2:
-                arr, lod = value
+            # one LoD-extraction path with the executor (tuple, LoDTensor,
+            # FetchedTensor all normalize the same way)
+            arr0, lod0 = Executor._split_lod_feed(value)
+            if lod0:
+                arr, lod = arr0, lod0
                 arr2, lod2, tm, sm = bucket_lod_batch(
                     arr, lod, self.length_buckets, self.count_buckets,
                     self.pad_value)
@@ -108,5 +108,5 @@ class BucketedFeeder(object):
                 token_masks[name] = tm
                 seq_masks[name] = sm
             else:
-                out[name] = value
+                out[name] = arr0
         return out, token_masks, seq_masks

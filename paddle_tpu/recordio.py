@@ -1,0 +1,184 @@
+"""RecordIO: chunked record files backed by the native C++ library
+(paddle_tpu/native/recordio.cc — the analog of reference
+paddle/fluid/recordio/ + create_recordio_file_reader_op +
+python recordio_writer.py).
+
+Records are opaque bytes at the native layer; this module adds the tensor
+serialization (a tuple of numpy arrays per record, length-prefixed npy
+blobs) and the reader-API integration:
+
+    with fluid.recordio.Writer('train.rio') as w:
+        for sample in reader():            # tuple of ndarrays
+            w.write_tensors(sample)
+    train_reader = fluid.recordio.reader('train.rio')   # yields tuples
+"""
+import ctypes
+import io
+import os
+
+import numpy as np
+
+from .native import load_library
+
+__all__ = ['Writer', 'Scanner', 'reader',
+           'convert_reader_to_recordio_file']
+
+
+def _lib():
+    lib = load_library('recordio', ['recordio.cc'], extra_link=['-lz'])
+    if not getattr(lib, '_prototyped', False):
+        lib.recordio_writer_open.restype = ctypes.c_void_p
+        lib.recordio_writer_open.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                             ctypes.c_int]
+        lib.recordio_writer_write.restype = ctypes.c_int
+        lib.recordio_writer_write.argtypes = [ctypes.c_void_p,
+                                              ctypes.c_char_p,
+                                              ctypes.c_uint32]
+        lib.recordio_writer_close.restype = ctypes.c_int
+        lib.recordio_writer_close.argtypes = [ctypes.c_void_p]
+        lib.recordio_writer_error.restype = ctypes.c_char_p
+        lib.recordio_writer_error.argtypes = [ctypes.c_void_p]
+        lib.recordio_scanner_open.restype = ctypes.c_void_p
+        lib.recordio_scanner_open.argtypes = [ctypes.c_char_p]
+        lib.recordio_scanner_next.restype = ctypes.c_int
+        lib.recordio_scanner_next.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_uint32)]
+        lib.recordio_scanner_error.restype = ctypes.c_char_p
+        lib.recordio_scanner_error.argtypes = [ctypes.c_void_p]
+        lib.recordio_scanner_close.restype = None
+        lib.recordio_scanner_close.argtypes = [ctypes.c_void_p]
+        lib._prototyped = True
+    return lib
+
+
+class Writer(object):
+    def __init__(self, path, compress=True, chunk_records=1000):
+        lib = _lib()
+        self._lib = lib
+        self._h = lib.recordio_writer_open(
+            path.encode(), 1 if compress else 0, int(chunk_records))
+        if not self._h:
+            raise IOError("recordio: cannot open %r for writing" % path)
+        self._closed = False
+
+    def write(self, data):
+        """Write one opaque bytes record."""
+        if isinstance(data, str):
+            data = data.encode()
+        if len(data) >= 2 ** 32:
+            raise ValueError(
+                "recordio record of %d bytes exceeds the 4 GiB framing "
+                "limit — split the sample" % len(data))
+        rc = self._lib.recordio_writer_write(self._h, data,
+                                             len(data))
+        if rc != 0:
+            err = self._lib.recordio_writer_error(self._h) or b''
+            raise IOError("recordio write failed: %s" % err.decode())
+
+    def write_tensors(self, arrays):
+        """Write a tuple of ndarrays as one record (npy-concatenated)."""
+        buf = io.BytesIO()
+        arrays = arrays if isinstance(arrays, (list, tuple)) else [arrays]
+        buf.write(np.uint32(len(arrays)).tobytes())
+        for a in arrays:
+            blob = io.BytesIO()
+            np.save(blob, np.asarray(a), allow_pickle=False)
+            b = blob.getvalue()
+            buf.write(np.uint32(len(b)).tobytes())
+            buf.write(b)
+        self.write(buf.getvalue())
+
+    def close(self):
+        if not self._closed:
+            self._closed = True
+            rc = self._lib.recordio_writer_close(self._h)
+            if rc != 0:
+                raise IOError("recordio close/flush failed")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+
+
+class Scanner(object):
+    """Iterates opaque bytes records; use reader() for tensor tuples."""
+
+    def __init__(self, path):
+        if not os.path.exists(path):
+            raise IOError("recordio: %r does not exist" % path)
+        lib = _lib()
+        self._lib = lib
+        self._h = lib.recordio_scanner_open(path.encode())
+        if not self._h:
+            raise IOError("recordio: cannot open %r" % path)
+        self._closed = False
+
+    def __iter__(self):
+        data = ctypes.c_char_p()
+        length = ctypes.c_uint32()
+        try:
+            while True:
+                rc = self._lib.recordio_scanner_next(
+                    self._h, ctypes.byref(data), ctypes.byref(length))
+                if rc == 0:
+                    break
+                if rc < 0:
+                    err = (self._lib.recordio_scanner_error(self._h) or
+                           b'').decode()
+                    raise IOError("recordio scan failed: %s" % err)
+                yield ctypes.string_at(data, length.value)
+        finally:
+            # abandoning the iterator early (break / firstn) must still
+            # release the native scanner + FILE*
+            self.close()
+
+    def close(self):
+        if not self._closed:
+            self._closed = True
+            self._lib.recordio_scanner_close(self._h)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def _decode_tensors(blob):
+    view = memoryview(blob)
+    n = int(np.frombuffer(view[:4], np.uint32)[0])
+    pos = 4
+    out = []
+    for _ in range(n):
+        ln = int(np.frombuffer(view[pos:pos + 4], np.uint32)[0])
+        pos += 4
+        out.append(np.load(io.BytesIO(bytes(view[pos:pos + ln])),
+                           allow_pickle=False))
+        pos += ln
+    return tuple(out)
+
+
+def reader(path):
+    """A paddle-style reader() factory yielding tensor tuples from a
+    recordio file (the create_recordio_file_reader_op analog)."""
+    def _reader():
+        for blob in Scanner(path):
+            yield _decode_tensors(blob)
+    return _reader
+
+
+def convert_reader_to_recordio_file(filename, reader_creator,
+                                    compress=True, chunk_records=1000,
+                                    feeder=None):
+    """Materialize any reader into a recordio file (reference
+    python/paddle/fluid/recordio_writer.py). Returns the record count."""
+    n = 0
+    with Writer(filename, compress=compress,
+                chunk_records=chunk_records) as w:
+        for sample in reader_creator():
+            w.write_tensors(sample)
+            n += 1
+    return n

@@ -1,0 +1,106 @@
+"""Native RecordIO file format (paddle_tpu/native/recordio.cc, the analog
+of reference paddle/fluid/recordio/ + recordio_writer.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import recordio
+
+
+class TestRecordIO(object):
+    def test_bytes_roundtrip(self, tmp_path):
+        path = str(tmp_path / "a.rio")
+        records = [b"hello", b"", b"x" * 10000, bytes(range(256)) * 7]
+        with recordio.Writer(path, compress=True, chunk_records=3) as w:
+            for r in records:
+                w.write(r)
+        got = list(recordio.Scanner(path))
+        assert got == records
+
+    def test_uncompressed(self, tmp_path):
+        path = str(tmp_path / "b.rio")
+        with recordio.Writer(path, compress=False, chunk_records=2) as w:
+            for i in range(5):
+                w.write(b"rec%d" % i)
+        assert list(recordio.Scanner(path)) == \
+            [b"rec0", b"rec1", b"rec2", b"rec3", b"rec4"]
+
+    def test_tensor_roundtrip(self, tmp_path):
+        path = str(tmp_path / "c.rio")
+        rng = np.random.RandomState(0)
+        samples = [
+            (rng.randn(3, 4).astype('float32'),
+             rng.randint(0, 9, (3, 1)).astype('int64')),
+            (rng.randn(2, 4).astype('float32'),
+             rng.randint(0, 9, (2, 1)).astype('int64')),
+        ]
+        with recordio.Writer(path) as w:
+            for s in samples:
+                w.write_tensors(s)
+        got = list(recordio.reader(path)())
+        assert len(got) == 2
+        for s, g in zip(samples, got):
+            assert len(g) == 2
+            np.testing.assert_array_equal(g[0], s[0])
+            np.testing.assert_array_equal(g[1], s[1])
+
+    def test_convert_reader(self, tmp_path):
+        path = str(tmp_path / "d.rio")
+
+        def creator():
+            for i in range(7):
+                yield (np.full((2, 2), i, np.float32),)
+
+        n = recordio.convert_reader_to_recordio_file(path, creator,
+                                                     chunk_records=3)
+        assert n == 7
+        vals = [int(s[0][0, 0]) for s in recordio.reader(path)()]
+        assert vals == list(range(7))
+
+    def test_corruption_detected(self, tmp_path):
+        path = str(tmp_path / "e.rio")
+        with recordio.Writer(path, compress=False) as w:
+            w.write(b"payload-payload-payload")
+        # flip a payload byte -> crc mismatch
+        blob = bytearray(open(path, 'rb').read())
+        blob[-3] ^= 0xFF
+        open(path, 'wb').write(bytes(blob))
+        with pytest.raises(IOError, match="crc|scan failed"):
+            list(recordio.Scanner(path))
+
+    def test_missing_file(self):
+        with pytest.raises(IOError, match="does not exist"):
+            recordio.Scanner("/nonexistent/x.rio")
+
+    def test_feeds_training(self, tmp_path):
+        """recordio file -> reader -> batch -> train (the reference
+        recordio->py_reader pipeline)."""
+        path = str(tmp_path / "train.rio")
+        rng = np.random.RandomState(1)
+
+        def creator():
+            for _ in range(32):
+                x = rng.randn(4).astype('float32')
+                y = np.array([x.sum() > 0], dtype='int64')
+                yield (x, y)
+
+        recordio.convert_reader_to_recordio_file(path, creator)
+
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        y = fluid.layers.data(name='y', shape=[1], dtype='int64')
+        pred = fluid.layers.fc(x, size=2, act='softmax')
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, y))
+        fluid.optimizer.SGD(0.5).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        batched = fluid.reader.batch(recordio.reader(path), batch_size=8)
+        losses = []
+        for _ in range(8):
+            for batch in batched():
+                X = np.stack([b[0] for b in batch])
+                Y = np.stack([b[1] for b in batch])
+                l, = exe.run(feed={'x': X, 'y': Y}, fetch_list=[loss])
+                losses.append(float(np.asarray(l).reshape(())))
+        assert losses[-1] < losses[0]
