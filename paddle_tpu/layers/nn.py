@@ -34,6 +34,7 @@ __all__ = [
     'sums_', 'logical_and', 'logical_or', 'logical_xor', 'logical_not',
     'where', 'sign', 'gather_nd', 'random_crop', 'mean_iou', 'hash',
     'grid_sampler', 'affine_grid', 'roi_pool', 'roi_align', 'psroi_pool',
+    'py_func', 'unpool', 'spp',
     'teacher_student_sigmoid_loss', 'selu', 'swish',
     'sharding_constraint', 'linear_chain_crf', 'crf_decoding', 'warpctc',
     'ctc_greedy_decoder', 'edit_distance',
@@ -1611,3 +1612,57 @@ def edit_distance(input, label, normalized=True, ignored_tokens=None):
                      outputs={'Out': [out], 'SequenceNum': [seq_num]},
                      attrs={'normalized': normalized})
     return out, seq_num
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Host-callback op (reference layers/nn.py py_func / py_func_op.cc):
+    runs `func` on host over the inputs' numpy values via jax.pure_callback.
+    `out` vars must declare full static shapes. With `backward_func`, the
+    gradient is a second host callback receiving (inputs..., out_grads...)
+    and returning grads for each input."""
+    from ..ops.misc_ops import register_py_func
+    helper = LayerHelper('py_func')
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    attrs = {'forward_callable_id': register_py_func(func)}
+    if backward_func is not None:
+        attrs['backward_callable_id'] = register_py_func(backward_func)
+        if skip_vars_in_backward_input:
+            skips = skip_vars_in_backward_input
+            skips = skips if isinstance(skips, (list, tuple)) else [skips]
+            attrs['backward_skip_inputs'] = [
+                v.name if hasattr(v, 'name') else v for v in skips]
+    helper.append_op(type='py_func', inputs={'X': list(xs)},
+                     outputs={'Out': list(outs)}, attrs=attrs)
+    return out
+
+
+def unpool(input, indices, ksize, strides=None, paddings=None, name=None):
+    """Max unpooling with the indices from max_pool2d_with_index
+    (reference unpool_op.cc)."""
+    helper = LayerHelper('unpool', name=name)
+    strides = strides or [1, 1]
+    paddings = paddings or [0, 0]
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type='unpool',
+                     inputs={'X': [input], 'Indices': [indices]},
+                     outputs={'Out': [out]},
+                     attrs={'ksize': list(ksize), 'strides': list(strides),
+                            'paddings': list(paddings)})
+    return out
+
+
+def spp(input, pyramid_height, pool_type='max', name=None):
+    """Spatial pyramid pooling (reference spp_op.cc)."""
+    helper = LayerHelper('spp', name=name)
+    c = input.shape[1] if input.shape else -1
+    total = 0
+    for l in range(pyramid_height):
+        total += (2 ** l) ** 2
+    out = helper.create_variable_for_type_inference(
+        input.dtype, shape=(-1, c * total if c > 0 else -1))
+    helper.append_op(type='spp', inputs={'X': [input]},
+                     outputs={'Out': [out]},
+                     attrs={'pyramid_height': pyramid_height,
+                            'pooling_type': pool_type})
+    return out

@@ -21,3 +21,4 @@ from . import crf_ctc_ops
 from . import detection_ops
 from . import vision_ops
 from . import quant_ops
+from . import misc_ops

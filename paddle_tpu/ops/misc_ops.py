@@ -1,0 +1,530 @@
+"""Long-tail ops: fc, 3-D conv-transpose/pool, unpool, spp, conv_shift,
+modified_huber_loss, similarity_focus, tree_conv, positive_negative_pair,
+get_places, py_func.
+
+Reference: operators/{fc_op, conv_transpose_op (3d), pool_with_index_op,
+unpool_op, spp_op, conv_shift_op, modified_huber_loss_op,
+similarity_focus_op, tree_conv_op (+math/tree2col), positive_negative_pair_op,
+controlflow/get_places_op, py_func_op}.cc
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op
+from ..core import amp
+
+
+# ---------------------------------------------------------------------------
+# fc (the fused op form; layers.fc composes mul+sum, but programs built
+# from fc op descs — e.g. loaded reference models — need the op itself)
+# ---------------------------------------------------------------------------
+
+@register_op('fc')
+def _fc(ctx, op):
+    """reference operators/fc_op.cc: Out = sum_i X_i W_i (+ Bias); W is a
+    list parallel to Input, and leading dims up to in_num_col_dims are
+    preserved in the output."""
+    xs = ctx.in_list(op, 'Input')
+    ws = ctx.in_list(op, 'W')
+    bias = ctx.in1(op, 'Bias')
+    col = op.attr('in_num_col_dims', 1)
+    if len(ws) != len(xs):
+        raise ValueError(
+            "fc: expected one W per Input (%d inputs, %d weights)"
+            % (len(xs), len(ws)))
+    out = None
+    lead_shape = None
+    for x, w in zip(xs, ws):
+        lead_shape = x.shape[:col]
+        lead = int(np.prod(lead_shape)) if col else 1
+        flat = x.reshape(lead, -1)
+        y = jnp.matmul(*amp.cast_compute(op, flat, w),
+                       preferred_element_type=amp.accum_dtype(flat))
+        y = y.astype(x.dtype)
+        out = y if out is None else out + y
+    if bias is not None:
+        out = out + bias.reshape(1, -1)
+    ctx.out(op, 'Out', out.reshape(tuple(lead_shape) + (out.shape[-1],)))
+
+
+# ---------------------------------------------------------------------------
+# 3-D conv transpose + pooling with index + unpool + spp
+# ---------------------------------------------------------------------------
+
+def _triple(v):
+    return tuple(v) if isinstance(v, (list, tuple)) else (v, v, v)
+
+
+@register_op('conv3d_transpose')
+def _conv3d_transpose(ctx, op):
+    """reference conv_transpose_op.cc 3-D registration (gradient-of-conv
+    formulation: lhs-dilate the input by stride)."""
+    x = ctx.in1(op, 'Input')       # NCDHW
+    w = ctx.in1(op, 'Filter')      # (C_in, C_out/groups, kd, kh, kw)
+    strides = _triple(op.attr('strides', [1, 1, 1]))
+    pads = _triple(op.attr('paddings', [0, 0, 0]))
+    dilations = _triple(op.attr('dilations', [1, 1, 1]))
+    groups = op.attr('groups', 1) or 1
+    ks = [(w.shape[2 + i] - 1) * dilations[i] + 1 for i in range(3)]
+    out_dtype = x.dtype
+    x, w = amp.cast_compute(op, x, w)
+    out = lax.conv_general_dilated(
+        x, jnp.swapaxes(w, 0, 1)[:, :, ::-1, ::-1, ::-1],
+        window_strides=(1, 1, 1),
+        padding=[(k - 1 - p, k - 1 - p) for k, p in zip(ks, pads)],
+        lhs_dilation=strides, rhs_dilation=dilations,
+        dimension_numbers=('NCDHW', 'OIDHW', 'NCDHW'),
+        feature_group_count=groups,
+        preferred_element_type=amp.accum_dtype(x))
+    ctx.out(op, 'Output', out.astype(out_dtype))
+
+
+def _pool_with_index(x, ksize, strides, pads, adaptive=False):
+    """Max pool over the trailing spatial dims returning (values, flat
+    argmax indices into the unpadded spatial plane) — reference
+    pool_with_index_op (MaxPool2dWithIndexFunctor, adaptive variant
+    included). Static window gather: index maps are numpy constants."""
+    nsp = len(ksize)
+    spatial = x.shape[-nsp:]
+    if adaptive:
+        # reference AdaptiveStartIndex/AdaptiveEndIndex: ksize is the
+        # OUTPUT size; windows have variable extents, padded to the max
+        out_sz = list(ksize)
+        per_dim = []
+        for i in range(nsp):
+            s = [int(np.floor(o * spatial[i] / out_sz[i]))
+                 for o in range(out_sz[i])]
+            e = [int(np.ceil((o + 1) * spatial[i] / out_sz[i]))
+                 for o in range(out_sz[i])]
+            per_dim.append((s, e))
+        kmax = [max(e - s for s, e in zip(*d)) for d in per_dim]
+        grids = np.meshgrid(*[np.arange(o) for o in out_sz],
+                            indexing='ij')
+        starts = [np.asarray(per_dim[i][0])[grids[i]]
+                  for i in range(nsp)]
+        ends = [np.asarray(per_dim[i][1])[grids[i]] for i in range(nsp)]
+        wins = np.meshgrid(*[np.arange(k) for k in kmax], indexing='ij')
+        idx = None
+        valid = None
+        for i in range(nsp):
+            coord = starts[i].reshape(starts[i].shape + (1,) * nsp) + \
+                wins[i].reshape((1,) * nsp + wins[i].shape)
+            ok = coord < ends[i].reshape(ends[i].shape + (1,) * nsp)
+            flat = np.clip(coord, 0, spatial[i] - 1)
+            idx = flat if idx is None else idx * spatial[i] + flat
+            valid = ok if valid is None else (valid & ok)
+        flat_idx = idx.reshape(int(np.prod(out_sz)), int(np.prod(kmax)))
+        flat_valid = valid.reshape(flat_idx.shape)
+        lead = x.shape[:-nsp]
+        xf = x.reshape(lead + (int(np.prod(spatial)),))
+        taps = jnp.take(xf, jnp.asarray(flat_idx), axis=-1)
+        neg = jnp.asarray(-jnp.inf, x.dtype)
+        taps = jnp.where(jnp.asarray(flat_valid), taps, neg)
+        vals = jnp.max(taps, -1)
+        arg = jnp.argmax(taps, -1)
+        flat_pos = jnp.take_along_axis(
+            jnp.broadcast_to(jnp.asarray(flat_idx), vals.shape + (
+                flat_idx.shape[1],)), arg[..., None], axis=-1)[..., 0]
+        return (vals.reshape(lead + tuple(out_sz)),
+                flat_pos.reshape(lead + tuple(out_sz)).astype(jnp.int32))
+    out_sz = [(spatial[i] + 2 * pads[i] - ksize[i]) // strides[i] + 1
+              for i in range(nsp)]
+
+    # flat gather map [prod(out_sz), prod(ksize)] into the flat spatial
+    # plane; -1 marks out-of-range (padding) taps
+    grids = np.meshgrid(*[np.arange(o) for o in out_sz], indexing='ij')
+    starts = [g * strides[i] - pads[i] for i, g in enumerate(grids)]
+    wins = np.meshgrid(*[np.arange(k) for k in ksize], indexing='ij')
+    idx = None
+    valid = None
+    for i in range(nsp):
+        coord = starts[i].reshape(starts[i].shape + (1,) * nsp) + \
+            wins[i].reshape((1,) * nsp + wins[i].shape)
+        ok = (coord >= 0) & (coord < spatial[i])
+        flat = np.clip(coord, 0, spatial[i] - 1)
+        idx = flat if idx is None else idx * spatial[i] + flat
+        valid = ok if valid is None else (valid & ok)
+    flat_idx = idx.reshape(int(np.prod(out_sz)), int(np.prod(ksize)))
+    flat_valid = valid.reshape(flat_idx.shape)
+
+    lead = x.shape[:-nsp]
+    xf = x.reshape(lead + (int(np.prod(spatial)),))
+    taps = jnp.take(xf, jnp.asarray(flat_idx), axis=-1)    # [..., O, K]
+    neg = jnp.asarray(-jnp.inf, x.dtype)
+    taps = jnp.where(jnp.asarray(flat_valid), taps, neg)
+    vals = jnp.max(taps, -1)
+    arg = jnp.argmax(taps, -1)
+    # per output position o: flat_idx[o, arg[..., o]]
+    flat_pos = jnp.take_along_axis(
+        jnp.broadcast_to(jnp.asarray(flat_idx), vals.shape + (
+            flat_idx.shape[1],)), arg[..., None], axis=-1)[..., 0]
+    return (vals.reshape(lead + tuple(out_sz)),
+            flat_pos.reshape(lead + tuple(out_sz)).astype(jnp.int32))
+
+
+@register_op('max_pool3d_with_index')
+def _max_pool3d_with_index(ctx, op):
+    x = ctx.in1(op, 'X')
+    ksize = _triple(op.attr('ksize'))
+    strides = _triple(op.attr('strides', [1, 1, 1]))
+    pads = _triple(op.attr('paddings', [0, 0, 0]))
+    if op.attr('global_pooling', False):
+        ksize = x.shape[-3:]
+        strides = (1, 1, 1)
+        pads = (0, 0, 0)
+    vals, mask = _pool_with_index(x, ksize, strides, pads,
+                                  adaptive=op.attr('adaptive', False))
+    ctx.out(op, 'Out', vals)
+    ctx.out(op, 'Mask', mask)
+
+
+@register_op('unpool')
+def _unpool(ctx, op):
+    """reference unpool_op.cc: scatter pooled values back to the argmax
+    positions recorded by max_pool2d_with_index."""
+    x = ctx.in1(op, 'X')            # [N, C, oh, ow]
+    mask = ctx.in1(op, 'Indices')   # flat positions into H*W
+    ksize = op.attr('ksize')
+    strides = op.attr('strides', [1, 1])
+    pads = op.attr('paddings', [0, 0])
+    n, c, oh, ow = x.shape
+    H = (oh - 1) * strides[0] - 2 * pads[0] + ksize[0]
+    W = (ow - 1) * strides[1] - 2 * pads[1] + ksize[1]
+
+    def one(xi, mi):
+        # xi/mi [c, oh, ow] -> scatter into [c, H*W]; assignment (not
+        # accumulate): overlapping windows sharing an argmax write the
+        # same max once, matching reference unpool_op.cc
+        flat = jnp.zeros((c, H * W), x.dtype)
+        cols = mi.reshape(c, -1).astype(jnp.int32)
+        vals = xi.reshape(c, -1)
+        flat = jax.vmap(lambda f, co, v: f.at[co].set(v, mode='drop'))(
+            flat, cols, vals)
+        return flat.reshape(c, H, W)
+
+    ctx.out(op, 'Out', jax.vmap(one)(x, mask))
+
+
+@register_op('spp')
+def _spp(ctx, op):
+    """reference spp_op.h: spatial pyramid pooling — at level p, a plain
+    pool2d with kernel = ceil(dim / 2^p), stride = kernel, padding =
+    (kernel * bins - dim + 1) / 2, exclusive averaging; levels flattened
+    and concatenated."""
+    from .nn_ops import _pool
+    x = ctx.in1(op, 'X')            # [N, C, H, W]
+    height = op.attr('pyramid_height')
+    ptype = op.attr('pooling_type', 'max')
+    n, c, h, w = x.shape
+    outs = []
+    for level in range(height):
+        bins = 2 ** level
+        kh = -(-h // bins)          # ceil
+        kw = -(-w // bins)
+        ph_ = (kh * bins - h + 1) // 2
+        pw_ = (kw * bins - w + 1) // 2
+        level_out = _pool(x, (kh, kw), (kh, kw), (ph_, pw_), ptype,
+                          True, False, False, False)
+        outs.append(level_out.reshape(n, c * bins * bins))
+    ctx.out(op, 'Out', jnp.concatenate(outs, 1))
+
+
+# ---------------------------------------------------------------------------
+# conv_shift / modified huber / similarity focus / pn-pair
+# ---------------------------------------------------------------------------
+
+@register_op('conv_shift')
+def _conv_shift(ctx, op):
+    """reference conv_shift_op.cc (NTM circular convolution):
+    Out[i] = sum_j X[(i + j) mod M] * Y[j], j centered on 0."""
+    x = ctx.in1(op, 'X')            # [B, M]
+    y = ctx.in1(op, 'Y')            # [B, N], N odd
+    m = x.shape[1]
+    n = y.shape[1]
+    half = (n - 1) // 2
+    shifts = jnp.arange(m)[:, None] + (jnp.arange(n)[None, :] - half)
+    idx = jnp.mod(shifts, m)                       # [M, N]
+    gathered = x[:, idx]                           # [B, M, N]
+    ctx.out(op, 'Out', jnp.sum(gathered * y[:, None, :], -1))
+
+
+@register_op('modified_huber_loss')
+def _modified_huber_loss(ctx, op):
+    """reference modified_huber_loss_op.cc: binary labels in {0,1} mapped
+    to {-1,1}; quadratic inside the margin, linear outside."""
+    x = ctx.in1(op, 'X').reshape(-1)
+    y = ctx.in1(op, 'Y').reshape(-1).astype(x.dtype) * 2.0 - 1.0
+    prod = x * y
+    loss = jnp.where(prod >= -1.0,
+                     jnp.square(jnp.maximum(0.0, 1.0 - prod)),
+                     -4.0 * prod)
+    ctx.out(op, 'IntermediateVal', prod.reshape(-1, 1))
+    ctx.out(op, 'Out', loss.reshape(-1, 1))
+
+
+@register_op('similarity_focus')
+def _similarity_focus(ctx, op):
+    """reference similarity_focus_op.cc: greedy row/column-exclusive
+    argmax mask over the plane selected by (axis, indexes), broadcast to
+    X's shape."""
+    x = ctx.in1(op, 'X')            # [N, A, B, C]
+    axis = op.attr('axis')
+    indexes = [int(i) for i in op.attr('indexes')]
+    if axis not in (1, 2, 3):
+        raise ValueError("similarity_focus: axis must be 1, 2 or 3")
+
+    def greedy_mask(t):
+        """t [B, C] -> 0/1 mask with min(B, C) exclusive maxima."""
+        b, c = t.shape
+        k = min(b, c)
+
+        def body(_, state):
+            mask, rowf, colf = state
+            masked = jnp.where(rowf[:, None] & colf[None, :], t, -jnp.inf)
+            p = jnp.argmax(masked)
+            i, j = p // c, p % c
+            mask = mask.at[i, j].set(1.0)
+            rowf = rowf.at[i].set(False)
+            colf = colf.at[j].set(False)
+            return mask, rowf, colf
+
+        mask, _, _ = lax.fori_loop(
+            0, k, body, (jnp.zeros_like(t), jnp.ones((b,), bool),
+                         jnp.ones((c,), bool)))
+        return mask
+
+    moved = jnp.moveaxis(x, axis, 1)           # [N, S, P, Q]
+    planes = moved[:, jnp.asarray(indexes)]    # [N, len(idx), P, Q]
+    masks = jax.vmap(jax.vmap(greedy_mask))(planes)
+    combined = jnp.max(masks, axis=1)          # elementwise-or
+    out = jnp.broadcast_to(combined[:, None], moved.shape)
+    ctx.out(op, 'Out', jnp.moveaxis(out, 1, axis).astype(x.dtype))
+
+
+@register_op('positive_negative_pair')
+def _positive_negative_pair(ctx, op):
+    """reference positive_negative_pair_op.cc: count correctly/incorrectly
+    ordered (pos, neg) pairs per query for LTR eval. QueryID groups rows;
+    ties count as 0.5/0.5."""
+    score = ctx.in1(op, 'Score').reshape(-1)
+    label = ctx.in1(op, 'Label').reshape(-1)
+    qid = ctx.in1(op, 'QueryID').reshape(-1)
+    weight = ctx.in1(op, 'Weight')
+    acc_pos = ctx.in1(op, 'AccumulatePositivePair')
+    acc_neg = ctx.in1(op, 'AccumulateNegativePair')
+    acc_neu = ctx.in1(op, 'AccumulateNeutralPair')
+    accs = (acc_pos, acc_neg, acc_neu)
+    if any(a is not None for a in accs) and any(a is None for a in accs):
+        raise ValueError(
+            "positive_negative_pair: supply all three Accumulate* inputs "
+            "or none")
+
+    same_q = qid[:, None] == qid[None, :]
+    upper = jnp.triu(jnp.ones_like(same_q), 1)
+    pair = same_q & (upper > 0) & (label[:, None] != label[None, :])
+    if weight is not None:
+        wv = weight.reshape(-1)
+        pw = (wv[:, None] + wv[None, :]) * 0.5   # reference: mean weight
+    else:
+        pw = jnp.ones_like(score)[:, None] * jnp.ones_like(score)[None, :]
+    hi_first = label[:, None] > label[None, :]
+    s_hi = jnp.where(hi_first, score[:, None], score[None, :])
+    s_lo = jnp.where(hi_first, score[None, :], score[:, None])
+    pos = jnp.sum(jnp.where(pair & (s_hi > s_lo), pw, 0.0))
+    neg = jnp.sum(jnp.where(pair & (s_hi < s_lo), pw, 0.0))
+    neu = jnp.sum(jnp.where(pair & (s_hi == s_lo), pw, 0.0))
+    if acc_pos is not None:
+        pos = pos + acc_pos.reshape(())
+        neg = neg + acc_neg.reshape(())
+        neu = neu + acc_neu.reshape(())
+    ctx.out(op, 'PositivePair', pos.reshape(1))
+    ctx.out(op, 'NegativePair', neg.reshape(1))
+    ctx.out(op, 'NeutralPair', neu.reshape(1))
+
+
+@register_op('get_places')
+def _get_places(ctx, op):
+    """reference controlflow/get_places_op.cc: device-count constant (the
+    consumer ParallelDo is superseded by SPMD, but programs carrying the
+    op still lower)."""
+    count = op.attr('device_count', 0)
+    if not count:
+        count = len(jax.devices())
+    ctx.out(op, 'Out', jnp.arange(count, dtype=jnp.int32))
+
+
+# ---------------------------------------------------------------------------
+# tree_conv (TBCNN, reference tree_conv_op + math/tree2col)
+# ---------------------------------------------------------------------------
+
+def _tree_patch_maps(edges, max_node, max_depth):
+    """numpy port of Tree2ColUtil: per root node, the DFS patch (node,
+    eta_l, eta_r, eta_t) truncated at max_depth. Returns dense
+    [n_nodes, max_patch] index + [n_nodes, max_patch, 3] eta arrays."""
+    tr = {}
+    node_count = 0
+    for u, v in edges:
+        if u == 0 or v == 0:
+            break
+        tr.setdefault(int(u), []).append(int(v))
+        node_count += 1
+    node_count += 1
+
+    patches = []
+    for root in range(1, node_count + 1):
+        # iterative DFS mirroring construct_patch
+        patch = [(root, 1, 1, 0)]
+        stack = [(root, 1, 1, 0)]
+        visited = {root}
+        while stack:
+            node, _, _, depth = stack[-1]
+            children = tr.get(node, [])
+            advanced = False
+            for i, v in enumerate(children):
+                if v not in visited and depth + 1 < max_depth:
+                    visited.add(v)
+                    stack.append((v, i, len(children), depth + 1))
+                    patch.append((v, i + 1, len(children), depth + 1))
+                    advanced = True
+            if not advanced:
+                stack.pop()
+        patches.append(patch)
+
+    max_patch = max(len(p) for p in patches)
+    idx = np.zeros((len(patches), max_patch), np.int32)
+    eta = np.zeros((len(patches), max_patch, 3), np.float32)
+    for r, patch in enumerate(patches):
+        for k, (node, index, pclen, depth) in enumerate(patch):
+            # reference math/tree2col.h eta formulas
+            eta_t = (max_depth - depth) / max_depth
+            tmp = 0.5 if pclen == 1 else (index - 1.0) / (pclen - 1.0)
+            eta_l = (1.0 - eta_t) * tmp
+            eta_r = (1.0 - eta_t) * (1.0 - eta_l)
+            idx[r, k] = node - 1
+            eta[r, k] = (eta_l, eta_r, eta_t)
+    return idx, eta, len(patches)
+
+
+@register_op('tree_conv', static_inputs=('EdgeSet',))
+def _tree_conv(ctx, op):
+    """reference tree_conv_op.h: per sample, tree2col builds a
+    [nodes, 3*F] patch matrix (eta-weighted sums over each node's
+    max_depth neighborhood), then patch @ Filter. The tree structure
+    (EdgeSet) binds statically — the static-LoD policy applied to trees."""
+    nodes = ctx.in1(op, 'NodesVector')     # [N, max_nodes, F]
+    filt = ctx.in1(op, 'Filter')           # [F, 3, out_size, num_filters]
+    edges = ctx.in1_static(op, 'EdgeSet')  # [N, max_edges, 2] static
+    max_depth = op.attr('max_depth')
+    n, max_nodes, f = nodes.shape
+    out_size, num_filters = filt.shape[2], filt.shape[3]
+    w = jnp.reshape(filt, (f * 3, out_size * num_filters))
+
+    outs = []
+    for b in range(n):
+        idx, eta, n_nodes = _tree_patch_maps(
+            np.asarray(edges[b]).reshape(-1, 2), max_nodes, max_depth)
+        feats = nodes[b][jnp.asarray(idx)]          # [nodes, P, F]
+        etas = jnp.asarray(eta)                     # [nodes, P, 3]
+        # patch[:, i*3+k] = sum_p eta[p,k] * feat[p,i]
+        patch = jnp.einsum('npf,npk->nfk', feats, etas)  # [nodes, F, 3]
+        patch = patch.reshape(n_nodes, f * 3)
+        out = patch @ w                              # [nodes, OS*NF]
+        pad = jnp.zeros((max_nodes - n_nodes, out.shape[1]), out.dtype)
+        outs.append(jnp.concatenate([out, pad], 0))
+    out = jnp.stack(outs).reshape(n, max_nodes, out_size, num_filters)
+    ctx.out(op, 'Out', out)
+
+
+# ---------------------------------------------------------------------------
+# py_func: host callback (reference py_func_op.cc, SURVEY §7 hard part 7)
+# ---------------------------------------------------------------------------
+
+_py_func_registry = []
+
+
+def register_py_func(fn):
+    _py_func_registry.append(fn)
+    return len(_py_func_registry) - 1
+
+
+@register_op('py_func')
+def _py_func(ctx, op):
+    """reference operators/py_func_op.cc: call a registered Python callable
+    on host with the op's inputs; outputs' shapes/dtypes come from the
+    declared out vars. Lowers to jax.pure_callback; with a registered
+    backward callable the grad is a second pure_callback (reference
+    py_func grad registration)."""
+    xs = ctx.in_list(op, 'X')
+    fwd_id = op.attr('forward_callable_id')
+    bwd_id = op.attr('backward_callable_id', -1)
+    out_names = op.output('Out')
+    shapes, dtypes = [], []
+    for nm in out_names:
+        v = ctx.var(nm)
+        if v is None or v.shape is None or any(
+                d is None or d < 0 for d in v.shape):
+            raise ValueError(
+                "py_func output %r needs a fully-known static shape "
+                "(host callbacks cannot infer shapes under XLA)" % nm)
+        shapes.append(tuple(v.shape))
+        dtypes.append(v.dtype)
+    result_spec = tuple(jax.ShapeDtypeStruct(s, d)
+                        for s, d in zip(shapes, dtypes))
+    fwd = _py_func_registry[fwd_id]
+
+    def host_call(*arrays):
+        out = fwd(*arrays)
+        out = out if isinstance(out, (list, tuple)) else [out]
+        return tuple(np.asarray(o).astype(d.dtype).reshape(d.shape)
+                     for o, d in zip(out, result_spec))
+
+    if bwd_id < 0:
+        outs = jax.pure_callback(host_call, result_spec, *xs)
+    else:
+        bwd = _py_func_registry[bwd_id]
+        # reference py_func_op.cc backward: callable receives (forward
+        # inputs minus skip_vars_in_backward_input) + forward outputs +
+        # output grads, and returns a grad per (non-skipped) input; skipped
+        # inputs get zero grads
+        skip = set(op.attr('backward_skip_inputs', []) or [])
+        in_names = op.input('X')
+        keep_idx = [i for i, nm in enumerate(in_names) if nm not in skip]
+
+        @jax.custom_vjp
+        def call(*args):
+            return jax.pure_callback(host_call, result_spec, *args)
+
+        def call_fwd(*args):
+            outs = jax.pure_callback(host_call, result_spec, *args)
+            return outs, (args, outs)
+
+        def call_bwd(res, cts):
+            args, outs_v = res
+            in_spec = tuple(jax.ShapeDtypeStruct(a.shape, a.dtype)
+                            for a in args)
+            kept_spec = tuple(in_spec[i] for i in keep_idx)
+
+            def host_grad(*arrays):
+                grads = bwd(*arrays)
+                grads = grads if isinstance(grads, (list, tuple)) \
+                    else [grads]
+                return tuple(
+                    np.asarray(g).astype(s.dtype).reshape(s.shape)
+                    for g, s in zip(grads, kept_spec))
+
+            kept_args = tuple(args[i] for i in keep_idx)
+            kept_grads = jax.pure_callback(host_grad, kept_spec,
+                                           *kept_args, *outs_v, *cts)
+            full = [jnp.zeros(s.shape, s.dtype) for s in in_spec]
+            for i, g in zip(keep_idx, kept_grads):
+                full[i] = g
+            return tuple(full)
+
+        call.defvjp(call_fwd, call_bwd)
+        outs = call(*xs)
+
+    outs = outs if isinstance(outs, (list, tuple)) else [outs]
+    for nm, o in zip(out_names, outs):
+        ctx.set(nm, o)

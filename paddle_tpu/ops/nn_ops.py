@@ -221,14 +221,21 @@ def _pool3d(ctx, op):
 
 @register_op('max_pool2d_with_index')
 def _max_pool2d_with_index(ctx, op):
+    """reference pool_with_index_op.cc: Mask carries real flat argmax
+    positions into H*W (consumed by unpool)."""
+    from .misc_ops import _pool_with_index
     x = ctx.in1(op, 'X')
     ksize = _pair(op.attr('ksize'))
     strides = _pair(op.attr('strides', [1, 1]))
     pads = _pair(op.attr('paddings', [0, 0]))
-    out = _pool(x, ksize, strides, pads, 'max', True, False,
-                op.attr('global_pooling', False), False)
-    ctx.out(op, 'Out', out)
-    ctx.out(op, 'Mask', jnp.zeros_like(out, dtype=jnp.int32))
+    if op.attr('global_pooling', False):
+        ksize = x.shape[-2:]
+        strides = (1, 1)
+        pads = (0, 0)
+    vals, mask = _pool_with_index(x, ksize, strides, pads,
+                                  adaptive=op.attr('adaptive', False))
+    ctx.out(op, 'Out', vals)
+    ctx.out(op, 'Mask', mask)
 
 
 # ---------------------------------------------------------------------------

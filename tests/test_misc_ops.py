@@ -1,0 +1,255 @@
+"""Long-tail ops (reference test_fc_op, test_conv3d_transpose_op,
+test_pool_max_op, test_unpool_op, test_spp_op, test_conv_shift_op,
+test_modified_huber_loss_op, test_similarity_focus_op, test_tree_conv_op,
+test_positive_negative_pair_op, test_py_func_op patterns)."""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.framework import Program, program_guard
+
+from test_detection_ops import _run_single_op
+
+
+class TestFcOp(object):
+    def test_matches_matmul(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(4, 6).astype(np.float32)
+        w = rng.randn(6, 3).astype(np.float32)
+        b = rng.randn(3).astype(np.float32)
+        out, = _run_single_op(
+            'fc', {'Input': x, 'W': w, 'Bias': b}, {'Out': ['fc_out']},
+            {'in_num_col_dims': 1})
+        np.testing.assert_allclose(out, x @ w + b, rtol=1e-5, atol=1e-5)
+
+
+class TestConv3dTranspose(object):
+    def test_inverts_stride1_shapes(self):
+        rng = np.random.RandomState(1)
+        x = rng.randn(1, 2, 3, 4, 4).astype(np.float32)
+        w = rng.randn(2, 3, 2, 2, 2).astype(np.float32)
+        out, = _run_single_op(
+            'conv3d_transpose', {'Input': x, 'Filter': w},
+            {'Output': ['c3t_out']},
+            {'strides': [2, 2, 2], 'paddings': [0, 0, 0],
+             'dilations': [1, 1, 1], 'groups': 1})
+        # (D-1)*s + k = 2*2+2 = 6; 3*2+2=8
+        assert out.shape == (1, 3, 6, 8, 8)
+        # spot value: out[0, :, 0, 0, 0] = x[0, :, 0, 0, 0] @ w[:, :, 0, 0, 0]
+        np.testing.assert_allclose(
+            out[0, :, 0, 0, 0], x[0, :, 0, 0, 0] @ w[:, :, 0, 0, 0],
+            rtol=1e-4, atol=1e-5)
+
+
+class TestPoolWithIndexAndUnpool(object):
+    def test_mask_and_unpool_roundtrip(self):
+        rng = np.random.RandomState(2)
+        x = rng.randn(2, 3, 4, 4).astype(np.float32)
+        out, mask = _run_single_op(
+            'max_pool2d_with_index', {'X': x},
+            {'Out': ['mpi_out'], 'Mask': ['mpi_mask']},
+            {'ksize': [2, 2], 'strides': [2, 2], 'paddings': [0, 0]})
+        assert out.shape == (2, 3, 2, 2)
+        # mask points at the argmax positions
+        for n in range(2):
+            for c in range(3):
+                for i in range(2):
+                    for j in range(2):
+                        win = x[n, c, 2*i:2*i+2, 2*j:2*j+2]
+                        assert out[n, c, i, j] == win.max()
+                        fi = int(mask[n, c, i, j])
+                        assert x[n, c].reshape(-1)[fi] == win.max()
+
+        # unpool scatters back
+        up, = _run_single_op(
+            'unpool', {'X': out, 'Indices': mask.astype(np.int32)},
+            {'Out': ['up_out']},
+            {'ksize': [2, 2], 'strides': [2, 2], 'paddings': [0, 0]})
+        assert up.shape == x.shape
+        # each max value is restored at its position; others zero
+        restored = (up != 0).sum()
+        assert restored <= 2 * 3 * 4
+        for n in range(2):
+            for c in range(3):
+                for i in range(2):
+                    for j in range(2):
+                        fi = int(mask[n, c, i, j])
+                        assert up[n, c].reshape(-1)[fi] == out[n, c, i, j]
+
+    def test_pool3d_with_index(self):
+        rng = np.random.RandomState(3)
+        x = rng.randn(1, 2, 4, 4, 4).astype(np.float32)
+        out, mask = _run_single_op(
+            'max_pool3d_with_index', {'X': x},
+            {'Out': ['mp3_out'], 'Mask': ['mp3_mask']},
+            {'ksize': [2, 2, 2], 'strides': [2, 2, 2],
+             'paddings': [0, 0, 0]})
+        assert out.shape == (1, 2, 2, 2, 2)
+        ref = x.reshape(1, 2, 2, 2, 2, 2, 2, 2).transpose(
+            0, 1, 2, 4, 6, 3, 5, 7).reshape(1, 2, 2, 2, 2, 8).max(-1)
+        np.testing.assert_allclose(out, ref, rtol=1e-6)
+
+
+class TestSpp(object):
+    def test_pyramid_sizes_and_values(self):
+        rng = np.random.RandomState(4)
+        x = rng.randn(2, 3, 4, 4).astype(np.float32)
+        out, = _run_single_op(
+            'spp', {'X': x}, {'Out': ['spp_out']},
+            {'pyramid_height': 2, 'pooling_type': 'max'})
+        # level0: 1x1 bins (3 ch) + level1: 2x2 bins (12) = 15 per sample
+        assert out.shape == (2, 3 * (1 + 4))
+        np.testing.assert_allclose(out[:, :3], x.max(axis=(2, 3)),
+                                   rtol=1e-6)
+
+
+class TestConvShift(object):
+    def test_circular_conv(self):
+        x = np.array([[1., 2., 3., 4., 5.]], np.float32)
+        y = np.array([[1., 0., 2.]], np.float32)   # j in {-1, 0, 1}
+        out, = _run_single_op(
+            'conv_shift', {'X': x, 'Y': y}, {'Out': ['cs_out']}, {})
+        # Out[i] = X[i-1]*Y[0](w=1) + X[i]*0 + X[i+1]*2
+        ref = np.array([[5 * 1 + 2 * 2, 1 + 3 * 2, 2 + 4 * 2, 3 + 5 * 2,
+                         4 + 1 * 2]], np.float32)
+        np.testing.assert_allclose(out, ref, rtol=1e-6)
+
+
+class TestModifiedHuber(object):
+    def test_matches_formula(self):
+        x = np.array([[2.0], [0.5], [-3.0]], np.float32)
+        y = np.array([[1], [0], [1]], np.float32)   # -> {1, -1, 1}
+        inter, out = _run_single_op(
+            'modified_huber_loss', {'X': x, 'Y': y},
+            {'IntermediateVal': ['mh_i'], 'Out': ['mh_out']}, {})
+        # yf = [2.0, -0.5, -3.0]
+        ref = [0.0, (1 - (-0.5)) ** 2, 12.0]
+        np.testing.assert_allclose(out.reshape(-1), ref, rtol=1e-5)
+
+
+class TestSimilarityFocus(object):
+    def test_exclusive_maxima(self):
+        x = np.zeros((1, 2, 3, 3), np.float32)
+        x[0, 0] = [[9, 1, 1], [1, 8, 1], [1, 1, 7]]
+        x[0, 1] = [[1, 1, 1], [1, 1, 1], [1, 1, 1]]
+        out, = _run_single_op(
+            'similarity_focus', {'X': x}, {'Out': ['sf_out']},
+            {'axis': 1, 'indexes': [0]})
+        assert out.shape == x.shape
+        # diagonal selected, broadcast over channel axis
+        mask = out[0, 0]
+        np.testing.assert_array_equal(mask, np.eye(3, dtype=np.float32))
+        np.testing.assert_array_equal(out[0, 1], np.eye(3,
+                                                        dtype=np.float32))
+
+
+class TestPositiveNegativePair(object):
+    def test_counts(self):
+        score = np.array([[0.9], [0.2], [0.5], [0.5]], np.float32)
+        label = np.array([[1.0], [0.0], [1.0], [0.0]], np.float32)
+        qid = np.array([[0], [0], [1], [1]], np.int32)
+        pos, neg, neu = _run_single_op(
+            'positive_negative_pair',
+            {'Score': score, 'Label': label, 'QueryID': qid},
+            {'PositivePair': ['pp'], 'NegativePair': ['np_'],
+             'NeutralPair': ['up']}, {})
+        # q0: (0.9 pos > 0.2 neg) correct; q1: tie
+        assert float(pos[0]) == 1.0
+        assert float(neg[0]) == 0.0
+        assert float(neu[0]) == 1.0
+
+
+class TestTreeConv(object):
+    def test_shapes_and_root_patch(self):
+        rng = np.random.RandomState(5)
+        # one tree: 1 -> (2, 3)
+        edges = np.array([[[1, 2], [1, 3], [0, 0]]], np.int32)
+        n_nodes, f = 3, 4
+        nodes = rng.randn(1, n_nodes, f).astype(np.float32)
+        filt = rng.randn(f, 3, 2, 5).astype(np.float32)
+        out, = _run_single_op(
+            'tree_conv',
+            {'NodesVector': nodes, 'EdgeSet': edges, 'Filter': filt},
+            {'Out': ['tc_out']}, {'max_depth': 2})
+        assert out.shape == (1, 3, 2, 5)
+        assert np.isfinite(out).all()
+        # leaf node 3 at max_depth 2: patch = itself only (eta_t=1)
+        patch3 = np.zeros(3 * f, np.float32)
+        patch3[2::3] = nodes[0, 2]      # eta_t slot
+        ref3 = patch3 @ filt.transpose(0, 1, 2, 3).reshape(f * 3, 10)
+        np.testing.assert_allclose(out[0, 2].reshape(-1), ref3,
+                                   rtol=1e-4, atol=1e-4)
+
+
+class TestPyFunc(object):
+    def test_forward_host_callback(self):
+        def host_fn(a):
+            return np.tanh(a) + 1.0
+
+        x = fluid.layers.data(name='x', shape=[3, 4], dtype='float32')
+        out_var = fluid.default_main_program().global_block().create_var(
+            name='pyf_out', shape=(3, 4), dtype='float32')
+        fluid.layers.py_func(host_fn, x, out_var)
+        exe = fluid.Executor(fluid.CPUPlace())
+        X = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+        o, = exe.run(feed={'x': X}, fetch_list=[out_var])
+        np.testing.assert_allclose(o, np.tanh(X) + 1.0, rtol=1e-5)
+
+    def test_backward_host_callback_trains(self):
+        """py_func with a custom backward participates in training."""
+        def fwd(a):
+            return a * a
+
+        def bwd(a, out, g):
+            # receives (inputs, outputs, out_grads) like reference py_func
+            return 2.0 * a * g
+
+        prog, startup = Program(), Program()
+        with program_guard(prog, startup):
+            x = fluid.layers.data(name='x', shape=[1], dtype='float32')
+            h = fluid.layers.fc(x, size=1,
+                                param_attr='pyf_w', bias_attr=False)
+            sq = prog.global_block().create_var(
+                name='pyf_sq', shape=(4, 1), dtype='float32')
+            fluid.layers.py_func(fwd, h, sq, backward_func=bwd)
+            loss = fluid.layers.mean(sq)
+            fluid.optimizer.SGD(0.1).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        X = np.ones((4, 1), np.float32)
+        losses = []
+        for _ in range(10):
+            l, = exe.run(prog, feed={'x': X}, fetch_list=[loss])
+            losses.append(float(np.asarray(l).reshape(())))
+        assert all(np.isfinite(v) for v in losses)
+        assert losses[-1] < losses[0]     # w -> 0 minimizes (w*x)^2
+
+    def test_requires_static_shape(self):
+        x = fluid.layers.data(name='x', shape=[-1, 4], dtype='float32')
+        out_var = fluid.default_main_program().global_block().create_var(
+            name='pyf_bad', shape=(-1, 4), dtype='float32')
+        fluid.layers.py_func(lambda a: a, x, out_var)
+        exe = fluid.Executor(fluid.CPUPlace())
+        with pytest.raises(ValueError, match="static shape"):
+            exe.run(feed={'x': np.zeros((2, 4), np.float32)},
+                    fetch_list=[out_var])
+
+
+    def test_adaptive_pool_with_index(self):
+        rng = np.random.RandomState(7)
+        x = rng.randn(1, 2, 6, 6).astype(np.float32)
+        out, mask = _run_single_op(
+            'max_pool2d_with_index', {'X': x},
+            {'Out': ['ap_out'], 'Mask': ['ap_mask']},
+            {'ksize': [4, 4], 'strides': [1, 1], 'paddings': [0, 0],
+             'adaptive': True})
+        assert out.shape == (1, 2, 4, 4)
+        # windows: start=floor(i*6/4), end=ceil((i+1)*6/4)
+        for i in range(4):
+            s, e = (i * 6) // 4, -((-(i + 1) * 6) // 4)
+            for j in range(4):
+                sj, ej = (j * 6) // 4, -((-(j + 1) * 6) // 4)
+                win = x[0, 0, s:e, sj:ej]
+                assert out[0, 0, i, j] == win.max()
