@@ -1,0 +1,185 @@
+"""AsyncExecutor + MultiSlotDataFeed: the file-driven multi-threaded
+trainer (reference framework/async_executor.{h,cc}:60,236,
+framework/data_feed.{h,cc}:49 MultiSlotDataFeed, data_feed.proto,
+python async_executor.py:79).
+
+TPU-native redesign: the reference runs one serial-executor THREAD per
+in-process worker, each pulling parsed batches from its DataFeed — thread
+parallelism substitutes for device parallelism. Under XLA the compiled
+step is already data-parallel across the device mesh, so here host
+threads do the expensive part they are actually good at (file parsing /
+batch assembly) and feed a single device stream through a bounded queue;
+`thread_num` controls the parser pool. The reference's Downpour/pslib
+async parameter-server mode has no TPU analog and is intentionally not
+provided (SURVEY §2.7: map CTR workloads to sync SPMD + sparse/sharded
+embeddings).
+
+MultiSlotDataFeed text format (reference data_feed.cc
+MultiSlotDataFeed::ParseOneInstance): each line is one sample; for every
+slot in order: <n> <v_1> ... <v_n>, uint64 slots ragged (fed with LoD),
+float dense slots fixed-width.
+"""
+import queue
+import threading
+
+import numpy as np
+
+from .framework import default_main_program
+from .executor import Executor, global_scope
+
+__all__ = ['DataFeedDesc', 'MultiSlotDataFeed', 'AsyncExecutor']
+
+
+class DataFeedDesc(object):
+    """Feed schema (reference data_feed.proto DataFeedDesc): ordered slots
+    with name / type ('uint64' | 'float') / is_dense / is_used."""
+
+    def __init__(self, batch_size=32):
+        self.batch_size = batch_size
+        self.slots = []
+
+    def add_slot(self, name, type='uint64', is_dense=False, is_used=True):
+        if type not in ('uint64', 'float'):
+            raise ValueError("slot type must be 'uint64' or 'float', got %r"
+                             % type)
+        self.slots.append({'name': name, 'type': type,
+                           'is_dense': bool(is_dense),
+                           'is_used': bool(is_used)})
+        return self
+
+    def set_batch_size(self, batch_size):
+        self.batch_size = int(batch_size)
+
+
+class MultiSlotDataFeed(object):
+    """Parses MultiSlot text files into executor feed dicts."""
+
+    def __init__(self, desc):
+        self.desc = desc
+
+    def parse_line(self, line):
+        """One sample: {slot_name: ndarray} following the slot schema."""
+        toks = line.split()
+        pos = 0
+        sample = {}
+        for slot in self.desc.slots:
+            if pos >= len(toks):
+                raise ValueError(
+                    "MultiSlotDataFeed: line ended before slot %r "
+                    "(reference data_feed.cc CheckFile format: "
+                    "<n> <v...> per slot)" % slot['name'])
+            n = int(toks[pos])
+            pos += 1
+            vals = toks[pos:pos + n]
+            if len(vals) != n:
+                raise ValueError(
+                    "MultiSlotDataFeed: slot %r declares %d values, line "
+                    "has %d" % (slot['name'], n, len(vals)))
+            pos += n
+            if not slot['is_used']:
+                continue
+            if slot['type'] == 'uint64':
+                sample[slot['name']] = np.asarray(vals, np.int64)
+            else:
+                sample[slot['name']] = np.asarray(vals, np.float32)
+        return sample
+
+    def batches_from_file(self, path):
+        """Yield feed dicts of up to batch_size samples. Ragged uint64
+        slots become (values [total, 1], lod) pairs; dense slots stack."""
+        batch = []
+        with open(path, 'r') as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                batch.append(self.parse_line(line))
+                if len(batch) >= self.desc.batch_size:
+                    yield self._assemble(batch)
+                    batch = []
+        if batch:
+            yield self._assemble(batch)
+
+    def _assemble(self, samples):
+        feed = {}
+        for slot in self.desc.slots:
+            if not slot['is_used']:
+                continue
+            name = slot['name']
+            vals = [s[name] for s in samples]
+            if slot['is_dense']:
+                feed[name] = np.stack(vals).astype(
+                    np.float32 if slot['type'] == 'float' else np.int64)
+            else:
+                offsets = [0]
+                for v in vals:
+                    offsets.append(offsets[-1] + len(v))
+                flat = np.concatenate(vals).reshape(-1, 1)
+                feed[name] = (flat, [offsets])
+        return feed
+
+
+class AsyncExecutor(object):
+    """File-driven trainer (reference async_executor.cc RunFromFile):
+    `thread_num` parser threads stream files into a bounded queue; the
+    main thread drives the compiled XLA step per batch."""
+
+    def __init__(self, place=None, scope=None):
+        self.executor = Executor(place)
+        self.scope = scope
+
+    def run(self, program, data_feed, filelist, thread_num=2,
+            fetch_list=None, debug=False, queue_size=16):
+        if isinstance(data_feed, DataFeedDesc):
+            data_feed = MultiSlotDataFeed(data_feed)
+        program = program if program is not None else \
+            default_main_program()
+        scope = self.scope if self.scope is not None else global_scope()
+        thread_num = max(1, int(thread_num))
+
+        files = queue.Queue()
+        for p in filelist:
+            files.put(p)
+        batches = queue.Queue(maxsize=queue_size)
+        errors = []
+
+        def parser():
+            while True:
+                try:
+                    path = files.get_nowait()
+                except queue.Empty:
+                    return
+                try:
+                    for feed in data_feed.batches_from_file(path):
+                        batches.put(feed)
+                except Exception as e:   # surface on the main thread
+                    errors.append(e)
+                    return
+
+        threads = [threading.Thread(target=parser, daemon=True)
+                   for _ in range(min(thread_num, len(filelist) or 1))]
+        for t in threads:
+            t.start()
+
+        results = []
+        alive = lambda: any(t.is_alive() for t in threads)
+        while True:
+            try:
+                feed = batches.get(timeout=0.05)
+            except queue.Empty:
+                if errors:
+                    raise errors[0]
+                if not alive():
+                    break
+                continue
+            out = self.executor.run(program, feed=feed,
+                                    fetch_list=fetch_list, scope=scope)
+            if fetch_list:
+                results.append(out)
+                if debug:
+                    print("AsyncExecutor step %d: %s"
+                          % (len(results), [np.asarray(o).reshape(-1)[:1]
+                                            for o in out]))
+        if errors:
+            raise errors[0]
+        return results

@@ -70,8 +70,9 @@ def _conv3d_transpose(ctx, op):
     ks = [(w.shape[2 + i] - 1) * dilations[i] + 1 for i in range(3)]
     out_dtype = x.dtype
     x, w = amp.cast_compute(op, x, w)
+    from .nn_ops import _transpose_kernel
     out = lax.conv_general_dilated(
-        x, jnp.swapaxes(w, 0, 1)[:, :, ::-1, ::-1, ::-1],
+        x, _transpose_kernel(w, groups, 3),
         window_strides=(1, 1, 1),
         padding=[(k - 1 - p, k - 1 - p) for k, p in zip(ks, pads)],
         lhs_dilation=strides, rhs_dilation=dilations,
@@ -79,6 +80,48 @@ def _conv3d_transpose(ctx, op):
         feature_group_count=groups,
         preferred_element_type=amp.accum_dtype(x))
     ctx.out(op, 'Output', out.astype(out_dtype))
+
+
+def _gathered_max(x, flat_idx, flat_valid, out_sz, nsp):
+    """Shared tail of the pool-with-index gather: masked max + flat argmax
+    position per output cell."""
+    spatial = x.shape[-nsp:]
+    lead = x.shape[:-nsp]
+    xf = x.reshape(lead + (int(np.prod(spatial)),))
+    taps = jnp.take(xf, jnp.asarray(flat_idx), axis=-1)    # [..., O, K]
+    neg = jnp.asarray(-jnp.inf, x.dtype)
+    taps = jnp.where(jnp.asarray(flat_valid), taps, neg)
+    vals = jnp.max(taps, -1)
+    arg = jnp.argmax(taps, -1)
+    # per output position o: flat_idx[o, arg[..., o]]
+    flat_pos = jnp.take_along_axis(
+        jnp.broadcast_to(jnp.asarray(flat_idx), vals.shape + (
+            flat_idx.shape[1],)), arg[..., None], axis=-1)[..., 0]
+    return (vals.reshape(lead + tuple(out_sz)),
+            flat_pos.reshape(lead + tuple(out_sz)).astype(jnp.int32))
+
+
+def _window_maps(out_sz, starts, wins, spatial, ends=None, pads_valid=True):
+    """Flat gather map [prod(out), prod(win)] + validity mask: coord =
+    start + win offset, valid while < end (adaptive) or inside the plane
+    (fixed windows)."""
+    nsp = len(spatial)
+    idx = None
+    valid = None
+    for i in range(nsp):
+        coord = starts[i].reshape(starts[i].shape + (1,) * nsp) + \
+            wins[i].reshape((1,) * nsp + wins[i].shape)
+        if ends is not None:
+            ok = coord < ends[i].reshape(ends[i].shape + (1,) * nsp)
+        else:
+            ok = (coord >= 0) & (coord < spatial[i])
+        flat = np.clip(coord, 0, spatial[i] - 1)
+        idx = flat if idx is None else idx * spatial[i] + flat
+        valid = ok if valid is None else (valid & ok)
+    k = int(np.prod([w.shape[i] for i, w in enumerate(wins)])) if wins \
+        else 1
+    n_out = int(np.prod(out_sz))
+    return idx.reshape(n_out, -1), valid.reshape(n_out, -1)
 
 
 def _pool_with_index(x, ksize, strides, pads, adaptive=False):
@@ -102,66 +145,19 @@ def _pool_with_index(x, ksize, strides, pads, adaptive=False):
         kmax = [max(e - s for s, e in zip(*d)) for d in per_dim]
         grids = np.meshgrid(*[np.arange(o) for o in out_sz],
                             indexing='ij')
-        starts = [np.asarray(per_dim[i][0])[grids[i]]
-                  for i in range(nsp)]
+        starts = [np.asarray(per_dim[i][0])[grids[i]] for i in range(nsp)]
         ends = [np.asarray(per_dim[i][1])[grids[i]] for i in range(nsp)]
         wins = np.meshgrid(*[np.arange(k) for k in kmax], indexing='ij')
-        idx = None
-        valid = None
-        for i in range(nsp):
-            coord = starts[i].reshape(starts[i].shape + (1,) * nsp) + \
-                wins[i].reshape((1,) * nsp + wins[i].shape)
-            ok = coord < ends[i].reshape(ends[i].shape + (1,) * nsp)
-            flat = np.clip(coord, 0, spatial[i] - 1)
-            idx = flat if idx is None else idx * spatial[i] + flat
-            valid = ok if valid is None else (valid & ok)
-        flat_idx = idx.reshape(int(np.prod(out_sz)), int(np.prod(kmax)))
-        flat_valid = valid.reshape(flat_idx.shape)
-        lead = x.shape[:-nsp]
-        xf = x.reshape(lead + (int(np.prod(spatial)),))
-        taps = jnp.take(xf, jnp.asarray(flat_idx), axis=-1)
-        neg = jnp.asarray(-jnp.inf, x.dtype)
-        taps = jnp.where(jnp.asarray(flat_valid), taps, neg)
-        vals = jnp.max(taps, -1)
-        arg = jnp.argmax(taps, -1)
-        flat_pos = jnp.take_along_axis(
-            jnp.broadcast_to(jnp.asarray(flat_idx), vals.shape + (
-                flat_idx.shape[1],)), arg[..., None], axis=-1)[..., 0]
-        return (vals.reshape(lead + tuple(out_sz)),
-                flat_pos.reshape(lead + tuple(out_sz)).astype(jnp.int32))
-    out_sz = [(spatial[i] + 2 * pads[i] - ksize[i]) // strides[i] + 1
-              for i in range(nsp)]
-
-    # flat gather map [prod(out_sz), prod(ksize)] into the flat spatial
-    # plane; -1 marks out-of-range (padding) taps
-    grids = np.meshgrid(*[np.arange(o) for o in out_sz], indexing='ij')
-    starts = [g * strides[i] - pads[i] for i, g in enumerate(grids)]
-    wins = np.meshgrid(*[np.arange(k) for k in ksize], indexing='ij')
-    idx = None
-    valid = None
-    for i in range(nsp):
-        coord = starts[i].reshape(starts[i].shape + (1,) * nsp) + \
-            wins[i].reshape((1,) * nsp + wins[i].shape)
-        ok = (coord >= 0) & (coord < spatial[i])
-        flat = np.clip(coord, 0, spatial[i] - 1)
-        idx = flat if idx is None else idx * spatial[i] + flat
-        valid = ok if valid is None else (valid & ok)
-    flat_idx = idx.reshape(int(np.prod(out_sz)), int(np.prod(ksize)))
-    flat_valid = valid.reshape(flat_idx.shape)
-
-    lead = x.shape[:-nsp]
-    xf = x.reshape(lead + (int(np.prod(spatial)),))
-    taps = jnp.take(xf, jnp.asarray(flat_idx), axis=-1)    # [..., O, K]
-    neg = jnp.asarray(-jnp.inf, x.dtype)
-    taps = jnp.where(jnp.asarray(flat_valid), taps, neg)
-    vals = jnp.max(taps, -1)
-    arg = jnp.argmax(taps, -1)
-    # per output position o: flat_idx[o, arg[..., o]]
-    flat_pos = jnp.take_along_axis(
-        jnp.broadcast_to(jnp.asarray(flat_idx), vals.shape + (
-            flat_idx.shape[1],)), arg[..., None], axis=-1)[..., 0]
-    return (vals.reshape(lead + tuple(out_sz)),
-            flat_pos.reshape(lead + tuple(out_sz)).astype(jnp.int32))
+        flat_idx, flat_valid = _window_maps(out_sz, starts, wins, spatial,
+                                            ends=ends)
+    else:
+        out_sz = [(spatial[i] + 2 * pads[i] - ksize[i]) // strides[i] + 1
+                  for i in range(nsp)]
+        grids = np.meshgrid(*[np.arange(o) for o in out_sz], indexing='ij')
+        starts = [g * strides[i] - pads[i] for i, g in enumerate(grids)]
+        wins = np.meshgrid(*[np.arange(k) for k in ksize], indexing='ij')
+        flat_idx, flat_valid = _window_maps(out_sz, starts, wins, spatial)
+    return _gathered_max(x, flat_idx, flat_valid, out_sz, nsp)
 
 
 @register_op('max_pool3d_with_index')
@@ -371,6 +367,10 @@ def _tree_patch_maps(edges, max_node, max_depth):
         tr.setdefault(int(u), []).append(int(v))
         node_count += 1
     node_count += 1
+    if node_count > max_node:
+        raise ValueError(
+            "tree_conv: EdgeSet implies %d nodes but NodesVector has "
+            "only %d rows" % (node_count, max_node))
 
     patches = []
     for root in range(1, node_count + 1):
@@ -472,6 +472,13 @@ def _py_func(ctx, op):
         dtypes.append(v.dtype)
     result_spec = tuple(jax.ShapeDtypeStruct(s, d)
                         for s, d in zip(shapes, dtypes))
+    if fwd_id >= len(_py_func_registry) or \
+            (bwd_id >= 0 and bwd_id >= len(_py_func_registry)):
+        raise ValueError(
+            "py_func callable id %d is not registered in this process — "
+            "py_func programs are not serializable across processes; "
+            "rebuild the program (layers.py_func re-registers the "
+            "callables)" % max(fwd_id, bwd_id))
     fwd = _py_func_registry[fwd_id]
 
     def host_call(*arrays):

@@ -127,6 +127,23 @@ def _conv3d(ctx, op):
     ctx.out(op, 'Output', out.astype(out_dtype))
 
 
+def _transpose_kernel(w, groups, n_sp):
+    """(C_in, C_out/g, k...) deconv filter -> (C_out, C_in/g, k...) conv
+    kernel with flipped spatial dims, handling groups (reference
+    conv_transpose_op.cc grouped deconvolution)."""
+    c_in = w.shape[0]
+    c_out_g = w.shape[1]
+    sp = w.shape[2:]
+    if groups == 1:
+        k = jnp.swapaxes(w, 0, 1)
+    else:
+        k = w.reshape((groups, c_in // groups, c_out_g) + sp)
+        k = jnp.swapaxes(k, 1, 2)
+        k = k.reshape((groups * c_out_g, c_in // groups) + sp)
+    flip = (slice(None), slice(None)) + (slice(None, None, -1),) * n_sp
+    return k[flip]
+
+
 @register_op('conv2d_transpose')
 def _conv2d_transpose(ctx, op):
     x = ctx.in1(op, 'Input')       # NCHW
@@ -141,7 +158,7 @@ def _conv2d_transpose(ctx, op):
     x, w = amp.cast_compute(op, x, w)
     # gradient-of-conv formulation: lhs-dilate input by stride
     out = lax.conv_general_dilated(
-        x, jnp.swapaxes(w, 0, 1)[:, :, ::-1, ::-1],
+        x, _transpose_kernel(w, groups, 2),
         window_strides=(1, 1),
         padding=[(kh - 1 - pads[0], kh - 1 - pads[0]),
                  (kw - 1 - pads[1], kw - 1 - pads[1])],

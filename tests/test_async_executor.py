@@ -1,0 +1,102 @@
+"""AsyncExecutor + MultiSlotDataFeed (reference async_executor.cc
+RunFromFile + data_feed.cc MultiSlotDataFeed + test_async_executor.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _write_files(tmp_path, n_files=2, lines_per=12, seed=0):
+    """CTR-ish data: ragged uint64 'words' slot + dense float 'dense'
+    slot + uint64 'label' slot (single id)."""
+    rng = np.random.RandomState(seed)
+    paths = []
+    for fi in range(n_files):
+        p = tmp_path / ("part-%d.txt" % fi)
+        with open(p, 'w') as f:
+            for _ in range(lines_per):
+                n_words = rng.randint(1, 5)
+                words = rng.randint(0, 30, n_words)
+                dense = rng.randn(3)
+                label = rng.randint(0, 2)
+                line = "%d %s " % (n_words, " ".join(map(str, words)))
+                line += "3 %s " % " ".join("%.4f" % v for v in dense)
+                line += "1 %d" % label
+                f.write(line + "\n")
+        paths.append(str(p))
+    return paths
+
+
+def _desc(batch_size=4):
+    desc = fluid.DataFeedDesc(batch_size=batch_size)
+    desc.add_slot('words', type='uint64', is_dense=False)
+    desc.add_slot('dense', type='float', is_dense=True)
+    desc.add_slot('label', type='uint64', is_dense=True)
+    return desc
+
+
+class TestMultiSlotDataFeed(object):
+    def test_parse_and_batch(self, tmp_path):
+        paths = _write_files(tmp_path, n_files=1, lines_per=6)
+        feed = fluid.MultiSlotDataFeed(_desc(batch_size=4))
+        batches = list(feed.batches_from_file(paths[0]))
+        assert len(batches) == 2           # 4 + 2
+        b = batches[0]
+        arr, lod = b['words']
+        assert arr.shape[1] == 1 and lod[0][0] == 0
+        assert len(lod[0]) == 5            # 4 sequences
+        assert b['dense'].shape == (4, 3)
+        assert b['label'].shape == (4, 1)
+
+    def test_malformed_line_raises(self, tmp_path):
+        p = tmp_path / "bad.txt"
+        p.write_text("3 1 2\n")            # declares 3 values, has 2
+        feed = fluid.MultiSlotDataFeed(_desc())
+        with pytest.raises(ValueError, match="declares 3 values"):
+            list(feed.batches_from_file(str(p)))
+
+
+class TestAsyncExecutor(object):
+    def test_file_driven_training(self, tmp_path):
+        paths = _write_files(tmp_path, n_files=3, lines_per=8)
+
+        words = fluid.layers.data(name='words', shape=[1], dtype='int64',
+                                  lod_level=1)
+        dense = fluid.layers.data(name='dense', shape=[3],
+                                  dtype='float32')
+        label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+        emb = fluid.layers.embedding(words, size=[30, 8], is_sparse=True)
+        pooled = fluid.layers.sequence_pool(emb, pool_type='sum')
+        feat = fluid.layers.concat([pooled, dense], axis=1)
+        pred = fluid.layers.fc(feat, size=2, act='softmax')
+        loss = fluid.layers.mean(
+            fluid.layers.cross_entropy(pred, label))
+        fluid.optimizer.Adam(0.05).minimize(loss)
+
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        async_exe = fluid.AsyncExecutor(fluid.CPUPlace())
+        results = []
+        for epoch in range(3):
+            results = async_exe.run(
+                fluid.default_main_program(), _desc(batch_size=4), paths,
+                thread_num=2, fetch_list=[loss])
+        assert len(results) == 6           # 24 lines / batch 4
+        vals = [float(np.asarray(r[0]).reshape(())) for r in results]
+        assert all(np.isfinite(v) for v in vals)
+
+    def test_parser_error_propagates(self, tmp_path):
+        p = tmp_path / "bad.txt"
+        p.write_text("oops\n")
+        x = fluid.layers.data(name='words', shape=[1], dtype='int64',
+                              lod_level=1)
+        loss = fluid.layers.mean(
+            fluid.layers.embedding(x, size=[10, 4]))
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        async_exe = fluid.AsyncExecutor()
+        desc = fluid.DataFeedDesc(batch_size=2)
+        desc.add_slot('words', type='uint64')
+        with pytest.raises(Exception):
+            async_exe.run(fluid.default_main_program(), desc, [str(p)],
+                          fetch_list=[loss])

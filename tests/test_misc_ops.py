@@ -78,6 +78,23 @@ class TestPoolWithIndexAndUnpool(object):
                         fi = int(mask[n, c, i, j])
                         assert up[n, c].reshape(-1)[fi] == out[n, c, i, j]
 
+    def test_adaptive_pool_with_index(self):
+        rng = np.random.RandomState(7)
+        x = rng.randn(1, 2, 6, 6).astype(np.float32)
+        out, mask = _run_single_op(
+            'max_pool2d_with_index', {'X': x},
+            {'Out': ['ap_out'], 'Mask': ['ap_mask']},
+            {'ksize': [4, 4], 'strides': [1, 1], 'paddings': [0, 0],
+             'adaptive': True})
+        assert out.shape == (1, 2, 4, 4)
+        # windows: start=floor(i*6/4), end=ceil((i+1)*6/4)
+        for i in range(4):
+            s, e = (i * 6) // 4, -((-(i + 1) * 6) // 4)
+            for j in range(4):
+                sj, ej = (j * 6) // 4, -((-(j + 1) * 6) // 4)
+                win = x[0, 0, s:e, sj:ej]
+                assert out[0, 0, i, j] == win.max()
+
     def test_pool3d_with_index(self):
         rng = np.random.RandomState(3)
         x = rng.randn(1, 2, 4, 4, 4).astype(np.float32)
@@ -237,19 +254,36 @@ class TestPyFunc(object):
                     fetch_list=[out_var])
 
 
-    def test_adaptive_pool_with_index(self):
-        rng = np.random.RandomState(7)
-        x = rng.randn(1, 2, 6, 6).astype(np.float32)
-        out, mask = _run_single_op(
-            'max_pool2d_with_index', {'X': x},
-            {'Out': ['ap_out'], 'Mask': ['ap_mask']},
-            {'ksize': [4, 4], 'strides': [1, 1], 'paddings': [0, 0],
-             'adaptive': True})
-        assert out.shape == (1, 2, 4, 4)
-        # windows: start=floor(i*6/4), end=ceil((i+1)*6/4)
-        for i in range(4):
-            s, e = (i * 6) // 4, -((-(i + 1) * 6) // 4)
-            for j in range(4):
-                sj, ej = (j * 6) // 4, -((-(j + 1) * 6) // 4)
-                win = x[0, 0, s:e, sj:ej]
-                assert out[0, 0, i, j] == win.max()
+class TestGroupedConvTranspose(object):
+    def test_conv2d_transpose_groups(self):
+        rng = np.random.RandomState(8)
+        x = rng.randn(1, 4, 5, 5).astype(np.float32)
+        w = rng.randn(4, 2, 3, 3).astype(np.float32)   # groups=2
+        out, = _run_single_op(
+            'conv2d_transpose', {'Input': x, 'Filter': w},
+            {'Output': ['g2t_out']},
+            {'strides': [1, 1], 'paddings': [0, 0],
+             'dilations': [1, 1], 'groups': 2})
+        assert out.shape == (1, 4, 7, 7)
+        # group 0 output depends only on group 0 input channels
+        x2 = x.copy()
+        x2[:, 2:] = 0.0
+        out2, = _run_single_op(
+            'conv2d_transpose', {'Input': x2, 'Filter': w},
+            {'Output': ['g2t_out2']},
+            {'strides': [1, 1], 'paddings': [0, 0],
+             'dilations': [1, 1], 'groups': 2})
+        np.testing.assert_allclose(out[:, :2], out2[:, :2], rtol=1e-5)
+        assert np.abs(out2[:, 2:]).max() < 1e-6
+
+    def test_conv3d_transpose_groups(self):
+        rng = np.random.RandomState(9)
+        x = rng.randn(1, 4, 3, 3, 3).astype(np.float32)
+        w = rng.randn(4, 2, 2, 2, 2).astype(np.float32)
+        out, = _run_single_op(
+            'conv3d_transpose', {'Input': x, 'Filter': w},
+            {'Output': ['g3t_out']},
+            {'strides': [1, 1, 1], 'paddings': [0, 0, 0],
+             'dilations': [1, 1, 1], 'groups': 2})
+        assert out.shape == (1, 4, 4, 4, 4)
+        assert np.isfinite(out).all()
