@@ -67,8 +67,9 @@ def _child(mode):
 
     if on_tpu:
         cfg = LMConfig(vocab_size=32000, seq_len=512, d_model=512, n_head=8,
-                       n_layer=6, d_ff=2048, dropout=0.1)
-        batch, steps, warmup = 32, 30, 5
+                       n_layer=6, d_ff=2048, dropout=0.1, attn_dropout=0.0,
+                       use_flash_attention=True)   # pallas fused attention
+        batch, steps, warmup = 64, 30, 5
     else:  # CPU smoke config
         cfg = LMConfig(vocab_size=1024, seq_len=64, d_model=128, n_head=4,
                        n_layer=2, d_ff=256, dropout=0.1)
@@ -124,6 +125,9 @@ def _child(mode):
         'step_ms': round(1000 * dt / steps, 2),
         'final_loss': round(loss, 4),
         'amp': bool(on_tpu),
+        'flash_attention': bool(
+            getattr(cfg, 'use_flash_attention', False)
+            and not getattr(cfg, 'attn_dropout', 0.0)),  # effective state
         'config': 'L%d d%d ff%d V%d seq%d b%d' % (
             cfg.n_layer, cfg.d_model, cfg.d_ff, cfg.vocab_size,
             cfg.seq_len, batch),

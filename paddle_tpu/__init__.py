@@ -41,7 +41,8 @@ from . import clip
 from . import unique_name
 from .param_attr import ParamAttr, WeightNormParamAttr
 from . import io
-from .io import (save_vars, save_params, save_persistables, load_vars,
+from .io import (export_stablehlo_model, load_stablehlo_model,
+                 save_vars, save_params, save_persistables, load_vars,
                  load_params, load_persistables, save_inference_model,
                  load_inference_model)
 from . import nets

@@ -79,7 +79,15 @@ class MultiSlotDataFeed(object):
             if not slot['is_used']:
                 continue
             if slot['type'] == 'uint64':
-                sample[slot['name']] = np.asarray(vals, np.int64)
+                try:
+                    sample[slot['name']] = np.asarray(vals, np.int64)
+                except OverflowError:
+                    raise ValueError(
+                        "MultiSlotDataFeed: slot %r has a feature id "
+                        ">= 2^63; ids index embedding tables here, so "
+                        "hash raw uint64 features into a bucket range "
+                        "first (reference hash_op / lookup table "
+                        "mod-size semantics)" % slot['name'])
             else:
                 sample[slot['name']] = np.asarray(vals, np.float32)
         return sample
@@ -163,14 +171,19 @@ class AsyncExecutor(object):
 
         results = []
         alive = lambda: any(t.is_alive() for t in threads)
+        done = False
         while True:
             try:
                 feed = batches.get(timeout=0.05)
             except queue.Empty:
                 if errors:
                     raise errors[0]
-                if not alive():
+                if done:
                     break
+                if not alive():
+                    # parsers finished; drain anything enqueued between
+                    # the timeout and the liveness check before exiting
+                    done = True
                 continue
             out = self.executor.run(program, feed=feed,
                                     fetch_list=fetch_list, scope=scope)

@@ -239,6 +239,8 @@ def lower_block(ctx, lo=0):
     for k, (tbl, flat_ids, dim, dtype) in enumerate(sites):
         wrt_vals['@sparse%d' % k] = jnp.zeros((flat_ids.shape[0], dim), dtype)
 
+    ckpt_names = set(bop.attr('checkpoints') or ())
+
     def fwd(wrt_vals):
         env2 = dict(base_env)
         env2.update(wrt_vals)
@@ -247,7 +249,10 @@ def lower_block(ctx, lo=0):
             sub.sparse_tables = sparse_set
             sub.sparse_mode = 'apply'
             sub.sparse_counter = [0]
-        lower_ops(sub, ops, lo, b)
+        if ckpt_names and not sparse_set:
+            _lower_with_remat(sub, ops, lo, b, ckpt_names)
+        else:
+            lower_ops(sub, ops, lo, b)
         return env2[loss_name], env2
 
     (loss_val, env2), pullback = _vjp_with_aux(fwd, wrt_vals)
@@ -286,6 +291,102 @@ def lower_block(ctx, lo=0):
             g = grads[n]
         ctx.env[gname] = g
     lower_block(ctx, b + 1)
+
+
+def _lower_with_remat(ctx, ops, lo, b, ckpt_names):
+    """Rematerialization (reference append_backward(checkpoints=...) /
+    the memory_optimize recompute strategy, realized the JAX way): the
+    forward segment is split at ops producing checkpoint vars and each
+    segment is traced under jax.checkpoint, so only segment boundaries are
+    saved for the backward pass — HBM traded for recompute FLOPs.
+
+    Segments containing control-flow sub-blocks or TensorArray writes run
+    unwrapped (their env values are not plain arrays)."""
+    # segment boundaries AFTER each op that produces a checkpoint var
+    bounds = []
+    for i in range(lo, b):
+        if ckpt_names & set(ops[i].output_arg_names):
+            bounds.append(i + 1)
+    if not bounds:
+        raise ValueError(
+            "append_backward(checkpoints=...): none of %s is produced by "
+            "this program's forward segment — stale vars from another "
+            "program build? (each build_lm/model build creates fresh "
+            "unique names)" % sorted(ckpt_names))
+    if bounds[-1] != b:
+        bounds.append(b)
+
+    start = lo
+    for end in bounds:
+        _lower_segment(ctx, ops, start, end)
+        start = end
+
+
+class _NonArraySegmentOutput(Exception):
+    pass
+
+
+def _is_plain_array(v):
+    import jax as _jax
+    return isinstance(v, (_jax.Array, jnp.ndarray, np.ndarray, float, int)) \
+        or hasattr(v, 'shape')
+
+
+def _lower_segment(ctx, ops, s, e):
+    if s >= e:
+        return
+    seg = ops[s:e]
+    wrappable = all('sub_block' not in op.attrs and
+                    op.type not in ('backward',)
+                    for op in seg)
+    if wrappable:
+        in_names, seen = [], set()
+        for op in seg:
+            for n in op.input_arg_names:
+                if n not in seen and ctx.has(n) and \
+                        _is_plain_array(ctx.env[n]):
+                    seen.add(n)
+                    in_names.append(n)
+        out_names, oseen = [], set()
+        for op in seg:
+            for n in op.output_arg_names:
+                if n not in oseen:
+                    oseen.add(n)
+                    out_names.append(n)
+        produced = []
+
+        def seg_fn(*vals):
+            env_l = dict(ctx.env)
+            env_l.update(zip(in_names, vals))
+            c2 = ctx.child(env_l)
+            for attr in ('sparse_tables', 'sparse_mode', 'sparse_counter'):
+                if hasattr(ctx, attr):
+                    setattr(c2, attr, getattr(ctx, attr))
+            # global op indices keep per-op RNG folds identical to the
+            # unwrapped lowering (dropout masks match)
+            lower_ops(c2, ops, s, e)
+            bad = [n for n in out_names
+                   if n in env_l and not _is_plain_array(env_l[n])]
+            if bad:
+                # TensorArrays etc. cannot cross a jax.checkpoint
+                # boundary; surface to the caller's fallback path
+                raise _NonArraySegmentOutput(bad)
+            del produced[:]
+            produced.extend(n for n in out_names if n in env_l)
+            return tuple(env_l[n] for n in produced)
+
+        try:
+            results = jax.checkpoint(seg_fn)(
+                *[ctx.env[n] for n in in_names])
+        except Exception:
+            # includes _NonArraySegmentOutput (TensorArray writes) and
+            # fall back to plain lowering for anything jax.checkpoint
+            # cannot trace (non-array state, host callbacks, ...)
+            lower_ops(ctx, ops, s, e)
+            return
+        ctx.env.update(zip(produced, results))
+        return
+    lower_ops(ctx, ops, s, e)
 
 
 def _vjp_with_aux(f, primal):

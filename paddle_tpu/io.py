@@ -24,7 +24,8 @@ from .core import serialization as _ser
 __all__ = [
     'save_vars', 'save_params', 'save_persistables', 'load_vars',
     'load_params', 'load_persistables', 'save_inference_model',
-    'load_inference_model', 'get_program_parameter',
+    'load_inference_model', 'export_stablehlo_model',
+    'load_stablehlo_model', 'get_program_parameter',
 ]
 
 
@@ -187,3 +188,99 @@ def load_inference_model(dirname, executor, model_filename=None,
     fetch_vars = [program.global_block().var(n)
                   for n in blob['fetch_names']]
     return program, blob['feed_names'], fetch_vars
+
+
+def export_stablehlo_model(dirname, feeded_var_names, target_vars, executor,
+                           example_feeds, main_program=None, scope=None):
+    """Serialize the pruned inference computation as portable StableHLO
+    (the deployment analog of the reference's __model__ ProgramDesc +
+    AnalysisPredictor, inference/io.cc — but as a compiler-level artifact:
+    the loaded module needs NO framework at all, only jax.export).
+
+    Parameters are baked into the module as constants from `scope`.
+    `example_feeds`: {name: ndarray-or-(shape, dtype)} fixing input
+    signatures (XLA needs static shapes). Writes __model__.stablehlo plus
+    a small JSON manifest; returns the manifest dict."""
+    import jax
+    from jax import export as jexport
+    import numpy as _np
+    from .core import lowering as _low
+    from .executor import global_scope as _gs, Executor as _Exe
+
+    if main_program is None:
+        main_program = default_main_program()
+    if isinstance(feeded_var_names, str):
+        feeded_var_names = [feeded_var_names]
+    if isinstance(target_vars, Variable):
+        target_vars = [target_vars]
+    target_names = [t.name for t in target_vars]
+    scope = scope if scope is not None else _gs()
+
+    inference_program = main_program.clone(for_test=True)
+    gb = inference_program.global_block()
+    gb.ops = [op for op in gb.ops
+              if getattr(op, 'role', 'Forward') not in
+              ('Backward', 'Optimize')]
+    inference_program._bump_version()
+    pruned = inference_program._prune(target_names)
+
+    read, written = _low.analyze_state(pruned, target_names)
+    needed = _Exe._read_before_write(pruned, read, written,
+                                     set(feeded_var_names), target_names)
+    fn, ro_names, rw_names = _low.build_fn(pruned, target_names, needed,
+                                           written)
+    state = {}
+    for n in list(ro_names) + list(rw_names):
+        v = scope.get(n)
+        if v is None:
+            raise RuntimeError(
+                "export_stablehlo_model: persistable %r is not in the "
+                "scope — run the startup program / load params first" % n)
+        state[n] = _np.asarray(v)
+
+    def _spec(v):
+        if isinstance(v, tuple):
+            shape, dtype = v
+            return jax.ShapeDtypeStruct(tuple(shape), _np.dtype(dtype))
+        arr = _np.asarray(v)
+        return jax.ShapeDtypeStruct(arr.shape, arr.dtype)
+
+    feed_specs = {n: _spec(example_feeds[n]) for n in feeded_var_names}
+    key = jax.random.PRNGKey(0)     # inference clone: no random ops live
+
+    def infer(*feed_vals):
+        feed = dict(zip(feeded_var_names, feed_vals))
+        ro = {n: state[n] for n in ro_names}
+        rw = {n: state[n] for n in rw_names}
+        fetches, _ = fn(feed, ro, rw, key)
+        return tuple(fetches)
+
+    exported = jexport.export(jax.jit(infer))(
+        *[feed_specs[n] for n in feeded_var_names])
+    os.makedirs(dirname, exist_ok=True)
+    path = os.path.join(dirname, '__model__.stablehlo')
+    with open(path, 'wb') as f:
+        f.write(exported.serialize())
+    manifest = {
+        'format': 'stablehlo', 'version': 1,
+        'feed_names': list(feeded_var_names),
+        'fetch_names': target_names,
+        'feed_shapes': {n: list(feed_specs[n].shape)
+                        for n in feeded_var_names},
+    }
+    with open(os.path.join(dirname, '__model__.stablehlo.json'),
+              'w') as f:
+        json.dump(manifest, f)
+    return manifest
+
+
+def load_stablehlo_model(dirname):
+    """Load a StableHLO export: returns (callable, manifest). The callable
+    takes feeds positionally in manifest['feed_names'] order and returns
+    the fetch tuple — no Program/Scope machinery involved."""
+    from jax import export as jexport
+    with open(os.path.join(dirname, '__model__.stablehlo'), 'rb') as f:
+        exported = jexport.deserialize(f.read())
+    with open(os.path.join(dirname, '__model__.stablehlo.json')) as f:
+        manifest = json.load(f)
+    return exported.call, manifest

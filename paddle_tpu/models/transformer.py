@@ -17,7 +17,8 @@ __all__ = ['multi_head_attention', 'transformer_block', 'build_lm',
 
 class LMConfig(object):
     def __init__(self, vocab_size=32000, seq_len=512, d_model=512,
-                 n_head=8, n_layer=6, d_ff=2048, dropout=0.1):
+                 n_head=8, n_layer=6, d_ff=2048, dropout=0.1,
+                 attn_dropout=None, use_flash_attention=True):
         self.vocab_size = vocab_size
         self.seq_len = seq_len
         self.d_model = d_model
@@ -25,10 +26,15 @@ class LMConfig(object):
         self.n_layer = n_layer
         self.d_ff = d_ff
         self.dropout = dropout
+        # dropout on attention probabilities (None = follow `dropout`,
+        # preserving the classic behavior); the fused (pallas) attention
+        # kernel runs only when the effective value is 0 (no in-kernel RNG)
+        self.attn_dropout = dropout if attn_dropout is None else attn_dropout
+        self.use_flash_attention = use_flash_attention
 
 
 def multi_head_attention(x, cfg, prefix, mask_var=None, is_test=False,
-                         seq_parallel=False):
+                         seq_parallel=False, causal=False):
     """Fused-QKV multi-head self-attention: one (D, 3D) matmul for Q,K,V
     (fewer, larger MXU matmuls than three separate projections)."""
     d, h = cfg.d_model, cfg.n_head
@@ -44,15 +50,33 @@ def multi_head_attention(x, cfg, prefix, mask_var=None, is_test=False,
                        axes=[0])
     v = layers.squeeze(layers.slice(qkv, axes=[0], starts=[2], ends=[3]),
                        axes=[0])
-    logits = layers.matmul(q, k, transpose_y=True, alpha=dh ** -0.5)
-    if mask_var is not None:
-        logits = layers.elementwise_add(logits, mask_var)
-    weights = layers.softmax(logits)
-    if cfg.dropout and not is_test:
-        weights = layers.dropout(weights, dropout_prob=cfg.dropout,
-                                 is_test=is_test,
-                                 dropout_implementation='upscale_in_train')
-    ctx = layers.matmul(weights, v)                    # (B, H, L, dh)
+    attn_drop = getattr(cfg, 'attn_dropout', 0.0)
+    # the fused kernel implements exactly causal masking and no probability
+    # dropout; any explicit mask_var (padding masks, bidirectional) or
+    # active attention dropout falls back to the unfused path
+    use_flash = getattr(cfg, 'use_flash_attention', False) and causal and \
+        mask_var is None and (is_test or not attn_drop)
+    if use_flash:
+        # fused causal attention (pallas on TPU): scores never leave VMEM
+        helper_block = x.block
+        ctx = helper_block.create_var(
+            name=prefix + '.flash_out',
+            shape=(-1, h, cfg.seq_len, dh), dtype='float32')
+        helper_block.append_op(
+            type='flash_attention',
+            inputs={'Q': [q], 'K': [k], 'V': [v]},
+            outputs={'Out': [ctx]},
+            attrs={'scale': dh ** -0.5, 'causal': True})
+    else:
+        logits = layers.matmul(q, k, transpose_y=True, alpha=dh ** -0.5)
+        if mask_var is not None:
+            logits = layers.elementwise_add(logits, mask_var)
+        weights = layers.softmax(logits)
+        if attn_drop and not is_test:
+            weights = layers.dropout(weights, dropout_prob=attn_drop,
+                                     is_test=is_test,
+                                     dropout_implementation='upscale_in_train')
+        ctx = layers.matmul(weights, v)                # (B, H, L, dh)
     ctx = layers.transpose(ctx, perm=[0, 2, 1, 3])
     ctx = layers.reshape(ctx, shape=[0, cfg.seq_len, d])
     out = layers.fc(input=ctx, size=d, num_flatten_dims=2,
@@ -61,13 +85,15 @@ def multi_head_attention(x, cfg, prefix, mask_var=None, is_test=False,
     return out
 
 
-def transformer_block(x, cfg, prefix, mask_var=None, is_test=False):
+def transformer_block(x, cfg, prefix, mask_var=None, is_test=False,
+                      causal=False):
     # pre-norm residual blocks
     ln1 = layers.layer_norm(x, begin_norm_axis=2,
                             param_attr=ParamAttr(name=prefix + '.ln1.w'),
                             bias_attr=ParamAttr(name=prefix + '.ln1.b'))
     attn = multi_head_attention(ln1, cfg, prefix + '.attn',
-                                mask_var=mask_var, is_test=is_test)
+                                mask_var=mask_var, is_test=is_test,
+                                causal=causal)
     x = layers.elementwise_add(x, attn)
     ln2 = layers.layer_norm(x, begin_norm_axis=2,
                             param_attr=ParamAttr(name=prefix + '.ln2.w'),
@@ -100,13 +126,29 @@ def build_lm(cfg=None, is_test=False):
         x = layers.dropout(x, dropout_prob=cfg.dropout, is_test=is_test,
                            dropout_implementation='upscale_in_train')
 
-    causal = np.triu(np.full((cfg.seq_len, cfg.seq_len), -1e9,
-                             dtype='float32'), k=1)
-    mask_var = layers.assign(causal)
+    attn_drop = getattr(cfg, 'attn_dropout', 0.0)
+    flash_ok = getattr(cfg, 'use_flash_attention', False) and \
+        (is_test or not attn_drop)
+    if flash_ok:
+        mask_var = None          # causal masking fused into the kernel
+    else:
+        causal_mask = np.triu(np.full((cfg.seq_len, cfg.seq_len), -1e9,
+                                      dtype='float32'), k=1)
+        mask_var = layers.assign(causal_mask)
 
+    block_outputs = []
     for i in range(cfg.n_layer):
         x = transformer_block(x, cfg, 'layer_%d' % i, mask_var=mask_var,
-                              is_test=is_test)
+                              is_test=is_test, causal=flash_ok)
+        block_outputs.append(x)
+    # per-layer boundaries for rematerialization:
+    # append_backward(checkpoints=cfg.block_outputs) trades recompute FLOPs
+    # for activation HBM (see core/lowering.py _lower_with_remat).
+    # NOTE: rebuilt per program — a second build_lm overwrites this with
+    # that program's fresh var names (the lowering raises loudly if stale
+    # checkpoint names are passed). Also stashed on the program itself.
+    cfg.block_outputs = block_outputs
+    tokens.block.program._lm_checkpoint_vars = block_outputs
     x = layers.layer_norm(x, begin_norm_axis=2,
                           param_attr=ParamAttr(name='final_ln.w'),
                           bias_attr=ParamAttr(name='final_ln.b'))

@@ -22,3 +22,4 @@ from . import detection_ops
 from . import vision_ops
 from . import quant_ops
 from . import misc_ops
+from . import attention_ops

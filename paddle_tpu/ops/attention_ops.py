@@ -1,0 +1,134 @@
+"""Fused attention: the pallas kernel tier (SURVEY §2.4: the TPU analog of
+the reference's operators/jit/ runtime-codegen kernels, with the same
+refer-vs-optimized cross-checking discipline — see tests/test_attention.py).
+
+`flash_attention` computes softmax(QK^T * scale + causal mask) V in one
+kernel: scores and probabilities live in VMEM only and never round-trip
+through HBM, which is the memory-bandwidth win on TPU (attention is
+HBM-bound at small d_head). One grid cell per (batch * head); each cell's
+Q/K/V tile fits VMEM for the seq lengths this kernel accepts (<= ~2k at
+d_head 64). The backward pass recomputes attention with the plain jnp
+formulation under jax AD (flash-style backward is a later optimization);
+forward-only inference gets the full benefit.
+
+Selection mirrors the reference jit-kernel `UseMe` pattern: on TPU the
+pallas kernel runs compiled; elsewhere the jnp reference implementation is
+used (the kernel itself is cross-checked against it in interpret mode).
+"""
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op
+
+_NEG_INF = -1e30
+
+
+def _attention_ref(q, k, v, scale, causal):
+    """Plain jnp reference ([BH, L, dh] each) — also the backward path."""
+    s = jnp.einsum('bqd,bkd->bqk', q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        ln = q.shape[1]
+        mask = jnp.tril(jnp.ones((ln, ln), bool))
+        s = jnp.where(mask[None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum('bqk,bkd->bqd', p.astype(v.dtype), v)
+
+
+def _flash_kernel(scale, causal, q_ref, k_ref, v_ref, o_ref):
+    q = q_ref[0].astype(jnp.float32)
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0]
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    if causal:
+        ln = q.shape[0]
+        rows = lax.broadcasted_iota(jnp.int32, (ln, ln), 0)
+        cols = lax.broadcasted_iota(jnp.int32, (ln, ln), 1)
+        s = jnp.where(rows >= cols, s, _NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    z = jnp.sum(p, axis=-1, keepdims=True)
+    o = jax.lax.dot_general(p / z, v.astype(jnp.float32),
+                            (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    o_ref[0] = o.astype(o_ref.dtype)
+
+
+def _flash_fwd_pallas(q, k, v, scale, causal, interpret):
+    from jax.experimental import pallas as pl
+    bh, ln, dh = q.shape
+    kernel = functools.partial(_flash_kernel, scale, causal)
+    spec = pl.BlockSpec((1, ln, dh), lambda i: (i, 0, 0))
+    return pl.pallas_call(
+        kernel,
+        grid=(bh,),
+        in_specs=[spec, spec, spec],
+        out_specs=spec,
+        out_shape=jax.ShapeDtypeStruct((bh, ln, dh), q.dtype),
+        interpret=interpret,
+    )(q, k, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash(q, k, v, scale, causal, use_pallas):
+    if use_pallas:
+        return _flash_fwd_pallas(q, k, v, scale, causal,
+                                 interpret=(use_pallas == 'interpret'))
+    return _attention_ref(q, k, v, scale, causal)
+
+
+def _flash_fwd(q, k, v, scale, causal, use_pallas):
+    return _flash(q, k, v, scale, causal, use_pallas), (q, k, v)
+
+
+def _flash_bwd(scale, causal, use_pallas, res, ct):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda a, b, c: _attention_ref(a, b, c, scale, causal),
+                     q, k, v)
+    return vjp(ct)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, scale=None, causal=True, use_pallas=None):
+    """q/k/v: [B, H, L, dh] (or [BH, L, dh]). On TPU lowers to the pallas
+    kernel; elsewhere to the jnp reference (use_pallas='interpret' forces
+    the kernel through the pallas interpreter for cross-checking)."""
+    shape4 = q.ndim == 4
+    if shape4:
+        b, h, ln, dh = q.shape
+        q = q.reshape(b * h, ln, dh)
+        k = k.reshape(b * h, ln, dh)
+        v = v.reshape(b * h, ln, dh)
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == 'tpu'
+    out = _flash(q, k, v, float(scale), bool(causal), use_pallas)
+    if shape4:
+        out = out.reshape(b, h, ln, dh)
+    return out
+
+
+@register_op('flash_attention')
+def _flash_attention_op(ctx, op):
+    """Program-level op: inputs Q, K, V [B, H, L, dh]; attrs scale (float,
+    default dh^-0.5) and causal (bool). AMP-markable: under bf16 policy the
+    kernel's matmuls run bf16 with fp32 softmax/accumulation (the kernel
+    upcasts internally with preferred_element_type)."""
+    from ..core import amp
+    q = ctx.in1(op, 'Q')
+    k = ctx.in1(op, 'K')
+    v = ctx.in1(op, 'V')
+    out_dtype = q.dtype
+    q, k, v = amp.cast_compute(op, q, k, v)
+    scale = op.attr('scale', 0.0) or None
+    causal = op.attr('causal', True)
+    out = flash_attention(q, k, v, scale=scale, causal=causal)
+    ctx.out(op, 'Out', out.astype(out_dtype))

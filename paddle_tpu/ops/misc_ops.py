@@ -101,7 +101,7 @@ def _gathered_max(x, flat_idx, flat_valid, out_sz, nsp):
             flat_pos.reshape(lead + tuple(out_sz)).astype(jnp.int32))
 
 
-def _window_maps(out_sz, starts, wins, spatial, ends=None, pads_valid=True):
+def _window_maps(out_sz, starts, wins, spatial, ends=None):
     """Flat gather map [prod(out), prod(win)] + validity mask: coord =
     start + win offset, valid while < end (adaptive) or inside the plane
     (fixed windows)."""
@@ -118,8 +118,6 @@ def _window_maps(out_sz, starts, wins, spatial, ends=None, pads_valid=True):
         flat = np.clip(coord, 0, spatial[i] - 1)
         idx = flat if idx is None else idx * spatial[i] + flat
         valid = ok if valid is None else (valid & ok)
-    k = int(np.prod([w.shape[i] for i, w in enumerate(wins)])) if wins \
-        else 1
     n_out = int(np.prod(out_sz))
     return idx.reshape(n_out, -1), valid.reshape(n_out, -1)
 

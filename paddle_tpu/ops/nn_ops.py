@@ -9,6 +9,8 @@ interpolate_op.cc, affine_channel_op.cc, nce_op.cc, hierarchical_sigmoid_op.cc.
 Convs/matmuls use lax.conv_general_dilated / dot so XLA tiles them on the MXU;
 bf16 inputs keep fp32 accumulation via preferred_element_type.
 """
+import functools
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -46,21 +48,54 @@ def _cross_entropy(ctx, op):
     ctx.out(op, 'Y', out)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _ce_hard(logits, lab, ignore_index):
+    """Hard-label softmax cross entropy that residualizes ONLY the logits:
+    the default AD path saves both logits and log_softmax — for an LM head
+    that is two [tokens, vocab] HBM buffers; the analytic gradient
+    softmax(x) - onehot needs just one."""
+    lse = jax.scipy.special.logsumexp(
+        logits.astype(jnp.float32), axis=-1)
+    picked = jnp.take_along_axis(logits.astype(jnp.float32),
+                                 lab[:, None], axis=-1)[:, 0]
+    loss = lse - picked
+    return jnp.where(lab != ignore_index, loss, 0.0)
+
+
+def _ce_hard_fwd(logits, lab, ignore_index):
+    return _ce_hard(logits, lab, ignore_index), (logits, lab)
+
+
+def _ce_hard_bwd(ignore_index, res, ct):
+    logits, lab = res
+    p = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    onehot = jax.nn.one_hot(lab, logits.shape[-1], dtype=p.dtype)
+    g = (p - onehot) * ct[:, None]
+    g = jnp.where((lab != ignore_index)[:, None], g, 0.0)
+    return g.astype(logits.dtype), None
+
+
+_ce_hard.defvjp(_ce_hard_fwd, _ce_hard_bwd)
+
+
 @register_op('softmax_with_cross_entropy')
 def _softmax_with_ce(ctx, op):
     logits = ctx.in1(op, 'Logits')
     label = ctx.in1(op, 'Label')
     soft_label = op.attr('soft_label', False)
     ignore_index = op.attr('ignore_index', -100)
-    log_sm = jax.nn.log_softmax(logits, axis=-1)
-    ctx.out(op, 'Softmax', jnp.exp(log_sm))
     if soft_label:
+        log_sm = jax.nn.log_softmax(logits, axis=-1)
+        ctx.out(op, 'Softmax', jnp.exp(log_sm))
         loss = -jnp.sum(label * log_sm, axis=-1, keepdims=True)
-    else:
-        p, lab = _gather_label(log_sm, label)
-        loss = -p
-        loss = jnp.where((lab != ignore_index)[:, None], loss, 0.0)
-    ctx.out(op, 'Loss', loss)
+        ctx.out(op, 'Loss', loss)
+        return
+    lab = label.reshape(-1).astype(jnp.int32)
+    loss = _ce_hard(logits, lab, ignore_index)
+    ctx.out(op, 'Loss', loss[:, None])
+    # the Softmax output only materializes if the program consumes it
+    if op.output('Softmax'):
+        ctx.out(op, 'Softmax', jax.nn.softmax(logits, axis=-1))
 
 
 @register_op('sigmoid_cross_entropy_with_logits')

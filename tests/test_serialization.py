@@ -8,6 +8,7 @@ AnalysisPredictor loads it and serves feed->run->fetch
 import json
 
 import numpy as np
+import pytest
 
 import paddle_tpu as fluid
 from paddle_tpu.core import serialization as ser
@@ -173,3 +174,50 @@ def test_save_inference_model_keeps_while(tmp_path):
         out = exe.run(prog, feed={feeds[0]: xs}, fetch_list=fetches,
                       scope=scope2)[0]
     np.testing.assert_allclose(np.asarray(out), direct)
+
+
+class TestStableHLOExport(object):
+    def test_export_and_load_no_framework(self, tmp_path):
+        """StableHLO export: the loaded artifact runs through jax.export
+        alone — weights baked in, no Program/Scope machinery."""
+        import paddle_tpu as fluid
+        import numpy as np
+
+        x = fluid.layers.data(name='x', shape=[8], dtype='float32')
+        y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+        pred = fluid.layers.fc(fluid.layers.fc(x, size=16, act='relu'),
+                               size=1)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        rng = np.random.RandomState(0)
+        X = rng.randn(4, 8).astype('float32')
+        Y = X.sum(1, keepdims=True).astype('float32')
+        for _ in range(3):
+            exe.run(feed={'x': X, 'y': Y}, fetch_list=[loss])
+
+        d = str(tmp_path / "shlo")
+        manifest = fluid.export_stablehlo_model(
+            d, ['x'], [pred], exe, example_feeds={'x': X})
+        assert manifest['feed_names'] == ['x']
+        import os
+        assert os.path.exists(os.path.join(d, '__model__.stablehlo'))
+
+        ref, = exe.run(feed={'x': X, 'y': Y}, fetch_list=[pred])
+        call, m2 = fluid.load_stablehlo_model(d)
+        out = call(X)
+        np.testing.assert_allclose(np.asarray(out[0]), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_missing_state_raises(self, tmp_path):
+        import paddle_tpu as fluid
+        import numpy as np
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        pred = fluid.layers.fc(x, size=2)
+        exe = fluid.Executor(fluid.CPUPlace())
+        with pytest.raises(RuntimeError, match="not in the scope"):
+            fluid.export_stablehlo_model(
+                str(tmp_path / "m"), ['x'], [pred], exe,
+                example_feeds={'x': np.zeros((1, 4), np.float32)})
