@@ -252,6 +252,14 @@ def lower_block(ctx, lo=0):
         if ckpt_names and not sparse_set:
             _lower_with_remat(sub, ops, lo, b, ckpt_names)
         else:
+            if ckpt_names and sparse_set:
+                import warnings
+                warnings.warn(
+                    "append_backward(checkpoints=...) is ignored when "
+                    "sparse (is_sparse=True) embedding gradients are in "
+                    "the same program: the sparse scout/dummy mechanism "
+                    "does not compose with jax.checkpoint segments yet",
+                    stacklevel=2)
             lower_ops(sub, ops, lo, b)
         return env2[loss_name], env2
 
@@ -378,10 +386,23 @@ def _lower_segment(ctx, ops, s, e):
         try:
             results = jax.checkpoint(seg_fn)(
                 *[ctx.env[n] for n in in_names])
-        except Exception:
-            # includes _NonArraySegmentOutput (TensorArray writes) and
-            # fall back to plain lowering for anything jax.checkpoint
-            # cannot trace (non-array state, host callbacks, ...)
+        except _NonArraySegmentOutput as exc:
+            import warnings
+            warnings.warn(
+                "remat: segment ops[%d:%d] produces non-array state %s "
+                "(TensorArray etc.) and runs WITHOUT rematerialization"
+                % (s, e, exc.args[0]), stacklevel=2)
+            lower_ops(ctx, ops, s, e)
+            return
+        except Exception as exc:
+            # anything jax.checkpoint cannot trace (trace-time statics,
+            # host callbacks, ...): fall back, but never silently
+            import warnings
+            warnings.warn(
+                "remat: segment ops[%d:%d] could not be wrapped in "
+                "jax.checkpoint (%s: %s) and runs WITHOUT "
+                "rematerialization" % (s, e, type(exc).__name__, exc),
+                stacklevel=2)
             lower_ops(ctx, ops, s, e)
             return
         ctx.env.update(zip(produced, results))

@@ -25,3 +25,5 @@ from . import mq2007
 __all__ = ['mnist', 'cifar', 'uci_housing', 'imdb', 'imikolov', 'movielens',
            'conll05', 'wmt14', 'wmt16', 'flowers', 'voc2012', 'sentiment',
            'mq2007', 'common']
+
+from . import image

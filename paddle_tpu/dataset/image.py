@@ -1,0 +1,145 @@
+"""Image preprocessing utilities (reference python/paddle/dataset/image.py:
+resize_short, to_chw, center_crop, random_crop, left_right_flip,
+simple_transform, load_and_transform).
+
+TPU-native note: the reference shells out to cv2 for decode/resize; here
+decoding uses PIL when available (decode is host-side data prep, not part
+of the compiled program) and the geometric ops are pure numpy so they work
+everywhere. Interpolation is bilinear.
+"""
+import numpy as np
+
+__all__ = [
+    'load_image', 'load_image_bytes', 'resize_short', 'to_chw',
+    'center_crop', 'random_crop', 'left_right_flip', 'simple_transform',
+    'load_and_transform',
+]
+
+
+def _require_pil():
+    try:
+        from PIL import Image
+        return Image
+    except ImportError:
+        raise ImportError(
+            "image decoding needs Pillow (PIL); geometric utilities "
+            "(resize_short/center_crop/...) work on numpy arrays without "
+            "it")
+
+
+def load_image(file, is_color=True):
+    """Load an image file to an HWC uint8 ndarray (RGB or grayscale)."""
+    Image = _require_pil()
+    im = Image.open(file)
+    im = im.convert('RGB' if is_color else 'L')
+    arr = np.asarray(im)
+    return arr if is_color else arr[:, :, None]
+
+
+def load_image_bytes(data, is_color=True):
+    import io
+    Image = _require_pil()
+    im = Image.open(io.BytesIO(data))
+    im = im.convert('RGB' if is_color else 'L')
+    arr = np.asarray(im)
+    return arr if is_color else arr[:, :, None]
+
+
+def _bilinear_resize(im, out_h, out_w):
+    """Pure-numpy bilinear resize of an HWC array."""
+    im = np.asarray(im)
+    h, w = im.shape[:2]
+    if h == out_h and w == out_w:
+        return im.copy()
+    ys = (np.arange(out_h) + 0.5) * h / out_h - 0.5
+    xs = (np.arange(out_w) + 0.5) * w / out_w - 0.5
+    y0 = np.clip(np.floor(ys), 0, h - 1).astype(int)
+    x0 = np.clip(np.floor(xs), 0, w - 1).astype(int)
+    y1 = np.clip(y0 + 1, 0, h - 1)
+    x1 = np.clip(x0 + 1, 0, w - 1)
+    wy = np.clip(ys - y0, 0.0, 1.0)[:, None, None]
+    wx = np.clip(xs - x0, 0.0, 1.0)[None, :, None]
+    imf = im.astype(np.float32)
+    if imf.ndim == 2:
+        imf = imf[:, :, None]
+        squeeze = True
+    else:
+        squeeze = False
+    r0 = imf[y0]
+    r1 = imf[y1]
+    top = r0[:, x0] * (1 - wx) + r0[:, x1] * wx
+    bot = r1[:, x0] * (1 - wx) + r1[:, x1] * wx
+    out = top * (1 - wy) + bot * wy
+    if np.issubdtype(im.dtype, np.integer):
+        out = np.clip(np.round(out), 0, 255).astype(im.dtype)
+    else:
+        out = out.astype(im.dtype)
+    return out[:, :, 0] if squeeze else out
+
+
+def resize_short(im, size):
+    """Resize so the SHORTER edge becomes `size` (aspect preserved),
+    reference image.py:197."""
+    h, w = im.shape[:2]
+    if h > w:
+        new_w, new_h = size, int(round(h * size / w))
+    else:
+        new_w, new_h = int(round(w * size / h)), size
+    return _bilinear_resize(im, new_h, new_w)
+
+
+def to_chw(im, order=(2, 0, 1)):
+    """HWC -> CHW (reference image.py:225)."""
+    assert len(im.shape) == len(order)
+    return im.transpose(order)
+
+
+def center_crop(im, size, is_color=True):
+    """Crop the center size x size patch (reference image.py:249)."""
+    h, w = im.shape[:2]
+    h_start = (h - size) // 2
+    w_start = (w - size) // 2
+    return im[h_start:h_start + size, w_start:w_start + size]
+
+
+def random_crop(im, size, is_color=True, rng=None):
+    """Crop a random size x size patch (reference image.py:277)."""
+    rng = rng or np.random
+    h, w = im.shape[:2]
+    h_start = rng.randint(0, h - size + 1)
+    w_start = rng.randint(0, w - size + 1)
+    return im[h_start:h_start + size, w_start:w_start + size]
+
+
+def left_right_flip(im, is_color=True):
+    """Mirror horizontally (reference image.py:305)."""
+    return im[:, ::-1]
+
+
+def simple_transform(im, resize_size, crop_size, is_train, is_color=True,
+                     mean=None, rng=None):
+    """resize_short -> (random crop + random flip | center crop) ->
+    CHW float32 -> optional mean subtraction (reference image.py:327)."""
+    rng = rng or np.random
+    im = resize_short(im, resize_size)
+    if is_train:
+        im = random_crop(im, crop_size, rng=rng)
+        if rng.randint(2) == 0:
+            im = left_right_flip(im, is_color)
+    else:
+        im = center_crop(im, crop_size)
+    if len(im.shape) == 3:
+        im = to_chw(im)
+    im = im.astype(np.float32)
+    if mean is not None:
+        mean = np.asarray(mean, np.float32)
+        if mean.ndim == 1 and im.ndim == 3:
+            mean = mean[:, None, None]
+        im -= mean
+    return im
+
+
+def load_and_transform(filename, resize_size, crop_size, is_train,
+                       is_color=True, mean=None):
+    return simple_transform(load_image(filename, is_color), resize_size,
+                            crop_size, is_train, is_color, mean)

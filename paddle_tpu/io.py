@@ -120,6 +120,22 @@ MODEL_FILENAME = '__model__'
 PARAMS_FILENAME = '__params__.npz'
 
 
+def _prune_for_inference(main_program, target_names):
+    """clone(for_test) + strip training-only ops + prune. Stripping
+    happens BEFORE pruning: optimizer ops write ParamOut under the
+    parameter's own name, so dependency-based pruning alone would drag the
+    whole backward+optimizer graph into the export (reference strips by op
+    role, op_proto_maker.h:26-36). Shared by save_inference_model and
+    export_stablehlo_model."""
+    inference_program = main_program.clone(for_test=True)
+    gb = inference_program.global_block()
+    gb.ops = [op for op in gb.ops
+              if getattr(op, 'role', 'Forward') not in
+              ('Backward', 'Optimize')]
+    inference_program._bump_version()
+    return inference_program._prune(target_names)
+
+
 def save_inference_model(dirname, feeded_var_names, target_vars, executor,
                          main_program=None, model_filename=None,
                          params_filename=None, export_for_deployment=True):
@@ -133,17 +149,7 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
         target_vars = [target_vars]
     target_names = [t.name for t in target_vars]
 
-    inference_program = main_program.clone(for_test=True)
-    # strip training-only ops BEFORE pruning: optimizer ops write ParamOut
-    # under the parameter's own name, so dependency-based pruning alone
-    # would drag the whole backward+optimizer graph into the export
-    # (reference strips by op role, op_proto_maker.h:26-36)
-    gb = inference_program.global_block()
-    gb.ops = [op for op in gb.ops
-              if getattr(op, 'role', 'Forward') not in
-              ('Backward', 'Optimize')]
-    inference_program._bump_version()
-    pruned = inference_program._prune(target_names)
+    pruned = _prune_for_inference(main_program, target_names)
     # _prune keeps all persistables; drop the ones no remaining op touches
     # (optimizer accumulators, learning rate) so the export carries only
     # the weights the model actually reads
@@ -216,13 +222,7 @@ def export_stablehlo_model(dirname, feeded_var_names, target_vars, executor,
     target_names = [t.name for t in target_vars]
     scope = scope if scope is not None else _gs()
 
-    inference_program = main_program.clone(for_test=True)
-    gb = inference_program.global_block()
-    gb.ops = [op for op in gb.ops
-              if getattr(op, 'role', 'Forward') not in
-              ('Backward', 'Optimize')]
-    inference_program._bump_version()
-    pruned = inference_program._prune(target_names)
+    pruned = _prune_for_inference(main_program, target_names)
 
     read, written = _low.analyze_state(pruned, target_names)
     needed = _Exe._read_before_write(pruned, read, written,

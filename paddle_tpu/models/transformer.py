@@ -141,12 +141,12 @@ def build_lm(cfg=None, is_test=False):
         x = transformer_block(x, cfg, 'layer_%d' % i, mask_var=mask_var,
                               is_test=is_test, causal=flash_ok)
         block_outputs.append(x)
-    # per-layer boundaries for rematerialization:
-    # append_backward(checkpoints=cfg.block_outputs) trades recompute FLOPs
-    # for activation HBM (see core/lowering.py _lower_with_remat).
-    # NOTE: rebuilt per program — a second build_lm overwrites this with
-    # that program's fresh var names (the lowering raises loudly if stale
-    # checkpoint names are passed). Also stashed on the program itself.
+    # per-layer boundaries for rematerialization, stashed on the PROGRAM
+    # (names are per-program; stale names raise loudly at lowering):
+    # append_backward(checkpoints=prog._lm_checkpoint_vars) trades
+    # recompute FLOPs for activation HBM (core/lowering.py
+    # _lower_with_remat). cfg.block_outputs mirrors the LAST build for
+    # convenience in single-program scripts.
     cfg.block_outputs = block_outputs
     tokens.block.program._lm_checkpoint_vars = block_outputs
     x = layers.layer_norm(x, begin_norm_axis=2,

@@ -130,5 +130,17 @@ def _flash_attention_op(ctx, op):
     q, k, v = amp.cast_compute(op, q, k, v)
     scale = op.attr('scale', 0.0) or None
     causal = op.attr('causal', True)
-    out = flash_attention(q, k, v, scale=scale, causal=causal)
+    use_pallas = None
+    try:
+        from ..parallel.api import get_active_mesh
+        mesh = get_active_mesh()
+        if mesh is not None and mesh.size > 1:
+            # under SPMD the XLA partitioner cannot split a pallas custom
+            # call; the einsum formulation partitions cleanly over the
+            # mesh instead (per-chip fusion is a later shard_map step)
+            use_pallas = False
+    except Exception:
+        pass
+    out = flash_attention(q, k, v, scale=scale, causal=causal,
+                          use_pallas=use_pallas)
     ctx.out(op, 'Out', out.astype(out_dtype))

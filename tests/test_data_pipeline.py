@@ -92,3 +92,36 @@ def test_inference_transpiler_bn_fold():
     np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
     types = [op.type for op in folded.global_block().ops]
     assert 'batch_norm' not in types
+
+
+class TestImageUtils(object):
+    """reference python/paddle/dataset/image.py geometric utilities."""
+
+    def test_resize_short_and_crops(self):
+        import numpy as np
+        from paddle_tpu.dataset import image as img
+        im = np.arange(20 * 30 * 3, dtype=np.uint8).reshape(20, 30, 3)
+        r = img.resize_short(im, 10)      # short edge 20 -> 10
+        assert r.shape == (10, 15, 3)
+        c = img.center_crop(r, 8)
+        assert c.shape == (8, 8, 3)
+        rc = img.random_crop(r, 8, rng=np.random.RandomState(0))
+        assert rc.shape == (8, 8, 3)
+        f = img.left_right_flip(c)
+        assert (f[:, ::-1] == c).all()
+        chw = img.to_chw(c)
+        assert chw.shape == (3, 8, 8)
+
+    def test_simple_transform_train_eval(self):
+        import numpy as np
+        from paddle_tpu.dataset import image as img
+        im = (np.random.RandomState(1).rand(32, 48, 3) * 255).astype(
+            np.uint8)
+        mean = [120.0, 120.0, 120.0]
+        tr = img.simple_transform(im, 24, 16, is_train=True, mean=mean,
+                                  rng=np.random.RandomState(2))
+        ev = img.simple_transform(im, 24, 16, is_train=False, mean=mean)
+        assert tr.shape == (3, 16, 16) and ev.shape == (3, 16, 16)
+        assert tr.dtype == np.float32
+        # mean subtraction applied
+        assert abs(float(ev.mean())) < 120.0
