@@ -10,6 +10,7 @@ benchmarks to run unchanged.
 from . import common
 from . import mnist
 from . import cifar
+from . import image
 from . import uci_housing
 from . import imdb
 from . import imikolov
@@ -22,8 +23,7 @@ from . import voc2012
 from . import sentiment
 from . import mq2007
 
-__all__ = ['mnist', 'cifar', 'uci_housing', 'imdb', 'imikolov', 'movielens',
+__all__ = [
+    'image','mnist', 'cifar', 'uci_housing', 'imdb', 'imikolov', 'movielens',
            'conll05', 'wmt14', 'wmt16', 'flowers', 'voc2012', 'sentiment',
            'mq2007', 'common']
-
-from . import image

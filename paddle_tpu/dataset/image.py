@@ -97,6 +97,10 @@ def to_chw(im, order=(2, 0, 1)):
 def center_crop(im, size, is_color=True):
     """Crop the center size x size patch (reference image.py:249)."""
     h, w = im.shape[:2]
+    if size > h or size > w:
+        raise ValueError(
+            "center_crop: size %d exceeds image dims (%d, %d)"
+            % (size, h, w))
     h_start = (h - size) // 2
     w_start = (w - size) // 2
     return im[h_start:h_start + size, w_start:w_start + size]

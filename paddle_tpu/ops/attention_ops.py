@@ -131,16 +131,13 @@ def _flash_attention_op(ctx, op):
     scale = op.attr('scale', 0.0) or None
     causal = op.attr('causal', True)
     use_pallas = None
-    try:
-        from ..parallel.api import get_active_mesh
-        mesh = get_active_mesh()
-        if mesh is not None and mesh.size > 1:
-            # under SPMD the XLA partitioner cannot split a pallas custom
-            # call; the einsum formulation partitions cleanly over the
-            # mesh instead (per-chip fusion is a later shard_map step)
-            use_pallas = False
-    except Exception:
-        pass
+    from ..parallel.api import get_active_mesh
+    mesh = get_active_mesh()
+    if mesh is not None and mesh.size > 1:
+        # under SPMD the XLA partitioner cannot split a pallas custom
+        # call; the einsum formulation partitions cleanly over the
+        # mesh instead (per-chip fusion is a later shard_map step)
+        use_pallas = False
     out = flash_attention(q, k, v, scale=scale, causal=causal,
                           use_pallas=use_pallas)
     ctx.out(op, 'Out', out.astype(out_dtype))

@@ -11,3 +11,4 @@ from . import collective
 from . import api
 from .mesh import default_device_count, make_mesh, data_mesh
 from .api import MeshRunner, ShardingRules
+from .ring_attention import ring_attention

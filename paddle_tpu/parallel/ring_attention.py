@@ -1,0 +1,94 @@
+"""Ring attention: sequence/context parallelism for long sequences.
+
+The reference has no long-context sharding (SURVEY §5: its "sequence
+parallelism" is LoD ragged batching); this is the TPU-native extension the
+capability maps onto: the sequence axis is sharded over mesh axis 'seq',
+each device holds an L/n block of Q/K/V, and K/V blocks rotate around the
+ring (lax.ppermute over ICI) while each device accumulates its Q block's
+attention with an online softmax — full attention over sequences n times
+longer than one chip could hold, with communication overlapped around the
+ring (Liu et al., Ring Attention with Blockwise Transformers).
+
+Written with shard_map so the collective schedule is explicit (this is the
+one place XLA's automatic SPMD cannot derive the rotation pattern).
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+__all__ = ['ring_attention']
+
+_NEG_INF = -1e30
+
+
+def _ring_inner(axis_name, scale, causal, q, k, v):
+    """Per-device body: q/k/v [B, H, Lb, dh] local blocks."""
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    b, h, lb, dh = q.shape
+
+    qf = q.astype(jnp.float32)
+    q_pos = idx * lb + jnp.arange(lb)                    # global q rows
+
+    def step(s, carry):
+        m, el, acc, k_cur, v_cur = carry
+        src = jnp.mod(idx - s, n)                        # k_cur's block id
+        k_pos = src * lb + jnp.arange(lb)
+        scores = jnp.einsum('bhqd,bhkd->bhqk', qf,
+                            k_cur.astype(jnp.float32)) * scale
+        if causal:
+            ok = q_pos[:, None] >= k_pos[None, :]
+            scores = jnp.where(ok[None, None], scores, _NEG_INF)
+        blk_max = jnp.max(scores, axis=-1)               # [b,h,lb]
+        m_new = jnp.maximum(m, blk_max)
+        # guard fully-masked blocks (m_new == -inf): no contribution
+        alpha = jnp.exp(jnp.minimum(m - m_new, 0.0))
+        p = jnp.exp(scores - m_new[..., None])
+        p = jnp.where(jnp.isfinite(scores), p, 0.0)
+        el_new = el * alpha + jnp.sum(p, axis=-1)
+        acc_new = acc * alpha[..., None] + jnp.einsum(
+            'bhqk,bhkd->bhqd', p, v_cur.astype(jnp.float32))
+        # rotate k/v one step around the ring
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        k_next = lax.ppermute(k_cur, axis_name, perm)
+        v_next = lax.ppermute(v_cur, axis_name, perm)
+        return m_new, el_new, acc_new, k_next, v_next
+
+    m0 = jnp.full((b, h, lb), _NEG_INF, jnp.float32)
+    el0 = jnp.zeros((b, h, lb), jnp.float32)
+    acc0 = jnp.zeros((b, h, lb, dh), jnp.float32)
+    m, el, acc, _, _ = lax.fori_loop(0, n, step, (m0, el0, acc0, k, v))
+    out = acc / jnp.maximum(el, 1e-20)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh, axis_name='seq', scale=None, causal=True):
+    """Blockwise ring attention. q/k/v: [B, H, L, dh] GLOBAL arrays whose
+    L dimension is (or will be) sharded over `mesh` axis `axis_name`;
+    returns attention output with the same sharding. L must be divisible
+    by the axis size."""
+    try:
+        from jax import shard_map
+    except ImportError:          # older jax
+        from jax.experimental.shard_map import shard_map
+
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    naxis = mesh.shape[axis_name]
+    if q.shape[2] % naxis != 0:
+        raise ValueError(
+            "ring_attention: sequence length %d not divisible by mesh "
+            "axis %r size %d" % (q.shape[2], axis_name, naxis))
+    spec = P(None, None, axis_name, None)
+    inner = functools.partial(_ring_inner, axis_name, float(scale),
+                              bool(causal))
+    try:
+        fn = shard_map(inner, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
+    except TypeError:            # older shard_map keyword
+        fn = shard_map(inner, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_rep=False)
+    return fn(q, k, v)
