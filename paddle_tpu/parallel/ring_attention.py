@@ -33,34 +33,49 @@ def _ring_inner(axis_name, scale, causal, q, k, v):
     qf = q.astype(jnp.float32)
     q_pos = idx * lb + jnp.arange(lb)                    # global q rows
 
-    def step(s, carry):
-        m, el, acc, k_cur, v_cur = carry
+    def accumulate(s, m, el, acc, k_cur, v_cur):
+        """Online-softmax update with the block that originated on device
+        (idx - s) mod n."""
         src = jnp.mod(idx - s, n)                        # k_cur's block id
         k_pos = src * lb + jnp.arange(lb)
         scores = jnp.einsum('bhqd,bhkd->bhqk', qf,
                             k_cur.astype(jnp.float32)) * scale
+        mask = None
         if causal:
-            ok = q_pos[:, None] >= k_pos[None, :]
-            scores = jnp.where(ok[None, None], scores, _NEG_INF)
+            mask = (q_pos[:, None] >= k_pos[None, :])[None, None]
+            scores = jnp.where(mask, scores, _NEG_INF)
         blk_max = jnp.max(scores, axis=-1)               # [b,h,lb]
         m_new = jnp.maximum(m, blk_max)
-        # guard fully-masked blocks (m_new == -inf): no contribution
         alpha = jnp.exp(jnp.minimum(m - m_new, 0.0))
         p = jnp.exp(scores - m_new[..., None])
-        p = jnp.where(jnp.isfinite(scores), p, 0.0)
+        if mask is not None:
+            # masked entries contribute exactly zero even in the
+            # fully-masked-block corner where m_new is still _NEG_INF
+            # (exp(-1e30 - -1e30) would otherwise be 1)
+            p = jnp.where(mask, p, 0.0)
         el_new = el * alpha + jnp.sum(p, axis=-1)
         acc_new = acc * alpha[..., None] + jnp.einsum(
             'bhqk,bhkd->bhqd', p, v_cur.astype(jnp.float32))
+        return m_new, el_new, acc_new
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(s, carry):
+        m, el, acc, k_cur, v_cur = carry
+        m, el, acc = accumulate(s, m, el, acc, k_cur, v_cur)
         # rotate k/v one step around the ring
-        perm = [(i, (i + 1) % n) for i in range(n)]
         k_next = lax.ppermute(k_cur, axis_name, perm)
         v_next = lax.ppermute(v_cur, axis_name, perm)
-        return m_new, el_new, acc_new, k_next, v_next
+        return m, el, acc, k_next, v_next
 
     m0 = jnp.full((b, h, lb), _NEG_INF, jnp.float32)
     el0 = jnp.zeros((b, h, lb), jnp.float32)
     acc0 = jnp.zeros((b, h, lb, dh), jnp.float32)
-    m, el, acc, _, _ = lax.fori_loop(0, n, step, (m0, el0, acc0, k, v))
+    # n-1 rotated steps, then the final block WITHOUT the useless closing
+    # rotation (saves one full K/V round over ICI per call)
+    m, el, acc, k_last, v_last = lax.fori_loop(
+        0, n - 1, step, (m0, el0, acc0, k, v))
+    m, el, acc = accumulate(n - 1, m, el, acc, k_last, v_last)
     out = acc / jnp.maximum(el, 1e-20)[..., None]
     return out.astype(q.dtype)
 
