@@ -60,6 +60,7 @@ from . import flags
 from .flags import get_flags, set_flags
 from . import debugger
 from . import recordio
+from . import checkpoint
 from . import async_executor
 from .async_executor import AsyncExecutor, DataFeedDesc, MultiSlotDataFeed
 from .data_feeder import DataFeeder

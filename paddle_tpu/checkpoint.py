@@ -1,0 +1,96 @@
+"""Sharded checkpointing of the Scope via orbax (SURVEY §5: "orbax-style
+sharded checkpoint of a named state pytree; keep 'everything persistable is
+the checkpoint'").
+
+The reference checkpoints by running generated save/load ops per variable
+(operators/save_op.cc) and pulls parameter-server slices for distributed
+state (io.py _save_distributed_persistables, checkpoint_notify_op.cc).
+TPU-native: the Scope's persistable entries ARE a named pytree; orbax
+writes each array in parallel (per-shard under multi-host / sharded
+Reduce-mode optimizer state) and restores with the original shardings —
+no gather-to-host, no pserver round-trips.
+
+    fluid.checkpoint.save_checkpoint(dirname, main_program, scope=scope)
+    fluid.checkpoint.load_checkpoint(dirname, main_program, scope=scope)
+
+Plain numpy values round-trip too, so single-host users get the same API.
+"""
+import os
+
+import numpy as np
+
+from .framework import default_main_program
+from .executor import global_scope
+
+__all__ = ['save_checkpoint', 'load_checkpoint']
+
+
+def _persistable_state(program, scope, strict=True):
+    state = {}
+    for v in program.list_vars():
+        if not v.persistable:
+            continue
+        val = scope.get(v.name)
+        if val is None:
+            if strict:
+                raise RuntimeError(
+                    "save_checkpoint: persistable %r has no value in the "
+                    "scope — run the startup program first" % v.name)
+            continue
+        state[v.name] = val
+    return state
+
+
+def save_checkpoint(dirname, main_program=None, scope=None, step=None):
+    """Write every persistable var of `main_program` found in `scope`.
+    Sharded jax.Arrays (multi-host or Reduce-mode state) are written
+    per-shard in parallel by orbax. Returns the checkpoint path."""
+    import orbax.checkpoint as ocp
+
+    main_program = main_program if main_program is not None else \
+        default_main_program()
+    scope = scope if scope is not None else global_scope()
+    state = _persistable_state(main_program, scope)
+    if not state:
+        raise RuntimeError("save_checkpoint: nothing persistable to save")
+
+    path = os.path.abspath(dirname if step is None
+                           else os.path.join(dirname, 'step_%d' % step))
+    ckpt = ocp.StandardCheckpointer()
+    ckpt.save(path, state, force=True)
+    ckpt.wait_until_finished()
+    return path
+
+
+def load_checkpoint(dirname, main_program=None, scope=None, step=None):
+    """Restore persistable vars into `scope`. Arrays come back with the
+    shardings they were saved with (orbax restores the layout); numpy
+    values restore as numpy. Returns the list of restored names."""
+    import orbax.checkpoint as ocp
+
+    main_program = main_program if main_program is not None else \
+        default_main_program()
+    scope = scope if scope is not None else global_scope()
+    path = os.path.abspath(dirname if step is None
+                           else os.path.join(dirname, 'step_%d' % step))
+    if not os.path.exists(path):
+        raise IOError("load_checkpoint: %r does not exist" % path)
+
+    ckpt = ocp.StandardCheckpointer()
+    restored = ckpt.restore(path)
+    # scope the restore to the program's persistables and validate the
+    # checkpoint matches (the symmetric contract of save_checkpoint)
+    wanted = set(v.name for v in main_program.list_vars() if v.persistable)
+    missing = wanted - set(restored)
+    if missing:
+        raise RuntimeError(
+            "load_checkpoint: checkpoint at %r is missing persistable "
+            "vars %s of the given program — wrong checkpoint/program "
+            "pair?" % (path, sorted(missing)))
+    names = []
+    for name, val in restored.items():
+        if name not in wanted:
+            continue          # extra entries from a superset program
+        scope.set(name, val)
+        names.append(name)
+    return sorted(names)
