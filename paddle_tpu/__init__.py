@@ -61,6 +61,9 @@ from .flags import get_flags, set_flags
 from . import debugger
 from . import recordio
 from . import checkpoint
+from . import average
+from .average import WeightedAverage
+from . import contrib
 from . import async_executor
 from .async_executor import AsyncExecutor, DataFeedDesc, MultiSlotDataFeed
 from .data_feeder import DataFeeder

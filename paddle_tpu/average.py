@@ -1,0 +1,38 @@
+"""WeightedAverage (reference python/paddle/fluid/average.py:40) — a pure
+host-side accumulator, unchanged semantics."""
+import numpy as np
+
+__all__ = ['WeightedAverage']
+
+
+def _is_number_or_matrix(var):
+    return isinstance(var, (int, float, np.ndarray)) or np.isscalar(var)
+
+
+class WeightedAverage(object):
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.numerator = None
+        self.denominator = None
+
+    def add(self, value, weight):
+        if not _is_number_or_matrix(value):
+            raise ValueError(
+                "The 'value' must be a number(int, float) or a numpy "
+                "ndarray.")
+        if not _is_number_or_matrix(weight):
+            raise ValueError("The 'weight' must be a number(int, float).")
+        if self.numerator is None or self.denominator is None:
+            self.numerator = value * weight
+            self.denominator = weight
+        else:
+            self.numerator += value * weight
+            self.denominator += weight
+
+    def eval(self):
+        if self.numerator is None or self.denominator is None:
+            raise ValueError(
+                "There is no data to be averaged in WeightedAverage.")
+        return self.numerator / self.denominator

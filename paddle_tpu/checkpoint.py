@@ -56,9 +56,9 @@ def save_checkpoint(dirname, main_program=None, scope=None, step=None):
 
     path = os.path.abspath(dirname if step is None
                            else os.path.join(dirname, 'step_%d' % step))
-    ckpt = ocp.StandardCheckpointer()
-    ckpt.save(path, state, force=True)
-    ckpt.wait_until_finished()
+    with ocp.StandardCheckpointer() as ckpt:
+        ckpt.save(path, state, force=True)
+        ckpt.wait_until_finished()
     return path
 
 
@@ -76,8 +76,8 @@ def load_checkpoint(dirname, main_program=None, scope=None, step=None):
     if not os.path.exists(path):
         raise IOError("load_checkpoint: %r does not exist" % path)
 
-    ckpt = ocp.StandardCheckpointer()
-    restored = ckpt.restore(path)
+    with ocp.StandardCheckpointer() as ckpt:
+        restored = ckpt.restore(path)
     # scope the restore to the program's persistables and validate the
     # checkpoint matches (the symmetric contract of save_checkpoint)
     wanted = set(v.name for v in main_program.list_vars() if v.persistable)

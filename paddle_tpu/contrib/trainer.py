@@ -1,0 +1,199 @@
+"""High-level Trainer/Inferencer API (reference
+python/paddle/fluid/contrib/trainer.py Trainer:169 / inferencer.py —
+the book 'high-level-api' test surface).
+
+The Trainer owns its programs and scope: `train_func` builds the forward
+and returns [loss, *metrics]; `optimizer_func` returns the optimizer. Each
+`train()` epoch streams a reader through the executor and fires the event
+handler with Begin/End Epoch/Step events. On TPU the underlying executor
+is the whole-program-compiled one; pass parallel=True to run data-parallel
+over the visible mesh (the reference's ParallelExecutor path)."""
+import numpy as np
+
+from ..framework import Program, program_guard
+from ..executor import Executor, Scope, scope_guard
+from ..data_feeder import DataFeeder
+from .. import io as _io
+from .. import unique_name
+
+__all__ = ['BeginEpochEvent', 'EndEpochEvent', 'BeginStepEvent',
+           'EndStepEvent', 'Trainer', 'Inferencer']
+
+
+class BeginEpochEvent(object):
+    def __init__(self, epoch_id):
+        self.epoch = epoch_id
+
+
+class EndEpochEvent(object):
+    def __init__(self, epoch_id):
+        self.epoch = epoch_id
+
+
+class BeginStepEvent(object):
+    def __init__(self, epoch_id, step_id):
+        self.epoch = epoch_id
+        self.step = step_id
+        # reference: handler may flip this to request metric fetches
+        self.fetch_metrics = True
+
+
+class EndStepEvent(object):
+    def __init__(self, epoch_id, step_id, metrics):
+        self.epoch = epoch_id
+        self.step = step_id
+        self.metrics = metrics
+
+
+class Trainer(object):
+    def __init__(self, train_func, optimizer_func, place=None,
+                 parallel=False, checkpoint_config=None):
+        self.place = place
+        self.parallel = parallel
+        # CheckpointConfig(dir, epoch_interval/step_interval) — wired to
+        # fluid.checkpoint (save after each epoch at the configured dir)
+        self.checkpoint_config = checkpoint_config
+        self.scope = Scope()
+        self.startup_program = Program()
+        self.train_program = Program()
+        with program_guard(self.train_program, self.startup_program):
+            with unique_name.guard():   # reference Trainer does the same:
+                # fresh name counters so re-built programs (Inferencer)
+                # reproduce identical parameter names
+                outs = train_func()
+            outs = outs if isinstance(outs, (list, tuple)) else [outs]
+            self.train_func_outputs = list(outs)
+            self.loss = outs[0]
+            # test program BEFORE optimizer ops (reference clones here)
+            self.test_program = self.train_program.clone(for_test=True)
+            optimizer = optimizer_func()
+            optimizer.minimize(self.loss)
+        self.exe = Executor(place)
+        with scope_guard(self.scope):
+            self.exe.run(self.startup_program, scope=self.scope)
+        self._compiled = None
+        self.__stopped = False
+
+    def _train_target(self):
+        if not self.parallel:
+            return self.train_program
+        if self._compiled is None:
+            from ..compiler import CompiledProgram
+            self._compiled = CompiledProgram(
+                self.train_program).with_data_parallel(
+                    loss_name=self.loss.name)
+        return self._compiled
+
+    def stop(self):
+        self.__stopped = True
+
+    def train(self, num_epochs, event_handler, reader=None,
+              feed_order=None):
+        if reader is None:
+            raise ValueError(
+                "Trainer.train needs a reader (a no-arg callable yielding "
+                "batches); got None")
+        feeder = DataFeeder(feed_list=feed_order,
+                            place=self.place,
+                            program=self.train_program) \
+            if feed_order else None
+        target = self._train_target()
+        fetch = [v.name for v in self.train_func_outputs]
+        with scope_guard(self.scope):
+            for epoch_id in range(num_epochs):
+                if self.__stopped:
+                    return
+                event_handler(BeginEpochEvent(epoch_id))
+                for step_id, data in enumerate(reader()):
+                    if self.__stopped:
+                        return
+                    begin = BeginStepEvent(epoch_id, step_id)
+                    event_handler(begin)
+                    feed = feeder.feed(data) if feeder else data
+                    if begin.fetch_metrics:
+                        metrics = self.exe.run(target, feed=feed,
+                                               fetch_list=fetch,
+                                               scope=self.scope)
+                    else:
+                        self.exe.run(target, feed=feed, scope=self.scope)
+                        metrics = None
+                    event_handler(EndStepEvent(epoch_id, step_id,
+                                               metrics))
+                event_handler(EndEpochEvent(epoch_id))
+                self._maybe_checkpoint(epoch_id)
+
+    def _maybe_checkpoint(self, epoch_id):
+        cc = self.checkpoint_config
+        if cc is None:
+            return
+        d = getattr(cc, 'checkpoint_dir', None) or \
+            (cc if isinstance(cc, str) else None)
+        if not d:
+            return
+        every = getattr(cc, 'epoch_interval', 1) or 1
+        if (epoch_id + 1) % every == 0:
+            from .. import checkpoint as _ckpt
+            _ckpt.save_checkpoint(d, self.train_program, scope=self.scope)
+
+    def test(self, reader, feed_order):
+        feeder = DataFeeder(feed_list=feed_order, place=self.place,
+                            program=self.test_program)
+        fetch = [v.name for v in self.train_func_outputs]
+        accumulated = None
+        total_w = 0
+        with scope_guard(self.scope):
+            for data in reader():
+                outs = self.exe.run(self.test_program,
+                                    feed=feeder.feed(data),
+                                    fetch_list=fetch, scope=self.scope)
+                w = len(data)
+                vals = [float(np.mean(np.asarray(o))) * w for o in outs]
+                accumulated = vals if accumulated is None else \
+                    [a + v for a, v in zip(accumulated, vals)]
+                total_w += w
+        return [a / max(total_w, 1) for a in (accumulated or [])]
+
+    def save_params(self, param_path):
+        with scope_guard(self.scope):
+            _io.save_persistables(self.exe, param_path,
+                                  self.train_program)
+
+    def save_inference_model(self, param_path, feeded_var_names,
+                             target_var_indexes):
+        targets = [self.train_func_outputs[i]
+                   for i in target_var_indexes]
+        with scope_guard(self.scope):
+            _io.save_inference_model(param_path, feeded_var_names,
+                                     targets, self.exe,
+                                     main_program=self.test_program)
+
+
+class Inferencer(object):
+    """reference contrib/inferencer.py: infer_func rebuilds the forward;
+    params load from a Trainer.save_params / save_inference_model dir."""
+
+    def __init__(self, infer_func, param_path, place=None,
+                 parallel=False):
+        if parallel:
+            raise NotImplementedError(
+                "Inferencer(parallel=True): run the returned program "
+                "through CompiledProgram.with_data_parallel instead")
+        self.place = place
+        self.scope = Scope()
+        self.inference_program = Program()
+        startup = Program()
+        with program_guard(self.inference_program, startup):
+            with unique_name.guard():   # same fresh-name discipline as
+                # Trainer, so parameter names line up with saved params
+                self.predict_var = infer_func()
+        self.exe = Executor(place)
+        with scope_guard(self.scope):
+            _io.load_persistables(self.exe, param_path,
+                                  self.inference_program)
+
+    def infer(self, inputs, return_numpy=True):
+        with scope_guard(self.scope):
+            return self.exe.run(self.inference_program, feed=inputs,
+                                fetch_list=[self.predict_var],
+                                scope=self.scope,
+                                return_numpy=return_numpy)

@@ -1,0 +1,122 @@
+"""High-level Trainer/Inferencer API (reference contrib/trainer.py +
+tests/book/high-level-api pattern)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _train_func():
+    x = fluid.layers.data(name='x', shape=[8], dtype='float32')
+    y = fluid.layers.data(name='y', shape=[1], dtype='int64')
+    h = fluid.layers.fc(x, size=16, act='relu')
+    pred = fluid.layers.fc(h, size=3, act='softmax')
+    loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, y))
+    acc = fluid.layers.accuracy(input=pred, label=y)
+    return [loss, acc]
+
+
+def _reader():
+    rng = np.random.RandomState(0)
+    lab = rng.randint(0, 3, 64)
+    centers = rng.randn(3, 8) * 2
+    X = (centers[lab] + 0.4 * rng.randn(64, 8)).astype('float32')
+    def r():
+        for i in range(0, 64, 16):
+            yield [(X[j], int(lab[j])) for j in range(i, i + 16)]
+    return r
+
+
+class TestTrainerAPI(object):
+    def test_train_events_test_save_infer(self, tmp_path):
+        events = []
+
+        def handler(e):
+            events.append(type(e).__name__)
+            if isinstance(e, fluid.contrib.EndStepEvent):
+                assert e.metrics is not None
+
+        trainer = fluid.contrib.Trainer(
+            train_func=_train_func,
+            optimizer_func=lambda: fluid.optimizer.Adam(0.05),
+            place=fluid.CPUPlace())
+        trainer.train(num_epochs=3, event_handler=handler,
+                      reader=_reader(), feed_order=['x', 'y'])
+        assert events.count('BeginEpochEvent') == 3
+        assert events.count('EndStepEvent') == 12
+
+        loss_avg, acc_avg = trainer.test(reader=_reader(),
+                                         feed_order=['x', 'y'])
+        assert acc_avg > 0.8, (loss_avg, acc_avg)
+
+        d = str(tmp_path / "params")
+        trainer.save_params(d)
+
+        def infer_func():
+            x = fluid.layers.data(name='x', shape=[8], dtype='float32')
+            h = fluid.layers.fc(x, size=16, act='relu')
+            return fluid.layers.fc(h, size=3, act='softmax')
+
+        inf = fluid.contrib.Inferencer(infer_func, d,
+                                       place=fluid.CPUPlace())
+        rng = np.random.RandomState(1)
+        out, = inf.infer({'x': rng.randn(4, 8).astype('float32')})
+        assert np.asarray(out).shape == (4, 3)
+
+    def test_stop_inside_handler(self):
+        seen = []
+
+        def handler(e):
+            seen.append(e)
+            if isinstance(e, fluid.contrib.EndStepEvent) and e.step >= 1:
+                trainer.stop()
+
+        trainer = fluid.contrib.Trainer(
+            train_func=_train_func,
+            optimizer_func=lambda: fluid.optimizer.SGD(0.1),
+            place=fluid.CPUPlace())
+        trainer.train(num_epochs=5, event_handler=handler,
+                      reader=_reader(), feed_order=['x', 'y'])
+        steps = [e for e in seen
+                 if isinstance(e, fluid.contrib.EndStepEvent)]
+        assert len(steps) == 2
+
+    def test_weighted_average(self):
+        avg = fluid.WeightedAverage()
+        avg.add(value=2.0, weight=1)
+        avg.add(value=4.0, weight=2)
+        assert abs(avg.eval() - 10.0 / 3) < 1e-9
+        avg.reset()
+        with pytest.raises(ValueError):
+            avg.eval()
+
+
+def test_checkpoint_config_saves_each_epoch(tmp_path):
+    class CheckpointConfig(object):
+        def __init__(self, checkpoint_dir, epoch_interval=1):
+            self.checkpoint_dir = checkpoint_dir
+            self.epoch_interval = epoch_interval
+
+    d = str(tmp_path / "trainer_ck")
+    trainer = fluid.contrib.Trainer(
+        train_func=_train_func,
+        optimizer_func=lambda: fluid.optimizer.SGD(0.1),
+        place=fluid.CPUPlace(),
+        checkpoint_config=CheckpointConfig(d))
+    trainer.train(num_epochs=2, event_handler=lambda e: None,
+                  reader=_reader(), feed_order=['x', 'y'])
+    import os
+    assert os.path.isdir(d)
+    with fluid.scope_guard(fluid.Scope()):
+        names = fluid.checkpoint.load_checkpoint(d, trainer.train_program)
+    assert names
+
+
+def test_train_requires_reader():
+    trainer = fluid.contrib.Trainer(
+        train_func=_train_func,
+        optimizer_func=lambda: fluid.optimizer.SGD(0.1),
+        place=fluid.CPUPlace())
+    import pytest as _pt
+    with _pt.raises(ValueError, match="needs a reader"):
+        trainer.train(num_epochs=1, event_handler=lambda e: None)
