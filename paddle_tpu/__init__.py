@@ -71,7 +71,6 @@ from . import compiler
 from .compiler import CompiledProgram
 from .parallel_executor import ParallelExecutor
 from .parallel_executor import ExecutionStrategy, BuildStrategy
-from . import contrib
 from . import inference
 from .inference import Predictor, PredictorConfig, create_predictor
 

@@ -50,24 +50,36 @@ class Trainer(object):
                  parallel=False, checkpoint_config=None):
         self.place = place
         self.parallel = parallel
-        # CheckpointConfig(dir, epoch_interval/step_interval) — wired to
-        # fluid.checkpoint (save after each epoch at the configured dir)
+        # CheckpointConfig(checkpoint_dir, epoch_interval) — saved via
+        # fluid.checkpoint after every epoch_interval epochs (step-based
+        # saving is not supported; pass a handler that calls
+        # fluid.checkpoint.save_checkpoint for finer control)
         self.checkpoint_config = checkpoint_config
+        if checkpoint_config is not None and \
+                getattr(checkpoint_config, 'step_interval', None):
+            raise NotImplementedError(
+                "CheckpointConfig.step_interval is not supported — "
+                "checkpoints save per epoch_interval; save manually in "
+                "an EndStepEvent handler for step-based saving")
         self.scope = Scope()
         self.startup_program = Program()
         self.train_program = Program()
         with program_guard(self.train_program, self.startup_program):
             with unique_name.guard():   # reference Trainer does the same:
                 # fresh name counters so re-built programs (Inferencer)
-                # reproduce identical parameter names
+                # and other processes reproduce identical names — the
+                # optimizer's lr/accumulator vars included, or
+                # checkpoints would not be portable across processes
                 outs = train_func()
-            outs = outs if isinstance(outs, (list, tuple)) else [outs]
-            self.train_func_outputs = list(outs)
-            self.loss = outs[0]
-            # test program BEFORE optimizer ops (reference clones here)
-            self.test_program = self.train_program.clone(for_test=True)
-            optimizer = optimizer_func()
-            optimizer.minimize(self.loss)
+                outs = outs if isinstance(outs, (list, tuple)) else [outs]
+                self.train_func_outputs = list(outs)
+                self.loss = outs[0]
+                # test program BEFORE optimizer ops (reference clones
+                # here)
+                self.test_program = self.train_program.clone(
+                    for_test=True)
+                optimizer = optimizer_func()
+                optimizer.minimize(self.loss)
         self.exe = Executor(place)
         with scope_guard(self.scope):
             self.exe.run(self.startup_program, scope=self.scope)
@@ -139,19 +151,17 @@ class Trainer(object):
         feeder = DataFeeder(feed_list=feed_order, place=self.place,
                             program=self.test_program)
         fetch = [v.name for v in self.train_func_outputs]
-        accumulated = None
-        total_w = 0
+        from ..average import WeightedAverage
+        avgs = [WeightedAverage() for _ in fetch]
         with scope_guard(self.scope):
             for data in reader():
                 outs = self.exe.run(self.test_program,
                                     feed=feeder.feed(data),
                                     fetch_list=fetch, scope=self.scope)
-                w = len(data)
-                vals = [float(np.mean(np.asarray(o))) * w for o in outs]
-                accumulated = vals if accumulated is None else \
-                    [a + v for a, v in zip(accumulated, vals)]
-                total_w += w
-        return [a / max(total_w, 1) for a in (accumulated or [])]
+                for avg, o in zip(avgs, outs):
+                    avg.add(value=float(np.mean(np.asarray(o))),
+                            weight=len(data))
+        return [a.eval() for a in avgs]
 
     def save_params(self, param_path):
         with scope_guard(self.scope):

@@ -4,7 +4,8 @@ EditDistance, DetectionMAP, Auc)."""
 import numpy as np
 
 __all__ = ['MetricBase', 'CompositeMetric', 'Precision', 'Recall',
-           'Accuracy', 'ChunkEvaluator', 'EditDistance', 'Auc']
+           'Accuracy', 'ChunkEvaluator', 'EditDistance', 'Auc',
+           'DetectionMAP']
 
 
 class MetricBase(object):
@@ -188,3 +189,122 @@ class Auc(MetricBase):
             idx -= 1
         return auc / tot_pos / tot_neg if tot_pos > 0.0 and tot_neg > 0.0 \
             else 0.0
+
+
+class DetectionMAP(MetricBase):
+    """Mean average precision for detection (reference fluid/metrics.py
+    DetectionMAP / operators/detection/detection_map_op.cc), computed
+    host-side per image.
+
+    update(detections, gt_boxes, gt_labels, difficult=None) per image:
+    - detections: [K, 6] rows (label, score, x1, y1, x2, y2); rows with
+      label < 0 are padding (the multiclass_nms static-capacity sentinel)
+      and are ignored;
+    - gt_boxes: [G, 4] corners; gt_labels: [G] ints;
+    - difficult: optional [G] bools (skipped unless evaluate_difficult).
+    eval() returns mAP over classes that have ground truth.
+    """
+
+    def __init__(self, name=None, class_num=None, overlap_threshold=0.5,
+                 evaluate_difficult=False, ap_version='integral'):
+        super(DetectionMAP, self).__init__(name)
+        if ap_version not in ('integral', '11point'):
+            raise ValueError("ap_version must be 'integral' or '11point'")
+        self.class_num = class_num
+        self.overlap_threshold = overlap_threshold
+        self.evaluate_difficult = evaluate_difficult
+        self.ap_version = ap_version
+        self.reset()
+
+    def reset(self, executor=None, program=None):
+        self._preds = {}      # class -> list of (score, tp)
+        self._gt_counts = {}  # class -> #non-difficult gt
+
+    @staticmethod
+    def _iou(box, boxes):
+        ix1 = np.maximum(box[0], boxes[:, 0])
+        iy1 = np.maximum(box[1], boxes[:, 1])
+        ix2 = np.minimum(box[2], boxes[:, 2])
+        iy2 = np.minimum(box[3], boxes[:, 3])
+        iw = np.maximum(ix2 - ix1, 0)
+        ih = np.maximum(iy2 - iy1, 0)
+        inter = iw * ih
+        a1 = (box[2] - box[0]) * (box[3] - box[1])
+        a2 = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+        union = a1 + a2 - inter
+        return np.where(union > 0, inter / np.maximum(union, 1e-12), 0.0)
+
+    def update(self, detections, gt_boxes, gt_labels, difficult=None):
+        detections = np.asarray(detections, np.float32).reshape(-1, 6)
+        detections = detections[detections[:, 0] >= 0]   # drop padding
+        gt_boxes = np.asarray(gt_boxes, np.float32).reshape(-1, 4)
+        gt_labels = np.asarray(gt_labels).reshape(-1).astype(int)
+        if difficult is None:
+            difficult = np.zeros(len(gt_labels), bool)
+        else:
+            difficult = np.asarray(difficult).reshape(-1).astype(bool)
+
+        for c in np.unique(gt_labels):
+            keep = (gt_labels == c) & (self.evaluate_difficult |
+                                       ~difficult)
+            self._gt_counts[int(c)] = \
+                self._gt_counts.get(int(c), 0) + int(keep.sum())
+
+        order = np.argsort(-detections[:, 1])
+        matched = np.zeros(len(gt_labels), bool)
+        for i in order:
+            label = int(detections[i, 0])
+            score = float(detections[i, 1])
+            box = detections[i, 2:6]
+            cand = np.where(gt_labels == label)[0]
+            best, best_iou = -1, self.overlap_threshold
+            if len(cand):
+                ious = self._iou(box, gt_boxes[cand])
+                j = int(np.argmax(ious))
+                if ious[j] >= best_iou:
+                    best = cand[j]
+            preds = self._preds.setdefault(label, [])
+            if best >= 0 and not matched[best]:
+                matched[best] = True
+                if difficult[best] and not self.evaluate_difficult:
+                    continue     # difficult matches are ignored entirely
+                preds.append((score, 1))
+            else:
+                preds.append((score, 0))
+
+    def _ap(self, preds, n_gt):
+        if n_gt == 0:
+            return None
+        if len(preds) == 0:
+            return 0.0
+        preds = sorted(preds, key=lambda p: -p[0])
+        tps = np.cumsum([p[1] for p in preds])
+        fps = np.cumsum([1 - p[1] for p in preds])
+        recall = tps / n_gt
+        precision = tps / np.maximum(tps + fps, 1e-12)
+        if self.ap_version == '11point':
+            ap = 0.0
+            for t in np.linspace(0, 1, 11):
+                p = precision[recall >= t].max() if (recall >= t).any() \
+                    else 0.0
+                ap += p / 11.0
+            return ap
+        # integral (VOC-style continuous)
+        ap = 0.0
+        prev_r = 0.0
+        for r, p in zip(recall, precision):
+            ap += (r - prev_r) * p
+            prev_r = r
+        return ap
+
+    def eval(self, executor=None, program=None):
+        aps = []
+        for c, n_gt in self._gt_counts.items():
+            ap = self._ap(self._preds.get(c, []), n_gt)
+            if ap is not None:
+                aps.append(ap)
+        if not aps:
+            raise ValueError(
+                "DetectionMAP: no ground truth accumulated — call "
+                "update() first")
+        return float(np.mean(aps))

@@ -536,3 +536,46 @@ class TestEmptyGroundTruth(object):
         # only background sampled, loc branch fully masked
         assert int(label.sum()) == 0
         assert (biw == 0).all()
+
+
+class TestDetectionMAPMetric(object):
+    def test_perfect_detections_map_1(self):
+        from paddle_tpu.metrics import DetectionMAP
+        m = DetectionMAP()
+        gt = np.array([[0., 0., 10., 10.], [20., 20., 30., 30.]])
+        labels = np.array([1, 2])
+        dets = np.array([[1, 0.9, 0., 0., 10., 10.],
+                         [2, 0.8, 20., 20., 30., 30.],
+                         [-1, 0., -1., -1., -1., -1.]])   # padding row
+        m.update(dets, gt, labels)
+        assert abs(m.eval() - 1.0) < 1e-6
+
+    def test_false_positive_lowers_map(self):
+        from paddle_tpu.metrics import DetectionMAP
+        m = DetectionMAP()
+        gt = np.array([[0., 0., 10., 10.]])
+        labels = np.array([1])
+        dets = np.array([[1, 0.9, 50., 50., 60., 60.],   # FP (higher score)
+                         [1, 0.8, 0., 0., 10., 10.]])    # TP
+        m.update(dets, gt, labels)
+        # precision at the TP point is 1/2; integral AP = 0.5
+        assert abs(m.eval() - 0.5) < 1e-6
+
+    def test_accumulates_across_images_and_nms_pipeline(self):
+        """End-to-end: multiclass_nms padded output feeds the metric."""
+        from paddle_tpu.metrics import DetectionMAP
+        boxes = np.array([[0., 0., 10., 10.],
+                          [20., 20., 30., 30.]], np.float32)[None]
+        scores = np.array([[0.0, 0.0],          # background
+                           [0.9, 0.1],
+                           [0.1, 0.8]], np.float32)[None]
+        out, = _run_single_op(
+            'multiclass_nms', {'BBoxes': boxes, 'Scores': scores},
+            {'Out': ['map_nms_out']},
+            {'background_label': 0, 'score_threshold': 0.3,
+             'nms_top_k': 2, 'nms_threshold': 0.5, 'nms_eta': 1.0,
+             'keep_top_k': 4, 'normalized': True})
+        m = DetectionMAP()
+        gt = np.array([[0., 0., 10., 10.], [20., 20., 30., 30.]])
+        m.update(out.reshape(-1, 6), gt, np.array([1, 2]))
+        assert abs(m.eval() - 1.0) < 1e-6
