@@ -57,10 +57,14 @@ class Trainer(object):
         self.checkpoint_config = checkpoint_config
         if checkpoint_config is not None and \
                 getattr(checkpoint_config, 'step_interval', None):
-            raise NotImplementedError(
-                "CheckpointConfig.step_interval is not supported — "
-                "checkpoints save per epoch_interval; save manually in "
-                "an EndStepEvent handler for step-based saving")
+            # the reference CheckpointConfig defaults step_interval=10;
+            # only epoch-based saving is implemented here
+            import warnings
+            warnings.warn(
+                "CheckpointConfig.step_interval is ignored — checkpoints "
+                "save per epoch_interval; save manually in an "
+                "EndStepEvent handler for step-based saving",
+                stacklevel=2)
         self.scope = Scope()
         self.startup_program = Program()
         self.train_program = Program()

@@ -533,11 +533,48 @@ def roi_perspective_transform(input, rois, transformed_height,
         "rectangular RoI extraction")
 
 
-def generate_proposal_labels(*args, **kwargs):
-    raise NotImplementedError(
-        "generate_proposal_labels (reference "
-        "operators/detection/generate_proposal_labels_op.cc) requires "
-        "dynamic subsampling of proposals; planned with the Mask-RCNN wave")
+def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
+                             im_info, batch_size_per_im=256,
+                             fg_fraction=0.25, fg_thresh=0.25,
+                             bg_thresh_hi=0.5, bg_thresh_lo=0.0,
+                             bbox_reg_weights=[0.1, 0.1, 0.2, 0.2],
+                             class_nums=None, use_random=True):
+    """reference layers/detection.py generate_proposal_labels: sample
+    Fast-RCNN training rois + per-class regression targets. Fixed
+    batch_size_per_im rows per image (static-shape policy; padding slots
+    repeat the last valid sample)."""
+    if not class_nums:
+        raise ValueError(
+            "generate_proposal_labels: class_nums is required (the "
+            "per-class regression target width is 4 * class_nums)")
+    helper = LayerHelper('generate_proposal_labels')
+    rois = helper.create_variable_for_type_inference(rpn_rois.dtype)
+    labels_int32 = helper.create_variable_for_type_inference('int32')
+    bbox_targets = helper.create_variable_for_type_inference(
+        rpn_rois.dtype)
+    bbox_inside_weights = helper.create_variable_for_type_inference(
+        rpn_rois.dtype)
+    bbox_outside_weights = helper.create_variable_for_type_inference(
+        rpn_rois.dtype)
+    helper.append_op(
+        type='generate_proposal_labels',
+        inputs={'RpnRois': [rpn_rois], 'GtClasses': [gt_classes],
+                'IsCrowd': [is_crowd], 'GtBoxes': [gt_boxes],
+                'ImInfo': [im_info]},
+        outputs={'Rois': [rois], 'LabelsInt32': [labels_int32],
+                 'BboxTargets': [bbox_targets],
+                 'BboxInsideWeights': [bbox_inside_weights],
+                 'BboxOutsideWeights': [bbox_outside_weights]},
+        attrs={'batch_size_per_im': batch_size_per_im,
+               'fg_fraction': fg_fraction, 'fg_thresh': fg_thresh,
+               'bg_thresh_hi': bg_thresh_hi, 'bg_thresh_lo': bg_thresh_lo,
+               'bbox_reg_weights': list(bbox_reg_weights),
+               'class_nums': class_nums, 'use_random': use_random})
+    for v in (rois, labels_int32, bbox_targets, bbox_inside_weights,
+              bbox_outside_weights):
+        v.stop_gradient = True
+    return (rois, labels_int32, bbox_targets, bbox_inside_weights,
+            bbox_outside_weights)
 
 
 def generate_mask_labels(*args, **kwargs):

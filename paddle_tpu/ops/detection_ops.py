@@ -971,3 +971,143 @@ def _rpn_target_assign(ctx, op):
                  'TargetBBox', 'BBoxInsideWeight'):
         if op.output(slot):
             ctx.set_lod(op.output(slot)[0], ())
+
+
+@register_op('generate_proposal_labels', needs_rng=True)
+def _generate_proposal_labels(ctx, op):
+    """reference operators/detection/generate_proposal_labels_op.cc
+    (SampleRoisForOneImage): mix RPN proposals with ground truth, split
+    into fg (IoU > fg_thresh) / bg (bg_thresh_lo <= IoU < bg_thresh_hi),
+    subsample to batch_size_per_im with fg_fraction, and emit per-class
+    expanded regression targets.
+
+    TPU deviation (the rpn_target_assign fixed-quota policy): every image
+    emits exactly batch_size_per_im rows (uniform static LoD); when fewer
+    eligible boxes exist, slots repeat the last valid sample so padding
+    never trains a fabricated example."""
+    rpn_rois = ctx.in1(op, 'RpnRois')          # LoD [sum_r, 4]
+    gt_classes = ctx.in1(op, 'GtClasses')      # LoD [sum_g, 1]
+    is_crowd = ctx.in1(op, 'IsCrowd')          # LoD [sum_g, 1]
+    gt_boxes = ctx.in1(op, 'GtBoxes')          # LoD [sum_g, 4]
+    im_info = ctx.in1(op, 'ImInfo')            # [N, 3]
+    roi_lod = ctx.in1_lod(op, 'RpnRois')
+    gt_lod = ctx.in1_lod(op, 'GtBoxes')
+    batch = int(op.attr('batch_size_per_im', 256))
+    fg_fraction = op.attr('fg_fraction', 0.25)
+    fg_thresh = op.attr('fg_thresh', 0.25)
+    bg_hi = op.attr('bg_thresh_hi', 0.5)
+    bg_lo = op.attr('bg_thresh_lo', 0.0)
+    weights = [float(w) for w in op.attr('bbox_reg_weights',
+                                         [0.1, 0.1, 0.2, 0.2])]
+    class_nums = int(op.attr('class_nums'))
+    use_random = op.attr('use_random', True)
+
+    roff = roi_lod[-1] if roi_lod else (0, rpn_rois.shape[0])
+    goff = gt_lod[-1] if gt_lod else (0, gt_boxes.shape[0])
+    n = len(roff) - 1
+    fg_quota = int(round(fg_fraction * batch))
+    key = ctx.rng()
+
+    rois_o, labels_o, tgt_o, biw_o, bow_o = [], [], [], [], []
+    for i in range(n):
+        rois_i = rpn_rois[roff[i]:roff[i + 1]] / im_info[i, 2]
+        gt_i = gt_boxes[goff[i]:goff[i + 1]]
+        cls_i = gt_classes[goff[i]:goff[i + 1]].reshape(-1).astype(
+            jnp.int32)
+        crowd_i = is_crowd[goff[i]:goff[i + 1]].reshape(-1) > 0 \
+            if is_crowd is not None else jnp.zeros(gt_i.shape[0], bool)
+        boxes = jnp.concatenate([gt_i, rois_i], 0)     # gt first (ref)
+        p = boxes.shape[0]
+        n_gt = gt_i.shape[0]
+        if n_gt == 0:
+            overlaps = jnp.zeros((p, 1))
+            cls_i = jnp.zeros((1,), jnp.int32)
+            gt_i = jnp.zeros((1, 4), boxes.dtype)
+            crowd_i = jnp.zeros((1,), bool)
+        else:
+            # pixel +1 convention like the reference BboxOverlaps
+            overlaps = _iou_matrix(boxes, gt_i, normalized=False)
+        max_ov = jnp.max(overlaps, 1)
+        arg_gt = jnp.argmax(overlaps, 1)
+        # crowd gt boxes (the first n_gt rows of `boxes`) are excluded
+        # from both fg and bg (reference sets their max_overlap to -1)
+        row_is_crowd = jnp.concatenate(
+            [crowd_i, jnp.zeros((p - crowd_i.shape[0],), bool)]) \
+            if n_gt else jnp.zeros((p,), bool)
+        max_ov = jnp.where(row_is_crowd, -1.0, max_ov)
+
+        fg = max_ov > fg_thresh
+        bg = (~fg) & (max_ov >= bg_lo) & (max_ov < bg_hi)
+        ki = jax.random.fold_in(key, i)
+        rand = jax.random.uniform(ki, (p,)) if use_random else \
+            jnp.arange(p, dtype=jnp.float32) / p
+        fg_rank = jnp.argsort(jnp.argsort(jnp.where(fg, rand, 2.0)))
+        fg_keep = fg & (fg_rank < fg_quota)
+        n_fg = jnp.sum(fg_keep.astype(jnp.int32))
+        bg_rank = jnp.argsort(jnp.argsort(jnp.where(bg, rand, 2.0)))
+        bg_keep = bg & (bg_rank < (batch - n_fg))
+        priority = jnp.where(fg_keep, fg_rank,
+                             jnp.where(bg_keep, fg_quota + bg_rank,
+                                       2 * p + 1))
+        order = jnp.argsort(priority)
+        if p >= batch:
+            sel = order[:batch]
+            in_range = jnp.ones((batch,), bool)
+        else:
+            sel = jnp.concatenate(
+                [order, jnp.zeros((batch - p,), order.dtype)])
+            in_range = jnp.arange(batch) < p
+        valid = (fg_keep | bg_keep)[sel] & in_range
+        # repeat the last valid sample into padding slots
+        last = jnp.maximum(jnp.max(jnp.where(
+            valid, jnp.arange(batch), -1)), 0)
+        sel = jnp.where(valid, sel, sel[last])
+        is_fg = fg_keep[sel]
+
+        sboxes = boxes[sel]
+        sgt = gt_i[jnp.clip(arg_gt[sel], 0, gt_i.shape[0] - 1)]
+        labels = jnp.where(is_fg, cls_i[jnp.clip(
+            arg_gt[sel], 0, cls_i.shape[0] - 1)], 0)
+
+        # BoxToDelta with reg weights (reference bbox_util.h,
+        # pixel +1 convention like rpn_target_assign)
+        bw = sboxes[:, 2] - sboxes[:, 0] + 1.0
+        bh = sboxes[:, 3] - sboxes[:, 1] + 1.0
+        bcx = sboxes[:, 0] + bw / 2
+        bcy = sboxes[:, 1] + bh / 2
+        gw = sgt[:, 2] - sgt[:, 0] + 1.0
+        gh = sgt[:, 3] - sgt[:, 1] + 1.0
+        gcx = sgt[:, 0] + gw / 2
+        gcy = sgt[:, 1] + gh / 2
+        deltas = jnp.stack([(gcx - bcx) / bw / weights[0],
+                            (gcy - bcy) / bh / weights[1],
+                            jnp.log(gw / bw) / weights[2],
+                            jnp.log(gh / bh) / weights[3]], -1)
+
+        # expand per class: row j writes its 4 targets at label slot
+        col = labels.astype(jnp.int32) * 4
+        tgt = jnp.zeros((batch, 4 * class_nums), boxes.dtype)
+        w = jnp.zeros((batch, 4 * class_nums), boxes.dtype)
+        rows = jnp.arange(batch)
+        for d in range(4):
+            tgt = tgt.at[rows, col + d].set(
+                jnp.where(is_fg, deltas[:, d], 0.0))
+            w = w.at[rows, col + d].set(
+                jnp.where(is_fg & (labels > 0), 1.0, 0.0))
+        rois_o.append(sboxes * im_info[i, 2])
+        labels_o.append(labels)
+        tgt_o.append(tgt)
+        biw_o.append(w)
+        bow_o.append(w)
+
+    uniform = tuple(batch * i for i in range(n + 1))
+    ctx.out(op, 'Rois', jnp.concatenate(rois_o, 0))
+    ctx.out(op, 'LabelsInt32',
+            jnp.concatenate(labels_o).reshape(-1, 1))
+    ctx.out(op, 'BboxTargets', jnp.concatenate(tgt_o, 0))
+    ctx.out(op, 'BboxInsideWeights', jnp.concatenate(biw_o, 0))
+    ctx.out(op, 'BboxOutsideWeights', jnp.concatenate(bow_o, 0))
+    for slot in ('Rois', 'LabelsInt32', 'BboxTargets',
+                 'BboxInsideWeights', 'BboxOutsideWeights'):
+        if op.output(slot):
+            ctx.set_lod(op.output(slot)[0], (uniform,))

@@ -579,3 +579,99 @@ class TestDetectionMAPMetric(object):
         gt = np.array([[0., 0., 10., 10.], [20., 20., 30., 30.]])
         m.update(out.reshape(-1, 6), gt, np.array([1, 2]))
         assert abs(m.eval() - 1.0) < 1e-6
+
+
+class TestGenerateProposalLabels(object):
+    def test_sampling_and_targets(self):
+        rng = np.random.RandomState(0)
+        # 6 proposals around 2 gts + noise
+        gt = np.array([[0., 0., 10., 10.], [20., 20., 30., 30.]],
+                      np.float32)
+        rois = np.concatenate([
+            gt + rng.randn(2, 4).astype(np.float32) * 0.5,   # near-gt
+            rng.rand(4, 4).astype(np.float32) * 5 + 50])     # far bg
+        rois[:, 2:] = np.maximum(rois[:, 2:], rois[:, :2] + 1)
+        cls = np.array([[1], [2]], np.int32)
+        crowd = np.zeros((2, 1), np.int32)
+        im_info = np.array([[60., 60., 1.]], np.float32)
+        out = _run_single_op(
+            'generate_proposal_labels',
+            {'RpnRois': (rois, [[0, 6]]), 'GtClasses': (cls, [[0, 2]]),
+             'IsCrowd': (crowd, [[0, 2]]), 'GtBoxes': (gt, [[0, 2]]),
+             'ImInfo': im_info},
+            {'Rois': ['gpl_rois'], 'LabelsInt32': ['gpl_lab'],
+             'BboxTargets': ['gpl_tgt'],
+             'BboxInsideWeights': ['gpl_biw'],
+             'BboxOutsideWeights': ['gpl_bow']},
+            {'batch_size_per_im': 8, 'fg_fraction': 0.5,
+             'fg_thresh': 0.5, 'bg_thresh_hi': 0.5, 'bg_thresh_lo': 0.0,
+             'bbox_reg_weights': [0.1, 0.1, 0.2, 0.2], 'class_nums': 3,
+             'use_random': False})
+        srois, labels, tgt, biw, bow = out
+        assert srois.shape == (8, 4)
+        assert labels.shape == (8, 1)
+        assert tgt.shape == (8, 12)        # 4 * class_nums
+        labels = labels.reshape(-1)
+        fg = labels > 0
+        # gt boxes themselves are proposals (concatenated first) -> fg
+        assert fg.sum() >= 2
+        assert set(labels[fg]).issubset({1, 2})
+        # fg rows put weights exactly at their class slot
+        for i in np.where(fg)[0]:
+            c = int(labels[i])
+            assert (biw[i, 4 * c:4 * c + 4] == 1).all()
+            others = np.delete(biw[i], range(4 * c, 4 * c + 4))
+            assert (others == 0).all()
+        # bg rows carry no regression weight
+        for i in np.where(~fg)[0]:
+            assert (biw[i] == 0).all()
+
+    def test_padding_never_counts_as_foreground(self):
+        """Fewer boxes than batch_size_per_im: padding repeats samples but
+        the fg count stays bounded by the real foregrounds."""
+        gt = np.array([[0., 0., 10., 10.]], np.float32)
+        rois = np.array([[50., 50., 55., 55.]], np.float32)  # pure bg
+        out = _run_single_op(
+            'generate_proposal_labels',
+            {'RpnRois': (rois, [[0, 1]]),
+             'GtClasses': (np.array([[1]], np.int32), [[0, 1]]),
+             'IsCrowd': (np.zeros((1, 1), np.int32), [[0, 1]]),
+             'GtBoxes': (gt, [[0, 1]]),
+             'ImInfo': np.array([[60., 60., 1.]], np.float32)},
+            {'Rois': ['gplp_rois'], 'LabelsInt32': ['gplp_lab'],
+             'BboxTargets': ['gplp_tgt'],
+             'BboxInsideWeights': ['gplp_biw'],
+             'BboxOutsideWeights': ['gplp_bow']},
+            {'batch_size_per_im': 16, 'fg_fraction': 0.5,
+             'fg_thresh': 0.5, 'bg_thresh_hi': 0.5, 'bg_thresh_lo': 0.0,
+             'bbox_reg_weights': [0.1, 0.1, 0.2, 0.2], 'class_nums': 2,
+             'use_random': False})
+        labels = out[1].reshape(-1)
+        # only 1 real fg (the gt itself) exists; padding must not
+        # inflate the fg count beyond real fg duplicates of LAST valid
+        # (which is a bg row) — so fg count stays at 1
+        assert (labels > 0).sum() <= 2, labels
+
+    def test_crowd_gt_excluded(self):
+        gt = np.array([[0., 0., 10., 10.], [20., 20., 30., 30.]],
+                      np.float32)
+        crowd = np.array([[1], [0]], np.int32)   # first gt is crowd
+        rois = np.array([[40., 40., 45., 45.]], np.float32)
+        out = _run_single_op(
+            'generate_proposal_labels',
+            {'RpnRois': (rois, [[0, 1]]),
+             'GtClasses': (np.array([[1], [2]], np.int32), [[0, 2]]),
+             'IsCrowd': (crowd, [[0, 2]]),
+             'GtBoxes': (gt, [[0, 2]]),
+             'ImInfo': np.array([[60., 60., 1.]], np.float32)},
+            {'Rois': ['gplc_rois'], 'LabelsInt32': ['gplc_lab'],
+             'BboxTargets': ['gplc_tgt'],
+             'BboxInsideWeights': ['gplc_biw'],
+             'BboxOutsideWeights': ['gplc_bow']},
+            {'batch_size_per_im': 8, 'fg_fraction': 0.5,
+             'fg_thresh': 0.5, 'bg_thresh_hi': 0.5, 'bg_thresh_lo': 0.0,
+             'bbox_reg_weights': [0.1, 0.1, 0.2, 0.2], 'class_nums': 3,
+             'use_random': False})
+        labels = out[1].reshape(-1)
+        # crowd gt never becomes a fg row with its class (1)
+        assert 1 not in set(labels.tolist()), labels
