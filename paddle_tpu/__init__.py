@@ -60,6 +60,7 @@ from . import flags
 from .flags import get_flags, set_flags
 from . import debugger
 from . import recordio
+from . import imperative
 from . import checkpoint
 from . import average
 from .average import WeightedAverage

@@ -5,6 +5,7 @@ reference paddle/contrib/float16/float16_transpiler.py), slim quantization.
 """
 from . import mixed_precision  # noqa: F401
 from . import quantize  # noqa: F401
+from . import slim  # noqa: F401
 from .quantize import QuantizeTranspiler  # noqa: F401
 from . import trainer  # noqa: F401
 from .trainer import (Trainer, Inferencer, BeginEpochEvent,  # noqa: F401

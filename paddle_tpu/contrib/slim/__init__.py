@@ -1,0 +1,21 @@
+"""Model-compression framework (reference contrib/slim parity).
+
+Reference: python/paddle/fluid/contrib/slim/ — CompressPass + Strategy
+orchestration (core/compress_pass.py, core/strategy.py), magnitude/ratio
+pruners (prune/pruner.py), QAT strategy (quantization/quantization_pass.py).
+
+TPU-native design: because the executor re-lowers programs from scope state
+each run, compression acts directly on the state pytree (numpy masks /
+physically resized arrays) plus lightweight program-desc rewrites — no
+IrGraph pass machinery is needed. Channel pruning REALLY shrinks parameter
+shapes (conv filter + dependent BN/conv/fc vars), so exported inference
+models get smaller, not just sparser.
+"""
+from .core import Context, Strategy, CompressPass
+from .prune import (Pruner, MagnitudePruner, RatioPruner, PruneStrategy,
+                    ChannelPruner)
+from .quantization import QuantizationStrategy
+
+__all__ = ['Context', 'Strategy', 'CompressPass', 'Pruner',
+           'MagnitudePruner', 'RatioPruner', 'PruneStrategy',
+           'ChannelPruner', 'QuantizationStrategy']

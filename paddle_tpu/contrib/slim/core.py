@@ -1,0 +1,128 @@
+"""CompressPass: epoch/batch-driven compression orchestration.
+
+Reference contract (slim/core/compress_pass.py:45 CompressPass,
+slim/core/strategy.py Strategy): strategies register callbacks
+(on_compress_begin / on_epoch_begin / on_batch_begin / on_batch_end /
+on_epoch_end / on_compress_end) and a Context carries (executor, scope,
+programs, epoch, batch) between them; CompressPass.apply runs the training
+loop with the callbacks woven in.
+"""
+
+__all__ = ['Context', 'Strategy', 'CompressPass']
+
+
+class Context(object):
+    """Mutable state shared by strategies (reference compress_pass.py:21)."""
+
+    def __init__(self, exe, scope, train_program=None, eval_program=None,
+                 startup_program=None):
+        self.exe = exe
+        self.scope = scope
+        self.train_program = train_program
+        self.eval_program = eval_program
+        self.startup_program = startup_program
+        self.epoch = 0
+        self.batch = 0
+        self.metrics = {}
+
+
+class Strategy(object):
+    """Base strategy active in [start_epoch, end_epoch] (reference
+    slim/core/strategy.py)."""
+
+    def __init__(self, start_epoch=0, end_epoch=10):
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+
+    def active(self, epoch):
+        return self.start_epoch <= epoch <= self.end_epoch
+
+    def on_compress_begin(self, context):
+        pass
+
+    def on_epoch_begin(self, context):
+        pass
+
+    def on_batch_begin(self, context):
+        pass
+
+    def on_batch_end(self, context):
+        pass
+
+    def on_epoch_end(self, context):
+        pass
+
+    def on_compress_end(self, context):
+        pass
+
+
+class CompressPass(object):
+    """Runs `epochs` passes over `train_reader`, executing `fetch_list` on
+    the (possibly strategy-rewritten) context.train_program each batch and
+    invoking strategy callbacks around it (reference compress_pass.py:45).
+
+    train_feeder(batch_data) must return the feed dict for Executor.run.
+    """
+
+    def __init__(self, executor, scope, train_program, train_reader,
+                 train_feeder, fetch_list=None, epochs=1,
+                 eval_program=None, startup_program=None,
+                 optimizer=None, loss=None):
+        self._exe = executor
+        self._scope = scope
+        self._train_program = train_program
+        self._train_reader = train_reader
+        self._train_feeder = train_feeder
+        self._fetch_list = list(fetch_list or [])
+        self._epochs = epochs
+        self._eval_program = eval_program
+        self._startup_program = startup_program
+        # when given, CompressPass owns backward construction: strategies
+        # that rewrite the forward program (QAT) run on_compress_begin
+        # BEFORE minimize, like the reference compressor built from config
+        # (slim/core/pass_builder.py:21 build_compressor)
+        self._optimizer = optimizer
+        self._loss = loss
+        self._strategies = []
+
+    def add_strategy(self, strategy):
+        self._strategies.append(strategy)
+        return self
+
+    def apply(self):
+        """Run the compression training loop; returns the Context (whose
+        train_program/scope hold the compressed result)."""
+        ctx = Context(self._exe, self._scope,
+                      train_program=self._train_program,
+                      eval_program=self._eval_program,
+                      startup_program=self._startup_program)
+        for s in self._strategies:
+            s.on_compress_begin(ctx)
+        if self._optimizer is not None and self._loss is not None:
+            from ... import program_guard
+            with program_guard(ctx.train_program,
+                               ctx.startup_program or ctx.train_program):
+                self._optimizer.minimize(self._loss)
+            if ctx.startup_program is not None:
+                self._exe.run(ctx.startup_program, scope=self._scope)
+        for epoch in range(self._epochs):
+            ctx.epoch = epoch
+            act = [s for s in self._strategies if s.active(epoch)]
+            for s in act:
+                s.on_epoch_begin(ctx)
+            for batch_id, data in enumerate(self._train_reader()):
+                ctx.batch = batch_id
+                for s in act:
+                    s.on_batch_begin(ctx)
+                feed = self._train_feeder(data)
+                outs = self._exe.run(ctx.train_program, feed=feed,
+                                     fetch_list=self._fetch_list,
+                                     scope=self._scope)
+                ctx.metrics['last_fetch'] = outs
+                for s in act:
+                    s.on_batch_end(ctx)
+            for s in act:
+                s.on_epoch_end(ctx)
+        for s in self._strategies:
+            s.on_compress_end(ctx)
+        return ctx

@@ -1,0 +1,246 @@
+"""Pruners + pruning strategies.
+
+Reference: slim/prune/pruner.py — MagnitudePruner (threshold mask),
+RatioPruner (top-|w| ratio mask) — and prune strategies driven by the
+CompressPass callbacks.
+
+TPU-native additions: masks are computed in numpy over scope state (the
+executor re-lowers from scope each run, so updated arrays are simply picked
+up; no graph surgery needed for soft pruning), and ChannelPruner performs
+REAL structured pruning — conv output channels are removed physically,
+with dependent vars (conv bias, batch_norm stats, the next conv's input
+channels, the first FC's rows) resized to match, shrinking the exported
+parameter count.
+"""
+import numpy as np
+
+__all__ = ['Pruner', 'MagnitudePruner', 'RatioPruner', 'PruneStrategy',
+           'ChannelPruner']
+
+from .core import Strategy
+
+
+class Pruner(object):
+    """mask = pruner.prune(param_array): 1 keeps, 0 prunes (reference
+    slim/prune/pruner.py:21)."""
+
+    def prune(self, param):
+        raise NotImplementedError
+
+
+class MagnitudePruner(Pruner):
+    """Zero weights with |w| < threshold (reference pruner.py:33)."""
+
+    def __init__(self, threshold):
+        self.threshold = float(threshold)
+
+    def prune(self, param):
+        return (np.abs(param) >= self.threshold).astype(param.dtype)
+
+
+class RatioPruner(Pruner):
+    """Keep the largest-|w| `ratio` fraction per parameter (reference
+    pruner.py:51: ratio=0.4 keeps 40%, prunes 60%)."""
+
+    def __init__(self, ratios=None):
+        self.ratios = dict(ratios or {})
+
+    def prune(self, param, ratio=None):
+        if ratio is None:
+            ratio = self.ratios.get('*', 1.0)
+        if ratio >= 1.0:
+            return np.ones_like(param)
+        k = max(int(ratio * param.size), 1)
+        flat = np.abs(param).reshape(-1)
+        thresh = np.partition(flat, -k)[-k]
+        return (np.abs(param) >= thresh).astype(param.dtype)
+
+
+class PruneStrategy(Strategy):
+    """Applies pruner masks to named parameters in the scope after every
+    batch while active (masks are recomputed each epoch begin, frozen
+    within the epoch so pruned weights stay zero through optimizer
+    updates)."""
+
+    def __init__(self, pruner, params=None, ratios=None, start_epoch=0,
+                 end_epoch=1000):
+        super(PruneStrategy, self).__init__(start_epoch, end_epoch)
+        self._pruner = pruner
+        self._params = list(params or [])
+        self._ratios = dict(ratios or {})
+        self._masks = {}
+
+    def _param_names(self, context):
+        if self._params:
+            return self._params
+        return [p.name for p in context.train_program.all_parameters()]
+
+    def on_epoch_begin(self, context):
+        self._masks = {}
+        for name in self._param_names(context):
+            value = context.scope.get(name)
+            if value is None:
+                continue
+            arr = np.asarray(value)
+            if name in self._ratios and isinstance(self._pruner, RatioPruner):
+                mask = self._pruner.prune(arr, self._ratios[name])
+            else:
+                mask = self._pruner.prune(arr)
+            self._masks[name] = mask
+        self._apply(context)
+
+    def on_batch_end(self, context):
+        self._apply(context)
+
+    def _apply(self, context):
+        for name, mask in self._masks.items():
+            value = context.scope.get(name)
+            if value is not None:
+                context.scope.set(name, np.asarray(value) * mask)
+
+    def sparsity(self, context):
+        """Fraction of pruned (zero-masked) weights across masked params."""
+        total = kept = 0
+        for mask in self._masks.values():
+            total += mask.size
+            kept += int(mask.sum())
+        return 1.0 - (kept / total if total else 1.0)
+
+
+# ---------------------------------------------------------------------------
+# structured channel pruning
+# ---------------------------------------------------------------------------
+
+_CHANNEL_KEEPING = {'relu', 'relu6', 'sigmoid', 'tanh', 'pool2d', 'dropout',
+                    'elementwise_add', 'scale', 'leaky_relu'}
+
+
+class ChannelPruner(object):
+    """Physically remove conv output channels with the lowest filter L1
+    norms (structured filter pruning), resizing dependent vars:
+
+    - the conv Filter [O,I,h,w] -> [O',I,h,w] and its bias [O] -> [O'];
+    - batch_norm Scale/Bias/Mean/Variance over the pruned channels;
+    - the NEXT conv's Filter input channels [O2,O,h,w] -> [O2,O',h,w];
+    - the first FC's weight rows (NCHW-flattened: channel c owns the
+      contiguous row block [c*H*W, (c+1)*H*W)).
+
+    The executor recompiles from the rewritten scope/program, so training
+    continues (finetune) on the smaller network directly — the TPU-native
+    analog of reference slim channel pruning on IrGraph.
+    """
+
+    def __init__(self, program, scope):
+        self._program = program
+        self._scope = scope
+
+    def _ops(self):
+        return list(self._program.global_block().ops)
+
+    def _consumers(self, var_name):
+        out = []
+        for op in self._ops():
+            if var_name in op.input_arg_names:
+                out.append(op)
+        return out
+
+    def _resize(self, name, new_arr, indexer=None):
+        old = self._scope.get(name)
+        old_shape = None if old is None else tuple(np.asarray(old).shape)
+        self._scope.set(name, new_arr)
+        var = self._program.global_block()._find_var_recursive(name)
+        if var is not None:
+            var.shape = tuple(new_arr.shape)
+        if indexer is None or old_shape is None:
+            return
+        # optimizer accumulators (moments, velocities, ...) are named
+        # '<param>_<slot>' and share the parameter's shape — resize them
+        # identically so finetuning continues on the pruned network
+        prefix = name + '_'
+        for other in list(self._scope.names()):
+            if not other.startswith(prefix):
+                continue
+            val = self._scope.get(other)
+            if val is None or tuple(np.asarray(val).shape) != old_shape:
+                continue
+            self._scope.set(other, indexer(np.asarray(val)))
+            ovar = self._program.global_block()._find_var_recursive(other)
+            if ovar is not None:
+                ovar.shape = tuple(new_arr.shape)
+
+    def prune_conv(self, filter_name, keep_ratio):
+        """Prune the conv2d whose Filter parameter is `filter_name` to
+        round(O * keep_ratio) output channels; returns kept indices."""
+        w = np.asarray(self._scope.get(filter_name))
+        o = w.shape[0]
+        keep_n = max(int(round(o * keep_ratio)), 1)
+        norms = np.abs(w).reshape(o, -1).sum(axis=1)
+        keep = np.sort(np.argsort(norms)[-keep_n:])
+        self._resize(filter_name, w[keep], indexer=lambda a: a[keep])
+
+        conv_op = None
+        for op in self._ops():
+            if op.type in ('conv2d', 'depthwise_conv2d') and \
+                    filter_name in op.input('Filter'):
+                conv_op = op
+                break
+        if conv_op is None:
+            raise ValueError("no conv2d consumes Filter %r" % filter_name)
+        out_name = conv_op.output('Output')[0]
+        self._propagate(out_name, keep)
+        return keep
+
+    def _propagate(self, var_name, keep):
+        """Walk consumers of `var_name` (a [N,C,H,W] activation whose C was
+        pruned to `keep`) and resize channel-dependent vars."""
+        for op in self._consumers(var_name):
+            if op.type in ('conv2d',):
+                fname = op.input('Filter')[0]
+                w = np.asarray(self._scope.get(fname))
+                self._resize(fname, w[:, keep],
+                             indexer=lambda a: a[:, keep])
+            elif op.type == 'depthwise_conv2d':
+                fname = op.input('Filter')[0]
+                w = np.asarray(self._scope.get(fname))
+                self._resize(fname, w[keep], indexer=lambda a: a[keep])
+                self._propagate(op.output('Output')[0], keep)
+            elif op.type == 'batch_norm':
+                for slot in ('Scale', 'Bias', 'Mean', 'Variance'):
+                    n = op.input(slot)[0]
+                    self._resize(n, np.asarray(self._scope.get(n))[keep],
+                                 indexer=lambda a: a[keep])
+                self._propagate(op.output('Y')[0], keep)
+            elif op.type == 'elementwise_add' and op.attr('axis', -1) == 1:
+                # conv bias add: Y is the [C] bias param
+                bname = op.input('Y')[0]
+                b = self._scope.get(bname)
+                if b is not None and np.asarray(b).ndim == 1:
+                    self._resize(bname, np.asarray(b)[keep],
+                                 indexer=lambda a: a[keep])
+                self._propagate(op.output('Out')[0], keep)
+            elif op.type == 'mul':
+                # first FC after flatten: rows are NCHW-flattened
+                in_var = self._program.global_block()._find_var_recursive(
+                    op.input('X')[0])
+                wname = op.input('Y')[0]
+                w = np.asarray(self._scope.get(wname))
+                shape = in_var.shape if in_var is not None else None
+                if shape is None or len(shape) < 4:
+                    raise ValueError(
+                        "cannot infer spatial size feeding mul %r" % wname)
+                hw = int(np.prod(shape[2:]))
+                rows = np.concatenate(
+                    [np.arange(c * hw, (c + 1) * hw) for c in keep])
+                self._resize(wname, w[rows], indexer=lambda a: a[rows])
+            elif op.type in _CHANNEL_KEEPING or op.type in (
+                    'relu', 'pool2d'):
+                outs = op.output('Out') or op.output('Output')
+                if outs:
+                    self._propagate(outs[0], keep)
+            # ops that flatten/reshape before mul keep NCHW row order;
+            # reshape/flatten pass channel blocks through contiguously
+            elif op.type in ('reshape', 'reshape2', 'flatten', 'flatten2',
+                             'squeeze', 'squeeze2'):
+                outs = op.output('Out')
+                if outs:
+                    self._propagate(outs[0], keep)

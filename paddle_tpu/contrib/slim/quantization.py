@@ -1,0 +1,44 @@
+"""QAT as a CompressPass strategy.
+
+Reference: slim/quantization/quantization_pass.py rewrites the IrGraph with
+fake-quant/dequant ops at a given epoch; here the existing
+QuantizeTranspiler (contrib/quantize.py — same fake-quant op semantics,
+program-level rewrite) is applied to context.train_program when the
+strategy activates, and the frozen int8 inference program is produced at
+compress end.
+"""
+from .core import Strategy
+
+__all__ = ['QuantizationStrategy']
+
+
+class QuantizationStrategy(Strategy):
+    def __init__(self, start_epoch=0, end_epoch=1000, weight_bits=8,
+                 activation_bits=8, activation_quantize_type='abs_max',
+                 freeze_on_end=True):
+        super(QuantizationStrategy, self).__init__(start_epoch, end_epoch)
+        from ..quantize import QuantizeTranspiler
+        self._transpiler = QuantizeTranspiler(
+            weight_bits=weight_bits, activation_bits=activation_bits,
+            activation_quantize_type=activation_quantize_type)
+        self._applied = False
+        self._freeze = freeze_on_end
+        self.freeze_program = None
+
+    def on_compress_begin(self, context):
+        # fake-quant insertion must precede backward construction, so the
+        # rewrite happens at compress begin (CompressPass then calls
+        # optimizer.minimize on the rewritten program)
+        if self._applied:
+            return
+        self._transpiler.training_transpile(
+            context.train_program, context.startup_program)
+        self._applied = True
+
+    def on_compress_end(self, context):
+        if not (self._applied and self._freeze):
+            return
+        prog = (context.eval_program or context.train_program).clone(
+            for_test=True)
+        self._transpiler.freeze_program(prog, scope=context.scope)
+        self.freeze_program = prog

@@ -1,0 +1,20 @@
+"""Imperative (dygraph) mode: eager op-by-op execution on jax arrays.
+
+The reference's early dygraph (paddle/fluid/imperative/tracer.h:40,
+layer.cc:103 Autograd; python python/paddle/fluid/imperative/base.py:28,46,
+layers.py:28,169, nn.py:28-407) interprets ops eagerly on VarBase tensors
+while a tracer records them for a backward walk. JAX is eager-native, so the
+TPU rebuild runs each op's registered lowering function directly on jax
+arrays (the SAME lowerings the compiled Program executor traces — one op
+library, two execution modes) and implements `backward()` by replaying the
+recorded tape under jax.grad.
+"""
+from .base import guard, enabled, to_variable, current_tracer, VarBase
+from .layers import Layer, PyLayer
+from .nn import Conv2D, Pool2D, FC, BatchNorm, Embedding
+from .optimizer import SGDOptimizer, AdamOptimizer
+from . import ops
+
+__all__ = ['guard', 'enabled', 'to_variable', 'current_tracer', 'VarBase',
+           'Layer', 'PyLayer', 'Conv2D', 'Pool2D', 'FC', 'BatchNorm',
+           'Embedding', 'SGDOptimizer', 'AdamOptimizer', 'ops']

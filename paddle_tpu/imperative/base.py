@@ -1,0 +1,195 @@
+"""Eager-mode core: VarBase tensors, the tape-recording Tracer, guard().
+
+Reference parity: python/paddle/fluid/imperative/base.py:28 `guard()`
+switches the tracer on, `:46` `to_variable`; the C++ tracer
+(imperative/tracer.h:40) records each op as it runs and `Autograd`
+(imperative/layer.cc:103) walks the recorded graph backward. Here the tape
+stores, per op, a pure replay function plus the input values captured at
+execution time; `VarBase.backward()` replays the tape as one functional
+program and differentiates it with jax.grad — reverse-mode AD with XLA
+semantics instead of per-op grad kernels.
+"""
+import contextlib
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+_tracer = None
+
+
+def enabled():
+    return _tracer is not None
+
+
+def current_tracer():
+    return _tracer
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    """Enable imperative mode (reference imperative/base.py:28)."""
+    global _tracer
+    prev = _tracer
+    _tracer = Tracer()
+    try:
+        yield
+    finally:
+        _tracer = prev
+
+
+class VarBase(object):
+    """Eager tensor: a jax array + autograd metadata (reference
+    imperative/layer.h VarBase: var_ + grads_ + stop_gradient)."""
+
+    def __init__(self, value, name=None, stop_gradient=True):
+        self._value = jnp.asarray(value)
+        self.name = name
+        self.stop_gradient = stop_gradient
+        self._grad = None
+
+    # -- value access ------------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self._value.shape)
+
+    @property
+    def dtype(self):
+        return np.dtype(self._value.dtype).name
+
+    def numpy(self):
+        return np.asarray(self._value)
+
+    def value(self):
+        return self._value
+
+    def set_value(self, value):
+        self._value = jnp.asarray(value)
+        return self
+
+    def detach(self):
+        return VarBase(self._value, name=self.name, stop_gradient=True)
+
+    def astype(self, dtype):
+        return VarBase(self._value.astype(dtype),
+                       stop_gradient=self.stop_gradient)
+
+    # -- autograd ----------------------------------------------------------
+    def gradient(self):
+        return None if self._grad is None else np.asarray(self._grad)
+
+    def clear_gradient(self):
+        self._grad = None
+
+    def backward(self):
+        """Compute d(self)/d(leaf) for every reachable leaf VarBase with
+        stop_gradient=False, accumulating into .gradient()."""
+        tr = current_tracer()
+        if tr is None:
+            raise RuntimeError(
+                "backward() outside imperative.guard(): no tape recorded")
+        tr.run_backward(self)
+
+    def __repr__(self):
+        return "VarBase(%s, shape=%s, dtype=%s)" % (
+            self.name or '<unnamed>', self.shape, self.dtype)
+
+    # minimal operator sugar (python math on eager tensors)
+    def _binary(self, other, op_type, reverse=False):
+        from .ops import apply_op
+        o = other if isinstance(other, VarBase) else to_variable(
+            np.asarray(other, dtype=self.dtype))
+        x, y = (o, self) if reverse else (self, o)
+        return apply_op(op_type, {'X': x, 'Y': y}, ['Out'], {})[0]
+
+    def __add__(self, o):
+        return self._binary(o, 'elementwise_add')
+
+    def __radd__(self, o):
+        return self._binary(o, 'elementwise_add', True)
+
+    def __sub__(self, o):
+        return self._binary(o, 'elementwise_sub')
+
+    def __mul__(self, o):
+        return self._binary(o, 'elementwise_mul')
+
+    def __truediv__(self, o):
+        return self._binary(o, 'elementwise_div')
+
+
+def to_variable(value, name=None, stop_gradient=True):
+    """numpy -> eager VarBase (reference imperative/base.py:46)."""
+    if isinstance(value, VarBase):
+        return value
+    return VarBase(np.asarray(value), name=name, stop_gradient=stop_gradient)
+
+
+class _TapeEntry(object):
+    __slots__ = ('replay', 'in_vars', 'in_vals', 'out_vars')
+
+    def __init__(self, replay, in_vars, in_vals, out_vars):
+        self.replay = replay          # pure fn: list[jax values] -> list
+        self.in_vars = in_vars        # VarBase refs (strong: id-stable)
+        self.in_vals = in_vals        # values captured at execution time
+        self.out_vars = out_vars
+
+
+class Tracer(object):
+    """Records eagerly-executed ops for backward replay (reference
+    imperative/tracer.h:40 Trace)."""
+
+    def __init__(self):
+        self._tape = []
+        self._op_counter = 0
+
+    def next_key(self):
+        self._op_counter += 1
+        return jax.random.PRNGKey(self._op_counter)
+
+    def record(self, replay, in_vars, in_vals, out_vars):
+        self._tape.append(_TapeEntry(replay, in_vars, in_vals, out_vars))
+
+    def clear(self):
+        """Drop the tape (start a fresh iteration's graph)."""
+        self._tape = []
+
+    def run_backward(self, target):
+        produced = {}                 # id(VarBase) -> producing entry index
+        for i, e in enumerate(self._tape):
+            for ov in e.out_vars:
+                produced[id(ov)] = i
+        if id(target) not in produced:
+            raise RuntimeError("backward() target was not produced under "
+                               "this imperative guard")
+
+        # leaves: grad-requiring inputs not produced by any tape op
+        leaves, leaf_ids = [], set()
+        for e in self._tape:
+            for iv in e.in_vars:
+                if (not iv.stop_gradient and id(iv) not in produced
+                        and id(iv) not in leaf_ids):
+                    leaf_ids.add(id(iv))
+                    leaves.append(iv)
+        if not leaves:
+            return
+
+        tape = self._tape
+
+        def forward(leaf_vals):
+            env = {id(l): v for l, v in zip(leaves, leaf_vals)}
+            for e in tape:
+                ins = [env.get(id(iv), cap)
+                       for iv, cap in zip(e.in_vars, e.in_vals)]
+                outs = e.replay(ins)
+                for ov, val in zip(e.out_vars, outs):
+                    env[id(ov)] = val
+            out = env[id(target)]
+            # reference Autograd seeds d(target)=ones; for non-scalars this
+            # equals differentiating sum(target)
+            return jnp.sum(out)
+
+        grads = jax.grad(forward)([l._value for l in leaves])
+        for leaf, g in zip(leaves, grads):
+            leaf._grad = g if leaf._grad is None else leaf._grad + g

@@ -1,0 +1,192 @@
+"""Layer / PyLayer: the eager module system.
+
+Reference parity: python/paddle/fluid/imperative/layers.py:28 `Layer`
+(parameter dict + sublayers + __call__->forward) and `:169` `PyLayer`
+(user-supplied numpy forward/backward as a differentiable node). PyLayer's
+host computation enters the jax graph via jax.pure_callback, so it stays
+differentiable on replay (the TPU analog of the reference's
+PyLayer::Apply C++ trampoline, imperative/layer.cc).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .base import VarBase, to_variable, current_tracer
+from .. import unique_name
+
+__all__ = ['Layer', 'PyLayer']
+
+
+class Parameter(VarBase):
+    """Trainable leaf (stop_gradient=False by default)."""
+
+    def __init__(self, value, name=None, trainable=True):
+        super(Parameter, self).__init__(value, name=name,
+                                        stop_gradient=not trainable)
+
+
+class Layer(object):
+    """Base class for eager layers (reference imperative/layers.py:28)."""
+
+    def __init__(self, name_scope=None, dtype='float32'):
+        self._full_name = unique_name.generate(
+            name_scope or self.__class__.__name__.lower())
+        self._dtype = dtype
+        self._parameters = {}
+        self._sub_layers = {}
+        self.training = True
+
+    def full_name(self):
+        return self._full_name
+
+    # -- parameter / sublayer registry ------------------------------------
+    def create_parameter(self, shape, dtype=None, default_initializer=None,
+                         is_bias=False, name=None):
+        dtype = dtype or self._dtype
+        rng = np.random.RandomState(
+            abs(hash((self._full_name, name, len(self._parameters)))) %
+            (2 ** 31))
+        if default_initializer is not None:
+            value = default_initializer(shape, dtype, rng)
+        elif is_bias:
+            value = np.zeros(shape, dtype)
+        else:                      # Xavier-uniform default
+            fan_in = int(np.prod(shape[1:])) if len(shape) > 1 else shape[0]
+            fan_out = shape[0]
+            limit = float(np.sqrt(6.0 / max(fan_in + fan_out, 1)))
+            value = rng.uniform(-limit, limit, shape).astype(dtype)
+        p = Parameter(value, name=name or unique_name.generate(
+            self._full_name + '.w'))
+        return p
+
+    def add_parameter(self, name, parameter):
+        self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name, sublayer):
+        self._sub_layers[name] = sublayer
+        return sublayer
+
+    def parameters(self, include_sublayers=True):
+        out = list(self._parameters.values())
+        if include_sublayers:
+            for l in self._sub_layers.values():
+                out.extend(l.parameters())
+        return out
+
+    def sublayers(self, include_sublayers=True):
+        out = list(self._sub_layers.values())
+        if include_sublayers:
+            for l in self._sub_layers.values():
+                out.extend(l.sublayers())
+        return out
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_gradient()
+
+    def train(self):
+        self.training = True
+        for l in self._sub_layers.values():
+            l.train()
+
+    def eval(self):
+        self.training = False
+        for l in self._sub_layers.values():
+            l.eval()
+
+    def state_dict(self):
+        out = {}
+        for k, p in self._parameters.items():
+            out[self._full_name + '.' + k] = p.numpy()
+        for l in self._sub_layers.values():
+            out.update(l.state_dict())
+        return out
+
+    def set_dict(self, state):
+        for k, p in self._parameters.items():
+            full = self._full_name + '.' + k
+            if full in state:
+                p.set_value(state[full])
+        for l in self._sub_layers.values():
+            l.set_dict(state)
+
+    # -- attribute sugar: self.conv = Conv2D(...) auto-registers ----------
+    def __setattr__(self, name, value):
+        if isinstance(value, Parameter):
+            self.__dict__.setdefault('_parameters', {})[name] = value
+        elif isinstance(value, Layer):
+            self.__dict__.setdefault('_sub_layers', {})[name] = value
+        object.__setattr__(self, name, value)
+
+    def __call__(self, *args, **kwargs):
+        return self.forward(*args, **kwargs)
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+
+class PyLayer(object):
+    """User-defined numpy forward/backward as a differentiable eager node
+    (reference imperative/layers.py:169; backward receives the output
+    cotangents and returns input cotangents)."""
+
+    @staticmethod
+    def forward(*inputs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(*douts):
+        raise NotImplementedError
+
+    @classmethod
+    def __call__(cls, *inputs):
+        return cls.apply(*inputs)
+
+    @classmethod
+    def apply(cls, *inputs):
+        in_vars = [v if isinstance(v, VarBase) else to_variable(v)
+                   for v in inputs]
+        in_vals = [v._value for v in in_vars]
+        np_ins = [np.asarray(v) for v in in_vals]
+        np_outs = cls.forward(*np_ins)
+        if not isinstance(np_outs, (list, tuple)):
+            np_outs = (np_outs,)
+        out_struct = tuple(jax.ShapeDtypeStruct(np.asarray(o).shape,
+                                                np.asarray(o).dtype)
+                           for o in np_outs)
+        in_struct = tuple(jax.ShapeDtypeStruct(np.asarray(i).shape,
+                                               np.asarray(i).dtype)
+                          for i in np_ins)
+
+        @jax.custom_vjp
+        def f(*vals):
+            return jax.pure_callback(
+                lambda *a: tuple(np.asarray(o) for o in _as_tuple(
+                    cls.forward(*[np.asarray(x) for x in a]))),
+                out_struct, *vals)
+
+        def f_fwd(*vals):
+            return f(*vals), None
+
+        def f_bwd(_, cts):
+            return jax.pure_callback(
+                lambda *a: tuple(np.asarray(g) for g in _as_tuple(
+                    cls.backward(*[np.asarray(x) for x in a]))),
+                in_struct, *cts)
+
+        f.defvjp(f_fwd, f_bwd)
+
+        def replay(vals):
+            return list(f(*vals))
+
+        out_vars = [VarBase(jnp.asarray(o), stop_gradient=False)
+                    for o in np_outs]
+        tr = current_tracer()
+        if tr is not None:
+            tr.record(replay, in_vars, in_vals, out_vars)
+        return out_vars if len(out_vars) > 1 else out_vars[0]
+
+
+def _as_tuple(x):
+    return x if isinstance(x, (list, tuple)) else (x,)

@@ -1,0 +1,174 @@
+"""Eager nn layers: Conv2D, Pool2D, FC, BatchNorm, Embedding.
+
+Reference parity: python/paddle/fluid/imperative/nn.py:28-407 (the five
+eager layers of the early dygraph). Each forward runs the SAME registered
+op lowerings the compiled Program executor uses (via imperative.ops
+.apply_op), so eager and graph mode share one op library — the design the
+reference reaches for with its shared OpInfoMap.
+"""
+import numpy as np
+
+from .base import VarBase, to_variable
+from .layers import Layer
+from .ops import apply_op
+
+__all__ = ['Conv2D', 'Pool2D', 'FC', 'BatchNorm', 'Embedding']
+
+
+def _act(out, act):
+    if act:
+        out, = apply_op(act, {'X': out}, ['Out'], {})
+    return out
+
+
+class Conv2D(Layer):
+    """Eager conv2d (+bias, +act): reference imperative/nn.py:28."""
+
+    def __init__(self, name_scope=None, num_channels=1, num_filters=1,
+                 filter_size=3, stride=1, padding=0, dilation=1, groups=1,
+                 use_cudnn=True, act=None, dtype='float32'):
+        super(Conv2D, self).__init__(name_scope, dtype)
+        self._act = act
+        self._stride = _pair(stride)
+        self._padding = _pair(padding)
+        self._dilation = _pair(dilation)
+        self._groups = groups or 1
+        fs = _pair(filter_size)
+        self.weight = self.create_parameter(
+            (num_filters, num_channels // self._groups, fs[0], fs[1]),
+            dtype, name=self._full_name + '.w')
+        self.bias = self.create_parameter(
+            (num_filters,), dtype, is_bias=True,
+            name=self._full_name + '.b')
+
+    def forward(self, input):
+        out, = apply_op('conv2d', {'Input': input, 'Filter': self.weight},
+                        ['Output'],
+                        {'strides': list(self._stride),
+                         'paddings': list(self._padding),
+                         'dilations': list(self._dilation),
+                         'groups': self._groups})
+        out, = apply_op('elementwise_add', {'X': out, 'Y': self.bias},
+                        ['Out'], {'axis': 1})
+        return _act(out, self._act)
+
+
+class Pool2D(Layer):
+    """Eager pool2d: reference imperative/nn.py (Pool2D)."""
+
+    def __init__(self, name_scope=None, pool_size=2, pool_type='max',
+                 pool_stride=1, pool_padding=0, global_pooling=False,
+                 use_cudnn=True, ceil_mode=False, exclusive=True,
+                 dtype='float32'):
+        super(Pool2D, self).__init__(name_scope, dtype)
+        self._attrs = {
+            'ksize': list(_pair(pool_size)),
+            'pooling_type': pool_type,
+            'strides': list(_pair(pool_stride)),
+            'paddings': list(_pair(pool_padding)),
+            'global_pooling': global_pooling,
+            'ceil_mode': ceil_mode,
+            'exclusive': exclusive,
+        }
+
+    def forward(self, input):
+        out, = apply_op('pool2d', {'X': input}, ['Out'], self._attrs)
+        return out
+
+
+class FC(Layer):
+    """Eager fully-connected (lazy weight creation on first forward, since
+    the input width is unknown until then): reference imperative/nn.py FC."""
+
+    def __init__(self, name_scope=None, size=1, num_flatten_dims=1,
+                 act=None, dtype='float32'):
+        super(FC, self).__init__(name_scope, dtype)
+        self._size = size
+        self._nfd = num_flatten_dims
+        self._act = act
+        self.weight = None
+        self.bias = None
+
+    def forward(self, input):
+        if self.weight is None:
+            in_dim = int(np.prod(input.shape[self._nfd:]))
+            self.weight = self.create_parameter(
+                (in_dim, self._size), self._dtype,
+                name=self._full_name + '.w')
+            self.bias = self.create_parameter(
+                (self._size,), self._dtype, is_bias=True,
+                name=self._full_name + '.b')
+        out, = apply_op('mul', {'X': input, 'Y': self.weight}, ['Out'],
+                        {'x_num_col_dims': self._nfd, 'y_num_col_dims': 1})
+        out, = apply_op('elementwise_add', {'X': out, 'Y': self.bias},
+                        ['Out'], {'axis': len(out.shape) - 1})
+        return _act(out, self._act)
+
+
+class BatchNorm(Layer):
+    """Eager batch_norm with running-stat buffers: reference
+    imperative/nn.py BatchNorm."""
+
+    def __init__(self, name_scope=None, num_channels=1, act=None,
+                 is_test=False, momentum=0.9, epsilon=1e-5,
+                 dtype='float32', data_layout='NCHW'):
+        super(BatchNorm, self).__init__(name_scope, dtype)
+        self._act = act
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self._layout = data_layout
+        self.weight = self.create_parameter(
+            (num_channels,), dtype,
+            default_initializer=lambda s, d, r: np.ones(s, d),
+            name=self._full_name + '.scale')
+        self.bias = self.create_parameter(
+            (num_channels,), dtype, is_bias=True,
+            name=self._full_name + '.bias')
+        # running stats: buffers, not trainable
+        self._mean = VarBase(np.zeros((num_channels,), dtype),
+                             name=self._full_name + '.mean')
+        self._variance = VarBase(np.ones((num_channels,), dtype),
+                                 name=self._full_name + '.var')
+        if is_test:
+            self.training = False
+
+    def forward(self, input):
+        y, mean_out, var_out = apply_op(
+            'batch_norm',
+            {'X': input, 'Scale': self.weight, 'Bias': self.bias,
+             'Mean': self._mean, 'Variance': self._variance},
+            ['Y', 'MeanOut', 'VarianceOut'],
+            {'momentum': self._momentum, 'epsilon': self._epsilon,
+             'is_test': not self.training, 'data_layout': self._layout})
+        if self.training:
+            # running-stat buffers advance outside the autograd tape
+            self._mean.set_value(mean_out._value)
+            self._variance.set_value(var_out._value)
+        return _act(y, self._act)
+
+
+class Embedding(Layer):
+    """Eager lookup_table: reference imperative/nn.py Embedding."""
+
+    def __init__(self, name_scope=None, size=(1, 1), is_sparse=False,
+                 padding_idx=None, dtype='float32'):
+        super(Embedding, self).__init__(name_scope, dtype)
+        self._padding_idx = -1 if padding_idx is None else padding_idx
+        scale = 1.0 / np.sqrt(size[1])
+        self.weight = self.create_parameter(
+            tuple(size), dtype,
+            default_initializer=lambda s, d, r:
+                r.uniform(-scale, scale, s).astype(d),
+            name=self._full_name + '.w')
+
+    def forward(self, input):
+        out, = apply_op('lookup_table',
+                        {'Ids': input, 'W': self.weight}, ['Out'],
+                        {'padding_idx': self._padding_idx})
+        return out
+
+
+def _pair(x, n=2):
+    if isinstance(x, (list, tuple)):
+        return tuple(x)
+    return (x,) * n

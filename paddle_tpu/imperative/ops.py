@@ -1,0 +1,143 @@
+"""apply_op: run one registered op lowering eagerly on VarBase inputs.
+
+This is the imperative interpreter loop of the reference dygraph
+(imperative/tracer.cc Trace: build the op, run it on the current place,
+record it) collapsed to one function: the op's *compiled-mode* lowering
+(core/registry.py) executes directly on jax arrays — the op library is
+shared between the Program executor and eager mode — and the active Tracer
+records a pure replay closure for backward().
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import get_op
+from .base import VarBase, to_variable, current_tracer
+
+__all__ = ['apply_op']
+
+
+class _FakeOp(object):
+    """Just enough of framework.Operator for lowering fns: input/output
+    slot name lists + attrs."""
+    __slots__ = ('type', '_inputs', '_outputs', '_attrs')
+
+    def __init__(self, type, inputs, outputs, attrs):
+        self.type = type
+        self._inputs = inputs
+        self._outputs = outputs
+        self._attrs = attrs
+
+    def input(self, slot):
+        return self._inputs.get(slot, [])
+
+    def output(self, slot):
+        return self._outputs.get(slot, [])
+
+    def attr(self, name, default=None):
+        return self._attrs.get(name, default)
+
+
+class _EagerCtx(object):
+    """Just enough of core.lowering.LowerContext for lowering fns, over a
+    plain {name: jax value} env (no Program, no LoD)."""
+
+    def __init__(self, env, key):
+        self.env = env
+        self._key = key
+
+    def has(self, name):
+        return name in self.env
+
+    def get(self, name):
+        return self.env[name]
+
+    def in1(self, op, slot, default=None):
+        names = op.input(slot)
+        return self.env[names[0]] if names else default
+
+    def in_list(self, op, slot):
+        return [self.env[n] for n in op.input(slot)]
+
+    def set(self, name, value):
+        self.env[name] = value
+
+    def out(self, op, slot, value, idx=0):
+        names = op.output(slot)
+        if names:
+            self.env[names[idx]] = value
+
+    def var(self, name):
+        return None
+
+    def rng(self):
+        return self._key
+
+    # eager mode is dense-only (LoD/ragged belongs to the Program path)
+    def lod_of(self, name):
+        return ()
+
+    def in1_lod(self, op, slot):
+        return ()
+
+    def set_lod(self, name, lod):
+        pass
+
+    def in1_static(self, op, slot, default=None):
+        names = op.input(slot)
+        if not names:
+            return default
+        return np.asarray(self.env[names[0]])
+
+    def static_value(self, name):
+        return np.asarray(self.env[name])
+
+    def set_static(self, name, value):
+        pass
+
+
+def apply_op(op_type, inputs, out_slots, attrs, stop_gradient=False):
+    """Execute `op_type` eagerly.
+
+    inputs: {slot: VarBase | [VarBase] | raw array}; out_slots: list of
+    output slot names (or (slot, n) for multi-output slots); attrs: dict.
+    Returns a list of output VarBases in out_slots order (flattened).
+    """
+    opdef = get_op(op_type)
+    in_slots, in_vars = {}, []
+    for slot, val in inputs.items():
+        vals = val if isinstance(val, (list, tuple)) else [val]
+        names = []
+        for v in vals:
+            if not isinstance(v, VarBase):
+                v = to_variable(v)
+            names.append('i%d' % len(in_vars))
+            in_vars.append(v)
+        in_slots[slot] = names
+
+    out_names, out_slot_map = [], {}
+    for s in out_slots:
+        slot, n = s if isinstance(s, tuple) else (s, 1)
+        names = ['o%d' % (len(out_names) + i) for i in range(n)]
+        out_names.extend(names)
+        out_slot_map[slot] = names
+
+    fake = _FakeOp(op_type, in_slots, out_slot_map, dict(attrs or {}))
+    tr = current_tracer()
+    key = tr.next_key() if tr is not None else jax.random.PRNGKey(0)
+    in_name_list = [n for names in in_slots.values() for n in names]
+
+    def replay(in_vals):
+        env = dict(zip(in_name_list, in_vals))
+        ctx = _EagerCtx(env, key)
+        opdef.lower(ctx, fake)
+        return [env.get(n) for n in out_names]
+
+    in_vals = [v._value for v in in_vars]
+    out_vals = replay(in_vals)
+    out_vars = [VarBase(val, stop_gradient=stop_gradient)
+                if val is not None else None for val in out_vals]
+    if tr is not None and not stop_gradient:
+        tr.record(replay, in_vars, in_vals,
+                  [ov for ov in out_vars if ov is not None])
+    return out_vars

@@ -1,0 +1,58 @@
+"""Eager-mode optimizers operating directly on Parameter VarBases.
+
+The reference reuses its graph optimizers under the tracer; here eager
+updates are plain jax array math on the parameter leaves (`minimize` =
+backward() + apply + clear tape), mirroring the
+backward->apply_gradients contract of python/paddle/fluid/optimizer.py:357.
+"""
+import numpy as np
+import jax.numpy as jnp
+
+__all__ = ['SGDOptimizer', 'AdamOptimizer']
+
+
+class _EagerOptimizer(object):
+    def __init__(self, learning_rate):
+        self._lr = learning_rate
+
+    def minimize(self, loss, parameter_list=None):
+        from .base import current_tracer
+        loss.backward()
+        params = parameter_list
+        if params is None:
+            raise ValueError("eager minimize needs parameter_list "
+                             "(e.g. model.parameters())")
+        for p in params:
+            if p._grad is not None:
+                self._apply_one(p)
+                p.clear_gradient()
+        tr = current_tracer()
+        if tr is not None:
+            tr.clear()
+
+    def _apply_one(self, p):
+        raise NotImplementedError
+
+
+class SGDOptimizer(_EagerOptimizer):
+    def _apply_one(self, p):
+        p._value = p._value - self._lr * p._grad
+
+
+class AdamOptimizer(_EagerOptimizer):
+    def __init__(self, learning_rate=1e-3, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8):
+        super(AdamOptimizer, self).__init__(learning_rate)
+        self._b1, self._b2, self._eps = beta1, beta2, epsilon
+        self._state = {}
+
+    def _apply_one(self, p):
+        m, v, t = self._state.get(id(p), (0.0, 0.0, 0))
+        t += 1
+        g = p._grad
+        m = self._b1 * m + (1 - self._b1) * g
+        v = self._b2 * v + (1 - self._b2) * g * g
+        mhat = m / (1 - self._b1 ** t)
+        vhat = v / (1 - self._b2 ** t)
+        p._value = p._value - self._lr * mhat / (jnp.sqrt(vhat) + self._eps)
+        self._state[id(p)] = (m, v, t)

@@ -1,19 +1,31 @@
-"""Fused attention: the pallas kernel tier (SURVEY §2.4: the TPU analog of
-the reference's operators/jit/ runtime-codegen kernels, with the same
-refer-vs-optimized cross-checking discipline — see tests/test_attention.py).
+"""Blocked flash attention: the pallas kernel tier (SURVEY §2.4: the TPU
+analog of the reference's operators/jit/ runtime-codegen kernels
+(jit/kernel_base.h:24-52), with the same refer-vs-optimized cross-checking
+discipline of operators/jit/test.cc — see tests/test_attention.py).
 
-`flash_attention` computes softmax(QK^T * scale + causal mask) V in one
-kernel: scores and probabilities live in VMEM only and never round-trip
-through HBM, which is the memory-bandwidth win on TPU (attention is
-HBM-bound at small d_head). One grid cell per (batch * head); each cell's
-Q/K/V tile fits VMEM for the seq lengths this kernel accepts (<= ~2k at
-d_head 64). The backward pass recomputes attention with the plain jnp
-formulation under jax AD (flash-style backward is a later optimization);
-forward-only inference gets the full benefit.
+Forward: FlashAttention-2 style. Grid (batch*head, q_block, k_block); the
+k dimension is innermost+sequential so f32 scratch (running max, running
+denominator, output accumulator) carries across k blocks — scores for one
+(q_block, k_block) tile live in VMEM only and never round-trip through HBM.
+Matmuls feed the MXU in the input dtype (bf16 under AMP) with f32
+accumulation via preferred_element_type; causal tiles below the diagonal
+are skipped with predication. Alongside O it emits per-row LSE
+(logsumexp), the residual the backward needs.
 
-Selection mirrors the reference jit-kernel `UseMe` pattern: on TPU the
-pallas kernel runs compiled; elsewhere the jnp reference implementation is
-used (the kernel itself is cross-checked against it in interpret mode).
+Backward: two pallas kernels (the FlashAttention-2 split):
+  - dQ:    grid (bh, q_block, k_block), accumulates dQ across k blocks;
+  - dK/dV: grid (bh, k_block, q_block), accumulates dK and dV across
+           q blocks.
+Both recompute the probability tile from (Q, K, LSE) instead of storing it
+— O(L) memory, O(L^2) recompute, the standard trade on HBM-bound hardware.
+delta = rowsum(dO * O) is precomputed outside the kernels (XLA fuses it).
+
+Under SPMD (an active MeshRunner mesh) the op no longer falls back to
+einsum: it wraps the kernel in shard_map over the (data, model) axes —
+batch and heads are embarrassingly parallel — and when the sequence axis
+itself is sharded it dispatches to the ring-attention path
+(parallel/ring_attention.py), making ring the long-context execution mode
+of this same op rather than a parallel universe.
 """
 import functools
 
@@ -28,7 +40,7 @@ _NEG_INF = -1e30
 
 
 def _attention_ref(q, k, v, scale, causal):
-    """Plain jnp reference ([BH, L, dh] each) — also the backward path."""
+    """Plain jnp reference ([BH, L, dh] each) — the 'refer' tier."""
     s = jnp.einsum('bqd,bkd->bqk', q, k,
                    preferred_element_type=jnp.float32) * scale
     if causal:
@@ -39,55 +51,269 @@ def _attention_ref(q, k, v, scale, causal):
     return jnp.einsum('bqk,bkd->bqd', p.astype(v.dtype), v)
 
 
-def _flash_kernel(scale, causal, q_ref, k_ref, v_ref, o_ref):
-    q = q_ref[0].astype(jnp.float32)
-    k = k_ref[0].astype(jnp.float32)
-    v = v_ref[0]
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+def _pick_block(ln, pref):
+    """Largest power-of-two tile (<= pref) dividing the sequence length."""
+    b = pref
+    while b > 128:
+        if ln % b == 0:
+            return b
+        b //= 2
+    return b if ln % b == 0 else ln
+
+
+def _compiler_params(pltpu, semantics):
+    cls = getattr(pltpu, 'CompilerParams', None) or \
+        getattr(pltpu, 'TPUCompilerParams')
+    try:
+        return cls(dimension_semantics=semantics)
+    except TypeError:       # field not supported on this version
+        return cls()
+
+
+# --------------------------------------------------------------------------
+# forward kernel
+# --------------------------------------------------------------------------
+
+def _fwd_kernel(scale, causal, nk, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr):
+    import jax.experimental.pallas as pl
+    i, j = pl.program_id(1), pl.program_id(2)
+    bq, bk = q_ref.shape[1], k_ref.shape[1]
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full(m_scr.shape, _NEG_INF, jnp.float32)
+        l_scr[...] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[...] = jnp.zeros(acc_scr.shape, jnp.float32)
+
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = i * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            cols = j * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            mask = rows >= cols
+            s = jnp.where(mask, s, _NEG_INF)
+        m_prev = m_scr[:, :1]
+        l_prev = l_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        if causal:
+            # rows whose tile slice is fully masked have m_new == _NEG_INF
+            # and exp(_NEG_INF - _NEG_INF) == 1; force masked entries to 0
+            p = jnp.where(mask, p, 0.0)
+        l_scr[...] = jnp.broadcast_to(
+            alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True), l_scr.shape)
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        pv = lax.dot_general(p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        acc_scr[...] = acc_scr[...] * alpha + pv
+
     if causal:
-        ln = q.shape[0]
-        rows = lax.broadcasted_iota(jnp.int32, (ln, ln), 0)
-        cols = lax.broadcasted_iota(jnp.int32, (ln, ln), 1)
-        s = jnp.where(rows >= cols, s, _NEG_INF)
-    m = jnp.max(s, axis=-1, keepdims=True)
-    p = jnp.exp(s - m)
-    z = jnp.sum(p, axis=-1, keepdims=True)
-    o = jax.lax.dot_general(p / z, v.astype(jnp.float32),
-                            (((1,), (0,)), ((), ())),
-                            preferred_element_type=jnp.float32)
-    o_ref[0] = o.astype(o_ref.dtype)
+        # tile visible iff its first key column <= last query row
+        pl.when(j * bk <= i * bq + bq - 1)(_compute)
+    else:
+        _compute()
+
+    @pl.when(j == nk - 1)
+    def _finish():
+        l = l_scr[:, :1]
+        o_ref[0] = (acc_scr[...] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+        lse_ref[0, 0] = m_scr[:, 0] + jnp.log(
+            jnp.maximum(l_scr[:, 0], 1e-30))
 
 
-def _flash_fwd_pallas(q, k, v, scale, causal, interpret):
-    from jax.experimental import pallas as pl
+def _flash_fwd_pallas(q, k, v, scale, causal, interpret, block_q, block_k):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
     bh, ln, dh = q.shape
-    kernel = functools.partial(_flash_kernel, scale, causal)
-    spec = pl.BlockSpec((1, ln, dh), lambda i: (i, 0, 0))
-    return pl.pallas_call(
+    bq = _pick_block(ln, block_q)
+    bk = _pick_block(ln, block_k)
+    nq, nk = ln // bq, ln // bk
+    kernel = functools.partial(_fwd_kernel, scale, causal, nk)
+    qspec = pl.BlockSpec((1, bq, dh), lambda b, i, j: (b, i, 0))
+    kspec = pl.BlockSpec((1, bk, dh), lambda b, i, j: (b, j, 0))
+    o, lse = pl.pallas_call(
         kernel,
-        grid=(bh,),
-        in_specs=[spec, spec, spec],
-        out_specs=spec,
-        out_shape=jax.ShapeDtypeStruct((bh, ln, dh), q.dtype),
+        grid=(bh, nq, nk),
+        in_specs=[qspec, kspec, kspec],
+        out_specs=[qspec,
+                   pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i))],
+        out_shape=[jax.ShapeDtypeStruct((bh, ln, dh), q.dtype),
+                   jax.ShapeDtypeStruct((bh, 1, ln), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((bq, 128), jnp.float32),
+                        pltpu.VMEM((bq, 128), jnp.float32),
+                        pltpu.VMEM((bq, dh), jnp.float32)],
+        compiler_params=_compiler_params(
+            pltpu, ("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q, k, v)
+    return o, lse[:, 0]
+
+
+# --------------------------------------------------------------------------
+# backward kernels
+# --------------------------------------------------------------------------
+
+def _bwd_dq_kernel(scale, causal, nk, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                   delta_ref, dq_ref, dq_scr):
+    import jax.experimental.pallas as pl
+    i, j = pl.program_id(1), pl.program_id(2)
+    bq, bk = q_ref.shape[1], k_ref.shape[1]
+
+    @pl.when(j == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros(dq_scr.shape, jnp.float32)
+
+    def _compute():
+        q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = i * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            cols = j * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        p = jnp.exp(s - lse_ref[0, 0][:, None])   # masked entries underflow
+        dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0, 0][:, None]) * scale
+        dq_scr[...] += lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        pl.when(j * bk <= i * bq + bq - 1)(_compute)
+    else:
+        _compute()
+
+    @pl.when(j == nk - 1)
+    def _finish():
+        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(scale, causal, nq, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                    delta_ref, dk_ref, dv_ref, dk_scr, dv_scr):
+    import jax.experimental.pallas as pl
+    i, j = pl.program_id(1), pl.program_id(2)      # i: k block, j: q block
+    bk, bq = k_ref.shape[1], q_ref.shape[1]
+
+    @pl.when(j == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros(dk_scr.shape, jnp.float32)
+        dv_scr[...] = jnp.zeros(dv_scr.shape, jnp.float32)
+
+    def _compute():
+        q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = j * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            cols = i * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        p = jnp.exp(s - lse_ref[0, 0][:, None])            # [bq, bk]
+        dv_scr[...] += lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0, 0][:, None]) * scale
+        dk_scr[...] += lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        # tile visible iff its last query row >= first key column
+        pl.when(j * bq + bq - 1 >= i * bk)(_compute)
+    else:
+        _compute()
+
+    @pl.when(j == nq - 1)
+    def _finish():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd_pallas(q, k, v, o, lse, do, scale, causal, interpret,
+                      block_q, block_k):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    bh, ln, dh = q.shape
+    bq = _pick_block(ln, block_q)
+    bk = _pick_block(ln, block_k)
+    nq, nk = ln // bq, ln // bk
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    lse3 = lse[:, None, :]
+    delta3 = delta[:, None, :]
+
+    qspec = pl.BlockSpec((1, bq, dh), lambda b, i, j: (b, i, 0))
+    kspec_j = pl.BlockSpec((1, bk, dh), lambda b, i, j: (b, j, 0))
+    rowspec = pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i))
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale, causal, nk),
+        grid=(bh, nq, nk),
+        in_specs=[qspec, kspec_j, kspec_j, qspec, rowspec, rowspec],
+        out_specs=[qspec],
+        out_shape=[jax.ShapeDtypeStruct((bh, ln, dh), q.dtype)],
+        scratch_shapes=[pltpu.VMEM((bq, dh), jnp.float32)],
+        compiler_params=_compiler_params(
+            pltpu, ("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, do, lse3, delta3)[0]
+
+    # k-major grid: q blocks stream innermost
+    qspec_j = pl.BlockSpec((1, bq, dh), lambda b, i, j: (b, j, 0))
+    kspec_i = pl.BlockSpec((1, bk, dh), lambda b, i, j: (b, i, 0))
+    rowspec_j = pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, j))
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale, causal, nq),
+        grid=(bh, nk, nq),
+        in_specs=[qspec_j, kspec_i, kspec_i, qspec_j, rowspec_j, rowspec_j],
+        out_specs=[kspec_i, kspec_i],
+        out_shape=[jax.ShapeDtypeStruct((bh, ln, dh), k.dtype),
+                   jax.ShapeDtypeStruct((bh, ln, dh), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((bk, dh), jnp.float32),
+                        pltpu.VMEM((bk, dh), jnp.float32)],
+        compiler_params=_compiler_params(
+            pltpu, ("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, do, lse3, delta3)
+    return dq, dk, dv
+
+
+# --------------------------------------------------------------------------
+# custom_vjp wrapper ([BH, L, dh] level)
+# --------------------------------------------------------------------------
+
+_DEF_BQ = 512
+_DEF_BK = 512
+
+
+def _fwd_impl(q, k, v, scale, causal, impl):
+    if impl in ('pallas', 'interpret'):
+        return _flash_fwd_pallas(q, k, v, scale, causal,
+                                 impl == 'interpret', _DEF_BQ, _DEF_BK)
+    return _attention_ref(q, k, v, scale, causal), None
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash(q, k, v, scale, causal, use_pallas):
-    if use_pallas:
-        return _flash_fwd_pallas(q, k, v, scale, causal,
-                                 interpret=(use_pallas == 'interpret'))
-    return _attention_ref(q, k, v, scale, causal)
+def _flash(q, k, v, scale, causal, impl):
+    return _fwd_impl(q, k, v, scale, causal, impl)[0]
 
 
-def _flash_fwd(q, k, v, scale, causal, use_pallas):
-    return _flash(q, k, v, scale, causal, use_pallas), (q, k, v)
+def _flash_fwd(q, k, v, scale, causal, impl):
+    o, lse = _fwd_impl(q, k, v, scale, causal, impl)
+    return o, (q, k, v, o, lse)
 
 
-def _flash_bwd(scale, causal, use_pallas, res, ct):
-    q, k, v = res
+def _flash_bwd(scale, causal, impl, res, ct):
+    q, k, v, o, lse = res
+    if impl in ('pallas', 'interpret'):
+        return _flash_bwd_pallas(q, k, v, o, lse, ct, scale, causal,
+                                 impl == 'interpret', _DEF_BQ, _DEF_BK)
     _, vjp = jax.vjp(lambda a, b, c: _attention_ref(a, b, c, scale, causal),
                      q, k, v)
     return vjp(ct)
@@ -96,10 +322,19 @@ def _flash_bwd(scale, causal, use_pallas, res, ct):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+def _resolve_impl(use_pallas):
+    if use_pallas is None:
+        return 'pallas' if jax.default_backend() == 'tpu' else 'ref'
+    if use_pallas == 'interpret':
+        return 'interpret'
+    return 'pallas' if use_pallas else 'ref'
+
+
 def flash_attention(q, k, v, scale=None, causal=True, use_pallas=None):
-    """q/k/v: [B, H, L, dh] (or [BH, L, dh]). On TPU lowers to the pallas
-    kernel; elsewhere to the jnp reference (use_pallas='interpret' forces
-    the kernel through the pallas interpreter for cross-checking)."""
+    """q/k/v: [B, H, L, dh] (or [BH, L, dh]). On TPU lowers to the blocked
+    pallas kernels (fwd + dq/dkv bwd); elsewhere to the jnp reference
+    (use_pallas='interpret' forces the kernels through the pallas
+    interpreter for cross-checking)."""
     shape4 = q.ndim == 4
     if shape4:
         b, h, ln, dh = q.shape
@@ -108,20 +343,71 @@ def flash_attention(q, k, v, scale=None, causal=True, use_pallas=None):
         v = v.reshape(b * h, ln, dh)
     if scale is None:
         scale = q.shape[-1] ** -0.5
-    if use_pallas is None:
-        use_pallas = jax.default_backend() == 'tpu'
-    out = _flash(q, k, v, float(scale), bool(causal), use_pallas)
+    impl = _resolve_impl(use_pallas)
+    if impl == 'pallas' and q.shape[1] % 128 and q.shape[1] > 1024:
+        # no 128-multiple tile divides L: the kernel would need one full-L
+        # VMEM tile; the fused-by-XLA reference is the safer lowering
+        impl = 'ref'
+    out = _flash(q, k, v, float(scale), bool(causal), impl)
     if shape4:
         out = out.reshape(b, h, ln, dh)
     return out
 
 
+# --------------------------------------------------------------------------
+# SPMD: shard_map over (data, model); ring dispatch for a sharded seq axis
+# --------------------------------------------------------------------------
+
+def _shard_map(fn, mesh, in_specs, out_specs):
+    from ..parallel.ring_attention import _shard_map as impl
+    return impl(fn, mesh, in_specs, out_specs)
+
+
+def _mesh_axis(mesh, name, dim_size):
+    """Axis name if present, >1, and divides dim_size; else None."""
+    if name in mesh.axis_names and mesh.shape[name] > 1 \
+            and dim_size % mesh.shape[name] == 0:
+        return name
+    return None
+
+
+def flash_attention_spmd(q, k, v, mesh, scale=None, causal=True,
+                         use_pallas=None):
+    """[B, H, L, dh] under an active mesh: batch sharded over 'data', heads
+    over 'model', kernel per shard via shard_map. If the 'seq' axis shards
+    L, dispatches to ring attention (the long-context mode)."""
+    from jax.sharding import PartitionSpec as P
+    b, h, ln, dh = q.shape
+    if scale is None:
+        scale = dh ** -0.5
+    data_ax = _mesh_axis(mesh, 'data', b)
+    model_ax = _mesh_axis(mesh, 'model', h)
+    seq_ax = _mesh_axis(mesh, 'seq', ln)
+    if seq_ax is not None:
+        from ..parallel.ring_attention import ring_attention
+        return ring_attention(q, k, v, mesh, axis_name=seq_ax,
+                              scale=scale, causal=causal,
+                              batch_axis=data_ax, head_axis=model_ax)
+    impl = _resolve_impl(use_pallas)
+    spec = P(data_ax, model_ax, None, None)
+
+    def inner(ql, kl, vl):
+        lb, lh = ql.shape[0], ql.shape[1]
+        o = _flash(ql.reshape(lb * lh, ln, dh), kl.reshape(lb * lh, ln, dh),
+                   vl.reshape(lb * lh, ln, dh), float(scale), bool(causal),
+                   impl)
+        return o.reshape(lb, lh, ln, dh)
+
+    return _shard_map(inner, mesh, (spec, spec, spec), spec)(q, k, v)
+
+
 @register_op('flash_attention')
 def _flash_attention_op(ctx, op):
     """Program-level op: inputs Q, K, V [B, H, L, dh]; attrs scale (float,
-    default dh^-0.5) and causal (bool). AMP-markable: under bf16 policy the
-    kernel's matmuls run bf16 with fp32 softmax/accumulation (the kernel
-    upcasts internally with preferred_element_type)."""
+    default dh^-0.5) and causal (bool). Under bf16 AMP the kernel's matmuls
+    run bf16 on the MXU with f32 accumulation (preferred_element_type) and
+    f32 softmax state. Under an active SPMD mesh the kernel runs per shard
+    via shard_map (ring attention when the sequence axis is sharded)."""
     from ..core import amp
     q = ctx.in1(op, 'Q')
     k = ctx.in1(op, 'K')
@@ -130,14 +416,25 @@ def _flash_attention_op(ctx, op):
     q, k, v = amp.cast_compute(op, q, k, v)
     scale = op.attr('scale', 0.0) or None
     causal = op.attr('causal', True)
-    use_pallas = None
     from ..parallel.api import get_active_mesh
     mesh = get_active_mesh()
+    use_pallas = None
+    if jax.default_backend() != 'tpu':
+        # on CPU (virtual-mesh tests, dryrun) exercise the real kernels
+        # through the pallas interpreter under SPMD; plain jnp otherwise
+        use_pallas = 'interpret' if mesh is not None else False
     if mesh is not None and mesh.size > 1:
-        # under SPMD the XLA partitioner cannot split a pallas custom
-        # call; the einsum formulation partitions cleanly over the
-        # mesh instead (per-chip fusion is a later shard_map step)
-        use_pallas = False
-    out = flash_attention(q, k, v, scale=scale, causal=causal,
-                          use_pallas=use_pallas)
+        if q.ndim == 4:
+            out = flash_attention_spmd(q, k, v, mesh, scale=scale,
+                                       causal=causal,
+                                       use_pallas=use_pallas)
+        else:
+            # 3-d [BH, L, dh]: no batch/head axes to shard_map over; the
+            # XLA auto-partitioner cannot split a pallas custom call, so
+            # lower the partitionable einsum reference instead
+            out = flash_attention(q, k, v, scale=scale, causal=causal,
+                                  use_pallas=False)
+    else:
+        out = flash_attention(q, k, v, scale=scale, causal=causal,
+                              use_pallas=use_pallas)
     ctx.out(op, 'Out', out.astype(out_dtype))

