@@ -1,0 +1,41 @@
+"""Isolate resnet slowness: time fwd-only vs train, raw-jax NHWC vs NCHW conv."""
+import time
+import numpy as np, jax, jax.numpy as jnp
+from jax import lax
+
+dev = jax.devices()[0]; print(dev.platform, dev.device_kind)
+B = 64
+
+def timeit(name, f, *a, iters=5):
+    out = f(*a); jax.block_until_ready(out)
+    t0 = time.time()
+    for _ in range(iters): out = f(*a)
+    # force real sync through the relay with a scalar pull
+    s = float(jnp.sum(out[0] if isinstance(out, tuple) else out).astype(jnp.float32))
+    dt = (time.time()-t0)/iters
+    print("%s: %.1f ms" % (name, dt*1e3))
+    return dt
+
+# raw conv stack bf16, NCHW vs NHWC: 10 convs 3x3 c256 on 56x56
+x_nchw = jnp.asarray(np.random.randn(B,256,56,56).astype('float32')).astype(jnp.bfloat16)
+w = jnp.asarray(np.random.randn(256,256,3,3).astype('float32')).astype(jnp.bfloat16)
+@jax.jit
+def conv_nchw(x, w):
+    for _ in range(10):
+        x = lax.conv_general_dilated(x, w, (1,1), [(1,1),(1,1)],
+                                     dimension_numbers=('NCHW','OIHW','NCHW'))
+    return x
+timeit('10x conv NCHW bf16', conv_nchw, x_nchw, w)
+
+x_nhwc = jnp.asarray(np.random.randn(B,56,56,256).astype('float32')).astype(jnp.bfloat16)
+w2 = jnp.asarray(np.random.randn(3,3,256,256).astype('float32')).astype(jnp.bfloat16)
+@jax.jit
+def conv_nhwc(x, w):
+    for _ in range(10):
+        x = lax.conv_general_dilated(x, w, (1,1), [(1,1),(1,1)],
+                                     dimension_numbers=('NHWC','HWIO','NHWC'))
+    return x
+timeit('10x conv NHWC bf16', conv_nhwc, x_nhwc, w2)
+# flops: 10 * 2*B*56*56*256*256*9 = 
+fl = 10*2*B*56*56*256*256*9
+print("flops per call: %.1f G" % (fl/1e9))

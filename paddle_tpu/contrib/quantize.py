@@ -226,3 +226,21 @@ class QuantizeTranspiler(object):
                            -bin_cnt - 1, bin_cnt).astype(np.int8)
             out[name] = (blob, scale)
         return out
+
+
+def calibrate_scales(exe, program, scope, feed_batches, var_names):
+    """Post-training int8 calibration: run `program` over the calibration
+    `feed_batches` and collect the running abs-max of each variable in
+    `var_names`, returning {name: scale} suitable for the int8
+    `quantize`/`dequantize` ops (Scale = bin_max / abs_max convention left
+    to the caller). The TPU analog of reference
+    contrib/int8_inference/utility.py's sampling pass."""
+    maxes = {n: 0.0 for n in var_names}
+    for feed in feed_batches:
+        outs = exe.run(program, feed=feed, fetch_list=list(var_names),
+                       scope=scope)
+        for n, v in zip(var_names, outs):
+            m = float(np.max(np.abs(np.asarray(v))))
+            if m > maxes[n]:
+                maxes[n] = m
+    return {n: (m if m > 0 else 1.0) for n, m in maxes.items()}

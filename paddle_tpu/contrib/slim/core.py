@@ -99,12 +99,19 @@ class CompressPass(object):
         for s in self._strategies:
             s.on_compress_begin(ctx)
         if self._optimizer is not None and self._loss is not None:
-            from ... import program_guard
+            from ... import program_guard, Scope, scope_guard
             with program_guard(ctx.train_program,
                                ctx.startup_program or ctx.train_program):
                 self._optimizer.minimize(self._loss)
             if ctx.startup_program is not None:
-                self._exe.run(ctx.startup_program, scope=self._scope)
+                # initialize ONLY vars the rewrite/minimize created — the
+                # full startup would re-randomize pretrained weights
+                tmp = Scope()
+                with scope_guard(tmp):
+                    self._exe.run(ctx.startup_program, scope=tmp)
+                for name in tmp.names():
+                    if not self._scope.has(name):
+                        self._scope.set(name, tmp.get(name))
         for epoch in range(self._epochs):
             ctx.epoch = epoch
             act = [s for s in self._strategies if s.active(epoch)]

@@ -187,12 +187,13 @@ class ChannelPruner(object):
         if conv_op is None:
             raise ValueError("no conv2d consumes Filter %r" % filter_name)
         out_name = conv_op.output('Output')[0]
-        self._propagate(out_name, keep)
+        self._propagate(out_name, keep, orig_c=o)
         return keep
 
-    def _propagate(self, var_name, keep):
+    def _propagate(self, var_name, keep, orig_c):
         """Walk consumers of `var_name` (a [N,C,H,W] activation whose C was
-        pruned to `keep`) and resize channel-dependent vars."""
+        pruned to `keep`; `orig_c` = channel count before pruning) and
+        resize channel-dependent vars."""
         for op in self._consumers(var_name):
             if op.type in ('conv2d',):
                 fname = op.input('Filter')[0]
@@ -203,13 +204,13 @@ class ChannelPruner(object):
                 fname = op.input('Filter')[0]
                 w = np.asarray(self._scope.get(fname))
                 self._resize(fname, w[keep], indexer=lambda a: a[keep])
-                self._propagate(op.output('Output')[0], keep)
+                self._propagate(op.output('Output')[0], keep, orig_c)
             elif op.type == 'batch_norm':
                 for slot in ('Scale', 'Bias', 'Mean', 'Variance'):
                     n = op.input(slot)[0]
                     self._resize(n, np.asarray(self._scope.get(n))[keep],
                                  indexer=lambda a: a[keep])
-                self._propagate(op.output('Y')[0], keep)
+                self._propagate(op.output('Y')[0], keep, orig_c)
             elif op.type == 'elementwise_add' and op.attr('axis', -1) == 1:
                 # conv bias add: Y is the [C] bias param
                 bname = op.input('Y')[0]
@@ -217,7 +218,7 @@ class ChannelPruner(object):
                 if b is not None and np.asarray(b).ndim == 1:
                     self._resize(bname, np.asarray(b)[keep],
                                  indexer=lambda a: a[keep])
-                self._propagate(op.output('Out')[0], keep)
+                self._propagate(op.output('Out')[0], keep, orig_c)
             elif op.type == 'mul':
                 # first FC after flatten: rows are NCHW-flattened
                 in_var = self._program.global_block()._find_var_recursive(
@@ -225,10 +226,15 @@ class ChannelPruner(object):
                 wname = op.input('Y')[0]
                 w = np.asarray(self._scope.get(wname))
                 shape = in_var.shape if in_var is not None else None
-                if shape is None or len(shape) < 4:
+                if shape is not None and len(shape) >= 4:
+                    hw = int(np.prod(shape[2:]))
+                elif w.shape[0] % orig_c == 0:
+                    # flattened NCHW input (reshape/flatten before the fc):
+                    # rows per channel from the weight itself
+                    hw = w.shape[0] // orig_c
+                else:
                     raise ValueError(
                         "cannot infer spatial size feeding mul %r" % wname)
-                hw = int(np.prod(shape[2:]))
                 rows = np.concatenate(
                     [np.arange(c * hw, (c + 1) * hw) for c in keep])
                 self._resize(wname, w[rows], indexer=lambda a: a[rows])
@@ -236,11 +242,21 @@ class ChannelPruner(object):
                     'relu', 'pool2d'):
                 outs = op.output('Out') or op.output('Output')
                 if outs:
-                    self._propagate(outs[0], keep)
+                    self._propagate(outs[0], keep, orig_c)
             # ops that flatten/reshape before mul keep NCHW row order;
             # reshape/flatten pass channel blocks through contiguously
             elif op.type in ('reshape', 'reshape2', 'flatten', 'flatten2',
                              'squeeze', 'squeeze2'):
+                # a concrete target dim that folds the channel axis must
+                # shrink with it (e.g. reshape([-1, C*H*W]))
+                shape_attr = op.attr('shape', None)
+                if shape_attr:
+                    new_shape = [
+                        (d // orig_c) * len(keep)
+                        if d > 0 and d >= orig_c and d % orig_c == 0
+                        else d
+                        for d in shape_attr]
+                    op.set_attr('shape', new_shape)
                 outs = op.output('Out')
                 if outs:
-                    self._propagate(outs[0], keep)
+                    self._propagate(outs[0], keep, orig_c)

@@ -1,0 +1,3 @@
+"""Distributed launch tooling (reference python/paddle/distributed/)."""
+from . import launch  # noqa: F401
+from .launch import launch_procs, init_from_env  # noqa: F401

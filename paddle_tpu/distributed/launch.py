@@ -1,0 +1,143 @@
+"""Multi-process trainer launcher.
+
+Reference parity: python/paddle/distributed/launch.py:40 spawns one trainer
+process per device with the PADDLE_* env contract (PADDLE_TRAINER_ID,
+PADDLE_CURRENT_ENDPOINT, PADDLE_TRAINERS_NUM, PADDLE_TRAINER_ENDPOINTS).
+The TPU-native launcher keeps that env contract and adds the
+jax.distributed coordinator address (PADDLE_COORDINATOR) so workers
+bootstrap the multi-host runtime with `init_from_env()` — the analog of
+the reference's gen_nccl_id gRPC unique-id exchange
+(operators/distributed_ops/gen_nccl_id_op.cc:31).
+
+CLI:  python -m paddle_tpu.distributed.launch \
+          --nproc_per_node 4 [--node_ip 127.0.0.1] [--log_dir logs] \
+          train_script.py [script args...]
+
+Each worker sees:
+  PADDLE_TRAINER_ID        global rank
+  PADDLE_TRAINERS_NUM      world size
+  PADDLE_CURRENT_ENDPOINT  this worker's ip:port
+  PADDLE_TRAINER_ENDPOINTS comma list of all endpoints
+  PADDLE_COORDINATOR       jax.distributed coordinator 'ip:port'
+"""
+import argparse
+import os
+import socket
+import subprocess
+import sys
+
+__all__ = ['launch_procs', 'init_from_env', 'main']
+
+
+def _free_port(ip='127.0.0.1'):
+    s = socket.socket()
+    s.bind((ip, 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def launch_procs(entrypoint, entrypoint_args=(), nproc_per_node=1,
+                 node_ip='127.0.0.1', node_ips=None, node_id=0,
+                 log_dir=None, env_extra=None, devices_per_proc=None):
+    """Spawn `nproc_per_node` worker processes with the PADDLE_* env
+    contract; returns the list of Popen objects (caller waits).
+
+    Multi-node: pass node_ips (list of node IPs, same launcher run on each
+    node with its node_id); endpoints are enumerated for all nodes, but
+    only this node's workers are spawned here — exactly the reference
+    start_procs contract (launch.py:40).
+    """
+    node_ips = list(node_ips or [node_ip])
+    nnodes = len(node_ips)
+    world = nnodes * nproc_per_node
+    base_ports = {}
+    endpoints = []
+    for ip in node_ips:
+        for i in range(nproc_per_node):
+            if ip == node_ip:
+                port = _free_port(ip)
+            else:          # remote ports cannot be probed; fixed scheme
+                port = 6170 + i
+            base_ports[(ip, i)] = port
+            endpoints.append('%s:%d' % (ip, port))
+    coordinator = '%s:%d' % (node_ips[0], _free_port(node_ips[0])
+                             if node_ips[0] == node_ip else 6269)
+
+    if log_dir:
+        os.makedirs(log_dir, exist_ok=True)
+    procs, logs = [], []
+    for i in range(nproc_per_node):
+        rank = node_id * nproc_per_node + i
+        env = dict(os.environ)
+        env.update(env_extra or {})
+        env.update({
+            'PADDLE_TRAINER_ID': str(rank),
+            'PADDLE_TRAINERS_NUM': str(world),
+            'PADDLE_CURRENT_ENDPOINT': endpoints[rank],
+            'PADDLE_TRAINER_ENDPOINTS': ','.join(endpoints),
+            'PADDLE_COORDINATOR': coordinator,
+        })
+        if devices_per_proc:
+            # virtual-device CPU runs (tests / laptops): give each worker
+            # its own device slice
+            env['JAX_PLATFORMS'] = 'cpu'
+            env['XLA_FLAGS'] = (
+                env.get('XLA_FLAGS', '').replace(
+                    '--xla_force_host_platform_device_count=8', '').strip()
+                + ' --xla_force_host_platform_device_count=%d'
+                % devices_per_proc).strip()
+        out = None
+        if log_dir:
+            f = open(os.path.join(log_dir, 'workerlog.%d' % rank), 'w')
+            logs.append(f)
+            out = f
+        cmd = [sys.executable, '-u', entrypoint] + list(entrypoint_args)
+        procs.append(subprocess.Popen(cmd, env=env, stdout=out,
+                                      stderr=subprocess.STDOUT
+                                      if out else None))
+    return procs
+
+
+def init_from_env():
+    """Worker-side bootstrap: read the launcher's env contract and
+    initialize jax.distributed; returns (rank, world_size). No-op (0, 1)
+    when not launched by the launcher."""
+    world = int(os.environ.get('PADDLE_TRAINERS_NUM', '1'))
+    rank = int(os.environ.get('PADDLE_TRAINER_ID', '0'))
+    coordinator = os.environ.get('PADDLE_COORDINATOR')
+    if world > 1 and coordinator:
+        from ..parallel import collective
+        collective.init_distributed(coordinator_address=coordinator,
+                                    num_processes=world, process_id=rank)
+    return rank, world
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description='paddle_tpu multi-process launcher')
+    ap.add_argument('--nproc_per_node', type=int, default=1)
+    ap.add_argument('--node_ip', default='127.0.0.1')
+    ap.add_argument('--node_ips', default='',
+                    help='comma list of all node IPs (multi-node)')
+    ap.add_argument('--node_id', type=int, default=0)
+    ap.add_argument('--log_dir', default=None)
+    ap.add_argument('--devices_per_proc', type=int, default=0,
+                    help='virtual CPU devices per worker (testing)')
+    ap.add_argument('entrypoint')
+    ap.add_argument('entrypoint_args', nargs=argparse.REMAINDER)
+    args = ap.parse_args(argv)
+    procs = launch_procs(
+        args.entrypoint, args.entrypoint_args,
+        nproc_per_node=args.nproc_per_node, node_ip=args.node_ip,
+        node_ips=[s for s in args.node_ips.split(',') if s] or None,
+        node_id=args.node_id, log_dir=args.log_dir,
+        devices_per_proc=args.devices_per_proc or None)
+    rc = 0
+    for p in procs:
+        rc |= p.wait()
+    sys.exit(rc)
+
+
+if __name__ == '__main__':
+    main()

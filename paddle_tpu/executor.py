@@ -366,6 +366,120 @@ class Executor(object):
         ]
 
     # ------------------------------------------------------------------
+    def run_fused(self, program=None, feed_list=None, fetch_list=None,
+                  scope=None, return_numpy=True, steps=None):
+        """Run len(feed_list) consecutive steps in ONE compiled call.
+
+        The step function is iterated on-device with lax.fori_loop over the
+        pre-stacked feed batches (uploaded once), so host->device launch
+        latency — which dominates when the chip sits behind a network
+        tunnel — is paid once per K steps instead of per step. This is the
+        TPU-native analog of the reference amortization knobs
+        (ExecutionStrategy.num_iteration_per_drop_scope,
+        details/execution_strategy.h:22; AsyncExecutor's many-iterations-
+        per-dispatch loop, framework/async_executor.cc:236).
+
+        feed_list: list of K feed dicts with identical names/shapes/dtypes
+        (dense only — LoD feeds bind statically and cannot be scanned), OR
+        a pre-stacked {name: array[K, ...]} dict — pass device-resident
+        (jax.device_put) stacked arrays to avoid re-uploading large feeds
+        on every call (the input-pipeline staging an async py_reader would
+        do). Returns the LAST step's fetches; all K state updates land in
+        the scope.
+        """
+        import jax
+        from jax import lax
+        if program is None:
+            program = default_main_program()
+        if scope is None:
+            scope = global_scope()
+        if not feed_list:
+            return []
+        if isinstance(feed_list, dict):
+            stacked = dict(feed_list)
+            k_steps = int(next(iter(stacked.values())).shape[0])
+            feed0 = {kk: np.asarray(v[0]) if not isinstance(v, jax.Array)
+                     else v[0] for kk, v in stacked.items()}
+        else:
+            prepared = [self._prepare_feed(program, f or {})
+                        for f in feed_list]
+            if any(lods for _, lods in prepared):
+                raise ValueError(
+                    "run_fused supports dense feeds only — LoD feeds bind "
+                    "statically per compile; bucket+pad them (reader/"
+                    "bucketing.py) to scan steps on-device")
+            feeds = [f for f, _ in prepared]
+            k_steps = len(feeds)
+            stacked = {name: np.stack([np.asarray(f[name]) for f in feeds])
+                       for name in feeds[0]}
+            feed0 = feeds[0]
+        static_names = self._static_feed_names(program)
+        if any(n in feed0 for n in static_names):
+            raise ValueError(
+                "run_fused cannot scan shape-bearing static feeds %r"
+                % sorted(static_names & set(feed0)))
+        fetch_names = [v.name if isinstance(v, Variable) else v
+                       for v in (fetch_list or [])]
+
+        n_steps = int(steps) if steps else k_steps
+        cache_key = ('fused', k_steps, n_steps, program._uid,
+                     program._version,
+                     self._feed_signature(feed0, (), ()),
+                     tuple(fetch_names))
+        entry = self._cache.get(cache_key)
+        if entry is None:
+            read, written = lowering.analyze_state(program, fetch_names)
+            needed = self._read_before_write(program, read, written,
+                                             set(feed0), fetch_names)
+            fn, ro_names, rw_names = lowering.build_fn(
+                program, fetch_names, needed, written)
+
+            def fused(stacked_feed, ro, rw, base_key):
+                # carry: (read-write subset fed back into fn, FULL written
+                # state for the scope, last fetches) — new_state covers all
+                # written persistables, a superset of the read-before-write
+                # names fn consumes
+                def body(i, carry):
+                    rw_c, _, _ = carry
+                    feed_i = {kk: lax.dynamic_index_in_dim(
+                        v, jnp.mod(i, k_steps), 0, keepdims=False)
+                              for kk, v in stacked_feed.items()}
+                    key_i = jax.random.fold_in(base_key, i)
+                    fetches_i, ns = fn(feed_i, ro, rw_c, key_i)
+                    rw_next = {kk: ns.get(kk, rw_c[kk]) for kk in rw_c}
+                    return rw_next, ns, tuple(fetches_i)
+                feed0 = {kk: v[0] for kk, v in stacked_feed.items()}
+                (f0, ns0) = jax.eval_shape(
+                    fn, feed0, ro, rw, jax.random.PRNGKey(0))
+                # seed the carry at the step function's fixed-point dtypes
+                rw = {kk: jnp.asarray(v, ns0[kk].dtype) if kk in ns0
+                      else v for kk, v in rw.items()}
+                ns_init = {kk: jnp.zeros(sp.shape, sp.dtype)
+                           for kk, sp in ns0.items()}
+                init_f = tuple(jnp.zeros(sp.shape, sp.dtype) for sp in f0)
+                _, ns_out, fetches = lax.fori_loop(
+                    0, n_steps, body, (rw, ns_init, init_f))
+                return fetches, ns_out
+
+            jitted = jax.jit(fused, donate_argnums=(2,))
+            entry = _CompiledEntry(jitted, fetch_names, ro_names, rw_names,
+                                   written, program, {})
+            self._cache[cache_key] = entry
+
+        ro_state = {n: self._state_value(scope, n, program)
+                    for n in entry.ro_names}
+        rw_state = {n: self._state_value(scope, n, program)
+                    for n in entry.rw_names}
+        self._run_counter += 1
+        key_arr = _run_key(program.random_seed, _next_program_run(program),
+                           self._run_counter)
+        fetches, new_state = entry.fn(stacked, ro_state, rw_state, key_arr)
+        scope.update(new_state)
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return list(fetches)
+
+    # ------------------------------------------------------------------
     def _state_value(self, scope, name, program):
         v = scope.get(name)
         if v is None:

@@ -42,10 +42,15 @@ class Layer(object):
     # -- parameter / sublayer registry ------------------------------------
     def create_parameter(self, shape, dtype=None, default_initializer=None,
                          is_bias=False, name=None):
+        import zlib
         dtype = dtype or self._dtype
+        # deterministic digest (NOT hash(): string hashing is randomized
+        # per process, which would make eager init irreproducible and
+        # divergent across hosts)
+        seed_src = '%s|%s|%d' % (self._full_name, name,
+                                 len(self._parameters))
         rng = np.random.RandomState(
-            abs(hash((self._full_name, name, len(self._parameters)))) %
-            (2 ** 31))
+            zlib.crc32(seed_src.encode()) % (2 ** 31))
         if default_initializer is not None:
             value = default_initializer(shape, dtype, rng)
         elif is_bias:

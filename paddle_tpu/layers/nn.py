@@ -34,7 +34,10 @@ __all__ = [
     'sums_', 'logical_and', 'logical_or', 'logical_xor', 'logical_not',
     'where', 'sign', 'gather_nd', 'random_crop', 'mean_iou', 'hash',
     'grid_sampler', 'affine_grid', 'roi_pool', 'roi_align', 'psroi_pool',
-    'py_func', 'unpool', 'spp',
+    'py_func', 'unpool', 'spp', 'adaptive_pool2d', 'adaptive_pool3d',
+    'dice_loss', 'image_resize_short', 'lstm', 'lstm_unit',
+    'conv3d_transpose', 'similarity_focus', 'tree_conv',
+    'merge_selected_rows', 'get_tensor_from_selected_rows',
     'teacher_student_sigmoid_loss', 'selu', 'swish',
     'sharding_constraint', 'linear_chain_crf', 'crf_decoding', 'warpctc',
     'ctc_greedy_decoder', 'edit_distance',
@@ -1665,4 +1668,267 @@ def spp(input, pyramid_height, pool_type='max', name=None):
                      outputs={'Out': [out]},
                      attrs={'pyramid_height': pyramid_height,
                             'pooling_type': pool_type})
+    return out
+
+
+def adaptive_pool2d(input, pool_size, pool_type='max', require_index=False,
+                    name=None):
+    """reference layers/nn.py:2597 adaptive_pool2d: pool to a fixed output
+    grid regardless of input size (pool2d with adaptive=True; the
+    require_index variant routes through max_pool2d_with_index)."""
+    if pool_type not in ('max', 'avg'):
+        raise ValueError("'pool_type' must be 'max' or 'avg'")
+    if require_index and pool_type != 'max':
+        raise ValueError("require_index is only valid with max pooling")
+    pool_size = list(_pair(pool_size))
+    n, c = input.shape[0], input.shape[1]
+    out_shape = (n, c, pool_size[0], pool_size[1])
+    if require_index:
+        helper = LayerHelper('max_pool2d_with_index', name=name)
+        out = helper.create_variable_for_type_inference(
+            input.dtype, shape=out_shape)
+        mask = helper.create_variable_for_type_inference(
+            'int32', shape=out_shape)
+        helper.append_op(
+            type='max_pool2d_with_index', inputs={'X': [input]},
+            outputs={'Out': [out], 'Mask': [mask]},
+            attrs={'ksize': pool_size, 'strides': [1, 1],
+                   'paddings': [0, 0], 'adaptive': True})
+        return out, mask
+    helper = LayerHelper('pool2d', name=name)
+    out = helper.create_variable_for_type_inference(
+        input.dtype, shape=out_shape)
+    helper.append_op(
+        type='pool2d', inputs={'X': [input]}, outputs={'Out': [out]},
+        attrs={'pooling_type': pool_type, 'ksize': pool_size,
+               'strides': [1, 1], 'paddings': [0, 0], 'adaptive': True})
+    return out
+
+
+def adaptive_pool3d(input, pool_size, pool_type='max', require_index=False,
+                    name=None):
+    """reference layers/nn.py adaptive_pool3d (pool3d with adaptive=True)."""
+    if pool_type not in ('max', 'avg'):
+        raise ValueError("'pool_type' must be 'max' or 'avg'")
+    if require_index and pool_type != 'max':
+        raise ValueError("require_index is only valid with max pooling")
+    pool_size = list(_pair(pool_size, 3))
+    n, c = input.shape[0], input.shape[1]
+    out_shape = (n, c) + tuple(pool_size)
+    if require_index:
+        helper = LayerHelper('max_pool3d_with_index', name=name)
+        out = helper.create_variable_for_type_inference(
+            input.dtype, shape=out_shape)
+        mask = helper.create_variable_for_type_inference(
+            'int32', shape=out_shape)
+        helper.append_op(
+            type='max_pool3d_with_index', inputs={'X': [input]},
+            outputs={'Out': [out], 'Mask': [mask]},
+            attrs={'ksize': pool_size, 'strides': [1, 1, 1],
+                   'paddings': [0, 0, 0], 'adaptive': True})
+        return out, mask
+    helper = LayerHelper('pool3d', name=name)
+    out = helper.create_variable_for_type_inference(
+        input.dtype, shape=out_shape)
+    helper.append_op(
+        type='pool3d', inputs={'X': [input]}, outputs={'Out': [out]},
+        attrs={'pooling_type': pool_type, 'ksize': pool_size,
+               'strides': [1, 1, 1], 'paddings': [0, 0, 0],
+               'adaptive': True})
+    return out
+
+
+def dice_loss(input, label, epsilon=1e-5):
+    """reference layers/nn.py:6582 dice_loss: 1 - 2*intersection/total
+    over one-hot labels, composed from existing ops like the reference."""
+    label = one_hot(label, depth=input.shape[-1])
+    reduce_dim = list(range(1, len(input.shape)))
+    inse = reduce_sum(elementwise_mul(input, label), dim=reduce_dim)
+    dice_denominator = elementwise_add(
+        reduce_sum(input, dim=reduce_dim),
+        reduce_sum(label, dim=reduce_dim))
+    dice_score = scale(
+        elementwise_div(
+            inse, scale(dice_denominator, scale=1.0, bias=epsilon)),
+        scale=-2.0, bias=1.0)
+    return reduce_mean(dice_score)
+
+
+def image_resize_short(input, out_short_len, resample='BILINEAR'):
+    """reference layers/nn.py:7030 image_resize_short: resize keeping the
+    aspect ratio so the SHORT side equals out_short_len."""
+    in_shape = input.shape
+    if len(in_shape) != 4:
+        raise ValueError("The rank of input must be 4 (NCHW).")
+    hw = list(in_shape[2:4])
+    short_idx = hw.index(min(hw))
+    long_idx = 1 - short_idx
+    out_shape = list(hw)
+    out_shape[short_idx] = out_short_len
+    out_shape[long_idx] = int(
+        float(out_shape[long_idx]) *
+        (float(out_short_len) / float(hw[short_idx])) + 0.5)
+    return image_resize(input=input, out_shape=out_shape, resample=resample)
+
+
+def lstm(input, init_h, init_c, max_len, hidden_size, num_layers,
+         dropout_prob=0.0, is_bidirec=False, is_test=False, name=None,
+         default_initializer=None, seed=-1):
+    """reference layers/nn.py:491 lstm — the cudnn_lstm-backed dense LSTM
+    (gates [i,f,c,o], no peepholes). Weight blob layout is documented on
+    the cudnn_lstm op (ops/rnn_ops.py): per layer/direction
+    Wx|Wh|bx|bh."""
+    helper = LayerHelper('cudnn_lstm', name=name)
+    dtype = input.dtype
+    input_size = input.shape[-1]
+    dirs = 2 if is_bidirec else 1
+    weight_size = 0
+    for layer in range(num_layers):
+        in_l = input_size if layer == 0 else hidden_size * dirs
+        weight_size += dirs * (in_l * 4 * hidden_size
+                               + hidden_size * 4 * hidden_size
+                               + 8 * hidden_size)
+    weight = helper.create_parameter(
+        attr=helper.param_attr, shape=[weight_size], dtype=dtype,
+        default_initializer=default_initializer)
+    out = helper.create_variable_for_type_inference(
+        dtype, shape=(input.shape[0], input.shape[1],
+                      hidden_size * dirs))
+    last_h = helper.create_variable_for_type_inference(
+        dtype, shape=(num_layers * dirs, input.shape[1], hidden_size))
+    last_c = helper.create_variable_for_type_inference(
+        dtype, shape=(num_layers * dirs, input.shape[1], hidden_size))
+    helper.append_op(
+        type='cudnn_lstm',
+        inputs={'Input': [input], 'InitH': [init_h], 'InitC': [init_c],
+                'W': [weight]},
+        outputs={'Out': [out], 'last_h': [last_h], 'last_c': [last_c]},
+        attrs={'max_len': max_len, 'hidden_size': hidden_size,
+               'num_layers': num_layers, 'is_bidirec': is_bidirec,
+               'input_size': input_size, 'dropout_prob': dropout_prob,
+               'is_test': is_test, 'seed': seed})
+    return out, last_h, last_c
+
+
+def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
+              param_attr=None, bias_attr=None, name=None):
+    """reference layers/nn.py:4089 lstm_unit: fc([x_t, h_prev]) -> 4D
+    gates -> lstm_unit op (gate order [i,f,o,j])."""
+    from .tensor import concat
+    helper = LayerHelper('lstm_unit', param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    size = cell_t_prev.shape[-1]
+    concat_out = concat([x_t, hidden_t_prev], axis=1)
+    fc_out = fc(input=concat_out, size=4 * size,
+                param_attr=helper.param_attr, bias_attr=helper.bias_attr)
+    h = helper.create_variable_for_type_inference(
+        x_t.dtype, shape=cell_t_prev.shape)
+    c = helper.create_variable_for_type_inference(
+        x_t.dtype, shape=cell_t_prev.shape)
+    helper.append_op(
+        type='lstm_unit',
+        inputs={'X': [fc_out], 'C_prev': [cell_t_prev]},
+        outputs={'H': [h], 'C': [c]},
+        attrs={'forget_bias': forget_bias})
+    return h, c
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=None,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None):
+    """reference layers/nn.py:3477 conv3d_transpose (NCDHW)."""
+    helper = LayerHelper('conv3d_transpose', param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    dtype = input.dtype
+    n, c, d_in, h, w_in = input.shape
+    groups = groups or 1
+    stride = _pair(stride, 3)
+    padding = _pair(padding, 3)
+    dilation = _pair(dilation, 3)
+    if filter_size is None:
+        if output_size is None:
+            raise ValueError("output_size or filter_size required")
+        output_size = _pair(output_size, 3)
+        in_sp = [d_in, h, w_in]
+        filter_size = [
+            (output_size[i] - (in_sp[i] - 1) * stride[i] + 2 * padding[i]
+             - 1) // dilation[i] + 1 for i in range(3)]
+    else:
+        filter_size = list(_pair(filter_size, 3))
+    wvar = helper.create_parameter(
+        attr=helper.param_attr,
+        shape=[c, num_filters // groups] + filter_size, dtype=dtype)
+    in_sp = [d_in, h, w_in]
+    out_sp = [
+        (in_sp[i] - 1) * stride[i] - 2 * padding[i] +
+        dilation[i] * (filter_size[i] - 1) + 1 for i in range(3)]
+    pre_bias = helper.create_variable_for_type_inference(
+        dtype, shape=(n, num_filters) + tuple(out_sp))
+    helper.append_op(
+        type='conv3d_transpose',
+        inputs={'Input': [input], 'Filter': [wvar]},
+        outputs={'Output': [pre_bias]},
+        attrs={'strides': list(stride), 'paddings': list(padding),
+               'dilations': list(dilation), 'groups': groups})
+    pre_act = helper.append_bias_op(pre_bias, dim_start=1, dim_end=2)
+    return helper.append_activation(pre_act)
+
+
+def similarity_focus(input, axis, indexes, name=None):
+    """reference layers/nn.py:9414 similarity_focus wrapper."""
+    helper = LayerHelper('similarity_focus', name=name)
+    if axis not in (1, 2, 3):
+        raise ValueError("axis must be 1, 2 or 3")
+    if not indexes:
+        raise ValueError("indexes can not be empty")
+    out = helper.create_variable_for_type_inference(
+        input.dtype, shape=input.shape)
+    helper.append_op(
+        type='similarity_focus', inputs={'X': [input]},
+        outputs={'Out': [out]},
+        attrs={'axis': axis, 'indexes': list(indexes)})
+    return out
+
+
+def tree_conv(nodes_vector, edge_set, output_size, num_filters=1,
+              max_depth=2, act='tanh', param_attr=None, bias_attr=None,
+              name=None):
+    """reference layers/nn.py:10307 tree_conv (TBCNN) wrapper."""
+    helper = LayerHelper('tree_conv', param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    dtype = nodes_vector.dtype
+    feature_size = nodes_vector.shape[2]
+    wvar = helper.create_parameter(
+        attr=helper.param_attr,
+        shape=[feature_size, 3, output_size, num_filters], dtype=dtype)
+    out = helper.create_variable_for_type_inference(
+        dtype, shape=(nodes_vector.shape[0], nodes_vector.shape[1],
+                      output_size, num_filters))
+    helper.append_op(
+        type='tree_conv',
+        inputs={'NodesVector': [nodes_vector], 'EdgeSet': [edge_set],
+                'Filter': [wvar]},
+        outputs={'Out': [out]},
+        attrs={'max_depth': max_depth})
+    if helper.bias_attr:
+        out = helper.append_bias_op(out, dim_start=3, dim_end=4)
+    return helper.append_activation(out)
+
+
+def merge_selected_rows(x, name=None):
+    """reference layers/nn.py:9146 merge_selected_rows wrapper."""
+    helper = LayerHelper('merge_selected_rows', name=name)
+    out = helper.create_variable_for_type_inference(x.dtype, shape=x.shape)
+    helper.append_op(type='merge_selected_rows', inputs={'X': [x]},
+                     outputs={'Out': [out]})
+    return out
+
+
+def get_tensor_from_selected_rows(x, name=None):
+    """reference layers/nn.py:9891 get_tensor_from_selected_rows wrapper."""
+    helper = LayerHelper('get_tensor_from_selected_rows', name=name)
+    out = helper.create_variable_for_type_inference(x.dtype, shape=x.shape)
+    helper.append_op(type='get_tensor_from_selected_rows',
+                     inputs={'X': [x]}, outputs={'Out': [out]})
     return out

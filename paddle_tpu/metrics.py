@@ -257,18 +257,25 @@ class DetectionMAP(MetricBase):
             score = float(detections[i, 1])
             box = detections[i, 2:6]
             cand = np.where(gt_labels == label)[0]
-            best, best_iou = -1, self.overlap_threshold
+            best = -1
             if len(cand):
                 ious = self._iou(box, gt_boxes[cand])
                 j = int(np.argmax(ious))
-                if ious[j] >= best_iou:
+                # strictly > like the reference
+                # (detection_map_op.h CalcTrueAndFalsePositive)
+                if ious[j] > self.overlap_threshold:
                     best = cand[j]
             preds = self._preds.setdefault(label, [])
-            if best >= 0 and not matched[best]:
-                matched[best] = True
+            if best >= 0:
                 if difficult[best] and not self.evaluate_difficult:
-                    continue     # difficult matches are ignored entirely
-                preds.append((score, 1))
+                    # the reference never marks difficult gts visited:
+                    # every match against one is ignored, including repeats
+                    continue
+                if not matched[best]:
+                    matched[best] = True
+                    preds.append((score, 1))
+                else:
+                    preds.append((score, 0))   # duplicate match = FP
             else:
                 preds.append((score, 0))
 

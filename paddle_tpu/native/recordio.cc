@@ -37,6 +37,13 @@ struct Writer {
 
   bool FlushChunk() {
     if (pending == 0) return true;
+    // the chunk header stores 32-bit lengths: a chunk larger than 4 GiB
+    // would silently truncate and corrupt the stream — refuse instead
+    // (writers should also flush on an accumulated-bytes threshold)
+    if (buf.size() > UINT32_MAX) {
+      error = "chunk exceeds 4 GiB (32-bit length field); flush more often";
+      return false;
+    }
     const std::string* payload = &buf;
     std::string comp;
     uint8_t flags = 0;
@@ -167,7 +174,11 @@ int recordio_writer_write(void* handle, const char* data, uint32_t len) {
   w->buf.append(reinterpret_cast<const char*>(&len), 4);
   w->buf.append(data, len);
   ++w->pending;
-  if (w->pending >= w->chunk_records) {
+  // flush on record count OR accumulated bytes: many large records must
+  // not accumulate past the 32-bit chunk length field (1 GiB threshold
+  // keeps chunks comfortably under the 4 GiB format limit)
+  if (w->pending >= w->chunk_records ||
+      w->buf.size() >= (1ull << 30)) {
     return w->FlushChunk() ? 0 : -1;
   }
   return 0;

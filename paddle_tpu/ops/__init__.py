@@ -23,3 +23,4 @@ from . import vision_ops
 from . import quant_ops
 from . import misc_ops
 from . import attention_ops
+from . import fused_ops

@@ -389,6 +389,10 @@ def flash_attention_spmd(q, k, v, mesh, scale=None, causal=True,
                               scale=scale, causal=causal,
                               batch_axis=data_ax, head_axis=model_ax)
     impl = _resolve_impl(use_pallas)
+    if impl == 'pallas' and ln % 128 and ln > 1024:
+        # same guard as flash_attention: no 128-multiple tile divides L,
+        # so the kernel would need one full-L VMEM tile per program
+        impl = 'ref'
     spec = P(data_ax, model_ax, None, None)
 
     def inner(ql, kl, vl):

@@ -1111,3 +1111,274 @@ def _generate_proposal_labels(ctx, op):
                  'BboxInsideWeights', 'BboxOutsideWeights'):
         if op.output(slot):
             ctx.set_lod(op.output(slot)[0], (uniform,))
+
+
+# ---------------------------------------------------------------------------
+# roi_perspective_transform — reference
+# operators/detection/roi_perspective_transform_op.cc
+# ---------------------------------------------------------------------------
+
+def _in_quad(x, y, qx, qy, eps=1e-4):
+    """Vectorized reference in_quad (op.cc:44-85): boundary test with eps
+    tolerance + even-odd crossing count. x/y: any shape; qx/qy: (4,)."""
+    on_edge = jnp.zeros(x.shape, bool)
+    n_cross = jnp.zeros(x.shape, jnp.int32)
+    for i in range(4):
+        xs, ys = qx[i], qy[i]
+        xe, ye = qx[(i + 1) % 4], qy[(i + 1) % 4]
+        horiz = jnp.abs(ys - ye) < eps
+        lo_x, hi_x = jnp.minimum(xs, xe), jnp.maximum(xs, xe)
+        lo_y, hi_y = jnp.minimum(ys, ye), jnp.maximum(ys, ye)
+        on_h = horiz & (jnp.abs(y - ys) < eps) & (x >= lo_x - eps) & \
+            (x <= hi_x + eps)
+        denom = jnp.where(horiz, 1.0, ye - ys)
+        ix = (y - ys) * (xe - xs) / denom + xs
+        on_e = (~horiz) & (jnp.abs(ix - x) < eps) & (y >= lo_y - eps) & \
+            (y <= hi_y + eps)
+        on_edge = on_edge | on_h | on_e
+        counted = (~horiz) & ~(y < lo_y + eps) & ~(y - hi_y > eps) & \
+            (ix - x > eps)
+        n_cross = n_cross + counted.astype(jnp.int32)
+    return on_edge | (n_cross % 2 == 1)
+
+
+def _perspective_matrix(qx, qy, tw, th):
+    """reference get_transform_matrix (op.cc:109-160): homography mapping
+    output (w, h) grid coords to input quad coords, with the normalized
+    width/height estimate."""
+    x0, x1, x2, x3 = qx[0], qx[1], qx[2], qx[3]
+    y0, y1, y2, y3 = qy[0], qy[1], qy[2], qy[3]
+    len1 = jnp.sqrt((x0 - x1) ** 2 + (y0 - y1) ** 2)
+    len2 = jnp.sqrt((x1 - x2) ** 2 + (y1 - y2) ** 2)
+    len3 = jnp.sqrt((x2 - x3) ** 2 + (y2 - y3) ** 2)
+    len4 = jnp.sqrt((x3 - x0) ** 2 + (y3 - y0) ** 2)
+    est_h = (len2 + len4) / 2.0
+    est_w = (len1 + len3) / 2.0
+    nh = float(th)
+    nw = jnp.minimum(jnp.round(est_w * (nh - 1) /
+                               jnp.maximum(est_h, 1e-6)) + 1, float(tw))
+    dx1, dx2, dx3 = x1 - x2, x3 - x2, x0 - x1 + x2 - x3
+    dy1, dy2, dy3 = y1 - y2, y3 - y2, y0 - y1 + y2 - y3
+    den = dx1 * dy2 - dx2 * dy1
+    den = jnp.where(jnp.abs(den) < 1e-12, 1e-12, den)
+    a31 = (dx3 * dy2 - dx2 * dy3) / den / (nw - 1)
+    a32 = (dx1 * dy3 - dx3 * dy1) / den / (nh - 1)
+    a21 = (y1 - y0 + a31 * (nw - 1) * y1) / (nw - 1)
+    a22 = (y3 - y0 + a32 * (nh - 1) * y3) / (nh - 1)
+    a11 = (x1 - x0 + a31 * (nw - 1) * x1) / (nw - 1)
+    a12 = (x3 - x0 + a32 * (nh - 1) * x3) / (nh - 1)
+    return jnp.stack([a11, a12, x0, a21, a22, y0, a31, a32,
+                      jnp.ones_like(a11)])
+
+
+def _bilinear_at(img, in_w, in_h):
+    """reference bilinear_interpolate (op.cc:183-236): img (C, H, W);
+    in_w/in_h (th, tw) source coords; zero outside [-0.5, dim-0.5]."""
+    c, h, w = img.shape
+    oob = (in_w < -0.5) | (in_w > w - 0.5) | (in_h < -0.5) | \
+        (in_h > h - 0.5)
+    iw = jnp.clip(in_w, 0.0, None)
+    ih = jnp.clip(in_h, 0.0, None)
+    wf = jnp.clip(jnp.floor(iw), 0, w - 1)
+    hf = jnp.clip(jnp.floor(ih), 0, h - 1)
+    iw = jnp.where(wf >= w - 1, float(w - 1), iw)
+    ih = jnp.where(hf >= h - 1, float(h - 1), ih)
+    wc = jnp.clip(wf + 1, 0, w - 1)
+    hc = jnp.clip(hf + 1, 0, h - 1)
+    w_fl = iw - wf
+    h_fl = ih - hf
+    wf_i, wc_i = wf.astype(jnp.int32), wc.astype(jnp.int32)
+    hf_i, hc_i = hf.astype(jnp.int32), hc.astype(jnp.int32)
+    v1 = img[:, hf_i, wf_i]
+    v2 = img[:, hc_i, wf_i]
+    v3 = img[:, hc_i, wc_i]
+    v4 = img[:, hf_i, wc_i]
+    val = ((1 - w_fl) * (1 - h_fl) * v1 + (1 - w_fl) * h_fl * v2 +
+           w_fl * h_fl * v3 + w_fl * (1 - h_fl) * v4)
+    return jnp.where(oob[None], 0.0, val)
+
+
+@register_op('roi_perspective_transform')
+def _roi_perspective_transform(ctx, op):
+    """reference operators/detection/roi_perspective_transform_op.cc:
+    ROIs (P, 8) quads [x1 y1 x2 y2 x3 y3 x4 y4] -> Out
+    (P, C, th, tw) via a per-roi perspective (homography) warp with
+    bilinear sampling; points outside the quad emit 0."""
+    x = ctx.in1(op, 'X')                        # (N, C, H, W)
+    rois = ctx.in1(op, 'ROIs')                  # LoD (P, 8)
+    th = int(op.attr('transformed_height', 1))
+    tw = int(op.attr('transformed_width', 1))
+    scale = float(op.attr('spatial_scale', 1.0))
+    lod = ctx.in1_lod(op, 'ROIs')
+    from ..core.lod import segment_ids
+    if lod:
+        img_ids = jnp.asarray(segment_ids(lod[-1]))
+    else:
+        img_ids = jnp.zeros((rois.shape[0],), jnp.int32)
+
+    qx = rois[:, 0::2] * scale                  # (P, 4)
+    qy = rois[:, 1::2] * scale
+    ow = jnp.arange(tw, dtype=x.dtype)
+    oh = jnp.arange(th, dtype=x.dtype)
+    grid_w, grid_h = jnp.meshgrid(ow, oh)       # (th, tw)
+
+    def one_roi(img, qxi, qyi):
+        m = _perspective_matrix(qxi, qyi, tw, th)
+        wdenom = m[6] * grid_w + m[7] * grid_h + m[8]
+        in_w = (m[0] * grid_w + m[1] * grid_h + m[2]) / wdenom
+        in_h = (m[3] * grid_w + m[4] * grid_h + m[5]) / wdenom
+        val = _bilinear_at(img, in_w, in_h)     # (C, th, tw)
+        inside = _in_quad(in_w, in_h, qxi, qyi)
+        return jnp.where(inside[None], val, 0.0)
+
+    imgs = jnp.take(x, img_ids, axis=0)         # (P, C, H, W)
+    out = jax.vmap(one_roi)(imgs, qx, qy)
+    ctx.out(op, 'Out', out.astype(x.dtype))
+    if op.output('Out'):
+        ctx.set_lod(op.output('Out')[0], lod)
+
+
+# ---------------------------------------------------------------------------
+# generate_mask_labels — reference
+# operators/detection/generate_mask_labels_op.cc (Mask-RCNN mask targets)
+# ---------------------------------------------------------------------------
+
+def _poly_mask(points, box, resolution):
+    """Rasterize one polygon (V, 2) into an (M, M) {0,1} mask w.r.t. `box`
+    [x1 y1 x2 y2] — the capability of reference mask_util.cc Polys2MaskWrtBox
+    (COCO RLE rasterization approximated by pixel-center point-in-polygon,
+    even-odd rule)."""
+    m = resolution
+    w = jnp.maximum(box[2] - box[0], 1e-6)
+    h = jnp.maximum(box[3] - box[1], 1e-6)
+    px = (points[:, 0] - box[0]) * m / w        # (V,)
+    py = (points[:, 1] - box[1]) * m / h
+    gx = jnp.arange(m, dtype=jnp.float32) + 0.5
+    gy = jnp.arange(m, dtype=jnp.float32) + 0.5
+    gw, gh = jnp.meshgrid(gx, gy)               # (M, M)
+    v = points.shape[0]
+    inside = jnp.zeros((m, m), jnp.int32)
+    for i in range(v):
+        xs, ys = px[i], py[i]
+        xe, ye = px[(i + 1) % v], py[(i + 1) % v]
+        cond = ((ys > gh) != (ye > gh))
+        ix = (gh - ys) * (xe - xs) / jnp.where(
+            jnp.abs(ye - ys) < 1e-9, 1e-9, ye - ys) + xs
+        inside = inside + (cond & (gw < ix)).astype(jnp.int32)
+    return (inside % 2 == 1)
+
+
+@register_op('generate_mask_labels')
+def _generate_mask_labels(ctx, op):
+    """reference operators/detection/generate_mask_labels_op.cc
+    (SampleMaskForOneImage): for each sampled roi with a fg label, pick the
+    gt segmentation whose polygon bounding box overlaps it most, rasterize
+    the polygons into a resolution x resolution binary mask in roi
+    coordinates, and expand to per-class targets (-1 = ignore).
+
+    TPU deviation (static shapes, same policy as generate_proposal_labels):
+    a mask-target row is emitted for EVERY input roi — bg rois carry class
+    0 with an all -1 (ignore) target, which is exactly how the reference
+    encodes maskless rows (op.cc:226-251 bg path + ExpandMaskTarget
+    cls==0). RoiHasMaskInt32 is therefore the identity row map."""
+    im_info = ctx.in1(op, 'ImInfo')             # (N, 3)
+    gt_classes = ctx.in1(op, 'GtClasses')       # LoD (G, 1) int32
+    is_crowd = ctx.in1(op, 'IsCrowd')           # LoD (G, 1) int32
+    gt_segms = ctx.in1(op, 'GtSegms')           # LoD-3 (S, 2)
+    rois = ctx.in1(op, 'Rois')                  # LoD (R, 4)
+    labels = ctx.in1(op, 'LabelsInt32')         # LoD (R, 1) int32
+    num_classes = int(op.attr('num_classes'))
+    resolution = int(op.attr('resolution'))
+
+    gt_lod = ctx.in1_lod(op, 'GtClasses')
+    segm_lod = ctx.in1_lod(op, 'GtSegms')
+    roi_lod = ctx.in1_lod(op, 'Rois')
+    if not (gt_lod and segm_lod and len(segm_lod) >= 2 and roi_lod):
+        raise ValueError("generate_mask_labels needs LoD GtClasses/"
+                         "GtSegms(level>=2)/Rois")
+    goff = gt_lod[-1]
+    roff = roi_lod[-1]
+    poly_off = segm_lod[-2]     # per-gt polygon boundaries
+    vert_off = segm_lod[-1]     # per-polygon vertex boundaries
+    n_img = len(goff) - 1
+    msq = resolution * resolution
+
+    out_rows = []
+    for im in range(n_img):
+        scale = im_info[im, 2]
+        g_lo, g_hi = goff[im], goff[im + 1]
+        r_lo, r_hi = roff[im], roff[im + 1]
+        n_gt = g_hi - g_lo
+        n_roi = r_hi - r_lo
+        if n_roi == 0:
+            continue
+        im_rois = rois[r_lo:r_hi] / scale       # (Ri, 4)
+        im_labels = labels[r_lo:r_hi].reshape(-1)
+
+        gt_masks, gt_boxes, gt_valid = [], [], []
+        for g in range(g_lo, g_hi):
+            p_lo, p_hi = poly_off[g], poly_off[g + 1]
+            pts_all = []
+            mask = jnp.zeros((resolution, resolution), bool)
+            box_pts = []
+            for p in range(p_lo, p_hi):
+                v_lo, v_hi = vert_off[p], vert_off[p + 1]
+                pts = gt_segms[v_lo:v_hi]       # (V, 2)
+                box_pts.append(pts)
+            if box_pts:
+                allpts = jnp.concatenate(box_pts, axis=0)
+                box = jnp.stack([allpts[:, 0].min(), allpts[:, 1].min(),
+                                 allpts[:, 0].max(), allpts[:, 1].max()])
+            else:
+                box = jnp.zeros((4,), jnp.float32)
+            for pts in box_pts:
+                mask = mask | _poly_mask(pts, box, resolution)
+            gt_masks.append(mask)
+            gt_boxes.append(box)
+            gt_valid.append((gt_classes[g, 0] > 0) &
+                            (is_crowd[g, 0] == 0))
+        if gt_masks:
+            gm = jnp.stack(gt_masks)            # (Gi, M, M)
+            gb = jnp.stack(gt_boxes)            # (Gi, 4)
+            gv = jnp.stack(gt_valid)            # (Gi,)
+            iou = _iou_matrix(im_rois, gb)      # (Ri, Gi)
+            iou = jnp.where(gv[None, :], iou, -1.0)
+            best = jnp.argmax(iou, axis=1)      # (Ri,)
+            roi_masks = jnp.take(gm, best, axis=0)  # (Ri, M, M)
+            # rasterize w.r.t. the roi box, resampled from the gt-box mask:
+            # sample grid of the roi in gt-box mask coords
+            best_box = jnp.take(gb, best, axis=0)   # (Ri, 4)
+
+            def resample(mask, gtb, roib):
+                gw = jnp.maximum(gtb[2] - gtb[0], 1e-6)
+                gh = jnp.maximum(gtb[3] - gtb[1], 1e-6)
+                xs = (roib[0] + (roib[2] - roib[0]) *
+                      (jnp.arange(resolution) + 0.5) / resolution)
+                ys = (roib[1] + (roib[3] - roib[1]) *
+                      (jnp.arange(resolution) + 0.5) / resolution)
+                cx = jnp.clip(((xs - gtb[0]) * resolution / gw).astype(
+                    jnp.int32), 0, resolution - 1)
+                cy = jnp.clip(((ys - gtb[1]) * resolution / gh).astype(
+                    jnp.int32), 0, resolution - 1)
+                inx = ((xs >= gtb[0]) & (xs <= gtb[2]))[None, :]
+                iny = ((ys >= gtb[1]) & (ys <= gtb[3]))[:, None]
+                samp = mask[cy][:, cx]
+                return samp & inx & iny
+            roi_masks = jax.vmap(resample)(roi_masks, best_box, im_rois)
+        else:
+            roi_masks = jnp.zeros((n_roi, resolution, resolution), bool)
+        fg = im_labels > 0
+        flat = roi_masks.reshape(n_roi, msq).astype(jnp.int32)
+        oh = jax.nn.one_hot(im_labels, num_classes,
+                            dtype=jnp.int32)    # (Ri, K)
+        expanded = jnp.where((oh[:, :, None] > 0) & fg[:, None, None],
+                             flat[:, None, :], -1)
+        out_rows.append(expanded.reshape(n_roi, num_classes * msq))
+    mask_int32 = jnp.concatenate(out_rows, axis=0)
+    ctx.out(op, 'MaskRois', rois)
+    ctx.out(op, 'RoiHasMaskInt32',
+            jnp.arange(rois.shape[0], dtype=jnp.int32)[:, None])
+    ctx.out(op, 'MaskInt32', mask_int32)
+    for slot in ('MaskRois', 'RoiHasMaskInt32', 'MaskInt32'):
+        if op.output(slot):
+            ctx.set_lod(op.output(slot)[0], (roi_lod[-1],))

@@ -1,0 +1,467 @@
+"""Fused-op surface (reference operators/fused/) + file IO ops.
+
+The reference ships hand-fused CPU/CUDA kernels for these; on TPU the
+whole program compiles through XLA, which performs the same fusions
+automatically, so each op lowers to its unfused composition — the op
+SURFACE is kept (programs built by the reference's fuse passes or user
+code execute correctly), while the fusion itself is the compiler's job
+(SURVEY §2.2). Each lowering cites the reference op it matches and is
+tested against a composition of our own unfused ops.
+
+Also here: save/load/save_combine/load_combine (reference save_op.cc:36,
+load_op, save_combine_op, load_combine_op) — save streams device values to
+host .npz via ordered io_callback inside the compiled step; load binds the
+file contents at trace time (static weights); and rnn_memory_helper
+(identity with gradient, reference rnn_memory_helper_op.cc).
+"""
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op
+from ..core.lod import segment_ids, lengths_from_offsets, context_maps
+from .rnn_ops import _padded_maps, _to_padded, _to_ragged, _act
+
+
+def _ew(name, x, y, axis=-1):
+    """Broadcast like our elementwise ops: y reshaped to x rank at axis."""
+    if y.ndim < x.ndim:
+        if axis < 0:
+            axis = x.ndim - y.ndim
+        shape = [1] * x.ndim
+        for i, d in enumerate(y.shape):
+            shape[axis + i] = d
+        y = y.reshape(shape)
+    if name == 'elementwise_add':
+        return x + y
+    if name == 'elementwise_mul':
+        return x * y
+    if name == 'elementwise_sub':
+        return x - y
+    raise NotImplementedError("fused_elemwise binary functor %r" % name)
+
+
+_UNARY = {'relu': jax.nn.relu, 'tanh': jnp.tanh,
+          'sigmoid': jax.nn.sigmoid}
+
+
+@register_op('fused_elemwise_activation')
+def _fused_elemwise_activation(ctx, op):
+    """reference fused/fused_elemwise_activation_op.cc: functor_list of
+    two; unary-compound = unary(binary(x, y)), binary-compound =
+    binary(x, unary(y)). `scale` attr parameterizes the scale functor."""
+    x = ctx.in1(op, 'X')
+    y = ctx.in1(op, 'Y')
+    functors = [str(f) for f in op.attr('functor_list')]
+    axis = int(op.attr('axis', -1))
+    scale = float(op.attr('scale', 0.0))
+    if len(functors) != 2:
+        raise ValueError("functor_list must have exactly 2 entries")
+
+    def unary(name, v):
+        if name == 'scale':
+            return v * scale
+        if name in _UNARY:
+            return _UNARY[name](v)
+        raise NotImplementedError(
+            "fused_elemwise unary functor %r" % name)
+
+    if functors[1].startswith('elementwise_'):
+        # unary(binary(x, y)) — unary compound
+        inter = _ew(functors[1], x, y, axis)
+        out = unary(functors[0], inter)
+    else:
+        # binary(x, unary(y))
+        inter = unary(functors[1], y)
+        out = _ew(functors[0], x, inter, axis)
+    ctx.out(op, 'Out', out)
+    ctx.out(op, 'IntermediateOut', inter)
+
+
+def _fusion_lstm_core(ctx, op, xx, lod):
+    """Shared LSTM tail for fusion_lstm / fused_embedding_fc_lstm (gate
+    order [c, i, f, o]: fusion_lstm_op.cc:134 'Weight = {W_cx, W_ix,
+    W_fx, W_ox}')."""
+    wh = ctx.in1(op, 'WeightH')                 # (D, 4D)
+    bias = ctx.in1(op, 'Bias')
+    d = wh.shape[0]
+    use_peepholes = bool(op.attr('use_peepholes', False))
+    reverse = bool(op.attr('is_reverse', False))
+    act_gate = _act(op.attr('gate_activation', 'sigmoid'))
+    act_cell = _act(op.attr('cell_activation', 'tanh'))
+    act_cand = _act(op.attr('candidate_activation', 'tanh'))
+    offsets = lod[-1]
+    gidx, sidx, n, maxt = _padded_maps(offsets, reverse=reverse)
+    xp = _to_padded(xx, gidx, n, maxt)          # (N, maxT, 4D)
+    b = bias.reshape(-1)
+    b_gates = b[:4 * d]
+    if use_peepholes:
+        w_ic, w_fc, w_oc = (b[4 * d:5 * d], b[5 * d:6 * d],
+                            b[6 * d:7 * d])
+    else:
+        w_ic = w_fc = w_oc = jnp.zeros((d,), xx.dtype)
+    h0 = ctx.in1(op, 'H0')
+    c0 = ctx.in1(op, 'C0')
+    h_init = h0.astype(xx.dtype) if h0 is not None else \
+        jnp.zeros((n, d), xx.dtype)
+    c_init = c0.astype(xx.dtype) if c0 is not None else \
+        jnp.zeros((n, d), xx.dtype)
+
+    def step(carry, xt):
+        h_prev, c_prev = carry
+        g = xt + b_gates + h_prev @ wh
+        cand = act_cand(g[:, :d])
+        i = act_gate(g[:, d:2 * d] + c_prev * w_ic)
+        f = act_gate(g[:, 2 * d:3 * d] + c_prev * w_fc)
+        c = cand * i + c_prev * f
+        o = act_gate(g[:, 3 * d:] + c * w_oc)
+        h = o * act_cell(c)
+        return (h, c), (h, c)
+
+    _, (hs, cs) = lax.scan(step, (h_init, c_init), xp.transpose(1, 0, 2))
+    hidden = _to_ragged(hs.transpose(1, 0, 2), sidx)
+    cell = _to_ragged(cs.transpose(1, 0, 2), sidx)
+    ctx.out(op, 'Hidden', hidden)
+    ctx.out(op, 'Cell', cell)
+    for slot in ('Hidden', 'Cell'):
+        if op.output(slot):
+            ctx.set_lod(op.output(slot)[0], lod)
+
+
+@register_op('fusion_lstm')
+def _fusion_lstm(ctx, op):
+    """reference fused/fusion_lstm_op.cc: x-projection fused into the
+    recurrence; XX = X @ WeightX."""
+    x = ctx.in1(op, 'X')                        # LoD (T, M)
+    wx = ctx.in1(op, 'WeightX')                 # (M, 4D)
+    lod = ctx.in1_lod(op, 'X')
+    if not lod:
+        raise ValueError("fusion_lstm requires LoD X")
+    xx = x @ wx
+    ctx.out(op, 'XX', xx)
+    _fusion_lstm_core(ctx, op, xx, lod)
+
+
+@register_op('fused_embedding_fc_lstm')
+def _fused_embedding_fc_lstm(ctx, op):
+    """reference fused/fused_embedding_fc_lstm_op.cc: the embedding table
+    stores pre-projected gate inputs (V, 4D); lookup replaces the fc."""
+    ids = ctx.in1(op, 'Ids')                    # LoD (T, 1) int64
+    emb = ctx.in1(op, 'Embeddings')             # (V, 4D)
+    lod = ctx.in1_lod(op, 'Ids')
+    if not lod:
+        raise ValueError("fused_embedding_fc_lstm requires LoD Ids")
+    xx = jnp.take(emb, ids.reshape(-1).astype(jnp.int32), axis=0)
+    ctx.out(op, 'XX', xx)
+    _fusion_lstm_core(ctx, op, xx, lod)
+
+
+@register_op('fusion_gru')
+def _fusion_gru(ctx, op):
+    """reference fused/fusion_gru_op.cc: gru with the x-projection fused;
+    gate layout [u, r | c] like gru_op."""
+    x = ctx.in1(op, 'X')                        # LoD (T, M)
+    wx = ctx.in1(op, 'WeightX')                 # (M, 3D)
+    wh = ctx.in1(op, 'WeightH')                 # (D, 3D)
+    bias = ctx.in1(op, 'Bias')
+    lod = ctx.in1_lod(op, 'X')
+    if not lod:
+        raise ValueError("fusion_gru requires LoD X")
+    d = wh.shape[0]
+    b = bias.reshape(-1) if bias is not None else jnp.zeros((3 * d,),
+                                                            x.dtype)
+    reverse = bool(op.attr('is_reverse', False))
+    origin_mode = bool(op.attr('origin_mode', False))
+    act_gate = _act(op.attr('gate_activation', 'sigmoid'))
+    act_node = _act(op.attr('activation', 'tanh'))
+    xx = x @ wx
+    ctx.out(op, 'XX', xx)
+    offsets = lod[-1]
+    gidx, sidx, n, maxt = _padded_maps(offsets, reverse=reverse)
+    xp = _to_padded(xx, gidx, n, maxt)
+    w_ur, w_c = wh[:, :2 * d], wh[:, 2 * d:]
+    h0 = ctx.in1(op, 'H0')
+    h_init = h0.astype(x.dtype) if h0 is not None else \
+        jnp.zeros((n, d), x.dtype)
+
+    def step(h_prev, xt):
+        xur = xt[:, :2 * d] + b[:2 * d]
+        xc = xt[:, 2 * d:] + b[2 * d:]
+        ur = act_gate(xur + h_prev @ w_ur)
+        u, r = ur[:, :d], ur[:, d:]
+        c = act_node(xc + (r * h_prev) @ w_c)
+        h = u * h_prev + (1.0 - u) * c if origin_mode \
+            else (1.0 - u) * h_prev + u * c
+        return h, h
+
+    _, hs = lax.scan(step, h_init, xp.transpose(1, 0, 2))
+    hidden = _to_ragged(hs.transpose(1, 0, 2), sidx)
+    ctx.out(op, 'Hidden', hidden)
+    if op.output('Hidden'):
+        ctx.set_lod(op.output('Hidden')[0], lod)
+
+
+@register_op('fusion_repeated_fc_relu')
+def _fusion_repeated_fc_relu(ctx, op):
+    """reference fused/fusion_repeated_fc_relu_op.cc: stacked
+    relu(x @ W + b)."""
+    x = ctx.in1(op, 'X')
+    ws = ctx.in_list(op, 'W')
+    bs = ctx.in_list(op, 'Bias')
+    cur = x
+    for w, b in zip(ws, bs):
+        cur = jax.nn.relu(cur @ w + b.reshape(-1))
+    ctx.out(op, 'Out', cur)
+    if op.output('Out'):
+        ctx.set_lod(op.output('Out')[0], ctx.in1_lod(op, 'X'))
+
+
+@register_op('fusion_seqconv_eltadd_relu')
+def _fusion_seqconv_eltadd_relu(ctx, op):
+    """reference fused/fusion_seqconv_eltadd_relu_op.cc:
+    relu(sequence_conv(x) + bias)."""
+    x = ctx.in1(op, 'X')                        # LoD (T, M)
+    filt = ctx.in1(op, 'Filter')                # (ctx_len*M, out)
+    bias = ctx.in1(op, 'Bias')
+    lod = ctx.in1_lod(op, 'X')
+    if not lod:
+        raise ValueError("fusion_seqconv_eltadd_relu requires LoD X")
+    ctx_len = int(op.attr('contextLength'))
+    ctx_start = int(op.attr('contextStart', 0))
+    t, m = x.shape
+    idx, valid = context_maps(lod[-1], ctx_len, ctx_start)
+    mat = jnp.take(x, jnp.asarray(idx.reshape(-1)), axis=0).reshape(
+        t, ctx_len, m)
+    mat = mat * jnp.asarray(valid)[:, :, None].astype(x.dtype)
+    col = mat.reshape(t, ctx_len * m)
+    ctx.out(op, 'ColMat', col)
+    out = jax.nn.relu(col @ filt + bias.reshape(-1))
+    ctx.out(op, 'Out', out)
+    if op.output('Out'):
+        ctx.set_lod(op.output('Out')[0], lod)
+
+
+@register_op('fusion_seqexpand_concat_fc')
+def _fusion_seqexpand_concat_fc(ctx, op):
+    """reference fused/fusion_seqexpand_concat_fc_op.cc: first input is a
+    (T, M0) LoD sequence; the rest are per-sequence (N, Mi) rows expanded
+    along it; concat features then fc (+activation)."""
+    xs = ctx.in_list(op, 'X')
+    w = ctx.in1(op, 'FCWeight')
+    b = ctx.in1(op, 'FCBias')
+    act = _act(op.attr('fc_activation', 'identity'))
+    lod = ctx.in1_lod(op, 'X')
+    if not lod:
+        raise ValueError("fusion_seqexpand_concat_fc requires LoD X[0]")
+    seg = jnp.asarray(segment_ids(lod[-1]))
+    parts = [xs[0]] + [jnp.take(xi, seg, axis=0) for xi in xs[1:]]
+    cat = jnp.concatenate(parts, axis=1)
+    out = cat @ w
+    if b is not None:
+        out = out + b.reshape(-1)
+    out = act(out)
+    ctx.out(op, 'Out', out)
+    if op.output('Out'):
+        ctx.set_lod(op.output('Out')[0], lod)
+
+
+@register_op('fusion_seqpool_concat')
+def _fusion_seqpool_concat(ctx, op):
+    """reference fused/fusion_seqpool_concat_op.cc: sequence_pool each
+    LoD input (SUM/AVERAGE/SQRT) then concat along axis 1."""
+    names = op.input('X')
+    pooltype = str(op.attr('pooltype', 'SUM')).upper()
+    pooled = []
+    for name in names:
+        x = ctx.get(name)
+        lod = ctx.lod_of(name)
+        if not lod:
+            raise ValueError("fusion_seqpool_concat input %r needs LoD"
+                             % name)
+        offsets = lod[-1]
+        n = len(offsets) - 1
+        seg = jnp.asarray(segment_ids(offsets))
+        s = jax.ops.segment_sum(x, seg, num_segments=n)
+        lens = jnp.asarray(
+            np.asarray(lengths_from_offsets(offsets), np.float32))
+        if pooltype == 'AVERAGE':
+            s = s / jnp.maximum(lens, 1.0)[:, None]
+        elif pooltype == 'SQRT':
+            s = s / jnp.sqrt(jnp.maximum(lens, 1.0))[:, None]
+        elif pooltype != 'SUM':
+            raise NotImplementedError(
+                "fusion_seqpool_concat pooltype %r" % pooltype)
+        pooled.append(s)
+    ctx.out(op, 'Out', jnp.concatenate(pooled, axis=1))
+    if op.output('Out'):
+        ctx.set_lod(op.output('Out')[0], ())
+
+
+@register_op('fusion_squared_mat_sub')
+def _fusion_squared_mat_sub(ctx, op):
+    """reference fused/fusion_squared_mat_sub_op.cc:
+    Out = scalar * ((X@Y)^2 - (X^2)@(Y^2))."""
+    x = ctx.in1(op, 'X')
+    y = ctx.in1(op, 'Y')
+    scalar = float(op.attr('scalar', 1.0))
+    xy = x @ y
+    out = scalar * (xy * xy - (x * x) @ (y * y))
+    ctx.out(op, 'SquaredX', x * x)
+    ctx.out(op, 'SquaredY', y * y)
+    ctx.out(op, 'SquaredXY', xy * xy)
+    ctx.out(op, 'Out', out)
+
+
+@register_op('fusion_transpose_flatten_concat')
+def _fusion_transpose_flatten_concat(ctx, op):
+    """reference fused/fusion_transpose_flatten_concat_op.cc: per input
+    transpose(trans_axis) -> flatten(flatten_axis) -> concat(concat_axis)."""
+    xs = ctx.in_list(op, 'X')
+    trans = [int(a) for a in op.attr('trans_axis')]
+    flat_axis = int(op.attr('flatten_axis'))
+    concat_axis = int(op.attr('concat_axis'))
+    outs = []
+    for x in xs:
+        t = jnp.transpose(x, trans)
+        lead = int(np.prod(t.shape[:flat_axis])) if flat_axis else 1
+        outs.append(t.reshape(lead, -1))
+    ctx.out(op, 'Out', jnp.concatenate(outs, axis=concat_axis))
+
+
+# ---------------------------------------------------------------------------
+# file IO ops — reference save_op.cc:36 / load_op.cc / *_combine variants
+# ---------------------------------------------------------------------------
+
+def _save_cb(path, overwrite):
+    def cb(*arrays):
+        if os.path.exists(path) and not overwrite:
+            raise RuntimeError("save op: %r exists and overwrite=False"
+                               % path)
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        np.savez(path, *[np.asarray(a) for a in arrays])
+        return np.zeros((), np.int32)
+    return cb
+
+
+def _io_callback(cb, args):
+    try:
+        return jax.experimental.io_callback(
+            cb, jax.ShapeDtypeStruct((), jnp.int32), *args, ordered=True)
+    except (AttributeError, ImportError):
+        return jax.pure_callback(cb, jax.ShapeDtypeStruct((), jnp.int32),
+                                 *args)
+
+
+@register_op('save', stateful=True)
+def _save(ctx, op):
+    """reference save_op.cc:36: serialize one variable to file_path. The
+    write happens via ordered io_callback inside the compiled step."""
+    x = ctx.in1(op, 'X')
+    path = str(op.attr('file_path'))
+    overwrite = bool(op.attr('overwrite', True))
+    _io_callback(_save_cb(path, overwrite), [x])
+
+
+@register_op('save_combine', stateful=True)
+def _save_combine(ctx, op):
+    """reference save_combine_op.cc: many variables, one file."""
+    xs = ctx.in_list(op, 'X')
+    path = str(op.attr('file_path'))
+    overwrite = bool(op.attr('overwrite', True))
+    _io_callback(_save_cb(path, overwrite), xs)
+
+
+def _npz_arrays(path):
+    if not os.path.exists(path) and os.path.exists(path + '.npz'):
+        path = path + '.npz'
+    with np.load(path) as z:
+        return [z['arr_%d' % i] for i in range(len(z.files))]
+
+
+@register_op('load')
+def _load(ctx, op):
+    """reference load_op.cc: read file_path into the output variable. The
+    file binds at program-compile time (weights are compile-time constants
+    to XLA, like the inference-engine param load, inference/io.cc)."""
+    arrays = _npz_arrays(str(op.attr('file_path')))
+    ctx.out(op, 'Out', jnp.asarray(arrays[0]))
+
+
+@register_op('load_combine')
+def _load_combine(ctx, op):
+    """reference load_combine_op.cc: one file, many output variables."""
+    arrays = _npz_arrays(str(op.attr('file_path')))
+    names = op.output('Out')
+    if len(arrays) < len(names):
+        raise ValueError("load_combine: file has %d arrays, program wants "
+                         "%d outputs" % (len(arrays), len(names)))
+    for i, name in enumerate(names):
+        ctx.set(name, jnp.asarray(arrays[i]))
+
+
+@register_op('rnn_memory_helper')
+def _rnn_memory_helper(ctx, op):
+    """reference rnn_memory_helper_op.cc: identity used by the recurrent
+    machinery to materialize a step's memory (gradient = identity)."""
+    ctx.out(op, 'Out', ctx.in1(op, 'X'))
+
+
+@register_op('detection_map')
+def _detection_map(ctx, op):
+    """reference operators/detection_map_op.cc — single-batch mAP (the
+    class_pos_count/true_pos/false_pos accumulation states are served by
+    metrics.DetectionMAP, which owns the cross-batch bookkeeping in this
+    design; feeding input states here raises). Computed host-side through
+    pure_callback on the shared numpy evaluator (it is a metric: no
+    gradient, data-dependent control flow)."""
+    det = ctx.in1(op, 'DetectRes')          # LoD (M, 6) [label,score,4box]
+    label = ctx.in1(op, 'Label')            # LoD (N, 6) or (N, 5)
+    if op.input('PosCount') or op.input('TruePos') or op.input('FalsePos'):
+        raise NotImplementedError(
+            "detection_map input accumulation states: use "
+            "metrics.DetectionMAP for cross-batch accumulation")
+    det_lod = ctx.in1_lod(op, 'DetectRes')
+    lab_lod = ctx.in1_lod(op, 'Label')
+    if not (det_lod and lab_lod):
+        raise ValueError("detection_map requires LoD DetectRes and Label")
+    overlap = float(op.attr('overlap_threshold', 0.5))
+    evaluate_difficult = bool(op.attr('evaluate_difficult', True))
+    ap_type = str(op.attr('ap_type', 'integral'))
+    class_num = int(op.attr('class_num', 0) or 0)
+    d_off, l_off = det_lod[-1], lab_lod[-1]
+
+    def compute(det_np, lab_np):
+        from ..metrics import DetectionMAP
+        det_np = np.asarray(det_np)
+        lab_np = np.asarray(lab_np)
+        ncls = class_num or int(max(det_np[:, 0].max(initial=0),
+                                    lab_np[:, 0].max(initial=0))) + 1
+        m = DetectionMAP(class_num=ncls, overlap_threshold=overlap,
+                         evaluate_difficult=evaluate_difficult,
+                         ap_version=('11point' if ap_type == '11point'
+                                     else 'integral'))
+        for i in range(len(d_off) - 1):
+            det_i = det_np[d_off[i]:d_off[i + 1]]
+            lab_i = lab_np[l_off[i]:l_off[i + 1]]
+            if lab_i.shape[1] == 6:
+                boxes = lab_i[:, 2:6]
+                labels = lab_i[:, 0].astype(np.int64)
+                difficult = lab_i[:, 1] > 0
+            else:
+                boxes = lab_i[:, 1:5]
+                labels = lab_i[:, 0].astype(np.int64)
+                difficult = None
+            m.update(det_i, boxes, labels, difficult)
+        return np.float32(m.eval())
+
+    out = jax.pure_callback(
+        compute, jax.ShapeDtypeStruct((), jnp.float32), det, label)
+    ctx.out(op, 'MAP', out.reshape(1))
+    ctx.out(op, 'AccumPosCount', jnp.zeros((0, 1), jnp.int32))
+    ctx.out(op, 'AccumTruePos', jnp.zeros((0, 2), jnp.float32))
+    ctx.out(op, 'AccumFalsePos', jnp.zeros((0, 2), jnp.float32))

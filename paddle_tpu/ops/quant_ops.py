@@ -103,3 +103,28 @@ def _fake_channel_wise_quantize_abs_max(ctx, op):
     out = _round_ste(x / scale.reshape(bshape) * bin_cnt)
     ctx.out(op, 'Out', out)
     ctx.out(op, 'OutScale', scale)
+
+
+@register_op('quantize')
+def _quantize(ctx, op):
+    """reference operators/quantize_op.cc (mkldnn int8 inference path):
+    Output = round(Input * Scale) as int8 (is_negative_input=True) or
+    uint8."""
+    x = ctx.in1(op, 'Input')
+    scale = float(op.attr('Scale', 1.0))
+    neg = bool(op.attr('is_negative_input', False))
+    q = jnp.round(x.astype(jnp.float32) * scale)
+    if neg:
+        out = jnp.clip(q, -128, 127).astype(jnp.int8)
+    else:
+        out = jnp.clip(q, 0, 255).astype(jnp.uint8)
+    ctx.out(op, 'Output', out)
+
+
+@register_op('dequantize')
+def _dequantize(ctx, op):
+    """reference operators/dequantize_op.cc: Output = Input / Scale as
+    float32."""
+    x = ctx.in1(op, 'Input')
+    scale = float(op.attr('Scale', 1.0))
+    ctx.out(op, 'Output', x.astype(jnp.float32) / scale)

@@ -305,3 +305,175 @@ def _row_conv(ctx, op):
     out = (gathered * filt[None, :, :]).sum(axis=1)
     ctx.out(op, 'Out', out)
     ctx.set_lod(op.output('Out')[0], lod)
+
+
+# ---------------------------------------------------------------------------
+# attention_lstm — reference attention_lstm_op.cc
+# ---------------------------------------------------------------------------
+
+@register_op('attention_lstm')
+def _attention_lstm(ctx, op):
+    """reference operators/attention_lstm_op.cc:211-227 (doc) and the CPU
+    kernel :335-404: per step, attention over the WHOLE sequence scored by
+    fc([x, expand(c_{t-1})]) -> relu -> optional scalar fc -> relu ->
+    softmax; the attended sum-pooled x drives one LSTM step with gate
+    order [forget, input, output, candidate] (kernel :380-396).
+
+    Batched TPU formulation: sequences padded to (N, maxT), softmax masked
+    to valid rows; one lax.scan instead of the reference's per-sequence
+    per-step BLAS loop."""
+    x = ctx.in1(op, 'X')                       # LoD (T, M)
+    c0 = ctx.in1(op, 'C0')                     # (N, D)
+    h0 = ctx.in1(op, 'H0')
+    atten_w = ctx.in1(op, 'AttentionWeight')   # (M+D, 1)
+    atten_b = ctx.in1(op, 'AttentionBias')     # (1, 1) optional
+    atten_s = ctx.in1(op, 'AttentionScalar')   # (1, 1) optional
+    atten_sb = ctx.in1(op, 'AttentionScalarBias')
+    lstm_w = ctx.in1(op, 'LSTMWeight')         # (D+M, 4D) [h-part; x-part]
+    lstm_b = ctx.in1(op, 'LSTMBias')           # (1, 4D)
+    act_gate = _act(op.attr('gate_activation', 'sigmoid'))
+    act_cell = _act(op.attr('cell_activation', 'tanh'))
+    act_cand = _act(op.attr('candidate_activation', 'tanh'))
+
+    lod, offsets = _lod_offsets(ctx, op, 'X')
+    m = x.shape[1]
+    d = lstm_w.shape[1] // 4
+    gidx, sidx, n, maxt = _padded_maps(offsets)
+    lens = jnp.asarray(lengths_from_offsets(offsets))
+    mask = jnp.arange(maxt)[None, :] < lens[:, None]        # (N, maxT)
+
+    # x(TxM) * atten_w[:M] part, shared across steps (kernel :336-338)
+    atted_x = x @ atten_w[:m] + (atten_b.reshape(()) if atten_b is not None
+                                 else 0.0)                  # (T, 1)
+    xp = _to_padded(x, gidx, n, maxt)                       # (N, maxT, M)
+    axp = _to_padded(atted_x, gidx, n, maxt)[..., 0]        # (N, maxT)
+
+    w_h = lstm_w[:d]                                        # (D, 4D)
+    w_x = lstm_w[d:]                                        # (M, 4D)
+    b = lstm_b.reshape(-1)
+    h_init = h0.astype(x.dtype) if h0 is not None else \
+        jnp.zeros((n, d), x.dtype)
+    c_init = c0.astype(x.dtype)
+
+    def step(carry, t):
+        h_prev, c_prev = carry
+        cell_bias = c_prev @ atten_w[m:]                    # (N, 1)
+        e = jax.nn.relu(axp + cell_bias)                    # (N, maxT)
+        if atten_s is not None:
+            e = e * atten_s.reshape(())
+            e = jax.nn.relu(e + (atten_sb.reshape(())
+                                 if atten_sb is not None else 0.0))
+        e = jnp.where(mask, e, -1e30)
+        p = jax.nn.softmax(e, axis=1)
+        lstm_x = jnp.einsum('nt,ntm->nm', p, xp)            # (N, M)
+        g = lstm_x @ w_x + h_prev @ w_h + b                 # (N, 4D)
+        f = act_gate(g[:, :d])
+        i = act_gate(g[:, d:2 * d])
+        o = act_gate(g[:, 2 * d:3 * d])
+        cand = act_cand(g[:, 3 * d:])
+        c_new = f * c_prev + i * cand
+        h_new = act_cell(c_new) * o
+        active = mask[:, t][:, None]
+        h = jnp.where(active, h_new, h_prev)
+        c = jnp.where(active, c_new, c_prev)
+        return (h, c), (h, c, p, lstm_x, g)
+
+    (_, _), (hs, cs, ps, lxs, gs) = lax.scan(
+        step, (h_init, c_init), jnp.arange(maxt))
+    hs = hs.transpose(1, 0, 2)                              # (N, maxT, D)
+    cs = cs.transpose(1, 0, 2)
+    ctx.out(op, 'Hidden', _to_ragged(hs, sidx))
+    ctx.out(op, 'Cell', _to_ragged(cs, sidx))
+    for slot in ('Hidden', 'Cell'):
+        if op.output(slot):
+            ctx.set_lod(op.output(slot)[0], lod)
+    ctx.out(op, 'AttentionedX', atted_x)
+    # workspace outputs hold their values after the final step of the last
+    # sequence, like the reference's reused scratch buffers
+    ctx.out(op, 'AttentionFCOut', ps[-1, -1][:, None])      # (maxT, 1)
+    ctx.out(op, 'LSTMX', lxs[-1, -1][None])                 # (1, M)
+    ctx.out(op, 'LSTMOUT', gs[-1, -1][None])                # (1, 4D)
+
+
+# ---------------------------------------------------------------------------
+# cudnn_lstm — reference cudnn_lstm_op.cc (multi-layer dense LSTM)
+# ---------------------------------------------------------------------------
+
+@register_op('cudnn_lstm', needs_rng=True)
+def _cudnn_lstm(ctx, op):
+    """reference operators/cudnn_lstm_op.cc:56-125: dense (no-LoD)
+    multi-layer, optionally bidirectional LSTM over Input
+    [seq_len, batch, input_size] with one flat weight blob W.
+
+    The cuDNN-packed blob layout is hardware-specific; the TPU-native blob
+    is defined as, per layer then per direction:
+      Wx (in_l, 4H) | Wh (H, 4H) | bx (4H) | bh (4H)
+    with in_l = input_size at layer 0 else H*num_directions, gate order
+    [i, f, c, o] (cuDNN's). Inter-layer dropout with prob `dropout_prob`
+    when not is_test (cudnn_lstm_op.cc:109-124)."""
+    x = ctx.in1(op, 'Input')                  # (T, B, in)
+    init_h = ctx.in1(op, 'InitH')             # (L*dirs, B, H)
+    init_c = ctx.in1(op, 'InitC')
+    w = ctx.in1(op, 'W').reshape(-1)
+    hidden = int(op.attr('hidden_size', 100))
+    layers = int(op.attr('num_layers', 1))
+    bidirec = bool(op.attr('is_bidirec', False))
+    dropout = float(op.attr('dropout_prob', 0.0))
+    is_test = bool(op.attr('is_test', False))
+    dirs = 2 if bidirec else 1
+    t_len, batch, in_size = x.shape
+
+    def one_direction(inp, wx, wh, bx, bh, h0, c0, reverse):
+        if reverse:
+            inp = inp[::-1]
+
+        def step(carry, xt):
+            h_prev, c_prev = carry
+            g = xt @ wx + h_prev @ wh + bx + bh
+            i = jax.nn.sigmoid(g[:, :hidden])
+            f = jax.nn.sigmoid(g[:, hidden:2 * hidden])
+            cand = jnp.tanh(g[:, 2 * hidden:3 * hidden])
+            o = jax.nn.sigmoid(g[:, 3 * hidden:])
+            c = f * c_prev + i * cand
+            h = o * jnp.tanh(c)
+            return (h, c), h
+
+        (h_last, c_last), hs = lax.scan(step, (h0, c0), inp)
+        if reverse:
+            hs = hs[::-1]
+        return hs, h_last, c_last
+
+    pos = 0
+
+    def take(nelem, shape):
+        nonlocal pos
+        out = w[pos:pos + nelem].reshape(shape)
+        pos += nelem
+        return out
+
+    cur = x
+    last_h, last_c = [], []
+    key = ctx.rng()
+    for layer in range(layers):
+        in_l = cur.shape[-1]
+        outs = []
+        for di in range(dirs):
+            wx = take(in_l * 4 * hidden, (in_l, 4 * hidden))
+            wh = take(hidden * 4 * hidden, (hidden, 4 * hidden))
+            bx = take(4 * hidden, (4 * hidden,))
+            bh = take(4 * hidden, (4 * hidden,))
+            sidx_state = layer * dirs + di
+            hs, h_l, c_l = one_direction(
+                cur, wx, wh, bx, bh, init_h[sidx_state], init_c[sidx_state],
+                reverse=(di == 1))
+            outs.append(hs)
+            last_h.append(h_l)
+            last_c.append(c_l)
+        cur = outs[0] if dirs == 1 else jnp.concatenate(outs, axis=-1)
+        if dropout and not is_test and layer < layers - 1:
+            key = jax.random.fold_in(key, layer)
+            keep = jax.random.bernoulli(key, 1.0 - dropout, cur.shape)
+            cur = jnp.where(keep, cur / (1.0 - dropout), 0.0)
+    ctx.out(op, 'Out', cur)
+    ctx.out(op, 'last_h', jnp.stack(last_h))
+    ctx.out(op, 'last_c', jnp.stack(last_c))

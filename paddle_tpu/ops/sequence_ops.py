@@ -513,3 +513,29 @@ def _sequence_mask(ctx, op):
     lens = x.reshape(x.shape + (1,))
     mask = jnp.arange(maxlen) < lens
     ctx.out(op, 'Y', mask.astype(dtype))
+
+
+@register_op('fused_embedding_seq_pool')
+def _fused_embedding_seq_pool(ctx, op):
+    """reference operators/fused/fused_embedding_seq_pool_op.cc: embedding
+    lookup + per-sequence sum pooling fused — the CTR hot path that never
+    materializes the (T, D) lookup table output in HBM. The TPU lowering
+    is take + segment_sum, which XLA fuses into one pass."""
+    w = ctx.in1(op, 'W')                       # (V, D)
+    ids = ctx.in1(op, 'Ids')                   # LoD (T, 1) int64
+    combiner = op.attr('combiner', 'sum')
+    if combiner != 'sum':
+        raise NotImplementedError(
+            "fused_embedding_seq_pool combiner %r (reference supports only "
+            "'sum' too, fused_embedding_seq_pool_op.cc:96-103)" % combiner)
+    lod = ctx.in1_lod(op, 'Ids')
+    if not lod:
+        raise ValueError("fused_embedding_seq_pool requires LoD Ids")
+    offsets = lod[-1]
+    n = len(offsets) - 1
+    seg = segment_ids(offsets)
+    emb = jnp.take(w, ids.reshape(-1).astype(jnp.int32), axis=0)  # (T, D)
+    out = jax.ops.segment_sum(emb, jnp.asarray(seg), num_segments=n)
+    ctx.out(op, 'Out', out)
+    if op.output('Out'):
+        ctx.set_lod(op.output('Out')[0], ())

@@ -72,14 +72,19 @@ def _roi_pool(ctx, op):
         neg = jnp.asarray(-jnp.inf, x.dtype)
         masked = jnp.where(mask[None], feat[:, None, None, :, :], neg)
         out = jnp.max(masked, axis=(3, 4))                       # [c, ph, pw]
-        return jnp.where(jnp.isfinite(out), out, 0.0)
+        # true flat argmax into the h*w plane (reference roi_pool_op.h
+        # argmax semantics: -1 for empty bins)
+        flat = masked.reshape(masked.shape[:3] + (-1,))
+        am = jnp.argmax(flat, axis=3).astype(jnp.int32)
+        am = jnp.where(jnp.isfinite(out), am, -1)
+        return jnp.where(jnp.isfinite(out), out, 0.0), am
 
     feats = x[jnp.asarray(batch_ids)]          # [R, c, h, w]
-    out = jax.vmap(one_roi)(rois, feats)
+    out, argmax = jax.vmap(one_roi)(rois, feats)
     ctx.out(op, 'Out', out)
     argm = op.output('Argmax')
     if argm:
-        ctx.set(argm[0], jnp.zeros(out.shape, jnp.int32))
+        ctx.set(argm[0], argmax)
     ctx.set_lod(op.output('Out')[0], ())
 
 

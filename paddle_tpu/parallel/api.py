@@ -140,6 +140,33 @@ class MeshRunner(object):
         from ..executor import _run_key, _next_program_run
         key_arr = _run_key(program.random_seed, _next_program_run(program),
                            self._run_counter)
+        if jax.process_count() > 1:
+            # multi-host: feeds are per-process local shards, state is
+            # replicated-identical — assemble global arrays (the same
+            # contract as spmd.DataParallelRunner; reference: each trainer
+            # feeds its own slice, params broadcast once)
+            def _glob_feed(name, v):
+                if isinstance(v, jax.Array) and not v.is_fully_addressable:
+                    return v
+                sh = self._sharding(P() if name in static_lods
+                                    else self._feed_specs.get(name, P()))
+                return jax.make_array_from_process_local_data(
+                    sh, np.asarray(v))
+
+            def _glob_state(name, v):
+                if isinstance(v, jax.Array) and not v.is_fully_addressable:
+                    return v
+                arr = np.asarray(v)
+                sh = self._sharding(self._rules.spec_for(name))
+                return jax.make_array_from_callback(
+                    arr.shape, sh, lambda idx: arr[idx])
+
+            feed = {k: _glob_feed(k, v) for k, v in feed.items()}
+            ro = {n: _glob_state(n, v) for n, v in ro.items()}
+            rw = {n: _glob_state(n, v) for n, v in rw.items()}
+            karr = np.asarray(key_arr)
+            key_arr = jax.make_array_from_callback(
+                karr.shape, self._sharding(P()), lambda idx: karr[idx])
         global _ACTIVE_MESH
         prev, _ACTIVE_MESH = _ACTIVE_MESH, self._mesh
         try:
@@ -157,8 +184,10 @@ class MeshRunner(object):
                 scope._lods.pop(n, None)
         from ..executor import _fetched
         if return_numpy:
+            from .spmd import DataParallelRunner
+            host = DataParallelRunner._fetch_to_host
             return [
-                _fetched(f, entry.lod_out[n])
-                if entry.lod_out.get(n) else np.asarray(f)
+                _fetched(host(f), entry.lod_out[n])
+                if entry.lod_out.get(n) else host(f)
                 for n, f in zip(fetch_names, fetches)]
         return list(fetches)

@@ -12,7 +12,8 @@ import jax.numpy as jnp
 from jax import lax
 
 __all__ = ['allreduce', 'allgather', 'reduce_scatter', 'alltoall',
-           'ppermute_shift', 'barrier', 'init_distributed',
+           'ppermute_shift', 'barrier', 'barrier_with_timeout',
+           'init_distributed',
            'global_device_count', 'local_device_count', 'process_index']
 
 
@@ -77,3 +78,39 @@ def local_device_count():
 
 def process_index():
     return jax.process_index()
+
+
+def barrier_with_timeout(name='paddle_tpu_barrier', timeout_s=60.0,
+                         on_timeout=None):
+    """Host-level barrier that DETECTS failed/unresponsive hosts: raises
+    RuntimeError if the cluster does not reach the barrier within
+    `timeout_s` (SURVEY §5 failure detection — the reference relies on
+    gRPC deadlines, FLAGS_rpc_deadline; the TPU-native runtime detects
+    failed hosts via jax.distributed barrier timeouts). `on_timeout`
+    (callable) runs before raising — hook for checkpoint-then-abort."""
+    import threading
+    done = threading.Event()
+    errs = []
+
+    def _run():
+        try:
+            if jax.process_count() > 1:
+                from jax.experimental import multihost_utils
+                multihost_utils.sync_global_devices(name)
+        except Exception as e:      # noqa: BLE001 — re-raised on main thread
+            errs.append(e)
+        finally:
+            done.set()
+
+    t = threading.Thread(target=_run, daemon=True)
+    t.start()
+    if not done.wait(timeout_s):
+        if on_timeout is not None:
+            on_timeout()
+        raise RuntimeError(
+            "barrier %r timed out after %.1fs: one or more of the %d "
+            "hosts is unresponsive (checkpoint-resume + job restart is "
+            "the recovery path, SURVEY §5)"
+            % (name, timeout_s, jax.process_count()))
+    if errs:
+        raise errs[0]

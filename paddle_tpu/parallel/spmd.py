@@ -86,10 +86,29 @@ class DataParallelRunner(object):
             return NamedSharding(mesh, P())
         v = program.global_block()._find_var_recursive(name)
         ndev = self.num_devices
-        if v is not None and v.shape and len(v.shape) >= 1 and \
-                v.shape[0] is not None and v.shape[0] > 0 and \
-                v.shape[0] % ndev == 0:
-            return NamedSharding(mesh, P('data'))
+        shape = tuple(v.shape) if v is not None and v.shape else ()
+        # shard the LARGEST axis divisible by the device count (reference
+        # ReduceSSAGraphBuilder balances whole params across devices; the
+        # sharded analog slices whichever axis divides evenly — dim0 for
+        # embeddings, dim1 for e.g. [in, out] fc weights with odd in)
+        best = None
+        for ax, dim in enumerate(shape):
+            if dim and dim > 0 and dim % ndev == 0 and \
+                    (best is None or dim > shape[best]):
+                best = ax
+        if best is not None:
+            spec = [None] * len(shape)
+            spec[best] = 'data'
+            return NamedSharding(mesh, P(*spec))
+        size = int(np.prod([d for d in shape if d])) if shape else 0
+        if size >= 1024:
+            import warnings
+            warnings.warn(
+                "Reduce (ZeRO) mode: variable %r shape %s has no axis "
+                "divisible by %d devices — replicating it (no per-device "
+                "memory saving for this variable; pad a dimension to a "
+                "multiple of the device count to shard it)"
+                % (name, shape, ndev), RuntimeWarning, stacklevel=3)
         return NamedSharding(mesh, P())
 
     def _compile(self, feed, fetch_names, feed_lods=None):

@@ -3,7 +3,11 @@ from .distribute_transpiler import (DistributeTranspiler,
 from .memory_optimization_transpiler import memory_optimize, release_memory
 from .inference_transpiler import InferenceTranspiler
 from .ps_dispatcher import RoundRobin, HashName, PSDispatcher
+from .passes import (Pass, PassRegistry, PatternMatcher, register_pass,
+                     get_pass, apply_passes)
 
 __all__ = ['DistributeTranspiler', 'DistributeTranspilerConfig',
            'memory_optimize', 'release_memory', 'InferenceTranspiler',
-           'RoundRobin', 'HashName', 'PSDispatcher']
+           'RoundRobin', 'HashName', 'PSDispatcher', 'Pass',
+           'PassRegistry', 'PatternMatcher', 'register_pass', 'get_pass',
+           'apply_passes']

@@ -18,6 +18,11 @@ class InferenceTranspiler(object):
     def transpile(self, program, place=None, scope=None):
         if scope is None:
             scope = global_scope()
+        # reference inference analysis runs semantic clean passes before
+        # fusions (framework/ir/is_test_pass, identity_scale_op_clean_pass)
+        from .passes import get_pass
+        get_pass('is_test_pass').apply(program, scope)
+        get_pass('identity_scale_op_clean_pass').apply(program, scope)
         block = program.global_block()
         i = 0
         while i < len(block.ops) - 1:

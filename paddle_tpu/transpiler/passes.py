@@ -1,0 +1,166 @@
+"""Program-rewrite pass framework.
+
+Reference parity: framework/ir/pass.h:32,144 (Pass + PassRegistry) and
+graph_pattern_detector.h — scoped down to what a trace-to-XLA design
+actually needs (SURVEY §2.2: XLA owns fusion; program-level rewrites cover
+semantic cleanups). Passes mutate the Program in place and bump its
+version so compile caches invalidate.
+
+Built-ins match the reference inference-analysis cleanups the round-2
+review called out (framework/ir/is_test_pass.cc,
+identity_scale_op_clean_pass.cc) plus the conv+BN fold, and the
+PatternMatcher gives transpilers a declarative way to find op chains
+(single-consumer var links), replacing ad-hoc index walking.
+"""
+import numpy as np
+
+__all__ = ['Pass', 'PassRegistry', 'PatternMatcher', 'register_pass',
+           'get_pass', 'apply_passes']
+
+
+class Pass(object):
+    """Base pass: subclass and implement apply_impl (reference
+    ir/pass.h:32)."""
+    name = None
+
+    def apply(self, program, scope=None):
+        self.apply_impl(program, scope)
+        program._bump_version()
+        return program
+
+    def apply_impl(self, program, scope):
+        raise NotImplementedError
+
+
+class PassRegistry(object):
+    _passes = {}
+
+    @classmethod
+    def register(cls, name, pass_cls):
+        if name in cls._passes:
+            raise KeyError("pass %r already registered" % name)
+        cls._passes[name] = pass_cls
+
+    @classmethod
+    def get(cls, name):
+        if name not in cls._passes:
+            raise KeyError("no pass named %r (have: %s)"
+                           % (name, sorted(cls._passes)))
+        return cls._passes[name]()
+
+    @classmethod
+    def names(cls):
+        return sorted(cls._passes)
+
+
+def register_pass(name):
+    def deco(pass_cls):
+        pass_cls.name = name
+        PassRegistry.register(name, pass_cls)
+        return pass_cls
+    return deco
+
+
+def get_pass(name):
+    return PassRegistry.get(name)
+
+
+def apply_passes(program, names, scope=None):
+    for n in names:
+        get_pass(n).apply(program, scope)
+    return program
+
+
+class PatternMatcher(object):
+    """Match chains of op types linked by single-consumer vars (the
+    program-level core of reference graph_pattern_detector.h).
+
+    match(block, ['conv2d', 'batch_norm']) yields lists of op objects
+    [conv, bn] where conv's first output is consumed ONLY by bn.
+    """
+
+    def __init__(self, block):
+        self.block = block
+
+    def _consumers(self, var_name):
+        return [o for o in self.block.ops if var_name in o.input_arg_names]
+
+    def match(self, types):
+        out = []
+        for op in list(self.block.ops):
+            if op.type != types[0]:
+                continue
+            chain = [op]
+            ok = True
+            for want in types[1:]:
+                outs = chain[-1].output_arg_names
+                if len(outs) < 1:
+                    ok = False
+                    break
+                # follow the op's primary output
+                nxt = None
+                for name in outs:
+                    cons = self._consumers(name)
+                    if len(cons) == 1 and cons[0].type == want:
+                        nxt = cons[0]
+                        break
+                if nxt is None:
+                    ok = False
+                    break
+                chain.append(nxt)
+            if ok:
+                out.append(chain)
+        return out
+
+
+@register_pass('is_test_pass')
+class IsTestPass(Pass):
+    """reference framework/ir/is_test_pass.cc: flip every op carrying an
+    is_test attr to inference mode."""
+
+    def apply_impl(self, program, scope):
+        for block in program.blocks:
+            for op in block.ops:
+                if op.attr('is_test', None) is not None or op.type in (
+                        'dropout', 'batch_norm', 'lrn', 'pool2d',
+                        'fake_quantize_range_abs_max'):
+                    op.set_attr('is_test', True)
+
+
+@register_pass('identity_scale_op_clean_pass')
+class IdentityScaleCleanPass(Pass):
+    """reference framework/ir/identity_scale_op_clean_pass.cc: remove
+    scale(x, scale=1, bias=0) ops, rewiring consumers to the input."""
+
+    def apply_impl(self, program, scope):
+        for block in program.blocks:
+            keep = []
+            rename = {}
+            for op in block.ops:
+                is_identity = (
+                    op.type == 'scale'
+                    and float(op.attr('scale', 1.0)) == 1.0
+                    and float(op.attr('bias', 0.0)) == 0.0
+                    and op.input('X') and op.output('Out'))
+                if is_identity:
+                    src = op.input('X')[0]
+                    rename[op.output('Out')[0]] = rename.get(src, src)
+                else:
+                    keep.append(op)
+            if not rename:
+                continue
+            for op in keep:
+                for slot, names in list(op.inputs.items()):
+                    op.inputs[slot] = [rename.get(n, n) for n in names]
+            block.ops = keep
+
+
+@register_pass('conv_bn_fuse_pass')
+class ConvBNFusePass(Pass):
+    """Constant-fold inference batch_norm into the preceding conv2d's
+    weights (reference framework/ir/conv_bn_fuse_pass.cc semantics via the
+    InferenceTranspiler implementation)."""
+
+    def apply_impl(self, program, scope):
+        from .inference_transpiler import InferenceTranspiler
+        InferenceTranspiler().transpile(program, scope=scope)

@@ -345,3 +345,97 @@ def test_understand_sentiment_lstm(tmp_path):
         avg_cost, lambda i: {'words': (W, lod), 'label': L},
         ['words', 'label'], [prediction], tmp_path, steps=20,
         infer_feed_names=['words'])
+
+
+def test_rnn_encoder_decoder(tmp_path):
+    """reference tests/book/test_rnn_encoder_decoder.py: bi-LSTM encoder
+    (forward + is_reverse dynamic_lstm) and a DynamicRNN decoder stepping
+    an explicit lstm cell (fc gates, reference lstm_step :66-85) booted
+    from the encoder state — the 9th book model, distinct from
+    machine_translation's gru seq2seq."""
+    dict_size = 30
+    word_dim = 16
+    hidden = 16
+    decoder_size = hidden
+
+    src = fluid.layers.data(name='src_w', shape=[1], dtype='int64',
+                            lod_level=1)
+    trg = fluid.layers.data(name='trg_w', shape=[1], dtype='int64',
+                            lod_level=1)
+    label = fluid.layers.data(name='lbl_w', shape=[1], dtype='int64',
+                              lod_level=1)
+
+    # bi_lstm_encoder (reference :42-62)
+    src_emb = fluid.layers.embedding(src, size=[dict_size, word_dim])
+    fwd_in = fluid.layers.fc(src_emb, size=hidden * 4)
+    fwd, _ = fluid.layers.dynamic_lstm(input=fwd_in, size=hidden * 4)
+    bwd_in = fluid.layers.fc(src_emb, size=hidden * 4)
+    bwd, _ = fluid.layers.dynamic_lstm(input=bwd_in, size=hidden * 4,
+                                       is_reverse=True)
+    src_fwd_last = fluid.layers.sequence_last_step(fwd)
+    src_bwd_first = fluid.layers.sequence_first_step(bwd)
+    encoded = fluid.layers.concat([src_fwd_last, src_bwd_first], axis=1)
+    decoder_boot = fluid.layers.fc(encoded, size=decoder_size,
+                                   act='tanh')
+    cell_init = fluid.layers.fill_constant_batch_size_like(
+        decoder_boot, shape=[-1, decoder_size], dtype='float32', value=0.0)
+
+    # lstm_decoder_without_attention (reference :87-114): DynamicRNN with
+    # an explicit fc-gate lstm step
+    trg_emb = fluid.layers.embedding(trg, size=[dict_size, word_dim])
+    drnn = fluid.layers.DynamicRNN()
+    with drnn.block():
+        x_t = drnn.step_input(trg_emb)
+        h_prev = drnn.memory(init=decoder_boot)
+        c_prev = drnn.memory(init=cell_init)
+        # reference lstm_step :66-85: gates from [x_t, h_prev]
+        gates = fluid.layers.fc(input=fluid.layers.concat(
+            [x_t, h_prev], axis=1), size=4 * decoder_size)
+        h, c = fluid.layers.lstm_unit_gates(gates, c_prev) \
+            if hasattr(fluid.layers, 'lstm_unit_gates') else \
+            _explicit_lstm_step(gates, c_prev, decoder_size)
+        drnn.update_memory(h_prev, h)
+        drnn.update_memory(c_prev, c)
+        out = fluid.layers.fc(h, size=dict_size, act='softmax')
+        drnn.output(out)
+    predict = drnn()
+    avg_cost = fluid.layers.mean(
+        fluid.layers.cross_entropy(input=predict, label=label))
+    fluid.optimizer.Adam(learning_rate=0.02).minimize(avg_cost)
+
+    rng = np.random.RandomState(7)
+    src_lod = [[0, 4, 9]]
+    trg_lod = [[0, 5, 8]]
+    SW = rng.randint(1, dict_size, (9, 1)).astype('int64')
+    TW = rng.randint(1, dict_size, (8, 1)).astype('int64')
+    NX = rng.randint(1, dict_size, (8, 1)).astype('int64')
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    _train_save_load_infer(
+        exe, fluid.default_main_program(), fluid.default_startup_program(),
+        avg_cost,
+        lambda i: {'src_w': (SW, src_lod), 'trg_w': (TW, trg_lod),
+                   'lbl_w': (NX, trg_lod)},
+        ['src_w', 'trg_w', 'lbl_w'], [predict], tmp_path,
+        steps=20, infer_feed_names=['src_w', 'trg_w'])
+
+
+def _explicit_lstm_step(gates, c_prev, size):
+    """reference test_rnn_encoder_decoder.py lstm_step :66-85: slice the
+    fused gate matrix and apply sigmoid/tanh gate math with layers ops."""
+    f = fluid.layers.sigmoid(
+        fluid.layers.slice(gates, axes=[1], starts=[0], ends=[size]))
+    i = fluid.layers.sigmoid(
+        fluid.layers.slice(gates, axes=[1], starts=[size],
+                           ends=[2 * size]))
+    o = fluid.layers.sigmoid(
+        fluid.layers.slice(gates, axes=[1], starts=[2 * size],
+                           ends=[3 * size]))
+    cand = fluid.layers.tanh(
+        fluid.layers.slice(gates, axes=[1], starts=[3 * size],
+                           ends=[4 * size]))
+    c = fluid.layers.elementwise_add(
+        fluid.layers.elementwise_mul(f, c_prev),
+        fluid.layers.elementwise_mul(i, cand))
+    h = fluid.layers.elementwise_mul(o, fluid.layers.tanh(c))
+    return h, c

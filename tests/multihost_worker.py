@@ -1,19 +1,27 @@
-"""Worker for the 2-process multi-host DP test (the reference
+"""Worker for the multi-process multi-host tests (the reference
 unittests/test_dist_base.py trainer-subprocess pattern, nccl2 mode).
 
-Run as: python multihost_worker.py <coordinator> <nproc> <pid>
-Each process owns 2 virtual CPU devices; the global mesh spans 4 devices
-across both processes. Prints per-step losses as JSON on the last line.
+Two entry modes:
+- argv: python multihost_worker.py <coordinator> <nproc> <pid>
+- launcher env (paddle_tpu.distributed.launch contract): no argv; rank /
+  world / coordinator come from PADDLE_* env vars via init_from_env().
+
+Each process owns MH_LOCAL_DEVICES (default 2) virtual CPU devices; the
+global mesh spans nproc * local devices. MH_MODE selects the parallelism:
+'dp' (CompiledProgram data parallel) or 'dp_tp' (MeshRunner over a
+data x model mesh). Prints per-step losses as JSON on the last line.
 """
 import json
 import os
 import sys
 
 os.environ['JAX_PLATFORMS'] = 'cpu'
+_local = int(os.environ.get('MH_LOCAL_DEVICES', '2'))
 flags = os.environ.get('XLA_FLAGS', '')
 if '--xla_force_host_platform_device_count' not in flags:
     os.environ['XLA_FLAGS'] = (
-        flags + ' --xla_force_host_platform_device_count=2').strip()
+        flags + ' --xla_force_host_platform_device_count=%d'
+        % _local).strip()
 
 import jax
 jax.config.update('jax_platforms', 'cpu')
@@ -21,43 +29,74 @@ jax.config.update('jax_platforms', 'cpu')
 import numpy as np
 
 
-def main():
-    coordinator, nproc, pid = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+def _build():
     import paddle_tpu as fluid
-    from paddle_tpu.parallel import collective
-
-    collective.init_distributed(coordinator_address=coordinator,
-                                num_processes=nproc, process_id=pid)
-    assert jax.process_count() == nproc
-    assert jax.device_count() == 2 * nproc
-
     main_p, startup = fluid.Program(), fluid.Program()
     main_p.random_seed = startup.random_seed = 23
     with fluid.program_guard(main_p, startup):
         x = fluid.layers.data(name='x', shape=[8], dtype='float32')
         y = fluid.layers.data(name='y', shape=[1], dtype='int64')
         h = fluid.layers.fc(x, size=16, act='relu')
-        p = fluid.layers.fc(h, size=3, act='softmax')
+        p = fluid.layers.fc(h, size=4, act='softmax')
         loss = fluid.layers.mean(fluid.layers.cross_entropy(p, y))
         fluid.optimizer.SGD(0.1).minimize(loss)
+    return main_p, startup, loss
 
+
+def main():
+    import paddle_tpu as fluid
+    if len(sys.argv) > 1:
+        coordinator, nproc, pid = (sys.argv[1], int(sys.argv[2]),
+                                   int(sys.argv[3]))
+        from paddle_tpu.parallel import collective
+        collective.init_distributed(coordinator_address=coordinator,
+                                    num_processes=nproc, process_id=pid)
+    else:
+        from paddle_tpu.distributed import init_from_env
+        pid, nproc = init_from_env()
+    assert jax.process_count() == nproc
+    assert jax.device_count() == _local * nproc
+
+    main_p, startup, loss = _build()
     exe = fluid.Executor()
     exe.run(startup)
 
     # deterministic global batch, split by process (reference: each
     # trainer reads its own slice)
     rng = np.random.RandomState(5)
-    X = rng.randn(16, 8).astype('float32')
-    Y = rng.randint(0, 3, (16, 1)).astype('int64')
-    lo, hi = pid * 8, (pid + 1) * 8
+    per = 32 // nproc
+    X = rng.randn(32, 8).astype('float32')
+    Y = rng.randint(0, 4, (32, 1)).astype('int64')
+    lo, hi = pid * per, (pid + 1) * per
 
-    compiled = fluid.CompiledProgram(main_p).with_data_parallel(
-        loss_name=loss.name)
+    mode = os.environ.get('MH_MODE', 'dp')
     losses = []
-    for _ in range(4):
-        l, = exe.run(compiled, feed={'x': X[lo:hi], 'y': Y[lo:hi]},
-                     fetch_list=[loss])
-        losses.append(float(np.asarray(l).reshape(())))
+    if mode == 'dp':
+        compiled = fluid.CompiledProgram(main_p).with_data_parallel(
+            loss_name=loss.name)
+        for _ in range(4):
+            l, = exe.run(compiled, feed={'x': X[lo:hi], 'y': Y[lo:hi]},
+                         fetch_list=[loss])
+            losses.append(float(np.asarray(l).reshape(())))
+    else:  # dp_tp: explicit data x model mesh spanning all hosts
+        from jax.sharding import PartitionSpec as P
+        from paddle_tpu.parallel import make_mesh, MeshRunner, ShardingRules
+        ndev = jax.device_count()
+        tp = 2
+        dp = ndev // tp
+        mesh = make_mesh([('data', dp), ('model', tp)])
+        rules = ShardingRules([
+            (r'fc_0\.w', P(None, 'model')),
+            (r'fc_0\.b', P('model',)),
+            (r'fc_1\.w', P('model', None)),
+        ])
+        runner = MeshRunner(main_p, mesh, param_rules=rules,
+                            feed_specs={'x': P('data'), 'y': P('data')})
+        scope = fluid.global_scope()
+        for _ in range(4):
+            l, = runner.run({'x': X[lo:hi], 'y': Y[lo:hi]}, [loss.name],
+                            scope)
+            losses.append(float(np.asarray(l).reshape(-1)[0]))
     print("LOSSES:" + json.dumps(losses))
 
 

@@ -1,0 +1,27 @@
+"""Frozen public-API signature gate (reference paddle/fluid/API.spec +
+tools/diff_api.py CI check): the live API signatures must match the
+checked-in API.spec; intentional changes regenerate it with
+tools/gen_api_spec.py."""
+import os
+import sys
+
+
+def test_api_spec_matches():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.join(repo, 'tools'))
+    try:
+        import gen_api_spec
+    finally:
+        sys.path.pop(0)
+    live = gen_api_spec.iter_api()
+    with open(os.path.join(repo, 'API.spec')) as f:
+        frozen = [l.rstrip('\n') for l in f if l.strip()]
+    live_set, frozen_set = set(live), set(frozen)
+    removed = sorted(frozen_set - live_set)[:20]
+    added = sorted(live_set - frozen_set)[:20]
+    assert live_set == frozen_set, (
+        "public API drifted from API.spec.\n"
+        "removed/changed (first 20): %s\n"
+        "added/changed (first 20): %s\n"
+        "If intentional: JAX_PLATFORMS=cpu python tools/gen_api_spec.py "
+        "> API.spec" % (removed, added))

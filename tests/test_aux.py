@@ -201,3 +201,32 @@ class TestFlagsUnderDataParallel(object):
         assert jax.config.jax_debug_nans
         fluid.set_flags('debug_nans', False)
         assert not jax.config.jax_debug_nans
+
+
+def test_barrier_with_timeout_single_host():
+    """Single process: the barrier is a fast no-op."""
+    from paddle_tpu.parallel import collective
+    collective.barrier_with_timeout('t_fast', timeout_s=5.0)
+
+
+def test_barrier_with_timeout_detects_hang(monkeypatch):
+    """A hung cluster barrier must raise within the timeout and run the
+    on_timeout hook (failure-detection contract)."""
+    import time as _time
+    import jax as _jax
+    from paddle_tpu.parallel import collective
+    import pytest as _pytest
+
+    monkeypatch.setattr(_jax, 'process_count', lambda: 2)
+
+    class _FakeMH(object):
+        @staticmethod
+        def sync_global_devices(name):
+            _time.sleep(30)
+    import jax.experimental as je
+    monkeypatch.setattr(je, 'multihost_utils', _FakeMH, raising=False)
+    fired = []
+    with _pytest.raises(RuntimeError, match='timed out'):
+        collective.barrier_with_timeout(
+            't_hang', timeout_s=0.5, on_timeout=lambda: fired.append(1))
+    assert fired == [1]

@@ -19,12 +19,18 @@ def _run_single_op(op_type, inputs, outputs, attrs, lods=None):
         block = prog.global_block()
         in_map = {}
         for slot, val in inputs.items():
-            arr = val[0] if isinstance(val, tuple) else val
-            v = block.create_var(name=slot, shape=np.asarray(arr).shape,
-                                 dtype=np.asarray(arr).dtype,
-                                 lod_level=1 if isinstance(val, tuple) else 0)
-            feed[slot] = val
-            in_map[slot] = [v]
+            vals = val if isinstance(val, list) else [val]
+            vs_in = []
+            for i, one in enumerate(vals):
+                arr = one[0] if isinstance(one, tuple) else one
+                name = slot if len(vals) == 1 else '%s_%d' % (slot, i)
+                v = block.create_var(
+                    name=name, shape=np.asarray(arr).shape,
+                    dtype=np.asarray(arr).dtype,
+                    lod_level=1 if isinstance(one, tuple) else 0)
+                feed[name] = one
+                vs_in.append(v)
+            in_map[slot] = vs_in
         out_map = {}
         fetch = []
         for slot, names in outputs.items():

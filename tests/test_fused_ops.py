@@ -1,0 +1,278 @@
+"""Fused-op surface (reference operators/fused/) + save/load IO ops:
+each fused op checked against the composition of unfused ops it
+replaces (the reference fuse-pass contract)."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from test_detection_ops import _run_single_op
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def test_fused_elemwise_activation():
+    rng = np.random.RandomState(0)
+    x = rng.randn(3, 4).astype('float32')
+    y = rng.randn(3, 4).astype('float32')
+    out, inter = _run_single_op(
+        'fused_elemwise_activation', {'X': x, 'Y': y},
+        {'Out': ['fea_o'], 'IntermediateOut': ['fea_i']},
+        {'functor_list': ['relu', 'elementwise_add'], 'axis': -1})
+    np.testing.assert_allclose(out, np.maximum(x + y, 0), rtol=1e-6)
+    np.testing.assert_allclose(inter, x + y, rtol=1e-6)
+    out2, _ = _run_single_op(
+        'fused_elemwise_activation', {'X': x, 'Y': y},
+        {'Out': ['fea_o2'], 'IntermediateOut': ['fea_i2']},
+        {'functor_list': ['elementwise_add', 'scale'], 'scale': 2.0,
+         'axis': -1})
+    np.testing.assert_allclose(out2, x + 2.0 * y, rtol=1e-6)
+
+
+def test_fusion_lstm_matches_lstm_op():
+    """fusion_lstm == mul + lstm (reference fc_lstm_fuse_pass contract);
+    gate order [c,i,f,o] shared with lstm_op."""
+    rng = np.random.RandomState(1)
+    M, D = 4, 3
+    lod = [[0, 3, 5]]
+    x = rng.randn(5, M).astype('float32')
+    wx = rng.randn(M, 4 * D).astype('float32')
+    wh = rng.randn(D, 4 * D).astype('float32')
+    b = rng.randn(1, 4 * D).astype('float32')
+    hid, cell, xx = _run_single_op(
+        'fusion_lstm',
+        {'X': (x, lod), 'WeightX': wx, 'WeightH': wh, 'Bias': b},
+        {'Hidden': ['fl_h'], 'Cell': ['fl_c'], 'XX': ['fl_xx']},
+        {'use_peepholes': False})
+    ref_hid, ref_cell = _run_single_op(
+        'lstm', {'Input': (x @ wx, lod), 'Weight': wh, 'Bias': b},
+        {'Hidden': ['l_h'], 'Cell': ['l_c'], 'BatchGate': ['l_g'],
+         'BatchCellPreAct': ['l_p']},
+        {'use_peepholes': False})[:2]
+    np.testing.assert_allclose(hid, ref_hid, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(cell, ref_cell, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(xx, x @ wx, rtol=1e-5)
+
+
+def test_fused_embedding_fc_lstm_matches_fusion_lstm():
+    rng = np.random.RandomState(2)
+    V, D = 6, 3
+    lod = [[0, 2, 4]]
+    ids = rng.randint(0, V, (4, 1)).astype('int64')
+    emb = rng.randn(V, 4 * D).astype('float32')
+    wh = rng.randn(D, 4 * D).astype('float32')
+    b = rng.randn(1, 4 * D).astype('float32')
+    hid, = _run_single_op(
+        'fused_embedding_fc_lstm',
+        {'Ids': (ids, lod), 'Embeddings': emb, 'WeightH': wh, 'Bias': b},
+        {'Hidden': ['fe_h'], 'Cell': ['fe_c'], 'XX': ['fe_xx']},
+        {'use_peepholes': False})[:1]
+    xx = emb[ids[:, 0]]
+    ref_hid, = _run_single_op(
+        'lstm', {'Input': (xx, lod), 'Weight': wh, 'Bias': b},
+        {'Hidden': ['l2_h'], 'Cell': ['l2_c'], 'BatchGate': ['l2_g'],
+         'BatchCellPreAct': ['l2_p']},
+        {'use_peepholes': False})[:1]
+    np.testing.assert_allclose(hid, ref_hid, rtol=1e-4, atol=1e-5)
+
+
+def test_fusion_gru_matches_gru_op():
+    rng = np.random.RandomState(3)
+    M, D = 4, 3
+    lod = [[0, 3, 5]]
+    x = rng.randn(5, M).astype('float32')
+    wx = rng.randn(M, 3 * D).astype('float32')
+    wh = rng.randn(D, 3 * D).astype('float32')
+    b = rng.randn(1, 3 * D).astype('float32')
+    hid, xx = _run_single_op(
+        'fusion_gru',
+        {'X': (x, lod), 'WeightX': wx, 'WeightH': wh, 'Bias': b},
+        {'Hidden': ['fg_h'], 'XX': ['fg_xx']}, {})
+    ref_hid, = _run_single_op(
+        'gru', {'Input': (x @ wx, lod), 'Weight': wh, 'Bias': b},
+        {'Hidden': ['g_h'], 'BatchGate': ['g_g'],
+         'BatchResetHiddenPrev': ['g_r'], 'BatchHidden': ['g_b']},
+        {})[:1]
+    np.testing.assert_allclose(hid, ref_hid, rtol=1e-4, atol=1e-5)
+
+
+def test_fusion_repeated_fc_relu():
+    rng = np.random.RandomState(4)
+    x = rng.randn(3, 5).astype('float32')
+    w1 = rng.randn(5, 4).astype('float32')
+    b1 = rng.randn(4).astype('float32')
+    w2 = rng.randn(4, 2).astype('float32')
+    b2 = rng.randn(2).astype('float32')
+    out, = _run_single_op(
+        'fusion_repeated_fc_relu',
+        {'X': x, 'W': [w1, w2], 'Bias': [b1, b2]},
+        {'Out': ['frf_o']}, {})
+    ref = np.maximum(np.maximum(x @ w1 + b1, 0) @ w2 + b2, 0)
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+def test_fusion_seqconv_eltadd_relu():
+    rng = np.random.RandomState(5)
+    lod = [[0, 3, 5]]
+    x = rng.randn(5, 4).astype('float32')
+    filt = rng.randn(3 * 4, 6).astype('float32')
+    bias = rng.randn(6).astype('float32')
+    out, _col = _run_single_op(
+        'fusion_seqconv_eltadd_relu',
+        {'X': (x, lod), 'Filter': filt, 'Bias': bias},
+        {'Out': ['fsc_o'], 'ColMat': ['fsc_c']},
+        {'contextLength': 3, 'contextStart': -1})
+    ref_sc, = _run_single_op(
+        'sequence_conv', {'X': (x, lod), 'Filter': filt},
+        {'Out': ['sc_o']},
+        {'contextLength': 3, 'contextStart': -1, 'contextStride': 1})
+    np.testing.assert_allclose(out, np.maximum(ref_sc + bias, 0),
+                               rtol=1e-5)
+
+
+def test_fusion_seqexpand_concat_fc():
+    rng = np.random.RandomState(6)
+    lod = [[0, 2, 5]]
+    x0 = rng.randn(5, 3).astype('float32')
+    x1 = rng.randn(2, 2).astype('float32')   # per-sequence rows
+    w = rng.randn(5, 4).astype('float32')
+    b = rng.randn(4).astype('float32')
+    out, = _run_single_op(
+        'fusion_seqexpand_concat_fc',
+        {'X': [(x0, lod), x1], 'FCWeight': w, 'FCBias': b},
+        {'Out': ['fsec_o']}, {'fc_activation': 'relu'})
+    seg = np.array([0, 0, 1, 1, 1])
+    cat = np.concatenate([x0, x1[seg]], axis=1)
+    np.testing.assert_allclose(out, np.maximum(cat @ w + b, 0),
+                               rtol=1e-5)
+
+
+def test_fusion_seqpool_concat():
+    rng = np.random.RandomState(7)
+    lod = [[0, 2, 5]]
+    xa = rng.randn(5, 3).astype('float32')
+    xb = rng.randn(5, 2).astype('float32')
+    out, = _run_single_op(
+        'fusion_seqpool_concat', {'X': [(xa, lod), (xb, lod)]},
+        {'Out': ['fsp_o']}, {'pooltype': 'SUM', 'axis': 1})
+    ref = np.concatenate([
+        np.stack([xa[:2].sum(0), xa[2:].sum(0)]),
+        np.stack([xb[:2].sum(0), xb[2:].sum(0)])], axis=1)
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+def test_fusion_squared_mat_sub():
+    rng = np.random.RandomState(8)
+    x = rng.randn(3, 4).astype('float32')
+    y = rng.randn(4, 5).astype('float32')
+    out, = _run_single_op(
+        'fusion_squared_mat_sub', {'X': x, 'Y': y},
+        {'Out': ['fsm_o'], 'SquaredX': ['fsm_x'], 'SquaredY': ['fsm_y'],
+         'SquaredXY': ['fsm_xy']},
+        {'scalar': 0.5})[:1]
+    ref = 0.5 * ((x @ y) ** 2 - (x * x) @ (y * y))
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_fusion_transpose_flatten_concat():
+    rng = np.random.RandomState(9)
+    a = rng.randn(2, 3, 4).astype('float32')
+    b = rng.randn(2, 5, 4).astype('float32')
+    out, = _run_single_op(
+        'fusion_transpose_flatten_concat', {'X': [a, b]},
+        {'Out': ['ftf_o']},
+        {'trans_axis': [0, 2, 1], 'flatten_axis': 1, 'concat_axis': 1})
+    ra = a.transpose(0, 2, 1).reshape(2, -1)
+    rb = b.transpose(0, 2, 1).reshape(2, -1)
+    np.testing.assert_allclose(out, np.concatenate([ra, rb], 1),
+                               rtol=1e-6)
+
+
+def test_save_load_ops_roundtrip():
+    """save/load ops on programs (reference save_op.cc:36 / load_op.cc)."""
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, 'blob.npz')
+        val = np.arange(12, dtype='float32').reshape(3, 4)
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data(name='sx', shape=[4], dtype='float32')
+            main.global_block().append_op(
+                type='save', inputs={'X': [x]}, outputs={},
+                attrs={'file_path': path, 'overwrite': True})
+        exe = fluid.Executor()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup, scope=scope)
+            exe.run(main, feed={'sx': val}, fetch_list=[x], scope=scope)
+        with np.load(path) as z:
+            np.testing.assert_array_equal(z['arr_0'], val)
+
+        main2, startup2 = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main2, startup2):
+            out = main2.global_block().create_var(
+                name='loaded', shape=(3, 4), dtype='float32')
+            main2.global_block().append_op(
+                type='load', inputs={}, outputs={'Out': [out]},
+                attrs={'file_path': path})
+        with fluid.scope_guard(scope):
+            got, = exe.run(main2, feed={}, fetch_list=[out], scope=scope)
+        np.testing.assert_array_equal(got, val)
+
+
+def test_save_combine_load_combine_roundtrip():
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, 'combined.npz')
+        a = np.ones((2, 2), 'float32')
+        b = np.full((3,), 7.0, 'float32')
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            xa = fluid.layers.data(name='ca', shape=[2], dtype='float32')
+            xb = fluid.layers.data(name='cb', shape=[3], dtype='float32',
+                                   append_batch_size=False)
+            main.global_block().append_op(
+                type='save_combine', inputs={'X': [xa, xb]}, outputs={},
+                attrs={'file_path': path, 'overwrite': True})
+        exe = fluid.Executor()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup, scope=scope)
+            exe.run(main, feed={'ca': a, 'cb': b}, fetch_list=[xa],
+                    scope=scope)
+        main2, startup2 = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main2, startup2):
+            oa = main2.global_block().create_var(
+                name='la', shape=(2, 2), dtype='float32')
+            ob = main2.global_block().create_var(
+                name='lb', shape=(3,), dtype='float32')
+            main2.global_block().append_op(
+                type='load_combine', inputs={},
+                outputs={'Out': [oa, ob]}, attrs={'file_path': path})
+        with fluid.scope_guard(scope):
+            ga, gb = exe.run(main2, feed={}, fetch_list=[oa, ob],
+                             scope=scope)
+        np.testing.assert_array_equal(ga, a)
+        np.testing.assert_array_equal(gb, b)
+
+
+def test_rnn_memory_helper_identity():
+    x = np.arange(6, dtype='float32').reshape(2, 3)
+    out, = _run_single_op('rnn_memory_helper', {'X': x},
+                          {'Out': ['rmh_o']}, {})
+    np.testing.assert_array_equal(out, x)
+
+
+def test_detection_map_op():
+    """Single perfect detection -> mAP 1 (detection_map_op.cc surface)."""
+    det = np.array([[1, 0.9, 10, 10, 20, 20]], 'float32')
+    lab = np.array([[1, 10, 10, 20, 20]], 'float32')
+    m, = _run_single_op(
+        'detection_map',
+        {'DetectRes': (det, [[0, 1]]), 'Label': (lab, [[0, 1]])},
+        {'MAP': ['dm_map'], 'AccumPosCount': ['dm_pc'],
+         'AccumTruePos': ['dm_tp'], 'AccumFalsePos': ['dm_fp']},
+        {'overlap_threshold': 0.5, 'class_num': 2})[:1]
+    np.testing.assert_allclose(np.asarray(m).reshape(()), 1.0, atol=1e-6)

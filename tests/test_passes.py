@@ -1,0 +1,96 @@
+"""Program-rewrite pass framework (reference framework/ir/pass.h:32,144,
+is_test_pass.cc, identity_scale_op_clean_pass.cc)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.transpiler import (PatternMatcher, get_pass, apply_passes,
+                                   register_pass, Pass, PassRegistry)
+
+
+def _conv_bn_model():
+    img = fluid.layers.data(name='pimg', shape=[3, 8, 8], dtype='float32')
+    c = fluid.layers.conv2d(img, num_filters=4, filter_size=3,
+                            padding=1, bias_attr=False)
+    b = fluid.layers.batch_norm(c)
+    # identity scale in the middle
+    s = fluid.layers.scale(b, scale=1.0, bias=0.0)
+    out = fluid.layers.fc(s, size=2, act='softmax')
+    return img, out
+
+
+def test_is_test_pass():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name='ti', shape=[4], dtype='float32')
+        h = fluid.layers.fc(img, size=4)
+        d = fluid.layers.dropout(h, dropout_prob=0.5)
+    get_pass('is_test_pass').apply(main)
+    drop = [op for op in main.global_block().ops if op.type == 'dropout']
+    assert drop and all(op.attr('is_test') for op in drop)
+
+
+def test_identity_scale_clean_pass():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img, out = _conv_bn_model()
+    n_before = len(main.global_block().ops)
+    get_pass('identity_scale_op_clean_pass').apply(main)
+    types = [op.type for op in main.global_block().ops]
+    assert 'scale' not in types
+    assert len(main.global_block().ops) == n_before - 1
+    # program still executes and produces the same result
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        r, = exe.run(main, feed={'pimg': np.ones((2, 3, 8, 8), 'float32')},
+                     fetch_list=[out], scope=scope)
+    assert np.isfinite(np.asarray(r)).all()
+
+
+def test_pattern_matcher():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        _conv_bn_model()
+    m = PatternMatcher(main.global_block())
+    chains = m.match(['conv2d', 'batch_norm'])
+    assert len(chains) == 1
+    assert [op.type for op in chains[0]] == ['conv2d', 'batch_norm']
+    assert m.match(['conv2d', 'softmax']) == []
+
+
+def test_custom_pass_registration():
+    @register_pass('test_only_noop_pass')
+    class Noop(Pass):
+        def apply_impl(self, program, scope):
+            pass
+    assert 'test_only_noop_pass' in PassRegistry.names()
+    main = fluid.Program()
+    v0 = main._version
+    apply_passes(main, ['test_only_noop_pass'])
+    assert main._version != v0      # caches invalidated
+
+
+def test_inference_transpiler_runs_clean_passes():
+    """Weak #8 (r2): InferenceTranspiler must run is_test +
+    identity-scale-clean, not only conv+BN folding."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img, out = _conv_bn_model()
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        infer = main.clone(for_test=True)
+        ref, = exe.run(infer, feed={'pimg': np.ones((2, 3, 8, 8),
+                                                    'float32')},
+                       fetch_list=[out.name], scope=scope)
+        fluid.transpiler.InferenceTranspiler().transpile(infer, scope=scope)
+        types = [op.type for op in infer.global_block().ops]
+        assert 'scale' not in types          # identity scale cleaned
+        assert 'batch_norm' not in types     # folded into conv
+        got, = exe.run(infer, feed={'pimg': np.ones((2, 3, 8, 8),
+                                                    'float32')},
+                       fetch_list=[out.name], scope=scope)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)

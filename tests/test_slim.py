@@ -186,3 +186,43 @@ def test_quantization_strategy():
         assert blobs
         for blob, scale in blobs.values():
             assert blob.dtype == np.int8 and scale > 0
+
+
+def test_channel_prune_through_reshape_fc():
+    """Channel pruning must follow reshape([-1, C*H*W]) into the FC weight
+    rows and shrink the reshape's target dim (round-3 review finding)."""
+    images, labels = _synthetic_digits(32)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name='img', shape=[1, 28, 28],
+                                dtype='float32')
+        label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+        c = fluid.layers.conv2d(img, num_filters=8, filter_size=5,
+                                padding=2, act='relu')
+        p = fluid.layers.pool2d(c, pool_size=4, pool_stride=4)
+        flat = fluid.layers.reshape(p, [-1, 8 * 7 * 7])
+        pred = fluid.layers.fc(flat, size=10, act='softmax')
+        cost = fluid.layers.mean(
+            fluid.layers.cross_entropy(input=pred, label=label))
+        fluid.optimizer.SGD(0.01).minimize(cost)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        feed = {'img': images, 'label': labels}
+        exe.run(main, feed=feed, fetch_list=[cost], scope=scope)
+        f1 = next(op.input('Filter')[0]
+                  for op in main.global_block().ops
+                  if op.type == 'conv2d')
+        fc_w = next(op.input('Y')[0] for op in main.global_block().ops
+                    if op.type == 'mul')
+        n_fc_before = np.asarray(scope.get(fc_w)).shape[0]
+        ChannelPruner(main, scope).prune_conv(f1, keep_ratio=0.5)
+        assert np.asarray(scope.get(fc_w)).shape[0] == n_fc_before // 2
+        # the reshape target dim shrank with the channels
+        rs = next(op for op in main.global_block().ops
+                  if op.type in ('reshape', 'reshape2'))
+        assert rs.attr('shape')[1] == 4 * 7 * 7
+        # finetune still runs on the pruned network
+        out, = exe.run(main, feed=feed, fetch_list=[cost], scope=scope)
+        assert np.isfinite(np.asarray(out)).all()

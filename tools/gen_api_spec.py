@@ -1,0 +1,79 @@
+"""Generate API.spec: the frozen public-API signature list (the reference
+CI gate paddle/fluid/API.spec checked by tools/diff_api.py). Run from the
+repo root to regenerate after an INTENTIONAL API change:
+
+    JAX_PLATFORMS=cpu python tools/gen_api_spec.py > API.spec
+"""
+import inspect
+import os
+import sys
+
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _spec_of(fn):
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        return '(unsignaturable)'
+    return str(sig)
+
+
+def iter_api():
+    import jax
+    try:
+        jax.config.update('jax_platforms', 'cpu')
+    except Exception:
+        pass
+    import paddle_tpu as fluid
+
+    modules = [
+        ('paddle_tpu', fluid),
+        ('paddle_tpu.layers', fluid.layers),
+        ('paddle_tpu.layers.detection', fluid.layers.detection),
+        ('paddle_tpu.optimizer', fluid.optimizer),
+        ('paddle_tpu.initializer', fluid.initializer),
+        ('paddle_tpu.regularizer', fluid.regularizer),
+        ('paddle_tpu.clip', fluid.clip),
+        ('paddle_tpu.metrics', fluid.metrics),
+        ('paddle_tpu.io', fluid.io),
+        ('paddle_tpu.nets', fluid.nets),
+        ('paddle_tpu.reader', fluid.reader),
+        ('paddle_tpu.imperative', fluid.imperative),
+        ('paddle_tpu.contrib.slim', fluid.contrib.slim),
+        ('paddle_tpu.parallel', fluid.parallel),
+        ('paddle_tpu.distributed.launch',
+         __import__('paddle_tpu.distributed.launch',
+                    fromlist=['launch'])),
+    ]
+    rows = []
+    for mod_name, mod in modules:
+        names = getattr(mod, '__all__', None)
+        if names is None:
+            names = [n for n in dir(mod) if not n.startswith('_')
+                     and (inspect.isfunction(getattr(mod, n))
+                          or inspect.isclass(getattr(mod, n)))]
+        for name in sorted(names):
+            obj = getattr(mod, name, None)
+            if obj is None:
+                continue
+            if inspect.isclass(obj):
+                rows.append('%s.%s.__init__ %s' % (
+                    mod_name, name, _spec_of(obj.__init__)))
+                for meth in sorted(vars(obj)):
+                    if meth.startswith('_'):
+                        continue
+                    m = getattr(obj, meth)
+                    if callable(m):
+                        rows.append('%s.%s.%s %s' % (
+                            mod_name, name, meth, _spec_of(m)))
+            elif callable(obj):
+                rows.append('%s.%s %s' % (mod_name, name, _spec_of(obj)))
+    return rows
+
+
+if __name__ == '__main__':
+    for row in iter_api():
+        sys.stdout.write(row + '\n')
