@@ -38,6 +38,7 @@ __all__ = [
     'dice_loss', 'image_resize_short', 'lstm', 'lstm_unit',
     'conv3d_transpose', 'similarity_focus', 'tree_conv',
     'merge_selected_rows', 'get_tensor_from_selected_rows',
+    'switch_moe',
     'teacher_student_sigmoid_loss', 'selu', 'swish',
     'sharding_constraint', 'linear_chain_crf', 'crf_decoding', 'warpctc',
     'ctc_greedy_decoder', 'edit_distance',
@@ -1932,3 +1933,46 @@ def get_tensor_from_selected_rows(x, name=None):
     helper.append_op(type='get_tensor_from_selected_rows',
                      inputs={'X': [x]}, outputs={'Out': [out]})
     return out
+
+
+def switch_moe(input, num_experts, d_ff, capacity_factor=1.25,
+               param_attr=None, name=None):
+    """Switch (top-1) Mixture-of-Experts FFN layer with expert parallelism
+    (TPU-native extension; functional core parallel/moe.py). Returns
+    (out, aux_loss): add `out` to the residual stream and `aux_loss`
+    (scaled) to the training loss."""
+    helper = LayerHelper('switch_moe', param_attr=param_attr, name=name)
+    d = input.shape[-1]
+    # five distinct parameters: a shared ParamAttr would collide on name
+    # (create_parameter assigns attr.name in place); an explicit user name
+    # is suffixed per parameter
+    attrs = helper.multiple_param_attr(5)
+    for i, a in enumerate(attrs):
+        if isinstance(a, ParamAttr) and a.name:
+            a.name = '%s.p%d' % (a.name, i)
+    rw = helper.create_parameter(attr=attrs[0],
+                                 shape=[d, num_experts], dtype=input.dtype)
+    wi = helper.create_parameter(attr=attrs[1],
+                                 shape=[num_experts, d, d_ff],
+                                 dtype=input.dtype)
+    bi = helper.create_parameter(attr=attrs[2],
+                                 shape=[num_experts, d_ff],
+                                 dtype=input.dtype, is_bias=True)
+    wo = helper.create_parameter(attr=attrs[3],
+                                 shape=[num_experts, d_ff, d],
+                                 dtype=input.dtype)
+    bo = helper.create_parameter(attr=attrs[4],
+                                 shape=[num_experts, d],
+                                 dtype=input.dtype, is_bias=True)
+    out = helper.create_variable_for_type_inference(
+        input.dtype, shape=input.shape)
+    aux = helper.create_variable_for_type_inference(
+        input.dtype, shape=(1,))
+    helper.append_op(
+        type='switch_moe',
+        inputs={'X': [input], 'RouterW': [rw], 'ExpertWIn': [wi],
+                'ExpertBIn': [bi], 'ExpertWOut': [wo],
+                'ExpertBOut': [bo]},
+        outputs={'Out': [out], 'AuxLoss': [aux]},
+        attrs={'capacity_factor': capacity_factor})
+    return out, aux

@@ -533,3 +533,48 @@ def _py_func(ctx, op):
     outs = outs if isinstance(outs, (list, tuple)) else [outs]
     for nm, o in zip(out_names, outs):
         ctx.set(nm, o)
+
+
+@register_op('switch_moe')
+def _switch_moe_op(ctx, op):
+    """Program-level switch-MoE FFN (TPU-native EP extension; functional
+    core in parallel/moe.py). Inputs X [N, T, d] (or [n, d]), RouterW
+    [d, E], ExpertWIn [E, d, ff], ExpertBIn [E, ff], ExpertWOut [E, ff, d],
+    ExpertBOut [E, d]; outputs Out (same shape as X, dropped tokens zero —
+    add the residual in the program) and AuxLoss (scalar load-balancing
+    term). Under an active mesh with an 'expert' axis the all_to_all EP
+    dataflow runs; otherwise a dense single-device evaluation."""
+    x = ctx.in1(op, 'X')
+    rw = ctx.in1(op, 'RouterW')
+    wi = ctx.in1(op, 'ExpertWIn')
+    bi = ctx.in1(op, 'ExpertBIn')
+    wo = ctx.in1(op, 'ExpertWOut')
+    bo = ctx.in1(op, 'ExpertBOut')
+    cf = float(op.attr('capacity_factor', 1.25))
+    orig_shape = x.shape
+    xt = x.reshape(-1, x.shape[-1])
+    from ..parallel.api import get_active_mesh
+    mesh = get_active_mesh()
+    n_exp = wi.shape[0]
+    if mesh is not None and 'expert' in mesh.axis_names and \
+            mesh.shape['expert'] > 1 and \
+            n_exp % mesh.shape['expert'] == 0 and \
+            xt.shape[0] % mesh.shape['expert'] == 0:
+        from ..parallel.moe import switch_moe
+        out, aux = switch_moe(xt, rw, wi, bi, wo, bo, mesh,
+                              capacity_factor=cf)
+    else:
+        # dense single-device evaluation (same semantics, no drops)
+        probs = jax.nn.softmax(xt @ rw, axis=-1)
+        idx = jnp.argmax(probs, axis=-1)
+        gate = jnp.max(probs, axis=-1)
+        h = jax.nn.relu(jnp.einsum('nd,edf->enf', xt, wi)
+                        + bi[:, None, :])
+        y_all = jnp.einsum('enf,efd->end', h, wo) + bo[:, None, :]
+        sel = jax.nn.one_hot(idx, n_exp, dtype=xt.dtype)   # [n, E]
+        out = jnp.einsum('ne,end->nd', sel, y_all) * gate[:, None]
+        frac_tokens = jnp.mean(sel, axis=0)
+        frac_probs = jnp.mean(probs, axis=0)
+        aux = n_exp * jnp.sum(frac_tokens * frac_probs)
+    ctx.out(op, 'Out', out.reshape(orig_shape))
+    ctx.out(op, 'AuxLoss', aux.reshape(1))

@@ -12,3 +12,5 @@ from . import api
 from .mesh import default_device_count, make_mesh, data_mesh
 from .api import MeshRunner, ShardingRules
 from .ring_attention import ring_attention
+from .pipeline import gpipe
+from .moe import switch_moe

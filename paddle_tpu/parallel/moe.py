@@ -1,0 +1,127 @@
+"""Mixture-of-Experts with expert parallelism over a mesh axis.
+
+The reference has no MoE/EP (SURVEY §2.7: absent); this is the TPU-native
+extension: switch (top-1) routing with static capacity, experts sharded
+over mesh axis 'expert', tokens exchanged with lax.all_to_all over ICI —
+the standard TPU MoE dataflow (dispatch einsum -> all_to_all -> expert
+FFN -> all_to_all -> combine einsum), entirely static-shaped: tokens over
+capacity are dropped and passed through the residual, exactly like
+production switch transformers.
+
+`switch_moe` is the functional core; it composes under jit/AD (router and
+experts train end-to-end; the load-balancing auxiliary loss is returned
+for the trainer to add).
+"""
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+__all__ = ['switch_moe']
+
+
+def _moe_inner(axis_name, tok_axis, n_experts, capacity, act_fn, x,
+               router_w, w_in, b_in, w_out, b_out):
+    """Per-device body. x: [n_local, d] this device's token shard;
+    w_in/... : [E_local, ...] this device's experts."""
+    n_dev = lax.psum(1, axis_name)
+    n_local, d = x.shape
+    e_local = n_experts // n_dev
+
+    # --- routing (every device routes its own tokens over ALL experts)
+    logits = x @ router_w                          # [n, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert_idx = jnp.argmax(probs, axis=-1)        # [n]
+    gate = jnp.max(probs, axis=-1)                 # [n]
+
+    # position of each token in its expert's queue; beyond capacity drops
+    onehot = jax.nn.one_hot(expert_idx, n_experts, dtype=jnp.int32)
+    pos = jnp.cumsum(onehot, axis=0) * onehot      # 1-based where routed
+    pos = jnp.sum(pos, axis=-1) - 1                # [n]
+    keep = pos < capacity
+
+    # dispatch tensor [n, E, C] — the classic one-hot einsum (built in
+    # x.dtype so bf16 stays bf16 end to end)
+    disp = (jax.nn.one_hot(expert_idx, n_experts,
+                           dtype=x.dtype)[:, :, None]
+            * jax.nn.one_hot(jnp.clip(pos, 0, capacity - 1), capacity,
+                             dtype=x.dtype)[:, None, :]
+            * keep[:, None, None].astype(x.dtype))
+    # [E, C, d] slots for this device's tokens
+    slots = jnp.einsum('nec,nd->ecd', disp, x)
+
+    # --- all_to_all: each device keeps its E_local experts' slots from
+    # every peer: [E, C, d] -> [E_local, n_dev, C, d]
+    slots = slots.reshape(n_dev, e_local, capacity, d)
+    slots = lax.all_to_all(slots, axis_name, split_axis=0, concat_axis=0,
+                           tiled=False)            # [n_dev, e_local, C, d]
+    slots = slots.transpose(1, 0, 2, 3).reshape(e_local,
+                                                n_dev * capacity, d)
+
+    # --- expert FFN on the gathered tokens
+    h = act_fn(jnp.einsum('end,edf->enf', slots, w_in) + b_in[:, None, :])
+    y = jnp.einsum('enf,efd->end', h, w_out) + b_out[:, None, :]
+
+    # --- route back
+    y = y.reshape(e_local, n_dev, capacity, d).transpose(1, 0, 2, 3)
+    y = lax.all_to_all(y, axis_name, split_axis=0, concat_axis=0,
+                       tiled=False)                # [n_dev, e_local, C, d]
+    y = y.reshape(n_experts, capacity, d)
+
+    # --- combine: weighted un-dispatch; dropped tokens get zeros (caller
+    # adds the residual)
+    out = jnp.einsum('nec,ecd->nd', disp * gate[:, None, None], y)
+
+    # load-balancing aux loss (Switch Transformer eq. 4), psum'd so every
+    # shard sees the global value
+    frac_tokens = jnp.mean(
+        jax.nn.one_hot(expert_idx, n_experts, dtype=x.dtype), axis=0)
+    frac_probs = jnp.mean(probs, axis=0)
+    # global fractions: mean over ALL token shards (the token axis may be
+    # a separate data axis)
+    axes = (axis_name,) if tok_axis == axis_name else (axis_name, tok_axis)
+    aux = n_experts * jnp.sum(
+        lax.pmean(frac_tokens, axes) * lax.pmean(frac_probs, axes))
+    return out, aux
+
+
+def switch_moe(x, router_w, expert_w_in, expert_b_in, expert_w_out,
+               expert_b_out, mesh, axis_name='expert',
+               capacity_factor=1.25, activation=jax.nn.relu,
+               data_axis=None):
+    """Top-1 (switch) MoE FFN with expert parallelism.
+
+    x: [n_tokens, d] (flatten batch*seq first), sharded over `data_axis`
+    (or `axis_name` if data_axis is None — the EP=DP layout) or
+    replicated.
+    router_w: [d, E]; expert_w_in: [E, d, ff]; expert_b_in: [E, ff];
+    expert_w_out: [E, ff, d]; expert_b_out: [E, d] — experts sharded over
+    `axis_name`.
+    Returns (y [n_tokens, d], aux_loss scalar): y is zero for dropped
+    tokens (add the residual outside); aux_loss is the Switch
+    load-balancing term.
+    """
+    n_exp = expert_w_in.shape[0]
+    n_dev = mesh.shape[axis_name]
+    if n_exp % n_dev:
+        raise ValueError("num experts %d not divisible by %r axis size %d"
+                         % (n_exp, axis_name, n_dev))
+    tok_axis = data_axis or axis_name
+    n_tok = x.shape[0]
+    shards = mesh.shape[tok_axis] if tok_axis in mesh.axis_names else 1
+    local_tok = n_tok // max(shards, 1)
+    capacity = max(int(np.ceil(capacity_factor * local_tok / n_exp)), 1)
+
+    from .ring_attention import _shard_map
+    espec = P(axis_name)
+    inner = functools.partial(_moe_inner, axis_name, tok_axis, n_exp,
+                              capacity, activation)
+    fn = _shard_map(
+        inner, mesh,
+        (P(tok_axis), P(), espec, espec, espec, espec),
+        (P(tok_axis), P()))
+    return fn(x, router_w, expert_w_in, expert_b_in, expert_w_out,
+              expert_b_out)

@@ -1,0 +1,110 @@
+"""Pipeline parallelism: GPipe-style microbatch pipelining over a mesh axis.
+
+The reference has no pipeline parallelism (SURVEY §2.7: PP absent); this is
+the TPU-native extension: homogeneous stages (the transformer case) hold
+their parameters sharded over mesh axis 'pipe', microbatches stream through
+the ring with lax.ppermute (collective-permute pipelining — activations
+move over ICI while every device computes a different microbatch), and the
+bubble is the classic (S-1)/(M+S-1) fraction. Everything is lax.fori_loop
++ masking, so the schedule is differentiable and jit/XLA-native: the
+backward pass is the reverse pipeline automatically via AD.
+
+gpipe(stage_fn, stage_params, x, ...) is the functional combinator; stage
+parameters are a pytree whose leaves carry a leading [S] stage dimension
+(sharded P('pipe') under the mesh), and stage_fn(params_slice, x) -> y must
+be shape-preserving (d_model -> d_model), like a transformer block.
+"""
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+__all__ = ['gpipe']
+
+
+def _gpipe_inner(axis_name, stage_fn, n_micro, params_local, x_all):
+    """Per-device body: params_local = this stage's params (leading stage
+    dim of size 1), x_all = [M, mb, ...] microbatches (replicated)."""
+    s = lax.axis_index(axis_name)
+    n_stage = lax.psum(1, axis_name)
+    params_local = jax.tree_util.tree_map(lambda p: p[0], params_local)
+    m = n_micro
+    mb_shape = x_all.shape[1:]
+
+    out_buf = jnp.zeros((m,) + mb_shape, x_all.dtype)
+    act0 = jnp.zeros(mb_shape, x_all.dtype)
+
+    def step(t, carry):
+        act, out_buf = carry
+        # stage 0 ingests microbatch t (clipped; inactive lanes masked)
+        x_t = x_all[jnp.clip(t, 0, m - 1)]
+        act_in = jnp.where(s == 0, x_t, act)
+        y = stage_fn(params_local, act_in)
+        mb_idx = t - s
+        active = (mb_idx >= 0) & (mb_idx < m)
+        y = jnp.where(active, y, act_in)
+        # the final stage records its finished microbatch
+        write = active & (s == n_stage - 1)
+        idx = jnp.clip(mb_idx, 0, m - 1)
+        out_buf = jnp.where(
+            write,
+            lax.dynamic_update_index_in_dim(out_buf, y, idx, 0),
+            out_buf)
+        # ship activations one stage down the ring
+        act_next = _ring_shift(y, axis_name)
+        return act_next, out_buf
+
+    n_steps = m + _static_axis_size(axis_name) - 1
+    act, out_buf = lax.fori_loop(0, n_steps, step, (act0, out_buf))
+    # only the last stage holds real outputs; sum-broadcast over the axis
+    out_buf = jnp.where(s == n_stage - 1, out_buf, 0.0)
+    return lax.psum(out_buf, axis_name)
+
+
+def _static_axis_size(axis_name):
+    # inside shard_map psum(1) folds to the static axis size
+    return lax.psum(1, axis_name)
+
+
+def _ring_shift(x, axis_name):
+    n = lax.psum(1, axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name, perm)
+
+
+def gpipe(stage_fn, stage_params, x, mesh, axis_name='pipe',
+          num_microbatches=None):
+    """Run x through S pipelined stages.
+
+    stage_fn(params, x_mb) -> y_mb: one stage, shape-preserving.
+    stage_params: pytree with leading stage dim S on every leaf (sharded
+    over `axis_name`).
+    x: [B, ...] global batch; B must divide into num_microbatches
+    (default: S, the minimum that fills the pipeline).
+    Returns stage_S(...stage_1(x)) with the same sharding as x
+    (replicated over the pipe axis).
+    """
+    n_stage = mesh.shape[axis_name]
+    for leaf in jax.tree_util.tree_leaves(stage_params):
+        if leaf.shape[0] != n_stage:
+            raise ValueError(
+                "stage_params leaf leading dim %d != mesh axis %r size %d "
+                "(every leaf needs the [S] stage dimension)"
+                % (leaf.shape[0], axis_name, n_stage))
+    m = num_microbatches or n_stage
+    b = x.shape[0]
+    if b % m:
+        raise ValueError("batch %d not divisible by %d microbatches"
+                         % (b, m))
+    x_mb = x.reshape((m, b // m) + x.shape[1:])
+
+    from .ring_attention import _shard_map
+    pspec = jax.tree_util.tree_map(
+        lambda _: P(axis_name), stage_params)
+    inner = functools.partial(_gpipe_inner, axis_name, stage_fn, m)
+    fn = _shard_map(inner, mesh, (pspec, P()), P())
+    out = fn(stage_params, x_mb)
+    return out.reshape(x.shape)

@@ -1,0 +1,218 @@
+"""Pipeline parallelism (GPipe over mesh axis 'pipe') and expert-parallel
+switch MoE — the TPU-native extensions for SURVEY §2.7's absent PP/EP
+rows; both checked for exact parity against serial references on the
+8-virtual-device CPU mesh."""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as fluid
+from paddle_tpu.parallel import make_mesh, gpipe, switch_moe
+
+
+def _stage_fn(params, x):
+    w, b = params
+    return jnp.tanh(x @ w + b)
+
+
+def _stage_params(s, d, seed=0):
+    rng = np.random.RandomState(seed)
+    w = jnp.asarray(rng.randn(s, d, d).astype('float32') * 0.3)
+    b = jnp.asarray(rng.randn(s, d).astype('float32') * 0.1)
+    return (w, b)
+
+
+def _serial(params, x):
+    w, b = params
+    for i in range(w.shape[0]):
+        x = _stage_fn((w[i], b[i]), x)
+    return x
+
+
+@pytest.mark.parametrize("n_micro", [4, 8])
+def test_gpipe_matches_serial(n_micro):
+    s, d, batch = 4, 8, 16
+    mesh = make_mesh([('pipe', s)])
+    params = _stage_params(s, d)
+    x = jnp.asarray(np.random.RandomState(1)
+                    .randn(batch, d).astype('float32'))
+    out = gpipe(_stage_fn, params, x, mesh, num_microbatches=n_micro)
+    ref = _serial(params, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_gpipe_grads_match_serial():
+    """AD through the pipeline schedule = the reverse pipeline; grads must
+    equal the serial composition's."""
+    s, d, batch = 4, 6, 8
+    mesh = make_mesh([('pipe', s)])
+    params = _stage_params(s, d, seed=2)
+    x = jnp.asarray(np.random.RandomState(3)
+                    .randn(batch, d).astype('float32'))
+
+    def loss_pipe(params):
+        return jnp.sum(gpipe(_stage_fn, params, x, mesh) ** 2)
+
+    def loss_serial(params):
+        return jnp.sum(_serial(params, x) ** 2)
+
+    g1 = jax.grad(loss_pipe)(params)
+    g2 = jax.grad(loss_serial)(params)
+    for a, b in zip(jax.tree_util.tree_leaves(g1),
+                    jax.tree_util.tree_leaves(g2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=3e-4, atol=3e-5)
+
+
+def test_gpipe_validates_stage_count():
+    mesh = make_mesh([('pipe', 4)])
+    params = _stage_params(3, 8)
+    with pytest.raises(ValueError, match="leading dim"):
+        gpipe(_stage_fn, params, jnp.zeros((8, 8)), mesh)
+
+
+def _moe_ref(x, rw, wi, bi, wo, bo):
+    """Dense per-token reference: top-1 expert, gate-weighted."""
+    probs = jax.nn.softmax(x @ rw, axis=-1)
+    idx = np.asarray(jnp.argmax(probs, axis=-1))
+    gate = np.asarray(jnp.max(probs, axis=-1))
+    out = np.zeros_like(np.asarray(x))
+    for n in range(x.shape[0]):
+        e = int(idx[n])
+        h = np.maximum(np.asarray(x)[n] @ np.asarray(wi)[e]
+                       + np.asarray(bi)[e], 0)
+        out[n] = gate[n] * (h @ np.asarray(wo)[e] + np.asarray(bo)[e])
+    return out
+
+
+def test_switch_moe_matches_dense():
+    """With generous capacity nothing drops: EP all_to_all dataflow must
+    equal the dense per-token reference exactly."""
+    e, d, ff, n_tok = 8, 6, 12, 32
+    mesh = make_mesh([('expert', 8)])
+    rng = np.random.RandomState(4)
+    x = jnp.asarray(rng.randn(n_tok, d).astype('float32'))
+    rw = jnp.asarray(rng.randn(d, e).astype('float32'))
+    wi = jnp.asarray(rng.randn(e, d, ff).astype('float32') * 0.3)
+    bi = jnp.asarray(rng.randn(e, ff).astype('float32') * 0.1)
+    wo = jnp.asarray(rng.randn(e, ff, d).astype('float32') * 0.3)
+    bo = jnp.asarray(rng.randn(e, d).astype('float32') * 0.1)
+    out, aux = switch_moe(x, rw, wi, bi, wo, bo, mesh,
+                          capacity_factor=float(n_tok))  # no drops
+    ref = _moe_ref(x, rw, wi, bi, wo, bo)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4,
+                               atol=2e-5)
+    assert np.isfinite(float(aux)) and float(aux) > 0
+
+
+def test_switch_moe_capacity_drops_and_grads():
+    """Tokens over capacity produce zero output (residual passthrough),
+    and gradients flow to router + experts."""
+    e, d, ff, n_tok = 4, 4, 8, 16
+    mesh = make_mesh([('expert', 4)])
+    rng = np.random.RandomState(5)
+    x = jnp.asarray(rng.randn(n_tok, d).astype('float32'))
+    rw = jnp.asarray(np.zeros((d, e), 'float32'))  # uniform router ->
+    # argmax all-0 -> everything routes to expert 0, capacity drops most
+    wi = jnp.asarray(rng.randn(e, d, ff).astype('float32') * 0.3)
+    bi = jnp.asarray(np.zeros((e, ff), 'float32'))
+    wo = jnp.asarray(rng.randn(e, ff, d).astype('float32') * 0.3)
+    bo = jnp.asarray(np.zeros((e, d), 'float32'))
+    out, aux = switch_moe(x, rw, wi, bi, wo, bo, mesh,
+                          capacity_factor=1.0)
+    out = np.asarray(out)
+    # capacity = ceil(1.0 * local_tok / E) with 4 shards of 4 tokens = 1
+    # slot per expert per shard -> exactly 1 token kept per shard
+    nonzero_rows = (np.abs(out).sum(axis=1) > 1e-7).sum()
+    assert nonzero_rows == 4, nonzero_rows
+
+    def loss(rw, wi):
+        y, aux = switch_moe(x, rw, wi, bi, wo, bo, mesh,
+                            capacity_factor=4.0)
+        return jnp.sum(y ** 2) + 0.01 * aux
+
+    g_rw, g_wi = jax.grad(loss, argnums=(0, 1))(
+        jnp.asarray(rng.randn(d, e).astype('float32')), wi)
+    assert np.isfinite(np.asarray(g_rw)).all()
+    assert np.abs(np.asarray(g_wi)).sum() > 0
+
+
+def test_switch_moe_layer_in_program():
+    """layers.switch_moe trains inside a Program (dense path off-mesh;
+    the EP path is exercised by the MeshRunner test below)."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 11
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='mx', shape=[8], dtype='float32')
+        y = fluid.layers.data(name='my', shape=[1], dtype='int64')
+        h = fluid.layers.fc(x, size=16, act='relu')
+        moe_out, aux = fluid.layers.switch_moe(h, num_experts=4, d_ff=32)
+        h2 = fluid.layers.elementwise_add(h, moe_out)   # residual
+        p = fluid.layers.fc(h2, size=4, act='softmax')
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(p, y))
+        total = fluid.layers.elementwise_add(
+            loss, fluid.layers.scale(fluid.layers.mean(aux), scale=0.01))
+        fluid.optimizer.Adam(1e-2).minimize(total)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    feed = {'mx': rng.randn(32, 8).astype('float32'),
+            'my': rng.randint(0, 4, (32, 1)).astype('int64')}
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        losses = [float(np.asarray(exe.run(
+            main, feed=feed, fetch_list=[loss], scope=scope)[0])
+            .reshape(())) for _ in range(8)]
+    assert losses[-1] < losses[0], losses
+
+
+def test_switch_moe_layer_under_expert_mesh():
+    """The same program under a MeshRunner with an 'expert' axis runs the
+    all_to_all EP dataflow (op dispatches on the active mesh)."""
+    from jax.sharding import PartitionSpec as P
+    from paddle_tpu.parallel import MeshRunner, ShardingRules
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 13
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='ex', shape=[8], dtype='float32')
+        y = fluid.layers.data(name='ey', shape=[1], dtype='int64')
+        h = fluid.layers.fc(x, size=8, act='relu')
+        moe_out, aux = fluid.layers.switch_moe(
+            h, num_experts=4, d_ff=16, capacity_factor=64.0)
+        h2 = fluid.layers.elementwise_add(h, moe_out)
+        p = fluid.layers.fc(h2, size=3, act='softmax')
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(p, y))
+        fluid.optimizer.SGD(0.05).minimize(loss)
+    mesh = make_mesh([('expert', 4)])
+    rules = ShardingRules([
+        (r'switch_moe_\d+\.w_[1-4]', P('expert')),
+    ])
+    runner = MeshRunner(main, mesh, param_rules=rules,
+                        feed_specs={'ex': P('expert'), 'ey': P('expert')})
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    rng = np.random.RandomState(1)
+    feed = {'ex': rng.randn(32, 8).astype('float32'),
+            'ey': rng.randint(0, 3, (32, 1)).astype('int64')}
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        vals = [float(np.asarray(runner.run(feed, [loss.name], scope)[0])
+                      .reshape(-1)[0]) for _ in range(4)]
+    assert all(np.isfinite(vals)), vals
+    assert vals[-1] < vals[0], vals
+
+
+def test_switch_moe_layer_named_param_attr():
+    """An explicitly named param_attr must yield five DISTINCT parameters
+    (suffixed), not a name collision (round-3 review finding)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='nx', shape=[6], dtype='float32')
+        out, aux = fluid.layers.switch_moe(
+            x, num_experts=2, d_ff=8,
+            param_attr=fluid.ParamAttr(name='my_moe'))
+    names = [p.name for p in main.all_parameters()]
+    moe_names = [n for n in names if n.startswith('my_moe')]
+    assert len(moe_names) == len(set(moe_names)) == 5, moe_names

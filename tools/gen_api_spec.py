@@ -14,11 +14,15 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 
 def _spec_of(fn):
+    import re
     try:
         sig = inspect.signature(fn)
     except (TypeError, ValueError):
         return '(unsignaturable)'
-    return str(sig)
+    # object reprs embed per-process addresses and private module paths
+    # (both unstable across processes/jax versions) — normalize them
+    out = re.sub(r' at 0x[0-9a-f]+', '', str(sig))
+    return re.sub(r'<[\w\.]+ object>', '<object>', out)
 
 
 def iter_api():
