@@ -259,16 +259,24 @@ def _child(mode):
                         type(e).__name__, str(e)[:200])}
                     time.sleep(5)
 
+        def _set_mfu(name):
+            r = models.get(name)
+            if isinstance(r, dict) and peak and 'flops_per_step' in r:
+                r['mfu'] = round(r['flops_per_step']
+                                 / (r['step_ms'] / 1000) / peak, 4)
+
         _try('lm_large', _bench_lm,
              dict(vocab_size=32000, seq_len=512, d_model=1024, n_head=16,
                   n_layer=8, d_ff=4096, dropout=0.1, attn_dropout=0.0,
                   use_flash_attention=True),
              32, 20, 2, True)
-        if isinstance(models.get('lm_large'), dict) and peak and \
-                'flops_per_step' in models['lm_large']:
-            r = models['lm_large']
-            r['mfu'] = round(r['flops_per_step']
-                             / (r['step_ms'] / 1000) / peak, 4)
+        _set_mfu('lm_large')
+        _try('lm_long_seq8k', _bench_lm,
+             dict(vocab_size=32000, seq_len=8192, d_model=512, n_head=8,
+                  n_layer=4, d_ff=2048, dropout=0.0, attn_dropout=0.0,
+                  use_flash_attention=True),
+             2, 10, 2, True)
+        _set_mfu('lm_long_seq8k')
         _try('resnet50', _bench_resnet50, 64, 4, 3, True)
         _try('ctr_sparse', _bench_ctr, 512, 50, 3)
     for r in models.values():

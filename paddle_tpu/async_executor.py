@@ -94,7 +94,18 @@ class MultiSlotDataFeed(object):
 
     def batches_from_file(self, path):
         """Yield feed dicts of up to batch_size samples. Ragged uint64
-        slots become (values [total, 1], lod) pairs; dense slots stack."""
+        slots become (values [total, 1], lod) pairs; dense slots stack.
+        Parsing runs in the native C++ tier when the toolchain is present
+        (reference framework/data_feed.cc), else the python tokenizer."""
+        import os as _os
+        try:
+            # the native path materializes the parsed file in memory; very
+            # large files stream through the python tokenizer instead
+            if _os.path.getsize(path) <= self.NATIVE_MAX_BYTES:
+                yield from self._batches_native(path)
+                return
+        except RuntimeError:
+            pass          # no toolchain: python fallback below
         batch = []
         with open(path, 'r') as f:
             for line in f:
@@ -107,6 +118,103 @@ class MultiSlotDataFeed(object):
                     batch = []
         if batch:
             yield self._assemble(batch)
+
+    # -- native parser path (reference data_feed.cc ParseOneInstance) ----
+    _native = None
+    NATIVE_MAX_BYTES = 256 * 1024 * 1024
+
+    @classmethod
+    def _native_lib(cls):
+        import ctypes
+        if cls._native is None:
+            from .native import load_library
+            lib = load_library('multislot', ['multislot.cc'])
+            lib.ms_parse_file.restype = ctypes.c_void_p
+            lib.ms_parse_file.argtypes = [
+                ctypes.c_char_p, ctypes.c_int,
+                ctypes.POINTER(ctypes.c_int),
+                ctypes.POINTER(ctypes.c_char_p)]
+            lib.ms_num_samples.restype = ctypes.c_int64
+            lib.ms_num_samples.argtypes = [ctypes.c_void_p]
+            lib.ms_slot_total.restype = ctypes.c_int64
+            lib.ms_slot_total.argtypes = [ctypes.c_void_p, ctypes.c_int]
+            lib.ms_slot_copy_u64.argtypes = [
+                ctypes.c_void_p, ctypes.c_int,
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_int64)]
+            lib.ms_slot_copy_float.argtypes = [
+                ctypes.c_void_p, ctypes.c_int,
+                ctypes.POINTER(ctypes.c_float),
+                ctypes.POINTER(ctypes.c_int64)]
+            lib.ms_free.argtypes = [ctypes.c_void_p]
+            cls._native = lib
+        return cls._native
+
+    def parse_file_native(self, path):
+        """Parse a whole MultiSlot file in C++; returns
+        (n_samples, {slot_name: (values, per_sample_lens)})."""
+        import ctypes
+        lib = self._native_lib()
+        slots = self.desc.slots
+        is_float = (ctypes.c_int * len(slots))(
+            *[1 if sl['type'] == 'float' else 0 for sl in slots])
+        err = ctypes.c_char_p()
+        h = lib.ms_parse_file(path.encode(), len(slots), is_float,
+                              ctypes.byref(err))
+        if not h:
+            raise ValueError(
+                "MultiSlotDataFeed(native): %s"
+                % (err.value.decode() if err.value else 'parse failed'))
+        try:
+            n = lib.ms_num_samples(h)
+            out = {}
+            for i, sl in enumerate(slots):
+                total = lib.ms_slot_total(h, i)
+                lens = np.empty(max(n, 1), np.int64)
+                if sl['type'] == 'float':
+                    vals = np.empty(max(total, 1), np.float32)
+                    lib.ms_slot_copy_float(
+                        h, i,
+                        vals.ctypes.data_as(
+                            ctypes.POINTER(ctypes.c_float)),
+                        lens.ctypes.data_as(
+                            ctypes.POINTER(ctypes.c_int64)))
+                else:
+                    vals = np.empty(max(total, 1), np.int64)
+                    lib.ms_slot_copy_u64(
+                        h, i,
+                        vals.ctypes.data_as(
+                            ctypes.POINTER(ctypes.c_int64)),
+                        lens.ctypes.data_as(
+                            ctypes.POINTER(ctypes.c_int64)))
+                out[sl['name']] = (vals[:total], lens[:n])
+            return int(n), out
+        finally:
+            lib.ms_free(h)
+
+    def _batches_native(self, path):
+        n, parsed = self.parse_file_native(path)
+        bs = self.desc.batch_size
+        offs = {name: np.concatenate([[0], np.cumsum(lens)])
+                for name, (vals, lens) in parsed.items()}
+        for lo in range(0, n, bs):
+            hi = min(lo + bs, n)
+            feed = {}
+            for sl in self.desc.slots:
+                if not sl['is_used']:
+                    continue
+                name = sl['name']
+                vals, lens = parsed[name]
+                o = offs[name]
+                chunk = vals[o[lo]:o[hi]]
+                if sl['is_dense']:
+                    width = int(lens[lo])
+                    feed[name] = chunk.reshape(hi - lo, width).astype(
+                        np.float32 if sl['type'] == 'float' else np.int64)
+                else:
+                    lod = (o[lo:hi + 1] - o[lo]).tolist()
+                    feed[name] = (chunk.reshape(-1, 1), [lod])
+            yield feed
 
     def _assemble(self, samples):
         feed = {}

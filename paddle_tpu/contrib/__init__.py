@@ -6,6 +6,12 @@ reference paddle/contrib/float16/float16_transpiler.py), slim quantization.
 from . import mixed_precision  # noqa: F401
 from . import quantize  # noqa: F401
 from . import slim  # noqa: F401
+from . import memory_usage_calc  # noqa: F401
+from .memory_usage_calc import memory_usage  # noqa: F401
+from . import op_frequence  # noqa: F401
+from .op_frequence import op_freq_statistic  # noqa: F401
+from . import hdfs_utils  # noqa: F401
+from . import decoder  # noqa: F401
 from .quantize import QuantizeTranspiler  # noqa: F401
 from . import trainer  # noqa: F401
 from .trainer import (Trainer, Inferencer, BeginEpochEvent,  # noqa: F401

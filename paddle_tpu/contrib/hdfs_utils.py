@@ -1,0 +1,71 @@
+"""HDFS client over the `hadoop fs` CLI (reference
+python/paddle/fluid/contrib/utils/hdfs_utils.py HDFSClient).
+
+The reference shells out to the hadoop binary; so does this — with a
+clear error when no hadoop toolchain is installed (the TPU training path
+reads from local disk / GCS mounts instead)."""
+import os
+import subprocess
+
+__all__ = ['HDFSClient']
+
+
+class HDFSClient(object):
+    def __init__(self, hadoop_home=None, configs=None):
+        self._hadoop = os.path.join(hadoop_home, 'bin', 'hadoop') \
+            if hadoop_home else 'hadoop'
+        self._configs = dict(configs or {})
+
+    def _run(self, *args):
+        cmd = [self._hadoop, 'fs']
+        for k, v in self._configs.items():
+            cmd += ['-D', '%s=%s' % (k, v)]
+        cmd += list(args)
+        try:
+            res = subprocess.run(cmd, capture_output=True, text=True)
+        except FileNotFoundError:
+            raise RuntimeError(
+                "hadoop binary %r not found — HDFSClient needs a hadoop "
+                "installation (pass hadoop_home=)" % self._hadoop)
+        return res.returncode, res.stdout, res.stderr
+
+    def is_exist(self, path):
+        rc, _, _ = self._run('-test', '-e', path)
+        return rc == 0
+
+    def is_dir(self, path):
+        rc, _, _ = self._run('-test', '-d', path)
+        return rc == 0
+
+    def delete(self, path):
+        rc, _, err = self._run('-rm', '-r', path)
+        return rc == 0
+
+    def upload(self, hdfs_path, local_path, overwrite=False):
+        args = ['-put'] + (['-f'] if overwrite else []) + \
+            [local_path, hdfs_path]
+        rc, _, err = self._run(*args)
+        if rc != 0:
+            raise RuntimeError("hdfs upload failed: %s" % err.strip())
+        return True
+
+    def download(self, hdfs_path, local_path):
+        rc, _, err = self._run('-get', hdfs_path, local_path)
+        if rc != 0:
+            raise RuntimeError("hdfs download failed: %s" % err.strip())
+        return True
+
+    def ls(self, path):
+        rc, out, err = self._run('-ls', path)
+        if rc != 0:
+            raise RuntimeError("hdfs ls failed: %s" % err.strip())
+        files = []
+        for line in out.splitlines():
+            parts = line.split()
+            if len(parts) >= 8:
+                files.append(parts[-1])
+        return files
+
+    def makedirs(self, path):
+        rc, _, err = self._run('-mkdir', '-p', path)
+        return rc == 0

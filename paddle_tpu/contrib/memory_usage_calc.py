@@ -1,0 +1,38 @@
+"""Estimate a Program's memory usage (reference
+python/paddle/fluid/contrib/memory_usage_calc.py memory_usage).
+
+The estimate sums var sizes with -1 batch dims bound to `batch_size`. On
+TPU the number is a lower bound on HBM residency (XLA buffer assignment
+reuses/fuses aggressively, and rematerialization trades it for FLOPs), so
+like the reference the result is reported as a range.
+"""
+import numpy as np
+
+__all__ = ['memory_usage']
+
+_DTYPE_SIZE = {
+    'float16': 2, 'bfloat16': 2, 'float32': 4, 'float64': 8,
+    'int8': 1, 'uint8': 1, 'int16': 2, 'int32': 4, 'int64': 8, 'bool': 1,
+}
+
+
+def memory_usage(program, batch_size):
+    """Returns (low_mb, high_mb): estimated memory range for one iteration
+    at `batch_size` (reference returns the same +-30% band)."""
+    if batch_size <= 0:
+        raise ValueError("batch_size must be positive")
+    total = 0
+    for block in program.blocks:
+        for var in block.vars.values():
+            shape = getattr(var, 'shape', None)
+            if not shape:
+                continue
+            size = _DTYPE_SIZE.get(str(var.dtype), 4)
+            n = 1
+            for d in shape:
+                if d is None or d < 0:
+                    d = batch_size
+                n *= int(d)
+            total += n * size
+    mb = total / (1024.0 ** 2)
+    return mb * 0.7, mb * 1.3

@@ -1,0 +1,23 @@
+"""Op-frequency statistics (reference
+python/paddle/fluid/contrib/op_frequence.py op_freq_statistic)."""
+from collections import OrderedDict
+
+__all__ = ['op_freq_statistic']
+
+
+def op_freq_statistic(program):
+    """Returns (uni_op_freq, adj_op_freq): single-op counts and adjacent
+    op-pair counts over the global block, most frequent first."""
+    uni, adj = {}, {}
+    prev = None
+    for block in program.blocks:
+        for op in block.ops:
+            uni[op.type] = uni.get(op.type, 0) + 1
+            if prev is not None:
+                key = prev + '->' + op.type
+                adj[key] = adj.get(key, 0) + 1
+            prev = op.type
+        prev = None
+    uni = OrderedDict(sorted(uni.items(), key=lambda kv: -kv[1]))
+    adj = OrderedDict(sorted(adj.items(), key=lambda kv: -kv[1]))
+    return uni, adj

@@ -244,3 +244,78 @@ def calibrate_scales(exe, program, scope, feed_batches, var_names):
             if m > maxes[n]:
                 maxes[n] = m
     return {n: (m if m > 0 else 1.0) for n, m in maxes.items()}
+
+
+def post_training_quantize(exe, program, scope, feed_batches,
+                           weight_bits=8):
+    """Post-training int8 quantization of an INFERENCE program (reference
+    contrib/int8_inference/utility.py + the mkldnn quantize/dequantize op
+    pipeline): calibrate activation scales over `feed_batches`, quantize
+    fc/mul weights to int8 blobs in the scope, and rewrite each eligible
+    mul op into quantize(int8) -> quantized_matmul(int8 x int8 -> int32 ->
+    rescale). Returns the list of rewritten op indices.
+
+    Eligible: 2-D mul ops whose Y is a parameter (the fc hot path). Other
+    ops stay fp32 — mixed int8/fp32 inference like the reference's
+    quantize/dequantize sandwiches.
+    """
+    block = program.global_block()
+    bin_max = float((1 << (weight_bits - 1)) - 1)      # 127
+
+    # 1) find eligible muls and the activation vars to calibrate
+    params = set(p.name for p in program.all_parameters())
+    targets = []
+    for idx, op in enumerate(block.ops):
+        if op.type != 'mul':
+            continue
+        if int(op.attr('x_num_col_dims', 1)) != 1:
+            continue
+        x_name = op.input('X')[0]
+        w_name = op.input('Y')[0]
+        if w_name not in params:
+            continue
+        xv = block._find_var_recursive(x_name)
+        if xv is not None and xv.shape and len(xv.shape) != 2:
+            continue
+        targets.append((idx, op, x_name, w_name))
+    if not targets:
+        return []
+
+    # 2) calibrate activation abs-max
+    act_names = sorted({x for _, _, x, _ in targets})
+    maxes = calibrate_scales(exe, program, scope, feed_batches, act_names)
+
+    # 3) quantize weights offline + rewrite ops (reverse order keeps
+    # earlier indices valid while inserting)
+    rewritten = []
+    for idx, op, x_name, w_name in reversed(targets):
+        w = np.asarray(scope.get(w_name))
+        w_absmax = float(np.max(np.abs(w))) or 1.0
+        sw = bin_max / w_absmax
+        w8 = np.clip(np.round(w * sw), -bin_max - 1,
+                     bin_max).astype(np.int8)
+        w8_name = w_name + '.int8'
+        block.create_var(name=w8_name, shape=w8.shape, dtype='int8',
+                         persistable=True)
+        scope.set(w8_name, w8)
+        sx = bin_max / maxes[x_name]
+        x8_name = x_name + '.int8'
+        xv = block._find_var_recursive(x_name)
+        block.create_var(name=x8_name,
+                         shape=tuple(xv.shape) if xv is not None and
+                         xv.shape else (-1,),
+                         dtype='int8')
+        out_name = op.output('Out')[0]
+        op.type = 'quantized_matmul'
+        op.inputs = {'X': [x8_name], 'Y': [w8_name]}
+        op.outputs = {'Out': [out_name]}
+        op.attrs = {'scale_x': sx, 'scale_y': sw}
+        block._insert_op(
+            idx, type='quantize', inputs={'Input': [x_name]},
+            outputs={'Output': [x8_name]},
+            attrs={'Scale': sx, 'is_negative_input': True})
+        rewritten.append(idx)
+    program._bump_version()
+    # indices shift with each insertion: report the FINAL positions
+    return [i for i, o in enumerate(block.ops)
+            if o.type == 'quantized_matmul']

@@ -1945,8 +1945,9 @@ def switch_moe(input, num_experts, d_ff, capacity_factor=1.25,
     d = input.shape[-1]
     # five distinct parameters: a shared ParamAttr would collide on name
     # (create_parameter assigns attr.name in place); an explicit user name
-    # is suffixed per parameter
-    attrs = helper.multiple_param_attr(5)
+    # is suffixed per parameter — on COPIES, never the caller's objects
+    import copy as _copy
+    attrs = [_copy.deepcopy(a) for a in helper.multiple_param_attr(5)]
     for i, a in enumerate(attrs):
         if isinstance(a, ParamAttr) and a.name:
             a.name = '%s.p%d' % (a.name, i)

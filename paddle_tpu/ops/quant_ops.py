@@ -128,3 +128,21 @@ def _dequantize(ctx, op):
     x = ctx.in1(op, 'Input')
     scale = float(op.attr('Scale', 1.0))
     ctx.out(op, 'Output', x.astype(jnp.float32) / scale)
+
+
+@register_op('quantized_matmul')
+def _quantized_matmul(ctx, op):
+    """Real int8 GEMM for the post-training-quantized inference path: int8
+    inputs accumulate in int32 on the MXU (preferred_element_type) and the
+    product of the two quantization scales rescales back to float — the
+    TPU analog of the reference's mkldnn int8 kernels
+    (operators/mkldnn/ int8 conv/fc; INT8 MXU throughput is 2x bf16 on
+    v5e)."""
+    x8 = ctx.in1(op, 'X')                  # int8 [N, K]
+    w8 = ctx.in1(op, 'Y')                  # int8 [K, M]
+    sx = float(op.attr('scale_x', 1.0))
+    sw = float(op.attr('scale_y', 1.0))
+    acc = jax.lax.dot_general(
+        x8, w8, (((x8.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    ctx.out(op, 'Out', acc.astype(jnp.float32) / (sx * sw))

@@ -52,7 +52,8 @@ class TestMultiSlotDataFeed(object):
         p = tmp_path / "bad.txt"
         p.write_text("3 1 2\n")            # declares 3 values, has 2
         feed = fluid.MultiSlotDataFeed(_desc())
-        with pytest.raises(ValueError, match="declares 3 values"):
+        with pytest.raises(ValueError,
+                           match="declares 3 values|malformed MultiSlot"):
             list(feed.batches_from_file(str(p)))
 
 
@@ -100,3 +101,59 @@ class TestAsyncExecutor(object):
         with pytest.raises(Exception):
             async_exe.run(fluid.default_main_program(), desc, [str(p)],
                           fetch_list=[loss])
+
+
+def test_native_multislot_parser_matches_python(tmp_path):
+    """The C++ MultiSlot parser (native/multislot.cc, reference
+    framework/data_feed.cc) must produce batches identical to the python
+    tokenizer."""
+    import numpy as np
+    from paddle_tpu.async_executor import MultiSlotDataFeed, DataFeedDesc
+    lines = [
+        "3 10 20 30 1 0.5 2 7 8",
+        "1 99 1 1.25 1 4",
+        "2 5 6 1 2.5 3 1 2 3",
+    ]
+    f = tmp_path / "slots.txt"
+    f.write_text("\n".join(lines) + "\n")
+    desc = DataFeedDesc(batch_size=2)
+    desc.add_slot('ids', 'uint64', is_dense=False)
+    desc.add_slot('dense', 'float', is_dense=True)
+    desc.add_slot('labels', 'uint64', is_dense=False)
+    feed = MultiSlotDataFeed(desc)
+    native = list(feed._batches_native(str(f)))
+
+    # python path, forced
+    py_batches = []
+    batch = []
+    for line in lines:
+        batch.append(feed.parse_line(line))
+        if len(batch) >= desc.batch_size:
+            py_batches.append(feed._assemble(batch))
+            batch = []
+    if batch:
+        py_batches.append(feed._assemble(batch))
+
+    assert len(native) == len(py_batches) == 2
+    for nb, pb in zip(native, py_batches):
+        assert set(nb) == set(pb)
+        for k in nb:
+            if isinstance(nb[k], tuple):
+                np.testing.assert_array_equal(nb[k][0], pb[k][0])
+                assert nb[k][1] == pb[k][1]
+            else:
+                np.testing.assert_array_equal(nb[k], pb[k])
+
+
+def test_native_multislot_rejects_out_of_range_ids(tmp_path):
+    """ids >= 2^63 must error, not wrap negative (same contract as the
+    python parser)."""
+    import pytest
+    from paddle_tpu.async_executor import MultiSlotDataFeed, DataFeedDesc
+    f = tmp_path / "big.txt"
+    f.write_text("1 9223372036854775808\n")
+    desc = DataFeedDesc(batch_size=1)
+    desc.add_slot('ids', 'uint64', is_dense=False)
+    feed = MultiSlotDataFeed(desc)
+    with pytest.raises(ValueError, match="malformed MultiSlot"):
+        list(feed._batches_native(str(f)))

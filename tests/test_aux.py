@@ -230,3 +230,31 @@ def test_barrier_with_timeout_detects_hang(monkeypatch):
         collective.barrier_with_timeout(
             't_hang', timeout_s=0.5, on_timeout=lambda: fired.append(1))
     assert fired == [1]
+
+
+def test_contrib_memory_usage_and_op_freq():
+    """reference contrib/memory_usage_calc.py + op_frequence.py."""
+    import paddle_tpu as fluid
+    from paddle_tpu.contrib import memory_usage, op_freq_statistic
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='mu_x', shape=[32], dtype='float32')
+        h = fluid.layers.fc(x, size=64, act='relu')
+        h = fluid.layers.fc(h, size=64, act='relu')
+        loss = fluid.layers.mean(h)
+    lo, hi = memory_usage(main, batch_size=16)
+    assert 0 < lo < hi
+    uni, adj = op_freq_statistic(main)
+    assert uni['mul'] == 2 and uni['relu'] == 2
+    assert adj.get('mul->elementwise_add') == 2
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        memory_usage(main, batch_size=0)
+
+
+def test_hdfs_client_raises_without_hadoop():
+    from paddle_tpu.contrib.hdfs_utils import HDFSClient
+    import pytest as _pytest
+    c = HDFSClient(hadoop_home='/nonexistent/hadoop')
+    with _pytest.raises(RuntimeError, match='hadoop binary'):
+        c.is_exist('/tmp/x')

@@ -1,0 +1,131 @@
+"""contrib decoder API (reference contrib/decoder/beam_search_decoder.py):
+StateCell + TrainingDecoder teacher-forced training, then BeamSearchDecoder
+generation with shared weights — the machine-translation decode contract
+on the dense-beam TPU layout."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.contrib.decoder import (InitState, StateCell,
+                                        TrainingDecoder, BeamSearchDecoder)
+
+V, D, H = 8, 12, 16
+
+
+def _make_cell(batch_ref):
+    init = InitState(init_boot=batch_ref, shape=[-1, H], value=0.0)
+    cell = StateCell(inputs={'x': None}, states={'h': init},
+                     out_state='h')
+
+    @cell.state_updater
+    def updater(c):
+        x = c.get_input('x')
+        h_prev = c.get_state('h')
+        h = fluid.layers.fc(
+            fluid.layers.concat([x, h_prev], axis=1), size=H, act='tanh',
+            param_attr=fluid.ParamAttr(name='dec_cell.w'),
+            bias_attr=fluid.ParamAttr(name='dec_cell.b'))
+        c.set_state('h', h)
+    return cell
+
+
+def test_training_decoder_then_beam_search():
+    # ---- training: teacher-forced identity task (predict input token)
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 5
+    with fluid.program_guard(main, startup):
+        trg = fluid.layers.data(name='trg', shape=[1], dtype='int64',
+                                lod_level=1)
+        lbl = fluid.layers.data(name='lbl', shape=[1], dtype='int64',
+                                lod_level=1)
+        trg_emb = fluid.layers.embedding(
+            trg, size=[V, D], param_attr=fluid.ParamAttr(name='dec.emb'))
+        boot = fluid.layers.sequence_pool(trg_emb, 'first')
+        cell = _make_cell(boot)
+        decoder = TrainingDecoder(cell)
+        with decoder.block():
+            x = decoder.step_input(trg_emb)
+            cell.compute_state(inputs={'x': x})
+            score = fluid.layers.fc(
+                cell.get_state('h'), size=V, act='softmax',
+                param_attr=fluid.ParamAttr(name='dec.score.w'),
+                bias_attr=fluid.ParamAttr(name='dec.score.b'))
+            cell.update_states()
+            decoder.output(score)
+        pred = decoder()
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, lbl))
+        fluid.optimizer.Adam(0.05).minimize(loss)
+
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    lod = [[0, 4, 8, 12, 16]]
+    toks = np.tile(rng.randint(1, V, (4, 1)), (1, 4)).reshape(16, 1)
+    toks = toks.astype('int64')
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        losses = []
+        for _ in range(40):
+            out, = exe.run(main, feed={'trg': (toks, lod),
+                                       'lbl': (toks, lod)},
+                           fetch_list=[loss], scope=scope)
+            losses.append(float(np.asarray(out).reshape(())))
+        assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+        # ---- generation: beam search with the trained weights
+        beam = 2
+        batch = 3
+        infer, istart = fluid.Program(), fluid.Program()
+        with fluid.program_guard(infer, istart):
+            init_ids_v = fluid.layers.data(
+                name='init_ids', shape=[-1, 1], dtype='int64')
+            init_sc_v = fluid.layers.data(
+                name='init_scores', shape=[-1, 1], dtype='float32')
+            boot_emb = fluid.layers.embedding(
+                init_ids_v, size=[V, D],
+                param_attr=fluid.ParamAttr(name='dec.emb'))
+            boot_emb = fluid.layers.reshape(boot_emb, [-1, D])
+            init = InitState(init_boot=boot_emb, shape=[-1, H], value=0.0)
+            cell2 = StateCell(inputs={'x': None}, states={'h': init},
+                              out_state='h')
+
+            @cell2.state_updater
+            def updater2(c):
+                x = c.get_input('x')
+                h_prev = c.get_state('h')
+                h = fluid.layers.fc(
+                    fluid.layers.concat([x, h_prev], axis=1), size=H,
+                    act='tanh',
+                    param_attr=fluid.ParamAttr(name='dec_cell.w'),
+                    bias_attr=fluid.ParamAttr(name='dec_cell.b'))
+                c.set_state('h', h)
+
+            dec = BeamSearchDecoder(
+                cell2, init_ids_v, init_sc_v, target_dict_dim=V,
+                word_dim=D, max_len=5, beam_size=beam, end_id=0,
+                embedding_param_attr=fluid.ParamAttr(name='dec.emb'),
+                score_param_attr=fluid.ParamAttr(name='dec.score.w'),
+                score_bias_attr=fluid.ParamAttr(name='dec.score.b'))
+            dec.decode()
+            sent_ids, sent_scores = dec()
+
+        # start from tokens the model was actually trained to repeat
+        start_toks = toks.reshape(4, 4)[:3, 0].astype('int64')
+        init_ids, init_scores = BeamSearchDecoder.make_initial_beams(
+            batch, beam, 0)
+        for i, t in enumerate(start_toks):
+            init_ids[i * beam:(i + 1) * beam] = t
+        # NOTE: do NOT run istart — it would re-initialize the shared
+        # trained parameters; the scope already holds them
+        si, ss = exe.run(infer, feed={'init_ids': init_ids,
+                                      'init_scores': init_scores},
+                         fetch_list=[sent_ids, sent_scores], scope=scope)
+    si = np.asarray(si)
+    ss = np.asarray(ss)
+    assert si.shape == (batch, beam, 5)
+    assert np.isfinite(ss).all()
+    # the model was trained to repeat its input token: the top beam from
+    # start token t should keep emitting t
+    for i, t in enumerate(start_toks):
+        assert si[i, 0, 0] == t, (i, t, si[i])
+        assert (si[i, 0] == t).mean() >= 0.6, (t, si[i, 0])

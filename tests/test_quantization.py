@@ -151,3 +151,49 @@ class TestQuantizeTranspiler(object):
             orig = np.asarray(scope.get(name))
             recon = w.astype(np.float32) * scale / 127.0
             assert np.abs(recon - orig).max() <= scale / 127.0 + 1e-6
+
+
+def test_post_training_quantize_int8_matmul():
+    """Post-training int8: calibrate -> int8 weights -> real int8 GEMM
+    (quantized_matmul, int32 accumulation); outputs within quantization
+    tolerance of fp32 (reference contrib/int8_inference/utility.py +
+    mkldnn int8 kernel pipeline)."""
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu.contrib.quantize import post_training_quantize
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='qx', shape=[16], dtype='float32')
+        h = fluid.layers.fc(x, size=32, act='relu')
+        out = fluid.layers.fc(h, size=8)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    calib = [{'qx': rng.randn(16, 16).astype('float32')}
+             for _ in range(4)]
+    test_feed = {'qx': rng.randn(8, 16).astype('float32')}
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        infer = main.clone(for_test=True)
+        ref, = exe.run(infer, feed=test_feed, fetch_list=[out.name],
+                       scope=scope)
+        rewritten = post_training_quantize(exe, infer, scope, calib)
+        assert len(rewritten) == 2          # both fc matmuls
+        types = [op.type for op in infer.global_block().ops]
+        assert types.count('quantize') == 2
+        assert types.count('quantized_matmul') == 2
+        assert 'mul' not in types
+        # int8 weight blobs in the scope
+        int8_names = [n for n in scope.names() if n.endswith('.int8')]
+        assert len(int8_names) == 2
+        assert all(np.asarray(scope.get(n)).dtype == np.int8
+                   for n in int8_names)
+        got, = exe.run(infer, feed=test_feed, fetch_list=[out.name],
+                       scope=scope)
+    ref = np.asarray(ref)
+    got = np.asarray(got)
+    # int8 quantization error budget: within a few percent of fp32 range
+    denom = np.abs(ref).max() or 1.0
+    assert np.max(np.abs(got - ref)) / denom < 0.05, (
+        np.max(np.abs(got - ref)), denom)
