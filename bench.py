@@ -161,6 +161,41 @@ def _bench_resnet50(batch, k_per_call, rounds, amp):
     }
 
 
+def _bench_bert(batch, k_per_call, rounds, amp):
+    """BERT-base pretraining samples/sec (BASELINE.md north-star row)."""
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu.contrib import mixed_precision as mp
+    from paddle_tpu.models.bert import (BertConfig, build_bert_pretrain,
+                                        make_pretrain_batch)
+
+    cfg = BertConfig(seq_len=128, max_predictions=20)   # BERT-base
+    main_p, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup):
+        total, mlm_loss, nsp_loss = build_bert_pretrain(cfg)
+        opt = fluid.optimizer.Adam(learning_rate=1e-4)
+        if amp:
+            opt = mp.decorate(opt)
+        opt.minimize(total)
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    batches = [make_pretrain_batch(cfg, batch, rng)
+               for _ in range(k_per_call)]
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        sec_step, loss, compile_s = _measure_steps(
+            exe, main_p, scope, batches, total, k_per_call, rounds)
+    return {
+        'samples_per_sec': round(batch / sec_step, 1),
+        'step_ms': round(sec_step * 1000, 2),
+        'compile_s': round(compile_s, 1),
+        'final_loss': round(loss, 4),
+        'config': 'bert-base L%d d%d seq%d b%d' % (
+            cfg.n_layer, cfg.d_model, cfg.seq_len, batch),
+    }
+
+
 def _bench_ctr(batch, k_per_call, rounds):
     """Wide&deep-style CTR: multi-slot embedding lookups + MLP, the sparse
     workload BASELINE.md's north-star table names (DeepFM/CTR)."""
@@ -278,6 +313,7 @@ def _child(mode):
              2, 10, 2, True)
         _set_mfu('lm_long_seq8k')
         _try('resnet50', _bench_resnet50, 64, 4, 3, True)
+        _try('bert_base', _bench_bert, 64, 10, 2, True)
         _try('ctr_sparse', _bench_ctr, 512, 50, 3)
     for r in models.values():
         r.pop('flops_per_step', None)

@@ -209,6 +209,11 @@ class MultiSlotDataFeed(object):
                 chunk = vals[o[lo]:o[hi]]
                 if sl['is_dense']:
                     width = int(lens[lo])
+                    if not (lens[lo:hi] == width).all():
+                        raise ValueError(
+                            "MultiSlotDataFeed: dense slot %r has varying "
+                            "widths %s in one batch" % (
+                                name, sorted(set(lens[lo:hi].tolist()))))
                     feed[name] = chunk.reshape(hi - lo, width).astype(
                         np.float32 if sl['type'] == 'float' else np.int64)
                 else:

@@ -1,0 +1,27 @@
+"""float16 transpiler (reference paddle/contrib/float16/
+float16_transpiler.py:66 Float16Transpiler).
+
+TPU divergence, by design: the numerically robust reduced precision on
+TPU is bfloat16 (same exponent range as fp32 — no loss-scaling machinery
+needed), so `float16_transpile` marks the program's MXU-heavy ops with
+the bf16 AMP policy (contrib/mixed_precision) instead of rewriting var
+dtypes to fp16. The observable contract matches: matmuls/convs execute in
+reduced precision, parameters and the program's var dtypes stay fp32.
+"""
+from . import mixed_precision as _mp
+
+__all__ = ['float16_transpile', 'Float16Transpiler']
+
+
+def float16_transpile(program, place=None, scope=None, dtype='bfloat16'):
+    """Mark `program` for reduced-precision compute (bf16 on TPU)."""
+    _mp.rewrite_program_bf16(program, dtype=dtype,
+                             amp_lists=_mp.AutoMixedPrecisionLists())
+    return program
+
+
+class Float16Transpiler(object):
+    """Reference-shaped class API."""
+
+    def transpile(self, program, place=None, scope=None):
+        return float16_transpile(program, place=place, scope=scope)

@@ -287,7 +287,6 @@ def post_training_quantize(exe, program, scope, feed_batches,
 
     # 3) quantize weights offline + rewrite ops (reverse order keeps
     # earlier indices valid while inserting)
-    rewritten = []
     for idx, op, x_name, w_name in reversed(targets):
         w = np.asarray(scope.get(w_name))
         w_absmax = float(np.max(np.abs(w))) or 1.0
@@ -314,7 +313,6 @@ def post_training_quantize(exe, program, scope, feed_batches,
             idx, type='quantize', inputs={'Input': [x_name]},
             outputs={'Output': [x8_name]},
             attrs={'Scale': sx, 'is_negative_input': True})
-        rewritten.append(idx)
     program._bump_version()
     # indices shift with each insertion: report the FINAL positions
     return [i for i, o in enumerate(block.ops)

@@ -1,3 +1,8 @@
+class EOFException(Exception):
+    """Raised when a py_reader's data source is exhausted (reference
+    fluid.core.EOFException from the blocking-queue reader ops)."""
+
+
 from . import types
 from .types import VarType, convert_np_dtype_to_dtype_, dtype_to_np
 from .registry import OpRegistry, register_op, get_op, has_op, all_ops

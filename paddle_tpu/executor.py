@@ -285,6 +285,14 @@ class Executor(object):
             use_program_cache=True):
         if program is None:
             program = default_main_program()
+        # started py_readers supply their variables when not explicitly fed
+        # (reference create_py_reader_op pulling the blocking queue)
+        src_prog = getattr(program, '_program', program)  # CompiledProgram
+        for rd in getattr(src_prog, '_py_readers', []):
+            if rd._thread is not None and not any(
+                    v.name in (feed or {}) for v in rd._vars):
+                feed = dict(feed or {})
+                feed.update(rd._next_feed())
         # CompiledProgram support is injected by compiler.py via duck-typing:
         if hasattr(program, '_executor_run'):
             return program._executor_run(self, feed, fetch_list, scope,

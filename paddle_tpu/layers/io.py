@@ -7,7 +7,8 @@ is the only graph-visible input declaration.
 from ..framework import default_main_program, default_startup_program
 from ..core.types import VarType
 
-__all__ = ['data']
+__all__ = ['data', 'py_reader', 'read_file', 'double_buffer',
+           'PyReader']
 
 
 def data(name, shape, append_batch_size=True, dtype='float32', lod_level=0,
@@ -22,3 +23,150 @@ def data(name, shape, append_batch_size=True, dtype='float32', lod_level=0,
         name=name, shape=tuple(shape), dtype=dtype, lod_level=lod_level,
         type=type, stop_gradient=stop_gradient, is_data=True,
         persistable=False)
+
+
+class PyReader(object):
+    """Program-level asynchronous reader (reference layers/io.py
+    py_reader:636 + create_py_reader_op / LoDTensorBlockingQueue,
+    operators/reader/lod_tensor_blocking_queue.h:31).
+
+    TPU-native design: the reader owns a bounded host-side queue fed by a
+    background thread (started by `start()`); the Executor pulls one batch
+    per run for the reader's variables — the graph-visible contract
+    (declare once, run without feed, EOFException at exhaustion) is the
+    reference's, while the device transfer rides the executor's normal
+    feed path (XLA donates/overlaps the host copy).
+    """
+
+    def __init__(self, capacity, shapes, dtypes, lod_levels=None,
+                 name=None, use_double_buffer=True):
+        import queue as _queue
+        from .. import unique_name
+        self._name = name or unique_name.generate('py_reader')
+        self._capacity = int(capacity)
+        self._queue = _queue.Queue(maxsize=self._capacity)
+        self._thread = None
+        self._paddle_reader = None
+        self._tensor_provider = None
+        self._exhausted = False
+        self._gen = 0            # bumped by reset(): stale feeders exit
+        self._error = None
+        lod_levels = list(lod_levels or [0] * len(shapes))
+        block = default_main_program().current_block()
+        self._vars = []
+        for i, (shape, dtype) in enumerate(zip(shapes, dtypes)):
+            v = block.create_var(
+                name='%s.out%d' % (self._name, i), shape=tuple(shape),
+                dtype=dtype, lod_level=lod_levels[i], is_data=True,
+                persistable=False, stop_gradient=True)
+            self._vars.append(v)
+        prog = default_main_program()
+        if not hasattr(prog, '_py_readers'):
+            prog._py_readers = []
+        prog._py_readers.append(self)
+
+    # -- wiring ------------------------------------------------------------
+    def decorate_paddle_reader(self, reader):
+        """reader(): generator of tuples/lists, one entry per declared
+        var (reference decorate_paddle_reader)."""
+        self._paddle_reader = reader
+        return self
+
+    def decorate_tensor_provider(self, provider):
+        self._tensor_provider = provider
+        return self
+
+    # -- runtime -----------------------------------------------------------
+    def start(self):
+        import threading
+        src = self._paddle_reader or self._tensor_provider
+        if src is None:
+            raise ValueError(
+                "py_reader %r has no data source — call "
+                "decorate_paddle_reader first" % self._name)
+        self._exhausted = False
+        self._error = None
+        my_gen = self._gen
+        q = self._queue
+
+        def _feeder():
+            try:
+                for sample in src():
+                    q.put(tuple(sample))
+                    if self._gen != my_gen:
+                        return          # reset() superseded this epoch
+            except BaseException as e:  # surfaced by _next_feed
+                self._error = e
+            finally:
+                q.put(None)             # EOF sentinel
+
+        self._thread = threading.Thread(target=_feeder, daemon=True)
+        self._thread.start()
+
+    def reset(self):
+        """Drain after EOF (or mid-epoch) so start() can run the next
+        epoch (reference reader->ReInit). A still-running feeder is
+        superseded: the generation bump makes it exit after its next put,
+        and the old queue is drained so a blocked put completes."""
+        import queue as _queue
+        self._gen += 1
+        old_q = self._queue
+        self._queue = _queue.Queue(maxsize=self._capacity)
+        while True:
+            try:
+                old_q.get_nowait()
+            except Exception:
+                break
+        self._exhausted = False
+        self._error = None
+        self._thread = None
+
+    def _next_feed(self):
+        from ..core import EOFException
+        if self._thread is None:
+            raise RuntimeError(
+                "py_reader %r is not started — call reader.start() before "
+                "Executor.run" % self._name)
+        if self._exhausted:
+            raise EOFException(
+                "py_reader %r is exhausted — call reader.reset()"
+                % self._name)
+        item = self._queue.get()
+        if item is None:
+            self._exhausted = True
+            if self._error is not None:
+                raise RuntimeError(
+                    "py_reader %r data source failed" % self._name) \
+                    from self._error
+            raise EOFException(
+                "py_reader %r reached the end of its data source"
+                % self._name)
+        if len(item) != len(self._vars):
+            raise ValueError(
+                "py_reader %r batch has %d fields, %d declared"
+                % (self._name, len(item), len(self._vars)))
+        return {v.name: val for v, val in zip(self._vars, item)}
+
+    @property
+    def out_vars(self):
+        return list(self._vars)
+
+
+def py_reader(capacity, shapes, dtypes, lod_levels=None, name=None,
+              use_double_buffer=True):
+    """reference layers/io.py:636 py_reader."""
+    return PyReader(capacity, shapes, dtypes, lod_levels=lod_levels,
+                    name=name, use_double_buffer=use_double_buffer)
+
+
+def read_file(reader):
+    """reference layers/io.py read_file: unpack the reader's variables."""
+    vars = reader.out_vars
+    return vars[0] if len(vars) == 1 else tuple(vars)
+
+
+def double_buffer(reader, place=None, name=None):
+    """reference layers/io.py:1005 double_buffer. The dispatch pipeline
+    already overlaps host->device copies with compute (async dispatch), so
+    this is the identity on the reader object."""
+    return reader

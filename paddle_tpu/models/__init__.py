@@ -10,3 +10,4 @@ from . import vgg
 from . import se_resnext
 from . import word2vec
 from . import transformer
+from . import bert

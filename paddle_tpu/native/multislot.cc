@@ -101,6 +101,18 @@ void* ms_parse_file(const char* path, int n_slots, const int* is_float,
     out->n_samples += 1;
     pending.clear();
   }
+  // a final line without trailing newline may still be pending
+  size_t a2 = pending.find_first_not_of(" \t\r\n");
+  if (a2 != std::string::npos) {
+    if (!parse_line(pending.c_str() + a2, n_slots, is_float, out)) {
+      err = "malformed MultiSlot line: " + pending.substr(a2, 80);
+      if (err_out) *err_out = const_cast<char*>(err.c_str());
+      fclose(f);
+      delete out;
+      return nullptr;
+    }
+    out->n_samples += 1;
+  }
   fclose(f);
   return out;
 }

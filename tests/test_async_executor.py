@@ -157,3 +157,18 @@ def test_native_multislot_rejects_out_of_range_ids(tmp_path):
     feed = MultiSlotDataFeed(desc)
     with pytest.raises(ValueError, match="malformed MultiSlot"):
         list(feed._batches_native(str(f)))
+
+
+def test_native_multislot_keeps_last_line_without_newline(tmp_path):
+    """A final sample without a trailing newline must not be dropped
+    (round-3 review finding)."""
+    from paddle_tpu.async_executor import MultiSlotDataFeed, DataFeedDesc
+    f = tmp_path / "nl.txt"
+    f.write_text("1 5\n1 7")          # no trailing newline
+    desc = DataFeedDesc(batch_size=4)
+    desc.add_slot('ids', 'uint64', is_dense=False)
+    feed = MultiSlotDataFeed(desc)
+    n, parsed = feed.parse_file_native(str(f))
+    assert n == 2
+    vals, lens = parsed['ids']
+    assert vals.tolist() == [5, 7]

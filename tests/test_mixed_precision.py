@@ -122,3 +122,27 @@ def test_bf16_conv_trains():
 def test_dynamic_loss_scaling_rejected():
     with pytest.raises(ValueError):
         mp.decorate(fluid.optimizer.SGD(0.1), use_dynamic_loss_scaling=True)
+
+
+def test_float16_transpiler_marks_program():
+    """contrib.float16 parity shim: reference Float16Transpiler's contract
+    mapped onto bf16 AMP marks."""
+    import paddle_tpu as fluid
+    from paddle_tpu.contrib.float16 import Float16Transpiler
+    from paddle_tpu.core.amp import AMP_ATTR
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='f16x', shape=[8], dtype='float32')
+        h = fluid.layers.fc(x, size=8)
+        loss = fluid.layers.mean(h)
+    Float16Transpiler().transpile(main)
+    muls = [op for op in main.global_block().ops if op.type == 'mul']
+    assert muls and all(op.attr(AMP_ATTR) == 'bfloat16' for op in muls)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    import numpy as np
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        out, = exe.run(main, feed={'f16x': np.ones((2, 8), 'float32')},
+                       fetch_list=[loss], scope=scope)
+    assert np.isfinite(np.asarray(out)).all()

@@ -1,0 +1,148 @@
+"""py_reader: program-declared async input (reference layers/io.py:636
+py_reader + reader ops; EOFException epoch contract)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core import EOFException
+
+
+def _reader_creator(n_batches, batch, seed=0):
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n_batches):
+            x = rng.randn(batch, 8).astype('float32')
+            y = (x.sum(1, keepdims=True) > 0).astype('int64')
+            yield x, y
+    return reader
+
+
+def test_py_reader_trains_without_feed():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        reader = fluid.layers.py_reader(
+            capacity=8, shapes=[(-1, 8), (-1, 1)],
+            dtypes=['float32', 'int64'])
+        x, y = fluid.layers.read_file(reader)
+        h = fluid.layers.fc(x, size=16, act='relu')
+        p = fluid.layers.fc(h, size=2, act='softmax')
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(p, y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    reader.decorate_paddle_reader(_reader_creator(5, 16))
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        for epoch in range(2):
+            reader.start()
+            losses = []
+            while True:
+                try:
+                    out, = exe.run(main, fetch_list=[loss], scope=scope)
+                except EOFException:
+                    reader.reset()
+                    break
+                losses.append(float(np.asarray(out).reshape(())))
+            assert len(losses) == 5, losses
+        assert np.isfinite(losses).all()
+
+
+def test_py_reader_requires_start():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        reader = fluid.layers.py_reader(
+            capacity=4, shapes=[(-1, 4)], dtypes=['float32'])
+        x = fluid.layers.read_file(reader)
+        loss = fluid.layers.mean(x)
+    reader.decorate_paddle_reader(lambda: iter([]))
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        # not started: the reader supplies nothing -> feed-missing error
+        with pytest.raises(Exception):
+            exe.run(main, fetch_list=[loss], scope=scope)
+
+
+def test_py_reader_explicit_feed_overrides():
+    """An explicit feed for the reader's vars bypasses the queue (useful
+    for eval with a fixed batch)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        reader = fluid.layers.py_reader(
+            capacity=4, shapes=[(-1, 4)], dtypes=['float32'])
+        x = fluid.layers.read_file(reader)
+        s = fluid.layers.reduce_sum(x)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        out, = exe.run(main, feed={x.name: np.ones((2, 4), 'float32')},
+                       fetch_list=[s], scope=scope)
+    assert float(np.asarray(out).reshape(())) == 8.0
+
+
+def test_py_reader_mid_epoch_reset_discards_stale_batches():
+    """reset() mid-epoch must not leak stale batches into the next epoch
+    (round-3 review finding)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        reader = fluid.layers.py_reader(
+            capacity=2, shapes=[(-1, 1)], dtypes=['float32'])
+        x = fluid.layers.read_file(reader)
+        s = fluid.layers.reduce_sum(x)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+
+    def epoch1():
+        for v in [100, 101, 102, 103, 104, 105]:
+            yield (np.full((1, 1), v, 'float32'),)
+
+    def epoch2():
+        for v in [200, 201, 202]:
+            yield (np.full((1, 1), v, 'float32'),)
+
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        reader.decorate_paddle_reader(epoch1)
+        reader.start()
+        first, = exe.run(main, fetch_list=[s], scope=scope)
+        assert float(np.asarray(first).reshape(())) == 100.0
+        reader.reset()                      # mid-epoch
+        reader.decorate_paddle_reader(epoch2)
+        reader.start()
+        vals = []
+        while True:
+            try:
+                out, = exe.run(main, fetch_list=[s], scope=scope)
+            except EOFException:
+                reader.reset()
+                break
+            vals.append(float(np.asarray(out).reshape(())))
+    assert vals == [200.0, 201.0, 202.0], vals
+
+
+def test_py_reader_source_error_surfaces():
+    """A raising data source must surface as an error, not a clean EOF
+    (round-3 review finding)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        reader = fluid.layers.py_reader(
+            capacity=2, shapes=[(-1, 1)], dtypes=['float32'])
+        x = fluid.layers.read_file(reader)
+        s = fluid.layers.reduce_sum(x)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+
+    def bad_reader():
+        yield (np.ones((1, 1), 'float32'),)
+        raise IOError("corrupt shard")
+
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        reader.decorate_paddle_reader(bad_reader)
+        reader.start()
+        exe.run(main, fetch_list=[s], scope=scope)     # batch 1 ok
+        with pytest.raises(RuntimeError, match="data source failed"):
+            while True:
+                exe.run(main, fetch_list=[s], scope=scope)
