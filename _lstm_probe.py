@@ -1,0 +1,6 @@
+import os, sys, json
+os.environ['BENCH_CHILD'] = 'tpu'
+sys.argv = ['bench.py']
+import bench
+r = bench._bench_stacked_lstm(32, 128, 10, 2)
+print(json.dumps(r))

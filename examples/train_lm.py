@@ -1,0 +1,75 @@
+"""Train the flagship Transformer LM on one TPU chip.
+
+Usage:  python examples/train_lm.py  [--steps 1000] [--batch 64]
+
+Shows the canonical training loop: build program -> AMP decorate ->
+run_fused multi-step windows (amortizes host latency) -> checkpoint.
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--steps', type=int, default=500)
+    ap.add_argument('--batch', type=int, default=64)
+    ap.add_argument('--window', type=int, default=50,
+                    help='steps fused per device call')
+    ap.add_argument('--ckpt_dir', default='')
+    args = ap.parse_args()
+
+    import jax
+    import paddle_tpu as fluid
+    from paddle_tpu.contrib import mixed_precision as mp
+    from paddle_tpu.models.transformer import build_lm, LMConfig
+
+    cfg = LMConfig(vocab_size=32000, seq_len=512, d_model=512, n_head=8,
+                   n_layer=6, d_ff=2048, dropout=0.1, attn_dropout=0.0,
+                   use_flash_attention=True)
+    main_p, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup):
+        tokens, labels, logits, avg_loss = build_lm(cfg)
+        lr = fluid.layers.learning_rate_scheduler.noam_decay(
+            cfg.d_model, 400)
+        opt = mp.decorate(fluid.optimizer.Adam(learning_rate=lr))
+        opt.minimize(avg_loss)
+
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    batches = [{
+        'tokens': rng.randint(0, cfg.vocab_size,
+                              (args.batch, cfg.seq_len)).astype('int64'),
+        'labels': rng.randint(0, cfg.vocab_size,
+                              (args.batch, cfg.seq_len)).astype('int64')}
+        for _ in range(8)]
+    stacked = {k: jax.device_put(np.stack([b[k] for b in batches]))
+               for k in batches[0]}
+
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        done = 0
+        t0 = time.time()
+        while done < args.steps:
+            n = min(args.window, args.steps - done)
+            loss, = exe.run_fused(main_p, stacked, fetch_list=[avg_loss],
+                                  scope=scope, steps=n)
+            done += n
+            dt = time.time() - t0
+            print('step %d  loss %.4f  (%.0f tok/s)' % (
+                done, float(np.asarray(loss).reshape(-1)[0]),
+                done * args.batch * cfg.seq_len / dt))
+        if args.ckpt_dir:
+            fluid.io.save_persistables(exe, args.ckpt_dir,
+                                       main_program=main_p)
+            print('saved to', args.ckpt_dir)
+
+
+if __name__ == '__main__':
+    main()

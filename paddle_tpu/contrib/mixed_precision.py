@@ -23,7 +23,7 @@ or rewrite an existing (inference) program in place::
 
     fluid.contrib.mixed_precision.rewrite_program_bf16(main_program)
 """
-from ..core.amp import AMP_ATTR
+from ..core.amp import AMP_ATTR, AMP_KEEP_ATTR
 
 __all__ = ['AutoMixedPrecisionLists', 'rewrite_program_bf16', 'decorate',
            'OptimizerWithMixedPrecision']
@@ -62,11 +62,19 @@ class AutoMixedPrecisionLists(object):
             self.black_list.add(t)
 
 
-def rewrite_program_bf16(program, amp_lists=None, dtype='bfloat16'):
+KEEP_ACTIVATION_OPS = {'conv2d', 'depthwise_conv2d', 'batch_norm'}
+
+
+def rewrite_program_bf16(program, amp_lists=None, dtype='bfloat16',
+                         keep_bf16_activations=False):
     """Mark every white-listed op in `program` to compute in `dtype`.
 
     The mark (core/amp.py AMP_ATTR) makes the op's lowering cast its fp32
-    compute inputs to bf16; accumulation and outputs stay fp32.
+    compute inputs to bf16; accumulation and outputs stay fp32 — unless
+    keep_bf16_activations is set, in which case conv/bn outputs STAY bf16
+    (dtype-preserving ops like relu/pool propagate it), halving activation
+    HBM traffic for conv nets; dense heads/losses still compute f32
+    because mul/softmax cast back.
     """
     amp_lists = amp_lists or AutoMixedPrecisionLists()
     n = 0
@@ -75,6 +83,10 @@ def rewrite_program_bf16(program, amp_lists=None, dtype='bfloat16'):
             if op.type in amp_lists.white_list:
                 op.attrs[AMP_ATTR] = dtype
                 n += 1
+            if keep_bf16_activations and op.type in KEEP_ACTIVATION_OPS \
+                    and op.type not in amp_lists.black_list:
+                op.attrs[AMP_ATTR] = dtype
+                op.attrs[AMP_KEEP_ATTR] = True
     program._bump_version()
     return n
 
@@ -89,7 +101,8 @@ class OptimizerWithMixedPrecision(object):
     """
 
     def __init__(self, optimizer, amp_lists=None, init_loss_scaling=1.0,
-                 use_dynamic_loss_scaling=False, dtype='bfloat16'):
+                 use_dynamic_loss_scaling=False, dtype='bfloat16',
+                 keep_bf16_activations=False):
         self._optimizer = optimizer
         self._amp_lists = amp_lists or AutoMixedPrecisionLists()
         if use_dynamic_loss_scaling or float(init_loss_scaling) != 1.0:
@@ -100,11 +113,13 @@ class OptimizerWithMixedPrecision(object):
                 "as fp32); use init_loss_scaling=1.0 and "
                 "use_dynamic_loss_scaling=False")
         self._dtype = dtype
+        self._keep_acts = keep_bf16_activations
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
         program = loss.block.program
-        rewrite_program_bf16(program, self._amp_lists, self._dtype)
+        rewrite_program_bf16(program, self._amp_lists, self._dtype,
+                             keep_bf16_activations=self._keep_acts)
         return self._optimizer.minimize(
             loss, startup_program=startup_program,
             parameter_list=parameter_list, no_grad_set=no_grad_set)
@@ -117,9 +132,11 @@ class OptimizerWithMixedPrecision(object):
 
 
 def decorate(optimizer, amp_lists=None, init_loss_scaling=1.0,
-             use_dynamic_loss_scaling=False):
+             use_dynamic_loss_scaling=False, keep_bf16_activations=False):
     """Wrap `optimizer` for bf16 mixed-precision training (reference
-    fluid.contrib.mixed_precision.decorate)."""
+    fluid.contrib.mixed_precision.decorate). keep_bf16_activations keeps
+    conv/bn outputs bf16 in HBM (conv-net bandwidth mode)."""
     return OptimizerWithMixedPrecision(
         optimizer, amp_lists=amp_lists, init_loss_scaling=init_loss_scaling,
-        use_dynamic_loss_scaling=use_dynamic_loss_scaling)
+        use_dynamic_loss_scaling=use_dynamic_loss_scaling,
+        keep_bf16_activations=keep_bf16_activations)

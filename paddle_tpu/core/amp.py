@@ -17,6 +17,7 @@ is needed because bf16 keeps fp32's exponent range.
 import jax.numpy as jnp
 
 AMP_ATTR = '__amp_dtype__'
+AMP_KEEP_ATTR = '__amp_keep_out__'
 
 
 def accum_dtype(x):
@@ -30,6 +31,16 @@ def accum_dtype(x):
     if getattr(x, 'dtype', None) == jnp.dtype(jnp.bfloat16):
         return None
     return jnp.float32
+
+
+def result_dtype(op, computed, declared):
+    """Output dtype for an AMP-marked op: normally the declared var dtype
+    (fp32 master activations); under the keep-activations policy
+    (AMP_KEEP_ATTR) the compute dtype is kept so activations stay bf16 in
+    HBM end to end — halving activation bandwidth for conv nets."""
+    if op.attr(AMP_KEEP_ATTR, False):
+        return getattr(computed, 'dtype', declared)
+    return declared
 
 
 def cast_compute(op, *vals):

@@ -388,12 +388,13 @@ class Executor(object):
         per-dispatch loop, framework/async_executor.cc:236).
 
         feed_list: list of K feed dicts with identical names/shapes/dtypes
-        (dense only — LoD feeds bind statically and cannot be scanned), OR
-        a pre-stacked {name: array[K, ...]} dict — pass device-resident
-        (jax.device_put) stacked arrays to avoid re-uploading large feeds
-        on every call (the input-pipeline staging an async py_reader would
-        do). Returns the LAST step's fetches; all K state updates land in
-        the scope.
+        — ragged (array, lod) feeds are allowed when every staged batch
+        shares ONE identical LoD (it binds statically; bucket+pad varied
+        shapes, reader/bucketing.py) — OR a pre-stacked
+        {name: array[K, ...]} dict: pass device-resident (jax.device_put)
+        stacked arrays to avoid re-uploading large feeds on every call
+        (the input-pipeline staging an async py_reader would do). Returns
+        the LAST step's fetches; all K state updates land in the scope.
         """
         import jax
         from jax import lax
@@ -403,6 +404,7 @@ class Executor(object):
             scope = global_scope()
         if not feed_list:
             return []
+        lods0 = {}
         if isinstance(feed_list, dict):
             stacked = dict(feed_list)
             k_steps = int(next(iter(stacked.values())).shape[0])
@@ -411,11 +413,13 @@ class Executor(object):
         else:
             prepared = [self._prepare_feed(program, f or {})
                         for f in feed_list]
-            if any(lods for _, lods in prepared):
+            lods0 = prepared[0][1]
+            if any(lods != lods0 for _, lods in prepared):
                 raise ValueError(
-                    "run_fused supports dense feeds only — LoD feeds bind "
-                    "statically per compile; bucket+pad them (reader/"
-                    "bucketing.py) to scan steps on-device")
+                    "run_fused LoD feeds must share one identical LoD "
+                    "across all staged batches (LoD binds statically per "
+                    "compile; bucket+pad to a common shape — "
+                    "reader/bucketing.py — to scan varied shapes)")
             feeds = [f for f, _ in prepared]
             k_steps = len(feeds)
             stacked = {name: np.stack([np.asarray(f[name]) for f in feeds])
@@ -432,15 +436,21 @@ class Executor(object):
         n_steps = int(steps) if steps else k_steps
         cache_key = ('fused', k_steps, n_steps, program._uid,
                      program._version,
-                     self._feed_signature(feed0, (), ()),
+                     self._feed_signature(feed0, lods0, ()),
                      tuple(fetch_names))
         entry = self._cache.get(cache_key)
         if entry is None:
             read, written = lowering.analyze_state(program, fetch_names)
             needed = self._read_before_write(program, read, written,
                                              set(feed0), fetch_names)
+            # scope-held LoD state binds statically too, like run()
+            scope_lods = {n: normalize_lod(l) for n, l in
+                          getattr(scope, '_lods', {}).items() if l}
+            static_lods = dict(scope_lods)
+            static_lods.update(lods0)
             fn, ro_names, rw_names = lowering.build_fn(
-                program, fetch_names, needed, written)
+                program, fetch_names, needed, written,
+                static_lods=static_lods)
 
             def fused(stacked_feed, ro, rw, base_key):
                 # carry: (read-write subset fed back into fn, FULL written

@@ -8,7 +8,7 @@ from ..framework import default_main_program, default_startup_program
 from ..core.types import VarType
 
 __all__ = ['data', 'py_reader', 'read_file', 'double_buffer',
-           'PyReader']
+           'PyReader', 'create_py_reader_by_data']
 
 
 def data(name, shape, append_batch_size=True, dtype='float32', lod_level=0,
@@ -40,6 +40,19 @@ class PyReader(object):
 
     def __init__(self, capacity, shapes, dtypes, lod_levels=None,
                  name=None, use_double_buffer=True):
+        self._init_common(capacity, name)
+        lod_levels = list(lod_levels or [0] * len(shapes))
+        block = default_main_program().current_block()
+        self._vars = []
+        for i, (shape, dtype) in enumerate(zip(shapes, dtypes)):
+            v = block.create_var(
+                name='%s.out%d' % (self._name, i), shape=tuple(shape),
+                dtype=dtype, lod_level=lod_levels[i], is_data=True,
+                persistable=False, stop_gradient=True)
+            self._vars.append(v)
+        self._register()
+
+    def _init_common(self, capacity, name):
         import queue as _queue
         from .. import unique_name
         self._name = name or unique_name.generate('py_reader')
@@ -51,15 +64,8 @@ class PyReader(object):
         self._exhausted = False
         self._gen = 0            # bumped by reset(): stale feeders exit
         self._error = None
-        lod_levels = list(lod_levels or [0] * len(shapes))
-        block = default_main_program().current_block()
-        self._vars = []
-        for i, (shape, dtype) in enumerate(zip(shapes, dtypes)):
-            v = block.create_var(
-                name='%s.out%d' % (self._name, i), shape=tuple(shape),
-                dtype=dtype, lod_level=lod_levels[i], is_data=True,
-                persistable=False, stop_gradient=True)
-            self._vars.append(v)
+
+    def _register(self):
         prog = default_main_program()
         if not hasattr(prog, '_py_readers'):
             prog._py_readers = []
@@ -169,4 +175,16 @@ def double_buffer(reader, place=None, name=None):
     """reference layers/io.py:1005 double_buffer. The dispatch pipeline
     already overlaps host->device copies with compute (async dispatch), so
     this is the identity on the reader object."""
+    return reader
+
+
+def create_py_reader_by_data(capacity, feed_list, name=None,
+                             use_double_buffer=True):
+    """reference layers/io.py create_py_reader_by_data: a py_reader whose
+    output variables ARE the given feed vars (so an existing feed-based
+    program switches to async input without rebuilding)."""
+    reader = PyReader.__new__(PyReader)
+    reader._init_common(capacity, name)
+    reader._vars = list(feed_list)
+    reader._register()
     return reader

@@ -141,7 +141,8 @@ def _conv2d(ctx, op):
         feature_group_count=groups,
         preferred_element_type=amp.accum_dtype(x))
     ctx.out(op, 'Output',
-            jnp.transpose(out, (0, 3, 1, 2)).astype(out_dtype))
+            jnp.transpose(out, (0, 3, 1, 2)).astype(
+                amp.result_dtype(op, x, out_dtype)))
 
 
 @register_op('depthwise_conv2d')
@@ -311,6 +312,7 @@ def _batch_norm(ctx, op):
     bias = ctx.in1(op, 'Bias')
     mean = ctx.in1(op, 'Mean')
     var = ctx.in1(op, 'Variance')
+    x = amp.cast_compute(op, x)
     momentum = op.attr('momentum', 0.9)
     eps = op.attr('epsilon', 1e-5)
     is_test = op.attr('is_test', False)
@@ -329,8 +331,11 @@ def _batch_norm(ctx, op):
         ctx.out(op, 'MeanOut', mean)
         ctx.out(op, 'VarianceOut', var)
     else:
-        m = jnp.mean(x, axis=axes)
-        v = jnp.var(x, axis=axes)
+        # statistics ALWAYS accumulate in f32 (a bf16 mean over ~1e5
+        # elements loses precision); running stats stay f32 state
+        xf = x.astype(jnp.float32)
+        m = jnp.mean(xf, axis=axes)
+        v = jnp.var(xf, axis=axes)
         ctx.out(op, 'MeanOut',
                 momentum * mean + (1.0 - momentum) * lax.stop_gradient(m))
         ctx.out(op, 'VarianceOut',

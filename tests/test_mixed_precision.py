@@ -146,3 +146,55 @@ def test_float16_transpiler_marks_program():
         out, = exe.run(main, feed={'f16x': np.ones((2, 8), 'float32')},
                        fetch_list=[loss], scope=scope)
     assert np.isfinite(np.asarray(out)).all()
+
+
+def test_keep_bf16_activations_convnet():
+    """keep_bf16_activations: conv/bn outputs stay bf16 (bandwidth mode);
+    training still tracks the fp32 run within bf16 tolerance."""
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu.contrib import mixed_precision as mp
+
+    def build(keep):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 21
+        with fluid.program_guard(main, startup):
+            img = fluid.layers.data(name='img', shape=[3, 8, 8],
+                                    dtype='float32')
+            y = fluid.layers.data(name='y', shape=[1], dtype='int64')
+            c = fluid.layers.conv2d(img, num_filters=8, filter_size=3,
+                                    padding=1, bias_attr=False)
+            c = fluid.layers.batch_norm(c)
+            c = fluid.layers.relu(c)
+            p = fluid.layers.pool2d(c, pool_size=2, pool_stride=2)
+            out = fluid.layers.fc(p, size=4, act='softmax')
+            loss = fluid.layers.mean(
+                fluid.layers.cross_entropy(out, y))
+            opt = fluid.optimizer.Momentum(0.05, momentum=0.9)
+            if keep is not None:
+                opt = mp.decorate(opt, keep_bf16_activations=keep)
+            opt.minimize(loss)
+        return main, startup, loss
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(16, 3, 8, 8).astype('float32')
+    Y = rng.randint(0, 4, (16, 1)).astype('int64')
+    exe = fluid.Executor()
+
+    results = {}
+    for mode in (None, False, True):
+        main, startup, loss = build(mode)
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup, scope=scope)
+            ls = [float(np.asarray(exe.run(
+                main, feed={'img': X, 'y': Y}, fetch_list=[loss],
+                scope=scope)[0]).reshape(())) for _ in range(5)]
+        results[mode] = ls
+    # both AMP modes track the fp32 trajectory within bf16 tolerance
+    np.testing.assert_allclose(results[False], results[None],
+                               rtol=0.1, atol=0.05)
+    np.testing.assert_allclose(results[True], results[None],
+                               rtol=0.1, atol=0.05)
+    # and training makes progress in keep mode
+    assert results[True][-1] < results[True][0]

@@ -146,3 +146,30 @@ def test_py_reader_source_error_surfaces():
         with pytest.raises(RuntimeError, match="data source failed"):
             while True:
                 exe.run(main, fetch_list=[s], scope=scope)
+
+
+def test_create_py_reader_by_data():
+    """Async input over EXISTING feed vars (reference
+    create_py_reader_by_data)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='cbx', shape=[4], dtype='float32')
+        reader = fluid.layers.create_py_reader_by_data(
+            capacity=4, feed_list=[x])
+        s = fluid.layers.reduce_sum(x)
+    reader.decorate_paddle_reader(
+        lambda: iter([(np.full((2, 4), v, 'float32'),) for v in (1, 2)]))
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        reader.start()
+        vals = []
+        while True:
+            try:
+                out, = exe.run(main, fetch_list=[s], scope=scope)
+            except EOFException:
+                reader.reset()
+                break
+            vals.append(float(np.asarray(out).reshape(())))
+    assert vals == [8.0, 16.0], vals

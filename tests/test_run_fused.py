@@ -74,7 +74,8 @@ def test_fused_continues_across_calls():
         assert np.isfinite(l1).all() and np.isfinite(l2).all()
 
 
-def test_fused_rejects_lod_feeds():
+def test_fused_lod_feed_single_batch_ok():
+    """One LoD binds statically; a lone staged LoD batch fuses fine."""
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
         x = fluid.layers.data(name='xs', shape=[4], dtype='float32',
@@ -87,9 +88,9 @@ def test_fused_rejects_lod_feeds():
         np.ones((3, 4), 'float32'), [[2, 1]], None)
     with fluid.scope_guard(scope):
         exe.run(startup, scope=scope)
-        with pytest.raises(ValueError, match="dense feeds only"):
-            exe.run_fused(main, [{'xs': lod_feed}], fetch_list=[loss],
-                          scope=scope)
+        out, = exe.run_fused(main, [{'xs': lod_feed}], fetch_list=[loss],
+                             scope=scope)
+    assert np.isfinite(out).all()
 
 
 def test_fused_handles_written_only_state():
@@ -122,3 +123,37 @@ def test_fused_handles_written_only_state():
                                 fetch_list=['gstep_counter'],
                                 scope=scope)[0]).reshape(-1)
         assert np.isfinite(h3).all()
+
+
+def test_fused_with_identical_lod_feeds():
+    """Ragged (LoD) feeds fuse when every staged batch shares the same
+    LoD (the bucketed-padding contract)."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 3
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='sx', shape=[6], dtype='float32',
+                              lod_level=1)
+        emb = fluid.layers.fc(x, size=12)
+        h = fluid.layers.dynamic_gru(input=emb, size=4)
+        last = fluid.layers.sequence_last_step(h)
+        p = fluid.layers.fc(last, size=2, act='softmax')
+        y = fluid.layers.data(name='sy', shape=[1], dtype='int64')
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(p, y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    lod = [[0, 3, 5]]
+    batches = [{'sx': (rng.randn(5, 6).astype('float32'), lod),
+                'sy': rng.randint(0, 2, (2, 1)).astype('int64')}
+               for _ in range(3)]
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        out, = exe.run_fused(main, batches, fetch_list=[loss], scope=scope)
+        assert np.isfinite(out).all()
+        # mismatched LoD across batches still errors
+        bad = batches[:2] + [{'sx': (rng.randn(5, 6).astype('float32'),
+                                     [[0, 2, 5]]),
+                              'sy': batches[0]['sy']}]
+        with pytest.raises(ValueError, match="identical LoD"):
+            exe.run_fused(main, bad, fetch_list=[loss], scope=scope)
