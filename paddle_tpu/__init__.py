@@ -61,6 +61,7 @@ from .flags import get_flags, set_flags
 from . import debugger
 from . import recordio
 from . import imperative
+from . import evaluator
 from . import checkpoint
 from . import average
 from .average import WeightedAverage

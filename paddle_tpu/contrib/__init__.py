@@ -13,6 +13,7 @@ from .op_frequence import op_freq_statistic  # noqa: F401
 from . import hdfs_utils  # noqa: F401
 from . import decoder  # noqa: F401
 from . import float16  # noqa: F401
+from . import reader  # noqa: F401
 from .quantize import QuantizeTranspiler  # noqa: F401
 from . import trainer  # noqa: F401
 from .trainer import (Trainer, Inferencer, BeginEpochEvent,  # noqa: F401

@@ -1,0 +1,43 @@
+"""contrib.reader: ctr_reader (reference
+python/paddle/fluid/contrib/reader/ctr_reader.py).
+
+The reference's ctr_reader is a C++ reader op streaming MultiSlot CTR
+files into a LoDTensorBlockingQueue; here the same contract composes from
+the native MultiSlot parser (async_executor.MultiSlotDataFeed over
+native/multislot.cc) and the py_reader queue: declare feed vars, call
+ctr_reader(...), start()/run()/EOF/reset().
+"""
+from ..layers.io import create_py_reader_by_data
+
+__all__ = ['ctr_reader']
+
+
+def ctr_reader(feed_data, capacity, thread_num, batch_size, file_list,
+               slots, name=None):
+    """Returns a started-able reader feeding `feed_data` vars from
+    MultiSlot `file_list`. `slots`: list of name, (name, type) or
+    (name, type, is_dense) — defaults 'uint64' sparse; order must match
+    feed_data. `thread_num` is accepted for reference-API parity but the
+    feeder is single-threaded here (the native C++ parser makes parsing
+    cheap; AsyncExecutor.run provides the multi-threaded file pool)."""
+    from ..async_executor import DataFeedDesc, MultiSlotDataFeed
+    desc = DataFeedDesc(batch_size=batch_size)
+    for sl in slots:
+        if isinstance(sl, (tuple, list)):
+            nm = sl[0]
+            tp = sl[1] if len(sl) > 1 else 'uint64'
+            dense = bool(sl[2]) if len(sl) > 2 else False
+            desc.add_slot(nm, tp, is_dense=dense)
+        else:
+            desc.add_slot(sl, 'uint64', is_dense=False)
+    feed = MultiSlotDataFeed(desc)
+    reader = create_py_reader_by_data(capacity, feed_data, name=name)
+    names = [sl['name'] for sl in desc.slots if sl['is_used']]
+
+    def _source():
+        for path in file_list:
+            for batch in feed.batches_from_file(path):
+                yield tuple(batch[n] for n in names)
+
+    reader.decorate_paddle_reader(_source)
+    return reader

@@ -1,0 +1,140 @@
+"""Deprecated Evaluator API (reference python/paddle/fluid/evaluator.py:44
+Evaluator, :126 ChunkEvaluator, :217 EditDistance) — kept for API parity;
+new code should use paddle_tpu.metrics (the same warning the reference
+emits).
+
+Design: states are persistable accumulator vars updated by `sums` ops in
+the main program (the reference pattern); `reset` zeroes them directly in
+the scope and `eval` reads them back — the executor round-trips the
+reference performs with generated reset/eval programs collapse to scope
+reads/writes in this runtime (state lives in the scope pytree).
+"""
+import warnings
+
+import numpy as np
+
+from . import layers
+from .framework import default_main_program
+from .executor import global_scope
+from .layer_helper import LayerHelper
+
+__all__ = ['ChunkEvaluator', 'EditDistance']
+
+
+class Evaluator(object):
+    """Base class (reference evaluator.py:44)."""
+
+    def __init__(self, name, **kwargs):
+        warnings.warn(
+            "The %s is deprecated, please use fluid.metrics.%s instead."
+            % (self.__class__.__name__, self.__class__.__name__), Warning)
+        self.states = []
+        self.metrics = []
+        self.helper = LayerHelper(name, **kwargs)
+
+    def reset(self, executor, reset_program=None, scope=None):
+        """Zero the accumulators. Pass `scope` when running with an
+        explicit Executor scope (or wrap in scope_guard) — state lives in
+        the scope the accumulation ops run against."""
+        scope = scope if scope is not None else global_scope()
+        for var in self.states:
+            scope.set(var.name,
+                      np.zeros([d if d and d > 0 else 1
+                                for d in var.shape], var.dtype))
+
+    def eval(self, executor, eval_program=None, scope=None):
+        raise NotImplementedError()
+
+    def _create_state(self, suffix, dtype, shape):
+        var = self.helper.main_program.global_block().create_var(
+            name='_'.join([self.helper.name, suffix]),
+            shape=tuple(shape), dtype=dtype, persistable=True)
+        global_scope().set(
+            var.name, np.zeros([d if d and d > 0 else 1 for d in shape],
+                               dtype))
+        self.states.append(var)
+        return var
+
+    def _state_values(self, executor, scope=None):
+        scope = scope if scope is not None else global_scope()
+        return [np.asarray(scope.get(v.name)) for v in self.states]
+
+
+class ChunkEvaluator(Evaluator):
+    """Accumulated chunk precision/recall/F1 (reference evaluator.py:126)."""
+
+    def __init__(self, input, label, chunk_scheme, num_chunk_types,
+                 excluded_chunk_types=None):
+        super(ChunkEvaluator, self).__init__('chunk_eval')
+        self.num_infer_chunks = self._create_state(
+            dtype='int64', shape=[1], suffix='num_infer_chunks')
+        self.num_label_chunks = self._create_state(
+            dtype='int64', shape=[1], suffix='num_label_chunks')
+        self.num_correct_chunks = self._create_state(
+            dtype='int64', shape=[1], suffix='num_correct_chunks')
+        (precision, recall, f1_score, num_infer_chunks, num_label_chunks,
+         num_correct_chunks) = layers.chunk_eval(
+            input=input, label=label, chunk_scheme=chunk_scheme,
+            num_chunk_types=num_chunk_types,
+            excluded_chunk_types=excluded_chunk_types)
+        layers.sums(input=[self.num_infer_chunks, num_infer_chunks],
+                    out=self.num_infer_chunks)
+        layers.sums(input=[self.num_label_chunks, num_label_chunks],
+                    out=self.num_label_chunks)
+        layers.sums(input=[self.num_correct_chunks, num_correct_chunks],
+                    out=self.num_correct_chunks)
+        self.metrics.extend([precision, recall, f1_score])
+
+    def eval(self, executor, eval_program=None, scope=None):
+        infer, label, correct = [
+            int(v.reshape(-1)[0])
+            for v in self._state_values(executor, scope)]
+        precision = float(correct) / infer if infer else 0.0
+        recall = float(correct) / label if label else 0.0
+        f1 = (2 * precision * recall / (precision + recall)
+              if correct else 0.0)
+        return (np.array([precision], 'float32'),
+                np.array([recall], 'float32'),
+                np.array([f1], 'float32'))
+
+
+class EditDistance(Evaluator):
+    """Accumulated average edit distance + instance error rate (reference
+    evaluator.py:217)."""
+
+    def __init__(self, input, label, ignored_tokens=None):
+        super(EditDistance, self).__init__('edit_distance')
+        self.total_distance = self._create_state(
+            dtype='float32', shape=[1], suffix='total_distance')
+        self.seq_num = self._create_state(
+            dtype='int64', shape=[1], suffix='seq_num')
+        self.instance_error = self._create_state(
+            dtype='int64', shape=[1], suffix='instance_error')
+        distances, seq_num = layers.edit_distance(
+            input=input, label=label, normalized=False,
+            ignored_tokens=ignored_tokens)
+        zero = layers.fill_constant(shape=[1], value=0.0, dtype='float32')
+        compare_result = layers.equal(distances, zero)
+        seq_right_count = layers.reshape(
+            layers.reduce_sum(layers.cast(x=compare_result,
+                                          dtype='int64')), shape=[1])
+        seq_num_1 = layers.reshape(layers.cast(seq_num, 'int64'),
+                                   shape=[1])
+        instance_error_count = layers.elementwise_sub(seq_num_1,
+                                                      seq_right_count)
+        total_distance = layers.reshape(
+            layers.reduce_sum(distances), shape=[1])
+        layers.sums(input=[self.total_distance, total_distance],
+                    out=self.total_distance)
+        layers.sums(input=[self.seq_num, seq_num_1], out=self.seq_num)
+        layers.sums(input=[self.instance_error, instance_error_count],
+                    out=self.instance_error)
+        self.metrics.append(total_distance)
+
+    def eval(self, executor, eval_program=None, scope=None):
+        total, n, err = [v.reshape(-1)[0]
+                         for v in self._state_values(executor, scope)]
+        avg_distance = float(total) / n if n else 0.0
+        avg_instance_error = float(err) / n if n else 0.0
+        return (np.array([avg_distance], 'float32'),
+                np.array([avg_instance_error], 'float32'))

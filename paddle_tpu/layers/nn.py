@@ -640,12 +640,19 @@ def _infer_reshape_shape(x, shape):
 
 def reshape(x, shape, actual_shape=None, act=None, inplace=False, name=None):
     helper = LayerHelper('reshape2', name=name)
-    out_shape = _infer_reshape_shape(x, shape)
+    # unknown input shape (shape=None vars): a fully-literal target IS
+    # the out shape; targets with 0/-1 stay unshaped and bind at lowering
+    if x.shape is not None:
+        out_shape = _infer_reshape_shape(x, shape)
+    elif all(isinstance(d, int) and d > 0 for d in shape):
+        out_shape = tuple(shape)
+    else:
+        out_shape = None
     out = helper.create_variable_for_type_inference(dtype=x.dtype,
                                                     shape=out_shape)
-    xshape = helper.create_variable_for_type_inference(dtype=x.dtype,
-                                                       shape=(0,) + tuple(
-                                                           x.shape))
+    xshape = helper.create_variable_for_type_inference(
+        dtype=x.dtype,
+        shape=((0,) + tuple(x.shape)) if x.shape is not None else None)
     helper.append_op(type='reshape2', inputs={'X': [x]},
                      outputs={'Out': [out], 'XShape': [xshape]},
                      attrs={'shape': list(shape)})
@@ -795,7 +802,10 @@ sums_ = sum
 
 def _elementwise(op_type, x, y, axis=-1, act=None, name=None):
     helper = LayerHelper(op_type, name=name, act=act)
-    shape = x.shape if len(x.shape) >= len(y.shape) else y.shape
+    if x.shape is None or y.shape is None:
+        shape = x.shape if x.shape is not None else y.shape
+    else:
+        shape = x.shape if len(x.shape) >= len(y.shape) else y.shape
     out = helper.create_variable_for_type_inference(dtype=x.dtype,
                                                     shape=shape)
     helper.append_op(type=op_type, inputs={'X': [x], 'Y': [y]},

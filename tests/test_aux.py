@@ -258,3 +258,62 @@ def test_hdfs_client_raises_without_hadoop():
     c = HDFSClient(hadoop_home='/nonexistent/hadoop')
     with _pytest.raises(RuntimeError, match='hadoop binary'):
         c.is_exist('/tmp/x')
+
+
+def test_deprecated_chunk_evaluator():
+    """Deprecated Evaluator API (reference evaluator.py:126) accumulates
+    chunk counts across runs."""
+    import warnings as _w
+    import paddle_tpu as fluid
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        inf = fluid.layers.data(name='ev_inf', shape=[1], dtype='int64',
+                                lod_level=1)
+        lab = fluid.layers.data(name='ev_lab', shape=[1], dtype='int64',
+                                lod_level=1)
+        with _w.catch_warnings(record=True) as rec:
+            _w.simplefilter('always')
+            ev = fluid.evaluator.ChunkEvaluator(
+                inf, lab, chunk_scheme='IOB', num_chunk_types=2)
+        assert any('deprecated' in str(r.message) for r in rec)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    # IOB with 2 types: tags 0..3 (B-0, I-0, B-1, I-1); 4 = O
+    seq = np.array([[0], [1], [4], [2]], 'int64')   # chunks: type0, type1
+    lod = [[0, 4]]
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        ev.reset(exe)
+        for _ in range(2):   # two identical batches accumulate
+            exe.run(main, feed={'ev_inf': (seq, lod),
+                                'ev_lab': (seq, lod)},
+                    fetch_list=ev.metrics, scope=scope)
+        precision, recall, f1 = ev.eval(exe)
+    assert precision[0] == 1.0 and recall[0] == 1.0 and f1[0] == 1.0
+    # accumulated counts doubled across batches
+    assert int(np.asarray(scope.get(
+        ev.num_correct_chunks.name)).reshape(-1)[0]) == 4
+
+
+def test_deprecated_edit_distance_evaluator():
+    import paddle_tpu as fluid
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        hyp = fluid.layers.data(name='ed_h', shape=[1], dtype='int64',
+                                lod_level=1)
+        ref = fluid.layers.data(name='ed_r', shape=[1], dtype='int64',
+                                lod_level=1)
+        ev = fluid.evaluator.EditDistance(hyp, ref)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    h = np.array([[1], [2], [3], [5]], 'int64')
+    r = np.array([[1], [2], [4], [5]], 'int64')
+    lod = [[0, 2, 4]]       # two sequences: exact match + 1 substitution
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        ev.reset(exe)
+        exe.run(main, feed={'ed_h': (h, lod), 'ed_r': (r, lod)},
+                fetch_list=ev.metrics, scope=scope)
+        avg_dist, avg_err = ev.eval(exe)
+    np.testing.assert_allclose(avg_dist[0], 0.5)   # (0 + 1) / 2
+    np.testing.assert_allclose(avg_err[0], 0.5)    # 1 of 2 sequences wrong

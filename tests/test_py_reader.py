@@ -173,3 +173,43 @@ def test_create_py_reader_by_data():
                 break
             vals.append(float(np.asarray(out).reshape(())))
     assert vals == [8.0, 16.0], vals
+
+
+def test_contrib_ctr_reader(tmp_path):
+    """contrib.reader.ctr_reader: MultiSlot files -> py_reader queue
+    (reference contrib/reader/ctr_reader.py contract)."""
+    from paddle_tpu.contrib.reader import ctr_reader
+    f = tmp_path / "ctr.txt"
+    # 3 samples: 2 sparse ids + 1 dense feature + 1 label id
+    f.write_text("2 3 4 1 0.5 1 1\n1 7 1 1.5 1 0\n1 9 1 2.5 1 1\n")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = fluid.layers.data(name='cr_ids', shape=[1], dtype='int64',
+                                lod_level=1)
+        dense = fluid.layers.data(name='cr_dense', shape=[1],
+                                  dtype='float32')
+        lbl = fluid.layers.data(name='cr_lbl', shape=[1], dtype='int64',
+                                lod_level=1)
+        reader = ctr_reader(
+            [ids, dense, lbl], capacity=4, thread_num=1, batch_size=2,
+            file_list=[str(f)],
+            slots=[('cr_ids', 'uint64', False),
+                   ('cr_dense', 'float', True),
+                   ('cr_lbl', 'uint64', False)])
+        emb = fluid.layers.embedding(ids, size=[16, 4], is_sparse=True)
+        pooled = fluid.layers.sequence_pool(emb, 'sum')
+        s = fluid.layers.reduce_sum(pooled)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        reader.start()
+        n = 0
+        while True:
+            try:
+                exe.run(main, fetch_list=[s], scope=scope)
+                n += 1
+            except EOFException:
+                reader.reset()
+                break
+    assert n == 2        # batches of 2 + 1
