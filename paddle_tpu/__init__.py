@@ -62,6 +62,9 @@ from . import debugger
 from . import recordio
 from . import imperative
 from . import evaluator
+from . import compat
+from . import net_drawer
+from . import default_scope_funcs
 from . import checkpoint
 from . import average
 from .average import WeightedAverage

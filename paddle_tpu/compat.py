@@ -1,0 +1,70 @@
+"""py2/3 compatibility helpers (reference python/paddle/fluid/compat.py).
+
+Python 2 is gone, but the helpers remain part of the public surface the
+reference's user code imports (to_text/to_bytes round-trips, exception
+message access), so they are kept with python-3 semantics.
+"""
+__all__ = [
+    'long_type', 'to_text', 'to_bytes', 'round', 'floor_division',
+    'get_exception_message',
+]
+
+long_type = int
+
+
+def _convert(obj, conv, inplace):
+    if isinstance(obj, list):
+        if inplace:
+            for i in range(len(obj)):
+                obj[i] = _convert(obj[i], conv, inplace)
+            return obj
+        return [_convert(o, conv, False) for o in obj]
+    if isinstance(obj, set):
+        if inplace:
+            items = [_convert(o, conv, False) for o in obj]
+            obj.clear()
+            obj.update(items)
+            return obj
+        return set(_convert(o, conv, False) for o in obj)
+    return conv(obj)
+
+
+def to_text(obj, encoding='utf-8', inplace=False):
+    """bytes -> str (lists/sets recursively), everything else unchanged."""
+    if obj is None:
+        return obj
+
+    def conv(o):
+        return o.decode(encoding) if isinstance(o, bytes) else o
+    return _convert(obj, conv, inplace)
+
+
+def to_bytes(obj, encoding='utf-8', inplace=False):
+    """str -> bytes (lists/sets recursively), everything else unchanged."""
+    if obj is None:
+        return obj
+
+    def conv(o):
+        return o.encode(encoding) if isinstance(o, str) else o
+    return _convert(obj, conv, inplace)
+
+
+def round(x, d=0):
+    """Python-3 banker-free rounding the reference normalizes to."""
+    import math
+    if x > 0.0:
+        p = 10 ** d
+        return float(math.floor((x * p) + math.copysign(0.5, x))) / p
+    if x < 0.0:
+        p = 10 ** d
+        return float(math.ceil((x * p) + math.copysign(0.5, x))) / p
+    return 0.0
+
+
+def floor_division(x, y):
+    return x // y
+
+
+def get_exception_message(exc):
+    """The exception's message text (reference compat helper)."""
+    return str(exc)

@@ -1,0 +1,51 @@
+"""Default-scope helpers (reference
+python/paddle/fluid/default_scope_funcs.py): a thread-wide scope stack
+with enter/leave, var lookup and scoped execution."""
+import threading
+
+from .executor import Scope
+
+__all__ = [
+    'get_cur_scope', 'enter_local_scope', 'leave_local_scope', 'var',
+    'find_var', 'scoped_function',
+]
+
+_tls = threading.local()
+
+
+def _stack():
+    if not hasattr(_tls, 'scopes') or not _tls.scopes:
+        _tls.scopes = [Scope()]
+    return _tls.scopes
+
+
+def get_cur_scope():
+    """The current scope of this thread's stack."""
+    return _stack()[-1]
+
+
+def enter_local_scope():
+    _stack().append(get_cur_scope().new_scope())
+
+
+def leave_local_scope():
+    st = _stack()
+    if len(st) > 1:
+        st.pop()
+
+
+def var(name):
+    return get_cur_scope().var(name)
+
+
+def find_var(name):
+    return get_cur_scope().find_var(name)
+
+
+def scoped_function(func):
+    """Run func inside a fresh local scope (reference scoped_function)."""
+    enter_local_scope()
+    try:
+        return func()
+    finally:
+        leave_local_scope()

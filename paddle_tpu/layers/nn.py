@@ -38,7 +38,7 @@ __all__ = [
     'dice_loss', 'image_resize_short', 'lstm', 'lstm_unit',
     'conv3d_transpose', 'similarity_focus', 'tree_conv',
     'merge_selected_rows', 'get_tensor_from_selected_rows',
-    'switch_moe',
+    'switch_moe', 'flash_attention',
     'teacher_student_sigmoid_loss', 'selu', 'swish',
     'sharding_constraint', 'linear_chain_crf', 'crf_decoding', 'warpctc',
     'ctc_greedy_decoder', 'edit_distance',
@@ -803,7 +803,9 @@ sums_ = sum
 def _elementwise(op_type, x, y, axis=-1, act=None, name=None):
     helper = LayerHelper(op_type, name=name, act=act)
     if x.shape is None or y.shape is None:
-        shape = x.shape if x.shape is not None else y.shape
+        # the unknown side may be the LARGER broadcast operand: any static
+        # shape stamped here could be wrong, so stay unshaped
+        shape = None
     else:
         shape = x.shape if len(x.shape) >= len(y.shape) else y.shape
     out = helper.create_variable_for_type_inference(dtype=x.dtype,
@@ -1987,3 +1989,21 @@ def switch_moe(input, num_experts, d_ff, capacity_factor=1.25,
         outputs={'Out': [out], 'AuxLoss': [aux]},
         attrs={'capacity_factor': capacity_factor})
     return out, aux
+
+
+def flash_attention(q, k, v, scale=None, causal=True, name=None):
+    """Fused multi-head attention layer over the blocked pallas kernel
+    (ops/attention_ops.py): q/k/v [B, H, L, dh]. Under an SPMD mesh the
+    kernel runs per shard (ring attention when the sequence axis is
+    sharded). TPU-native extension exposed at the layers surface."""
+    helper = LayerHelper('flash_attention', name=name)
+    out = helper.create_variable_for_type_inference(q.dtype, shape=q.shape)
+    # scale attr 0.0 means "kernel default dh**-0.5" (the op handler's
+    # contract) — pass the user's value through untouched otherwise
+    helper.append_op(
+        type='flash_attention',
+        inputs={'Q': [q], 'K': [k], 'V': [v]},
+        outputs={'Out': [out]},
+        attrs={'scale': float(scale) if scale is not None else 0.0,
+               'causal': bool(causal)})
+    return out

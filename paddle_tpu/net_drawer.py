@@ -1,0 +1,26 @@
+"""Program visualization (reference python/paddle/fluid/net_drawer.py):
+draw a Program's op graph. Delegates to the graphviz writer in
+debugger.py (the maintained path); kept as a module for API parity."""
+import json
+
+from .debugger import draw_block_graphviz
+
+__all__ = ['draw_graph']
+
+
+def draw_graph(startup_program, main_program, path='graph.dot', **kwargs):
+    """Write main_program's global block as graphviz dot to `path`
+    (reference draw_graph merges startup+main; startup is init-only here
+    and omitted from the drawing)."""
+    return draw_block_graphviz(main_program, path)
+
+
+def op_summary(program):
+    """JSON-able op summary (name/inputs/outputs per op) — the structure
+    the reference's drawer renders."""
+    out = []
+    for op in program.global_block().ops:
+        out.append({'type': op.type,
+                    'inputs': {k: list(v) for k, v in op.inputs.items()},
+                    'outputs': {k: list(v) for k, v in op.outputs.items()}})
+    return json.dumps(out)

@@ -178,3 +178,28 @@ def test_spmd_seq_axis_dispatches_to_ring():
                          v.reshape(b * h, ln, dh), dh ** -0.5, True)
     np.testing.assert_allclose(np.asarray(out).reshape(b * h, ln, dh),
                                np.asarray(ref), rtol=2e-4, atol=2e-5)
+
+
+def test_flash_attention_layer():
+    """layers.flash_attention wrapper == the op == the jnp reference."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        q = fluid.layers.data(name='fq', shape=[2, 16, 8], dtype='float32')
+        k = fluid.layers.data(name='fk', shape=[2, 16, 8], dtype='float32')
+        v = fluid.layers.data(name='fv', shape=[2, 16, 8], dtype='float32')
+        out = fluid.layers.flash_attention(q, k, v, causal=True)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    Q = rng.randn(2, 2, 16, 8).astype('float32')
+    K = rng.randn(2, 2, 16, 8).astype('float32')
+    V = rng.randn(2, 2, 16, 8).astype('float32')
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        got, = exe.run(main, feed={'fq': Q, 'fk': K, 'fv': V},
+                       fetch_list=[out], scope=scope)
+    ref = _attention_ref(jnp.asarray(Q.reshape(4, 16, 8)),
+                         jnp.asarray(K.reshape(4, 16, 8)),
+                         jnp.asarray(V.reshape(4, 16, 8)), 8 ** -0.5, True)
+    np.testing.assert_allclose(np.asarray(got).reshape(4, 16, 8),
+                               np.asarray(ref), rtol=1e-4, atol=1e-5)

@@ -317,3 +317,43 @@ def test_deprecated_edit_distance_evaluator():
         avg_dist, avg_err = ev.eval(exe)
     np.testing.assert_allclose(avg_dist[0], 0.5)   # (0 + 1) / 2
     np.testing.assert_allclose(avg_err[0], 0.5)    # 1 of 2 sequences wrong
+
+
+def test_compat_helpers():
+    import paddle_tpu as fluid
+    c = fluid.compat
+    assert c.to_text(b'ab') == 'ab'
+    assert c.to_bytes('ab') == b'ab'
+    assert c.to_text([b'a', [b'b']]) == ['a', ['b']]
+    assert c.round(2.5) == 3.0 and c.round(-2.5) == -3.0
+    assert c.floor_division(7, 2) == 3
+    assert c.get_exception_message(ValueError('boom')) == 'boom'
+
+
+def test_default_scope_funcs():
+    import numpy as np
+    from paddle_tpu import default_scope_funcs as dsf
+    dsf.var('dsv').get_tensor().set(np.ones((2,), 'float32'))
+    assert dsf.find_var('dsv') is not None
+
+    def inner():
+        dsf.var('inner_v').get_tensor().set(np.zeros((1,), 'float32'))
+        return dsf.find_var('inner_v') is not None
+    assert dsf.scoped_function(inner)
+    # local scope left: inner_v gone, dsv still visible
+    assert dsf.find_var('inner_v') is None
+    assert dsf.find_var('dsv') is not None
+
+
+def test_net_drawer(tmp_path):
+    import paddle_tpu as fluid
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='nd_x', shape=[4], dtype='float32')
+        fluid.layers.fc(x, size=2)
+    out = tmp_path / 'g.dot'
+    fluid.net_drawer.draw_graph(startup, main, path=str(out))
+    assert out.exists() and 'mul' in out.read_text()
+    import json
+    summary = json.loads(fluid.net_drawer.op_summary(main))
+    assert any(o['type'] == 'mul' for o in summary)

@@ -42,6 +42,11 @@ def iter_api():
         ('paddle_tpu.regularizer', fluid.regularizer),
         ('paddle_tpu.clip', fluid.clip),
         ('paddle_tpu.metrics', fluid.metrics),
+        ('paddle_tpu.evaluator', fluid.evaluator),
+        ('paddle_tpu.compat', fluid.compat),
+        ('paddle_tpu.net_drawer', fluid.net_drawer),
+        ('paddle_tpu.default_scope_funcs', fluid.default_scope_funcs),
+        ('paddle_tpu.contrib.reader', fluid.contrib.reader),
         ('paddle_tpu.io', fluid.io),
         ('paddle_tpu.nets', fluid.nets),
         ('paddle_tpu.reader', fluid.reader),
