@@ -39,7 +39,13 @@ def var(name):
 
 
 def find_var(name):
-    return get_cur_scope().find_var(name)
+    """Resolve through the scope stack (the reference scope parent chain:
+    inner scopes see enclosing vars)."""
+    for scope in reversed(_stack()):
+        found = scope.find_var(name)
+        if found is not None:
+            return found
+    return None
 
 
 def scoped_function(func):

@@ -1998,12 +1998,14 @@ def flash_attention(q, k, v, scale=None, causal=True, name=None):
     sharded). TPU-native extension exposed at the layers surface."""
     helper = LayerHelper('flash_attention', name=name)
     out = helper.create_variable_for_type_inference(q.dtype, shape=q.shape)
-    # scale attr 0.0 means "kernel default dh**-0.5" (the op handler's
-    # contract) — pass the user's value through untouched otherwise
+    # omitted scale attr = kernel default dh**-0.5; a present attr (even
+    # 0.0) is taken literally
+    attrs = {'causal': bool(causal)}
+    if scale is not None:
+        attrs['scale'] = float(scale)
     helper.append_op(
         type='flash_attention',
         inputs={'Q': [q], 'K': [k], 'V': [v]},
         outputs={'Out': [out]},
-        attrs={'scale': float(scale) if scale is not None else 0.0,
-               'causal': bool(causal)})
+        attrs=attrs)
     return out

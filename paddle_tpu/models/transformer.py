@@ -31,6 +31,8 @@ class LMConfig(object):
         # kernel runs only when the effective value is 0 (no in-kernel RNG)
         self.attn_dropout = dropout if attn_dropout is None else attn_dropout
         self.use_flash_attention = use_flash_attention
+        # balanced causal layout when the sequence axis is ring-sharded
+        self.ring_zigzag = False
 
 
 def multi_head_attention(x, cfg, prefix, mask_var=None, is_test=False,
@@ -66,7 +68,9 @@ def multi_head_attention(x, cfg, prefix, mask_var=None, is_test=False,
             type='flash_attention',
             inputs={'Q': [q], 'K': [k], 'V': [v]},
             outputs={'Out': [ctx]},
-            attrs={'scale': dh ** -0.5, 'causal': True})
+            attrs={'scale': dh ** -0.5, 'causal': True,
+                   'ring_zigzag': bool(getattr(cfg, 'ring_zigzag',
+                                               False))})
     else:
         logits = layers.matmul(q, k, transpose_y=True, alpha=dh ** -0.5)
         if mask_var is not None:

@@ -372,10 +372,11 @@ def _mesh_axis(mesh, name, dim_size):
 
 
 def flash_attention_spmd(q, k, v, mesh, scale=None, causal=True,
-                         use_pallas=None):
+                         use_pallas=None, ring_zigzag=False):
     """[B, H, L, dh] under an active mesh: batch sharded over 'data', heads
     over 'model', kernel per shard via shard_map. If the 'seq' axis shards
-    L, dispatches to ring attention (the long-context mode)."""
+    L, dispatches to ring attention (the long-context mode); ring_zigzag
+    uses the balanced causal layout (parallel/ring_attention.py)."""
     from jax.sharding import PartitionSpec as P
     b, h, ln, dh = q.shape
     if scale is None:
@@ -385,9 +386,12 @@ def flash_attention_spmd(q, k, v, mesh, scale=None, causal=True,
     seq_ax = _mesh_axis(mesh, 'seq', ln)
     if seq_ax is not None:
         from ..parallel.ring_attention import ring_attention
+        zz = (bool(ring_zigzag) and causal
+              and ln % (2 * mesh.shape[seq_ax]) == 0)
         return ring_attention(q, k, v, mesh, axis_name=seq_ax,
                               scale=scale, causal=causal,
-                              batch_axis=data_ax, head_axis=model_ax)
+                              batch_axis=data_ax, head_axis=model_ax,
+                              zigzag=zz)
     impl = _resolve_impl(use_pallas)
     if impl == 'pallas' and ln % 128 and ln > 1024:
         # same guard as flash_attention: no 128-multiple tile divides L,
@@ -418,7 +422,11 @@ def _flash_attention_op(ctx, op):
     v = ctx.in1(op, 'V')
     out_dtype = q.dtype
     q, k, v = amp.cast_compute(op, q, k, v)
-    scale = op.attr('scale', 0.0) or None
+    # missing attr -> kernel default dh**-0.5; a present value (incl. 0.0)
+    # is literal. Legacy programs that stored 0.0 meaning "default" keep
+    # that behavior.
+    scale = op.attr('scale', None)
+    scale = None if scale is None or scale == 0.0 else float(scale)
     causal = op.attr('causal', True)
     from ..parallel.api import get_active_mesh
     mesh = get_active_mesh()
@@ -429,9 +437,10 @@ def _flash_attention_op(ctx, op):
         use_pallas = 'interpret' if mesh is not None else False
     if mesh is not None and mesh.size > 1:
         if q.ndim == 4:
-            out = flash_attention_spmd(q, k, v, mesh, scale=scale,
-                                       causal=causal,
-                                       use_pallas=use_pallas)
+            out = flash_attention_spmd(
+                q, k, v, mesh, scale=scale, causal=causal,
+                use_pallas=use_pallas,
+                ring_zigzag=op.attr('ring_zigzag', False))
         else:
             # 3-d [BH, L, dh]: no batch/head axes to shard_map over; the
             # XLA auto-partitioner cannot split a pallas custom call, so

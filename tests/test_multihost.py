@@ -155,3 +155,13 @@ def test_launcher_env_contract():
         devices_per_proc=2)
     for p in procs:
         assert p.wait(timeout=300) == 0
+
+
+def test_four_process_dp_tp_mesh():
+    """4 processes x 2 virtual devices: dp=4 x tp=2 MeshRunner spanning
+    processes — tensor-parallel shards cross host boundaries."""
+    results = _run_workers(4, env_extra={'MH_MODE': 'dp_tp'})
+    for other in results[1:]:
+        np.testing.assert_allclose(results[0], other, rtol=1e-5,
+                                   atol=1e-6)
+    assert all(np.isfinite(results[0]))

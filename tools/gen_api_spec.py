@@ -68,6 +68,9 @@ def iter_api():
             obj = getattr(mod, name, None)
             if obj is None:
                 continue
+            if getattr(obj, '__module__', None) == 'builtins':
+                rows.append('%s.%s <builtin alias>' % (mod_name, name))
+                continue
             if inspect.isclass(obj):
                 rows.append('%s.%s.__init__ %s' % (
                     mod_name, name, _spec_of(obj.__init__)))
