@@ -9,12 +9,14 @@ arrays (the SAME lowerings the compiled Program executor traces — one op
 library, two execution modes) and implements `backward()` by replaying the
 recorded tape under jax.grad.
 """
-from .base import guard, enabled, to_variable, current_tracer, VarBase
+from .base import (guard, enabled, to_variable, current_tracer, VarBase,
+                   save_dygraph, load_dygraph)
 from .layers import Layer, PyLayer
 from .nn import Conv2D, Pool2D, FC, BatchNorm, Embedding
 from .optimizer import SGDOptimizer, AdamOptimizer
 from . import ops
 
 __all__ = ['guard', 'enabled', 'to_variable', 'current_tracer', 'VarBase',
+           'save_dygraph', 'load_dygraph',
            'Layer', 'PyLayer', 'Conv2D', 'Pool2D', 'FC', 'BatchNorm',
            'Embedding', 'SGDOptimizer', 'AdamOptimizer', 'ops']

@@ -10,6 +10,7 @@ program and differentiates it with jax.grad — reverse-mode AD with XLA
 semantics instead of per-op grad kernels.
 """
 import contextlib
+import os
 
 import numpy as np
 import jax
@@ -193,3 +194,22 @@ class Tracer(object):
         grads = jax.grad(forward)([l._value for l in leaves])
         for leaf, g in zip(leaves, grads):
             leaf._grad = g if leaf._grad is None else leaf._grad + g
+
+
+def save_dygraph(state_dict, path):
+    """Persist an eager model/optimizer state dict ({name: ndarray}) to
+    `path`.npz (the dygraph analog of io.save_persistables; reference adds
+    fluid.dygraph.save_persistables in the successor release)."""
+    arrays = {k: np.asarray(v) for k, v in state_dict.items()}
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    np.savez(path if path.endswith('.npz') else path + '.npz', **arrays)
+
+
+def load_dygraph(path):
+    """Load a state dict saved by save_dygraph; returns {name: ndarray}
+    for Layer.set_dict."""
+    p = path if path.endswith('.npz') else path + '.npz'
+    with np.load(p) as z:
+        return {k: z[k] for k in z.files}

@@ -292,3 +292,144 @@ def test_selected_rows_layer_wrappers():
         out, = exe.run(main, feed={'srx': np.ones((3, 4), 'float32')},
                        fetch_list=[t], scope=scope)
     np.testing.assert_array_equal(out, np.ones((3, 4), 'float32'))
+
+
+from op_test import OpTest
+
+
+def _sig(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def _attention_lstm_np(x, c0, h0, aw, ab, lw, lb, lod):
+    M = x.shape[1]
+    D = lw.shape[1] // 4
+    hid = np.zeros((x.shape[0], D), 'float32')
+    cell = np.zeros((x.shape[0], D), 'float32')
+    off = lod[0]
+    for n in range(len(off) - 1):
+        xs = x[off[n]:off[n + 1]]
+        atted = xs @ aw[:M] + ab[0, 0]
+        c_prev, h_prev = c0[n].copy(), h0[n].copy()
+        for t in range(xs.shape[0]):
+            e = np.maximum(atted[:, 0] + (c_prev @ aw[M:]).item(), 0.0)
+            e = e - e.max()
+            p = np.exp(e) / np.exp(e).sum()
+            lx = p @ xs
+            g = lx @ lw[D:] + h_prev @ lw[:D] + lb[0]
+            f, i, o = _sig(g[:D]), _sig(g[D:2 * D]), _sig(g[2 * D:3 * D])
+            cand = np.tanh(g[3 * D:])
+            c_prev = f * c_prev + i * cand
+            h_prev = np.tanh(c_prev) * o
+            hid[off[n] + t] = h_prev
+            cell[off[n] + t] = c_prev
+    return hid, cell
+
+
+def test_attention_lstm_grad():
+    """Finite-difference grad check for attention_lstm (the OpTest
+    discipline for the round-3 op tail)."""
+    rng = np.random.RandomState(0)
+    M, D = 3, 2
+    lod = [[0, 2, 4]]
+    x = rng.uniform(-0.3, 0.3, (4, M)).astype('float32')
+    c0 = rng.uniform(-0.2, 0.2, (2, D)).astype('float32')
+    h0 = rng.uniform(-0.2, 0.2, (2, D)).astype('float32')
+    aw = rng.uniform(-0.3, 0.3, (M + D, 1)).astype('float32')
+    ab = rng.uniform(-0.1, 0.1, (1, 1)).astype('float32')
+    lw = rng.uniform(-0.3, 0.3, (D + M, 4 * D)).astype('float32')
+    lb = rng.uniform(-0.1, 0.1, (1, 4 * D)).astype('float32')
+    hid, cell = _attention_lstm_np(x, c0, h0, aw, ab, lw, lb, lod)
+
+    class C(OpTest):
+        def setup(self):
+            self.op_type = 'attention_lstm'
+            self.inputs = {'X': (x, lod), 'C0': c0, 'H0': h0,
+                           'AttentionWeight': aw, 'AttentionBias': ab,
+                           'LSTMWeight': lw, 'LSTMBias': lb}
+            self.outputs = {'Hidden': (hid, lod), 'Cell': (cell, lod)}
+            self.attrs = {}
+    C().check_output(atol=1e-4)
+    C().check_grad(['X', 'LSTMWeight', 'AttentionWeight'], ['Hidden'],
+                   max_relative_error=0.03)
+
+
+def test_cudnn_lstm_grad():
+    rng = np.random.RandomState(1)
+    T, B, I, H = 3, 2, 3, 2
+    x = rng.uniform(-0.3, 0.3, (T, B, I)).astype('float32')
+    h0 = np.zeros((1, B, H), 'float32')
+    c0 = np.zeros((1, B, H), 'float32')
+    w = rng.uniform(-0.3, 0.3,
+                    (I * 4 * H + H * 4 * H + 8 * H,)).astype('float32')
+
+    wx = w[:I * 4 * H].reshape(I, 4 * H)
+    wh = w[I * 4 * H:I * 4 * H + H * 4 * H].reshape(H, 4 * H)
+    bx = w[-8 * H:-4 * H]
+    bh = w[-4 * H:]
+    out_ref = np.zeros((T, B, H), 'float32')
+    h, c = h0[0], c0[0]
+    for t in range(T):
+        g = x[t] @ wx + h @ wh + bx + bh
+        i = _sig(g[:, :H])
+        f = _sig(g[:, H:2 * H])
+        cand = np.tanh(g[:, 2 * H:3 * H])
+        o = _sig(g[:, 3 * H:])
+        c = f * c + i * cand
+        h = o * np.tanh(c)
+        out_ref[t] = h
+
+    class C(OpTest):
+        def setup(self):
+            self.op_type = 'cudnn_lstm'
+            self.inputs = {'Input': x, 'InitH': h0, 'InitC': c0, 'W': w}
+            self.outputs = {'Out': out_ref, 'last_h': h[None],
+                            'last_c': c[None]}
+            self.attrs = {'hidden_size': H, 'num_layers': 1,
+                          'is_bidirec': False, 'input_size': I,
+                          'is_test': True}
+    C().check_output(atol=1e-4)
+    C().check_grad(['Input', 'W'], ['Out'], max_relative_error=0.02)
+
+
+def test_fused_embedding_seq_pool_grad():
+    rng = np.random.RandomState(2)
+    w = rng.uniform(-0.3, 0.3, (8, 4)).astype('float32')
+    ids = np.array([[1], [2], [5]], 'int64')
+    lod = [[0, 2, 3]]
+    ref = np.stack([w[[1, 2]].sum(0), w[5]])
+
+    class C(OpTest):
+        def setup(self):
+            self.op_type = 'fused_embedding_seq_pool'
+            self.inputs = {'W': w, 'Ids': (ids, lod)}
+            self.outputs = {'Out': ref}
+            self.attrs = {'combiner': 'sum'}
+    C().check_output(atol=1e-5)
+    C().check_grad(['W'], ['Out'], max_relative_error=0.01)
+
+
+def test_roi_perspective_transform_grad():
+    """Gradient flows into X through the bilinear perspective sampling."""
+    h = w = 6
+    x = np.random.RandomState(3).uniform(
+        0.1, 1.0, (1, 1, h, w)).astype('float32')
+    rois = np.array([[1, 1, 4, 1, 4, 4, 1, 4]], 'float32')
+    lod = [[0, 1]]
+    # forward reference from the op itself (cross-checked vs numpy in
+    # test_roi_perspective_transform_axis_aligned); here we pin gradients
+    out, = _run_single_op(
+        'roi_perspective_transform', {'X': x, 'ROIs': (rois, lod)},
+        {'Out': ['rptg']},
+        {'transformed_height': 4, 'transformed_width': 4,
+         'spatial_scale': 1.0})
+
+    class C(OpTest):
+        def setup(self):
+            self.op_type = 'roi_perspective_transform'
+            self.inputs = {'X': x, 'ROIs': (rois, lod)}
+            self.outputs = {'Out': np.asarray(out)}
+            self.attrs = {'transformed_height': 4,
+                          'transformed_width': 4, 'spatial_scale': 1.0}
+    C().check_grad(['X'], ['Out'], max_relative_error=0.02,
+                   no_grad_set={'ROIs'})
