@@ -133,19 +133,20 @@ def _bench_lm(cfg_kwargs, batch, k_per_call, rounds, amp):
     }
 
 
-def _bench_resnet50(batch, k_per_call, rounds, amp):
+def _bench_image_model(build_fn, label_str, batch, k_per_call, rounds,
+                       amp):
+    """Shared ImageNet-model measurement (resnet50 / se_resnext rows):
+    Momentum + keep-bf16-activations AMP (+13% images/sec measured on
+    v5e), 24+-step fused windows."""
     import numpy as np
     import paddle_tpu as fluid
     from paddle_tpu.contrib import mixed_precision as mp
-    from paddle_tpu.models.resnet import build as build_resnet
 
     main_p, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main_p, startup):
-        img, label, pred, avg_cost, acc = build_resnet('imagenet', depth=50)
+        img, label, pred, avg_cost, acc = build_fn()
         opt = fluid.optimizer.Momentum(learning_rate=0.1, momentum=0.9)
         if amp:
-            # bandwidth mode: conv/bn activations stay bf16 in HBM
-            # (+13% images/sec measured on v5e)
             opt = mp.decorate(opt, keep_bf16_activations=True)
         opt.minimize(avg_cost)
     exe = fluid.Executor(fluid.TPUPlace(0))
@@ -164,8 +165,15 @@ def _bench_resnet50(batch, k_per_call, rounds, amp):
         'step_ms': round(sec_step * 1000, 2),
         'compile_s': round(compile_s, 1),
         'final_loss': round(loss, 4),
-        'config': 'resnet50 imagenet b%d' % batch,
+        'config': '%s imagenet b%d' % (label_str, batch),
     }
+
+
+def _bench_resnet50(batch, k_per_call, rounds, amp):
+    from paddle_tpu.models.resnet import build as build_resnet
+    return _bench_image_model(
+        lambda: build_resnet('imagenet', depth=50), 'resnet50', batch,
+        k_per_call, rounds, amp)
 
 
 def _bench_bert(batch, k_per_call, rounds, amp):
@@ -250,6 +258,13 @@ def _bench_stacked_lstm(batch, seq_len, k_per_call, rounds):
         'config': 'stacked_lstm L%d h%d seq%d b%d' % (
             layers_n, hid, seq_len, batch),
     }
+
+
+def _bench_se_resnext(batch, k_per_call, rounds, amp):
+    """SE-ResNeXt-50 (reference benchmark/fluid/models/se_resnext.py)."""
+    from paddle_tpu.models.se_resnext import build as build_se
+    return _bench_image_model(build_se, 'se_resnext50', batch,
+                              k_per_call, rounds, amp)
 
 
 def _bench_ctr(batch, k_per_call, rounds):
@@ -371,6 +386,7 @@ def _child(mode):
         _try('resnet50', _bench_resnet50, 64, 4, 3, True)
         _try('bert_base', _bench_bert, 64, 10, 2, True)
         _try('stacked_lstm', _bench_stacked_lstm, 32, 128, 10, 2)
+        _try('se_resnext', _bench_se_resnext, 32, 4, 2, True)
         _try('ctr_sparse', _bench_ctr, 512, 50, 3)
     for r in models.values():
         r.pop('flops_per_step', None)

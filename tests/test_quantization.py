@@ -197,3 +197,37 @@ def test_post_training_quantize_int8_matmul():
     denom = np.abs(ref).max() or 1.0
     assert np.max(np.abs(got - ref)) / denom < 0.05, (
         np.max(np.abs(got - ref)), denom)
+
+
+def test_post_training_quantize_stablehlo_export(tmp_path):
+    """PTQ int8 program exports to StableHLO and reloads — the deployment
+    path (quantize -> int8 GEMM graph -> framework-free artifact)."""
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu.contrib.quantize import post_training_quantize
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='qx2', shape=[8], dtype='float32')
+        out = fluid.layers.fc(fluid.layers.fc(x, size=16, act='relu'),
+                              size=4)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        infer = main.clone(for_test=True)
+        post_training_quantize(
+            exe, infer, scope, [{'qx2': rng.randn(16, 8).astype('float32')}])
+        ref, = exe.run(infer, feed={'qx2': np.ones((2, 8), 'float32')},
+                       fetch_list=[out.name], scope=scope)
+        d = str(tmp_path / 'int8_model')
+        fluid.export_stablehlo_model(
+            d, ['qx2'], [out], exe,
+            example_feeds={'qx2': np.ones((2, 8), 'float32')},
+            main_program=infer)
+        call, manifest = fluid.load_stablehlo_model(d)
+        got = call(np.ones((2, 8), 'float32'))
+        got = got[0] if isinstance(got, (list, tuple)) else got
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
