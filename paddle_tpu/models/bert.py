@@ -23,10 +23,11 @@ class BertConfig(LMConfig):
     def __init__(self, vocab_size=30522, seq_len=128, d_model=768,
                  n_head=12, n_layer=12, d_ff=3072, dropout=0.1,
                  type_vocab_size=2, max_predictions=20, **kw):
+        kw.setdefault('use_flash_attention', True)
         super(BertConfig, self).__init__(
             vocab_size=vocab_size, seq_len=seq_len, d_model=d_model,
             n_head=n_head, n_layer=n_layer, d_ff=d_ff, dropout=dropout,
-            use_flash_attention=False, **kw)
+            **kw)
         self.type_vocab_size = type_vocab_size
         self.max_predictions = max_predictions
 
@@ -63,16 +64,24 @@ def build_bert_pretrain(cfg=None, is_test=False):
         x = layers.dropout(x, dropout_prob=cfg.dropout, is_test=is_test,
                            dropout_implementation='upscale_in_train')
 
-    # additive padding mask broadcast over heads/query positions:
-    # [B, 1, 1, L] with -1e9 on pads (bidirectional attention)
-    neg = layers.scale(input_mask, scale=1e9, bias=-1e9)   # 0 real, -1e9 pad
-    mask_var = layers.reshape(neg, shape=[-1, 1, 1, cfg.seq_len])
+    # per-key additive padding bias [B, L]: 0 real, -1e9 pad — fused into
+    # the flash kernel when enabled; otherwise broadcast to [B,1,1,L]
+    neg = layers.scale(input_mask, scale=1e9, bias=-1e9)
+    attn_drop = getattr(cfg, 'attn_dropout', 0.0)
+    flash_ok = getattr(cfg, 'use_flash_attention', False) and \
+        (is_test or not attn_drop)
+    if flash_ok:
+        bias_var = neg
+        mask_var = None
+    else:
+        bias_var = None
+        mask_var = layers.reshape(neg, shape=[-1, 1, 1, cfg.seq_len])
 
     ckpts = []
     for i in range(cfg.n_layer):
         x = transformer_block(x, cfg, 'bert.layer_%d' % i,
                               mask_var=mask_var, is_test=is_test,
-                              causal=False)
+                              causal=False, key_padding_bias=bias_var)
         ckpts.append(x)
     tokens.block.program._lm_checkpoint_vars = ckpts
     x = layers.layer_norm(x, begin_norm_axis=2,

@@ -36,7 +36,8 @@ class LMConfig(object):
 
 
 def multi_head_attention(x, cfg, prefix, mask_var=None, is_test=False,
-                         seq_parallel=False, causal=False):
+                         seq_parallel=False, causal=False,
+                         key_padding_bias=None):
     """Fused-QKV multi-head self-attention: one (D, 3D) matmul for Q,K,V
     (fewer, larger MXU matmuls than three separate projections)."""
     d, h = cfg.d_model, cfg.n_head
@@ -53,10 +54,11 @@ def multi_head_attention(x, cfg, prefix, mask_var=None, is_test=False,
     v = layers.squeeze(layers.slice(qkv, axes=[0], starts=[2], ends=[3]),
                        axes=[0])
     attn_drop = getattr(cfg, 'attn_dropout', 0.0)
-    # the fused kernel implements exactly causal masking and no probability
-    # dropout; any explicit mask_var (padding masks, bidirectional) or
-    # active attention dropout falls back to the unfused path
-    use_flash = getattr(cfg, 'use_flash_attention', False) and causal and \
+    # the fused kernel supports causal masking and per-key padding biases
+    # (key_padding_bias [B, L]); a full additive mask_var or active
+    # attention dropout falls back to the unfused path
+    use_flash = getattr(cfg, 'use_flash_attention', False) and \
+        (causal or key_padding_bias is not None) and \
         mask_var is None and (is_test or not attn_drop)
     if use_flash:
         # fused causal attention (pallas on TPU): scores never leave VMEM
@@ -64,17 +66,25 @@ def multi_head_attention(x, cfg, prefix, mask_var=None, is_test=False,
         ctx = helper_block.create_var(
             name=prefix + '.flash_out',
             shape=(-1, h, cfg.seq_len, dh), dtype='float32')
+        flash_inputs = {'Q': [q], 'K': [k], 'V': [v]}
+        if key_padding_bias is not None:
+            flash_inputs['KeyPaddingBias'] = [key_padding_bias]
         helper_block.append_op(
             type='flash_attention',
-            inputs={'Q': [q], 'K': [k], 'V': [v]},
+            inputs=flash_inputs,
             outputs={'Out': [ctx]},
-            attrs={'scale': dh ** -0.5, 'causal': True,
+            attrs={'scale': dh ** -0.5, 'causal': bool(causal),
                    'ring_zigzag': bool(getattr(cfg, 'ring_zigzag',
                                                False))})
     else:
         logits = layers.matmul(q, k, transpose_y=True, alpha=dh ** -0.5)
         if mask_var is not None:
             logits = layers.elementwise_add(logits, mask_var)
+        if key_padding_bias is not None:
+            # [B, L] per-key bias broadcasts over heads/query positions
+            logits = layers.elementwise_add(
+                logits, layers.reshape(key_padding_bias,
+                                       [-1, 1, 1, cfg.seq_len]))
         weights = layers.softmax(logits)
         if attn_drop and not is_test:
             weights = layers.dropout(weights, dropout_prob=attn_drop,
@@ -90,14 +100,15 @@ def multi_head_attention(x, cfg, prefix, mask_var=None, is_test=False,
 
 
 def transformer_block(x, cfg, prefix, mask_var=None, is_test=False,
-                      causal=False):
+                      causal=False, key_padding_bias=None):
     # pre-norm residual blocks
     ln1 = layers.layer_norm(x, begin_norm_axis=2,
                             param_attr=ParamAttr(name=prefix + '.ln1.w'),
                             bias_attr=ParamAttr(name=prefix + '.ln1.b'))
     attn = multi_head_attention(ln1, cfg, prefix + '.attn',
                                 mask_var=mask_var, is_test=is_test,
-                                causal=causal)
+                                causal=causal,
+                                key_padding_bias=key_padding_bias)
     x = layers.elementwise_add(x, attn)
     ln2 = layers.layer_norm(x, begin_norm_axis=2,
                             param_attr=ParamAttr(name=prefix + '.ln2.w'),

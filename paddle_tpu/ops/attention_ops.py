@@ -74,9 +74,15 @@ def _compiler_params(pltpu, semantics):
 # forward kernel
 # --------------------------------------------------------------------------
 
-def _fwd_kernel(scale, causal, nk, q_ref, k_ref, v_ref, o_ref, lse_ref,
-                m_scr, l_scr, acc_scr):
+def _fwd_kernel(scale, causal, nk, has_bias, *refs):
     import jax.experimental.pallas as pl
+    if has_bias:
+        (q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
+         m_scr, l_scr, acc_scr) = refs
+    else:
+        (q_ref, k_ref, v_ref, o_ref, lse_ref,
+         m_scr, l_scr, acc_scr) = refs
+        bias_ref = None
     i, j = pl.program_id(1), pl.program_id(2)
     bq, bk = q_ref.shape[1], k_ref.shape[1]
 
@@ -92,6 +98,9 @@ def _fwd_kernel(scale, causal, nk, q_ref, k_ref, v_ref, o_ref, lse_ref,
         v = v_ref[0]
         s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
+        if bias_ref is not None:
+            # per-key additive bias (padding masks: 0 keep / -1e9 drop)
+            s = s + bias_ref[0, 0][None, :]
         if causal:
             rows = i * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             cols = j * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
@@ -106,6 +115,10 @@ def _fwd_kernel(scale, causal, nk, q_ref, k_ref, v_ref, o_ref, lse_ref,
             # rows whose tile slice is fully masked have m_new == _NEG_INF
             # and exp(_NEG_INF - _NEG_INF) == 1; force masked entries to 0
             p = jnp.where(mask, p, 0.0)
+        if bias_ref is not None:
+            # exact zero for dropped keys (-1e8 or lower — covers the
+            # documented -1e9 pad convention), independent of underflow
+            p = jnp.where(bias_ref[0, 0][None, :] > -1e8, p, 0.0)
         l_scr[...] = jnp.broadcast_to(
             alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True), l_scr.shape)
         m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
@@ -127,20 +140,29 @@ def _fwd_kernel(scale, causal, nk, q_ref, k_ref, v_ref, o_ref, lse_ref,
             jnp.maximum(l_scr[:, 0], 1e-30))
 
 
-def _flash_fwd_pallas(q, k, v, scale, causal, interpret, block_q, block_k):
+def _flash_fwd_pallas(q, k, v, scale, causal, interpret, block_q, block_k,
+                      bias=None, n_heads=1):
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
     bh, ln, dh = q.shape
     bq = _pick_block(ln, block_q)
     bk = _pick_block(ln, block_k)
     nq, nk = ln // bq, ln // bk
-    kernel = functools.partial(_fwd_kernel, scale, causal, nk)
+    has_bias = bias is not None
+    kernel = functools.partial(_fwd_kernel, scale, causal, nk, has_bias)
     qspec = pl.BlockSpec((1, bq, dh), lambda b, i, j: (b, i, 0))
     kspec = pl.BlockSpec((1, bk, dh), lambda b, i, j: (b, j, 0))
+    ins = [q, k, v]
+    in_specs = [qspec, kspec, kspec]
+    if has_bias:
+        # bias [B, L]: each (batch*head) row b maps to batch b // n_heads
+        ins.append(bias.astype(jnp.float32)[:, None, :])
+        in_specs.append(pl.BlockSpec(
+            (1, 1, bk), lambda b, i, j: (b // n_heads, 0, j)))
     o, lse = pl.pallas_call(
         kernel,
         grid=(bh, nq, nk),
-        in_specs=[qspec, kspec, kspec],
+        in_specs=in_specs,
         out_specs=[qspec,
                    pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i))],
         out_shape=[jax.ShapeDtypeStruct((bh, ln, dh), q.dtype),
@@ -151,7 +173,7 @@ def _flash_fwd_pallas(q, k, v, scale, causal, interpret, block_q, block_k):
         compiler_params=_compiler_params(
             pltpu, ("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(q, k, v)
+    )(*ins)
     return o, lse[:, 0]
 
 
@@ -159,9 +181,15 @@ def _flash_fwd_pallas(q, k, v, scale, causal, interpret, block_q, block_k):
 # backward kernels
 # --------------------------------------------------------------------------
 
-def _bwd_dq_kernel(scale, causal, nk, q_ref, k_ref, v_ref, do_ref, lse_ref,
-                   delta_ref, dq_ref, dq_scr):
+def _bwd_dq_kernel(scale, causal, nk, has_bias, *refs):
     import jax.experimental.pallas as pl
+    if has_bias:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
+         dq_ref, dq_scr) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dq_ref, dq_scr) = refs
+        bias_ref = None
     i, j = pl.program_id(1), pl.program_id(2)
     bq, bk = q_ref.shape[1], k_ref.shape[1]
 
@@ -173,6 +201,8 @@ def _bwd_dq_kernel(scale, causal, nk, q_ref, k_ref, v_ref, do_ref, lse_ref,
         q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
         s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
+        if bias_ref is not None:
+            s = s + bias_ref[0, 0][None, :]
         if causal:
             rows = i * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             cols = j * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
@@ -195,9 +225,15 @@ def _bwd_dq_kernel(scale, causal, nk, q_ref, k_ref, v_ref, do_ref, lse_ref,
         dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(scale, causal, nq, q_ref, k_ref, v_ref, do_ref, lse_ref,
-                    delta_ref, dk_ref, dv_ref, dk_scr, dv_scr):
+def _bwd_dkv_kernel(scale, causal, nq, has_bias, *refs):
     import jax.experimental.pallas as pl
+    if has_bias:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
+         dk_ref, dv_ref, dk_scr, dv_scr) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_scr, dv_scr) = refs
+        bias_ref = None
     i, j = pl.program_id(1), pl.program_id(2)      # i: k block, j: q block
     bk, bq = k_ref.shape[1], q_ref.shape[1]
 
@@ -210,6 +246,8 @@ def _bwd_dkv_kernel(scale, causal, nq, q_ref, k_ref, v_ref, do_ref, lse_ref,
         q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
         s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
+        if bias_ref is not None:
+            s = s + bias_ref[0, 0][None, :]
         if causal:
             rows = j * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             cols = i * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
@@ -238,7 +276,7 @@ def _bwd_dkv_kernel(scale, causal, nq, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
 
 def _flash_bwd_pallas(q, k, v, o, lse, do, scale, causal, interpret,
-                      block_q, block_k):
+                      block_q, block_k, bias=None, n_heads=1):
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
     bh, ln, dh = q.shape
@@ -248,30 +286,44 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, scale, causal, interpret,
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     lse3 = lse[:, None, :]
     delta3 = delta[:, None, :]
+    has_bias = bias is not None
+    bias3 = bias.astype(jnp.float32)[:, None, :] if has_bias else None
 
     qspec = pl.BlockSpec((1, bq, dh), lambda b, i, j: (b, i, 0))
     kspec_j = pl.BlockSpec((1, bk, dh), lambda b, i, j: (b, j, 0))
     rowspec = pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i))
+    ins = [q, k, v, do, lse3, delta3]
+    in_specs = [qspec, kspec_j, kspec_j, qspec, rowspec, rowspec]
+    if has_bias:
+        ins.append(bias3)
+        in_specs.append(pl.BlockSpec(
+            (1, 1, bk), lambda b, i, j: (b // n_heads, 0, j)))
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, scale, causal, nk),
+        functools.partial(_bwd_dq_kernel, scale, causal, nk, has_bias),
         grid=(bh, nq, nk),
-        in_specs=[qspec, kspec_j, kspec_j, qspec, rowspec, rowspec],
+        in_specs=in_specs,
         out_specs=[qspec],
         out_shape=[jax.ShapeDtypeStruct((bh, ln, dh), q.dtype)],
         scratch_shapes=[pltpu.VMEM((bq, dh), jnp.float32)],
         compiler_params=_compiler_params(
             pltpu, ("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(q, k, v, do, lse3, delta3)[0]
+    )(*ins)[0]
 
     # k-major grid: q blocks stream innermost
     qspec_j = pl.BlockSpec((1, bq, dh), lambda b, i, j: (b, j, 0))
     kspec_i = pl.BlockSpec((1, bk, dh), lambda b, i, j: (b, i, 0))
     rowspec_j = pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, j))
+    ins2 = [q, k, v, do, lse3, delta3]
+    in_specs2 = [qspec_j, kspec_i, kspec_i, qspec_j, rowspec_j, rowspec_j]
+    if has_bias:
+        ins2.append(bias3)
+        in_specs2.append(pl.BlockSpec(
+            (1, 1, bk), lambda b, i, j: (b // n_heads, 0, i)))
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, scale, causal, nq),
+        functools.partial(_bwd_dkv_kernel, scale, causal, nq, has_bias),
         grid=(bh, nk, nq),
-        in_specs=[qspec_j, kspec_i, kspec_i, qspec_j, rowspec_j, rowspec_j],
+        in_specs=in_specs2,
         out_specs=[kspec_i, kspec_i],
         out_shape=[jax.ShapeDtypeStruct((bh, ln, dh), k.dtype),
                    jax.ShapeDtypeStruct((bh, ln, dh), v.dtype)],
@@ -280,7 +332,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, scale, causal, interpret,
         compiler_params=_compiler_params(
             pltpu, ("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(q, k, v, do, lse3, delta3)
+    )(*ins2)
     return dq, dk, dv
 
 
@@ -322,6 +374,58 @@ def _flash_bwd(scale, causal, impl, res, ct):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+def _attention_ref_biased(q, k, v, bias, scale, causal, n_heads):
+    """jnp reference with per-key additive bias [B, L] (row b of the
+    [BH, L, dh] inputs belongs to batch b // n_heads)."""
+    s = jnp.einsum('bqd,bkd->bqk', q, k,
+                   preferred_element_type=jnp.float32) * scale
+    s = s + jnp.repeat(bias.astype(jnp.float32), n_heads, axis=0)[:, None, :]
+    if causal:
+        ln = q.shape[1]
+        mask = jnp.tril(jnp.ones((ln, ln), bool))
+        s = jnp.where(mask[None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum('bqk,bkd->bqd', p.astype(v.dtype), v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash_biased(q, k, v, bias, scale, causal, impl, n_heads):
+    return _fwd_impl_biased(q, k, v, bias, scale, causal, impl,
+                            n_heads)[0]
+
+
+def _fwd_impl_biased(q, k, v, bias, scale, causal, impl, n_heads):
+    if impl in ('pallas', 'interpret'):
+        return _flash_fwd_pallas(q, k, v, scale, causal,
+                                 impl == 'interpret', _DEF_BQ, _DEF_BK,
+                                 bias=bias, n_heads=n_heads)
+    return _attention_ref_biased(q, k, v, bias, scale, causal,
+                                 n_heads), None
+
+
+def _flash_biased_fwd(q, k, v, bias, scale, causal, impl, n_heads):
+    o, lse = _fwd_impl_biased(q, k, v, bias, scale, causal, impl, n_heads)
+    return o, (q, k, v, bias, o, lse)
+
+
+def _flash_biased_bwd(scale, causal, impl, n_heads, res, ct):
+    q, k, v, bias, o, lse = res
+    # bias is a padding mask: treated as non-differentiable (zero grad)
+    if impl in ('pallas', 'interpret'):
+        dq, dk, dv = _flash_bwd_pallas(
+            q, k, v, o, lse, ct, scale, causal, impl == 'interpret',
+            _DEF_BQ, _DEF_BK, bias=bias, n_heads=n_heads)
+        return dq, dk, dv, jnp.zeros_like(bias)
+    _, vjp = jax.vjp(
+        lambda a, b, c: _attention_ref_biased(a, b, c, bias, scale,
+                                              causal, n_heads), q, k, v)
+    dq, dk, dv = vjp(ct)
+    return dq, dk, dv, jnp.zeros_like(bias)
+
+
+_flash_biased.defvjp(_flash_biased_fwd, _flash_biased_bwd)
+
+
 def _resolve_impl(use_pallas):
     if use_pallas is None:
         return 'pallas' if jax.default_backend() == 'tpu' else 'ref'
@@ -330,14 +434,18 @@ def _resolve_impl(use_pallas):
     return 'pallas' if use_pallas else 'ref'
 
 
-def flash_attention(q, k, v, scale=None, causal=True, use_pallas=None):
+def flash_attention(q, k, v, scale=None, causal=True, use_pallas=None,
+                    key_padding_bias=None, num_heads=1):
     """q/k/v: [B, H, L, dh] (or [BH, L, dh]). On TPU lowers to the blocked
     pallas kernels (fwd + dq/dkv bwd); elsewhere to the jnp reference
     (use_pallas='interpret' forces the kernels through the pallas
-    interpreter for cross-checking)."""
+    interpreter for cross-checking). key_padding_bias: optional [B, L]
+    additive per-key bias (0 keep / -1e9 drop — BERT-style padding masks),
+    fused into the kernel; treated as non-differentiable."""
     shape4 = q.ndim == 4
     if shape4:
         b, h, ln, dh = q.shape
+        num_heads = h
         q = q.reshape(b * h, ln, dh)
         k = k.reshape(b * h, ln, dh)
         v = v.reshape(b * h, ln, dh)
@@ -348,7 +456,11 @@ def flash_attention(q, k, v, scale=None, causal=True, use_pallas=None):
         # no 128-multiple tile divides L: the kernel would need one full-L
         # VMEM tile; the fused-by-XLA reference is the safer lowering
         impl = 'ref'
-    out = _flash(q, k, v, float(scale), bool(causal), impl)
+    if key_padding_bias is not None:
+        out = _flash_biased(q, k, v, key_padding_bias, float(scale),
+                            bool(causal), impl, int(num_heads))
+    else:
+        out = _flash(q, k, v, float(scale), bool(causal), impl)
     if shape4:
         out = out.reshape(b, h, ln, dh)
     return out
@@ -372,7 +484,8 @@ def _mesh_axis(mesh, name, dim_size):
 
 
 def flash_attention_spmd(q, k, v, mesh, scale=None, causal=True,
-                         use_pallas=None, ring_zigzag=False):
+                         use_pallas=None, ring_zigzag=False,
+                         key_padding_bias=None):
     """[B, H, L, dh] under an active mesh: batch sharded over 'data', heads
     over 'model', kernel per shard via shard_map. If the 'seq' axis shards
     L, dispatches to ring attention (the long-context mode); ring_zigzag
@@ -385,6 +498,14 @@ def flash_attention_spmd(q, k, v, mesh, scale=None, causal=True,
     model_ax = _mesh_axis(mesh, 'model', h)
     seq_ax = _mesh_axis(mesh, 'seq', ln)
     if seq_ax is not None:
+        if key_padding_bias is not None:
+            # ring + bias would need the bias rotating with K/V blocks;
+            # the partitionable einsum reference covers this case
+            return _flash_biased(
+                q.reshape(b * h, ln, dh), k.reshape(b * h, ln, dh),
+                v.reshape(b * h, ln, dh), key_padding_bias,
+                float(scale), bool(causal), 'ref',
+                h).reshape(b, h, ln, dh)
         from ..parallel.ring_attention import ring_attention
         zz = (bool(ring_zigzag) and causal
               and ln % (2 * mesh.shape[seq_ax]) == 0)
@@ -399,14 +520,30 @@ def flash_attention_spmd(q, k, v, mesh, scale=None, causal=True,
         impl = 'ref'
     spec = P(data_ax, model_ax, None, None)
 
-    def inner(ql, kl, vl):
+    if key_padding_bias is None:
+        def inner(ql, kl, vl):
+            lb, lh = ql.shape[0], ql.shape[1]
+            o = _flash(ql.reshape(lb * lh, ln, dh),
+                       kl.reshape(lb * lh, ln, dh),
+                       vl.reshape(lb * lh, ln, dh), float(scale),
+                       bool(causal), impl)
+            return o.reshape(lb, lh, ln, dh)
+
+        return _shard_map(inner, mesh, (spec, spec, spec), spec)(q, k, v)
+
+    # the [B, L] bias shards along the batch axis like Q/K/V
+    bspec = P(data_ax, None)
+
+    def inner_biased(ql, kl, vl, bl):
         lb, lh = ql.shape[0], ql.shape[1]
-        o = _flash(ql.reshape(lb * lh, ln, dh), kl.reshape(lb * lh, ln, dh),
-                   vl.reshape(lb * lh, ln, dh), float(scale), bool(causal),
-                   impl)
+        o = _flash_biased(ql.reshape(lb * lh, ln, dh),
+                          kl.reshape(lb * lh, ln, dh),
+                          vl.reshape(lb * lh, ln, dh), bl, float(scale),
+                          bool(causal), impl, lh)
         return o.reshape(lb, lh, ln, dh)
 
-    return _shard_map(inner, mesh, (spec, spec, spec), spec)(q, k, v)
+    return _shard_map(inner_biased, mesh, (spec, spec, spec, bspec),
+                      spec)(q, k, v, key_padding_bias)
 
 
 @register_op('flash_attention')
@@ -422,6 +559,11 @@ def _flash_attention_op(ctx, op):
     v = ctx.in1(op, 'V')
     out_dtype = q.dtype
     q, k, v = amp.cast_compute(op, q, k, v)
+    bias = ctx.in1(op, 'KeyPaddingBias')       # optional [B, L]
+    if bias is not None and q.ndim != 4:
+        raise NotImplementedError(
+            "flash_attention KeyPaddingBias needs 4-d [B, H, L, dh] Q "
+            "(the bias row maps to batch via the head dim)")
     # missing attr -> kernel default dh**-0.5; a present value (incl. 0.0)
     # is literal. Legacy programs that stored 0.0 meaning "default" keep
     # that behavior.
@@ -436,18 +578,20 @@ def _flash_attention_op(ctx, op):
         # through the pallas interpreter under SPMD; plain jnp otherwise
         use_pallas = 'interpret' if mesh is not None else False
     if mesh is not None and mesh.size > 1:
-        if q.ndim == 4:
-            out = flash_attention_spmd(
-                q, k, v, mesh, scale=scale, causal=causal,
-                use_pallas=use_pallas,
-                ring_zigzag=op.attr('ring_zigzag', False))
-        else:
+        if q.ndim != 4:
             # 3-d [BH, L, dh]: no batch/head axes to shard_map over; the
             # XLA auto-partitioner cannot split a pallas custom call, so
             # lower the partitionable einsum reference instead
             out = flash_attention(q, k, v, scale=scale, causal=causal,
                                   use_pallas=False)
+        else:
+            out = flash_attention_spmd(
+                q, k, v, mesh, scale=scale, causal=causal,
+                use_pallas=use_pallas,
+                ring_zigzag=op.attr('ring_zigzag', False),
+                key_padding_bias=bias)
     else:
         out = flash_attention(q, k, v, scale=scale, causal=causal,
-                              use_pallas=use_pallas)
+                              use_pallas=use_pallas,
+                              key_padding_bias=bias)
     ctx.out(op, 'Out', out.astype(out_dtype))
