@@ -219,6 +219,21 @@ class ChannelPruner(object):
                     self._resize(bname, np.asarray(b)[keep],
                                  indexer=lambda a: a[keep])
                 self._propagate(op.output('Out')[0], keep, orig_c)
+            elif op.type == 'elementwise_add':
+                # residual join: the other branch (activation OR a
+                # channel-shaped persistable) still carries orig_c
+                # channels, so walking through would leave a runtime shape
+                # mismatch. Pruning across a residual requires aligning
+                # both producers; not supported — fail loudly instead of
+                # mis-pruning.
+                other = [n for n in op.input_arg_names if n != var_name]
+                if other:
+                    raise ValueError(
+                        "ChannelPruner: conv %r feeds a residual "
+                        "elementwise_add (other input %r); pruning across "
+                        "residual joins is unsupported — exclude this conv "
+                        "from prune targets" % (var_name, other[0]))
+                self._propagate(op.output('Out')[0], keep, orig_c)
             elif op.type == 'mul':
                 # first FC after flatten: rows are NCHW-flattened
                 in_var = self._program.global_block()._find_var_recursive(

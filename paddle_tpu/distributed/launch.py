@@ -51,18 +51,17 @@ def launch_procs(entrypoint, entrypoint_args=(), nproc_per_node=1,
     node_ips = list(node_ips or [node_ip])
     nnodes = len(node_ips)
     world = nnodes * nproc_per_node
-    base_ports = {}
+    # Multi-node: every node must compute the SAME endpoint/coordinator
+    # addresses, so the fixed port scheme (coordinator 6269, workers
+    # 6170+i) is used on all nodes including node 0 — free-port probing is
+    # only safe single-node, where no other launcher needs to agree.
     endpoints = []
     for ip in node_ips:
         for i in range(nproc_per_node):
-            if ip == node_ip:
-                port = _free_port(ip)
-            else:          # remote ports cannot be probed; fixed scheme
-                port = 6170 + i
-            base_ports[(ip, i)] = port
+            port = _free_port(ip) if nnodes == 1 else 6170 + i
             endpoints.append('%s:%d' % (ip, port))
-    coordinator = '%s:%d' % (node_ips[0], _free_port(node_ips[0])
-                             if node_ips[0] == node_ip else 6269)
+    coordinator = '%s:%d' % (
+        node_ips[0], _free_port(node_ips[0]) if nnodes == 1 else 6269)
 
     if log_dir:
         os.makedirs(log_dir, exist_ok=True)

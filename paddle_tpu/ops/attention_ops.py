@@ -208,6 +208,10 @@ def _bwd_dq_kernel(scale, causal, nk, has_bias, *refs):
             cols = j * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             s = jnp.where(rows >= cols, s, _NEG_INF)
         p = jnp.exp(s - lse_ref[0, 0][:, None])   # masked entries underflow
+        if bias_ref is not None:
+            # all-padded rows have lse = log(1e-30); without the forward's
+            # exact zeroing p explodes to ~e^69 and poisons dQ
+            p = jnp.where(bias_ref[0, 0][None, :] > -1e8, p, 0.0)
         dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
         ds = p * (dp - delta_ref[0, 0][:, None]) * scale
@@ -253,6 +257,8 @@ def _bwd_dkv_kernel(scale, causal, nq, has_bias, *refs):
             cols = i * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             s = jnp.where(rows >= cols, s, _NEG_INF)
         p = jnp.exp(s - lse_ref[0, 0][:, None])            # [bq, bk]
+        if bias_ref is not None:
+            p = jnp.where(bias_ref[0, 0][None, :] > -1e8, p, 0.0)
         dv_scr[...] += lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)

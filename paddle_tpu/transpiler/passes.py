@@ -133,26 +133,41 @@ class IdentityScaleCleanPass(Pass):
     scale(x, scale=1, bias=0) ops, rewiring consumers to the input."""
 
     def apply_impl(self, program, scope):
+        # The reference pass rewires the PRODUCER of X to emit the scale's
+        # Out name, so Out (the name users fetch after transpile) survives;
+        # a scale whose input has no in-block producer (feed/parameter) is
+        # left alone because there is nothing to rewire.
         for block in program.blocks:
-            keep = []
-            rename = {}
-            for op in block.ops:
-                is_identity = (
-                    op.type == 'scale'
-                    and float(op.attr('scale', 1.0)) == 1.0
-                    and float(op.attr('bias', 0.0)) == 0.0
-                    and op.input('X') and op.output('Out'))
-                if is_identity:
+            changed = True
+            while changed:
+                changed = False
+                for i, op in enumerate(block.ops):
+                    is_identity = (
+                        op.type == 'scale'
+                        and float(op.attr('scale', 1.0)) == 1.0
+                        and float(op.attr('bias', 0.0)) == 0.0
+                        and op.input('X') and op.output('Out'))
+                    if not is_identity:
+                        continue
                     src = op.input('X')[0]
-                    rename[op.output('Out')[0]] = rename.get(src, src)
-                else:
-                    keep.append(op)
-            if not rename:
-                continue
-            for op in keep:
-                for slot, names in list(op.inputs.items()):
-                    op.inputs[slot] = [rename.get(n, n) for n in names]
-            block.ops = keep
+                    dst = op.output('Out')[0]
+                    producer = None
+                    for prev in block.ops[:i]:
+                        if src in prev.output_arg_names:
+                            producer = prev
+                    if producer is None:
+                        continue
+                    producer._rename_output(src, dst)
+                    # src no longer exists after the rewire: rename readers
+                    # in EVERY block (sub-blocks of while/cond read parent
+                    # vars by name)
+                    for blk in program.blocks:
+                        for other in blk.ops:
+                            if other is not op:
+                                other._rename_input(src, dst)
+                    block.ops = block.ops[:i] + block.ops[i + 1:]
+                    changed = True
+                    break
 
 
 @register_pass('conv_bn_fuse_pass')

@@ -259,6 +259,29 @@ def test_masked_flash_kernel_matches_reference():
                                    rtol=3e-3, atol=3e-4)
 
 
+def test_masked_flash_bwd_all_padded_row_bounded():
+    """ADVICE r3: a batch element whose keys are ALL padded (bias -1e9
+    everywhere) must produce zero grads through the pallas backward, not
+    exp(-lse) ~ e^69 garbage."""
+    rng = np.random.RandomState(5)
+    B, H, L, dh = 2, 1, 128, 16
+    q = jnp.asarray(rng.randn(B, H, L, dh).astype('float32'))
+    k = jnp.asarray(rng.randn(B, H, L, dh).astype('float32'))
+    v = jnp.asarray(rng.randn(B, H, L, dh).astype('float32'))
+    bias_np = np.zeros((B, L), 'float32')
+    bias_np[0, :] = -1e9                       # batch 0 entirely padded
+    bias = jnp.asarray(bias_np)
+    gq, gk, gv = jax.grad(
+        lambda a, b, c: jnp.sum(flash_attention(
+            a, b, c, causal=False, use_pallas='interpret',
+            key_padding_bias=bias) ** 2), argnums=(0, 1, 2))(q, k, v)
+    for g in (gq, gk, gv):
+        arr = np.asarray(g)
+        assert np.isfinite(arr).all()
+        assert np.abs(arr[0]).max() == 0.0     # padded element: exact zero
+        assert np.abs(arr).max() < 1e3
+
+
 def test_bert_flash_vs_unfused_parity():
     """BERT with the masked flash path == the unfused mask_var path."""
     from paddle_tpu.models.bert import (BertConfig, build_bert_pretrain,

@@ -49,6 +49,44 @@ def test_identity_scale_clean_pass():
     assert np.isfinite(np.asarray(r)).all()
 
 
+def test_identity_scale_clean_keeps_out_fetchable():
+    """ADVICE r3: the reference pass rewires the PRODUCER to emit the
+    scale's Out name, so fetching that name after cleaning still works."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name='pi2', shape=[4], dtype='float32')
+        h = fluid.layers.fc(img, size=4, act='relu')
+        s = fluid.layers.scale(h, scale=1.0, bias=0.0)
+        out = fluid.layers.fc(s, size=2)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    x = np.random.RandomState(0).rand(3, 4).astype('float32')
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        ref_s, ref_o = exe.run(main, feed={'pi2': x},
+                               fetch_list=[s.name, out.name], scope=scope)
+        get_pass('identity_scale_op_clean_pass').apply(main)
+        types = [op.type for op in main.global_block().ops]
+        assert 'scale' not in types
+        # the scale's Out name is still produced (by the rewired fc)
+        got_s, got_o = exe.run(main, feed={'pi2': x},
+                               fetch_list=[s.name, out.name], scope=scope)
+    np.testing.assert_allclose(got_s, ref_s, rtol=1e-5)
+    np.testing.assert_allclose(got_o, ref_o, rtol=1e-5)
+
+
+def test_identity_scale_on_feed_is_kept():
+    """A scale whose X has no in-block producer (a feed) cannot be rewired
+    and must survive cleaning."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name='pi3', shape=[4], dtype='float32')
+        s = fluid.layers.scale(img, scale=1.0, bias=0.0)
+        fluid.layers.fc(s, size=2)
+    get_pass('identity_scale_op_clean_pass').apply(main)
+    assert 'scale' in [op.type for op in main.global_block().ops]
+
+
 def test_pattern_matcher():
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):

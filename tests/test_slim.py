@@ -138,6 +138,32 @@ def test_channel_prune_finetune_export():
             assert np.asarray(out).shape == (4, 10)
 
 
+def test_channel_prune_residual_raises():
+    """ADVICE r3: pruning a conv whose output feeds a residual
+    elementwise_add must fail loudly, not mis-prune one branch."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name='rimg', shape=[4, 8, 8],
+                                dtype='float32')
+        c1 = fluid.layers.conv2d(img, num_filters=8, filter_size=3,
+                                 padding=1, bias_attr=False)
+        c2 = fluid.layers.conv2d(img, num_filters=8, filter_size=3,
+                                 padding=1, bias_attr=False)
+        fluid.layers.elementwise_add(c1, c2)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        conv1_filter = None
+        for op in main.global_block().ops:
+            if op.type == 'conv2d':
+                conv1_filter = op.input('Filter')[0]
+                break
+        with pytest.raises(ValueError, match='residual'):
+            ChannelPruner(main, scope).prune_conv(conv1_filter,
+                                                  keep_ratio=0.5)
+
+
 def test_quantization_strategy():
     images, labels = _synthetic_digits(32)
     main, startup = fluid.Program(), fluid.Program()
