@@ -170,9 +170,13 @@ def lower_ops(ctx, ops, lo, hi):
 def _share_lod(ctx, op):
     """Default LoD propagation (reference InferShapeContext::ShareLoD: most
     elementwise-ish ops share their first input's LoD with outputs). An op
-    that set (or cleared) an output's lod explicitly wins; otherwise any
-    output whose leading dim matches a lod-carrying input's leading dim
-    inherits that input's lod."""
+    that set (or cleared) an output's lod explicitly wins; ops registered
+    with share_lod=False (rows permuted/selected/reinterpreted — transpose,
+    gather, reverse, ...) never inherit; otherwise any output whose leading
+    dim matches a lod-carrying input's leading dim inherits that input's
+    lod."""
+    if not get_op(op.type).share_lod:
+        return
     in_lod = None
     lead = None
     for n in op.input_arg_names:

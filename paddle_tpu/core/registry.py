@@ -15,10 +15,10 @@ a custom rule use jax.custom_vjp inside their lowering.
 
 class OpDef(object):
     __slots__ = ('type', 'lower', 'infer_shape', 'stateful', 'needs_rng',
-                 'static_inputs')
+                 'static_inputs', 'share_lod')
 
     def __init__(self, type, lower, infer_shape=None, stateful=False,
-                 needs_rng=False, static_inputs=()):
+                 needs_rng=False, static_inputs=(), share_lod=True):
         self.type = type
         self.lower = lower
         self.infer_shape = infer_shape
@@ -29,6 +29,13 @@ class OpDef(object):
         # compile-time constants (part of the program-cache key), the way XLA
         # requires shape-bearing values to be static.
         self.static_inputs = tuple(static_inputs)
+        # Default-ShareLoD opt-out (reference declares ShareLoD per op in
+        # InferShape — framework/operator.cc InferShapeContext::ShareLoD).
+        # share_lod=False marks ops whose output rows do NOT correspond
+        # 1:1 in-order to the lod-carrying input's rows, so a coincidental
+        # leading-dim match must not attach the input's LoD (an op can
+        # still ctx.set_lod explicitly).
+        self.share_lod = bool(share_lod)
 
 
 class OpRegistry(object):
@@ -58,12 +65,12 @@ _registry = OpRegistry()
 
 
 def register_op(type, infer_shape=None, stateful=False, needs_rng=False,
-                static_inputs=()):
+                static_inputs=(), share_lod=True):
     """Decorator: register `fn(ctx, op)` as the lowering for op `type`."""
     def deco(fn):
         _registry.register(type, fn, infer_shape=infer_shape,
                            stateful=stateful, needs_rng=needs_rng,
-                           static_inputs=static_inputs)
+                           static_inputs=static_inputs, share_lod=share_lod)
         return fn
     return deco
 

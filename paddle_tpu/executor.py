@@ -347,6 +347,15 @@ class Executor(object):
             import jax
             jax.block_until_ready(fetches)
         scope.update(new_state)
+        # checkpoint_notify (ops/dist_ops.py): the reference RPCs the
+        # checkpoint dir to pservers each execution; here the executor is
+        # the checkpoint writer, so save persistables after the run
+        for cn_op in program.global_block().ops:
+            if cn_op.type == 'checkpoint_notify':
+                cn_dir = cn_op.attr('dir', '') or 'checkpoint_notify'
+                from .io import save_persistables
+                with scope_guard(scope):
+                    save_persistables(self, cn_dir, main_program=program)
         # propagate LoD of written persistables into the scope, and of
         # fetches into the returned tensors
         for n in entry.written:
@@ -493,6 +502,13 @@ class Executor(object):
                            self._run_counter)
         fetches, new_state = entry.fn(stacked, ro_state, rw_state, key_arr)
         scope.update(new_state)
+        # checkpoint_notify: same host-side save contract as run()
+        for cn_op in program.global_block().ops:
+            if cn_op.type == 'checkpoint_notify':
+                cn_dir = cn_op.attr('dir', '') or 'checkpoint_notify'
+                from .io import save_persistables
+                with scope_guard(scope):
+                    save_persistables(self, cn_dir, main_program=program)
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return list(fetches)

@@ -24,3 +24,4 @@ from . import quant_ops
 from . import misc_ops
 from . import attention_ops
 from . import fused_ops
+from . import dist_ops

@@ -40,13 +40,13 @@ def _reshape2(ctx, op):
         ctx.out(op, 'XShape', jnp.zeros((0,) + x.shape, dtype=x.dtype))
 
 
-@register_op('transpose')
+@register_op('transpose', share_lod=False)
 def _transpose(ctx, op):
     x = ctx.in1(op, 'X')
     ctx.out(op, 'Out', jnp.transpose(x, op.attr('axis')))
 
 
-@register_op('transpose2')
+@register_op('transpose2', share_lod=False)
 def _transpose2(ctx, op):
     x = ctx.in1(op, 'X')
     ctx.out(op, 'Out', jnp.transpose(x, op.attr('axis')))
@@ -227,7 +227,7 @@ def _crop(ctx, op):
     ctx.out(op, 'Out', x[idx])
 
 
-@register_op('gather')
+@register_op('gather', share_lod=False)
 def _gather(ctx, op):
     x = ctx.in1(op, 'X')
     index = ctx.in1(op, 'Index').reshape(-1).astype(jnp.int32)
@@ -247,7 +247,7 @@ def _scatter(ctx, op):
     ctx.out(op, 'Out', out)
 
 
-@register_op('gather_nd')
+@register_op('gather_nd', share_lod=False)
 def _gather_nd(ctx, op):
     x = ctx.in1(op, 'X')
     index = ctx.in1(op, 'Index').astype(jnp.int32)
@@ -266,6 +266,14 @@ def _lookup_table(ctx, op):
     ids = ctx.in1(op, 'Ids')
     padding_idx = op.attr('padding_idx', -1)
     flat = ids.reshape(-1).astype(jnp.int32)
+    if op.attr('is_distributed', False):
+        # vocab-sharded table (reference is_distributed prefetch path,
+        # operators/distributed/parameter_prefetch.cc:177): pin dim 0 to the
+        # 'model' mesh axis; XLA partitions the take into shard-local masked
+        # gathers + psum over ICI — the split_ids/prefetch/merge_ids RPC
+        # pipeline as one compiled SPMD gather (ops/dist_ops.py)
+        from .dist_ops import table_sharding_constraint
+        w = table_sharding_constraint(w)
 
     w_name = op.input('W')[0]
     sparse = w_name in getattr(ctx, 'sparse_tables', ())
@@ -279,12 +287,19 @@ def _lookup_table(ctx, op):
             + ctx.env['@sparse%d' % k]
     else:
         out = jnp.take(w, flat, axis=0)
+    ctx.out(op, 'Out', embedding_epilogue(out, flat, ids, w, padding_idx))
+
+
+def embedding_epilogue(out, flat, ids, w, padding_idx):
+    """Shared lookup_table / lookup_sparse_table tail: zero the padding_idx
+    rows and restore the ids' leading shape (a trailing 1 folds into the
+    embedding dim, fluid convention)."""
     if padding_idx is not None and padding_idx != -1:
         pad = padding_idx if padding_idx >= 0 else padding_idx + w.shape[0]
         out = jnp.where((flat == pad)[:, None], 0.0, out)
     out_shape = ids.shape[:-1] + (w.shape[1],) if ids.shape and \
         ids.shape[-1] == 1 else ids.shape + (w.shape[1],)
-    ctx.out(op, 'Out', out.reshape(out_shape))
+    return out.reshape(out_shape)
 
 
 @register_op('top_k')
@@ -319,7 +334,7 @@ def _argsort(ctx, op):
     ctx.out(op, 'Out', jnp.sort(x, axis=axis))
 
 
-@register_op('reverse')
+@register_op('reverse', share_lod=False)
 def _reverse(ctx, op):
     x = ctx.in1(op, 'X')
     axes = op.attr('axis')

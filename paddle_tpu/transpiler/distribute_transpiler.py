@@ -77,18 +77,46 @@ class DistributeTranspiler(object):
             return
 
         # pserver mode: shard large parameters along their largest dim over
-        # the 'model' axis — one rule per parameter above min_block_size
+        # the 'model' axis — one rule per parameter above min_block_size.
+        # lookup_table(is_distributed=True) tables ALWAYS shard on dim 0
+        # (vocab), whatever their size: that is the distributed-lookup-table
+        # path (reference distribute_transpiler.py:161 special-cases these
+        # into a prefetch pipeline; here the rule + the lowering's sharding
+        # constraint make XLA emit the id-exchange collectives).
+        dist_tables = set()
+        for block in program.blocks:
+            for dop in block.ops:
+                if dop.type in ('lookup_table', 'lookup_sparse_table') and \
+                        dop.attr('is_distributed', False):
+                    dist_tables.add(dop.input('W')[0])
         rules = []
+
+        def _shard_with_accumulators(p, axis):
+            """One rule for the parameter plus one per optimizer
+            accumulator (named '<param>_<slot>...', optimizer.py:92) whose
+            shape matches the parameter's — moments must shard WITH their
+            parameter or every device re-materializes the full [V, d]
+            state the sharding exists to avoid. Shape-matched only:
+            beta-pow style scalar accumulators stay replicated."""
+            spec = [None] * len(p.shape)
+            spec[axis] = 'model'
+            rules.append((r'^%s$' % _re_escape(p.name), P(*spec)))
+            for v in program.list_vars():
+                if v.name.startswith(p.name + '_') and v.shape is not None \
+                        and tuple(v.shape) == tuple(p.shape) \
+                        and not isinstance(v, Parameter):
+                    rules.append((r'^%s$' % _re_escape(v.name), P(*spec)))
+
         for p in program.all_parameters():
             if not isinstance(p, Parameter) or p.shape is None:
+                continue
+            if p.name in dist_tables:
+                _shard_with_accumulators(p, 0)
                 continue
             size = int(np.prod(p.shape))
             if self.config.slice_var_up and \
                     size >= self.config.min_block_size and len(eplist) > 1:
-                axis = int(np.argmax(p.shape))
-                spec = [None] * len(p.shape)
-                spec[axis] = 'model'
-                rules.append((r'^%s$' % _re_escape(p.name), P(*spec)))
+                _shard_with_accumulators(p, int(np.argmax(p.shape)))
         self._plan = ShardingPlan(ShardingRules(rules),
                                   num_shards=len(eplist))
 
