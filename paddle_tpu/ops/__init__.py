@@ -25,3 +25,4 @@ from . import misc_ops
 from . import attention_ops
 from . import fused_ops
 from . import dist_ops
+from . import pipeline_ops

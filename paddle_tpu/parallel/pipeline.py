@@ -25,9 +25,10 @@ from jax.sharding import PartitionSpec as P
 __all__ = ['gpipe']
 
 
-def _gpipe_inner(axis_name, stage_fn, n_micro, params_local, x_all):
+def _gpipe_inner(axis_name, stage_fn, n_micro, params_local, x_all, extra):
     """Per-device body: params_local = this stage's params (leading stage
-    dim of size 1), x_all = [M, mb, ...] microbatches (replicated)."""
+    dim of size 1), x_all = [M, mb, ...] microbatches (replicated), extra =
+    replicated shared context (attention masks etc.) or None."""
     s = lax.axis_index(axis_name)
     n_stage = lax.psum(1, axis_name)
     params_local = jax.tree_util.tree_map(lambda p: p[0], params_local)
@@ -42,7 +43,8 @@ def _gpipe_inner(axis_name, stage_fn, n_micro, params_local, x_all):
         # stage 0 ingests microbatch t (clipped; inactive lanes masked)
         x_t = x_all[jnp.clip(t, 0, m - 1)]
         act_in = jnp.where(s == 0, x_t, act)
-        y = stage_fn(params_local, act_in)
+        y = stage_fn(params_local, act_in) if extra is None else \
+            stage_fn(params_local, act_in, extra)
         mb_idx = t - s
         active = (mb_idx >= 0) & (mb_idx < m)
         y = jnp.where(active, y, act_in)
@@ -76,14 +78,16 @@ def _ring_shift(x, axis_name):
 
 
 def gpipe(stage_fn, stage_params, x, mesh, axis_name='pipe',
-          num_microbatches=None):
+          num_microbatches=None, extra=None):
     """Run x through S pipelined stages.
 
-    stage_fn(params, x_mb) -> y_mb: one stage, shape-preserving.
+    stage_fn(params, x_mb[, extra]) -> y_mb: one stage, shape-preserving.
     stage_params: pytree with leading stage dim S on every leaf (sharded
     over `axis_name`).
     x: [B, ...] global batch; B must divide into num_microbatches
     (default: S, the minimum that fills the pipeline).
+    extra: optional pytree of shared context (masks, position tables),
+    replicated to every stage and passed as stage_fn's third argument.
     Returns stage_S(...stage_1(x)) with the same sharding as x
     (replicated over the pipe axis).
     """
@@ -105,6 +109,12 @@ def gpipe(stage_fn, stage_params, x, mesh, axis_name='pipe',
     pspec = jax.tree_util.tree_map(
         lambda _: P(axis_name), stage_params)
     inner = functools.partial(_gpipe_inner, axis_name, stage_fn, m)
-    fn = _shard_map(inner, mesh, (pspec, P()), P())
-    out = fn(stage_params, x_mb)
+    if extra is None:
+        fn = _shard_map(lambda p, xx: inner(p, xx, None), mesh,
+                        (pspec, P()), P())
+        out = fn(stage_params, x_mb)
+    else:
+        espec = jax.tree_util.tree_map(lambda _: P(), extra)
+        fn = _shard_map(inner, mesh, (pspec, P(), espec), P())
+        out = fn(stage_params, x_mb, extra)
     return out.reshape(x.shape)

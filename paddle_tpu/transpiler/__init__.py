@@ -5,9 +5,11 @@ from .inference_transpiler import InferenceTranspiler
 from .ps_dispatcher import RoundRobin, HashName, PSDispatcher
 from .passes import (Pass, PassRegistry, PatternMatcher, register_pass,
                      get_pass, apply_passes)
+from .pipeline_transpiler import PipelineTranspiler
 
 __all__ = ['DistributeTranspiler', 'DistributeTranspilerConfig',
            'memory_optimize', 'release_memory', 'InferenceTranspiler',
            'RoundRobin', 'HashName', 'PSDispatcher', 'Pass',
            'PassRegistry', 'PatternMatcher', 'register_pass', 'get_pass',
+           'PipelineTranspiler',
            'apply_passes']

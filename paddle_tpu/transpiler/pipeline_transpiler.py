@@ -1,0 +1,199 @@
+"""PipelineTranspiler: program-level pipeline parallelism.
+
+The reference fluid (~1.3) has no pipeline parallelism (SURVEY §2.7) — this
+is the TPU-native extension, at Program level: the transpiler detects the
+repeated layer structure of the forward graph (the transformer-block run),
+splits it at layer boundaries, and replaces the run with ONE `gpipe_run`
+meta-op whose lowering streams microbatches through the stages with
+lax.ppermute over mesh axis 'pipe' (parallel/pipeline.py). The backward
+pass is the reverse pipeline automatically via jax.vjp through the
+schedule; optimizer ops are untouched (per-layer parameters keep their
+names — grads flow to them through the in-trace stacking).
+
+Detection contract (the "layer boundary" rule): a maximal run of >= 2
+contiguous op segments with identical op-type sequences, where exactly ONE
+non-persistable activation crosses each boundary (shape-preserving layer,
+e.g. [B, L, D] -> [B, L, D]) and any other crossing vars are the SAME names
+at every boundary (shared context such as an attention mask — closed over,
+replicated). Parameters referenced by segment k bind position-for-position
+to segment 0's names and are stacked [n_stages, layers_per_stage, ...]
+inside the trace.
+
+Memory note: parameter STATE stays per-layer (replicated or sharded by
+MeshRunner rules); the pipeline distributes compute and activation
+residency, not parameter storage.
+"""
+import numpy as np
+
+__all__ = ['PipelineTranspiler']
+
+
+def _forward_range(block):
+    ops = block.ops
+    b = next((i for i, o in enumerate(ops) if o.type == 'backward'),
+             len(ops))
+    return ops, b
+
+
+class PipelineTranspiler(object):
+    def __init__(self):
+        self.plan = None
+
+    # -- detection ---------------------------------------------------------
+    @staticmethod
+    def _crossing_sets(block, ops, hi):
+        """crossings[i] = non-persistable vars produced before op i and
+        consumed at/after op i (i in 1..hi-1) — the live activations a cut
+        at position i would have to stream."""
+        produced_at, last_use = {}, {}
+        for i in range(hi):
+            op = ops[i]
+            for n in op.input_arg_names:
+                last_use[n] = i
+            for n in op.output_arg_names:
+                produced_at.setdefault(n, i)
+        # vars consumed by the backward/loss tail (>= hi) stay live forever
+        for i in range(hi, len(ops)):
+            for n in ops[i].input_arg_names:
+                if n in produced_at:
+                    last_use[n] = len(ops)
+
+        def persistable(n):
+            v = block._find_var_recursive(n)
+            return v is not None and v.persistable
+
+        crossings = {}
+        for i in range(1, hi):
+            crossings[i] = frozenset(
+                n for n, p in produced_at.items()
+                if p < i and last_use.get(n, -1) >= i and not persistable(n))
+        return crossings
+
+    def _find_run(self, program, n_stages):
+        """Locate the layer run: returns (start, period, n_layers, shared,
+        acts) with acts[k] = the activation crossing boundary k."""
+        block = program.global_block()
+        ops, hi = _forward_range(block)
+        crossings = self._crossings = self._crossing_sets(block, ops, hi)
+        types = [op.type for op in ops[:hi]]
+
+        best = None
+        # smallest period first: for equal coverage a finer split gives
+        # more stage-count flexibility; sub-layer periods are rejected by
+        # the single-crossing rule (mid-block boundaries carry both the
+        # residual trunk and the branch activation)
+        for period in range(1, hi // 2 + 1):
+            for start in range(1, hi - 2 * period + 1):
+                if types[start:start + period] != \
+                        types[start + period:start + 2 * period]:
+                    continue
+                n = 2
+                while start + (n + 1) * period <= hi and \
+                        types[start:start + period] == \
+                        types[start + n * period:start + (n + 1) * period]:
+                    n += 1
+                bounds = [start + k * period for k in range(n + 1)]
+                sets = [crossings.get(b) for b in bounds]
+                if any(s is None for s in sets):
+                    continue
+                # shared context (masks etc.) is what every INTERIOR
+                # boundary carries; the final boundary no longer needs it
+                # (no following segment consumes it)
+                shared = frozenset.intersection(*sets[:-1])
+                uniq = [s - shared for s in sets]
+                if any(len(u) != 1 for u in uniq):
+                    continue
+                acts = [next(iter(u)) for u in uniq]
+                if len(set(acts)) != len(acts):
+                    continue
+                cover = n * period
+                if best is None or cover > best[0]:
+                    best = (cover, start, period, n, shared, acts)
+        if best is None:
+            raise ValueError(
+                "PipelineTranspiler: no repeated layer run with single-"
+                "activation boundaries found in the forward graph")
+        _, start, period, n_layers, shared, acts = best
+        if n_layers % n_stages:
+            raise ValueError(
+                "PipelineTranspiler: %d layers do not divide into %d "
+                "pipeline stages" % (n_layers, n_stages))
+        return start, period, n_layers, sorted(shared), acts
+
+    # -- rewrite -----------------------------------------------------------
+    def transpile(self, program=None, num_stages=2, num_microbatches=0):
+        """Rewrite `program` in place; returns the program. The rewritten
+        program runs serially (identical math) without a mesh, and as a
+        gpipe pipeline under a MeshRunner whose mesh has a 'pipe' axis of
+        size `num_stages`."""
+        from ..framework import default_main_program
+        if program is None:
+            program = default_main_program()
+        block = program.global_block()
+        start, period, n_layers, shared, acts = self._find_run(
+            program, num_stages)
+        ops, _ = _forward_range(block)
+        seg0 = ops[start:start + period]
+        run_outputs = {n for o in ops[start:start + n_layers * period]
+                       for n in o.output_arg_names}
+        inside = [n for n in shared if n in run_outputs]
+        if inside:
+            raise ValueError(
+                "PipelineTranspiler: shared context vars %r are produced "
+                "inside the layer run — cannot close over them" % inside)
+
+        # position-aligned external bindings: inputs a segment reads that
+        # it does not produce and that aren't the streamed activation or
+        # shared context
+        def externals(seg, act_in):
+            produced = set()
+            for o in seg:
+                produced.update(o.output_arg_names)
+            out = []
+            for t, o in enumerate(seg):
+                for slot in sorted(o.inputs):
+                    for pos, n in enumerate(o.inputs[slot]):
+                        if n in produced or n == act_in or n in shared:
+                            continue
+                        out.append(((t, slot, pos), n))
+            return out
+
+        ext0 = externals(seg0, acts[0])
+        slot_names = [n for _, n in ext0]
+        bindings = []                      # [layer][slot] -> real name
+        for k in range(n_layers):
+            seg = ops[start + k * period:start + (k + 1) * period]
+            extk = externals(seg, acts[k])
+            if [key for key, _ in extk] != [key for key, _ in ext0]:
+                raise ValueError(
+                    "PipelineTranspiler: layer %d's external inputs do not "
+                    "align position-for-position with layer 0" % k)
+            bindings.append([n for _, n in extk])
+
+        # move segment-0's ops into a sub-block (parent = global block, so
+        # var lookups recurse); later segments' ops are dropped entirely
+        cur_idx = program.current_block_idx
+        sub = program._create_block(parent_idx=block.idx)
+        program.current_block_idx = cur_idx
+        sub.ops = list(seg0)
+
+        all_bound = sorted({n for bk in bindings for n in bk})
+        meta_inputs = {'X': [acts[0]], 'Params': all_bound}
+        if shared:
+            meta_inputs['Shared'] = list(shared)
+        from ..framework import Operator
+        meta = Operator(
+            block, 'gpipe_run', meta_inputs, {'Out': [acts[n_layers]]},
+            {'sub_block': sub.idx, 'n_layers': n_layers,
+             'num_stages': num_stages,
+             'num_microbatches': int(num_microbatches),
+             'in_var': acts[0], 'out_var': acts[1],
+             'slot_names': slot_names,
+             'bindings_flat': [n for bk in bindings for n in bk],
+             'shared_names': list(shared)})
+        block.ops = ops[:start] + [meta] + ops[start + n_layers * period:]
+        program._bump_version()
+        self.plan = {'start': start, 'period': period,
+                     'n_layers': n_layers, 'num_stages': num_stages,
+                     'activation': acts[0], 'shared': list(shared)}
+        return program
