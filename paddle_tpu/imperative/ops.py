@@ -83,6 +83,23 @@ class _EagerCtx(object):
     def set_lod(self, name, lod):
         pass
 
+    # eager mode keeps no NHWC layout twins (every op materializes its
+    # public NCHW value immediately); the twin API degrades to transposes
+    def has_nhwc(self, op, slot):
+        return False
+
+    def in_nhwc(self, op, slot, default=None):
+        names = op.input(slot)
+        if not names:
+            return default
+        import jax.numpy as jnp
+        return jnp.transpose(self.env[names[0]], (0, 2, 3, 1))
+
+    def out_nhwc(self, op, slot, value_nhwc, idx=0):
+        import jax.numpy as jnp
+        self.out(op, slot, jnp.transpose(value_nhwc, (0, 3, 1, 2)),
+                 idx=idx)
+
     def in1_static(self, op, slot, default=None):
         names = op.input(slot)
         if not names:

@@ -14,8 +14,13 @@ from ..core.registry import register_op
 def _register_act(name, fn, attrs=()):
     @register_op(name)
     def _lower(ctx, op, _fn=fn, _attrs=attrs):
-        x = ctx.in1(op, 'X')
         kw = {a: op.attr(a, d) for a, d in _attrs}
+        # unary elementwise is layout-invariant: follow the producer's
+        # NHWC twin (core/lowering.py) so conv stacks stay channels-minor
+        if ctx.has_nhwc(op, 'X'):
+            ctx.out_nhwc(op, 'Out', _fn(ctx.in_nhwc(op, 'X'), **kw))
+            return
+        x = ctx.in1(op, 'X')
         ctx.out(op, 'Out', _fn(x, **kw))
 
 

@@ -75,6 +75,23 @@ def _register_elementwise(name, fn):
                                      x.height))
                 return
             x = x.to_dense()
+        # layout-twin path (core/lowering.py ctx.nhwc): keep channels-minor
+        # residual adds / conv-bias adds / SE-style scales transpose-free
+        if ctx.has_nhwc(op, 'X') and getattr(x, 'ndim', 0) == 4 \
+                and not isinstance(y, SelectedRows):
+            xt = ctx.in_nhwc(op, 'X')
+            axis = op.attr('axis', -1)
+            yt = None
+            if getattr(y, 'ndim', None) == 4:
+                yt = ctx.in_nhwc(op, 'Y')      # twin or transposed NCHW
+            elif getattr(y, 'ndim', None) == 1 and axis == 1 \
+                    and y.shape[0] == x.shape[1]:
+                yt = y.reshape((1, 1, 1, -1))  # per-channel bias/scale
+            elif getattr(y, 'size', 0) == 1:
+                yt = y
+            if yt is not None:
+                ctx.out_nhwc(op, 'Out', _fn(xt, yt))
+                return
         y = broadcast_y_to(x, y, op.attr('axis', -1))
         ctx.out(op, 'Out', _fn(x, y))
 

@@ -120,7 +120,7 @@ def _pair(v, n=2):
 
 @register_op('conv2d')
 def _conv2d(ctx, op):
-    x = ctx.in1(op, 'Input')       # NCHW
+    x = ctx.in_nhwc(op, 'Input')   # channels-minor twin (or transposed)
     w = ctx.in1(op, 'Filter')      # OIHW (I = C/groups)
     strides = _pair(op.attr('strides', [1, 1]))
     pads = _pair(op.attr('paddings', [0, 0]))
@@ -129,20 +129,21 @@ def _conv2d(ctx, op):
     out_dtype = x.dtype
     x, w = amp.cast_compute(op, x, w)
     # compute in NHWC: the TPU conv path is an order of magnitude faster
-    # with channels-minor layouts (measured 11x on v5e); the wrapping
-    # transposes are layout copies that XLA fuses/cancels between
-    # consecutive convs, so the public NCHW contract is unchanged
+    # with channels-minor layouts (measured 11x on v5e). The output is
+    # emitted as a layout twin (out_nhwc): downstream BN/pool/relu/
+    # elementwise consume the NHWC value directly, so whole conv stacks
+    # stay channels-minor in HBM (measured ~5x again over per-op
+    # transpose round-trips) while env keeps the public NCHW contract.
     out = lax.conv_general_dilated(
-        jnp.transpose(x, (0, 2, 3, 1)), jnp.transpose(w, (2, 3, 1, 0)),
+        x, jnp.transpose(w, (2, 3, 1, 0)),
         window_strides=strides,
         padding=[(pads[0], pads[0]), (pads[1], pads[1])],
         rhs_dilation=dilations,
         dimension_numbers=('NHWC', 'HWIO', 'NHWC'),
         feature_group_count=groups,
         preferred_element_type=amp.accum_dtype(x))
-    ctx.out(op, 'Output',
-            jnp.transpose(out, (0, 3, 1, 2)).astype(
-                amp.result_dtype(op, x, out_dtype)))
+    ctx.out_nhwc(op, 'Output',
+                 out.astype(amp.result_dtype(op, x, out_dtype)))
 
 
 @register_op('depthwise_conv2d')
@@ -221,31 +222,41 @@ def _depthwise_conv2d_transpose(ctx, op):
 
 
 def _pool(x, ksize, strides, pads, ptype, exclusive, adaptive, global_pool,
-          ceil_mode):
+          ceil_mode, channels_last=False):
+    """Window pooling. channels_last=True pools a channels-minor (NHWC)
+    value — the layout-twin path that keeps conv stacks transpose-free."""
     n_sp = len(ksize)
+    sp0 = 1 if channels_last else 2         # first spatial axis
+    sp_shape = x.shape[sp0:sp0 + n_sp]
     if global_pool:
-        ksize = x.shape[-n_sp:]
+        ksize = sp_shape
         pads = (0,) * n_sp
         strides = (1,) * n_sp
     if adaptive:
         # adaptive: output size = ksize; use even splits
         out_sz = ksize
-        in_sz = x.shape[-n_sp:]
+        in_sz = sp_shape
         strides = tuple(i // o for i, o in zip(in_sz, out_sz))
         ksize = tuple(i - (o - 1) * s for i, o, s in
                       zip(in_sz, out_sz, strides))
         pads = (0,) * n_sp
-    window = (1, 1) + tuple(ksize)
-    strides_full = (1, 1) + tuple(strides)
-    pad_full = [(0, 0), (0, 0)] + [(p, p) for p in pads]
+    if channels_last:
+        window = (1,) + tuple(ksize) + (1,)
+        strides_full = (1,) + tuple(strides) + (1,)
+        sp_pad = [(p, p) for p in pads]
+    else:
+        window = (1, 1) + tuple(ksize)
+        strides_full = (1, 1) + tuple(strides)
+        sp_pad = [(p, p) for p in pads]
     if ceil_mode:
-        new_pad = []
+        sp_pad = []
         for i, (p, k, s) in enumerate(zip(pads, ksize, strides)):
-            in_dim = x.shape[2 + i]
+            in_dim = sp_shape[i]
             out_dim = -(-(in_dim + 2 * p - k) // s) + 1  # ceil
             needed = (out_dim - 1) * s + k - in_dim - p
-            new_pad.append((p, max(p, needed)))
-        pad_full = [(0, 0), (0, 0)] + new_pad
+            sp_pad.append((p, max(p, needed)))
+    pad_full = ([(0, 0)] + sp_pad + [(0, 0)]) if channels_last else \
+        ([(0, 0), (0, 0)] + sp_pad)
     if ptype == 'max':
         init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
             jnp.iinfo(x.dtype).min
@@ -261,13 +272,16 @@ def _pool(x, ksize, strides, pads, ptype, exclusive, adaptive, global_pool,
 
 @register_op('pool2d')
 def _pool2d(ctx, op):
-    x = ctx.in1(op, 'X')
-    out = _pool(x, _pair(op.attr('ksize')), _pair(op.attr('strides', [1, 1])),
-                _pair(op.attr('paddings', [0, 0])),
-                op.attr('pooling_type', 'max'),
-                op.attr('exclusive', True), op.attr('adaptive', False),
-                op.attr('global_pooling', False), op.attr('ceil_mode', False))
-    ctx.out(op, 'Out', out)
+    args = (_pair(op.attr('ksize')), _pair(op.attr('strides', [1, 1])),
+            _pair(op.attr('paddings', [0, 0])),
+            op.attr('pooling_type', 'max'),
+            op.attr('exclusive', True), op.attr('adaptive', False),
+            op.attr('global_pooling', False), op.attr('ceil_mode', False))
+    if ctx.has_nhwc(op, 'X'):
+        ctx.out_nhwc(op, 'Out', _pool(ctx.in_nhwc(op, 'X'), *args,
+                                      channels_last=True))
+    else:
+        ctx.out(op, 'Out', _pool(ctx.in1(op, 'X'), *args))
 
 
 @register_op('pool3d')
@@ -307,7 +321,13 @@ def _max_pool2d_with_index(ctx, op):
 
 @register_op('batch_norm')
 def _batch_norm(ctx, op):
-    x = ctx.in1(op, 'X')
+    # layout-twin path: when the producer left an NHWC twin (conv/pool),
+    # normalize channels-minor — stats reduce over leading axes and the
+    # affine broadcasts on the minor dim, so the conv stack never
+    # materializes NCHW between ops
+    twin = ctx.has_nhwc(op, 'X') and ctx.get(op.input('X')[0]).ndim == 4 \
+        and op.attr('data_layout', 'NCHW') == 'NCHW'
+    x = ctx.in_nhwc(op, 'X') if twin else ctx.in1(op, 'X')
     scale = ctx.in1(op, 'Scale')
     bias = ctx.in1(op, 'Bias')
     mean = ctx.in1(op, 'Mean')
@@ -316,7 +336,7 @@ def _batch_norm(ctx, op):
     momentum = op.attr('momentum', 0.9)
     eps = op.attr('epsilon', 1e-5)
     is_test = op.attr('is_test', False)
-    layout = op.attr('data_layout', 'NCHW')
+    layout = 'NHWC' if twin else op.attr('data_layout', 'NCHW')
     use_global = op.attr('use_global_stats', False) or is_test
 
     if layout == 'NCHW':
@@ -332,7 +352,10 @@ def _batch_norm(ctx, op):
         ctx.out(op, 'VarianceOut', var)
     else:
         # statistics ALWAYS accumulate in f32 (a bf16 mean over ~1e5
-        # elements loses precision); running stats stay f32 state
+        # elements loses precision); running stats stay f32 state.
+        # Two-pass mean/var (jnp.var): the one-pass E[x^2]-E[x]^2 form
+        # cancels catastrophically for channels with large mean and tiny
+        # variance (|m|^2*eps swamps the true variance)
         xf = x.astype(jnp.float32)
         m = jnp.mean(xf, axis=axes)
         v = jnp.var(xf, axis=axes)
@@ -344,7 +367,10 @@ def _batch_norm(ctx, op):
     ctx.out(op, 'SavedVariance', 1.0 / jnp.sqrt(v + eps))
     xn = (x - m.reshape(bshape)) / jnp.sqrt(v.reshape(bshape) + eps)
     y = xn * scale.reshape(bshape) + bias.reshape(bshape)
-    ctx.out(op, 'Y', y.astype(x.dtype))
+    if twin:
+        ctx.out_nhwc(op, 'Y', y.astype(x.dtype))
+    else:
+        ctx.out(op, 'Y', y.astype(x.dtype))
 
 
 @register_op('layer_norm')

@@ -1,0 +1,97 @@
+"""Dump the HLO of the ACTUAL run_fused loop for resnet50 and histogram
+the while-body computation (what one step really materializes)."""
+import collections
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    import jax
+    import paddle_tpu as fluid
+    from paddle_tpu.contrib import mixed_precision as mp
+    from paddle_tpu.models.resnet import build as build_resnet
+
+    batch = int(os.environ.get('HLO_BATCH', '64'))
+    k = 4
+    main_p, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup):
+        img, label, pred, avg_cost, acc = build_resnet('imagenet', depth=50)
+        opt = mp.decorate(
+            fluid.optimizer.Momentum(learning_rate=0.1, momentum=0.9),
+            keep_bf16_activations=True)
+        opt.minimize(avg_cost)
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    stacked = {'img': jax.device_put(np.stack(
+        [rng.randn(batch, 3, 224, 224).astype('float32')
+         for _ in range(k)])),
+        'label': jax.device_put(np.stack(
+            [rng.randint(0, 1000, (batch, 1)).astype('int64')
+             for _ in range(k)]))}
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        exe.run_fused(main_p, stacked, fetch_list=[avg_cost], scope=scope,
+                      return_numpy=True, steps=24)
+        entry = next(v for kk, v in exe._cache.items()
+                     if isinstance(kk, tuple) and kk and kk[0] == 'fused')
+        ro = {n: scope.get(n) for n in entry.ro_names}
+        rw = {n: scope.get(n) for n in entry.rw_names}
+        txt = entry.fn.lower(stacked, ro, rw,
+                             jax.random.PRNGKey(0)).compile().as_text()
+    out = os.environ.get('HLO_OUT', '/tmp/rn50_fused.hlo')
+    with open(out, 'w') as f:
+        f.write(txt)
+    print("bytes:", len(txt), "->", out)
+
+    # find the while body computation (largest computation containing
+    # convolutions, excluding fused computations)
+    comps = re.split(r'\n(?=%|ENTRY)', txt)
+    dt_size = {'f32': 4, 'bf16': 2, 's32': 4, 'u32': 4, 'pred': 1,
+               'f16': 2, 's64': 8, 'u8': 1, 's8': 1}
+    best = None
+    for c in comps:
+        if 'fused' in c.split('{')[0] or 'region' not in c.split('{')[0] \
+                and 'body' not in c.split('{')[0]:
+            pass
+        n_conv = len(re.findall(r'convolution|custom-call', c))
+        if best is None or n_conv > best[0]:
+            best = (n_conv, c)
+    body = best[1]
+    print("\nbody computation header:", body.split('\n')[0][:120])
+    kind_count = collections.Counter()
+    kind_bytes = collections.Counter()
+    for mm in re.finditer(r'=\s+(\w+)\[([0-9,]*)\][^ ]*\s+([\w-]+)\(',
+                          body):
+        dt, shape, kind = mm.groups()
+        n = 1
+        for d in shape.split(','):
+            if d:
+                n *= int(d)
+        kind_count[kind] += 1
+        kind_bytes[kind] += n * dt_size.get(dt, 4)
+    total = sum(kind_bytes.values())
+    print("body materializes %.2f GB" % (total / 1e9))
+    for kk, c in kind_count.most_common(18):
+        print("  %-22s %5d  %9.1f MB" % (kk, c, kind_bytes[kk] / 1e6))
+    big = sorted(
+        ((int(np.prod([int(d) for d in mm.group(2).split(',') if d]))
+          * dt_size.get(mm.group(1), 4), mm.group(3), mm.group(1),
+          mm.group(2))
+         for mm in re.finditer(
+             r'=\s+(\w+)\[([0-9,]*)\][^ ]*\s+([\w-]+)\(', body)),
+        reverse=True)
+    print("\nbiggest body outputs:")
+    for s, kk, dt, sh in big[:12]:
+        print("  %8.1f MB %-14s %s[%s]" % (s / 1e6, kk, dt, sh))
+    convs = re.findall(r'convolution\([^\n]*dim_labels=([^ ,}]*)', body)
+    print("\nbody conv dim_labels:", collections.Counter(convs))
+
+
+if __name__ == '__main__':
+    main()
