@@ -134,8 +134,9 @@ def _bench_lm(cfg_kwargs, batch, k_per_call, rounds, amp):
 
 
 def _bench_image_model(build_fn, label_str, batch, k_per_call, rounds,
-                       amp):
-    """Shared ImageNet-model measurement (resnet50 / se_resnext rows):
+                       amp, img_shape=(3, 224, 224), n_class=1000,
+                       dataset='imagenet'):
+    """Shared image-model measurement (resnet50 / se_resnext / vgg rows):
     Momentum + keep-bf16-activations AMP (+13% images/sec measured on
     v5e), 24+-step fused windows."""
     import numpy as np
@@ -152,8 +153,9 @@ def _bench_image_model(build_fn, label_str, batch, k_per_call, rounds,
     exe = fluid.Executor(fluid.TPUPlace(0))
     scope = fluid.Scope()
     rng = np.random.RandomState(0)
-    batches = [{'img': rng.randn(batch, 3, 224, 224).astype('float32'),
-                'label': rng.randint(0, 1000, (batch, 1)).astype('int64')}
+    batches = [{'img': rng.randn(batch, *img_shape).astype('float32'),
+                'label': rng.randint(0, n_class,
+                                     (batch, 1)).astype('int64')}
                for _ in range(k_per_call)]
     with fluid.scope_guard(scope):
         exe.run(startup, scope=scope)
@@ -165,7 +167,7 @@ def _bench_image_model(build_fn, label_str, batch, k_per_call, rounds,
         'step_ms': round(sec_step * 1000, 2),
         'compile_s': round(compile_s, 1),
         'final_loss': round(loss, 4),
-        'config': '%s imagenet b%d' % (label_str, batch),
+        'config': '%s %s b%d' % (label_str, dataset, batch),
     }
 
 
@@ -267,20 +269,113 @@ def _bench_se_resnext(batch, k_per_call, rounds, amp):
                               k_per_call, rounds, amp)
 
 
-def _bench_ctr(batch, k_per_call, rounds):
+def _bench_vgg(batch, k_per_call, rounds, amp):
+    """VGG16-BN cifar10 (reference benchmark/fluid/models/vgg.py:28
+    vgg16_bn_drop; fluid_benchmark default data_set cifar10)."""
+    from paddle_tpu.models.vgg import build as build_vgg
+    return _bench_image_model(
+        lambda: build_vgg(class_dim=10, image_shape=(3, 32, 32)),
+        'vgg16', batch, k_per_call, rounds, amp,
+        img_shape=(3, 32, 32), n_class=10, dataset='cifar10')
+
+
+def _bench_nmt(batch, seq_len, k_per_call, rounds):
+    """Attention seq2seq NMT train + beam-search generation timing
+    (reference benchmark/fluid/models/machine_translation.py:186:
+    emb/enc/dec 512, dict 30000; its harness trains only, is_generating=
+    False — the generation timing is our addition). Train feeds are
+    ragged LoD batches with one shared bucket shape per fused window."""
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu.models.seq2seq import (Seq2SeqConfig, build_nmt_train,
+                                           build_nmt_generate)
+
+    cfg = Seq2SeqConfig()       # reference scale: 512/512/512, V=30000
+    main_p, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup):
+        feeds, avg_cost, _pred = build_nmt_train(cfg)
+        fluid.optimizer.Adam(learning_rate=1e-4).minimize(avg_cost)
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    lod = [list(range(0, (batch + 1) * seq_len, seq_len))]
+    total = batch * seq_len
+    batches = [{
+        'source_sequence': (rng.randint(
+            1, cfg.dict_size, (total, 1)).astype('int64'), lod),
+        'target_sequence': (rng.randint(
+            1, cfg.dict_size, (total, 1)).astype('int64'), lod),
+        'label_sequence': (rng.randint(
+            1, cfg.dict_size, (total, 1)).astype('int64'), lod),
+    } for _ in range(k_per_call)]
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        sec_step, loss, compile_s = _measure_steps(
+            exe, main_p, scope, batches, avg_cost, k_per_call, rounds)
+    out = {
+        'samples_per_sec': round(batch / sec_step, 1),
+        'tokens_per_sec': round(total / sec_step, 1),
+        'step_ms': round(sec_step * 1000, 2),
+        'compile_s': round(compile_s, 1),
+        'final_loss': round(loss, 4),
+        'config': 'nmt emb%d enc%d dec%d V%d seq%d b%d' % (
+            cfg.embedding_dim, cfg.encoder_size, cfg.decoder_size,
+            cfg.dict_size, seq_len, batch),
+    }
+    # beam-search generation: one compiled While decode per call (the
+    # timing includes one relay round-trip; reported per sentence)
+    try:
+        from paddle_tpu.contrib.decoder import BeamSearchDecoder
+        gmain, gstart = fluid.Program(), fluid.Program()
+        gcfg = Seq2SeqConfig(beam_size=3)
+        with fluid.program_guard(gmain, gstart):
+            gfeeds, (ids_v, sc_v) = build_nmt_generate(gcfg, max_len=50)
+        gb = 8
+        src = (rng.randint(1, cfg.dict_size,
+                           (gb * seq_len, 1)).astype('int64'),
+               [list(range(0, (gb + 1) * seq_len, seq_len))])
+        init_ids, init_scores = BeamSearchDecoder.make_initial_beams(
+            gb, gcfg.beam_size, 0)
+        gscope = fluid.Scope()
+        with fluid.scope_guard(gscope):
+            exe.run(gstart, scope=gscope)
+            feed = {'source_sequence': src, 'init_ids': init_ids,
+                    'init_scores': init_scores}
+            exe.run(gmain, feed=feed, fetch_list=[ids_v, sc_v],
+                    scope=gscope)                      # compile
+            best = float('inf')
+            for _ in range(max(1, rounds)):
+                t0 = time.time()
+                exe.run(gmain, feed=feed, fetch_list=[ids_v, sc_v],
+                        scope=gscope)
+                best = min(best, time.time() - t0)
+        out['beam_decode_ms_per_sentence'] = round(best * 1000 / gb, 2)
+        out['beam_config'] = 'beam%d maxlen50 b%d' % (gcfg.beam_size, gb)
+    except Exception as e:
+        out['beam_error'] = '%s: %s' % (type(e).__name__, str(e)[:150])
+    return out
+
+
+def _bench_ctr(batch, k_per_call, rounds, vocab=100000, dim=16,
+               is_distributed=False):
     """Wide&deep-style CTR: multi-slot embedding lookups + MLP, the sparse
-    workload BASELINE.md's north-star table names (DeepFM/CTR)."""
+    workload BASELINE.md's north-star table names (DeepFM/CTR).
+    is_distributed=True sizes the table for the vocab-sharded path
+    (reference lookup_table is_distributed / parameter_prefetch) — on the
+    single bench chip the shard is the whole table; the 8-way sharded
+    placement itself is validated by dryrun_multichip's V=1M mesh case."""
     import numpy as np
     import paddle_tpu as fluid
 
-    vocab, slots, dim = 100000, 26, 16
+    slots = 26
     main_p, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main_p, startup):
         ids = fluid.layers.data(name='ids', shape=[slots], dtype='int64')
         label = fluid.layers.data(name='label', shape=[1], dtype='float32')
         emb = fluid.layers.embedding(
             input=fluid.layers.reshape(ids, [-1, slots, 1]),
-            size=[vocab, dim], is_sparse=True)
+            size=[vocab, dim], is_sparse=True,
+            is_distributed=is_distributed)
         flat = fluid.layers.reshape(emb, [-1, slots * dim])
         h = fluid.layers.fc(flat, size=400, act='relu')
         h = fluid.layers.fc(h, size=400, act='relu')
@@ -388,6 +483,10 @@ def _child(mode):
         _try('stacked_lstm', _bench_stacked_lstm, 32, 128, 10, 2)
         _try('se_resnext', _bench_se_resnext, 32, 4, 2, True)
         _try('ctr_sparse', _bench_ctr, 512, 50, 3)
+        _try('vgg16', _bench_vgg, 128, 10, 3, True)
+        _try('machine_translation', _bench_nmt, 32, 30, 6, 2)
+        _try('ctr_sharded_v1m', _bench_ctr, 512, 20, 2,
+             vocab=1 << 20, dim=32, is_distributed=True)
     for r in models.values():
         r.pop('flops_per_step', None)
     flag.pop('flops_per_step', None)
