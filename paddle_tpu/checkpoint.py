@@ -53,6 +53,24 @@ def save_checkpoint(dirname, main_program=None, scope=None, step=None):
     state = _persistable_state(main_program, scope)
     if not state:
         raise RuntimeError("save_checkpoint: nothing persistable to save")
+    import jax
+    if jax.process_count() > 1:
+        # orbax multi-host serialization needs GLOBAL arrays; values that
+        # never went through a mesh (learning-rate scalars, counters) are
+        # host-local and identical on every process — promote them to
+        # replicated global arrays
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        repl = NamedSharding(
+            Mesh(np.array(jax.devices()), ('all',)), P())
+
+        def _globalize(v):
+            if isinstance(v, jax.Array) and not v.is_fully_addressable:
+                return v
+            arr = np.asarray(v)
+            return jax.make_array_from_callback(
+                arr.shape, repl, lambda idx: arr[idx])
+
+        state = {k: _globalize(v) for k, v in state.items()}
 
     path = os.path.abspath(dirname if step is None
                            else os.path.join(dirname, 'step_%d' % step))

@@ -71,6 +71,42 @@ def main():
 
     mode = os.environ.get('MH_MODE', 'dp')
     losses = []
+    if mode == 'ckpt':
+        # kill-and-resume drill (reference io.py
+        # _save_distributed_persistables + unittests/dist_save_load.py):
+        # Reduce-mode DP (ZeRO-style sharded param/optimizer state),
+        # orbax sharded checkpoint mid-run.
+        #   ref:    4 uninterrupted steps
+        #   crash:  2 steps -> save -> 1 more (un-checkpointed) step ->
+        #           abnormal death (os._exit(17))
+        #   resume: fresh cluster restores the checkpoint and runs steps
+        #           3-4 — must match ref[2:]
+        phase = os.environ['MH_CKPT_PHASE']
+        ckpt_dir = os.environ['MH_CKPT_DIR']
+        bs = fluid.BuildStrategy()
+        bs.reduce_strategy = fluid.BuildStrategy.ReduceStrategy.Reduce
+        compiled = fluid.CompiledProgram(main_p).with_data_parallel(
+            loss_name=loss.name, build_strategy=bs)
+
+        def step():
+            l, = exe.run(compiled, feed={'x': X[lo:hi], 'y': Y[lo:hi]},
+                         fetch_list=[loss])
+            return float(np.asarray(l).reshape(()))
+
+        if phase == 'ref':
+            losses = [step() for _ in range(4)]
+        elif phase == 'crash':
+            losses = [step() for _ in range(2)]
+            fluid.checkpoint.save_checkpoint(ckpt_dir, main_p)
+            step()                      # advances PAST the checkpoint
+            sys.stdout.flush()
+            os._exit(17)                # die abnormally mid-run
+        else:                           # resume
+            restored = fluid.checkpoint.load_checkpoint(ckpt_dir, main_p)
+            assert restored, "nothing restored"
+            losses = [step() for _ in range(2)]
+        print("LOSSES:" + json.dumps(losses))
+        return
     if mode == 'dp':
         compiled = fluid.CompiledProgram(main_p).with_data_parallel(
             loss_name=loss.name)
