@@ -17,13 +17,22 @@ owns memory and kernels); what remains is the debugging/determinism tier:
                            subsumes eager deletion)
 - FLAGS_paddle_num_threads accepted for API parity (host threading is
                            XLA-managed)
+- FLAGS_deterministic_compile  pin matmul precision ('highest') so compiled
+                           programs are bit-reproducible across rebuilds —
+                           the TPU analog of FLAGS_cudnn_deterministic
+                           (reference __init__.py:143)
+- FLAGS_barrier_deadline_secs  default timeout for
+                           parallel.collective.barrier_with_timeout, the
+                           failure-detection knob (reference
+                           FLAGS_rpc_deadline, distributed RPC tier)
 """
 import os
 
 __all__ = ['get_flags', 'set_flags']
 
-_BOOL = ('check_nan_inf', 'debug_nans', 'cpu_deterministic', 'benchmark')
-_FLOAT = ('eager_delete_tensor_gb',)
+_BOOL = ('check_nan_inf', 'debug_nans', 'cpu_deterministic', 'benchmark',
+         'deterministic_compile')
+_FLOAT = ('eager_delete_tensor_gb', 'barrier_deadline_secs')
 _INT = ('paddle_num_threads',)
 
 _flags = {}
@@ -47,6 +56,7 @@ def _load_env():
 
 
 _debug_nans_touched = False
+_det_compile_touched = False
 
 
 def _apply_side_effects():
@@ -57,6 +67,11 @@ def _apply_side_effects():
     if _debug_nans_touched or 'FLAGS_debug_nans' in os.environ:
         import jax
         jax.config.update('jax_debug_nans', bool(_flags.get('debug_nans')))
+    if _det_compile_touched or 'FLAGS_deterministic_compile' in os.environ:
+        import jax
+        jax.config.update(
+            'jax_default_matmul_precision',
+            'highest' if _flags.get('deterministic_compile') else None)
 
 
 def get_flags(name=None):
@@ -77,7 +92,7 @@ def set_flags(flags_or_name, value=None):
         items = flags_or_name.items()
     else:
         items = [(flags_or_name, value)]
-    global _debug_nans_touched
+    global _debug_nans_touched, _det_compile_touched
     for name, v in items:
         name = name[6:] if name.startswith('FLAGS_') else name
         if name not in _flags:
@@ -87,6 +102,8 @@ def set_flags(flags_or_name, value=None):
             v = _parse_bool(v) if not isinstance(v, bool) else v
         if name == 'debug_nans':
             _debug_nans_touched = True
+        if name == 'deterministic_compile':
+            _det_compile_touched = True
         _flags[name] = v
     _apply_side_effects()
 

@@ -233,21 +233,20 @@ def multiclass_nms(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
 def detection_output(loc, scores, prior_box, prior_box_var,
                      background_label=0, nms_threshold=0.3, nms_top_k=400,
                      keep_top_k=200, score_threshold=0.01, nms_eta=1.0):
-    """reference layers/detection.py detection_output: decode predictions
-    against priors then run multiclass NMS."""
-    helper = LayerHelper('detection_output')
-    decoded_box = box_coder(
-        prior_box=prior_box, prior_box_var=prior_box_var, target_box=loc,
-        code_type='decode_center_size')
-    scores = nn.softmax(scores)
-    scores = nn.transpose(scores, perm=[0, 2, 1])
-    scores.stop_gradient = True
-    decoded_box.stop_gradient = True
+    """SSD post-processing (reference layers/detection.py
+    detection_output): regression offsets decode against the priors, the
+    per-class score tensor pivots to [N, classes, priors], and multiclass
+    NMS prunes the decoded set. Neither stage carries gradients."""
+    class_major = nn.transpose(nn.softmax(scores), perm=[0, 2, 1])
+    boxes = box_coder(prior_box=prior_box, prior_box_var=prior_box_var,
+                      target_box=loc, code_type='decode_center_size')
+    boxes.stop_gradient = True
+    class_major.stop_gradient = True
     return multiclass_nms(
-        bboxes=decoded_box, scores=scores, score_threshold=score_threshold,
-        nms_top_k=nms_top_k, keep_top_k=keep_top_k,
-        nms_threshold=nms_threshold, normalized=False, nms_eta=nms_eta,
-        background_label=background_label)
+        bboxes=boxes, scores=class_major,
+        score_threshold=score_threshold, nms_threshold=nms_threshold,
+        nms_top_k=nms_top_k, keep_top_k=keep_top_k, nms_eta=nms_eta,
+        background_label=background_label, normalized=False)
 
 
 def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
@@ -255,85 +254,84 @@ def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
              neg_pos_ratio=3.0, neg_overlap=0.5, loc_loss_weight=1.0,
              conf_loss_weight=1.0, match_type='per_prediction',
              mining_type='max_negative', normalize=True, sample_size=None):
-    """reference layers/detection.py ssd_loss:874 — the 5-step SSD multibox
-    loss (match, conf loss, hard mining, target assign, weighted sum)."""
+    """SSD multibox loss (reference layers/detection.py ssd_loss:874).
+
+    The pipeline the op set dictates: IoU-match ground truth to priors,
+    score every prior's classification loss, mine hard negatives against
+    that score, re-assign classification + regression targets under the
+    mined match, and sum the weighted class/location losses per image.
+    """
     helper = LayerHelper('ssd_loss')
     if mining_type != 'max_negative':
         raise ValueError("Only support mining_type == max_negative now.")
+    n_img, n_prior, _ = confidence.shape
+    conf2d = nn.flatten(x=confidence, axis=2)
 
-    num, num_prior, num_class = confidence.shape
+    def _frozen(v):
+        v.stop_gradient = True
+        return v
 
-    def __reshape_to_2d(var):
-        return nn.flatten(x=var, axis=2)
+    def _class_loss(match):
+        """Per-prior softmax CE of conf2d against labels gathered through
+        `match` (+ the weight tensor target_assign produces)."""
+        lab, w = target_assign(labels, match,
+                               mismatch_value=background_label,
+                               negative_indices=None)
+        lab2d = _frozen(tensor.cast(x=nn.flatten(x=lab, axis=2),
+                                    dtype='int64'))
+        return nn.softmax_with_cross_entropy(conf2d, lab2d), w
 
-    # 1. IoU + bipartite match
-    iou = iou_similarity(x=gt_box, y=prior_box)
-    matched_indices, matched_dist = bipartite_match(iou, match_type,
-                                                    overlap_threshold)
+    labels = _frozen(nn.reshape(x=gt_label, shape=(-1, 1)))
 
-    # 2. confidence loss for mining
-    gt_label = nn.reshape(x=gt_label, shape=(-1, 1))
-    gt_label.stop_gradient = True
-    target_label, _ = target_assign(gt_label, matched_indices,
-                                    mismatch_value=background_label)
-    confidence2d = __reshape_to_2d(confidence)
-    target_label = tensor.cast(x=target_label, dtype='int64')
-    target_label = __reshape_to_2d(target_label)
-    target_label.stop_gradient = True
-    conf_loss = nn.softmax_with_cross_entropy(confidence2d, target_label)
-    conf_loss = nn.reshape(x=conf_loss, shape=(num, num_prior))
-    conf_loss.stop_gradient = True
+    # match phase: one bipartite assignment per image from the IoU table
+    match, match_dist = bipartite_match(
+        iou_similarity(x=gt_box, y=prior_box), match_type,
+        overlap_threshold)
 
-    # 3. hard example mining
-    neg_indices = helper.create_variable_for_type_inference('int32')
-    updated_matched_indices = helper.create_variable_for_type_inference(
-        'int32')
+    # mining phase: rank candidate negatives by their current class loss
+    mining_loss, _ = _class_loss(match)
+    mining_loss = _frozen(nn.reshape(x=mining_loss,
+                                     shape=(n_img, n_prior)))
+    negs = helper.create_variable_for_type_inference('int32')
+    mined_match = helper.create_variable_for_type_inference('int32')
     helper.append_op(
         type='mine_hard_examples',
-        inputs={'ClsLoss': [conf_loss], 'MatchIndices': [matched_indices],
-                'MatchDist': [matched_dist]},
-        outputs={'NegIndices': [neg_indices],
-                 'UpdatedMatchIndices': [updated_matched_indices]},
+        inputs={'ClsLoss': [mining_loss], 'MatchIndices': [match],
+                'MatchDist': [match_dist]},
+        outputs={'NegIndices': [negs],
+                 'UpdatedMatchIndices': [mined_match]},
         attrs={'neg_pos_ratio': neg_pos_ratio,
                'neg_dist_threshold': neg_overlap,
                'mining_type': mining_type,
                'sample_size': sample_size or 0})
 
-    # 4. assign targets
-    encoded_bbox = box_coder(
-        prior_box=prior_box, prior_box_var=prior_box_var,
-        target_box=gt_box, code_type='encode_center_size')
-    target_bbox, target_loc_weight = target_assign(
-        encoded_bbox, updated_matched_indices,
+    # target phase: classification targets include the mined negatives;
+    # regression targets are the priors' encoded ground-truth offsets
+    lab_mined, conf_w = target_assign(
+        labels, mined_match, negative_indices=negs,
         mismatch_value=background_label)
-    target_label, target_conf_weight = target_assign(
-        gt_label, updated_matched_indices, negative_indices=neg_indices,
-        mismatch_value=background_label)
+    lab2d = _frozen(tensor.cast(x=nn.flatten(x=lab_mined, axis=2),
+                                dtype='int64'))
+    cls = nn.softmax_with_cross_entropy(conf2d, lab2d) \
+        * _frozen(nn.flatten(x=conf_w, axis=2))
 
-    # 5. weighted loss
-    target_label = __reshape_to_2d(target_label)
-    target_label = tensor.cast(x=target_label, dtype='int64')
-    conf_loss = nn.softmax_with_cross_entropy(confidence2d, target_label)
-    target_conf_weight = __reshape_to_2d(target_conf_weight)
-    conf_loss = conf_loss * target_conf_weight
-    target_label.stop_gradient = True
-    target_conf_weight.stop_gradient = True
+    offsets = box_coder(prior_box=prior_box, prior_box_var=prior_box_var,
+                        target_box=gt_box,
+                        code_type='encode_center_size')
+    t_box, loc_w = target_assign(offsets, mined_match,
+                                 mismatch_value=background_label)
+    loc_w2d = _frozen(nn.flatten(x=loc_w, axis=2))
+    reg = nn.smooth_l1(nn.flatten(x=location, axis=2),
+                       _frozen(nn.flatten(x=t_box, axis=2))) * loc_w2d
 
-    location2d = __reshape_to_2d(location)
-    target_bbox = __reshape_to_2d(target_bbox)
-    loc_loss = nn.smooth_l1(location2d, target_bbox)
-    target_loc_weight2d = __reshape_to_2d(target_loc_weight)
-    loc_loss = loc_loss * target_loc_weight2d
-    target_bbox.stop_gradient = True
-    target_loc_weight.stop_gradient = True
-
-    loss = conf_loss_weight * conf_loss + loc_loss_weight * loc_loss
-    loss = nn.reshape(x=loss, shape=(num, num_prior))
-    loss = nn.reduce_sum(loss, dim=1, keep_dim=True)
+    # reduction phase: weighted sum per prior, summed per image,
+    # optionally normalized by the number of matched priors
+    total = nn.reduce_sum(
+        nn.reshape(x=conf_loss_weight * cls + loc_loss_weight * reg,
+                   shape=(n_img, n_prior)), dim=1, keep_dim=True)
     if normalize:
-        normalizer = nn.reduce_sum(target_loc_weight2d)
-        loss = loss / normalizer
-    return loss
+        total = total / nn.reduce_sum(loc_w2d)
+    return total
 
 
 def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,

@@ -80,14 +80,18 @@ def process_index():
     return jax.process_index()
 
 
-def barrier_with_timeout(name='paddle_tpu_barrier', timeout_s=60.0,
+def barrier_with_timeout(name='paddle_tpu_barrier', timeout_s=None,
                          on_timeout=None):
     """Host-level barrier that DETECTS failed/unresponsive hosts: raises
     RuntimeError if the cluster does not reach the barrier within
     `timeout_s` (SURVEY §5 failure detection — the reference relies on
     gRPC deadlines, FLAGS_rpc_deadline; the TPU-native runtime detects
     failed hosts via jax.distributed barrier timeouts). `on_timeout`
-    (callable) runs before raising — hook for checkpoint-then-abort."""
+    (callable) runs before raising — hook for checkpoint-then-abort.
+    timeout_s defaults to FLAGS_barrier_deadline_secs (or 60)."""
+    if timeout_s is None:
+        from .. import flags as _flags
+        timeout_s = _flags.get_flags('barrier_deadline_secs') or 60.0
     import threading
     done = threading.Event()
     errs = []
