@@ -33,7 +33,9 @@ def _nbytes(tree):
 
 def record_case(program, feed, static_lods, ro_state, rw_state, key_arr,
                 fetch_names, fetches):
-    out_dir = os.environ['PADDLE_OPTEST_COLLECT_DIR']
+    out_dir = os.environ.get('PADDLE_OPTEST_COLLECT_DIR')
+    if not out_dir:
+        return
     try:
         ops = [op.type for block in program.blocks for op in block.ops]
         new = set(ops) - _seen_ops

@@ -271,12 +271,12 @@ def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
         v.stop_gradient = True
         return v
 
-    def _class_loss(match):
+    def _class_loss(match, negative_indices=None):
         """Per-prior softmax CE of conf2d against labels gathered through
         `match` (+ the weight tensor target_assign produces)."""
         lab, w = target_assign(labels, match,
                                mismatch_value=background_label,
-                               negative_indices=None)
+                               negative_indices=negative_indices)
         lab2d = _frozen(tensor.cast(x=nn.flatten(x=lab, axis=2),
                                     dtype='int64'))
         return nn.softmax_with_cross_entropy(conf2d, lab2d), w
@@ -307,13 +307,8 @@ def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
 
     # target phase: classification targets include the mined negatives;
     # regression targets are the priors' encoded ground-truth offsets
-    lab_mined, conf_w = target_assign(
-        labels, mined_match, negative_indices=negs,
-        mismatch_value=background_label)
-    lab2d = _frozen(tensor.cast(x=nn.flatten(x=lab_mined, axis=2),
-                                dtype='int64'))
-    cls = nn.softmax_with_cross_entropy(conf2d, lab2d) \
-        * _frozen(nn.flatten(x=conf_w, axis=2))
+    cls_raw, conf_w = _class_loss(mined_match, negative_indices=negs)
+    cls = cls_raw * _frozen(nn.flatten(x=conf_w, axis=2))
 
     offsets = box_coder(prior_box=prior_box, prior_box_var=prior_box_var,
                         target_box=gt_box,

@@ -35,8 +35,10 @@ def _auc(ctx, op):
         (lab == 1).astype(jnp.int64))
     neg_hist = jnp.zeros(num_thresholds + 1, jnp.int64).at[bins].add(
         (lab == 0).astype(jnp.int64))
-    stat_pos = stat_pos_in.astype(jnp.int64) + pos_hist
-    stat_neg = stat_neg_in.astype(jnp.int64) + neg_hist
+    # stats may arrive [T+1] or [1, T+1] (layers.auc / reference auc_op
+    # both use a leading 1) — compute flat, emit in the input's shape
+    stat_pos = stat_pos_in.reshape(-1).astype(jnp.int64) + pos_hist
+    stat_neg = stat_neg_in.reshape(-1).astype(jnp.int64) + neg_hist
     # AUC by trapezoid over thresholds (descending)
     tp = jnp.cumsum(stat_pos[::-1])
     fp = jnp.cumsum(stat_neg[::-1])
@@ -47,8 +49,8 @@ def _auc(ctx, op):
     auc = jnp.trapezoid(tpr, fpr) if hasattr(jnp, 'trapezoid') else \
         jnp.trapz(tpr, fpr)
     ctx.out(op, 'AUC', auc.astype(jnp.float32).reshape(1))
-    ctx.out(op, 'StatPosOut', stat_pos)
-    ctx.out(op, 'StatNegOut', stat_neg)
+    ctx.out(op, 'StatPosOut', stat_pos.reshape(stat_pos_in.shape))
+    ctx.out(op, 'StatNegOut', stat_neg.reshape(stat_neg_in.shape))
 
 
 @register_op('precision_recall')

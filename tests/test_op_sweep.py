@@ -1,0 +1,458 @@
+"""Coverage sweep for registered ops not exercised by any other test's
+executor path (found while wiring the TPU second-place harness: these ops
+had lowerings but no executed program). Each test runs a minimal program
+through the real executor with golden/property checks — and, under
+PADDLE_OPTEST_COLLECT_DIR, feeds the TPU replay corpus."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from test_detection_ops import _run_single_op
+
+
+def _r(seed, *shape):
+    return np.random.RandomState(seed).randn(*shape).astype('float32')
+
+
+# ---------------------------------------------------------------------------
+# elementwise / compare / logical
+# ---------------------------------------------------------------------------
+
+def test_compare_and_logical_family():
+    a = np.array([[1.0, 2.0], [3.0, 4.0]], 'float32')
+    b = np.array([[1.0, 3.0], [2.0, 4.0]], 'float32')
+    for op, ref in [('equal', a == b), ('not_equal', a != b),
+                    ('less_equal', a <= b), ('greater_equal', a >= b)]:
+        out, = _run_single_op(op, {'X': a, 'Y': b}, {'Out': ['c_' + op]},
+                              {})
+        np.testing.assert_array_equal(out.astype(bool), ref)
+    t = np.array([True, False, True])
+    f = np.array([False, False, True])
+    for op, ref in [('logical_and', t & f), ('logical_or', t | f),
+                    ('logical_xor', t ^ f)]:
+        out, = _run_single_op(op, {'X': t, 'Y': f}, {'Out': ['l_' + op]},
+                              {})
+        np.testing.assert_array_equal(out.astype(bool), ref)
+    out, = _run_single_op('logical_not', {'X': t}, {'Out': ['l_not']}, {})
+    np.testing.assert_array_equal(out.astype(bool), ~t)
+
+
+def test_elementwise_mod_floordiv_minus():
+    a = np.array([[7.0, 9.0]], 'float32')
+    b = np.array([[2.0, 4.0]], 'float32')
+    out, = _run_single_op('elementwise_mod', {'X': a, 'Y': b},
+                          {'Out': ['em']}, {})
+    np.testing.assert_allclose(out, np.mod(a, b))
+    out, = _run_single_op('elementwise_floordiv', {'X': a, 'Y': b},
+                          {'Out': ['ef']}, {})
+    np.testing.assert_allclose(out, a // b)
+    out, = _run_single_op('minus', {'X': a, 'Y': b}, {'Out': ['mn']}, {})
+    np.testing.assert_allclose(out, a - b)
+
+
+def test_reduce_all_any():
+    x = np.array([[True, True], [True, False]])
+    out, = _run_single_op('reduce_all', {'X': x}, {'Out': ['ra']},
+                          {'dim': [1], 'keep_dim': False,
+                           'reduce_all': False})
+    np.testing.assert_array_equal(out.astype(bool), x.all(1))
+    out, = _run_single_op('reduce_any', {'X': x}, {'Out': ['ry']},
+                          {'dim': [1], 'keep_dim': False,
+                           'reduce_all': False})
+    np.testing.assert_array_equal(out.astype(bool), x.any(1))
+
+
+# ---------------------------------------------------------------------------
+# tensor manipulation
+# ---------------------------------------------------------------------------
+
+def test_tensor_manip_family():
+    x = _r(0, 2, 3, 4, 4)
+    out, = _run_single_op('transpose', {'X': x}, {'Out': ['tp']},
+                          {'axis': [0, 2, 3, 1]})
+    np.testing.assert_allclose(out, x.transpose(0, 2, 3, 1))
+    out, = _run_single_op('reverse', {'X': x}, {'Out': ['rv']},
+                          {'axis': [1]})
+    np.testing.assert_allclose(out, x[:, ::-1])
+    out, = _run_single_op('flatten', {'X': x}, {'Out': ['fl']},
+                          {'axis': 2})
+    np.testing.assert_allclose(out, x.reshape(6, 16))
+    out, = _run_single_op('squeeze', {'X': x[:, :1]}, {'Out': ['sq']},
+                          {'axes': [1]})
+    assert out.shape == (2, 4, 4)
+    out, = _run_single_op('unsqueeze', {'X': x}, {'Out': ['usq']},
+                          {'axes': [0]})
+    assert out.shape == (1, 2, 3, 4, 4)
+    out, = _run_single_op('tile', {'X': x[:, :, 0, 0]}, {'Out': ['tl']},
+                          {'repeat_times': [2, 1]})
+    np.testing.assert_allclose(out, np.tile(x[:, :, 0, 0], (2, 1)))
+    outs = _run_single_op('unstack', {'X': x[..., 0]},
+                          {'Y': ['us0', 'us1']}, {'axis': 0})
+    np.testing.assert_allclose(outs[0], x[0, ..., 0])
+    out, = _run_single_op('crop', {'X': x}, {'Out': ['cr']},
+                          {'offsets': [0, 1, 0, 0],
+                           'shape': [2, 2, 4, 4]})
+    np.testing.assert_allclose(out, x[:, 1:3])
+    out, = _run_single_op('strided_slice', {'Input': x}, {'Out': ['ss']},
+                          {'axes': [3], 'starts': [0], 'ends': [4],
+                           'strides': [2]})
+    np.testing.assert_allclose(out, x[..., ::2])
+    idx = np.array([[0, 2], [1, 0]], 'int64')
+    out, = _run_single_op('gather_nd', {'X': x, 'Index': idx},
+                          {'Out': ['gn']}, {})
+    np.testing.assert_allclose(out, x[(0, 1), (2, 0)])
+    out, = _run_single_op('fill_zeros_like', {'X': x}, {'Out': ['fz']},
+                          {})
+    assert (out == 0).all() and out.shape == x.shape
+    v = np.array([3.0, 1.0, 2.0], 'float32')
+    out, = _run_single_op('diag', {'Diagonal': v}, {'Out': ['dg']}, {})
+    np.testing.assert_allclose(out, np.diag(v))
+    out, = _run_single_op('shape', {'Input': x}, {'Out': ['shp']}, {})
+    np.testing.assert_array_equal(np.asarray(out).reshape(-1),
+                                  [2, 3, 4, 4])
+    out, = _run_single_op('isfinite', {'X': np.array([1.0, np.inf])},
+                          {'Out': ['isf']}, {})
+    assert not bool(np.asarray(out).reshape(-1)[0])
+    out, = _run_single_op('arg_min', {'X': x[..., 0, 0]},
+                          {'Out': ['am']}, {'axis': 1})
+    np.testing.assert_array_equal(out, x[..., 0, 0].argmin(1))
+
+
+def test_vision_layout_ops():
+    x = _r(1, 2, 4, 4, 4)
+    out, = _run_single_op('space_to_depth', {'X': x}, {'Out': ['s2d']},
+                          {'blocksize': 2})
+    assert out.shape == (2, 16, 2, 2)
+    out, = _run_single_op('shuffle_channel', {'X': x}, {'Out': ['shc']},
+                          {'group': 2})
+    assert out.shape == x.shape
+    ref = x.reshape(2, 2, 2, 4, 4).transpose(0, 2, 1, 3, 4).reshape(
+        2, 4, 4, 4)
+    np.testing.assert_allclose(out, ref)
+    out, = _run_single_op('nearest_interp', {'X': x}, {'Out': ['ni']},
+                          {'out_h': 8, 'out_w': 8})
+    assert out.shape == (2, 4, 8, 8)
+    out, = _run_single_op('pad2d', {'X': x}, {'Out': ['p2']},
+                          {'paddings': [1, 1, 2, 2], 'mode': 'constant',
+                           'pad_value': 0.0})
+    assert out.shape == (2, 4, 6, 8)
+    y = _r(2, 2, 4, 2, 2)
+    out, = _run_single_op('pad_constant_like', {'X': x, 'Y': y},
+                          {'Out': ['pcl']}, {'pad_value': 0.0})
+    assert out.shape == x.shape
+    np.testing.assert_allclose(out[:, :, :2, :2], y)
+    out, = _run_single_op('im2sequence', {'X': x}, {'Out': ['i2s']},
+                          {'kernels': [2, 2], 'strides': [2, 2],
+                           'paddings': [0, 0, 0, 0]})
+    assert np.asarray(out).shape[-1] == 4 * 2 * 2
+    out, = _run_single_op(
+        'polygon_box_transform', {'Input': _r(3, 1, 8, 2, 2)},
+        {'Output': ['pbt']}, {})
+    assert np.asarray(out).shape == (1, 8, 2, 2)
+
+
+# ---------------------------------------------------------------------------
+# nn extras
+# ---------------------------------------------------------------------------
+
+def test_norm_and_activation_family():
+    x = _r(4, 3, 8)
+    out = _run_single_op('norm', {'X': x}, {'Out': ['nm'],
+                                            'Norm': ['nm_n']},
+                         {'axis': 1, 'epsilon': 1e-10})[0]
+    np.testing.assert_allclose(
+        out, x / np.sqrt((x * x).sum(1, keepdims=True) + 1e-10),
+        rtol=1e-5)
+    out, = _run_single_op('l1_norm', {'X': x}, {'Out': ['l1']}, {})
+    np.testing.assert_allclose(np.asarray(out).reshape(()),
+                               np.abs(x).sum(), rtol=1e-5)
+    out, = _run_single_op('clip_by_norm', {'X': x}, {'Out': ['cbn']},
+                          {'max_norm': 1.0})
+    assert np.sqrt((np.asarray(out) ** 2).sum()) <= 1.0 + 1e-4
+    out, = _run_single_op('rsqrt', {'X': np.abs(x) + 1.0},
+                          {'Out': ['rs']}, {})
+    np.testing.assert_allclose(out, 1.0 / np.sqrt(np.abs(x) + 1.0),
+                               rtol=1e-5)
+    out, = _run_single_op('selu', {'X': x}, {'Out': ['se']}, {})
+    assert np.isfinite(out).all()
+    a = np.full((1, 3, 1), 0.25, 'float32')
+    out, = _run_single_op('prelu', {'X': x[None], 'Alpha': a},
+                          {'Out': ['pr']}, {'mode': 'channel'})
+    np.testing.assert_allclose(
+        out, np.where(x[None] > 0, x[None], 0.25 * x[None]), rtol=1e-5)
+    xs = _r(5, 2, 6, 3, 3)
+    out, = _run_single_op('maxout', {'X': xs}, {'Out': ['mo']},
+                          {'groups': 2})
+    assert np.asarray(out).shape == (2, 3, 3, 3)
+
+
+def test_norm_layers_4d():
+    x = _r(6, 2, 4, 3, 3)
+    g = np.ones(4, 'float32')
+    b = np.zeros(4, 'float32')
+    out = _run_single_op('group_norm', {'X': x, 'Scale': g, 'Bias': b},
+                         {'Y': ['gn_y'], 'Mean': ['gn_m'],
+                          'Variance': ['gn_v']},
+                         {'groups': 2, 'epsilon': 1e-5})[0]
+    assert np.abs(np.asarray(out).mean()) < 0.1
+    out, = _run_single_op('affine_channel',
+                          {'X': x, 'Scale': 2 * g, 'Bias': b + 1},
+                          {'Out': ['ac']}, {})
+    np.testing.assert_allclose(out, 2 * x + 1, rtol=1e-5)
+    bs = np.full(4, 1e-4, 'float32')
+    bsum = np.zeros(4, 'float32')
+    bsq = np.full(4, 1e-4, 'float32')
+    out = _run_single_op(
+        'data_norm', {'X': x[:, :, 0, 0], 'BatchSize': bs,
+                      'BatchSum': bsum, 'BatchSquareSum': bsq},
+        {'Y': ['dn_y'], 'Means': ['dn_m'], 'Scales': ['dn_s']},
+        {'epsilon': 1e-4})[0]
+    assert np.isfinite(out).all()
+
+
+def test_conv3d_depthwise_and_transpose():
+    x = _r(7, 1, 2, 4, 6, 6)
+    w = _r(8, 3, 2, 2, 2, 2)
+    out, = _run_single_op('conv3d', {'Input': x, 'Filter': w},
+                          {'Output': ['c3']},
+                          {'strides': [1, 1, 1], 'paddings': [0, 0, 0],
+                           'dilations': [1, 1, 1], 'groups': 1})
+    assert np.asarray(out).shape == (1, 3, 3, 5, 5)
+    xd = _r(9, 1, 4, 6, 6)
+    wd = _r(10, 4, 1, 3, 3)
+    out, = _run_single_op('depthwise_conv2d',
+                          {'Input': xd, 'Filter': wd},
+                          {'Output': ['dw']},
+                          {'strides': [1, 1], 'paddings': [1, 1],
+                           'dilations': [1, 1], 'groups': 4})
+    assert np.asarray(out).shape == (1, 4, 6, 6)
+    wt = _r(11, 4, 1, 2, 2)
+    out, = _run_single_op('depthwise_conv2d_transpose',
+                          {'Input': xd, 'Filter': wt},
+                          {'Output': ['dwt']},
+                          {'strides': [2, 2], 'paddings': [0, 0],
+                           'dilations': [1, 1], 'groups': 4})
+    assert np.asarray(out).shape == (1, 4, 12, 12)
+
+
+# ---------------------------------------------------------------------------
+# losses / metrics
+# ---------------------------------------------------------------------------
+
+def test_loss_family():
+    logits = _r(12, 4, 1)
+    labels = (np.random.RandomState(13).rand(4, 1) > 0.5).astype(
+        'float32')
+    out, = _run_single_op('hinge_loss',
+                          {'Logits': logits, 'Labels': labels},
+                          {'Loss': ['hl']}, {})
+    np.testing.assert_allclose(
+        out, np.maximum(0, 1 - (2 * labels - 1) * logits), rtol=1e-5)
+    left, right = _r(14, 4, 1), _r(15, 4, 1)
+    lab = (np.random.RandomState(16).rand(4, 1) > 0.5).astype('float32')
+    out, = _run_single_op('rank_loss',
+                          {'Label': lab, 'Left': left, 'Right': right},
+                          {'Out': ['rl']}, {})
+    np.testing.assert_allclose(
+        out, np.log1p(np.exp(left - right)) - lab * (left - right),
+        rtol=1e-4)
+    out = _run_single_op('margin_rank_loss',
+                         {'Label': 2 * lab - 1, 'X1': left, 'X2': right},
+                         {'Out': ['mrl'], 'Activated': ['mrl_a']},
+                         {'margin': 0.1})[0]
+    np.testing.assert_allclose(
+        out, np.maximum(0, -(2 * lab - 1) * (left - right) + 0.1),
+        rtol=1e-5)
+    x = np.abs(_r(17, 4, 5)) + 0.1
+    l5 = np.random.RandomState(18).randint(0, 5, (4, 1)).astype('int64')
+    out, = _run_single_op('bpr_loss', {'X': x, 'Label': l5},
+                          {'Y': ['bpr']}, {})
+    assert np.isfinite(out).all()
+    y = _r(19, 4, 5)
+    out = _run_single_op('smooth_l1_loss', {'X': x, 'Y': y},
+                         {'Out': ['sl1'], 'Diff': ['sl1_d']},
+                         {'sigma': 1.0})[0]
+    assert np.asarray(out).shape == (4, 1)
+    out = _run_single_op('squared_l2_distance', {'X': x, 'Y': y},
+                         {'Out': ['l2d'], 'sub_result': ['l2d_s']}, {})[0]
+    np.testing.assert_allclose(np.asarray(out).reshape(-1),
+                               ((x - y) ** 2).sum(1), rtol=1e-5)
+    p = 1.0 / (1.0 + np.exp(-x))
+    out, = _run_single_op('teacher_student_sigmoid_loss',
+                          {'X': x, 'Label': np.clip(y, 0, 1)},
+                          {'Y': ['tss']}, {})
+    assert np.isfinite(out).all()
+    onehot = np.eye(5, dtype='float32')[l5.reshape(-1)]
+    out, = _run_single_op('label_smooth', {'X': onehot}, {'Out': ['ls']},
+                          {'epsilon': 0.1})
+    np.testing.assert_allclose(out, onehot * 0.9 + 0.1 / 5, rtol=1e-5)
+
+
+def test_metrics_family():
+    pred = np.array([[0.2, 0.8], [0.7, 0.3], [0.4, 0.6]], 'float32')
+    lab = np.array([[1], [0], [1]], 'int64')
+    stat = np.zeros((1, 4096), 'int64')
+    outs = _run_single_op(
+        'auc', {'Predict': pred, 'Label': lab, 'StatPos': stat,
+                'StatNeg': stat.copy()},
+        {'AUC': ['auc_v'], 'StatPosOut': ['auc_sp'],
+         'StatNegOut': ['auc_sn']}, {'slide_steps': 0})
+    assert 0.99 <= float(np.asarray(outs[0]).reshape(())) <= 1.0
+    pred5 = np.abs(_r(20, 6, 1))
+    idx = np.random.RandomState(21).randint(0, 3, (6, 1)).astype('int64')
+    lab6 = np.random.RandomState(22).randint(0, 3, (6, 1)).astype('int64')
+    w = np.ones((6, 1), 'float32')
+    states = np.zeros((3, 4), 'float32')
+    outs = _run_single_op(
+        'precision_recall',
+        {'MaxProbs': pred5, 'Indices': idx, 'Labels': lab6, 'Weights': w,
+         'StatesInfo': states},
+        {'BatchMetrics': ['pr_b'], 'AccumMetrics': ['pr_a'],
+         'AccumStatesInfo': ['pr_s']}, {'class_number': 3})
+    assert np.isfinite(np.asarray(outs[0])).all()
+    p = np.array([[0, 1], [1, 1]], 'int64')
+    l = np.array([[0, 1], [0, 1]], 'int64')
+    outs = _run_single_op(
+        'mean_iou', {'Predictions': p, 'Labels': l},
+        {'OutMeanIou': ['miou'], 'OutWrong': ['miou_w'],
+         'OutCorrect': ['miou_c']}, {'num_classes': 2})
+    assert 0.0 <= float(np.asarray(outs[0]).reshape(())) <= 1.0
+
+
+# ---------------------------------------------------------------------------
+# random / misc
+# ---------------------------------------------------------------------------
+
+def test_random_family():
+    out, = _run_single_op('uniform_random', {}, {'Out': ['ur']},
+                          {'shape': [64, 8], 'min': -1.0, 'max': 1.0,
+                           'dtype': 'float32'})
+    assert out.shape == (64, 8) and -1 <= out.min() and out.max() <= 1
+    out, = _run_single_op('gaussian_random', {}, {'Out': ['gr']},
+                          {'shape': [128, 4], 'mean': 0.0, 'std': 1.0,
+                           'dtype': 'float32'})
+    assert abs(float(out.mean())) < 0.3
+    out, = _run_single_op('truncated_gaussian_random', {},
+                          {'Out': ['tgr']},
+                          {'shape': [256], 'mean': 0.0, 'std': 1.0,
+                           'dtype': 'float32'})
+    assert np.abs(out).max() <= 2.0 + 1e-5
+    x = _r(23, 3, 5)
+    out, = _run_single_op('uniform_random_batch_size_like', {'Input': x},
+                          {'Out': ['urb']},
+                          {'shape': [-1, 7], 'min': 0.0, 'max': 1.0,
+                           'dtype': 'float32'})
+    assert out.shape == (3, 7)
+    probs = np.full((4, 8), 1.0 / 8, 'float32')
+    out, = _run_single_op('sampling_id', {'X': probs}, {'Out': ['sid']},
+                          {})
+    assert np.asarray(out).shape[0] == 4
+    out, = _run_single_op('random_crop', {'X': _r(24, 2, 3, 8, 8),
+                                          'Seed': np.array([7], 'int64')},
+                          {'Out': ['rc']},
+                          {'shape': [3, 5, 5]})
+    assert np.asarray(out).shape == (2, 3, 5, 5)
+
+
+def test_misc_family():
+    x = _r(25, 4, 6)
+    out, = _run_single_op('hash', {'X': np.abs(
+        np.random.RandomState(26).randint(0, 100, (5, 1))).astype(
+        'int64')}, {'Out': ['hs']}, {'num_hash': 2, 'mod_by': 1000})
+    assert np.asarray(out).shape == (5, 2, 1)
+    assert (np.asarray(out) < 1000).all()
+    lens = np.array([2, 4, 3], 'int64')
+    out, = _run_single_op('sequence_mask', {'X': lens}, {'Y': ['sm']},
+                          {'maxlen': 5, 'out_dtype': 'float32'})
+    ref = (np.arange(5)[None] < lens[:, None]).astype('float32')
+    np.testing.assert_allclose(out, ref)
+    out, = _run_single_op('fill', {}, {'Out': ['fi']},
+                          {'shape': [2, 2], 'value': [3.5] * 4,
+                           'dtype': 'float32'})
+    np.testing.assert_allclose(out, np.full((2, 2), 3.5))
+    w = _r(27, 3, 4, 5)
+    out, = _run_single_op('bilinear_tensor_product',
+                          {'X': x[:, :4], 'Y': _r(28, 4, 5), 'Weight': w},
+                          {'Out': ['btp']}, {})
+    assert np.asarray(out).shape == (4, 3)
+
+
+def test_sampled_softmax_family():
+    x = _r(29, 6, 8)
+    lab = np.random.RandomState(30).randint(0, 20, (6, 1)).astype('int64')
+    w = _r(31, 20, 8)
+    b = np.zeros(20, 'float32')
+    outs = _run_single_op(
+        'nce', {'Input': x, 'Label': lab, 'Weight': w, 'Bias': b},
+        {'Cost': ['nce_c'], 'SampleLogits': ['nce_sl'],
+         'SampleLabels': ['nce_slb']},
+        {'num_total_classes': 20, 'num_neg_samples': 5})
+    assert np.isfinite(np.asarray(outs[0])).all()
+    wh = _r(32, 19, 8)
+    outs = _run_single_op(
+        'hierarchical_sigmoid',
+        {'X': x, 'W': wh, 'Label': lab, 'Bias': np.zeros(19, 'float32')},
+        {'Out': ['hs_o'], 'PreOut': ['hs_p']}, {'num_classes': 20})
+    assert np.isfinite(np.asarray(outs[0])).all()
+    logits = _r(33, 4, 30)
+    lab4 = np.random.RandomState(34).randint(0, 30, (4, 1)).astype(
+        'int64')
+    outs = _run_single_op(
+        'sample_logits', {'Logits': logits, 'Labels': lab4},
+        {'SampledLogits': ['slg'], 'Samples': ['slg_s'],
+         'SampledLabels': ['slb'], 'Probabilities': ['slg_p']},
+        {'num_samples': 8})
+    assert np.isfinite(np.asarray(outs[0])).all()
+
+
+def test_quant_and_optimizer_tail():
+    x = _r(35, 4, 6)
+    scale = np.array([0.0], 'float32')
+    outs = _run_single_op(
+        'fake_quantize_range_abs_max',
+        {'X': x, 'InScale': scale, 'Iter': np.array([0], 'int64'),
+         'OutScales': np.zeros(16, 'float32')},
+        {'Out': ['fq'], 'OutScale': ['fq_s'],
+         'OutScales': ['fq_ss']},
+        {'bit_length': 8, 'window_size': 16, 'is_test': False})
+    assert np.isfinite(np.asarray(outs[0])).all()
+    p = _r(36, 5)
+    g = _r(37, 5)
+    lr = np.array([0.1], 'float32')
+    out, = _run_single_op(
+        'proximal_gd', {'Param': p, 'Grad': g, 'LearningRate': lr},
+        {'ParamOut': ['pgd']}, {'l1': 0.01, 'l2': 0.01})
+    assert np.isfinite(out).all()
+    m = np.zeros(5, 'float32') + 0.1
+    outs = _run_single_op(
+        'proximal_adagrad',
+        {'Param': p, 'Moment': m, 'Grad': g, 'LearningRate': lr},
+        {'ParamOut': ['pa_p'], 'MomentOut': ['pa_m']},
+        {'l1': 0.01, 'l2': 0.01})
+    assert np.isfinite(np.asarray(outs[0])).all()
+    v = np.zeros(5, 'float32')
+    outs = _run_single_op(
+        'lars_momentum',
+        {'Param': p, 'Grad': g, 'Velocity': v, 'LearningRate': lr},
+        {'ParamOut': ['lm_p'], 'VelocityOut': ['lm_v']},
+        {'mu': 0.9, 'lars_coeff': 0.001, 'lars_weight_decay': 0.0005})
+    assert np.isfinite(np.asarray(outs[0])).all()
+
+
+def test_average_accumulates():
+    p = _r(38, 4)
+    z = np.zeros(4, 'float32')
+    c = np.zeros(1, 'int64')
+    outs = _run_single_op(
+        'average_accumulates',
+        {'param': p, 'in_sum_1': z, 'in_sum_2': z.copy(),
+         'in_sum_3': z.copy(), 'in_num_accumulates': c,
+         'in_old_num_accumulates': c.copy(),
+         'in_num_updates': c.copy()},
+        {'out_sum_1': ['aa1'], 'out_sum_2': ['aa2'],
+         'out_sum_3': ['aa3'], 'out_num_accumulates': ['aan'],
+         'out_old_num_accumulates': ['aao'],
+         'out_num_updates': ['aau']},
+        {'average_window': 10, 'max_average_window': 20,
+         'min_average_window': 5})
+    np.testing.assert_allclose(np.asarray(outs[0]), p, rtol=1e-6)
