@@ -11,3 +11,4 @@ from . import se_resnext
 from . import word2vec
 from . import transformer
 from . import bert
+from . import seq2seq
