@@ -41,15 +41,21 @@ RTOL = float(os.environ.get('OPTEST_RTOL', '2e-2'))
 ATOL = float(os.environ.get('OPTEST_ATOL', '2e-3'))
 
 
-def _load_cases(d):
+def _load_named(d, names):
     cases = []
-    for path in sorted(glob.glob(os.path.join(d, 'case_*.pkl'))):
+    for name in names:
         try:
-            with open(path, 'rb') as f:
-                cases.append((os.path.basename(path), pickle.load(f)))
+            with open(os.path.join(d, name), 'rb') as f:
+                cases.append((name, pickle.load(f)))
         except Exception as e:
-            print("skip %s: %s" % (path, e))
+            print("skip %s: %s" % (name, e))
     return cases
+
+
+def _load_cases(d):
+    return _load_named(d, sorted(
+        os.path.basename(p)
+        for p in glob.glob(os.path.join(d, 'case_*.pkl'))))
 
 
 def _build(case):
@@ -111,17 +117,6 @@ def _replayable(case):
     registered in the ORIGINAL process, and save/load ops touch the
     collect run's temp files."""
     return not (_HOST_SIDE & set(case['ops']))
-
-
-def _load_named(d, names):
-    cases = []
-    for name in names:
-        try:
-            with open(os.path.join(d, name), 'rb') as f:
-                cases.append((name, pickle.load(f)))
-        except Exception as e:
-            print("skip %s: %s" % (name, e))
-    return cases
 
 
 def _run_range(d, lo_hi):
@@ -224,7 +219,10 @@ def main():
     d = sys.argv[1] if len(sys.argv) > 1 else 'optest_cases'
     if os.environ.get('OPTEST_RANGE'):
         return _run_range(d, os.environ['OPTEST_RANGE'])
-    cases = [c for c in _load_cases(d) if _replayable(c[1])]
+    # the parent only needs names + op metadata — the heavy program/feed/
+    # state payloads are re-read by each child for its own window
+    cases = [(name, {'ops': c['ops'], 'new_ops': c['new_ops']})
+             for name, c in _load_cases(d) if _replayable(c)]
     if not cases:
         print("no cases in %r — run the collect phase first" % d)
         sys.exit(2)
@@ -235,10 +233,12 @@ def main():
     if os.environ.get('OPTEST_FRESH'):
         for part in sorted(glob.glob(os.path.join(d, 'part_*.json'))):
             os.remove(part)
+    expected_parts = []
     for lo in range(0, n, window):
         hi = min(lo + window, n)
         want = [name for name, _ in cases[lo:hi]]
         part = os.path.join(d, 'part_%05d.json' % lo)
+        expected_parts.append(part)
         if os.path.exists(part):
             # cache hit only if the part matches the CURRENT corpus slice
             # (a re-collected corpus shifts windows)
@@ -266,7 +266,17 @@ def main():
     report = {'rtol': RTOL, 'atol': ATOL, 'cases': [], 'failures': []}
     covered = set()
     done = set()
+    platforms = set()
+    # merge exactly this run's windows; anything else (older chunk sizes,
+    # shrunk corpora) is stale and removed
     for part in sorted(glob.glob(os.path.join(d, 'part_*.json'))):
+        if part not in expected_parts:
+            print("stale part %s (window layout changed) — removing"
+                  % part)
+            os.remove(part)
+    for part in expected_parts:
+        if not os.path.exists(part):
+            continue
         try:
             with open(part) as f:
                 p = json.load(f)
@@ -275,21 +285,27 @@ def main():
                   "window" % (part, e))
             os.remove(part)
             continue
-        report.setdefault('platform', p.get('platform'))
+        platforms.add(p.get('platform'))
         report.setdefault('device_kind', p.get('device_kind'))
         report['cases'] += p['cases']
         report['failures'] += p['failures']
-        covered.update(p.get('covered', []))
         done.update(r['case'] for r in p['cases'])
         done.update(r['case'] for r in p['failures'])
+        if p.get('platform') == 'tpu':
+            covered.update(p.get('covered', []))
+        else:
+            print("WARNING: part %s ran on %r — its passes do NOT count "
+                  "as TPU coverage" % (part, p.get('platform')))
     for name, case in cases:          # windows that died leave gaps
         if name not in done:
             report['failures'].append(
                 {'case': name, 'stage': 'window-crash',
                  'new_ops': case['new_ops']})
-    if report.get('platform') and report['platform'] != 'tpu':
-        print("WARNING: replay ran on %r, not TPU — this report does NOT "
-              "TPU-validate anything" % report['platform'])
+    report['platforms'] = sorted(x for x in platforms if x)
+    report['platform'] = 'tpu' if platforms == {'tpu'} else 'mixed'
+    if report['platform'] != 'tpu':
+        print("WARNING: replay windows ran on %s — only TPU windows "
+              "count toward coverage" % report['platforms'])
 
     import paddle_tpu  # noqa: F401  (registry import)
     from paddle_tpu.core.registry import all_ops
