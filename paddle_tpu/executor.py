@@ -203,6 +203,17 @@ def _fetched(arr, lod):
     return out
 
 
+class _FeedSpec(object):
+    """Shape/dtype stand-in for a staged run_fused batch — enough for
+    _feed_signature (np.shape reads .shape, _dtype reads .dtype) without
+    touching device data."""
+    __slots__ = ('shape', 'dtype')
+
+    def __init__(self, shape, dtype):
+        self.shape = shape
+        self.dtype = dtype
+
+
 class Executor(object):
     def __init__(self, place=None):
         self.place = place if place is not None else TPUPlace(0)
@@ -216,7 +227,15 @@ class Executor(object):
     def _feed_signature(self, feed, feed_lods=(), static_feed=()):
         feed_lods = dict(feed_lods) if feed_lods else {}
         static_feed = dict(static_feed) if static_feed else {}
-        sig = tuple(sorted((k, tuple(np.shape(v)), str(np.asarray(v).dtype))
+
+        def _dtype(v):
+            # metadata only — np.asarray on a device jax.Array fetches the
+            # WHOLE buffer host-side (measured 1.5 s/call on run_fused's
+            # stacked feeds; this key is computed every run)
+            dt = getattr(v, 'dtype', None)
+            return str(dt) if dt is not None else str(np.asarray(v).dtype)
+
+        sig = tuple(sorted((k, tuple(np.shape(v)), _dtype(v))
                            for k, v in feed.items()))
         lod_sig = tuple(sorted(feed_lods.items()))
         static_sig = tuple(sorted(
@@ -427,8 +446,13 @@ class Executor(object):
         if isinstance(feed_list, dict):
             stacked = dict(feed_list)
             k_steps = int(next(iter(stacked.values())).shape[0])
-            feed0 = {kk: np.asarray(v[0]) if not isinstance(v, jax.Array)
-                     else v[0] for kk, v in stacked.items()}
+            # metadata-only stand-ins for one staged batch: feed0 exists
+            # for the cache key (shape/dtype) and key-set checks; slicing
+            # the device arrays here would dispatch a per-leaf device op
+            # on every steady-state call
+            feed0 = {kk: _FeedSpec(tuple(np.shape(v))[1:],
+                                   getattr(v, 'dtype', None))
+                     for kk, v in stacked.items()}
         else:
             prepared = [self._prepare_feed(program, f or {})
                         for f in feed_list]

@@ -1,0 +1,63 @@
+"""Profile where wall time goes in one steady-state run_fused call."""
+import cProfile
+import io
+import os
+import pstats
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    import jax
+    import paddle_tpu as fluid
+    from paddle_tpu.contrib import mixed_precision as mp
+    from paddle_tpu.models.resnet import build as build_resnet
+
+    batch = 64
+    main_p, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup):
+        img, label, pred, avg_cost, acc = build_resnet('imagenet',
+                                                       depth=50)
+        opt = mp.decorate(
+            fluid.optimizer.Momentum(learning_rate=0.1, momentum=0.9),
+            keep_bf16_activations=True)
+        opt.minimize(avg_cost)
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    stacked = {'img': jax.device_put(np.stack(
+        [rng.randn(batch, 3, 224, 224).astype('float32')
+         for _ in range(4)])),
+        'label': jax.device_put(np.stack(
+            [rng.randint(0, 1000, (batch, 1)).astype('int64')
+             for _ in range(4)]))}
+    jax.block_until_ready(stacked)
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        for steps in (1, 1, 24):
+            exe.run_fused(main_p, stacked, fetch_list=[avg_cost],
+                          scope=scope, return_numpy=True, steps=steps)
+        # timed single calls at steps=1: the per-call floor
+        for trial in range(4):
+            t0 = time.time()
+            out = exe.run_fused(main_p, stacked, fetch_list=[avg_cost],
+                                scope=scope, return_numpy=False, steps=1)
+            float(np.asarray(out[0]).reshape(-1)[0])
+            print("steps=1 call: %.3fs" % (time.time() - t0), flush=True)
+        pr = cProfile.Profile()
+        pr.enable()
+        out = exe.run_fused(main_p, stacked, fetch_list=[avg_cost],
+                            scope=scope, return_numpy=False, steps=1)
+        float(np.asarray(out[0]).reshape(-1)[0])
+        pr.disable()
+        s = io.StringIO()
+        pstats.Stats(pr, stream=s).sort_stats('cumulative').print_stats(18)
+        print(s.getvalue())
+
+
+if __name__ == '__main__':
+    main()

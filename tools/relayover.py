@@ -1,0 +1,67 @@
+"""Isolate the run_fused residual per-call overhead through the relay:
+is it input leaves, output leaves, bytes, or the fetch?"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def timeit(fn, fetch, n=6):
+    best = float('inf')
+    for _ in range(n):
+        t0 = time.time()
+        out = fn()
+        fetch(out)
+        best = min(best, time.time() - t0)
+    return round(best, 4)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    rng = np.random.RandomState(0)
+
+    results = {}
+
+    # baseline: scalar -> scalar
+    s = jax.device_put(jnp.float32(1.0))
+    f0 = jax.jit(lambda x: x + 1)
+    float(f0(s))
+    results['scalar'] = timeit(lambda: f0(s), lambda o: float(o))
+
+    for leaves, mb_per in (
+            (500, 1), (500, 0), (50, 10), (50, 0), (5, 100)):
+        d = {('v%d' % i): jax.device_put(jnp.asarray(
+            rng.randn(max(1, mb_per * 262144)).astype('float32')))
+            for i in range(leaves)}
+        jax.block_until_ready(d)
+
+        fid = jax.jit(lambda dd: jax.tree_util.tree_map(
+            lambda x: x, dd))
+        out = fid(d)
+        jax.block_until_ready(out)
+        results['alias_%dx%dMB' % (leaves, mb_per)] = timeit(
+            lambda: fid(d), lambda o: float(o['v0'][0]))
+
+        fadd = jax.jit(lambda dd: jax.tree_util.tree_map(
+            lambda x: x + 1.0, dd))
+        out = fadd(d)
+        jax.block_until_ready(out)
+        results['add_%dx%dMB' % (leaves, mb_per)] = timeit(
+            lambda: fadd(d), lambda o: float(o['v0'][0]))
+
+        fscalar = jax.jit(lambda dd: sum(
+            x[0] for x in jax.tree_util.tree_leaves(dd)))
+        float(fscalar(d))
+        results['toscalar_%dx%dMB' % (leaves, mb_per)] = timeit(
+            lambda: fscalar(d), lambda o: float(o))
+        del d
+    print(json.dumps(results))
+
+
+if __name__ == '__main__':
+    main()
