@@ -439,6 +439,50 @@ def test_quant_and_optimizer_tail():
     assert np.isfinite(np.asarray(outs[0])).all()
 
 
+def test_lod_array_glue_roundtrip():
+    """lod_rank_table -> lod_tensor_to_array -> array_to_lod_tensor must
+    reproduce the ragged input (and its LoD); max_sequence_len and
+    reorder_lod_tensor_by_rank derive from the same table (reference
+    lod_rank_table_op.cc + lod_tensor_to_array_op.cc family)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='lx', shape=[3], dtype='float32',
+                              lod_level=1, append_batch_size=False)
+        table = fluid.layers.lod_rank_table(x)
+        arr = fluid.layers.lod_tensor_to_array(x, table)
+        back = fluid.layers.array_to_lod_tensor(arr, table)
+        mx = fluid.layers.max_sequence_len(table)
+        reord = fluid.layers.reorder_lod_tensor_by_rank(x, table)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    xv = np.arange(21, dtype='float32').reshape(7, 3)
+    lod = [[0, 2, 7]]
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        b, m, r = exe.run(main, feed={'lx': (xv, lod)},
+                          fetch_list=[back, mx, reord], scope=scope)
+    np.testing.assert_allclose(np.asarray(b), xv)
+    assert b.lod() == [[0, 2, 7]]
+    assert int(np.asarray(m).reshape(-1)[0]) == 5
+    # rank order: seq1 (len 5) first, then seq0 (len 2)
+    np.testing.assert_allclose(np.asarray(r)[:5], xv[2:7])
+    np.testing.assert_allclose(np.asarray(r)[5:], xv[:2])
+
+
+def test_is_empty_and_prelu_simple():
+    out, = _run_single_op('is_empty', {'X': np.zeros((0, 3), 'float32')},
+                          {'Out': ['ie']}, {})
+    assert bool(np.asarray(out).reshape(-1)[0])
+    out, = _run_single_op('is_empty', {'X': np.zeros((2, 3), 'float32')},
+                          {'Out': ['ie2']}, {})
+    assert not bool(np.asarray(out).reshape(-1)[0])
+    x = _r(40, 3, 4)
+    out, = _run_single_op('prelu_simple', {'X': x}, {'Out': ['ps']},
+                          {'alpha': 0.1})
+    np.testing.assert_allclose(out, np.where(x >= 0, x, 0.1 * x),
+                               rtol=1e-6)
+
+
 def test_average_accumulates():
     p = _r(38, 4)
     z = np.zeros(4, 'float32')
