@@ -19,10 +19,13 @@ Measurement contract (round-3 redesign):
   timed window and its standalone cost reported as sync_ms.
 - a JSON line is ALWAYS emitted: the measurement runs in a child process
   with a timeout; TPU failure falls back to a labeled CPU run.
-- rows measure THROUGHPUT on synthetic data; some tasks saturate to ~0
-  loss within the window (stacked_lstm, ctr memorize their staged
-  batches). Training-dynamics evidence lives in BASELINE.md's 2000-step
-  convergence run, not here.
+- every row must end the window at a NON-DEGENERATE loss (VERDICT r4
+  weak #3): labels come from a fixed random TEACHER function of the
+  inputs (learnable structure, not memorizable noise), sequence/CTR rows
+  stage one DISTINCT batch per step (no repeats to memorize), and image
+  rows use a low enough LR that 4 staged batches don't saturate within
+  the window. Long-run convergence evidence lives in BASELINE.md
+  (2000-step LM + the round-5 conv/CTR appendix).
 """
 import glob
 import json
@@ -152,17 +155,39 @@ def _bench_image_model(build_fn, label_str, batch, k_per_call, rounds,
     main_p, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main_p, startup):
         img, label, pred, avg_cost, acc = build_fn()
-        opt = fluid.optimizer.Momentum(learning_rate=0.1, momentum=0.9)
+        # lr 0.02 (not the reference harness's 0.1): with 4 staged
+        # batches a 240-step window at 0.1 memorizes to ~0 loss, which
+        # proves nothing about training dynamics
+        opt = fluid.optimizer.Momentum(learning_rate=0.02, momentum=0.9)
         if amp:
             opt = mp.decorate(opt, keep_bf16_activations=True)
         opt.minimize(avg_cost)
     exe = fluid.Executor(fluid.TPUPlace(0))
     scope = fluid.Scope()
     rng = np.random.RandomState(0)
-    batches = [{'img': rng.randn(batch, *img_shape).astype('float32'),
-                'label': rng.randint(0, n_class,
-                                     (batch, 1)).astype('int64')}
-               for _ in range(k_per_call)]
+    # teacher labels: class = argmax of a fixed random projection of the
+    # 8x8-downsampled image — learnable structure rather than pure noise
+    c, h, w = img_shape
+    pool = (h % 8 == 0 and w % 8 == 0)   # exact 8x8 pooling when possible
+
+    def _features(imgs):
+        if pool:
+            imgs = imgs.reshape(imgs.shape[0], c, 8, h // 8, 8, w // 8) \
+                .mean(axis=(3, 5))
+        return imgs.reshape(imgs.shape[0], -1)
+
+    feat_dim = _features(np.zeros((1,) + tuple(img_shape),
+                                  'float32')).shape[1]
+    teacher = rng.randn(feat_dim, n_class).astype('float32')
+
+    def _teacher_label(imgs):
+        return np.argmax(_features(imgs) @ teacher, 1) \
+            .astype('int64').reshape(-1, 1)
+
+    batches = []
+    for _ in range(k_per_call):
+        imgs = rng.randn(batch, *img_shape).astype('float32')
+        batches.append({'img': imgs, 'label': _teacher_label(imgs)})
     with fluid.scope_guard(scope):
         exe.run(startup, scope=scope)
         sec_step, loss, compile_s = _measure_steps(
@@ -260,15 +285,21 @@ def _bench_stacked_lstm(batch, seq_len, k_per_call, rounds):
     rng = np.random.RandomState(0)
     lod = [list(range(0, (batch + 1) * seq_len, seq_len))]
     total = batch * seq_len
-    batches = [{'words': (rng.randint(0, vocab,
-                                      (total, 1)).astype('int64'), lod),
-                'label': rng.randint(0, 2, (batch, 1)).astype('int64')}
-               for _ in range(k_per_call)]
+    # one distinct batch per step; sentiment teacher = sign of the mean
+    # of fixed per-token scores (the LSTM-pool-able structure)
+    n_steps = max(30, k_per_call)
+    tok_score = rng.randn(vocab).astype('float32')
+    batches = []
+    for _ in range(n_steps):
+        words = rng.randint(0, vocab, (total, 1)).astype('int64')
+        sent = (tok_score[words.reshape(batch, seq_len)].mean(1) > 0)
+        batches.append({'words': (words, lod),
+                        'label': sent.astype('int64').reshape(-1, 1)})
     with fluid.scope_guard(scope):
         exe.run(startup, scope=scope)
         sec_step, lossv, compile_s = _measure_steps(
-            exe, main_p, scope, batches, loss, k_per_call, rounds,
-            steps=max(30, k_per_call))
+            exe, main_p, scope, batches, loss, n_steps, rounds,
+            steps=n_steps)
     return {
         'samples_per_sec': round(batch / sec_step, 1),
         'step_ms': round(sec_step * 1000, 2),
@@ -402,15 +433,22 @@ def _bench_ctr(batch, k_per_call, rounds, vocab=100000, dim=16,
     exe = fluid.Executor(fluid.TPUPlace(0))
     scope = fluid.Scope()
     rng = np.random.RandomState(0)
-    batches = [{'ids': rng.randint(0, vocab,
-                                   (batch, slots)).astype('int64'),
-                'label': rng.randint(0, 2, (batch, 1)).astype('float32')}
-               for _ in range(k_per_call)]
+    # one DISTINCT batch per step (ids are tiny; nothing repeats, so the
+    # window measures online learning, not memorization) with teacher
+    # labels: click iff the ids' fixed random scores sum positive —
+    # exactly the per-id structure the embedding model can learn
+    n_steps = max(150, k_per_call)
+    id_score = rng.randn(vocab).astype('float32')
+    batches = []
+    for _ in range(n_steps):
+        ids = rng.randint(0, vocab, (batch, slots)).astype('int64')
+        lbl = (id_score[ids].sum(1) > 0).astype('float32').reshape(-1, 1)
+        batches.append({'ids': ids, 'label': lbl})
     with fluid.scope_guard(scope):
         exe.run(startup, scope=scope)
         sec_step, loss, compile_s = _measure_steps(
-            exe, main_p, scope, batches, loss, k_per_call, rounds,
-            steps=max(150, k_per_call))
+            exe, main_p, scope, batches, loss, n_steps, rounds,
+            steps=n_steps)
     return {
         'samples_per_sec': round(batch / sec_step, 1),
         'step_ms': round(sec_step * 1000, 2),
@@ -418,6 +456,99 @@ def _bench_ctr(batch, k_per_call, rounds, vocab=100000, dim=16,
         'final_loss': round(loss, 4),
         'config': 'ctr v%d s%d d%d b%d' % (vocab, slots, dim, batch),
     }
+
+
+def _bench_inference(rounds=9):
+    """Predictor (deploy-path) latency: save_inference_model ->
+    load_inference_model -> Predictor.run at batch 1 and 128, p50 ms per
+    call (the reference inference/tests/api/analyzer_resnet50_tester.cc /
+    analyzer_bert_tester pattern). The per-call number includes the
+    ~0.15 s relay round-trip this chip sits behind, so a device-resident
+    `machine_ms` is also reported: K forwards scanned in ONE compiled
+    call on the predictor's own pruned program (what an on-device serving
+    loop would see)."""
+    import shutil
+    import tempfile
+    import numpy as np
+    import paddle_tpu as fluid
+
+    out = {}
+
+    def _row(name, build_prog, make_feed, fetch_pick):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            feeds, targets = build_prog(main)
+        exe = fluid.Executor(fluid.TPUPlace(0))
+        scope = fluid.Scope()
+        d = tempfile.mkdtemp(prefix='bench_infer_')
+        try:
+            with fluid.scope_guard(scope):
+                exe.run(startup, scope=scope)
+                fluid.io.save_inference_model(d, feeds, targets, exe,
+                                              main_program=main)
+            pred = fluid.create_predictor(d)
+            row = {}
+            for b in (1, 128):
+                feed = make_feed(b)
+                pred.run(feed)                       # compile
+                times = []
+                for _ in range(rounds):
+                    t0 = time.time()
+                    pred.run(feed)
+                    times.append((time.time() - t0) * 1000)
+                times.sort()
+                row['p50_ms_b%d' % b] = round(times[len(times) // 2], 2)
+                # device-resident serving rate: K forwards, one call
+                k = 32 if b == 1 else 8
+                import jax
+                stacked = {kk: jax.device_put(
+                    np.stack([np.asarray(v)] * k))
+                    for kk, v in feed.items()}
+                with fluid.scope_guard(pred.scope):
+                    pred.executor.run_fused(
+                        pred.program, stacked,
+                        fetch_list=pred.fetch_vars, steps=k)   # compile
+                    t0 = time.time()
+                    pred.executor.run_fused(
+                        pred.program, stacked,
+                        fetch_list=pred.fetch_vars, steps=k)
+                    dt = time.time() - t0
+                row['machine_ms_b%d' % b] = round(dt * 1000 / k, 2)
+            out[name] = row
+        finally:
+            shutil.rmtree(d, ignore_errors=True)
+
+    rng = np.random.RandomState(0)
+
+    def _resnet_prog(main):
+        from paddle_tpu.models.resnet import build as build_resnet
+        img, label, pred_v, avg_cost, acc = build_resnet('imagenet',
+                                                         depth=50)
+        return ['img'], [pred_v]
+
+    def _resnet_feed(b):
+        return {'img': rng.randn(b, 3, 224, 224).astype('float32')}
+
+    from paddle_tpu.models.bert import (BertConfig, build_bert_pretrain,
+                                        make_pretrain_batch)
+    bcfg = BertConfig(seq_len=128, max_predictions=20)
+
+    def _bert_prog(main):
+        total, mlm, nsp = build_bert_pretrain(bcfg, is_test=True)
+        return ['tokens', 'segments', 'input_mask', 'mlm_positions',
+                'mlm_labels', 'nsp_labels'], [total]
+
+    def _bert_feed(b):
+        return make_pretrain_batch(bcfg, b, rng)
+
+    for name, fns in (('resnet50_infer', (_resnet_prog, _resnet_feed)),
+                      ('bert_infer', (_bert_prog, _bert_feed))):
+        try:
+            _row(name, fns[0], fns[1], None)
+        except Exception as e:
+            out[name] = {'error': '%s: %s' % (type(e).__name__,
+                                              str(e)[:200])}
+    return out
 
 
 def _child(mode):
@@ -506,6 +637,7 @@ def _child(mode):
              vocab=1 << 20, dim=32, is_distributed=True)
         _try('stacked_lstm', _bench_stacked_lstm, 32, 128, 10, 2)
         _try('ctr_sparse', _bench_ctr, 512, 50, 3)
+        _try('inference', _bench_inference)
     for r in models.values():
         r.pop('flops_per_step', None)
     flag.pop('flops_per_step', None)

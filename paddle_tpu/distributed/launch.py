@@ -29,12 +29,24 @@ import sys
 __all__ = ['launch_procs', 'init_from_env', 'main']
 
 
+def _free_ports(n, ip='127.0.0.1'):
+    """Allocate n distinct free ports: every probe socket stays bound until
+    all n are claimed, so two callers in one launch can't be handed the
+    same port (the close-then-reprobe race)."""
+    socks = []
+    try:
+        for _ in range(n):
+            s = socket.socket()
+            s.bind((ip, 0))
+            socks.append(s)
+        return [s.getsockname()[1] for s in socks]
+    finally:
+        for s in socks:
+            s.close()
+
+
 def _free_port(ip='127.0.0.1'):
-    s = socket.socket()
-    s.bind((ip, 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+    return _free_ports(1, ip)[0]
 
 
 def launch_procs(entrypoint, entrypoint_args=(), nproc_per_node=1,
@@ -56,12 +68,17 @@ def launch_procs(entrypoint, entrypoint_args=(), nproc_per_node=1,
     # 6170+i) is used on all nodes including node 0 — free-port probing is
     # only safe single-node, where no other launcher needs to agree.
     endpoints = []
-    for ip in node_ips:
-        for i in range(nproc_per_node):
-            port = _free_port(ip) if nnodes == 1 else 6170 + i
-            endpoints.append('%s:%d' % (ip, port))
-    coordinator = '%s:%d' % (
-        node_ips[0], _free_port(node_ips[0]) if nnodes == 1 else 6269)
+    if nnodes == 1:
+        # all ports drawn from one held-socket batch (probe race: closing
+        # a probe then reprobing can hand two workers the same port)
+        ports = _free_ports(nproc_per_node + 1, node_ips[0])
+        endpoints = ['%s:%d' % (node_ips[0], p) for p in ports[:-1]]
+        coordinator = '%s:%d' % (node_ips[0], ports[-1])
+    else:
+        for ip in node_ips:
+            for i in range(nproc_per_node):
+                endpoints.append('%s:%d' % (ip, 6170 + i))
+        coordinator = '%s:%d' % (node_ips[0], 6269)
 
     if log_dir:
         os.makedirs(log_dir, exist_ok=True)

@@ -148,6 +148,35 @@ def _next_program_run(program):
     return n
 
 
+# Ops whose lowering calls back into the host (pure_callback / io_callback /
+# debug.print). Backends without host-callback support (the axon PJRT relay
+# rejects send/recv callbacks at run time) execute programs containing them
+# in SEGMENTS: compiled device segments split at each host op, with the host
+# op run eagerly on CPU between them and only the crossing vars transferred
+# — the TPU-native analog of the reference's per-op kernel fallback +
+# cross-place PrepareData (framework/operator.cc:930,1003), done at program
+# granularity because XLA compiles whole programs, not single ops.
+_HOST_SEGMENT_OPS = ('py_func', 'print', 'detection_map', 'save',
+                     'save_combine')
+
+_cb_supported = [None]
+
+
+def _callbacks_supported():
+    """Probe (once) whether the default backend can run host callbacks
+    inside compiled programs; backend NAME is not enough — the axon relay
+    reports 'tpu' yet rejects send/recv callbacks at run time."""
+    if _cb_supported[0] is None:
+        try:
+            out = jax.jit(lambda: jax.pure_callback(
+                lambda: np.int32(1),
+                jax.ShapeDtypeStruct((), jnp.int32)))()
+            _cb_supported[0] = int(out) == 1
+        except Exception:
+            _cb_supported[0] = False
+    return _cb_supported[0]
+
+
 _global_scope = Scope()
 _scope_stack = [_global_scope]
 
@@ -171,7 +200,7 @@ class _CompiledEntry(object):
     # holds a strong ref to the program so id(program) cache keys can never
     # alias a garbage-collected program's address
     __slots__ = ('fn', 'fetch_names', 'ro_names', 'rw_names', 'written',
-                 'program', 'lod_out')
+                 'program', 'lod_out', 'notify_dirs')
 
     def __init__(self, fn, fetch_names, ro_names, rw_names, written,
                  program, lod_out=None):
@@ -182,6 +211,12 @@ class _CompiledEntry(object):
         self.written = written
         self.program = program
         self.lod_out = lod_out if lod_out is not None else {}
+        # checkpoint_notify dirs, precomputed once per compile so the hot
+        # run path doesn't rescan the op list every call
+        self.notify_dirs = [
+            op.attr('dir', '') or 'checkpoint_notify'
+            for op in program.global_block().ops
+            if op.type == 'checkpoint_notify']
 
 
 class FetchedTensor(np.ndarray):
@@ -210,6 +245,13 @@ class _FeedSpec(object):
     __slots__ = ('shape', 'dtype')
 
     def __init__(self, shape, dtype):
+        if dtype is None:
+            # the pre-stacked dict path documents arrays only; falling
+            # through would put dtype('O') in the compile-cache key
+            raise TypeError(
+                "run_fused pre-stacked feeds must be arrays with a .dtype "
+                "(np.ndarray or jax.Array); got a value of shape %r without "
+                "one — np.stack plain lists before staging" % (shape,))
         self.shape = shape
         self.dtype = dtype
 
@@ -332,6 +374,32 @@ class Executor(object):
         static_lods = dict(scope_lods)
         static_lods.update(feed_lods)
 
+        seg_mode = os.environ.get('PADDLE_SEGMENT_HOST_OPS', 'auto')
+        if seg_mode != '0':
+            # memoized per program version: the common (host-op-free)
+            # training step must not rescan the op list every call
+            cached = getattr(program, '_host_split_cache', None)
+            if cached is None or cached[0] != program._version:
+                main_ops = program.global_block().ops
+                host_pos = [i for i, op in enumerate(main_ops)
+                            if op.type in _HOST_SEGMENT_OPS]
+                bwd_pos = [i for i, op in enumerate(main_ops)
+                           if op.type == 'backward']
+                # a host op inside a differentiated forward span cannot
+                # be split out (it would cut the jax.vjp closure) — those
+                # keep the callback path (py_func backward_func is itself
+                # a callback, so such programs need callback support
+                # anyway)
+                splittable = bool(host_pos) and (
+                    not bwd_pos or min(host_pos) > max(bwd_pos))
+                cached = (program._version, splittable)
+                program._host_split_cache = cached
+            if cached[1] and (seg_mode == '1'
+                              or not _callbacks_supported()):
+                return self._run_segmented(
+                    program, feed, fetch_names, scope, return_numpy,
+                    static_lods, static_feed)
+
         key = (program._uid, program._version,
                self._feed_signature(feed, static_lods, static_feed),
                tuple(fetch_names))
@@ -379,12 +447,10 @@ class Executor(object):
         # checkpoint_notify (ops/dist_ops.py): the reference RPCs the
         # checkpoint dir to pservers each execution; here the executor is
         # the checkpoint writer, so save persistables after the run
-        for cn_op in program.global_block().ops:
-            if cn_op.type == 'checkpoint_notify':
-                cn_dir = cn_op.attr('dir', '') or 'checkpoint_notify'
-                from .io import save_persistables
-                with scope_guard(scope):
-                    save_persistables(self, cn_dir, main_program=program)
+        for cn_dir in entry.notify_dirs:
+            from .io import save_persistables
+            with scope_guard(scope):
+                save_persistables(self, cn_dir, main_program=program)
         # propagate LoD of written persistables into the scope, and of
         # fetches into the returned tensors
         for n in entry.written:
@@ -410,6 +476,169 @@ class Executor(object):
             _fetched(f, entry.lod_out[n]) if entry.lod_out.get(n) else f
             for n, f in zip(entry.fetch_names, fetches)
         ]
+
+    # ------------------------------------------------------------------
+    def _segment_plan(self, program, fetch_names):
+        """Split the main block at host-callback ops into parts
+        [('dev', lo, hi) | ('host', i, i+1)]; for each part precompute its
+        sub-program (a clone with the op slice), the values it consumes
+        from earlier parts/feeds, and the crossing vars it must fetch."""
+        ops = program.global_block().ops
+        parts = []
+        lo = 0
+        for i, op in enumerate(ops):
+            if op.type in _HOST_SEGMENT_OPS:
+                if i > lo:
+                    parts.append(('dev', lo, i))
+                parts.append(('host', i, i + 1))
+                lo = i + 1
+        if lo < len(ops):
+            parts.append(('dev', lo, len(ops)))
+
+        def _reads(part_ops):
+            """Names read by the ops (incl. nested control-flow blocks,
+            whose bodies read parent vars not listed on the parent op)."""
+            acc = set()
+            produced = set()
+
+            from .framework import SUB_BLOCK_ATTRS
+
+            def _walk(op_list):
+                for op in op_list:
+                    acc.update(n for n in op.input_arg_names
+                               if n not in produced)
+                    for a in SUB_BLOCK_ATTRS:
+                        idx = getattr(op, 'attrs', {}).get(a)
+                        if idx is not None:
+                            _walk(program.block(int(idx)).ops)
+                    produced.update(op.output_arg_names)
+            _walk(part_ops)
+            return acc
+
+        plan = []
+        for k, (kind, plo, phi) in enumerate(parts):
+            sub = program.clone()
+            sub.global_block().ops = sub.global_block().ops[plo:phi]
+            ins = _reads(ops[plo:phi])
+            later_ins = set()
+            for _, qlo, qhi in parts[k + 1:]:
+                later_ins |= _reads(ops[qlo:qhi])
+            produced = set()
+            for op in ops[plo:phi]:
+                produced.update(op.output_arg_names)
+            gb = program.global_block()
+            crossing = sorted(
+                n for n in produced
+                if (n in later_ins or n in fetch_names)
+                and not (gb._find_var_recursive(n) is not None
+                         and gb._find_var_recursive(n).persistable))
+            plan.append({'kind': kind, 'sub': sub, 'ins': ins,
+                         'crossing': crossing})
+        return plan
+
+    def _run_segmented(self, program, feed, fetch_names, scope,
+                       return_numpy, static_lods, static_feed):
+        """Heterogeneous execution for backends without host callbacks: see
+        _HOST_SEGMENT_OPS. Device segments are compiled and cached like
+        normal runs; host ops run eagerly on the CPU backend with only the
+        crossing vars transferred."""
+        key = ('hostseg', program._uid, program._version,
+               self._feed_signature(feed, static_lods, static_feed),
+               tuple(fetch_names))
+        plan = self._cache.get(key)
+        if plan is None:
+            plan = self._segment_plan(program, fetch_names)
+            self._cache[key] = plan
+
+        self._run_counter += 1
+        key_arr = _run_key(program.random_seed, _next_program_run(program),
+                           self._run_counter)
+        val_env = dict(feed)
+        lod_env = dict(static_lods)
+        for seg in plan:
+            sub = seg['sub']
+            seg_feed = {n: v for n, v in val_env.items() if n in seg['ins']}
+            seg_fetch = list(seg['crossing'])
+            entry = seg.get('entry')
+            if entry is None:
+                read, written = lowering.analyze_state(sub, seg_fetch)
+                needed = self._read_before_write(
+                    sub, read, written, set(seg_feed), seg_fetch)
+                lod_out = {}
+                if seg['kind'] == 'dev':
+                    fn, ro_names, rw_names = lowering.build_callable(
+                        sub, seg_fetch, needed, written,
+                        static_lods=lod_env, static_feed=static_feed,
+                        lod_out=lod_out)
+                else:
+                    fn, ro_names, rw_names = lowering.build_fn(
+                        sub, seg_fetch, needed, written,
+                        static_lods=lod_env, static_feed=static_feed,
+                        lod_out=lod_out,
+                        lower_params={'host_eager': True})
+                entry = _CompiledEntry(fn, seg_fetch, ro_names, rw_names,
+                                       written, sub, lod_out)
+                seg['entry'] = entry
+            ro = {n: self._state_value(scope, n, program)
+                  for n in entry.ro_names}
+            rw = {n: self._state_value(scope, n, program)
+                  for n in entry.rw_names}
+            if seg['kind'] == 'host':
+                # transfer only the crossing vars; run the op eagerly —
+                # callbacks execute immediately (host-side) outside of jit.
+                # Prefer pinning the tiny surrounding math to the CPU
+                # backend; under the axon relay 'cpu' is not registered at
+                # all, so fall back to plain eager (the callback itself
+                # still runs on host either way)
+                import contextlib
+                seg_feed = {n: np.asarray(v) for n, v in seg_feed.items()}
+                ro = {n: np.asarray(v) for n, v in ro.items()}
+                rw = {n: np.asarray(v) for n, v in rw.items()}
+                try:
+                    guard = jax.default_device(
+                        jax.local_devices(backend='cpu')[0])
+                except Exception:
+                    guard = contextlib.nullcontext()
+                with guard:
+                    fetches, new_state = entry.fn(seg_feed, ro, rw, key_arr)
+            else:
+                fetches, new_state = entry.fn(seg_feed, ro, rw, key_arr)
+            from . import flags as _flags
+            if _flags.get_flags('check_nan_inf'):
+                _check_nan_inf(new_state,
+                               dict(zip(entry.fetch_names, fetches)))
+            scope.update(new_state)
+            val_env.update(zip(entry.fetch_names, fetches))
+            lod_env.update(entry.lod_out)
+            # written-persistable LoD lands in the scope exactly as in
+            # run(): set when the segment produced one, cleared otherwise
+            for n in entry.written:
+                lod = entry.lod_out.get(n)
+                if lod:
+                    scope._lods[n] = lod
+                else:
+                    scope._lods.pop(n, None)
+
+        from .io import save_persistables
+        for seg in plan:
+            for cn_dir in seg['entry'].notify_dirs:
+                with scope_guard(scope):
+                    save_persistables(self, cn_dir, main_program=program)
+
+        from .core.selected_rows import SelectedRows
+        out = []
+        for n in fetch_names:
+            if n in val_env:
+                v = val_env[n]
+            else:
+                v = self._state_value(scope, n, program)
+            if isinstance(v, SelectedRows):
+                v = v.to_dense()
+            lod = lod_env.get(n)
+            if return_numpy or lod:
+                v = _fetched(v, lod) if lod else np.asarray(v)
+            out.append(v)
+        return out
 
     # ------------------------------------------------------------------
     def run_fused(self, program=None, feed_list=None, fetch_list=None,
@@ -553,12 +782,10 @@ class Executor(object):
         fetches, new_state = entry.fn(stacked, ro_state, rw_state, key_arr)
         scope.update(new_state)
         # checkpoint_notify: same host-side save contract as run()
-        for cn_op in program.global_block().ops:
-            if cn_op.type == 'checkpoint_notify':
-                cn_dir = cn_op.attr('dir', '') or 'checkpoint_notify'
-                from .io import save_persistables
-                with scope_guard(scope):
-                    save_persistables(self, cn_dir, main_program=program)
+        for cn_dir in entry.notify_dirs:
+            from .io import save_persistables
+            with scope_guard(scope):
+                save_persistables(self, cn_dir, main_program=program)
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return list(fetches)

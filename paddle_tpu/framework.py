@@ -174,6 +174,12 @@ class Parameter(Variable):
 # Operator
 # ---------------------------------------------------------------------------
 
+# attr names under which control-flow ops reference their body blocks
+# (while/recurrent: sub_block; conditional_block/IfElse: the true/false
+# pair). Every structural walk over nested blocks must use this one list.
+SUB_BLOCK_ATTRS = ('sub_block', 'sub_block_true', 'sub_block_false')
+
+
 class Operator(object):
     """One op in a block: type + named input/output var-name lists + attrs.
 

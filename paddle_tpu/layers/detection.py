@@ -581,11 +581,30 @@ def detection_map(detect_res, label, class_num, background_label=0,
                   overlap_threshold=0.3, evaluate_difficult=True,
                   has_state=None, input_states=None, out_states=None,
                   ap_version='integral'):
-    """Detection mAP (reference operators/metrics (detection_map_op.cc) via
-    layers/detection.py detection_map). Computed host-side by
-    metrics.DetectionMAP over fetched detections — the streaming-state op
-    form is not jit-compilable (ragged inputs); use the metric class."""
-    raise NotImplementedError(
-        "detection_map: use paddle_tpu.metrics.DetectionMAP on fetched "
-        "detection results (host-side metric, reference fluid/metrics.py "
-        "DetectionMAP)")
+    """Detection mAP (reference operators/detection_map_op.cc via
+    layers/detection.py detection_map). A host metric: the op runs on the
+    CPU step of the executor's segmented heterogeneous path (executor.py
+    _run_segmented), so it composes with device programs even on backends
+    without host-callback support. Cross-batch accumulation states are
+    owned by metrics.DetectionMAP (the streaming-state op form is not
+    jit-compilable over ragged inputs); passing input_states here raises
+    in the op lowering (ops/fused_ops.py detection_map)."""
+    helper = LayerHelper('detection_map')
+    out = helper.create_variable_for_type_inference(dtype='float32')
+    pos_count = helper.create_variable_for_type_inference(dtype='int32')
+    true_pos = helper.create_variable_for_type_inference(dtype='float32')
+    false_pos = helper.create_variable_for_type_inference(dtype='float32')
+    inputs = {'DetectRes': [detect_res], 'Label': [label]}
+    if input_states is not None:
+        inputs.update({'PosCount': [input_states[0]],
+                       'TruePos': [input_states[1]],
+                       'FalsePos': [input_states[2]]})
+    helper.append_op(
+        type='detection_map', inputs=inputs,
+        outputs={'MAP': [out], 'AccumPosCount': [pos_count],
+                 'AccumTruePos': [true_pos], 'AccumFalsePos': [false_pos]},
+        attrs={'overlap_threshold': overlap_threshold,
+               'evaluate_difficult': evaluate_difficult,
+               'ap_type': ap_version, 'class_num': class_num})
+    out.stop_gradient = True
+    return out

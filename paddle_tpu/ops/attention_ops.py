@@ -346,8 +346,12 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, scale, causal, interpret,
 # custom_vjp wrapper ([BH, L, dh] level)
 # --------------------------------------------------------------------------
 
-_DEF_BQ = 512
-_DEF_BK = 512
+# default tile sizes; the round-3 sweep measured 512x512 optimal at
+# d_head 64 (256/128 tiles 1.5-2.5x slower). Env-overridable so perf
+# sweeps (tools/mfuexp.py) can re-measure without editing source.
+import os as _os
+_DEF_BQ = int(_os.environ.get('PADDLE_FLASH_BQ', '512'))
+_DEF_BK = int(_os.environ.get('PADDLE_FLASH_BK', '512'))
 
 
 def _fwd_impl(q, k, v, scale, causal, impl):

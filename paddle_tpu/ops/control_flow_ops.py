@@ -95,10 +95,11 @@ def _written_names(program, block, acc=None):
     """All var names any op in `block` (or nested sub-blocks) writes."""
     if acc is None:
         acc = set()
+    from ..framework import SUB_BLOCK_ATTRS
     for op in block.ops:
         for n in op.output_arg_names:
             acc.add(n)
-        for a in ('sub_block', 'sub_block_true', 'sub_block_false'):
+        for a in SUB_BLOCK_ATTRS:
             try:
                 idx = op.attr(a)
             except Exception:

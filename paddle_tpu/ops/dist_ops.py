@@ -73,7 +73,7 @@ def table_sharding_constraint(w):
 # split_ids / merge_ids
 # ---------------------------------------------------------------------------
 
-@register_op('split_ids')
+@register_op('split_ids', share_lod=False)
 def _split_ids(ctx, op):
     """Partition ids by owner shard: out[k] holds the ids with id %% N == k.
 
@@ -81,6 +81,12 @@ def _split_ids(ctx, op):
     input's length (capacity); slots whose id belongs to another shard carry
     the sentinel -1, and the original position is preserved. merge_ids
     understands this layout and round-trips exactly.
+
+    Id-range limit: with JAX x64 disabled (this framework's default),
+    jnp.int64 silently narrows to int32, so ids must fit in [0, 2^31) —
+    merge_ids/lookup below cast to int32 anyway. Vocabularies beyond 2^31
+    rows need jax_enable_x64; the sharded-embedding path (tensor_ops
+    lookup_table is_distributed) has the same contract.
     """
     ids = ctx.in1(op, 'Ids')
     flat = ids.reshape(-1).astype(jnp.int64) \

@@ -315,7 +315,7 @@ def _fusion_squared_mat_sub(ctx, op):
     ctx.out(op, 'Out', out)
 
 
-@register_op('fusion_transpose_flatten_concat')
+@register_op('fusion_transpose_flatten_concat', share_lod=False)
 def _fusion_transpose_flatten_concat(ctx, op):
     """reference fused/fusion_transpose_flatten_concat_op.cc: per input
     transpose(trans_axis) -> flatten(flatten_axis) -> concat(concat_axis)."""
@@ -348,7 +348,10 @@ def _save_cb(path, overwrite):
     return cb
 
 
-def _io_callback(cb, args):
+def _io_callback(cb, args, host_eager=False):
+    if host_eager:
+        # executor host segment: values are concrete, write directly
+        return cb(*[np.asarray(a) for a in args])
     try:
         return jax.experimental.io_callback(
             cb, jax.ShapeDtypeStruct((), jnp.int32), *args, ordered=True)
@@ -364,7 +367,8 @@ def _save(ctx, op):
     x = ctx.in1(op, 'X')
     path = str(op.attr('file_path'))
     overwrite = bool(op.attr('overwrite', True))
-    _io_callback(_save_cb(path, overwrite), [x])
+    _io_callback(_save_cb(path, overwrite), [x],
+                 host_eager=ctx.params.get('host_eager', False))
 
 
 @register_op('save_combine', stateful=True)
@@ -373,7 +377,8 @@ def _save_combine(ctx, op):
     xs = ctx.in_list(op, 'X')
     path = str(op.attr('file_path'))
     overwrite = bool(op.attr('overwrite', True))
-    _io_callback(_save_cb(path, overwrite), xs)
+    _io_callback(_save_cb(path, overwrite), xs,
+                 host_eager=ctx.params.get('host_eager', False))
 
 
 def _npz_arrays(path):
@@ -459,8 +464,11 @@ def _detection_map(ctx, op):
             m.update(det_i, boxes, labels, difficult)
         return np.float32(m.eval())
 
-    out = jax.pure_callback(
-        compute, jax.ShapeDtypeStruct((), jnp.float32), det, label)
+    if ctx.params.get('host_eager'):
+        out = jnp.asarray(compute(np.asarray(det), np.asarray(label)))
+    else:
+        out = jax.pure_callback(
+            compute, jax.ShapeDtypeStruct((), jnp.float32), det, label)
     ctx.out(op, 'MAP', out.reshape(1))
     ctx.out(op, 'AccumPosCount', jnp.zeros((0, 1), jnp.int32))
     ctx.out(op, 'AccumTruePos', jnp.zeros((0, 2), jnp.float32))

@@ -168,14 +168,24 @@ def _clip_by_norm(ctx, op):
 def _print(ctx, op):
     x = ctx.in1(op, 'X')
     message = op.attr('message', '')
-    # jax.debug.print needs host-callback support; backends without it
-    # (e.g. the axon PJRT tunnel) get a passthrough instead of a crash
-    try:
-        supports_cb = jax.default_backend() in ('cpu', 'tpu', 'gpu')
-    except Exception:
-        supports_cb = False
-    if supports_cb:
-        jax.debug.print(message + " {}", x)
+    if ctx.params.get('host_eager'):
+        # executor host segment: the value is concrete — print directly
+        print(message, np.asarray(x))
+    else:
+        # jax.debug.print needs host-callback support, which is probed
+        # (not inferred from the backend NAME — the axon relay reports
+        # 'tpu' yet rejects send/recv callbacks at run time). Main-block
+        # prints after the backward op get the segmented host path; a
+        # print in a differentiated forward span or inside a control-flow
+        # sub-block cannot be split out, so on callback-less backends it
+        # degrades to a passthrough instead of a runtime abort.
+        from ..executor import _callbacks_supported
+        try:
+            supports_cb = _callbacks_supported()
+        except Exception:
+            supports_cb = False
+        if supports_cb:
+            jax.debug.print(message + " {}", x)
     ctx.out(op, 'Out', x)
 
 

@@ -485,7 +485,11 @@ def _py_func(ctx, op):
         return tuple(np.asarray(o).astype(d.dtype).reshape(d.shape)
                      for o, d in zip(out, result_spec))
 
-    if bwd_id < 0:
+    if ctx.params.get('host_eager'):
+        # executor host segment (backends without callback support): the
+        # values are concrete — call the registered function directly
+        outs = host_call(*[np.asarray(x) for x in xs])
+    elif bwd_id < 0:
         outs = jax.pure_callback(host_call, result_spec, *xs)
     else:
         bwd = _py_func_registry[bwd_id]

@@ -600,7 +600,7 @@ def _sample_logits(ctx, op):
     ctx.out(op, 'Probabilities', jnp.full_like(out, 1.0 / v))
 
 
-@register_op('im2sequence')
+@register_op('im2sequence', share_lod=False)
 def _im2sequence(ctx, op):
     x = ctx.in1(op, 'X')  # NCHW
     kernels = op.attr('kernels')

@@ -41,12 +41,14 @@ def _gpipe_run(ctx, op):
     from ..parallel.api import get_active_mesh
     sub = ctx.program.block(int(op.attr('sub_block')))
     n_layers = int(op.attr('n_layers'))
-    in_var = op.attr('in_var')
-    out_var = op.attr('out_var')
+    # a boundary may carry K tensors (residual trunk + branch, h/c pairs);
+    # legacy single-activation programs carry in_var/out_var
+    in_vars = list(op.attr('in_vars') or [op.attr('in_var')])
+    out_vars = list(op.attr('out_vars') or [op.attr('out_var')])
     shared = list(op.attr('shared_names') or [])
     slot_names, bindings = _bindings(op)
 
-    act = ctx.get(op.input('X')[0])
+    act = tuple(ctx.get(n) for n in op.input('X'))
     shared_vals = {n: ctx.get(n) for n in shared}
     base_key = ctx.rng()
 
@@ -62,13 +64,14 @@ def _gpipe_run(ctx, op):
         # serial fallback: the original layer loop, same math
         for k in range(n_layers):
             env = dict(shared_vals)
-            env[in_var] = act
+            env.update(zip(in_vars, act))
             for sname, real in zip(slot_names, bindings[k]):
                 env[sname] = ctx.get(real)
             seg_env = _lower_segment(ctx, sub, env,
                                      jax.random.fold_in(base_key, k))
-            act = seg_env[out_var]
-        ctx.out(op, 'Out', act)
+            act = tuple(seg_env[n] for n in out_vars)
+        for j, n in enumerate(op.output('Out')):
+            ctx.set(n, act[j])
         return
 
     from ..parallel.pipeline import gpipe
@@ -93,11 +96,12 @@ def _gpipe_run(ctx, op):
         try:
             for jj in range(lps):
                 env = dict(extra)
-                env[in_var] = x
+                env.update(zip(in_vars, x))
                 for e, sname in enumerate(slot_names):
                     env[sname] = params[e][jj]
                 key = jax.random.fold_in(base_key, s * lps + jj)
-                x = _lower_segment(ctx, sub, env, key)[out_var]
+                seg_env = _lower_segment(ctx, sub, env, key)
+                x = tuple(seg_env[n] for n in out_vars)
         finally:
             _papi._ACTIVE_MESH = prev
         return x
@@ -105,4 +109,5 @@ def _gpipe_run(ctx, op):
     out = gpipe(stage_fn, stacked, act, mesh,
                 num_microbatches=int(op.attr('num_microbatches') or 0)
                 or None, extra=shared_vals)
-    ctx.out(op, 'Out', out)
+    for j, n in enumerate(op.output('Out')):
+        ctx.set(n, out[j])

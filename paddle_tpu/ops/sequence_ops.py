@@ -400,7 +400,7 @@ def _sequence_erase(ctx, op):
 # sequence_scatter — reference sequence_ops/sequence_scatter_op.cc
 # ---------------------------------------------------------------------------
 
-@register_op('sequence_scatter')
+@register_op('sequence_scatter', share_lod=False)
 def _sequence_scatter(ctx, op):
     x = ctx.in1(op, 'X')          # (n, d)
     ids = ctx.in1(op, 'Ids')      # lod (t, 1) int

@@ -128,13 +128,13 @@ def _register_shape_ops():
 _register_shape_ops()
 
 
-@register_op('stack')
+@register_op('stack', share_lod=False)
 def _stack(ctx, op):
     xs = ctx.in_list(op, 'X')
     ctx.out(op, 'Y', jnp.stack(xs, axis=op.attr('axis', 0)))
 
 
-@register_op('unstack')
+@register_op('unstack', share_lod=False)
 def _unstack(ctx, op):
     x = ctx.in1(op, 'X')
     axis = op.attr('axis', 0)
@@ -205,7 +205,7 @@ def _slice(ctx, op):
     ctx.out(op, 'Out', x[tuple(idx)])
 
 
-@register_op('strided_slice')
+@register_op('strided_slice', share_lod=False)
 def _strided_slice(ctx, op):
     x = ctx.in1(op, 'Input')
     axes = op.attr('axes')
@@ -218,7 +218,7 @@ def _strided_slice(ctx, op):
     ctx.out(op, 'Out', x[tuple(idx)])
 
 
-@register_op('crop')
+@register_op('crop', share_lod=False)
 def _crop(ctx, op):
     x = ctx.in1(op, 'X')
     offsets = op.attr('offsets')
@@ -234,7 +234,7 @@ def _gather(ctx, op):
     ctx.out(op, 'Out', jnp.take(x, index, axis=0))
 
 
-@register_op('scatter')
+@register_op('scatter', share_lod=False)
 def _scatter(ctx, op):
     x = ctx.in1(op, 'X')
     ids = ctx.in1(op, 'Ids').reshape(-1).astype(jnp.int32)
@@ -325,7 +325,7 @@ def _arg_min(ctx, op):
     ctx.out(op, 'Out', jnp.argmin(x, axis=axis).astype(jnp.int64))
 
 
-@register_op('argsort')
+@register_op('argsort', share_lod=False)
 def _argsort(ctx, op):
     x = ctx.in1(op, 'X')
     axis = op.attr('axis', -1)
@@ -343,14 +343,14 @@ def _reverse(ctx, op):
     ctx.out(op, 'Out', jnp.flip(x, axis=tuple(axes)))
 
 
-@register_op('multiplex')
+@register_op('multiplex', share_lod=False)
 def _multiplex(ctx, op):
     ids = ctx.in1(op, 'Ids').reshape(-1).astype(jnp.int32)
     xs = jnp.stack(ctx.in_list(op, 'X'), axis=0)
     ctx.out(op, 'Out', xs[ids, jnp.arange(xs.shape[1])])
 
 
-@register_op('where')
+@register_op('where', share_lod=False)
 def _where(ctx, op):
     cond = ctx.in1(op, 'Condition')
     x = ctx.in1(op, 'X')
@@ -428,7 +428,7 @@ def _hash(ctx, op):
     ctx.out(op, 'Out', jnp.stack(outs, axis=-1)[:, :, None])
 
 
-@register_op('diag')
+@register_op('diag', share_lod=False)
 def _diag(ctx, op):
     d = ctx.in1(op, 'Diagonal')
     ctx.out(op, 'Out', jnp.diag(d))

@@ -22,48 +22,53 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-__all__ = ['gpipe']
+__all__ = ['gpipe', 'gpipe_1f1b_grad']
 
 
 def _gpipe_inner(axis_name, stage_fn, n_micro, params_local, x_all, extra):
     """Per-device body: params_local = this stage's params (leading stage
-    dim of size 1), x_all = [M, mb, ...] microbatches (replicated), extra =
-    replicated shared context (attention masks etc.) or None."""
+    dim of size 1), x_all = pytree of [M, mb, ...] microbatch leaves
+    (replicated) — a multi-tensor boundary (residual trunk + branch, h/c
+    pairs) streams as a tuple — extra = replicated shared context
+    (attention masks etc.) or None."""
+    tmap = jax.tree_util.tree_map
     s = lax.axis_index(axis_name)
     n_stage = lax.psum(1, axis_name)
-    params_local = jax.tree_util.tree_map(lambda p: p[0], params_local)
+    params_local = tmap(lambda p: p[0], params_local)
     m = n_micro
-    mb_shape = x_all.shape[1:]
 
-    out_buf = jnp.zeros((m,) + mb_shape, x_all.dtype)
-    act0 = jnp.zeros(mb_shape, x_all.dtype)
+    out_buf = tmap(jnp.zeros_like, x_all)
+    act0 = tmap(lambda a: jnp.zeros(a.shape[1:], a.dtype), x_all)
 
     def step(t, carry):
         act, out_buf = carry
         # stage 0 ingests microbatch t (clipped; inactive lanes masked)
-        x_t = x_all[jnp.clip(t, 0, m - 1)]
-        act_in = jnp.where(s == 0, x_t, act)
+        ti = jnp.clip(t, 0, m - 1)
+        act_in = tmap(lambda xa, aa: jnp.where(s == 0, xa[ti], aa),
+                      x_all, act)
         y = stage_fn(params_local, act_in) if extra is None else \
             stage_fn(params_local, act_in, extra)
         mb_idx = t - s
         active = (mb_idx >= 0) & (mb_idx < m)
-        y = jnp.where(active, y, act_in)
+        y = tmap(lambda ya, aa: jnp.where(active, ya, aa), y, act_in)
         # the final stage records its finished microbatch
         write = active & (s == n_stage - 1)
         idx = jnp.clip(mb_idx, 0, m - 1)
-        out_buf = jnp.where(
-            write,
-            lax.dynamic_update_index_in_dim(out_buf, y, idx, 0),
-            out_buf)
+        out_buf = tmap(
+            lambda ob, ya: jnp.where(
+                write, lax.dynamic_update_index_in_dim(ob, ya, idx, 0),
+                ob),
+            out_buf, y)
         # ship activations one stage down the ring
-        act_next = _ring_shift(y, axis_name)
+        act_next = tmap(lambda ya: _ring_shift(ya, axis_name), y)
         return act_next, out_buf
 
     n_steps = m + _static_axis_size(axis_name) - 1
     act, out_buf = lax.fori_loop(0, n_steps, step, (act0, out_buf))
     # only the last stage holds real outputs; sum-broadcast over the axis
-    out_buf = jnp.where(s == n_stage - 1, out_buf, 0.0)
-    return lax.psum(out_buf, axis_name)
+    return tmap(
+        lambda ob: lax.psum(jnp.where(s == n_stage - 1, ob, 0), axis_name),
+        out_buf)
 
 
 def _static_axis_size(axis_name):
@@ -77,6 +82,181 @@ def _ring_shift(x, axis_name):
     return lax.ppermute(x, axis_name, perm)
 
 
+def _1f1b_inner(axis_name, stage_fn, loss_fn, n_micro, params_local,
+                x_all, largs_all, extra):
+    """Per-device 1F1B body. Schedule (just-in-time warmup; S stages, M
+    microbatches, steps t = 0 .. 2(M+S)-3):
+
+        fwd of mb i at stage s:  t = s + 2i
+        bwd of mb i at stage s:  t = 2S - s - 1 + 2i
+
+    Production feeds consumption exactly one step later in BOTH
+    directions (F_i(s+1) = F_i(s)+1, B_i(s-1) = B_i(s)+1), so one
+    ppermute down (activations) and one up (cotangents) per step suffice
+    and nothing needs an in-flight buffer. fwd and bwd offsets have
+    disjoint parity per device, so each step runs ONE stage computation
+    under lax.cond — in steady state every stage strictly alternates
+    F,B: the 1F1B property. A stage keeps at most S - s outstanding
+    stage-input activations (the 1F1B memory bound) in a depth-S ring
+    buffer — a GPipe backward instead stores all M. The stage forward is
+    recomputed inside the bwd step (per-stage remat, standard for 1F1B).
+    The last stage folds loss_fn into its bwd, seeding the cotangent
+    locally per microbatch."""
+    s = lax.axis_index(axis_name)
+    n_stage = lax.psum(1, axis_name)
+    params_local = jax.tree_util.tree_map(lambda p: p[0], params_local)
+    m = n_micro
+    S = _static_axis_size(axis_name)
+    mb_shape = x_all.shape[1:]
+    dtype = x_all.dtype
+
+    in_buf = jnp.zeros((S,) + mb_shape, dtype)          # stage inputs
+    xgrad_buf = jnp.zeros((m,) + mb_shape, dtype)       # stage-0 cotangents
+    acc_g = jax.tree_util.tree_map(jnp.zeros_like, params_local)
+    loss_acc = jnp.zeros((), jnp.float32)
+
+    def _stage(p, a):
+        return stage_fn(p, a) if extra is None else stage_fn(p, a, extra)
+
+    def _last_stage_loss(p, a, la):
+        return loss_fn(_stage(p, a), la)
+
+    def step(t, carry):
+        act_in, cot_in, in_buf, xgrad_buf, acc_g, loss_acc = carry
+        # ---- schedule arithmetic ----
+        tf = t - s
+        do_fwd = (tf >= 0) & ((tf % 2) == 0) & (tf // 2 < m)
+        i_f = jnp.clip(tf // 2, 0, m - 1)
+        tb = t - (2 * n_stage - s - 1)
+        do_bwd = (tb >= 0) & ((tb % 2) == 0) & (tb // 2 < m)
+        i_b = jnp.clip(tb // 2, 0, m - 1)
+
+        # ---- forward ----
+        a_in = jnp.where(s == 0, x_all[i_f], act_in)
+        in_buf = jnp.where(
+            do_fwd,
+            lax.dynamic_update_index_in_dim(in_buf, a_in, i_f % S, 0),
+            in_buf)
+
+        def fwd_branch(_):
+            y = _stage(params_local, a_in)
+            return (y, jnp.zeros(mb_shape, dtype), loss_acc, acc_g)
+
+        # ---- backward (stage forward recomputed; last stage seeds the
+        # cotangent from its per-microbatch loss) ----
+        def bwd_branch(_):
+            a_saved = in_buf[i_b % S]
+            is_last = s == n_stage - 1
+
+            def last(_):
+                (l, (pg, ag)) = jax.value_and_grad(
+                    _last_stage_loss, argnums=(0, 1))(
+                        params_local, a_saved, jax.tree_util.tree_map(
+                            lambda v: v[i_b], largs_all))
+                return l.astype(jnp.float32), pg, ag
+
+            def mid(_):
+                _, vjp = jax.vjp(lambda p, a: _stage(p, a),
+                                 params_local, a_saved)
+                pg, ag = vjp(cot_in)
+                return jnp.zeros((), jnp.float32), pg, ag
+
+            l, pg, ag = lax.cond(is_last, last, mid, operand=None)
+            new_acc = jax.tree_util.tree_map(lambda g, d: g + d, acc_g, pg)
+            return (jnp.zeros(mb_shape, dtype), ag.astype(dtype),
+                    loss_acc + l, new_acc)
+
+        y_out, cot_up, loss_acc, acc_g = lax.cond(
+            do_bwd, bwd_branch, fwd_branch, operand=None)
+        # a device doing neither (bubble) must not corrupt the loss/grads:
+        # fwd_branch already leaves them unchanged, and its y is ignored
+        # downstream via the consumer's own schedule mask
+
+        # stage-0 records the input cotangent of its finished microbatch
+        xgrad_buf = jnp.where(
+            do_bwd & (s == 0),
+            lax.dynamic_update_index_in_dim(xgrad_buf, cot_up, i_b, 0),
+            xgrad_buf)
+
+        act_next = _ring_shift(y_out, axis_name)          # ship down
+        cot_next = _ring_shift_up(cot_up, axis_name)      # ship up
+        return (act_next, cot_next, in_buf, xgrad_buf, acc_g, loss_acc)
+
+    # last event: B_{m-1}(0) at t = 2S - 1 + 2(m-1)
+    n_steps = 2 * (m + S) - 2
+    init = (jnp.zeros(mb_shape, dtype), jnp.zeros(mb_shape, dtype),
+            in_buf, xgrad_buf, acc_g, loss_acc)
+    _, _, _, xgrad_buf, acc_g, loss_acc = lax.fori_loop(
+        0, n_steps, step, init)
+    # loss lives on the last stage, x-grads on stage 0; psum replicates
+    loss_out = lax.psum(loss_acc, axis_name)
+    xgrad_out = lax.psum(
+        jnp.where(s == 0, xgrad_buf, 0).astype(dtype), axis_name)
+    acc_g = jax.tree_util.tree_map(lambda g: g[None], acc_g)
+    return loss_out, acc_g, xgrad_out
+
+
+def _ring_shift_up(x, axis_name):
+    n = lax.psum(1, axis_name)
+    perm = [(i, (i - 1) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name, perm)
+
+
+def gpipe_1f1b_grad(stage_fn, stage_params, x, loss_fn, loss_args, mesh,
+                    axis_name='pipe', num_microbatches=None, extra=None):
+    """One 1F1B-scheduled training step: returns (loss_sum, param_grads,
+    x_grad).
+
+    Unlike `gpipe` (whose backward is jax.vjp of the forward schedule — a
+    reverse pipeline that must hold every microbatch's activations), 1F1B
+    interleaves each microbatch's backward as soon as its cotangent is
+    available, bounding live stage-input activations at S instead of M —
+    the schedule used for deep pipelines where M >> S. The loss must be
+    computable per microbatch (it is fused into the last stage), which is
+    why this is a grad combinator rather than a forward combinator.
+
+    stage_fn(params_slice, x_mb[, extra]) -> y_mb   shape-preserving
+    loss_fn(y_mb, loss_args_mb) -> scalar           per-microbatch loss
+    loss_args: pytree with leading [B] batch dim (labels etc.)
+    Returns loss summed over microbatches, grads with the [S] stage dim
+    (sharded over `axis_name`), and d loss/d x.
+
+    Grad parity with the serial composition is exact up to reduction
+    order (tests/test_pipeline_moe.py::test_1f1b_grads_match_serial).
+    No reference counterpart: fluid ~1.3 has no pipeline parallelism.
+    """
+    n_stage = mesh.shape[axis_name]
+    for leaf in jax.tree_util.tree_leaves(stage_params):
+        if leaf.shape[0] != n_stage:
+            raise ValueError(
+                "stage_params leaf leading dim %d != mesh axis %r size %d"
+                % (leaf.shape[0], axis_name, n_stage))
+    m = num_microbatches or n_stage
+    b = x.shape[0]
+    if b % m:
+        raise ValueError("batch %d not divisible by %d microbatches"
+                         % (b, m))
+    x_mb = x.reshape((m, b // m) + x.shape[1:])
+    largs_mb = jax.tree_util.tree_map(
+        lambda v: v.reshape((m, b // m) + v.shape[1:]), loss_args)
+
+    from .ring_attention import _shard_map
+    pspec = jax.tree_util.tree_map(lambda _: P(axis_name), stage_params)
+    lspec = jax.tree_util.tree_map(lambda _: P(), largs_mb)
+    inner = functools.partial(_1f1b_inner, axis_name, stage_fn, loss_fn, m)
+    if extra is None:
+        fn = _shard_map(
+            lambda p, xx, la: inner(p, xx, la, None), mesh,
+            (pspec, P(), lspec), (P(), pspec, P()))
+        loss, grads, xg = fn(stage_params, x_mb, largs_mb)
+    else:
+        espec = jax.tree_util.tree_map(lambda _: P(), extra)
+        fn = _shard_map(inner, mesh, (pspec, P(), lspec, espec),
+                        (P(), pspec, P()))
+        loss, grads, xg = fn(stage_params, x_mb, largs_mb, extra)
+    return loss, grads, xg.reshape(x.shape)
+
+
 def gpipe(stage_fn, stage_params, x, mesh, axis_name='pipe',
           num_microbatches=None, extra=None):
     """Run x through S pipelined stages.
@@ -84,13 +264,17 @@ def gpipe(stage_fn, stage_params, x, mesh, axis_name='pipe',
     stage_fn(params, x_mb[, extra]) -> y_mb: one stage, shape-preserving.
     stage_params: pytree with leading stage dim S on every leaf (sharded
     over `axis_name`).
-    x: [B, ...] global batch; B must divide into num_microbatches
-    (default: S, the minimum that fills the pipeline).
+    x: [B, ...] global batch, or a PYTREE of [B, ...] leaves when the
+    layer boundary carries several tensors (residual trunk + branch,
+    LSTM h/c); stage_fn then receives and returns the same structure.
+    B must divide into num_microbatches (default: S, the minimum that
+    fills the pipeline).
     extra: optional pytree of shared context (masks, position tables),
     replicated to every stage and passed as stage_fn's third argument.
     Returns stage_S(...stage_1(x)) with the same sharding as x
     (replicated over the pipe axis).
     """
+    tmap = jax.tree_util.tree_map
     n_stage = mesh.shape[axis_name]
     for leaf in jax.tree_util.tree_leaves(stage_params):
         if leaf.shape[0] != n_stage:
@@ -99,22 +283,26 @@ def gpipe(stage_fn, stage_params, x, mesh, axis_name='pipe',
                 "(every leaf needs the [S] stage dimension)"
                 % (leaf.shape[0], axis_name, n_stage))
     m = num_microbatches or n_stage
-    b = x.shape[0]
+    x_leaves = jax.tree_util.tree_leaves(x)
+    b = x_leaves[0].shape[0]
+    if any(leaf.shape[0] != b for leaf in x_leaves):
+        raise ValueError("gpipe: all activation leaves must share the "
+                         "leading batch dim")
     if b % m:
         raise ValueError("batch %d not divisible by %d microbatches"
                          % (b, m))
-    x_mb = x.reshape((m, b // m) + x.shape[1:])
+    x_mb = tmap(lambda a: a.reshape((m, b // m) + a.shape[1:]), x)
 
     from .ring_attention import _shard_map
-    pspec = jax.tree_util.tree_map(
-        lambda _: P(axis_name), stage_params)
+    pspec = tmap(lambda _: P(axis_name), stage_params)
+    xspec = tmap(lambda _: P(), x_mb)
     inner = functools.partial(_gpipe_inner, axis_name, stage_fn, m)
     if extra is None:
         fn = _shard_map(lambda p, xx: inner(p, xx, None), mesh,
-                        (pspec, P()), P())
+                        (pspec, xspec), xspec)
         out = fn(stage_params, x_mb)
     else:
-        espec = jax.tree_util.tree_map(lambda _: P(), extra)
-        fn = _shard_map(inner, mesh, (pspec, P(), espec), P())
+        espec = tmap(lambda _: P(), extra)
+        fn = _shard_map(inner, mesh, (pspec, xspec, espec), xspec)
         out = fn(stage_params, x_mb, extra)
-    return out.reshape(x.shape)
+    return tmap(lambda o: o.reshape((b,) + o.shape[2:]), out)

@@ -151,12 +151,24 @@ class IdentityScaleCleanPass(Pass):
                         continue
                     src = op.input('X')[0]
                     dst = op.output('Out')[0]
-                    producer = None
-                    for prev in block.ops[:i]:
-                        if src in prev.output_arg_names:
-                            producer = prev
-                    if producer is None:
+                    # rewiring is only sound when src has exactly ONE writer
+                    # in the whole program (non-SSA programs may overwrite
+                    # src later; renaming every reader would then alias
+                    # readers of the later write onto the stale dst value)
+                    # — and when dst has no OTHER writer either (rewiring
+                    # the producer to emit dst must not clobber or be
+                    # clobbered by an unrelated write of dst)
+                    writers = [o for blk in program.blocks for o in blk.ops
+                               if o is not op and src in o.output_arg_names]
+                    if len(writers) != 1 or writers[0] not in block.ops[:i]:
                         continue
+                    dst_writers = [o for blk in program.blocks
+                                   for o in blk.ops
+                                   if o is not op
+                                   and dst in o.output_arg_names]
+                    if dst_writers:
+                        continue
+                    producer = writers[0]
                     producer._rename_output(src, dst)
                     # src no longer exists after the rewire: rename readers
                     # in EVERY block (sub-blocks of while/cond read parent

@@ -11,13 +11,19 @@ schedule; optimizer ops are untouched (per-layer parameters keep their
 names — grads flow to them through the in-trace stacking).
 
 Detection contract (the "layer boundary" rule): a maximal run of >= 2
-contiguous op segments with identical op-type sequences, where exactly ONE
-non-persistable activation crosses each boundary (shape-preserving layer,
-e.g. [B, L, D] -> [B, L, D]) and any other crossing vars are the SAME names
-at every boundary (shared context such as an attention mask — closed over,
-replicated). Parameters referenced by segment k bind position-for-position
-to segment 0's names and are stacked [n_stages, layers_per_stage, ...]
-inside the trace.
+contiguous op segments with identical op-type sequences, where the SAME
+NUMBER (1..8) of non-persistable activations crosses every boundary
+(shape-preserving layer: a single [B, L, D] trunk, or K tensors — e.g. a
+separately-materialized residual + branch, or a decoder's h/c pair — which
+stream through the pipeline as a tuple) and any other crossing vars are
+the SAME names at every boundary (shared context such as an attention
+mask — closed over, replicated). Multi-tensor boundaries align by
+consumption position: boundary k's tensors are ordered by their first use
+in segment k, which corresponds across segments because the op structure
+is identical (the final boundary, which no segment consumes, aligns by
+production position instead). Parameters referenced by segment k bind
+position-for-position to segment 0's names and are stacked
+[n_stages, layers_per_stage, ...] inside the trace.
 
 Memory note: parameter STATE stays per-layer (replicated or sharded by
 MeshRunner rules); the pipeline distributes compute and activation
@@ -69,9 +75,63 @@ class PipelineTranspiler(object):
                 if p < i and last_use.get(n, -1) >= i and not persistable(n))
         return crossings
 
+    MAX_CROSSING = 8
+
+    @staticmethod
+    def _use_keys(seg, names):
+        """name -> first consumption position (t, slot, pos) in seg, or
+        None if any name is never consumed there."""
+        out = {}
+        for t, o in enumerate(seg):
+            for slot in sorted(o.inputs):
+                for pos, n in enumerate(o.inputs[slot]):
+                    if n in names and n not in out:
+                        out[n] = (t, slot, pos)
+        return out if len(out) == len(names) else None
+
+    @staticmethod
+    def _prod_keys(seg, names):
+        """name -> first production position (t, slot, pos) in seg."""
+        out = {}
+        for t, o in enumerate(seg):
+            for slot in sorted(o.outputs):
+                for pos, n in enumerate(o.outputs[slot]):
+                    if n in names and n not in out:
+                        out[n] = (t, slot, pos)
+        return out if len(out) == len(names) else None
+
+    def _order_boundaries(self, ops, start, period, n, uniq):
+        """Order each boundary's crossing tensors so index j means the
+        same role at every boundary: interior boundaries by first use in
+        their consuming segment; the final boundary (consumed by nothing)
+        by production position, permuted into use order via boundary 1's
+        production keys. Returns acts[k] lists or None if unalignable."""
+        segs = [ops[start + k * period:start + (k + 1) * period]
+                for k in range(n)]
+        use = [self._use_keys(segs[k], uniq[k]) for k in range(n)]
+        if any(u is None for u in use):
+            return None
+        key_lists = [sorted(u.values()) for u in use]
+        if any(kl != key_lists[0] for kl in key_lists[1:]):
+            return None
+        acts = [sorted(uniq[k], key=lambda nm: use[k][nm])
+                for k in range(n)]
+        if len(uniq[0]) == 1:
+            return acts + [[next(iter(uniq[n]))]]
+        # final boundary: match production keys against boundary 1's
+        prod1 = self._prod_keys(segs[0], uniq[1])
+        prodn = self._prod_keys(segs[n - 1], uniq[n])
+        if prod1 is None or prodn is None:
+            return None
+        if sorted(prod1.values()) != sorted(prodn.values()):
+            return None
+        by_key = {k: nm for nm, k in prodn.items()}
+        acts.append([by_key[prod1[nm]] for nm in acts[1]])
+        return acts
+
     def _find_run(self, program, n_stages):
         """Locate the layer run: returns (start, period, n_layers, shared,
-        acts) with acts[k] = the activation crossing boundary k."""
+        acts) with acts[k] = the ordered activations crossing boundary k."""
         block = program.global_block()
         ops, hi = _forward_range(block)
         crossings = self._crossings = self._crossing_sets(block, ops, hi)
@@ -79,9 +139,9 @@ class PipelineTranspiler(object):
 
         best = None
         # smallest period first: for equal coverage a finer split gives
-        # more stage-count flexibility; sub-layer periods are rejected by
-        # the single-crossing rule (mid-block boundaries carry both the
-        # residual trunk and the branch activation)
+        # more stage-count flexibility; spurious sub-layer periods are
+        # rejected by boundary-set consistency (mid-block cuts carry
+        # differently-shaped crossing sets at different boundaries)
         for period in range(1, hi // 2 + 1):
             for start in range(1, hi - 2 * period + 1):
                 if types[start:start + period] != \
@@ -101,18 +161,26 @@ class PipelineTranspiler(object):
                 # (no following segment consumes it)
                 shared = frozenset.intersection(*sets[:-1])
                 uniq = [s - shared for s in sets]
-                if any(len(u) != 1 for u in uniq):
+                c = len(uniq[0])
+                if not (1 <= c <= self.MAX_CROSSING) or \
+                        any(len(u) != c for u in uniq):
                     continue
-                acts = [next(iter(u)) for u in uniq]
-                if len(set(acts)) != len(acts):
+                flat = [nm for u in uniq for nm in u]
+                if len(set(flat)) != len(flat):
                     continue
-                cover = n * period
+                acts = self._order_boundaries(ops, start, period, n, uniq)
+                if acts is None:
+                    continue
+                # prefer single-tensor boundaries at equal coverage (the
+                # cheapest stream); then larger coverage
+                cover = (n * period, -c)
                 if best is None or cover > best[0]:
                     best = (cover, start, period, n, shared, acts)
         if best is None:
             raise ValueError(
-                "PipelineTranspiler: no repeated layer run with single-"
-                "activation boundaries found in the forward graph")
+                "PipelineTranspiler: no repeated layer run with "
+                "consistent crossing-activation boundaries (1..%d tensors) "
+                "found in the forward graph" % self.MAX_CROSSING)
         _, start, period, n_layers, shared, acts = best
         if n_layers % n_stages:
             raise ValueError(
@@ -143,7 +211,7 @@ class PipelineTranspiler(object):
                 "inside the layer run — cannot close over them" % inside)
 
         # position-aligned external bindings: inputs a segment reads that
-        # it does not produce and that aren't the streamed activation or
+        # it does not produce and that aren't the streamed activations or
         # shared context
         def externals(seg, act_in):
             produced = set()
@@ -153,17 +221,17 @@ class PipelineTranspiler(object):
             for t, o in enumerate(seg):
                 for slot in sorted(o.inputs):
                     for pos, n in enumerate(o.inputs[slot]):
-                        if n in produced or n == act_in or n in shared:
+                        if n in produced or n in act_in or n in shared:
                             continue
                         out.append(((t, slot, pos), n))
             return out
 
-        ext0 = externals(seg0, acts[0])
+        ext0 = externals(seg0, set(acts[0]))
         slot_names = [n for _, n in ext0]
         bindings = []                      # [layer][slot] -> real name
         for k in range(n_layers):
             seg = ops[start + k * period:start + (k + 1) * period]
-            extk = externals(seg, acts[k])
+            extk = externals(seg, set(acts[k]))
             if [key for key, _ in extk] != [key for key, _ in ext0]:
                 raise ValueError(
                     "PipelineTranspiler: layer %d's external inputs do not "
@@ -178,16 +246,17 @@ class PipelineTranspiler(object):
         sub.ops = list(seg0)
 
         all_bound = sorted({n for bk in bindings for n in bk})
-        meta_inputs = {'X': [acts[0]], 'Params': all_bound}
+        meta_inputs = {'X': list(acts[0]), 'Params': all_bound}
         if shared:
             meta_inputs['Shared'] = list(shared)
         from ..framework import Operator
         meta = Operator(
-            block, 'gpipe_run', meta_inputs, {'Out': [acts[n_layers]]},
+            block, 'gpipe_run', meta_inputs,
+            {'Out': list(acts[n_layers])},
             {'sub_block': sub.idx, 'n_layers': n_layers,
              'num_stages': num_stages,
              'num_microbatches': int(num_microbatches),
-             'in_var': acts[0], 'out_var': acts[1],
+             'in_vars': list(acts[0]), 'out_vars': list(acts[1]),
              'slot_names': slot_names,
              'bindings_flat': [n for bk in bindings for n in bk],
              'shared_names': list(shared)})
@@ -195,5 +264,6 @@ class PipelineTranspiler(object):
         program._bump_version()
         self.plan = {'start': start, 'period': period,
                      'n_layers': n_layers, 'num_stages': num_stages,
-                     'activation': acts[0], 'shared': list(shared)}
+                     'n_crossing': len(acts[0]),
+                     'activation': list(acts[0]), 'shared': list(shared)}
         return program

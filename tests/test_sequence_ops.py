@@ -373,6 +373,106 @@ def test_lod_not_shared_on_coincidental_dim_match():
     assert hasattr(oe, 'lod') and oe.lod() == [[0, 1, 4]]
 
 
+def _lod_leak_cases():
+    """Single-op programs where a row-reinterpreting op's output leading
+    dim coincides with a LoD input's — each op here is declared
+    share_lod=False (docs/share_lod_audit.md) and must NOT leak the LoD."""
+    rng = np.random.RandomState(7)
+    x43 = rng.randn(4, 3).astype('float32')
+    return [
+        ('scatter',
+         {'X': ('x', (4, 3), 'float32', [[0, 1, 4]]),
+          'Ids': ('ids', (2,), 'int64', None),
+          'Updates': ('upd', (2, 3), 'float32', None)},
+         {'Out': (4, 3)}, {},
+         {'x': x43, 'ids': np.array([0, 2], 'int64'),
+          'upd': np.ones((2, 3), 'float32')}),
+        ('multiplex',
+         {'X': [('m0', (4, 3), 'float32', [[0, 2, 4]]),
+                ('m1', (4, 3), 'float32', None)],
+          'Ids': ('mid', (4, 1), 'int32', None)},
+         {'Out': (4, 3)}, {},
+         {'m0': x43, 'm1': -x43,
+          'mid': np.zeros((4, 1), 'int32')}),
+        ('argsort',
+         {'X': ('x', (4, 3), 'float32', [[0, 1, 4]])},
+         {'Out': (4, 3), 'Indices': (4, 3)}, {'axis': 0},
+         {'x': x43}),
+        ('unstack',
+         {'X': ('x', (4, 4, 3), 'float32', [[0, 1, 4]])},
+         {'Y': [(4, 3)] * 4}, {'axis': 1, 'num': 4},
+         {'x': rng.randn(4, 4, 3).astype('float32')}),
+        ('split_ids',
+         {'Ids': ('ids', (4, 1), 'int64', [[0, 1, 4]])},
+         {'Out': [(4,)] * 2}, {},
+         {'ids': np.array([[0], [1], [2], [3]], 'int64')}),
+        ('crop',
+         {'X': ('x', (4, 3), 'float32', [[0, 1, 4]])},
+         {'Out': (4, 2)}, {'offsets': [0, 1], 'shape': [4, 2]},
+         {'x': x43}),
+        ('sequence_scatter',
+         {'X': ('x', (4, 3), 'float32', None),
+          'Ids': ('ids', (4, 1), 'int64', [[0, 1, 2, 3, 4]]),
+          'Updates': ('upd', (4, 1), 'float32', [[0, 1, 2, 3, 4]])},
+         {'Out': (4, 3)}, {},
+         {'x': x43, 'ids': np.array([[0], [1], [0], [2]], 'int64'),
+          'upd': np.ones((4, 1), 'float32')}),
+        ('strided_slice',
+         {'Input': ('x', (4, 3), 'float32', [[0, 1, 4]])},
+         {'Out': (4, 2)},
+         {'axes': [1], 'starts': [0], 'ends': [2], 'strides': [1]},
+         {'x': x43}),
+        ('diag',
+         {'Diagonal': ('d', (4,), 'float32', [[0, 1, 4]])},
+         {'Out': (4, 4)}, {},
+         {'d': np.arange(4, dtype='float32')}),
+    ]
+
+
+@pytest.mark.parametrize(
+    'case', _lod_leak_cases(), ids=lambda c: c[0])
+def test_share_lod_false_ops_do_not_leak(case):
+    """Parametrized sweep of the share_lod=False declarations (VERDICT r4
+    #9; reference InferShapeContext::ShareLoD is per-op, so inheritance
+    must be too)."""
+    op_type, in_spec, out_spec, attrs, feed_vals = case
+    prog, startup = fluid.Program(), fluid.Program()
+    feed = {}
+    with fluid.program_guard(prog, startup):
+        blk = prog.global_block()
+        ins = {}
+        for slot, spec in in_spec.items():
+            specs = spec if isinstance(spec, list) else [spec]
+            vs = []
+            for name, shape, dtype, lod in specs:
+                v = blk.create_var(name=name, shape=shape, dtype=dtype,
+                                   stop_gradient=True,
+                                   lod_level=1 if lod else 0)
+                feed[name] = (feed_vals[name], lod) if lod \
+                    else feed_vals[name]
+                vs.append(v)
+            ins[slot] = vs
+        outs = {}
+        fetch = []
+        for slot, spec in out_spec.items():
+            specs = spec if isinstance(spec, list) else [spec]
+            vs = []
+            for i, shape in enumerate(specs):
+                v = blk.create_var(name='%s_out_%s_%d' % (op_type, slot, i),
+                                   stop_gradient=True)
+                vs.append(v)
+                fetch.append(v.name)
+            outs[slot] = vs
+        blk.append_op(type=op_type, inputs=ins, outputs=outs, attrs=attrs)
+    exe = fluid.Executor(fluid.CPUPlace())
+    sc = fluid.Scope()
+    got = exe.run(prog, feed=feed, fetch_list=fetch, scope=sc)
+    for name, g in zip(fetch, got):
+        leaked = hasattr(g, 'lod') and g.lod()
+        assert not leaked, "%s output %s leaked LoD %s" % (
+            op_type, name, leaked and g.lod())
+
+
 def test_create_lod_tensor_roundtrip():
     t = fluid.create_lod_tensor(np.ones((5, 2), 'float32'), [[2, 3]], None)
     assert t.lod() == [[0, 2, 5]]

@@ -1,0 +1,119 @@
+"""Training-dynamics appendix runs (VERDICT r4 #3): long multi-window
+convergence on a conv net and on CTR, the treatment BASELINE.md already
+gives the flagship LM (2000-step run). Loss is reported at every fused
+window boundary, on teacher tasks with fresh batches per step inside a
+window — the loss can only fall by LEARNING the teacher structure.
+
+Usage:  python tools/convergence.py [resnet|ctr|both]
+Writes one JSON line per model: {"model", "steps", "losses": [...]}.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def run_resnet(windows=12, k=24, batch=64):
+    import jax
+    import paddle_tpu as fluid
+    from paddle_tpu.contrib import mixed_precision as mp
+    from paddle_tpu.models.resnet import build as build_resnet
+
+    main_p, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup):
+        img, label, pred, avg_cost, acc = build_resnet('imagenet',
+                                                       depth=50)
+        opt = mp.decorate(
+            fluid.optimizer.Momentum(learning_rate=0.02, momentum=0.9),
+            keep_bf16_activations=True)
+        opt.minimize(avg_cost)
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    teacher = rng.randn(192, 1000).astype('float32')
+
+    def make_window():
+        imgs = rng.randn(k, batch, 3, 224, 224).astype('float32')
+        pooled = imgs.reshape(k * batch, 3, 8, 28, 8, 28).mean(axis=(3, 5))
+        lbl = np.argmax(pooled.reshape(k * batch, -1) @ teacher, 1)
+        return {'img': jax.device_put(imgs),
+                'label': jax.device_put(
+                    lbl.astype('int64').reshape(k, batch, 1))}
+
+    losses = []
+    t0 = time.time()
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        for w in range(windows):
+            stacked = make_window()
+            jax.block_until_ready(stacked)
+            out = exe.run_fused(main_p, stacked, fetch_list=[avg_cost],
+                                scope=scope, steps=k)
+            losses.append(round(float(np.asarray(out[0]).reshape(-1)[0]),
+                                4))
+            print("resnet window %d (step %d): loss %.4f" %
+                  (w, (w + 1) * k, losses[-1]), flush=True)
+    print(json.dumps({'model': 'resnet50_teacher1000',
+                      'steps': windows * k, 'batch': batch,
+                      'losses': losses,
+                      'wall_s': round(time.time() - t0, 1)}))
+
+
+def run_ctr(windows=10, k=200, batch=512, vocab=100000, dim=16):
+    import jax
+    import paddle_tpu as fluid
+
+    slots = 26
+    main_p, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup):
+        ids = fluid.layers.data(name='ids', shape=[slots], dtype='int64')
+        label = fluid.layers.data(name='label', shape=[1],
+                                  dtype='float32')
+        emb = fluid.layers.embedding(
+            input=fluid.layers.reshape(ids, [-1, slots, 1]),
+            size=[vocab, dim], is_sparse=True)
+        flat = fluid.layers.reshape(emb, [-1, slots * dim])
+        h = fluid.layers.fc(flat, size=400, act='relu')
+        h = fluid.layers.fc(h, size=400, act='relu')
+        p = fluid.layers.fc(h, size=1, act='sigmoid')
+        loss = fluid.layers.mean(fluid.layers.log_loss(p, label))
+        fluid.optimizer.Adagrad(learning_rate=0.01).minimize(loss)
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    id_score = rng.randn(vocab).astype('float32')
+
+    def make_window():
+        idsv = rng.randint(0, vocab, (k, batch, slots)).astype('int64')
+        lbl = (id_score[idsv].sum(2) > 0).astype('float32')
+        return {'ids': jax.device_put(idsv),
+                'label': jax.device_put(lbl.reshape(k, batch, 1))}
+
+    losses = []
+    t0 = time.time()
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        for w in range(windows):
+            stacked = make_window()
+            jax.block_until_ready(stacked)
+            out = exe.run_fused(main_p, stacked, fetch_list=[loss],
+                                scope=scope, steps=k)
+            losses.append(round(float(np.asarray(out[0]).reshape(-1)[0]),
+                                4))
+            print("ctr window %d (step %d): loss %.4f" %
+                  (w, (w + 1) * k, losses[-1]), flush=True)
+    print(json.dumps({'model': 'ctr_teacher', 'steps': windows * k,
+                      'batch': batch, 'vocab': vocab, 'losses': losses,
+                      'wall_s': round(time.time() - t0, 1)}))
+
+
+if __name__ == '__main__':
+    which = sys.argv[1] if len(sys.argv) > 1 else 'both'
+    if which in ('resnet', 'both'):
+        run_resnet()
+    if which in ('ctr', 'both'):
+        run_ctr()

@@ -1,0 +1,168 @@
+"""Close the on-chip op tail (VERDICT r4 #8): synthetic driver cases for
+the ops the collected corpus never replays on the TPU —
+
+  print                executor-segmented host op (needs a program case)
+  shrink_rnn_memory    static-mask identity (control_flow_ops.py:546)
+  split_selected_rows  needs SelectedRows state (built here via a real
+                       is_sparse embedding gradient, then densified with
+                       get_tensor_from_selected_rows so fetches compare)
+  gpipe_run            degenerate single-chip replay: no 'pipe' mesh ->
+                       the serial layer-loop lowering (pipeline_ops.py:61)
+  switch_moe           degenerate single-chip replay: no 'expert' mesh ->
+                       dense evaluation (misc_ops.py switch_moe)
+
+Runs each program once on CPU with the optest collection hook armed, so
+the recorded cases use the exact same format/machinery as the rest of the
+corpus (core/optest_collect.py). Case numbering starts at 9000 to sort
+after the collected corpus.
+
+Run:  JAX_PLATFORMS=cpu python tools/tailcases.py [corpus_dir]
+"""
+import glob
+import os
+import pickle
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def _seed_seen(d):
+    """Pre-populate the collector's seen-op set with everything the corpus
+    already covers, so only the tail programs below produce new cases."""
+    from paddle_tpu.core import optest_collect
+    seen = set()
+    for p in glob.glob(os.path.join(d, 'case_*.pkl')):
+        try:
+            with open(p, 'rb') as f:
+                seen.update(pickle.load(f)['ops'])
+        except Exception:
+            pass
+    optest_collect._seen_ops.update(seen)
+    optest_collect._case_counter[0] = 8999
+
+
+def _run(main, startup, feed, fetches):
+    import paddle_tpu as fluid
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        return exe.run(main, feed=feed, fetch_list=fetches, scope=scope)
+
+
+def case_print_and_shrink():
+    import paddle_tpu as fluid
+    from paddle_tpu.framework import Program, program_guard
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        p = fluid.layers.Print(x, message='tail:')
+        s = fluid.layers.shrink_rnn_memory_identity(p) \
+            if hasattr(fluid.layers, 'shrink_rnn_memory_identity') else None
+        if s is None:
+            blk = main.global_block()
+            s = blk.create_var(name='shrunk', dtype='float32',
+                               stop_gradient=False)
+            blk.append_op(type='shrink_rnn_memory',
+                          inputs={'X': [p]}, outputs={'Out': [s]},
+                          attrs={})
+        y = fluid.layers.scale(s, scale=2.0)
+    X = np.random.RandomState(0).randn(3, 4).astype('float32')
+    out, = _run(main, startup, {'x': X}, [y])
+    np.testing.assert_allclose(np.asarray(out), 2.0 * X, rtol=1e-6)
+
+
+def case_split_selected_rows():
+    import paddle_tpu as fluid
+    from paddle_tpu.framework import Program, program_guard
+    main, startup = Program(), Program()
+    V, D = 12, 4
+    with program_guard(main, startup):
+        ids = fluid.layers.data(name='ids', shape=[1], dtype='int64')
+        emb = fluid.layers.embedding(ids, size=[V, D], is_sparse=True,
+                                     param_attr='tail_w')
+        loss = fluid.layers.mean(fluid.layers.square(emb))
+        grads = fluid.backward.append_backward(loss)
+        gvar = grads[0][1]                         # tail_w@GRAD SelectedRows
+        blk = main.global_block()
+        outs = []
+        for k, h in enumerate((8, 4)):             # height sections
+            o = blk.create_var(name='ssr_out%d' % k, stop_gradient=True)
+            outs.append(o)
+        blk.append_op(type='split_selected_rows', inputs={'X': [gvar]},
+                      outputs={'Out': outs},
+                      attrs={'height_sections': [8, 4]})
+        dense = []
+        for k, o in enumerate(outs):
+            dv = blk.create_var(name='ssr_dense%d' % k, stop_gradient=True)
+            blk.append_op(type='get_tensor_from_selected_rows',
+                          inputs={'X': [o]}, outputs={'Out': [dv]})
+            dense.append(dv)
+    ids_np = np.array([[1], [9], [1], [5]], np.int64)
+    outs_v = _run(main, startup, {'ids': ids_np}, [loss] + dense)
+    assert all(np.isfinite(np.asarray(v)).all() for v in outs_v)
+
+
+def case_gpipe_run():
+    import paddle_tpu as fluid
+    from paddle_tpu.models.transformer import build_lm, LMConfig
+    cfg = LMConfig(vocab_size=64, seq_len=8, d_model=16, n_head=2,
+                   n_layer=2, d_ff=32, dropout=0.0, attn_dropout=0.0,
+                   use_flash_attention=False)
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 5
+    with fluid.program_guard(main, startup):
+        tokens, labels, logits, avg_loss = build_lm(cfg)
+    fluid.transpiler.PipelineTranspiler().transpile(main, num_stages=2)
+    assert any(op.type == 'gpipe_run'
+               for op in main.global_block().ops)
+    rng = np.random.RandomState(1)
+    feed = {'tokens': rng.randint(0, 64, (4, 8)).astype('int64'),
+            'labels': rng.randint(0, 64, (4, 8)).astype('int64')}
+    out, = _run(main, startup, feed, [avg_loss])
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def case_switch_moe():
+    import paddle_tpu as fluid
+    from paddle_tpu.framework import Program, program_guard
+    main, startup = Program(), Program()
+    main.random_seed = startup.random_seed = 9
+    with program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[16], dtype='float32')
+        out, aux = fluid.layers.switch_moe(x, num_experts=4, d_ff=32)
+        total = fluid.layers.elementwise_add(
+            fluid.layers.mean(fluid.layers.square(out)), aux)
+    X = np.random.RandomState(2).randn(8, 16).astype('float32')
+    out_v, = _run(main, startup, {'x': X}, [total])
+    assert np.isfinite(np.asarray(out_v)).all()
+
+
+def main():
+    d = sys.argv[1] if len(sys.argv) > 1 else 'optest_cases'
+    import jax
+    try:
+        jax.config.update('jax_platforms', 'cpu')
+    except Exception:
+        pass
+    assert jax.devices()[0].platform == 'cpu', "run with JAX_PLATFORMS=cpu"
+    os.environ['PADDLE_OPTEST_COLLECT_DIR'] = d
+    for old in glob.glob(os.path.join(d, 'case_9*.pkl')):
+        os.remove(old)
+    _seed_seen(d)
+    for fn in (case_print_and_shrink, case_split_selected_rows,
+               case_gpipe_run, case_switch_moe):
+        fn()
+        print("ok:", fn.__name__)
+    new = sorted(glob.glob(os.path.join(d, 'case_9*.pkl')))
+    print("recorded %d tail cases:" % len(new))
+    for p in new:
+        with open(p, 'rb') as f:
+            c = pickle.load(f)
+        print(" ", os.path.basename(p), c['new_ops'])
+
+
+if __name__ == '__main__':
+    main()
