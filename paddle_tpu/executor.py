@@ -642,7 +642,8 @@ class Executor(object):
 
     # ------------------------------------------------------------------
     def run_fused(self, program=None, feed_list=None, fetch_list=None,
-                  scope=None, return_numpy=True, steps=None):
+                  scope=None, return_numpy=True, steps=None,
+                  _prepared=None):
         """Run len(feed_list) consecutive steps in ONE compiled call.
 
         The step function is iterated on-device with lax.fori_loop over the
@@ -655,13 +656,21 @@ class Executor(object):
         per-dispatch loop, framework/async_executor.cc:236).
 
         feed_list: list of K feed dicts with identical names/shapes/dtypes
-        — ragged (array, lod) feeds are allowed when every staged batch
-        shares ONE identical LoD (it binds statically; bucket+pad varied
-        shapes, reader/bucketing.py) — OR a pre-stacked
+        — ragged (array, lod) feeds may VARY their LoD/shape across the
+        staged batches: the list is split into maximal consecutive
+        same-LoD segments (order-preserving, so the training trajectory
+        is untouched) and each segment scans as its own fused call.
+        Compiles are cached per (shape, segment length), so a stream
+        sorted bucket-major (reader/bucketing.py) fuses at full length,
+        while a heavily interleaved stream degrades gracefully toward
+        per-step execution (correct, but without the fusion win — group
+        by bucket first when throughput matters). — OR a pre-stacked
         {name: array[K, ...]} dict: pass device-resident (jax.device_put)
         stacked arrays to avoid re-uploading large feeds on every call
         (the input-pipeline staging an async py_reader would do). Returns
         the LAST step's fetches; all K state updates land in the scope.
+        `steps` (run more scan iterations than staged batches, cycling
+        them) requires a uniform-LoD feed_list.
         """
         import jax
         from jax import lax
@@ -683,15 +692,32 @@ class Executor(object):
                                    getattr(v, 'dtype', None))
                      for kk, v in stacked.items()}
         else:
-            prepared = [self._prepare_feed(program, f or {})
-                        for f in feed_list]
+            prepared = _prepared if _prepared is not None else [
+                self._prepare_feed(program, f or {}) for f in feed_list]
             lods0 = prepared[0][1]
             if any(lods != lods0 for _, lods in prepared):
-                raise ValueError(
-                    "run_fused LoD feeds must share one identical LoD "
-                    "across all staged batches (LoD binds statically per "
-                    "compile; bucket+pad to a common shape — "
-                    "reader/bucketing.py — to scan varied shapes)")
+                # mixed-LoD stream: split into maximal consecutive
+                # same-LoD segments and fuse each separately — order is
+                # preserved, so K state updates land exactly as a
+                # per-step loop would apply them
+                if steps:
+                    raise ValueError(
+                        "run_fused(steps=...) cycles the staged batches "
+                        "and requires one uniform LoD; omit steps for a "
+                        "mixed-LoD stream (segments run at their own "
+                        "lengths)")
+                out = []
+                seg_lo = 0
+                for i in range(1, len(feed_list) + 1):
+                    if i == len(feed_list) or \
+                            prepared[i][1] != prepared[seg_lo][1]:
+                        out = self.run_fused(
+                            program, feed_list[seg_lo:i],
+                            fetch_list=fetch_list, scope=scope,
+                            return_numpy=return_numpy,
+                            _prepared=prepared[seg_lo:i])
+                        seg_lo = i
+                return out
             feeds = [f for f, _ in prepared]
             k_steps = len(feeds)
             stacked = {name: np.stack([np.asarray(f[name]) for f in feeds])
