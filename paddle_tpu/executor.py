@@ -711,11 +711,20 @@ class Executor(object):
                 for i in range(1, len(feed_list) + 1):
                     if i == len(feed_list) or \
                             prepared[i][1] != prepared[seg_lo][1]:
-                        out = self.run_fused(
-                            program, feed_list[seg_lo:i],
-                            fetch_list=fetch_list, scope=scope,
-                            return_numpy=return_numpy,
-                            _prepared=prepared[seg_lo:i])
+                        # chunk the segment to power-of-two lengths
+                        # (largest first): compiles cache per (shape,
+                        # chunk length), so this bounds entries per LoD
+                        # shape to O(log K) across arbitrary streams
+                        # instead of one per distinct segment length
+                        lo = seg_lo
+                        while lo < i:
+                            size = 1 << ((i - lo).bit_length() - 1)
+                            out = self.run_fused(
+                                program, feed_list[lo:lo + size],
+                                fetch_list=fetch_list, scope=scope,
+                                return_numpy=return_numpy,
+                                _prepared=prepared[lo:lo + size])
+                            lo += size
                         seg_lo = i
                 return out
             feeds = [f for f, _ in prepared]
@@ -731,21 +740,24 @@ class Executor(object):
         fetch_names = [v.name if isinstance(v, Variable) else v
                        for v in (fetch_list or [])]
 
+        # scope-held LoD state binds statically too, like run() — and like
+        # run() it must be part of the cache key, or a compile baked with
+        # a stale scope LoD would be reused after the scope's LoD changes
+        scope_lods = {n: normalize_lod(l) for n, l in
+                      getattr(scope, '_lods', {}).items() if l}
+        static_lods = dict(scope_lods)
+        static_lods.update(lods0)
+
         n_steps = int(steps) if steps else k_steps
         cache_key = ('fused', k_steps, n_steps, program._uid,
                      program._version,
-                     self._feed_signature(feed0, lods0, ()),
+                     self._feed_signature(feed0, static_lods, ()),
                      tuple(fetch_names))
         entry = self._cache.get(cache_key)
         if entry is None:
             read, written = lowering.analyze_state(program, fetch_names)
             needed = self._read_before_write(program, read, written,
                                              set(feed0), fetch_names)
-            # scope-held LoD state binds statically too, like run()
-            scope_lods = {n: normalize_lod(l) for n, l in
-                          getattr(scope, '_lods', {}).items() if l}
-            static_lods = dict(scope_lods)
-            static_lods.update(lods0)
             fn, ro_names, rw_names = lowering.build_fn(
                 program, fetch_names, needed, written,
                 static_lods=static_lods)

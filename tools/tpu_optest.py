@@ -46,44 +46,46 @@ CHUNK = int(os.environ.get('OPTEST_CHUNK', '6'))
 RTOL = float(os.environ.get('OPTEST_RTOL', '1e-3'))
 ATOL = float(os.environ.get('OPTEST_ATOL', '1e-4'))
 
-# Per-op loosen factors (x base tolerance), each justified by the op's
-# numerics rather than by chip bugs:
-#  - long accumulation chains (conv/pool gradients, big reductions) lose
-#    relative bits even at 'highest' precision when the TPU's f32 add
-#    tree orders differ from CPU's;
-#  - exp/log/erf-family transcendentals differ ~1 ulp between libm and the
-#    TPU's polynomial kernels, which amplifies through softmax/CE chains;
-#  - variance/normalization ops divide by quantities computed by those
-#    same differing reductions.
+# Per-op loosen factors (x base tolerance), DATA-DRIVEN from the round-5
+# replay of all 474 cases: outside the conv family every listed op's
+# worst observed normalized violation was <= 0.31 (i.e. it PASSED at the
+# base tolerance with ~3x margin), so the general tier is a slim 2x
+# covering accumulation-order noise in transcendental/recurrence/
+# normalization/loss chains.
+# The conv family is the one genuinely loose tier: its BACKWARD replays
+# run at default (bf16x3) matmul precision because pinning 'highest'
+# hangs the relay compiler (see _needs_default_precision), and the
+# observed violations there reach 8.7 (conv3d) — 12x covers them.
+_CONV_LOOSEN = 12
 PER_OP_LOOSEN = {
-    'conv2d': 10, 'conv2d_transpose': 10, 'conv3d': 10, 'conv2d_fusion': 10,
-    'conv2d_inception_fusion': 10, 'depthwise_conv2d': 10,
-    'pool2d': 10, 'pool3d': 10, 'batch_norm': 20, 'layer_norm': 20,
-    'group_norm': 20, 'instance_norm': 20, 'data_norm': 20,
-    'softmax': 10, 'softmax_with_cross_entropy': 20, 'cross_entropy': 10,
-    'cross_entropy2': 10, 'sigmoid_cross_entropy_with_logits': 10,
-    'log_softmax': 10, 'exp': 10, 'expm1': 10, 'pow': 10, 'square': 5,
-    'erf': 10, 'gelu': 10, 'tanh': 5, 'sigmoid': 5, 'logsigmoid': 5,
-    'softplus': 10, 'stanh': 5, 'softsign': 5, 'rsqrt': 10,
-    'matmul': 5, 'mul': 5, 'fc': 5, 'bmm': 5, 'cos_sim': 20,
-    'reduce_mean': 5, 'reduce_sum': 5, 'mean': 5, 'sum': 5,
-    'squared_l2_norm': 10, 'squared_l2_distance': 10, 'l2_normalize': 10,
-    'norm': 10, 'clip_by_norm': 10, 'grid_sampler': 20, 'affine_grid': 10,
-    'bilinear_interp': 10, 'nearest_interp': 5, 'bilinear_tensor_product': 10,
-    'lstm': 20, 'lstmp': 20, 'gru': 20, 'gru_unit': 20, 'lstm_unit': 10,
-    'dynamic_lstm': 20, 'dynamic_gru': 20, 'attention_lstm': 20,
-    'fused_embedding_fc_lstm': 20, 'fusion_lstm': 20, 'fusion_gru': 20,
-    'warpctc': 50, 'linear_chain_crf': 20, 'crf_decoding': 20,
-    'margin_rank_loss': 10, 'rank_loss': 10, 'smooth_l1_loss': 10,
-    'huber_loss': 10, 'kldiv_loss': 10, 'log_loss': 10, 'bpr_loss': 20,
-    'nce': 20, 'hierarchical_sigmoid': 20, 'sample_logits': 20,
-    'yolov3_loss': 50, 'yolo_box': 20, 'roi_align': 10, 'roi_pool': 10,
-    'prelu': 5, 'selu': 10, 'elu': 10, 'swish': 10, 'hard_swish': 5,
-    'mish': 10, 'celu': 10, 'softshrink': 5, 'brelu': 5,
-    'adam': 10, 'adamax': 10, 'adagrad': 10, 'adadelta': 10,
-    'rmsprop': 10, 'ftrl': 20, 'lamb': 20, 'lars_momentum': 10,
-    'flash_attention': 50,  # pallas bf16 MXU kernel by design
+    'conv2d': _CONV_LOOSEN, 'conv2d_transpose': _CONV_LOOSEN,
+    'conv3d': _CONV_LOOSEN, 'conv3d_transpose': _CONV_LOOSEN,
+    'conv2d_fusion': _CONV_LOOSEN,
+    'conv2d_inception_fusion': _CONV_LOOSEN,
+    'depthwise_conv2d': _CONV_LOOSEN,
+    'depthwise_conv2d_transpose': _CONV_LOOSEN,
 }
+PER_OP_LOOSEN.update({op: 2 for op in (
+    'pool2d', 'pool3d', 'batch_norm', 'layer_norm', 'group_norm',
+    'instance_norm', 'data_norm', 'softmax', 'softmax_with_cross_entropy',
+    'cross_entropy', 'cross_entropy2', 'sigmoid_cross_entropy_with_logits',
+    'log_softmax', 'exp', 'expm1', 'pow', 'square', 'erf', 'gelu', 'tanh',
+    'sigmoid', 'logsigmoid', 'softplus', 'stanh', 'softsign', 'rsqrt',
+    'matmul', 'mul', 'fc', 'bmm', 'cos_sim', 'reduce_mean', 'reduce_sum',
+    'mean', 'sum', 'squared_l2_norm', 'squared_l2_distance',
+    'l2_normalize', 'norm', 'clip_by_norm', 'grid_sampler', 'affine_grid',
+    'bilinear_interp', 'nearest_interp', 'bilinear_tensor_product',
+    'lstm', 'lstmp', 'gru', 'gru_unit', 'lstm_unit', 'dynamic_lstm',
+    'dynamic_gru', 'attention_lstm', 'fused_embedding_fc_lstm',
+    'fusion_lstm', 'fusion_gru', 'warpctc', 'linear_chain_crf',
+    'crf_decoding', 'margin_rank_loss', 'rank_loss', 'smooth_l1_loss',
+    'huber_loss', 'kldiv_loss', 'log_loss', 'bpr_loss', 'nce',
+    'hierarchical_sigmoid', 'sample_logits', 'yolov3_loss', 'yolo_box',
+    'roi_align', 'roi_pool', 'prelu', 'selu', 'elu', 'swish',
+    'hard_swish', 'mish', 'celu', 'softshrink', 'brelu', 'adam',
+    'adamax', 'adagrad', 'adadelta', 'rmsprop', 'ftrl', 'lamb',
+    'lars_momentum', 'flash_attention',
+)})
 
 
 # Ops where per-op gradient validation does not apply, with the reason —
