@@ -17,8 +17,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 
 
-def run_resnet(windows=12, k=24, batch=64):
+def run_resnet(windows=40, k=24, batch=64):
     import jax
+    import jax.numpy as jnp
     import paddle_tpu as fluid
     from paddle_tpu.contrib import mixed_precision as mp
     from paddle_tpu.models.resnet import build as build_resnet
@@ -34,22 +35,30 @@ def run_resnet(windows=12, k=24, batch=64):
     exe = fluid.Executor(fluid.TPUPlace(0))
     scope = fluid.Scope()
     rng = np.random.RandomState(0)
-    teacher = rng.randn(192, 1000).astype('float32')
+    teacher_dev = jax.device_put(rng.randn(192, 1000).astype('float32'))
 
-    def make_window():
-        imgs = rng.randn(k, batch, 3, 224, 224).astype('float32')
+    # fresh batches generated ON DEVICE each window: the earlier host-side
+    # version shipped 350 MB of images through the relay per 24-step
+    # window (864 s wall for 288 steps); device generation makes the run
+    # compute-bound, so 1000 steps take minutes
+    @jax.jit
+    def gen_window(key):
+        imgs = jax.random.normal(key, (k, batch, 3, 224, 224),
+                                 jnp.float32)
         pooled = imgs.reshape(k * batch, 3, 8, 28, 8, 28).mean(axis=(3, 5))
-        lbl = np.argmax(pooled.reshape(k * batch, -1) @ teacher, 1)
-        return {'img': jax.device_put(imgs),
-                'label': jax.device_put(
-                    lbl.astype('int64').reshape(k, batch, 1))}
+        lbl = jnp.argmax(pooled.reshape(k * batch, -1) @ teacher_dev, 1)
+        return imgs, lbl.astype(jnp.int64).reshape(k, batch, 1)
+
+    def make_window(idx):
+        imgs, lbl = gen_window(jax.random.PRNGKey(idx + 1))
+        return {'img': imgs, 'label': lbl}
 
     losses = []
     t0 = time.time()
     with fluid.scope_guard(scope):
         exe.run(startup, scope=scope)
         for w in range(windows):
-            stacked = make_window()
+            stacked = make_window(w)
             jax.block_until_ready(stacked)
             out = exe.run_fused(main_p, stacked, fetch_list=[avg_cost],
                                 scope=scope, steps=k)

@@ -128,9 +128,10 @@ def main():
         float(jnp.sum(floor_j(s))[None][0])
 
     sec_floor = _slope(run_floor, s1=2000, s2=20000, reps=2)
-    print("recurrence dependency floor (%d seq GEMMs [%d,%d]x[%d,%d]): "
-          "%.3f ms" % (T * L, B, H, H, 4 * H, sec_floor * 1000),
-          flush=True)
+    print("stripped-chain probe (%d seq sliced dots [%d,%d]x[%d,<=%d]; "
+          "XLA's simplifier may narrow the sliced dot to H columns — "
+          "a context point, not a bound): %.3f ms"
+          % (T * L, B, H, H, 4 * H, sec_floor * 1000), flush=True)
 
     # seqpool analog: segment-sum over [T*B, D] — HBM-bound
     D = 512
