@@ -40,6 +40,10 @@ def _seed_seen(d):
         except Exception:
             pass
     optest_collect._seen_ops.update(seen)
+    # save/load appear in old corpus cases that are NOT replayable (temp
+    # paths); un-see them so the fixed-path fixture cases below record
+    optest_collect._seen_ops.difference_update(
+        {'save', 'save_combine', 'load', 'load_combine'})
     optest_collect._case_counter[0] = 8999
 
 
@@ -125,6 +129,60 @@ def case_gpipe_run():
     assert np.isfinite(np.asarray(out)).all()
 
 
+from tools.tpu_optest import _FIX_PREFIX as FIXDIR  # one shared constant
+
+
+def case_save():
+    """save / save_combine through the executor (host-eager on segmented
+    backends). Uses a FIXED path so the replay tool can admit the case
+    (collect-run temp paths are what keep ordinary save/load cases out of
+    the corpus); the save replay rewrites identical deterministic content
+    before the load case (below) binds it."""
+    import paddle_tpu as fluid
+    from paddle_tpu.framework import Program, program_guard
+    os.makedirs(FIXDIR, exist_ok=True)
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        y = fluid.layers.scale(x, scale=2.0)
+        y2 = fluid.layers.scale(x, scale=3.0)
+        blk = main.global_block()
+        blk.append_op(type='save', inputs={'X': [y]}, outputs={},
+                      attrs={'file_path': FIXDIR + '/y.npz',
+                             'overwrite': True})
+        blk.append_op(type='save_combine', inputs={'X': [y, y2]},
+                      outputs={},
+                      attrs={'file_path': FIXDIR + '/comb.npz',
+                             'overwrite': True})
+        z = fluid.layers.elementwise_add(y, y2)
+    X = np.random.RandomState(11).randn(3, 4).astype('float32')
+    out, = _run(main, startup, {'x': X}, [z])
+    np.testing.assert_allclose(np.asarray(out), 5.0 * X, rtol=1e-6)
+
+
+def case_load():
+    """load / load_combine: the files bind at trace time (static weights,
+    the inference-engine contract) from the fixtures case_save wrote."""
+    import paddle_tpu as fluid
+    from paddle_tpu.framework import Program, program_guard
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        blk = main.global_block()
+        z = blk.create_var(name='ld_y', stop_gradient=True)
+        blk.append_op(type='load', inputs={}, outputs={'Out': [z]},
+                      attrs={'file_path': FIXDIR + '/y.npz'})
+        a = blk.create_var(name='ld_a', stop_gradient=True)
+        b = blk.create_var(name='ld_b', stop_gradient=True)
+        blk.append_op(type='load_combine', inputs={},
+                      outputs={'Out': [a, b]},
+                      attrs={'file_path': FIXDIR + '/comb.npz'})
+        out = fluid.layers.elementwise_add(
+            fluid.layers.elementwise_add(z, a), b)
+    X = np.random.RandomState(11).randn(3, 4).astype('float32')
+    got, = _run(main, startup, {}, [out])
+    np.testing.assert_allclose(np.asarray(got), 7.0 * X, rtol=1e-6)
+
+
 def case_switch_moe():
     import paddle_tpu as fluid
     from paddle_tpu.framework import Program, program_guard
@@ -153,7 +211,7 @@ def main():
         os.remove(old)
     _seed_seen(d)
     for fn in (case_print_and_shrink, case_split_selected_rows,
-               case_gpipe_run, case_switch_moe):
+               case_gpipe_run, case_switch_moe, case_save, case_load):
         fn()
         print("ok:", fn.__name__)
     new = sorted(glob.glob(os.path.join(d, 'case_9*.pkl')))
@@ -161,6 +219,21 @@ def main():
     for p in new:
         with open(p, 'rb') as f:
             c = pickle.load(f)
+        # embed load fixtures in the case itself, so a replay on a fresh
+        # machine (or after /tmp is cleared and the save window is
+        # part-cached) can rematerialize them before the trace-time bind
+        if {'load', 'load_combine'} & set(c['ops']):
+            fix = {}
+            for b in c['program'].blocks:
+                for op in b.ops:
+                    if op.type in ('load', 'load_combine'):
+                        path = str(op.attr('file_path'))
+                        with np.load(path) as z:
+                            fix[path] = [z['arr_%d' % i]
+                                         for i in range(len(z.files))]
+            c['fixtures'] = fix
+            with open(p, 'wb') as f:
+                pickle.dump(c, f, protocol=4)
         print(" ", os.path.basename(p), c['new_ops'])
 
 

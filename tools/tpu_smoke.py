@@ -1,0 +1,107 @@
+"""On-chip smoke of heterogeneous (host-op) execution — the persistent
+form of the round-5 done-criterion "a TPU-backend test runs a
+py_func-containing program end-to-end" (VERDICT r4 #2).
+
+Runs three programs on the real chip through Executor's segmented path
+(the relay backend rejects host callbacks inside compiled programs, so
+py_func / print / detection_map execute as eager host steps between
+compiled device segments — executor.py _run_segmented):
+
+  1. fc -> py_func(tanh+1 on host) -> scale -> Print   (+ numeric check)
+  2. detection_map over LoD feeds                       (mAP == 1.0)
+  3. a train step with Print after the optimizer        (loss falls)
+
+Usage: python tools/tpu_smoke.py   (prints SMOKE_OK on success)
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    import jax
+    jax.config.update('jax_default_matmul_precision', 'highest')
+    import paddle_tpu as fluid
+    from paddle_tpu.framework import Program, program_guard
+
+    assert jax.devices()[0].platform == 'tpu', "needs the TPU chip"
+
+    # 1) py_func + print between device segments
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        h = fluid.layers.fc(x, size=8, param_attr='smoke_w',
+                            bias_attr=False)
+        out_var = prog.global_block().create_var(
+            name='smoke_pyf', shape=(3, 8), dtype='float32')
+        fluid.layers.py_func(lambda a: np.tanh(a) + 1.0, h, out_var)
+        y = fluid.layers.scale(out_var, scale=3.0)
+        yp = fluid.layers.Print(y, message='tpu smoke y:')
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    scope = fluid.Scope()
+    X = np.random.RandomState(0).randn(3, 4).astype('float32')
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        o, = exe.run(prog, feed={'x': X}, fetch_list=[yp], scope=scope)
+    W = np.asarray(scope.get('smoke_w'))
+    ref = 3.0 * (np.tanh(X @ W) + 1.0)
+    err = float(np.abs(np.asarray(o) - ref).max())
+    assert err < 1e-4, "py_func segmented result off by %g" % err
+    print("py_func segment OK (max err %.2e)" % err)
+
+    # 2) detection_map (host metric) with LoD feeds
+    det = np.array([[0, 0.9, 0.1, 0.1, 0.4, 0.4],
+                    [0, 0.3, 0.5, 0.5, 0.9, 0.9],
+                    [1, 0.8, 0.2, 0.2, 0.6, 0.6]], np.float32)
+    lab = np.array([[0, 0, 0.1, 0.1, 0.4, 0.4],
+                    [1, 0, 0.2, 0.2, 0.6, 0.6]], np.float32)
+    prog2, startup2 = Program(), Program()
+    with program_guard(prog2, startup2):
+        d = fluid.layers.data(name='det', shape=[6], dtype='float32',
+                              lod_level=1)
+        g = fluid.layers.data(name='lab', shape=[6], dtype='float32',
+                              lod_level=1)
+        m = fluid.layers.detection_map(d, g, class_num=2)
+    s2 = fluid.Scope()
+    with fluid.scope_guard(s2):
+        exe.run(startup2, scope=s2)
+        out, = exe.run(prog2, feed={'det': (det, [[0, 3]]),
+                                    'lab': (lab, [[0, 2]])},
+                       fetch_list=[m], scope=s2)
+    v = float(np.asarray(out).reshape(-1)[0])
+    assert v > 0.9, "detection_map %g" % v
+    print("detection_map segment OK (mAP %.3f)" % v)
+
+    # 3) full train step with a Print after the optimizer
+    prog3, startup3 = Program(), Program()
+    with program_guard(prog3, startup3):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        yv = fluid.layers.data(name='y', shape=[1], dtype='float32')
+        pred = fluid.layers.fc(x, size=1, param_attr='smoke_w3',
+                               bias_attr=False)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(pred, yv))
+        loss_p = fluid.layers.Print(loss, message='smoke loss:')
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    s3 = fluid.Scope()
+    rng = np.random.RandomState(2)
+    Xt = rng.randn(16, 4).astype('float32')
+    Yt = (Xt @ np.array([[1.], [2.], [-1.], [0.5]], np.float32))
+    losses = []
+    with fluid.scope_guard(s3):
+        exe.run(startup3, scope=s3)
+        for _ in range(5):
+            l, = exe.run(prog3, feed={'x': Xt, 'y': Yt},
+                         fetch_list=[loss_p], scope=s3)
+            losses.append(float(np.asarray(l).reshape(-1)[0]))
+    assert losses[-1] < losses[0], losses
+    print("train-with-Print OK (loss %.4f -> %.4f)"
+          % (losses[0], losses[-1]))
+    print("SMOKE_OK")
+
+
+if __name__ == '__main__':
+    main()
