@@ -533,8 +533,12 @@ def _bench_inference(rounds=9, deadline=None):
                     continue
                 feed = make_feed(b)
                 pred.run(feed)                       # compile
+                # a >8 MB feed makes each call relay-upload-bound
+                # (~10 s for b128 images): fewer rounds, same p50 story
+                n_bytes = sum(np.asarray(v).nbytes for v in feed.values())
+                n_rounds = min(rounds, 5) if n_bytes > (8 << 20) else rounds
                 times = []
-                for _ in range(rounds):
+                for _ in range(n_rounds):
                     t0 = time.time()
                     pred.run(feed)
                     times.append((time.time() - t0) * 1000)
@@ -692,15 +696,20 @@ def _child(mode):
         _set_mfu('bert_base')
         _try('se_resnext', _bench_se_resnext, 128, 4, 2, True)
         _try('vgg16', _bench_vgg, 128, 10, 3, True)
-        _try('machine_translation', _bench_nmt, 32, 30, 6, 2)
         _try('ctr_sharded_v1m', _bench_ctr, 512, 20, 2,
              vocab=1 << 20, dim=32, is_distributed=True)
         _try('stacked_lstm', _bench_stacked_lstm, 32, 128, 10, 2)
         _try('ctr_sparse', _bench_ctr, 512, 50, 3)
-        # inference needs ~4 fresh compiles; cap it at the child budget
-        # minus headroom for JSON emission
+        # inference (~6 fresh compiles, 2 models) runs BEFORE nmt: its two
+        # rows are required deliverables, while nmt's ~500 s while-loop
+        # train compile is the budget whale — nmt goes last so the
+        # elapsed-budget guard above makes IT the row that absorbs
+        # chip-contention overruns, not everything after it. Bounded at
+        # ~600 s so a hung relay can't starve nmt in the good case.
         _try('inference', _bench_inference,
-             deadline=start + TPU_MODEL_BUDGET_S - 120)
+             deadline=min(start + TPU_MODEL_BUDGET_S - 120,
+                          time.time() + 600))
+        _try('machine_translation', _bench_nmt, 32, 30, 6, 2)
     for r in models.values():
         r.pop('flops_per_step', None)
     flag.pop('flops_per_step', None)

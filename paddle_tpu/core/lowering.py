@@ -29,12 +29,18 @@ class LowerContext(object):
     """Mutable environment while tracing one block: var name -> jax value."""
 
     def __init__(self, program, block, env, base_key, wrt=(), params=None,
-                 lods=None, statics=None):
+                 lods=None, statics=None, op_offset=0):
         self.program = program
         self.block = block
         self.env = env
         self.base_key = base_key
         self.op_index = 0
+        # rng() folds op_offset + op_index: a host-op segment (executor
+        # _run_segmented) slices the global block at plo, so its offset is
+        # plo — making per-op PRNG keys identical to the unsegmented
+        # program's. NOT inherited by child (sub-block) contexts: child
+        # blocks keep their own indexing in both execution modes.
+        self.op_offset = op_offset
         self.wrt = set(wrt)
         # extra knobs lowerings may consult
         self.params = params or {}
@@ -182,7 +188,8 @@ class LowerContext(object):
 
     # ---- rng -------------------------------------------------------------
     def rng(self):
-        key = jax.random.fold_in(self.base_key, self.op_index)
+        key = jax.random.fold_in(self.base_key,
+                                 self.op_offset + self.op_index)
         seed = self.program.random_seed
         if seed:
             key = jax.random.fold_in(key, seed)
@@ -537,7 +544,9 @@ def build_fn(program, fetch_names, read_names, written_names,
         ctx = LowerContext(program, program.global_block(), env, key,
                            params=lower_params,
                            lods=dict(static_lods or {}),
-                           statics=dict(static_feed or {}))
+                           statics=dict(static_feed or {}),
+                           op_offset=(lower_params or {}).get(
+                               'op_offset', 0))
         lower_block(ctx)
         env = ctx.env
         if lod_out is not None:
@@ -551,7 +560,8 @@ def build_fn(program, fetch_names, read_names, written_names,
 
 
 def build_callable(program, fetch_names, read_names, written_names,
-                   static_lods=None, static_feed=None, lod_out=None):
+                   static_lods=None, static_feed=None, lod_out=None,
+                   lower_params=None):
     """Single-device compile of build_fn.
 
     rw_state (read-and-written persistables, e.g. params being optimized) is
@@ -561,6 +571,7 @@ def build_callable(program, fetch_names, read_names, written_names,
     fn, ro_names, rw_names = build_fn(program, fetch_names, read_names,
                                       written_names, static_lods=static_lods,
                                       static_feed=static_feed,
-                                      lod_out=lod_out)
+                                      lod_out=lod_out,
+                                      lower_params=lower_params)
     jitted = jax.jit(fn, donate_argnums=(2,))
     return jitted, ro_names, rw_names

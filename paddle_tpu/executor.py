@@ -423,7 +423,7 @@ class Executor(object):
         for n in entry.ro_names:
             ro_state[n] = self._state_value(scope, n, program)
         for n in entry.rw_names:
-            rw_state[n] = self._state_value(scope, n, program)
+            rw_state[n] = self._state_value(scope, n, program, cache=False)
 
         self._run_counter += 1
         key_arr = _run_key(program.random_seed, _next_program_run(program),
@@ -533,7 +533,7 @@ class Executor(object):
                 and not (gb._find_var_recursive(n) is not None
                          and gb._find_var_recursive(n).persistable))
             plan.append({'kind': kind, 'sub': sub, 'ins': ins,
-                         'crossing': crossing})
+                         'crossing': crossing, 'lo': plo})
         return plan
 
     def _run_segmented(self, program, feed, fetch_names, scope,
@@ -565,23 +565,30 @@ class Executor(object):
                 needed = self._read_before_write(
                     sub, read, written, set(seg_feed), seg_fetch)
                 lod_out = {}
+                # op_offset = the segment's slice start in the original
+                # block, so every op derives the SAME per-op PRNG key as
+                # the unsegmented program (rng streams must not depend on
+                # where host ops split the program, and two RNG ops at
+                # equal within-segment indices must not collide)
                 if seg['kind'] == 'dev':
                     fn, ro_names, rw_names = lowering.build_callable(
                         sub, seg_fetch, needed, written,
                         static_lods=lod_env, static_feed=static_feed,
-                        lod_out=lod_out)
+                        lod_out=lod_out,
+                        lower_params={'op_offset': seg['lo']})
                 else:
                     fn, ro_names, rw_names = lowering.build_fn(
                         sub, seg_fetch, needed, written,
                         static_lods=lod_env, static_feed=static_feed,
                         lod_out=lod_out,
-                        lower_params={'host_eager': True})
+                        lower_params={'host_eager': True,
+                                      'op_offset': seg['lo']})
                 entry = _CompiledEntry(fn, seg_fetch, ro_names, rw_names,
                                        written, sub, lod_out)
                 seg['entry'] = entry
             ro = {n: self._state_value(scope, n, program)
                   for n in entry.ro_names}
-            rw = {n: self._state_value(scope, n, program)
+            rw = {n: self._state_value(scope, n, program, cache=False)
                   for n in entry.rw_names}
             if seg['kind'] == 'host':
                 # transfer only the crossing vars; run the op eagerly —
@@ -812,7 +819,7 @@ class Executor(object):
 
         ro_state = {n: self._state_value(scope, n, program)
                     for n in entry.ro_names}
-        rw_state = {n: self._state_value(scope, n, program)
+        rw_state = {n: self._state_value(scope, n, program, cache=False)
                     for n in entry.rw_names}
         self._run_counter += 1
         key_arr = _run_key(program.random_seed, _next_program_run(program),
@@ -829,7 +836,7 @@ class Executor(object):
         return list(fetches)
 
     # ------------------------------------------------------------------
-    def _state_value(self, scope, name, program):
+    def _state_value(self, scope, name, program, cache=True):
         v = scope.get(name)
         if v is None:
             raise RuntimeError(
@@ -847,9 +854,25 @@ class Executor(object):
             # leak back into the scope (save_persistables would then
             # checkpoint the narrowed array).
             dv = jnp.asarray(v)
-            if isinstance(v, np.ndarray) and dv.dtype == v.dtype \
+            if cache and isinstance(v, np.ndarray) and dv.dtype == v.dtype \
                     and dv.shape == v.shape:
-                scope.update({name: dv})
+                # The scope now answers reads from the device copy, so a
+                # later IN-PLACE write through the caller's numpy alias
+                # would be silently dropped. Freeze the caller's buffer so
+                # that write raises loudly instead (rebind via scope.set /
+                # tensor.set to update). A view (v.base is not None) can't
+                # be frozen against writes through its base — skip caching
+                # and keep re-converting those. Callers pass cache=False
+                # for read-AND-written names: new_state rebinds those
+                # right after the run, so the scope never aliases the
+                # caller's buffer past the call and freezing it would
+                # break legitimate host-side reuse of an init buffer.
+                if v.base is None:
+                    try:
+                        v.flags.writeable = False
+                    except ValueError:
+                        return dv
+                    scope.update({name: dv})
             return dv
         return v
 

@@ -357,3 +357,92 @@ def test_net_drawer(tmp_path):
     import json
     summary = json.loads(fluid.net_drawer.op_summary(main))
     assert any(o['type'] == 'mul' for o in summary)
+
+
+class TestScopeDeviceCache(object):
+    """Executor._state_value caches the device copy of read-only numpy
+    state back into the scope (the predictor serving-latency win) and
+    FREEZES the caller's buffer so a later in-place write raises instead
+    of being silently dropped against the cached copy."""
+
+    def _linear_prog(self):
+        from paddle_tpu.framework import Program, program_guard
+        prog, startup = Program(), Program()
+        with program_guard(prog, startup):
+            x = fluid.layers.data(name='x', shape=[3], dtype='float32')
+            y = fluid.layers.mul(
+                x, fluid.default_main_program().global_block().create_var(
+                    name='cache_w', shape=(3, 2), dtype='float32',
+                    persistable=True))
+        return prog, startup, y
+
+    def test_inplace_write_after_run_raises(self):
+        prog, startup, y = self._linear_prog()
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        # .copy() so w OWNS its buffer (a reshape view is never cached)
+        w = np.arange(6, dtype=np.float32).reshape(3, 2).copy()
+        X = np.ones((1, 3), np.float32)
+        with fluid.scope_guard(scope):
+            scope.set('cache_w', w)
+            o1, = exe.run(prog, feed={'x': X}, fetch_list=[y], scope=scope)
+            with pytest.raises(ValueError):
+                w[:] = 0.0  # buffer frozen: loud, not silently stale
+            np.testing.assert_allclose(np.asarray(o1), X @ w)
+
+    def test_rebind_via_scope_set_is_observed(self):
+        prog, startup, y = self._linear_prog()
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        w = np.arange(6, dtype=np.float32).reshape(3, 2)
+        X = np.ones((1, 3), np.float32)
+        with fluid.scope_guard(scope):
+            scope.set('cache_w', w)
+            exe.run(prog, feed={'x': X}, fetch_list=[y], scope=scope)
+            w2 = -np.arange(6, dtype=np.float32).reshape(3, 2)
+            scope.set('cache_w', w2)  # rebinding is the supported update
+            o2, = exe.run(prog, feed={'x': X}, fetch_list=[y], scope=scope)
+            np.testing.assert_allclose(np.asarray(o2), X @ w2)
+
+    def test_view_state_not_frozen_and_stays_live(self):
+        """A numpy VIEW can't be frozen against writes through its base,
+        so it is not cached — mutations through the base stay observed."""
+        prog, startup, y = self._linear_prog()
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        base = np.zeros((4, 2), np.float32)
+        w = base[:3]
+        X = np.ones((1, 3), np.float32)
+        with fluid.scope_guard(scope):
+            scope.set('cache_w', w)
+            exe.run(prog, feed={'x': X}, fetch_list=[y], scope=scope)
+            base[:3] = np.arange(6, dtype=np.float32).reshape(3, 2)
+            o2, = exe.run(prog, feed={'x': X}, fetch_list=[y], scope=scope)
+            np.testing.assert_allclose(np.asarray(o2), X @ w)
+
+    def test_trainable_state_buffer_not_frozen(self):
+        """rw (read-and-written) state is rebound by new_state right after
+        the run — the caller's init buffer must stay writable for
+        legitimate host-side reuse."""
+        from paddle_tpu.framework import Program, program_guard
+        prog, startup = Program(), Program()
+        with program_guard(prog, startup):
+            x = fluid.layers.data(name='x', shape=[3], dtype='float32')
+            y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+            pred = fluid.layers.fc(x, size=1, param_attr='cache_tw',
+                                   bias_attr=False)
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(pred, y))
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        w = np.zeros((3, 1), np.float32)
+        X = np.ones((2, 3), np.float32)
+        Y = np.ones((2, 1), np.float32)
+        with fluid.scope_guard(scope):
+            exe.run(startup, scope=scope)
+            scope.set('cache_tw', w)
+            exe.run(prog, feed={'x': X, 'y': Y}, fetch_list=[loss],
+                    scope=scope)
+        assert w.flags.writeable
+        w[:] = 7.0  # must not raise: the scope no longer aliases w

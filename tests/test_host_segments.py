@@ -64,7 +64,10 @@ class TestSegmentedExecution(object):
 
     def test_print_after_training_step(self, forced_segmentation, capsys):
         """print + a full train step: backward/optimizer segment compiles,
-        the print runs host-side, state updates land in the scope."""
+        the print runs host-side, state updates land in the scope. The
+        print op must come AFTER minimize — a host op inside the
+        differentiated forward span is not splittable (executor.py run())
+        and would silently take the ordinary path."""
         prog, startup = Program(), Program()
         with program_guard(prog, startup):
             x = fluid.layers.data(name='x', shape=[4], dtype='float32')
@@ -73,8 +76,8 @@ class TestSegmentedExecution(object):
                                    bias_attr=False)
             loss = fluid.layers.mean(
                 fluid.layers.square_error_cost(pred, y))
-            loss_p = fluid.layers.Print(loss, message='seg loss:')
             fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+            loss_p = fluid.layers.Print(loss, message='seg loss:')
         exe = fluid.Executor(fluid.CPUPlace())
         scope = fluid.Scope()
         rng = np.random.RandomState(2)
@@ -89,6 +92,43 @@ class TestSegmentedExecution(object):
                              fetch_list=[loss_p], scope=scope)
                 losses.append(float(np.asarray(l).reshape(-1)[0]))
         assert losses[-1] < losses[0]
+        # the segmented path really ran (not the ordinary compiled path)
+        assert any(isinstance(k, tuple) and k and k[0] == 'hostseg'
+                   for k in exe._cache), \
+            "print-after-minimize program did not take the segmented path"
+        # and the print op really printed, host-side
+        assert 'seg loss:' in capsys.readouterr().out
+
+    def test_rng_stream_independent_of_segmentation(self, monkeypatch):
+        """Per-op PRNG keys fold the op's GLOBAL block index (lowering
+        op_offset), so (a) two rng ops in different segments never draw
+        identical bits and (b) the segmented stream matches the
+        unsegmented program exactly."""
+        def _run(mode):
+            monkeypatch.setenv('PADDLE_SEGMENT_HOST_OPS', mode)
+            prog, startup = Program(), Program()
+            prog.random_seed = 1234
+            with program_guard(prog, startup):
+                a = fluid.layers.uniform_random([2, 3])
+                a_p = fluid.layers.Print(a, message='rngseg:')
+                b = fluid.layers.uniform_random([2, 3])
+                out = fluid.layers.elementwise_add(a_p, b)
+            exe = fluid.Executor(fluid.CPUPlace())
+            scope = fluid.Scope()
+            with fluid.scope_guard(scope):
+                exe.run(startup, scope=scope)
+                av, bv, _ = exe.run(prog, fetch_list=[a, b, out],
+                                    scope=scope)
+            return np.asarray(av), np.asarray(bv)
+
+        a1, b1 = _run('1')
+        a0, b0 = _run('0')
+        # (a) the two draws sit at the same within-segment index (0) in
+        # different segments — they must still be distinct
+        assert not np.array_equal(a1, b1)
+        # (b) segmentation must not change the random stream
+        np.testing.assert_array_equal(a1, a0)
+        np.testing.assert_array_equal(b1, b0)
 
     def test_statefulness_across_segments(self, forced_segmentation):
         """A persistable var updated before a host op is visible after it."""

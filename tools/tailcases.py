@@ -227,10 +227,9 @@ def main():
             for b in c['program'].blocks:
                 for op in b.ops:
                     if op.type in ('load', 'load_combine'):
+                        from paddle_tpu.ops.fused_ops import _npz_arrays
                         path = str(op.attr('file_path'))
-                        with np.load(path) as z:
-                            fix[path] = [z['arr_%d' % i]
-                                         for i in range(len(z.files))]
+                        fix[path] = _npz_arrays(path)
             c['fixtures'] = fix
             with open(p, 'wb') as f:
                 pickle.dump(c, f, protocol=4)

@@ -253,7 +253,7 @@ def _ensure_fixtures(case):
     for path, arrays in (case.get('fixtures') or {}).items():
         if path.startswith(_FIX_PREFIX) and not os.path.exists(path):
             os.makedirs(os.path.dirname(path), exist_ok=True)
-            np.savez(open(path, 'wb'), *arrays)
+            np.savez(path, *arrays)
 
 
 def _run_via_executor(case):
