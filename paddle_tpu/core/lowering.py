@@ -196,12 +196,17 @@ class LowerContext(object):
         return key
 
     def child(self, env, wrt=None, block=None):
+        # SAME-block children (backward vjp spans, recompute) keep this
+        # context's op_offset so their lower_ops indices stay global;
+        # sub-BLOCK children reset to 0 — child blocks fold their own
+        # indexing identically in segmented and unsegmented execution.
         c = LowerContext(self.program,
                          self.block if block is None else block,
                          env, self.base_key,
                          wrt=self.wrt if wrt is None else wrt,
                          params=self.params, lods=self.lods,
-                         statics=self.statics)
+                         statics=self.statics,
+                         op_offset=self.op_offset if block is None else 0)
         return c
 
 

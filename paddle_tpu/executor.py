@@ -862,7 +862,11 @@ class Executor(object):
                 # that write raises loudly instead (rebind via scope.set /
                 # tensor.set to update). A view (v.base is not None) can't
                 # be frozen against writes through its base — skip caching
-                # and keep re-converting those. Callers pass cache=False
+                # and keep re-converting those. (Known gap: a view the
+                # CALLER created before this freeze stays writable —
+                # numpy does not propagate writeable=False to existing
+                # views — so writes through such an alias are still
+                # silently dropped.) Callers pass cache=False
                 # for read-AND-written names: new_state rebinds those
                 # right after the run, so the scope never aliases the
                 # caller's buffer past the call and freezing it would

@@ -42,8 +42,10 @@ def _seed_seen(d):
     optest_collect._seen_ops.update(seen)
     # save/load appear in old corpus cases that are NOT replayable (temp
     # paths); un-see them so the fixed-path fixture cases below record
+    # ... and py_func: corpus py_func cases carry anonymous callables
+    # (never replayable); the tail case uses a named importable one
     optest_collect._seen_ops.difference_update(
-        {'save', 'save_combine', 'load', 'load_combine'})
+        {'save', 'save_combine', 'load', 'load_combine', 'py_func'})
     optest_collect._case_counter[0] = 8999
 
 
@@ -183,6 +185,50 @@ def case_load():
     np.testing.assert_allclose(np.asarray(got), 7.0 * X, rtol=1e-6)
 
 
+def case_is_empty():
+    """is_empty (static emptiness predicate, meta.py). Round-5 replay
+    exposed that its prior chip 'coverage' came from a stale cached part
+    whose case files had been re-collected away — give it a real case."""
+    import paddle_tpu as fluid
+    from paddle_tpu.framework import Program, program_guard
+    main_p, startup = Program(), Program()
+    with program_guard(main_p, startup):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        e = fluid.layers.control_flow.is_empty(x)
+        out = fluid.layers.cast(e, 'float32')
+    X = np.random.RandomState(3).randn(2, 4).astype('float32')
+    got, = _run(main_p, startup, {'x': X}, [out])
+    assert float(np.asarray(got).reshape(-1)[0]) == 0.0
+
+
+def _tail_pyfunc(a):
+    """Module-level so the replay process can re-import it by dotted name
+    (the py_func op stores only a process-local registry index)."""
+    return np.tanh(a) + 0.5
+
+
+def case_py_func():
+    """py_func through the executor's segmented path — the one op the
+    chip corpus couldn't replay (VERDICT r4 #8 'or item 2 covers
+    py_func/print too'). The callable is a named module-level function;
+    main() embeds its dotted name so tools/tpu_optest.py re-registers it
+    in the replay process."""
+    import paddle_tpu as fluid
+    from paddle_tpu.framework import Program, program_guard
+    main_p, startup = Program(), Program()
+    with program_guard(main_p, startup):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        h = fluid.layers.scale(x, scale=2.0)
+        out_var = main_p.global_block().create_var(
+            name='pyf_out', shape=(3, 4), dtype='float32')
+        fluid.layers.py_func(_tail_pyfunc, h, out_var)
+        y = fluid.layers.scale(out_var, scale=3.0)
+    X = np.random.RandomState(7).randn(3, 4).astype('float32')
+    out, = _run(main_p, startup, {'x': X}, [y])
+    np.testing.assert_allclose(
+        np.asarray(out), 3.0 * (np.tanh(2.0 * X) + 0.5), rtol=1e-6)
+
+
 def case_switch_moe():
     import paddle_tpu as fluid
     from paddle_tpu.framework import Program, program_guard
@@ -211,7 +257,8 @@ def main():
         os.remove(old)
     _seed_seen(d)
     for fn in (case_print_and_shrink, case_split_selected_rows,
-               case_gpipe_run, case_switch_moe, case_save, case_load):
+               case_gpipe_run, case_switch_moe, case_py_func,
+               case_is_empty, case_save, case_load):
         fn()
         print("ok:", fn.__name__)
     new = sorted(glob.glob(os.path.join(d, 'case_9*.pkl')))
@@ -231,6 +278,32 @@ def main():
                         path = str(op.attr('file_path'))
                         fix[path] = _npz_arrays(path)
             c['fixtures'] = fix
+            with open(p, 'wb') as f:
+                pickle.dump(c, f, protocol=4)
+        # embed dotted names for py_func callables so the replay process
+        # can re-register them at the recorded ids (the op attr is a
+        # process-local registry index)
+        if 'py_func' in c['ops']:
+            from paddle_tpu.ops.misc_ops import _py_func_registry
+            pf = {}
+            for b in c['program'].blocks:
+                for op in b.ops:
+                    if op.type != 'py_func':
+                        continue
+                    ids = [int(op.attr('forward_callable_id'))]
+                    bid = int(op.attr('backward_callable_id', -1))
+                    if bid >= 0:
+                        ids.append(bid)
+                    for cid in ids:
+                        fn = _py_func_registry[cid]
+                        # running as a script makes __module__ '__main__',
+                        # which the replay process can't import — record
+                        # the importable module path instead
+                        mod = fn.__module__
+                        if mod == '__main__':
+                            mod = 'tools.tailcases'
+                        pf[cid] = '%s:%s' % (mod, fn.__qualname__)
+            c['py_funcs'] = pf
             with open(p, 'wb') as f:
                 pickle.dump(c, f, protocol=4)
         print(" ", os.path.basename(p), c['new_ops'])

@@ -222,7 +222,8 @@ _FIX_PREFIX = '/tmp/paddle_optest_fixtures'
 # ops whose replay must go through the executor's segmented heterogeneous
 # path (host callbacks are rejected by the relay backend inside jit);
 # replayed one case at a time via a real Executor run
-_SEGMENT_REPLAY = {'detection_map', 'print', 'save', 'save_combine'}
+_SEGMENT_REPLAY = {'detection_map', 'print', 'save', 'save_combine',
+                   'py_func'}
 
 
 # conv-family ops whose BACKWARD, compiled at matmul precision 'highest',
@@ -254,6 +255,24 @@ def _ensure_fixtures(case):
         if path.startswith(_FIX_PREFIX) and not os.path.exists(path):
             os.makedirs(os.path.dirname(path), exist_ok=True)
             np.savez(path, *arrays)
+
+
+def _ensure_py_funcs(case):
+    """Install the case's py_func callables into THIS process's registry
+    at their recorded ids (tools/tailcases.py embeds 'module:qualname'
+    names for importable top-level functions — the py_func op only
+    stores a process-local registry index)."""
+    import importlib
+    from paddle_tpu.ops.misc_ops import _py_func_registry
+    for cid, dotted in (case.get('py_funcs') or {}).items():
+        cid = int(cid)
+        mod, _, qual = dotted.partition(':')
+        fn = importlib.import_module(mod)
+        for part in qual.split('.'):
+            fn = getattr(fn, part)
+        while len(_py_func_registry) <= cid:
+            _py_func_registry.append(None)
+        _py_func_registry[cid] = fn
 
 
 def _run_via_executor(case):
@@ -289,7 +308,20 @@ def _replayable(case):
     run's temp files."""
     ops = set(case['ops'])
     if 'py_func' in ops:
-        return False
+        # replayable iff every callable id used by the program has an
+        # importable dotted name embedded (tools/tailcases.py); ordinary
+        # collected py_func cases carry anonymous callables and stay out
+        ids = set()
+        for b in case['program'].blocks:
+            for op in b.ops:
+                if op.type == 'py_func':
+                    ids.add(int(op.attr('forward_callable_id')))
+                    bid = int(op.attr('backward_callable_id', -1))
+                    if bid >= 0:
+                        ids.add(bid)
+        have = {int(k) for k in (case.get('py_funcs') or {})}
+        if not ids <= have:
+            return False
     if _SAVELOAD & ops:
         for b in case['program'].blocks:
             for op in b.ops:
@@ -359,6 +391,16 @@ def _replay_chunks(cases, report, covered, base=0):
         built = []
         for name, case in chunk:
             _ensure_fixtures(case)
+            try:
+                _ensure_py_funcs(case)
+            except Exception as e:
+                # an unresolvable callable must fail THIS case, not the
+                # whole window
+                report['failures'].append(
+                    {'case': name, 'stage': 'py-func-install',
+                     'new_ops': case['new_ops'],
+                     'error': '%s: %s' % (type(e).__name__, str(e)[:200])})
+                continue
             if _SEGMENT_REPLAY & set(case['ops']):
                 try:
                     got = _run_via_executor(case)
