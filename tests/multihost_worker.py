@@ -71,6 +71,54 @@ def main():
 
     mode = os.environ.get('MH_MODE', 'dp')
     losses = []
+    if mode == 'pipe':
+        # pipeline parallelism ACROSS processes: mesh('pipe', 4) spans
+        # both workers' devices, so each gpipe_run microbatch ppermute
+        # crosses the process boundary (the multi-host analog of the
+        # reference's pipeline trainers; section-per-device
+        # pipeline_trainer). Serial reference computed locally — both
+        # processes build identical programs/feeds from shared seeds.
+        from paddle_tpu.parallel import make_mesh, MeshRunner
+        from paddle_tpu.models.transformer import build_lm, LMConfig
+        cfg = LMConfig(vocab_size=64, seq_len=8, d_model=16, n_head=2,
+                       n_layer=4, d_ff=32, dropout=0.0, attn_dropout=0.0,
+                       use_flash_attention=False)
+
+        def _lm_prog():
+            mp, sp = fluid.Program(), fluid.Program()
+            mp.random_seed = sp.random_seed = 31
+            with fluid.program_guard(mp, sp):
+                tokens, labels, logits, avg_loss = build_lm(cfg)
+                fluid.optimizer.Adam(learning_rate=1e-3).minimize(avg_loss)
+            return mp, sp, avg_loss
+
+        rngp = np.random.RandomState(6)
+        pfeeds = [{'tokens': rngp.randint(
+                       0, cfg.vocab_size, (8, cfg.seq_len)).astype('int64'),
+                   'labels': rngp.randint(
+                       0, cfg.vocab_size, (8, cfg.seq_len)).astype('int64')}
+                  for _ in range(3)]
+        mp1, sp1, l1 = _lm_prog()
+        sref = fluid.Scope()
+        with fluid.scope_guard(sref):
+            exe.run(sp1, scope=sref)
+            ref = [float(np.asarray(exe.run(
+                       mp1, feed=f, fetch_list=[l1], scope=sref)[0]
+                   ).reshape(())) for f in pfeeds]
+        mp2, sp2, l2 = _lm_prog()
+        ndev = jax.device_count()
+        fluid.transpiler.PipelineTranspiler().transpile(mp2,
+                                                        num_stages=ndev)
+        mesh = make_mesh([('pipe', ndev)])
+        runner = MeshRunner(mp2, mesh)
+        s2 = fluid.Scope()
+        with fluid.scope_guard(s2):
+            exe.run(sp2, scope=s2)
+            got = [float(np.asarray(runner.run(
+                       f, [l2.name], s2)[0]).reshape(()))
+                   for f in pfeeds]
+        print("LOSSES:" + json.dumps({'ref': ref, 'pipe': got}))
+        return
     if mode == 'ckpt':
         # kill-and-resume drill (reference io.py
         # _save_distributed_persistables + unittests/dist_save_load.py):

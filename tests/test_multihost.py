@@ -200,3 +200,17 @@ def test_four_process_dp_tp_mesh():
         np.testing.assert_allclose(results[0], other, rtol=1e-5,
                                    atol=1e-6)
     assert all(np.isfinite(results[0]))
+
+
+def test_two_process_pipeline_matches_serial():
+    """Pipeline parallelism ACROSS processes: PipelineTranspiler +
+    mesh('pipe', 4) spanning 2 workers x 2 devices — every microbatch
+    ppermute crosses the process boundary — must reproduce the serial
+    loss trajectory (fwd + bwd + Adam through the gpipe schedule)."""
+    results = _run_workers(2, env_extra={'MH_MODE': 'pipe'}, timeout=420)
+    for r in results:
+        np.testing.assert_allclose(r['pipe'], r['ref'],
+                                   rtol=2e-4, atol=2e-5)
+        assert all(np.isfinite(r['ref']))
+    np.testing.assert_allclose(results[0]['pipe'], results[1]['pipe'],
+                               rtol=1e-6, atol=0)
