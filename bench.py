@@ -553,7 +553,13 @@ def _bench_inference(rounds=9, deadline=None):
                 # b128 only: each machine window is another full compile.
                 if b != 128 or _over():
                     continue
-                k = 8
+                # Differential windows (the lstmroof.py slope method):
+                # machine_ms = (t(k2) - t(k1)) / (k2 - k1), best-of-3
+                # per window. A single fixed-k window divides the RELAY
+                # round-trip (0.1-6 s depending on tunnel load) by k and
+                # leaks it into the number; the slope cancels the
+                # constant term entirely.
+                k1, k2 = 8, 40
                 import jax
                 import jax.numpy as jnp
 
@@ -562,19 +568,42 @@ def _bench_inference(rounds=9, deadline=None):
                     if arr.dtype.kind == 'f' and arr.nbytes > (1 << 20):
                         key = jax.random.PRNGKey(0)
                         return jax.random.normal(
-                            key, (k,) + arr.shape, jnp.float32)
-                    return jax.device_put(np.stack([arr] * k))
+                            key, (k1,) + arr.shape, jnp.float32)
+                    return jax.device_put(np.stack([arr] * k1))
                 stacked = {kk: _stage(v) for kk, v in feed.items()}
-                with fluid.scope_guard(pred.scope):
-                    pred.executor.run_fused(
-                        pred.program, stacked,
-                        fetch_list=pred.fetch_vars, steps=k)   # compile
-                    t0 = time.time()
-                    pred.executor.run_fused(
-                        pred.program, stacked,
-                        fetch_list=pred.fetch_vars, steps=k)
-                    dt = time.time() - t0
-                row['machine_ms_b%d' % b] = round(dt * 1000 / k, 2)
+
+                def _timed(n_steps):
+                    with fluid.scope_guard(pred.scope):
+                        pred.executor.run_fused(
+                            pred.program, stacked,
+                            fetch_list=pred.fetch_vars,
+                            steps=n_steps)                    # compile
+                        best = float('inf')
+                        for _ in range(3):
+                            t0 = time.time()
+                            pred.executor.run_fused(
+                                pred.program, stacked,
+                                fetch_list=pred.fetch_vars,
+                                steps=n_steps)
+                            best = min(best, time.time() - t0)
+                    return best
+                t1 = _timed(k1)
+                if _over():
+                    continue
+                t2 = _timed(k2)
+                # best-of-3 only rejects jitter when at least one sample
+                # per window is clean; a non-positive slope means the
+                # relay moved under us — re-measure the pair once, and
+                # if it is STILL unstable publish the raw windows
+                # instead of a negative "serving rate"
+                if t2 <= t1 and not _over():
+                    t1, t2 = _timed(k1), _timed(k2)
+                if t2 > t1:
+                    row['machine_ms_b%d' % b] = round(
+                        (t2 - t1) * 1000 / (k2 - k1), 2)
+                else:
+                    row['machine_unstable_b%d' % b] = [
+                        round(t1, 3), round(t2, 3)]
             out[name] = row
         finally:
             shutil.rmtree(d, ignore_errors=True)

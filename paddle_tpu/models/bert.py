@@ -122,14 +122,26 @@ def build_bert_pretrain(cfg=None, is_test=False):
     return total, mlm_loss, nsp_loss
 
 
-def make_pretrain_batch(cfg, batch, rng):
-    """Synthetic pretraining batch with the BERT feed contract."""
+def make_pretrain_batch(cfg, batch, rng, toks=None):
+    """Synthetic pretraining batch with the BERT feed contract. `toks`
+    overrides the uniform-random token stream (shape [batch, L]) so
+    structured corpora (e.g. tools/convergence.py's Markov teacher) share
+    this masking/flat-position/[MASK]-id contract instead of copying it;
+    a faster vectorized position draw is used when batch is large."""
     L, P = cfg.seq_len, cfg.max_predictions
-    toks = rng.randint(4, cfg.vocab_size, (batch, L)).astype('int64')
+    if toks is None:
+        toks = rng.randint(4, cfg.vocab_size, (batch, L)).astype('int64')
+    else:
+        toks = np.asarray(toks, 'int64')
+        assert toks.shape == (batch, L), (toks.shape, batch, L)
     segs = np.zeros((batch, L), 'int64')
     segs[:, L // 2:] = 1
     mask = np.ones((batch, L), 'float32')
-    pos = np.stack([rng.choice(L, P, replace=False) for _ in range(batch)])
+    if batch > 256:
+        pos = np.argsort(rng.rand(batch, L), axis=1)[:, :P]
+    else:
+        pos = np.stack([rng.choice(L, P, replace=False)
+                        for _ in range(batch)])
     flat_pos = (pos + np.arange(batch)[:, None] * L).astype('int64')
     labels = np.take_along_axis(toks, pos, axis=1).astype('int64')
     toks_masked = toks.copy()

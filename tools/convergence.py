@@ -4,8 +4,10 @@ gives the flagship LM (2000-step run). Loss is reported at every fused
 window boundary, on teacher tasks with fresh batches per step inside a
 window — the loss can only fall by LEARNING the teacher structure.
 
-Usage:  python tools/convergence.py [resnet|ctr|both]
+Usage:  python tools/convergence.py [resnet|ctr|bert|both]
 Writes one JSON line per model: {"model", "steps", "losses": [...]}.
+'both' runs all three ('bert' was added round 5: MLM on a Markov
+teacher corpus).
 """
 import json
 import os
@@ -120,9 +122,100 @@ def run_ctr(windows=10, k=200, batch=512, vocab=100000, dim=16):
                       'wall_s': round(time.time() - t0, 1)}))
 
 
+def run_bert(windows=30, k=50, batch=64, teacher_vocab=4096, lr=3e-4,
+             n_layer=12, d_model=768, n_head=12, d_ff=3072, amp=True):
+    """BERT-base MLM on a MARKOV teacher corpus: tok[i+1] = perm[tok[i]]
+    with prob 0.9 (random otherwise), so a masked token is predictable
+    from either neighbor through a learnable vocab transition — MLM loss
+    can fall only by learning the corpus structure (the uniform
+    make_pretrain_batch corpus is unlearnable noise, right for
+    throughput rows, wrong for convergence evidence). The teacher lives
+    on a `teacher_vocab`-id subset of the full 30522 vocab (the full
+    model/softmax is unchanged): descent has two stages — support
+    (ln 30522 = 10.33 -> ln tv, learned in <50 steps) then transitions
+    (-> ~0.1*ln(tv) + H(0.9)). MEASURED (BASELINE.md appendix):
+    BERT-base completes the support stage and then plateaus at the
+    unigram floor for >=10^4 steps regardless of size/AMP/attention
+    path — the long attention-binding plateau of BERT-scale
+    pretraining — while the same program at toy scale (vocab 64,
+    L2 d32) descends through the floor within 15 steps on both CPU and
+    chip. Bench-budget runs therefore evidence the support stage and
+    numeric health, not full contextual convergence."""
+    import jax
+    import paddle_tpu as fluid
+    from paddle_tpu.contrib import mixed_precision as mp
+    from paddle_tpu.models.bert import (BertConfig, build_bert_pretrain,
+                                        make_pretrain_batch)
+
+    cfg = BertConfig(seq_len=128, max_predictions=20, n_layer=n_layer,
+                     d_model=d_model, n_head=n_head, d_ff=d_ff)
+    main_p, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup):
+        total, mlm, nsp = build_bert_pretrain(cfg)
+        # minimize MLM ONLY: the synthetic nsp labels are random noise,
+        # and this tool's purpose is convergence evidence — training the
+        # nsp head against coin flips would push unlearnable gradient
+        # into the shared encoder. (The bench throughput row keeps
+        # `total`, matching real pretraining cost.)
+        # plain AMP (fp32 activations) — the bench's proven BERT config;
+        # keep_bf16_activations NaNs bert's layer_norm/softmax stack
+        opt = fluid.optimizer.Adam(learning_rate=lr)
+        if amp:
+            opt = mp.decorate(opt)
+        opt.minimize(mlm)
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    V, L, P = cfg.vocab_size, cfg.seq_len, cfg.max_predictions
+    tv = min(teacher_vocab, V - 4)
+    perm = rng.permutation(np.arange(4, 4 + tv)).astype('int64')
+
+    def gen_tokens(n):
+        toks = np.empty((n, L), 'int64')
+        toks[:, 0] = rng.randint(4, 4 + tv, n)
+        for i in range(L - 1):
+            follow = rng.rand(n) < 0.9
+            toks[:, i + 1] = np.where(follow, perm[toks[:, i] - 4],
+                                      rng.randint(4, 4 + tv, n))
+        return toks
+
+    def make_window():
+        # per-step batches through the model's own masking/flat-position
+        # contract (make_pretrain_batch owns the [MASK] id and the
+        # positions-into-[batch*L] convention), stacked for run_fused
+        steps = [make_pretrain_batch(cfg, batch, rng,
+                                     toks=gen_tokens(batch))
+                 for _ in range(k)]
+        return {kk: jax.device_put(np.stack([s[kk] for s in steps]))
+                for kk in steps[0]}
+
+    losses = []
+    t0 = time.time()
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        for w in range(windows):
+            stacked = make_window()
+            jax.block_until_ready(stacked)
+            out = exe.run_fused(main_p, stacked, fetch_list=[mlm],
+                                scope=scope, steps=k)
+            losses.append(round(float(np.asarray(out[0]).reshape(-1)[0]),
+                                4))
+            print("bert window %d (step %d): mlm loss %.4f" %
+                  (w, (w + 1) * k, losses[-1]), flush=True)
+    print(json.dumps({'model': 'bert_markov_teacher',
+                      'config': 'L%d d%d h%d ff%d' % (n_layer, d_model,
+                                                      n_head, d_ff),
+                      'steps': windows * k, 'batch': batch,
+                      'teacher_vocab': tv, 'lr': lr, 'amp': bool(amp),
+                      'losses': losses,
+                      'wall_s': round(time.time() - t0, 1)}))
+
+
 if __name__ == '__main__':
     which = sys.argv[1] if len(sys.argv) > 1 else 'both'
     if which in ('resnet', 'both'):
         run_resnet()
     if which in ('ctr', 'both'):
         run_ctr()
+    if which in ('bert', 'both'):
+        run_bert()
