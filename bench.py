@@ -551,7 +551,10 @@ def _bench_inference(rounds=9, deadline=None):
                 # values (BERT's input_mask is a 0/1 contract; feeding it
                 # noise would corrupt the attention bias).
                 # b128 only: each machine window is another full compile.
-                if b != 128 or _over():
+                if b != 128:
+                    continue
+                if _over():
+                    row['skipped_machine_b%d' % b] = 'time budget'
                     continue
                 # Differential windows (the lstmroof.py slope method):
                 # machine_ms = (t(k2) - t(k1)) / (k2 - k1), best-of-3
@@ -589,6 +592,9 @@ def _bench_inference(rounds=9, deadline=None):
                     return best
                 t1 = _timed(k1)
                 if _over():
+                    # mark the cut so a consumer can tell 'metric cut by
+                    # budget' from 'bench version without the metric'
+                    row['skipped_machine_b%d' % b] = 'time budget'
                     continue
                 t2 = _timed(k2)
                 # best-of-3 only rejects jitter when at least one sample

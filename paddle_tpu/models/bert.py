@@ -137,11 +137,9 @@ def make_pretrain_batch(cfg, batch, rng, toks=None):
     segs = np.zeros((batch, L), 'int64')
     segs[:, L // 2:] = 1
     mask = np.ones((batch, L), 'float32')
-    if batch > 256:
-        pos = np.argsort(rng.rand(batch, L), axis=1)[:, :P]
-    else:
-        pos = np.stack([rng.choice(L, P, replace=False)
-                        for _ in range(batch)])
+    # vectorized uniform P-subset without replacement (same distribution
+    # as a per-row rng.choice loop, one draw for the whole batch)
+    pos = np.argsort(rng.rand(batch, L), axis=1)[:, :P]
     flat_pos = (pos + np.arange(batch)[:, None] * L).astype('int64')
     labels = np.take_along_axis(toks, pos, axis=1).astype('int64')
     toks_masked = toks.copy()

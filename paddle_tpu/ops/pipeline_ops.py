@@ -106,8 +106,18 @@ def _gpipe_run(ctx, op):
             _papi._ACTIVE_MESH = prev
         return x
 
+    # compose with data parallelism when the mesh carries a 'data' axis:
+    # microbatch rows shard over it and param cotangents psum over it
+    # (parallel/pipeline.py batch_axis) — falls back to replication when
+    # the per-microbatch row count does not divide the axis
+    n_micro = int(op.attr('num_microbatches') or 0) or n_stages
+    batch_axis = None
+    if mesh.shape.get('data', 1) > 1:
+        b0 = int(jnp.shape(act[0])[0])
+        if b0 % n_micro == 0 and (b0 // n_micro) % mesh.shape['data'] == 0:
+            batch_axis = 'data'
     out = gpipe(stage_fn, stacked, act, mesh,
-                num_microbatches=int(op.attr('num_microbatches') or 0)
-                or None, extra=shared_vals)
+                num_microbatches=n_micro, extra=shared_vals,
+                batch_axis=batch_axis)
     for j, n in enumerate(op.output('Out')):
         ctx.set(n, out[j])

@@ -25,16 +25,23 @@ from jax.sharding import PartitionSpec as P
 __all__ = ['gpipe', 'gpipe_1f1b_grad']
 
 
-def _gpipe_inner(axis_name, stage_fn, n_micro, params_local, x_all, extra):
+def _gpipe_inner(axis_name, stage_fn, n_micro, batch_axis, params_local,
+                 x_all, extra):
     """Per-device body: params_local = this stage's params (leading stage
     dim of size 1), x_all = pytree of [M, mb, ...] microbatch leaves
-    (replicated) — a multi-tensor boundary (residual trunk + branch, h/c
+    (replicated over 'pipe'; microbatch rows sharded over `batch_axis`
+    when set) — a multi-tensor boundary (residual trunk + branch, h/c
     pairs) streams as a tuple — extra = replicated shared context
     (attention masks etc.) or None."""
     tmap = jax.tree_util.tree_map
     s = lax.axis_index(axis_name)
     n_stage = lax.psum(1, axis_name)
     params_local = tmap(lambda p: p[0], params_local)
+    # NOTE on batch_axis grads: params/extra enter with in_specs that do
+    # not mention the batch axis; jax's shard_map TRANSPOSE already
+    # psums their cotangents over unmentioned manual axes (verified by
+    # grad-parity tests — an explicit in-body psum double-counts), so
+    # outer AD through this body needs no extra reduction here.
     m = n_micro
 
     out_buf = tmap(jnp.zeros_like, x_all)
@@ -82,8 +89,8 @@ def _ring_shift(x, axis_name):
     return lax.ppermute(x, axis_name, perm)
 
 
-def _1f1b_inner(axis_name, stage_fn, loss_fn, n_micro, params_local,
-                x_all, largs_all, extra):
+def _1f1b_inner(axis_name, stage_fn, loss_fn, n_micro, batch_axis,
+                params_local, x_all, largs_all, extra):
     """Per-device 1F1B body. Schedule (just-in-time warmup; S stages, M
     microbatches, steps t = 0 .. 2(M+S)-3):
 
@@ -192,6 +199,13 @@ def _1f1b_inner(axis_name, stage_fn, loss_fn, n_micro, params_local,
     loss_out = lax.psum(loss_acc, axis_name)
     xgrad_out = lax.psum(
         jnp.where(s == 0, xgrad_buf, 0).astype(dtype), axis_name)
+    if batch_axis is not None:
+        # per-data-shard partial sums: the loss and the (replicated)
+        # param grads must reduce over the batch axis; x-grads stay
+        # per-shard, matching the input sharding
+        loss_out = lax.psum(loss_out, batch_axis)
+        acc_g = jax.tree_util.tree_map(
+            lambda g: lax.psum(g, batch_axis), acc_g)
     acc_g = jax.tree_util.tree_map(lambda g: g[None], acc_g)
     return loss_out, acc_g, xgrad_out
 
@@ -203,7 +217,8 @@ def _ring_shift_up(x, axis_name):
 
 
 def gpipe_1f1b_grad(stage_fn, stage_params, x, loss_fn, loss_args, mesh,
-                    axis_name='pipe', num_microbatches=None, extra=None):
+                    axis_name='pipe', num_microbatches=None, extra=None,
+                    batch_axis=None):
     """One 1F1B-scheduled training step: returns (loss_sum, param_grads,
     x_grad).
 
@@ -236,30 +251,47 @@ def gpipe_1f1b_grad(stage_fn, stage_params, x, loss_fn, loss_args, mesh,
     if b % m:
         raise ValueError("batch %d not divisible by %d microbatches"
                          % (b, m))
+    if batch_axis is not None and (b // m) % mesh.shape[batch_axis]:
+        raise ValueError(
+            "gpipe_1f1b_grad batch_axis=%r: microbatch rows %d not "
+            "divisible by the axis size %d"
+            % (batch_axis, b // m, mesh.shape[batch_axis]))
     x_mb = x.reshape((m, b // m) + x.shape[1:])
     largs_mb = jax.tree_util.tree_map(
         lambda v: v.reshape((m, b // m) + v.shape[1:]), loss_args)
 
     from .ring_attention import _shard_map
+    manual = {axis_name} | ({batch_axis} if batch_axis else set())
+    bspec = P(None, batch_axis) if batch_axis else P()
     pspec = jax.tree_util.tree_map(lambda _: P(axis_name), stage_params)
-    lspec = jax.tree_util.tree_map(lambda _: P(), largs_mb)
-    inner = functools.partial(_1f1b_inner, axis_name, stage_fn, loss_fn, m)
+    lspec = jax.tree_util.tree_map(lambda _: bspec, largs_mb)
+    inner = functools.partial(_1f1b_inner, axis_name, stage_fn, loss_fn,
+                              m, batch_axis)
     if extra is None:
         fn = _shard_map(
             lambda p, xx, la: inner(p, xx, la, None), mesh,
-            (pspec, P(), lspec), (P(), pspec, P()))
+            (pspec, bspec, lspec), (P(), pspec, bspec),
+            axis_names=manual)
         loss, grads, xg = fn(stage_params, x_mb, largs_mb)
     else:
         espec = jax.tree_util.tree_map(lambda _: P(), extra)
-        fn = _shard_map(inner, mesh, (pspec, P(), lspec, espec),
-                        (P(), pspec, P()))
+        fn = _shard_map(inner, mesh, (pspec, bspec, lspec, espec),
+                        (P(), pspec, bspec), axis_names=manual)
         loss, grads, xg = fn(stage_params, x_mb, largs_mb, extra)
     return loss, grads, xg.reshape(x.shape)
 
 
 def gpipe(stage_fn, stage_params, x, mesh, axis_name='pipe',
-          num_microbatches=None, extra=None):
+          num_microbatches=None, extra=None, batch_axis=None):
     """Run x through S pipelined stages.
+
+    batch_axis: name of a DATA-parallel mesh axis to compose with — the
+    microbatch rows shard over it (each data replica pipelines only its
+    batch shard) and parameter/shared-context cotangents psum over it,
+    so grads through outer AD equal the serial full-batch grads. The
+    axis size must divide B // num_microbatches (each microbatch's rows
+    split across the axis). Default None replicates the batch over
+    every non-pipe axis (correct, but duplicated compute).
 
     stage_fn(params, x_mb[, extra]) -> y_mb: one stage, shape-preserving.
     stage_params: pytree with leading stage dim S on every leaf (sharded
@@ -291,18 +323,27 @@ def gpipe(stage_fn, stage_params, x, mesh, axis_name='pipe',
     if b % m:
         raise ValueError("batch %d not divisible by %d microbatches"
                          % (b, m))
+    if batch_axis is not None and (b // m) % mesh.shape[batch_axis]:
+        raise ValueError(
+            "gpipe batch_axis=%r: microbatch rows %d not divisible by "
+            "the axis size %d" % (batch_axis, b // m,
+                                  mesh.shape[batch_axis]))
     x_mb = tmap(lambda a: a.reshape((m, b // m) + a.shape[1:]), x)
 
     from .ring_attention import _shard_map
+    manual = {axis_name} | ({batch_axis} if batch_axis else set())
     pspec = tmap(lambda _: P(axis_name), stage_params)
-    xspec = tmap(lambda _: P(), x_mb)
-    inner = functools.partial(_gpipe_inner, axis_name, stage_fn, m)
+    xspec = tmap(lambda _: P(None, batch_axis) if batch_axis else P(),
+                 x_mb)
+    inner = functools.partial(_gpipe_inner, axis_name, stage_fn, m,
+                              batch_axis)
     if extra is None:
         fn = _shard_map(lambda p, xx: inner(p, xx, None), mesh,
-                        (pspec, xspec), xspec)
+                        (pspec, xspec), xspec, axis_names=manual)
         out = fn(stage_params, x_mb)
     else:
         espec = tmap(lambda _: P(), extra)
-        fn = _shard_map(inner, mesh, (pspec, xspec, espec), xspec)
+        fn = _shard_map(inner, mesh, (pspec, xspec, espec), xspec,
+                        axis_names=manual)
         out = fn(stage_params, x_mb, extra)
     return tmap(lambda o: o.reshape((b,) + o.shape[2:]), out)

@@ -120,11 +120,25 @@ def _ring_inner(axis_name, scale, causal, q, k, v, q_pos):
     return out.astype(q.dtype)
 
 
-def _shard_map(fn, mesh, in_specs, out_specs):
+def _shard_map(fn, mesh, in_specs, out_specs, axis_names=None):
+    """axis_names: restrict MANUAL axes to this subset — the other mesh
+    axes stay under the automatic SPMD partitioner, so e.g. gpipe over
+    mesh(data=2, pipe=4) with axis_names={'pipe'} keeps the feed's
+    'data' sharding (and the backward psum over 'data') instead of
+    replicating the whole batch per data replica. Ignored on jax
+    versions whose shard_map lacks the parameter (manual-over-all, the
+    previous behavior)."""
     try:
         from jax import shard_map
     except ImportError:          # older jax
         from jax.experimental.shard_map import shard_map
+    if axis_names is not None:
+        try:
+            return shard_map(fn, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False,
+                             axis_names=frozenset(axis_names))
+        except TypeError:
+            pass
     try:
         return shard_map(fn, mesh=mesh, in_specs=in_specs,
                          out_specs=out_specs, check_vma=False)

@@ -278,3 +278,60 @@ def test_switch_moe_layer_named_param_attr():
     names = [p.name for p in main.all_parameters()]
     moe_names = [n for n in names if n.startswith('my_moe')]
     assert len(moe_names) == len(set(moe_names)) == 5, moe_names
+
+
+def test_gpipe_batch_axis_shards_and_matches_serial():
+    """mesh(data=2, pipe=4) with batch_axis='data': the output batch must
+    STAY data-sharded (no silent all-gather — a replicated-composition
+    regression passes trajectory tests but loses the sharding), and
+    loss + grads through outer AD must equal the serial full batch."""
+    from jax.sharding import PartitionSpec as P, NamedSharding
+    from paddle_tpu.parallel import make_mesh
+    from paddle_tpu.parallel.pipeline import gpipe, gpipe_1f1b_grad
+
+    mesh = make_mesh([('data', 2), ('pipe', 4)])
+    rng = np.random.RandomState(0)
+    S, b, d = 4, 8, 16
+    w = jnp.asarray(rng.randn(S, d, d).astype('float32') * 0.3)
+    bias = jnp.zeros((S, d), jnp.float32)
+    x = jax.device_put(rng.randn(b, d).astype('float32'),
+                       NamedSharding(mesh, P('data')))
+    lbl = jax.device_put(rng.randn(b, d).astype('float32'),
+                         NamedSharding(mesh, P('data')))
+
+    def stage(p, a):
+        return jnp.tanh(a @ p[0] + p[1])
+
+    @jax.jit
+    def fwd_loss(wb, x, lbl):
+        out = gpipe(stage, wb, x, mesh, num_microbatches=4,
+                    batch_axis='data')
+        return jnp.sum((out - lbl) ** 2), out
+
+    (l, out), g = jax.value_and_grad(fwd_loss, has_aux=True)(
+        (w, bias), x, lbl)
+    assert 'data' in str(out.sharding.spec), out.sharding.spec
+
+    def serial_loss(wb, x, lbl):
+        h = x
+        for s in range(S):
+            h = jnp.tanh(h @ wb[0][s] + wb[1][s])
+        return jnp.sum((h - lbl) ** 2)
+
+    sl, sg = jax.value_and_grad(serial_loss)((w, bias), x, lbl)
+    np.testing.assert_allclose(float(l), float(sl), rtol=1e-5)
+    for a, bb in zip(jax.tree_util.tree_leaves(g),
+                     jax.tree_util.tree_leaves(sg)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(bb),
+                                   rtol=1e-4, atol=1e-5)
+
+    loss, grads, _xg = jax.jit(
+        lambda w, bias, x, lbl: gpipe_1f1b_grad(
+            stage, (w, bias), x,
+            lambda y, la: jnp.sum((y - la) ** 2), lbl, mesh,
+            num_microbatches=4, batch_axis='data'))(w, bias, x, lbl)
+    np.testing.assert_allclose(float(loss), float(sl), rtol=1e-5)
+    for a, bb in zip(jax.tree_util.tree_leaves(grads),
+                     jax.tree_util.tree_leaves(sg)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(bb),
+                                   rtol=1e-4, atol=1e-5)
