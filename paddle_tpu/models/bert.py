@@ -126,8 +126,8 @@ def make_pretrain_batch(cfg, batch, rng, toks=None):
     """Synthetic pretraining batch with the BERT feed contract. `toks`
     overrides the uniform-random token stream (shape [batch, L]) so
     structured corpora (e.g. tools/convergence.py's Markov teacher) share
-    this masking/flat-position/[MASK]-id contract instead of copying it;
-    a faster vectorized position draw is used when batch is large."""
+    this masking/flat-position/[MASK]-id contract instead of copying
+    it."""
     L, P = cfg.seq_len, cfg.max_predictions
     if toks is None:
         toks = rng.randint(4, cfg.vocab_size, (batch, L)).astype('int64')

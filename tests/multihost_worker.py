@@ -107,10 +107,26 @@ def main():
                    ).reshape(())) for f in pfeeds]
         mp2, sp2, l2 = _lm_prog()
         ndev = jax.device_count()
-        fluid.transpiler.PipelineTranspiler().transpile(mp2,
-                                                        num_stages=ndev)
-        mesh = make_mesh([('pipe', ndev)])
-        runner = MeshRunner(mp2, mesh)
+        if os.environ.get('MH_PIPE_DP'):
+            # dp-composed pipeline with the PIPE axis outermost: devices
+            # are ordered by process, so pipe stage pairs land in
+            # DIFFERENT processes — every stage-to-stage ppermute crosses
+            # the process boundary (DCN in a real topology) while the
+            # batch shards over 'data' (gpipe_run auto-engages
+            # batch_axis)
+            from jax.sharding import PartitionSpec as P
+            pp = ndev // 2
+            fluid.transpiler.PipelineTranspiler().transpile(
+                mp2, num_stages=pp)
+            mesh = make_mesh([('pipe', pp), ('data', 2)])
+            runner = MeshRunner(mp2, mesh,
+                                feed_specs={'tokens': P('data'),
+                                            'labels': P('data')})
+        else:
+            fluid.transpiler.PipelineTranspiler().transpile(
+                mp2, num_stages=ndev)
+            mesh = make_mesh([('pipe', ndev)])
+            runner = MeshRunner(mp2, mesh)
         s2 = fluid.Scope()
         with fluid.scope_guard(s2):
             exe.run(sp2, scope=s2)

@@ -214,3 +214,19 @@ def test_two_process_pipeline_matches_serial():
         assert all(np.isfinite(r['ref']))
     np.testing.assert_allclose(results[0]['pipe'], results[1]['pipe'],
                                rtol=1e-6, atol=0)
+
+
+def test_two_process_dp_pipe_composition():
+    """dp-composed pipeline over 2 workers x 2 devices: mesh(pipe=2,
+    data=2) with pipe OUTERMOST, so each stage pair spans the process
+    boundary (the ppermutes cross DCN) while the batch shards over
+    'data' (batch_axis engaged in gpipe) — trajectory must equal
+    serial."""
+    results = _run_workers(2, env_extra={'MH_MODE': 'pipe',
+                                         'MH_PIPE_DP': '1'}, timeout=420)
+    for r in results:
+        np.testing.assert_allclose(r['pipe'], r['ref'],
+                                   rtol=2e-4, atol=2e-5)
+        assert all(np.isfinite(r['ref']))
+    np.testing.assert_allclose(results[0]['pipe'], results[1]['pipe'],
+                               rtol=1e-6, atol=0)
