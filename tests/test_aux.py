@@ -446,3 +446,27 @@ class TestScopeDeviceCache(object):
                     scope=scope)
         assert w.flags.writeable
         w[:] = 7.0  # must not raise: the scope no longer aliases w
+
+
+def test_ps_dispatchers():
+    """RoundRobin / HashName parameter placement (reference
+    transpiler/ps_dispatcher.py:18,46,70): RoundRobin cycles endpoints
+    deterministically and reset() restarts the cycle; HashName is
+    stable per name."""
+    from paddle_tpu.transpiler.ps_dispatcher import RoundRobin, HashName
+
+    class V(object):
+        def __init__(self, name):
+            self.name = name
+
+    eps = ['h0:6174', 'h1:6174', 'h2:6174']
+    rr = RoundRobin(eps)
+    vs = [V('w%d' % i) for i in range(7)]
+    got = rr.dispatch(vs)
+    assert got == [eps[i % 3] for i in range(7)]
+    rr.reset()
+    assert rr.dispatch(vs[:3]) == eps
+    hn = HashName(eps)
+    first = hn.dispatch(vs)
+    assert hn.dispatch(vs) == first          # stable per name
+    assert set(first) <= set(eps)
