@@ -674,6 +674,17 @@ def _child(mode):
     float(jax.numpy.zeros(()))
     sync_ms = round((time.time() - t0) * 1000, 1)
 
+    # steady-state per-run host overhead (residency + donation contract:
+    # after warmup, a run() dispatch must not re-stage state through the
+    # host) and compile-cache reuse for a rebuilt identical program in a
+    # fresh Executor — measured, not asserted
+    try:
+        from tools.runoverhead import measure_run_overhead
+        run_overhead = measure_run_overhead(30 if on_tpu else 200)
+    except Exception as e:
+        run_overhead = {'error': '%s: %s' % (type(e).__name__,
+                                             str(e)[:200])}
+
     if on_tpu:
         flagship_cfg = dict(vocab_size=32000, seq_len=512, d_model=512,
                             n_head=8, n_layer=6, d_ff=2048, dropout=0.1,
@@ -762,6 +773,7 @@ def _child(mode):
         'step_ms': flag['step_ms'],
         'compile_s': flag['compile_s'],
         'sync_ms': sync_ms,
+        'run_overhead': run_overhead,
         'final_loss': flag['final_loss'],
         'amp': bool(on_tpu),
         'flash_attention': True,

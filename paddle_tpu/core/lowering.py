@@ -566,17 +566,20 @@ def build_fn(program, fetch_names, read_names, written_names,
 
 def build_callable(program, fetch_names, read_names, written_names,
                    static_lods=None, static_feed=None, lod_out=None,
-                   lower_params=None):
+                   lower_params=None, donate=True):
     """Single-device compile of build_fn.
 
     rw_state (read-and-written persistables, e.g. params being optimized) is
     donated to XLA so parameter updates alias their input buffers — the
     equivalent of the reference's in-place optimizer kernels + memory passes
-    (details/inplace_op_pass.cc), for free via buffer donation."""
+    (details/inplace_op_pass.cc), for free via buffer donation. `donate=False`
+    opts out (the executor passes its policy: off through the host-relay
+    backend, where donated buffers round-trip host-side, and under
+    PADDLE_DONATE=0 for callers that keep stale references into the scope)."""
     fn, ro_names, rw_names = build_fn(program, fetch_names, read_names,
                                       written_names, static_lods=static_lods,
                                       static_feed=static_feed,
                                       lod_out=lod_out,
                                       lower_params=lower_params)
-    jitted = jax.jit(fn, donate_argnums=(2,))
+    jitted = jax.jit(fn, donate_argnums=(2,) if donate else ())
     return jitted, ro_names, rw_names

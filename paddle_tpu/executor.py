@@ -10,11 +10,23 @@ run:451, global_scope:34) and the C++ serial executor it drives
   repeatedly calls it. There is no per-op interpreter.
 - The Scope is a flat name -> array store holding persistable state (params,
   optimizer moments, LR counters). It is the checkpointable pytree: the
-  reference's "everything persistable is the checkpoint" principle.
-- feed: numpy in; fetch: numpy out (device transfer at program boundary only —
-  the reference's feed/fetch ops collapse into function arguments/results).
+  reference's "everything persistable is the checkpoint" principle. Scope
+  values are DEVICE-RESIDENT jax.Arrays across run() calls: state is uploaded
+  once, updates land as the jitted outputs, and host materialization happens
+  only at explicit read points (fetch with return_numpy=True, tensor shims,
+  io.save_persistables). The rw-state pytree is donated by default so updates
+  alias their input buffers (see _donation_enabled for the escape hatches).
+- feed: numpy (or already-device jax.Array) in; fetch: numpy out by default
+  (the reference's feed/fetch ops collapse into function arguments/results);
+  return_numpy=False keeps fetches on device.
+- Compiled entries are cached by structural program fingerprint (not object
+  identity) in per-executor + process-wide LRU caches, and XLA's persistent
+  compilation cache is wired for cross-process reuse — see
+  docs/executor_performance.md for the full contract.
 """
+import collections
 import os
+import threading
 
 import numpy as np
 import jax
@@ -177,6 +189,180 @@ def _callbacks_supported():
     return _cb_supported[0]
 
 
+def _donation_enabled(fused=False):
+    """Default-ON buffer donation for the rw-state pytree: parameter updates
+    alias their input buffers instead of holding old+new state simultaneously
+    (2x peak HBM). Escape hatches: PADDLE_DONATE=0 disables both run paths —
+    callers that keep reading a stale reference to a pre-run scope value need
+    it (the scope itself is always rebound to the new state right after the
+    call, so normal callers never observe a donated buffer);
+    PADDLE_FUSED_DONATE overrides for run_fused only (its historical opt-in
+    name). Guards: through the axon host-relay backend — detected as "no
+    host-callback support", the same probe the segmenting path uses —
+    donated buffers are round-tripped host-side on every call (~1.5 s/call
+    measured on resnet50's ~400 MB state), so donation defaults OFF there;
+    and optest collection records the pre-run rw state after the call, which
+    donation would have deleted."""
+    if os.environ.get('PADDLE_OPTEST_COLLECT_DIR'):
+        return False
+    if fused:
+        env = os.environ.get('PADDLE_FUSED_DONATE')
+        if env is not None:
+            return env != '0'
+    env = os.environ.get('PADDLE_DONATE')
+    if env is not None:
+        return env != '0'
+    return _callbacks_supported()
+
+
+_persistent_cache_dir = [None]
+
+
+def _wire_persistent_cache():
+    """Point JAX's persistent compilation cache at a durable directory so a
+    SECOND PROCESS compiling the same program hits the on-disk XLA cache and
+    time-to-first-step drops from compile_s to cache-deserialize time.
+    Directory: $PADDLE_COMPILE_CACHE_DIR, default ~/.cache/paddle_tpu/xla;
+    PADDLE_COMPILE_CACHE_DIR= (empty) disables. The min-compile-time /
+    min-entry-size floors are zeroed so every executor program is eligible —
+    one entry per (program fingerprint, feed signature) is exactly the
+    working set the in-process cache already holds."""
+    if _persistent_cache_dir[0] is not None:
+        return _persistent_cache_dir[0]
+    path = os.environ.get('PADDLE_COMPILE_CACHE_DIR')
+    if path is None:
+        try:
+            existing = jax.config.jax_compilation_cache_dir
+        except Exception:
+            existing = None
+        if existing:
+            # the user already configured jax's cache (jax.config or
+            # JAX_COMPILATION_CACHE_DIR): respect their directory and write
+            # floors, don't override either
+            _persistent_cache_dir[0] = existing
+            return existing
+    if path is None:
+        # Default wiring is gated to accelerator backends. XLA:CPU
+        # executables round-tripped through the on-disk cache were observed
+        # to produce WRONG NUMERICS on this jax version (a freshly written
+        # entry re-read by the next process diverges a checkpoint-resume
+        # trajectory — donation/aliasing appears to be lost in
+        # deserialization), and CPU compiles are cheap anyway. An explicit
+        # PADDLE_COMPILE_CACHE_DIR still wires any backend.
+        try:
+            backend = jax.default_backend()
+        except Exception:
+            backend = ''
+        if backend in ('', 'cpu'):
+            _persistent_cache_dir[0] = ''
+            return ''
+        path = os.path.join(os.path.expanduser('~'), '.cache',
+                            'paddle_tpu', 'xla')
+    if path:
+        try:
+            backend = jax.default_backend()
+        except Exception:
+            backend = ''
+        if backend == 'cpu':
+            # the operator asked for it explicitly, but this combination is
+            # the one observed to corrupt numerics — never do it silently
+            import warnings
+            warnings.warn(
+                "PADDLE_COMPILE_CACHE_DIR wires the persistent XLA cache "
+                "on the CPU backend: cache round-trips of XLA:CPU "
+                "executables were observed to produce WRONG numerics on "
+                "this jax version (checkpoint-resume divergence). Use "
+                "only for accelerator runs, or unset it on CPU hosts.",
+                stacklevel=2)
+        try:
+            os.makedirs(path, exist_ok=True)
+            jax.config.update('jax_compilation_cache_dir', path)
+            for knob, v in (
+                    ('jax_persistent_cache_min_compile_time_secs', 0),
+                    ('jax_persistent_cache_min_entry_size_bytes', -1)):
+                try:
+                    jax.config.update(knob, v)
+                except Exception:       # knob absent on this jax version
+                    pass
+        except Exception:
+            path = ''                   # unwritable home etc.: run without
+    _persistent_cache_dir[0] = path or ''
+    return _persistent_cache_dir[0]
+
+
+class _LRUCache(object):
+    """Bounded compile cache: long-lived serving processes must not leak
+    compiled entries (and the strong program refs they hold) without bound.
+    Hits move the key to the back; inserting past the cap evicts from the
+    front (least recently used). Exposes the small dict surface the
+    tools/tests already use (len, iter, items, get, [k]=v, clear)."""
+
+    def __init__(self, cap=None):
+        # cap=None resolves PADDLE_EXECUTOR_CACHE_SIZE lazily at each bound
+        # check, so the env var works even when set after import (the
+        # module-level _shared_cache is constructed at import time)
+        self._cap = max(1, int(cap)) if cap is not None else None
+        self._d = collections.OrderedDict()
+        # the process-wide cache is shared by every Executor; serving
+        # processes run one executor per thread, so all ops take the lock
+        # (iteration hands out snapshots rather than live iterators)
+        self._lock = threading.RLock()
+
+    @property
+    def cap(self):
+        if self._cap is not None:
+            return self._cap
+        try:
+            return max(1, int(os.environ.get('PADDLE_EXECUTOR_CACHE_SIZE',
+                                             '64')))
+        except ValueError:
+            return 64
+
+    def get(self, key, default=None):
+        with self._lock:
+            try:
+                self._d.move_to_end(key)
+            except KeyError:
+                return default
+            return self._d[key]
+
+    def __setitem__(self, key, value):
+        with self._lock:
+            self._d[key] = value
+            self._d.move_to_end(key)
+            while len(self._d) > self.cap:
+                self._d.popitem(last=False)
+
+    def __contains__(self, key):
+        with self._lock:
+            return key in self._d
+
+    def __len__(self):
+        with self._lock:
+            return len(self._d)
+
+    def __iter__(self):
+        with self._lock:
+            return iter(list(self._d))
+
+    def items(self):
+        with self._lock:
+            return list(self._d.items())
+
+    def clear(self):
+        with self._lock:
+            self._d.clear()
+
+
+# Process-wide compiled-entry cache, keyed by program FINGERPRINT (structural
+# identity, framework.Program._fingerprint) rather than _uid: a re-built but
+# identical Program — a fresh Predictor on the same saved model, a rebuilt
+# graph in a new Executor — reuses the compiled entry instead of recompiling.
+# Per-executor caches front this one so Executor.close() / per-executor
+# bookkeeping keep their existing semantics.
+_shared_cache = _LRUCache()
+
+
 _global_scope = Scope()
 _scope_stack = [_global_scope]
 
@@ -259,11 +445,27 @@ class _FeedSpec(object):
 class Executor(object):
     def __init__(self, place=None):
         self.place = place if place is not None else TPUPlace(0)
-        self._cache = {}
+        self._cache = _LRUCache()
         self._run_counter = 0
 
     def close(self):
+        # drops this executor's view only; the process-wide fingerprint
+        # cache keeps entries alive for other executors (it is LRU-bounded,
+        # so close() is no longer load-bearing for memory)
         self._cache.clear()
+
+    # ------------------------------------------------------------------
+    def _cache_get(self, key):
+        entry = self._cache.get(key)
+        if entry is None:
+            entry = _shared_cache.get(key)
+            if entry is not None:
+                self._cache[key] = entry
+        return entry
+
+    def _cache_put(self, key, entry):
+        self._cache[key] = entry
+        _shared_cache[key] = entry
 
     # ------------------------------------------------------------------
     def _feed_signature(self, feed, feed_lods=(), static_feed=()):
@@ -304,7 +506,11 @@ class Executor(object):
         for name, value in feed.items():
             value, lod = self._split_lod_feed(value)
             var = gb._find_var_recursive(name)
-            arr = np.asarray(value)
+            # already-device feeds (a staged input pipeline, a
+            # return_numpy=False fetch fed back in) pass through untouched:
+            # np.asarray would pull the whole buffer host-side only for the
+            # run to re-upload it
+            arr = value if isinstance(value, jax.Array) else np.asarray(value)
             if var is not None and var.dtype is not None and \
                     arr.dtype != var.dtype:
                 # feeding python lists of ints to a float var etc.
@@ -400,11 +606,16 @@ class Executor(object):
                     program, feed, fetch_names, scope, return_numpy,
                     static_lods, static_feed)
 
-        key = (program._uid, program._version,
+        donate = _donation_enabled()
+        key = (program._fingerprint(),
                self._feed_signature(feed, static_lods, static_feed),
-               tuple(fetch_names))
-        entry = self._cache.get(key) if use_program_cache else None
+               tuple(fetch_names), donate)
+        entry = self._cache_get(key) if use_program_cache else None
         if entry is None:
+            # wired at first compile, not Executor construction: building an
+            # executor must stay free of backend initialization (io-only
+            # executors, relay clients where client creation takes seconds)
+            _wire_persistent_cache()
             read, written = lowering.analyze_state(program, fetch_names)
             # only require state that is read before being written this run
             needed = self._read_before_write(program, read, written,
@@ -413,11 +624,11 @@ class Executor(object):
             fn, ro_names, rw_names = lowering.build_callable(
                 program, fetch_names, needed, written,
                 static_lods=static_lods, static_feed=static_feed,
-                lod_out=lod_out)
+                lod_out=lod_out, donate=donate)
             entry = _CompiledEntry(fn, fetch_names, ro_names, rw_names,
                                    written, program, lod_out)
             if use_program_cache:
-                self._cache[key] = entry
+                self._cache_put(key, entry)
 
         ro_state, rw_state = {}, {}
         for n in entry.ro_names:
@@ -437,13 +648,19 @@ class Executor(object):
             from .core.optest_collect import record_case
             record_case(program, feed, static_lods, ro_state, rw_state,
                         key_arr, fetch_names, fetches)
+        # rebind the scope BEFORE the nan-check can raise: with donation on,
+        # the pre-run rw buffers are already consumed, so bailing out here
+        # would leave the scope pointing at deleted arrays — a NaN state is
+        # at least readable/checkpointable for debugging
+        scope.update(new_state)
         from . import flags as _flags
         if _flags.get_flags('check_nan_inf'):
             _check_nan_inf(new_state, dict(zip(entry.fetch_names, fetches)))
         if _flags.get_flags('benchmark'):
-            import jax
-            jax.block_until_ready(fetches)
-        scope.update(new_state)
+            # block on the new state too: timing only fetches under-measures
+            # steps whose outputs are all state writes (pure-train steps
+            # fetching just a scalar loss, or nothing at all)
+            jax.block_until_ready((fetches, new_state))
         # checkpoint_notify (ops/dist_ops.py): the reference RPCs the
         # checkpoint dir to pservers each execution; here the executor is
         # the checkpoint writer, so save persistables after the run
@@ -542,13 +759,14 @@ class Executor(object):
         _HOST_SEGMENT_OPS. Device segments are compiled and cached like
         normal runs; host ops run eagerly on the CPU backend with only the
         crossing vars transferred."""
-        key = ('hostseg', program._uid, program._version,
+        donate = _donation_enabled()
+        key = ('hostseg', program._fingerprint(),
                self._feed_signature(feed, static_lods, static_feed),
-               tuple(fetch_names))
-        plan = self._cache.get(key)
+               tuple(fetch_names), donate)
+        plan = self._cache_get(key)
         if plan is None:
             plan = self._segment_plan(program, fetch_names)
-            self._cache[key] = plan
+            self._cache_put(key, plan)
 
         self._run_counter += 1
         key_arr = _run_key(program.random_seed, _next_program_run(program),
@@ -561,6 +779,7 @@ class Executor(object):
             seg_fetch = list(seg['crossing'])
             entry = seg.get('entry')
             if entry is None:
+                _wire_persistent_cache()
                 read, written = lowering.analyze_state(sub, seg_fetch)
                 needed = self._read_before_write(
                     sub, read, written, set(seg_feed), seg_fetch)
@@ -574,7 +793,7 @@ class Executor(object):
                     fn, ro_names, rw_names = lowering.build_callable(
                         sub, seg_fetch, needed, written,
                         static_lods=lod_env, static_feed=static_feed,
-                        lod_out=lod_out,
+                        lod_out=lod_out, donate=donate,
                         lower_params={'op_offset': seg['lo']})
                 else:
                     fn, ro_names, rw_names = lowering.build_fn(
@@ -610,11 +829,13 @@ class Executor(object):
                     fetches, new_state = entry.fn(seg_feed, ro, rw, key_arr)
             else:
                 fetches, new_state = entry.fn(seg_feed, ro, rw, key_arr)
+            # scope rebinds before the nan-check for the same donated-buffer
+            # reason as run(): a raise must not strand deleted arrays
+            scope.update(new_state)
             from . import flags as _flags
             if _flags.get_flags('check_nan_inf'):
                 _check_nan_inf(new_state,
                                dict(zip(entry.fetch_names, fetches)))
-            scope.update(new_state)
             val_env.update(zip(entry.fetch_names, fetches))
             lod_env.update(entry.lod_out)
             # written-persistable LoD lands in the scope exactly as in
@@ -756,12 +977,13 @@ class Executor(object):
         static_lods.update(lods0)
 
         n_steps = int(steps) if steps else k_steps
-        cache_key = ('fused', k_steps, n_steps, program._uid,
-                     program._version,
+        donate = _donation_enabled(fused=True)
+        cache_key = ('fused', k_steps, n_steps, program._fingerprint(),
                      self._feed_signature(feed0, static_lods, ()),
-                     tuple(fetch_names))
-        entry = self._cache.get(cache_key)
+                     tuple(fetch_names), donate)
+        entry = self._cache_get(cache_key)
         if entry is None:
+            _wire_persistent_cache()
             read, written = lowering.analyze_state(program, fetch_names)
             needed = self._read_before_write(program, read, written,
                                              set(feed0), fetch_names)
@@ -805,17 +1027,16 @@ class Executor(object):
                     0, n_steps, body, (st_init, init_f))
                 return fetches, {kk: st_out[kk] for kk in ns0}
 
-            # Donation default OFF for the fused path: through the axon
-            # relay, donated buffers are round-tripped host-side on every
-            # call (~1.5 s/call measured on resnet50's ~400 MB state —
-            # the dominant cost of r3's conv rows). Donation only saves
-            # transient HBM between calls; opt back in for models whose
-            # state approaches HBM capacity.
-            donate = os.environ.get('PADDLE_FUSED_DONATE', '0') == '1'
+            # Donation default ON (see _donation_enabled): parameter updates
+            # alias their input buffers instead of doubling peak HBM —
+            # except through the axon relay, where donated buffers are
+            # round-tripped host-side on every call (~1.5 s/call measured
+            # on resnet50's ~400 MB state — the dominant cost of r3's conv
+            # rows); PADDLE_FUSED_DONATE / PADDLE_DONATE override.
             jitted = jax.jit(fused, donate_argnums=(2,) if donate else ())
             entry = _CompiledEntry(jitted, fetch_names, ro_names, rw_names,
                                    written, program, {})
-            self._cache[cache_key] = entry
+            self._cache_put(cache_key, entry)
 
         ro_state = {n: self._state_value(scope, n, program)
                     for n in entry.ro_names}

@@ -412,6 +412,33 @@ class Program(object):
     def _bump_version(self):
         self._version += 1
 
+    def _fingerprint(self):
+        """Structural identity for compile-cache keys: a stable hash of the
+        serialized program (blocks/vars/ops/attrs + random_seed, which is
+        baked into the trace by LowerContext.rng). Two independently BUILT
+        but identical programs — e.g. the same model constructed twice, or
+        a program re-loaded by a fresh process — share a fingerprint, so
+        the executor reuses the compiled entry instead of recompiling per
+        `_uid`. Falls back to the uid (no sharing, never wrong) for
+        programs whose attrs the durable schema cannot encode (py_func
+        callables etc.). Cached per (_uid, _version); any mutation bumps
+        the version and invalidates it."""
+        cached = getattr(self, '_fp_cache', None)
+        if cached is not None and cached[0] == self._version:
+            return cached[1]
+        try:
+            from .core import serialization as _ser
+            import hashlib
+            import json as _json
+            blob = _ser.program_to_dict(self)
+            fp = 'fp:' + hashlib.sha1(
+                _json.dumps(blob, sort_keys=True,
+                            separators=(',', ':')).encode()).hexdigest()
+        except Exception:
+            fp = 'uid:%d:%d' % (self._uid, self._version)
+        self._fp_cache = (self._version, fp)
+        return fp
+
     # -- cloning / pruning -------------------------------------------------
     def clone(self, for_test=False):
         p = copy.deepcopy(self)

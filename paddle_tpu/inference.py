@@ -47,10 +47,19 @@ class Predictor(object):
     def get_output_names(self):
         return [v.name for v in self.fetch_vars]
 
-    def run(self, feed):
+    def run(self, feed, return_numpy=True):
         """feed: dict name->array, or list of arrays in feed_names order.
         Returns list of numpy arrays in fetch order
-        (AnalysisPredictor::Run analog)."""
+        (AnalysisPredictor::Run analog).
+
+        Params stay device-resident across calls (the executor caches the
+        device copy into the predictor's private scope on first use), so
+        steady-state cost is feed upload + one compiled call + fetch.
+        `return_numpy=False` keeps the fetches device-resident too — no
+        host sync — for callers that chain them into another device
+        computation (feeding a second predictor, device-side post-
+        processing); feeds may likewise be jax.Arrays and are then never
+        staged through the host."""
         if not isinstance(feed, dict):
             arrays = list(feed)
             if len(arrays) != len(self.feed_names):
@@ -63,7 +72,10 @@ class Predictor(object):
             raise ValueError("missing feeds: %s" % missing)
         with scope_guard(self.scope):
             outs = self.executor.run(self.program, feed=feed,
-                                     fetch_list=self.fetch_vars)
+                                     fetch_list=self.fetch_vars,
+                                     return_numpy=return_numpy)
+        if not return_numpy:
+            return list(outs)
         return [np.asarray(o) for o in outs]
 
 

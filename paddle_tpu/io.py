@@ -52,6 +52,17 @@ def save_vars(executor, dirname, main_program=None, vars=None,
         val = scope.get(name)
         if val is None:
             raise RuntimeError("variable %r has no value in scope" % name)
+        if getattr(val, 'is_deleted', None) is not None and val.is_deleted():
+            # a donated run consumed this buffer and the scope was never
+            # rebound (a stale scope snapshot, or an aborted run) — fail
+            # with the cause instead of jax's opaque deleted-buffer error
+            raise RuntimeError(
+                "variable %r holds a donated (deleted) device buffer — it "
+                "was consumed by a donated executor run. Save from the "
+                "live scope (which is rebound to the new state after every "
+                "run), or opt out of donation with PADDLE_DONATE=0." % name)
+        # explicit host materialization point: scope values stay
+        # device-resident across runs and are only pulled host-side here
         arrays[name] = np.asarray(val)
     if filename is not None:
         if not filename.endswith('.npz'):
