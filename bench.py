@@ -669,6 +669,21 @@ def _child(mode):
     kind = getattr(dev, 'device_kind', '') or ''
     start = time.time()
 
+    # attach monitor counter DELTAS (cache hits, donations, bytes moved)
+    # to each row so BENCH_*.json carries causal context, not just timings
+    from paddle_tpu import monitor as _monitor
+    _COUNTER_PREFIXES = ('compile_cache', 'donation', 'feed_host_bytes',
+                         'fetch_host_bytes', 'nan_check')
+
+    def _with_counters(fn, *args, **kw):
+        before = _monitor.counters()
+        row = fn(*args, **kw)
+        if isinstance(row, dict):
+            row['counters'] = {
+                k: v for k, v in _monitor.counter_delta(before).items()
+                if k.startswith(_COUNTER_PREFIXES)}
+        return row
+
     # standalone device->host sync cost, for transparency
     t0 = time.time()
     float(jax.numpy.zeros(()))
@@ -689,14 +704,15 @@ def _child(mode):
         flagship_cfg = dict(vocab_size=32000, seq_len=512, d_model=512,
                             n_head=8, n_layer=6, d_ff=2048, dropout=0.1,
                             attn_dropout=0.0, use_flash_attention=True)
-        flag = _bench_lm(flagship_cfg, batch=64, k_per_call=30,
-                         rounds=3, amp=True)
+        flag = _with_counters(_bench_lm, flagship_cfg, batch=64,
+                              k_per_call=30, rounds=3, amp=True)
     else:
-        flag = _bench_lm(dict(vocab_size=1024, seq_len=64, d_model=128,
-                              n_head=4, n_layer=2, d_ff=256, dropout=0.1,
-                              attn_dropout=0.0, use_flash_attention=True),
-                         batch=8, k_per_call=4, rounds=2, amp=False,
-                         steps_per_call=4)
+        flag = _with_counters(
+            _bench_lm, dict(vocab_size=1024, seq_len=64, d_model=128,
+                            n_head=4, n_layer=2, d_ff=256, dropout=0.1,
+                            attn_dropout=0.0, use_flash_attention=True),
+            batch=8, k_per_call=4, rounds=2, amp=False,
+            steps_per_call=4)
 
     peak = _peak_for(kind) if on_tpu else None
     mfu = None
@@ -712,7 +728,7 @@ def _child(mode):
                     models[name] = {'skipped': 'time budget'}
                     return
                 try:
-                    models[name] = fn(*args, **kw)
+                    models[name] = _with_counters(fn, *args, **kw)
                     return
                 except Exception as e:  # failed extra must not kill the line
                     models[name] = {'error': '%s: %s' % (
@@ -779,6 +795,7 @@ def _child(mode):
         'flash_attention': True,
         'fused_steps_per_call': 120 if on_tpu else 4,
         'config': flag['config'],
+        'counters': flag.get('counters'),
         'models': models,
     }))
 

@@ -55,6 +55,7 @@ from . import dataset
 from . import models
 from . import transpiler
 from . import parallel
+from . import monitor
 from . import profiler
 from . import flags
 from .flags import get_flags, set_flags

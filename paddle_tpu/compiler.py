@@ -8,6 +8,7 @@ dimension sharded; gradient allreduce (psum over ICI) is inserted by the XLA
 SPMD partitioner, replacing the whole OpHandle/NCCL machinery. See
 parallel/spmd.py for the execution path.
 """
+from . import monitor
 from .framework import default_main_program
 
 __all__ = ['CompiledProgram', 'ExecutionStrategy', 'BuildStrategy']
@@ -85,6 +86,8 @@ class CompiledProgram(object):
     # duck-typed hook called by Executor.run
     def _executor_run(self, executor, feed, fetch_list, scope, return_numpy):
         if not self._is_data_parallel:
+            # recurses into Executor.run, which carries the observability
+            # instrumentation — no metrics here or they'd double-count
             return executor.run(self._program, feed=feed,
                                 fetch_list=fetch_list, scope=scope,
                                 return_numpy=return_numpy)
@@ -93,5 +96,10 @@ class CompiledProgram(object):
             self._spmd = spmd.DataParallelRunner(
                 self._program, loss_name=self._loss_name,
                 build_strategy=self._build_strategy, places=self._places)
-        return self._spmd.run(executor, feed, fetch_list, scope,
-                              return_numpy)
+        # the SPMD runner never reaches Executor._run_impl, so the run-level
+        # metrics are recorded at this delegation instead (compile-cache
+        # counters live in spmd.DataParallelRunner.run)
+        with monitor.timed_span('run', 'executor_run_seconds'):
+            monitor.inc('executor_run_total')
+            return self._spmd.run(executor, feed, fetch_list, scope,
+                                  return_numpy)

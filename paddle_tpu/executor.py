@@ -27,11 +27,13 @@ run:451, global_scope:34) and the C++ serial executor it drives
 import collections
 import os
 import threading
+import time
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 
+from . import monitor
 from .framework import (Program, Variable, default_main_program, CPUPlace,
                         TPUPlace)
 from .core import lowering
@@ -134,6 +136,7 @@ def _check_nan_inf(new_state, fetches):
             if arr.dtype.kind == 'f' and not np.isfinite(arr).all():
                 bad.append(name)
     if bad:
+        monitor.inc('nan_check_trigger_total')
         raise RuntimeError(
             "FLAGS_check_nan_inf: NaN/Inf detected in %s after executor "
             "run" % sorted(set(bad)))
@@ -202,23 +205,48 @@ def _donation_enabled(fused=False):
     donated buffers are round-tripped host-side on every call (~1.5 s/call
     measured on resnet50's ~400 MB state), so donation defaults OFF there;
     and optest collection records the pre-run rw state after the call, which
-    donation would have deleted."""
+    donation would have deleted.
+
+    Every resolution is counted: donation_run_total when ON,
+    donation_fallback_total{reason} when OFF — so "did donation silently
+    fall back through the host relay" is a snapshot read, not a debugger
+    session."""
     if os.environ.get('PADDLE_OPTEST_COLLECT_DIR'):
+        monitor.inc('donation_fallback_total',
+                    labels={'reason': 'optest_collect'})
         return False
+    env = None
     if fused:
         env = os.environ.get('PADDLE_FUSED_DONATE')
-        if env is not None:
-            return env != '0'
-    env = os.environ.get('PADDLE_DONATE')
+    if env is None:
+        env = os.environ.get('PADDLE_DONATE')
     if env is not None:
-        return env != '0'
-    return _callbacks_supported()
+        if env != '0':
+            monitor.inc('donation_run_total')
+            return True
+        monitor.inc('donation_fallback_total',
+                    labels={'reason': 'env_opt_out'})
+        return False
+    if _callbacks_supported():
+        monitor.inc('donation_run_total')
+        return True
+    monitor.inc('donation_fallback_total', labels={'reason': 'host_relay'})
+    return False
 
 
 _persistent_cache_dir = [None]
 
 
 def _wire_persistent_cache():
+    path = _wire_persistent_cache_impl()
+    # wiring state as a gauge: 1 = on-disk XLA cache wired, 0 = disabled
+    # (CPU guard, empty PADDLE_COMPILE_CACHE_DIR, unwritable dir)
+    monitor.set_gauge('compile_persistent_cache_wired',
+                      1.0 if path else 0.0)
+    return path
+
+
+def _wire_persistent_cache_impl():
     """Point JAX's persistent compilation cache at a durable directory so a
     SECOND PROCESS compiling the same program hits the on-disk XLA cache and
     time-to-first-step drops from compile_s to cache-deserialize time.
@@ -332,6 +360,7 @@ class _LRUCache(object):
             self._d.move_to_end(key)
             while len(self._d) > self.cap:
                 self._d.popitem(last=False)
+                monitor.inc('compile_cache_eviction')
 
     def __contains__(self, key):
         with self._lock:
@@ -502,6 +531,7 @@ class Executor(object):
 
     def _prepare_feed(self, program, feed):
         out, lods = {}, {}
+        host_bytes = 0
         gb = program.global_block()
         for name, value in feed.items():
             value, lod = self._split_lod_feed(value)
@@ -521,6 +551,10 @@ class Executor(object):
                 elif arr.dtype == np.float64:
                     arr = arr.astype(var.dtype)
             out[name] = arr
+            if not isinstance(arr, jax.Array):
+                # host-staged feed bytes (device jax.Array feeds pass
+                # through without a host->device transfer and don't count)
+                host_bytes += int(getattr(arr, 'nbytes', 0))
             if lod:
                 if lod[-1][-1] != arr.shape[0]:
                     raise ValueError(
@@ -530,6 +564,8 @@ class Executor(object):
                         "recursive_sequence_lengths)"
                         % (name, [list(l) for l in lod], arr.shape[0]))
                 lods[name] = lod
+        if host_bytes:
+            monitor.inc('feed_host_bytes', host_bytes)
         return out, lods
 
     @staticmethod
@@ -566,6 +602,17 @@ class Executor(object):
         if hasattr(program, '_executor_run'):
             return program._executor_run(self, feed, fetch_list, scope,
                                          return_numpy)
+        # instrumented from here down: 'run' span + per-run wall-latency
+        # histogram (the delegating paths above recurse into run() and
+        # would double-count). The counter counts ATTEMPTS — a run that
+        # raises (nan check, bad feed) must not vanish from the rate
+        with monitor.timed_span('run', 'executor_run_seconds'):
+            monitor.inc('executor_run_total')
+            return self._run_impl(program, feed, fetch_list, scope,
+                                  return_numpy, use_program_cache)
+
+    def _run_impl(self, program, feed, fetch_list, scope, return_numpy,
+                  use_program_cache):
         if scope is None:
             scope = global_scope()
         feed, feed_lods = self._prepare_feed(program, feed or {})
@@ -611,7 +658,11 @@ class Executor(object):
                self._feed_signature(feed, static_lods, static_feed),
                tuple(fetch_names), donate)
         entry = self._cache_get(key) if use_program_cache else None
-        if entry is None:
+        fresh_compile = entry is None
+        if fresh_compile:
+            monitor.inc('compile_cache_miss' if use_program_cache
+                        else 'compile_cache_bypass')
+            t_compile = time.perf_counter()
             # wired at first compile, not Executor construction: building an
             # executor must stay free of backend initialization (io-only
             # executors, relay clients where client creation takes seconds)
@@ -629,6 +680,8 @@ class Executor(object):
                                    written, program, lod_out)
             if use_program_cache:
                 self._cache_put(key, entry)
+        else:
+            monitor.inc('compile_cache_hit')
 
         ro_state, rw_state = {}, {}
         for n in entry.ro_names:
@@ -639,7 +692,16 @@ class Executor(object):
         self._run_counter += 1
         key_arr = _run_key(program.random_seed, _next_program_run(program),
                            self._run_counter)
-        fetches, new_state = entry.fn(feed, ro_state, rw_state, key_arr)
+        if fresh_compile:
+            # jax.jit is lazy: the XLA compile happens inside the FIRST
+            # call, so honest compile wall time spans lowering + that call
+            with monitor.span('compile'):
+                fetches, new_state = entry.fn(feed, ro_state, rw_state,
+                                              key_arr)
+            monitor.observe('compile_seconds',
+                            time.perf_counter() - t_compile)
+        else:
+            fetches, new_state = entry.fn(feed, ro_state, rw_state, key_arr)
         if os.environ.get('PADDLE_OPTEST_COLLECT_DIR'):
             # TPU second-place validation (reference op_test.py:304
             # check_output_with_place / the mkldnn-suite reuse pattern):
@@ -659,8 +721,13 @@ class Executor(object):
         if _flags.get_flags('benchmark'):
             # block on the new state too: timing only fetches under-measures
             # steps whose outputs are all state writes (pure-train steps
-            # fetching just a scalar loss, or nothing at all)
+            # fetching just a scalar loss, or nothing at all). The synced
+            # wait lands in the executor_sync_seconds histogram — the
+            # device-completion tail FLAGS_benchmark exists to expose
+            t_sync = time.perf_counter()
             jax.block_until_ready((fetches, new_state))
+            monitor.observe('executor_sync_seconds',
+                            time.perf_counter() - t_sync)
         # checkpoint_notify (ops/dist_ops.py): the reference RPCs the
         # checkpoint dir to pservers each execution; here the executor is
         # the checkpoint writer, so save persistables after the run
@@ -681,11 +748,15 @@ class Executor(object):
                    for f in fetches]  # fetched sparse grads densify, like
         # the reference's fetch of a SelectedRows var materializing a tensor
         if return_numpy:
-            return [
+            out = [
                 _fetched(f, entry.lod_out[n])
                 if entry.lod_out.get(n) else np.asarray(f)
                 for n, f in zip(entry.fetch_names, fetches)
             ]
+            if out:
+                monitor.inc('fetch_host_bytes',
+                            sum(int(getattr(f, 'nbytes', 0)) for f in out))
+            return out
         # return_numpy=False keeps fetches device-resident (no host sync);
         # only lod-carrying results are wrapped, since the LoD metadata is
         # the point of asking for them
@@ -759,14 +830,18 @@ class Executor(object):
         _HOST_SEGMENT_OPS. Device segments are compiled and cached like
         normal runs; host ops run eagerly on the CPU backend with only the
         crossing vars transferred."""
+        monitor.inc('executor_run_segmented_total')
         donate = _donation_enabled()
         key = ('hostseg', program._fingerprint(),
                self._feed_signature(feed, static_lods, static_feed),
                tuple(fetch_names), donate)
         plan = self._cache_get(key)
         if plan is None:
+            monitor.inc('compile_cache_miss')
             plan = self._segment_plan(program, fetch_names)
             self._cache_put(key, plan)
+        else:
+            monitor.inc('compile_cache_hit')
 
         self._run_counter += 1
         key_arr = _run_key(program.random_seed, _next_program_run(program),
@@ -779,6 +854,7 @@ class Executor(object):
             seg_fetch = list(seg['crossing'])
             entry = seg.get('entry')
             if entry is None:
+                t_compile = time.perf_counter()
                 _wire_persistent_cache()
                 read, written = lowering.analyze_state(sub, seg_fetch)
                 needed = self._read_before_write(
@@ -805,6 +881,11 @@ class Executor(object):
                 entry = _CompiledEntry(fn, seg_fetch, ro_names, rw_names,
                                        written, sub, lod_out)
                 seg['entry'] = entry
+                # segment build cost (the jit compile itself is lazy and
+                # lands in this segment's first call below; device-segment
+                # granularity is close enough for the rare hostseg path)
+                monitor.observe('compile_seconds',
+                                time.perf_counter() - t_compile)
             ro = {n: self._state_value(scope, n, program)
                   for n in entry.ro_names}
             rw = {n: self._state_value(scope, n, program, cache=False)
@@ -900,17 +981,32 @@ class Executor(object):
         `steps` (run more scan iterations than staged batches, cycling
         them) requires a uniform-LoD feed_list.
         """
+        if not feed_list:
+            return []
+        with monitor.timed_span('run_fused', 'executor_run_fused_seconds'):
+            monitor.inc('executor_run_fused_total')
+            return self._run_fused_impl(program, feed_list, fetch_list,
+                                        scope, return_numpy, steps,
+                                        _prepared)
+
+    def _run_fused_impl(self, program, feed_list, fetch_list, scope,
+                        return_numpy, steps, _prepared):
         import jax
         from jax import lax
         if program is None:
             program = default_main_program()
         if scope is None:
             scope = global_scope()
-        if not feed_list:
-            return []
         lods0 = {}
         if isinstance(feed_list, dict):
             stacked = dict(feed_list)
+            # host-resident stacks upload on this call; device jax.Arrays
+            # (the documented staging pattern) don't re-cross the host.
+            # The list path below counts its bytes in _prepare_feed.
+            host = sum(int(v.nbytes) for v in stacked.values()
+                       if isinstance(v, np.ndarray))
+            if host:
+                monitor.inc('feed_host_bytes', host)
             k_steps = int(next(iter(stacked.values())).shape[0])
             # metadata-only stand-ins for one staged batch: feed0 exists
             # for the cache key (shape/dtype) and key-set checks; slicing
@@ -947,11 +1043,14 @@ class Executor(object):
                         lo = seg_lo
                         while lo < i:
                             size = 1 << ((i - lo).bit_length() - 1)
-                            out = self.run_fused(
+                            # recurse through _run_fused_impl, NOT the
+                            # public wrapper: one logical run_fused call
+                            # counts once, and segment windows must not
+                            # nest duplicate spans/latency observations
+                            out = self._run_fused_impl(
                                 program, feed_list[lo:lo + size],
-                                fetch_list=fetch_list, scope=scope,
-                                return_numpy=return_numpy,
-                                _prepared=prepared[lo:lo + size])
+                                fetch_list, scope, return_numpy, None,
+                                prepared[lo:lo + size])
                             lo += size
                         seg_lo = i
                 return out
@@ -982,7 +1081,10 @@ class Executor(object):
                      self._feed_signature(feed0, static_lods, ()),
                      tuple(fetch_names), donate)
         entry = self._cache_get(cache_key)
-        if entry is None:
+        fresh_compile = entry is None
+        if fresh_compile:
+            monitor.inc('compile_cache_miss')
+            t_compile = time.perf_counter()
             _wire_persistent_cache()
             read, written = lowering.analyze_state(program, fetch_names)
             needed = self._read_before_write(program, read, written,
@@ -1037,6 +1139,8 @@ class Executor(object):
             entry = _CompiledEntry(jitted, fetch_names, ro_names, rw_names,
                                    written, program, {})
             self._cache_put(cache_key, entry)
+        else:
+            monitor.inc('compile_cache_hit')
 
         ro_state = {n: self._state_value(scope, n, program)
                     for n in entry.ro_names}
@@ -1045,7 +1149,16 @@ class Executor(object):
         self._run_counter += 1
         key_arr = _run_key(program.random_seed, _next_program_run(program),
                            self._run_counter)
-        fetches, new_state = entry.fn(stacked, ro_state, rw_state, key_arr)
+        if fresh_compile:
+            # as in run(): jax.jit compiles inside the first call
+            with monitor.span('compile'):
+                fetches, new_state = entry.fn(stacked, ro_state, rw_state,
+                                              key_arr)
+            monitor.observe('compile_seconds',
+                            time.perf_counter() - t_compile)
+        else:
+            fetches, new_state = entry.fn(stacked, ro_state, rw_state,
+                                          key_arr)
         scope.update(new_state)
         # checkpoint_notify: same host-side save contract as run()
         for cn_dir in entry.notify_dirs:
@@ -1053,7 +1166,11 @@ class Executor(object):
             with scope_guard(scope):
                 save_persistables(self, cn_dir, main_program=program)
         if return_numpy:
-            return [np.asarray(f) for f in fetches]
+            out = [np.asarray(f) for f in fetches]
+            if out:
+                monitor.inc('fetch_host_bytes',
+                            sum(int(f.nbytes) for f in out))
+            return out
         return list(fetches)
 
     # ------------------------------------------------------------------

@@ -25,6 +25,11 @@ owns memory and kernels); what remains is the debugging/determinism tier:
                            parallel.collective.barrier_with_timeout, the
                            failure-detection knob (reference
                            FLAGS_rpc_deadline, distributed RPC tier)
+- FLAGS_monitor_log        path for periodic JSON-lines monitor snapshots
+                           (monitor.configure_logging; interval via
+                           PADDLE_MONITOR_LOG_INTERVAL_S, default 60 s) —
+                           the flag-tier hook into the observability layer,
+                           see docs/observability.md
 """
 import os
 
@@ -34,6 +39,7 @@ _BOOL = ('check_nan_inf', 'debug_nans', 'cpu_deterministic', 'benchmark',
          'deterministic_compile')
 _FLOAT = ('eager_delete_tensor_gb', 'barrier_deadline_secs')
 _INT = ('paddle_num_threads',)
+_STR = ('monitor_log',)
 
 _flags = {}
 
@@ -52,14 +58,17 @@ def _load_env():
     for name in _INT:
         v = os.environ.get('FLAGS_' + name)
         _flags[name] = int(v) if v else 0
-    _apply_side_effects()
+    for name in _STR:
+        _flags[name] = os.environ.get('FLAGS_' + name) or ''
+    _apply_side_effects(import_time=True)
 
 
 _debug_nans_touched = False
 _det_compile_touched = False
+_monitor_log_touched = False
 
 
-def _apply_side_effects():
+def _apply_side_effects(import_time=False):
     # only drive jax_debug_nans when the user actually used the flag —
     # never clobber a JAX_DEBUG_NANS / jax.config setting made outside
     # this flag tier
@@ -72,6 +81,25 @@ def _apply_side_effects():
         jax.config.update(
             'jax_default_matmul_precision',
             'highest' if _flags.get('deterministic_compile') else None)
+    if _monitor_log_touched or 'FLAGS_monitor_log' in os.environ:
+        # configure_logging no-ops when the path is unchanged and the
+        # writer is alive, so re-running side effects for an unrelated
+        # set_flags never restarts the log thread
+        from . import monitor
+        try:
+            monitor.configure_logging(_flags.get('monitor_log') or None)
+        except OSError:
+            if not import_time:
+                raise       # explicit set_flags: fail loudly (and roll back)
+            # a stale FLAGS_monitor_log env var must not turn every
+            # `import paddle_tpu` into a crash: warn, run without logging.
+            # Clear the flag value too, or every later set_flags call (for
+            # ANY flag) would re-attempt the bad path and raise
+            import warnings
+            warnings.warn(
+                "FLAGS_monitor_log=%r is not writable; monitor logging "
+                "disabled" % _flags.get('monitor_log'), stacklevel=2)
+            _flags['monitor_log'] = ''
 
 
 def get_flags(name=None):
@@ -92,7 +120,8 @@ def set_flags(flags_or_name, value=None):
         items = flags_or_name.items()
     else:
         items = [(flags_or_name, value)]
-    global _debug_nans_touched, _det_compile_touched
+    global _debug_nans_touched, _det_compile_touched, _monitor_log_touched
+    old = dict(_flags)
     for name, v in items:
         name = name[6:] if name.startswith('FLAGS_') else name
         if name not in _flags:
@@ -100,12 +129,28 @@ def set_flags(flags_or_name, value=None):
                            % (name, sorted(_flags)))
         if name in _BOOL:
             v = _parse_bool(v) if not isinstance(v, bool) else v
+        if name in _STR:
+            v = '' if v is None else str(v)
         if name == 'debug_nans':
             _debug_nans_touched = True
         if name == 'deterministic_compile':
             _det_compile_touched = True
+        if name == 'monitor_log':
+            _monitor_log_touched = True
         _flags[name] = v
-    _apply_side_effects()
+    try:
+        _apply_side_effects()
+    except Exception:
+        # a failed side effect (e.g. an unwritable FLAGS_monitor_log) must
+        # not leave the rejected value behind: later UNRELATED set_flags
+        # calls re-run side effects and would keep raising it
+        _flags.clear()
+        _flags.update(old)
+        try:
+            _apply_side_effects()       # re-sync to the restored values
+        except Exception:
+            pass                        # the original error wins
+        raise
 
 
 _load_env()

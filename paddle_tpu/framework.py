@@ -421,10 +421,13 @@ class Program(object):
         the executor reuses the compiled entry instead of recompiling per
         `_uid`. Falls back to the uid (no sharing, never wrong) for
         programs whose attrs the durable schema cannot encode (py_func
-        callables etc.). Cached per (_uid, _version); any mutation bumps
-        the version and invalidates it."""
+        callables etc.). Cached per (_version, random_seed) — structural
+        mutations bump the version, and random_seed sits in the key
+        directly because it is a plain attribute assignment that bumps
+        nothing yet is baked into the trace."""
         cached = getattr(self, '_fp_cache', None)
-        if cached is not None and cached[0] == self._version:
+        if cached is not None and cached[0] == (self._version,
+                                                self.random_seed):
             return cached[1]
         try:
             from .core import serialization as _ser
@@ -435,8 +438,9 @@ class Program(object):
                 _json.dumps(blob, sort_keys=True,
                             separators=(',', ':')).encode()).hexdigest()
         except Exception:
-            fp = 'uid:%d:%d' % (self._uid, self._version)
-        self._fp_cache = (self._version, fp)
+            fp = 'uid:%d:%d:%s' % (self._uid, self._version,
+                                   self.random_seed)
+        self._fp_cache = ((self._version, self.random_seed), fp)
         return fp
 
     # -- cloning / pruning -------------------------------------------------

@@ -9,6 +9,7 @@ layout, memory planning all happen in XLA rather than hand-written passes).
 """
 import numpy as np
 
+from . import monitor
 from .executor import Executor, Scope, scope_guard
 from . import io as _io
 
@@ -70,10 +71,15 @@ class Predictor(object):
         missing = [n for n in self.feed_names if n not in feed]
         if missing:
             raise ValueError("missing feeds: %s" % missing)
-        with scope_guard(self.scope):
-            outs = self.executor.run(self.program, feed=feed,
-                                     fetch_list=self.fetch_vars,
-                                     return_numpy=return_numpy)
+        # rides the executor's own run/compile instrumentation; the
+        # predictor-level counter + span separate serving traffic from
+        # training runs in the same process
+        monitor.inc('predictor_run_total')
+        with monitor.span('predictor.run'):
+            with scope_guard(self.scope):
+                outs = self.executor.run(self.program, feed=feed,
+                                         fetch_list=self.fetch_vars,
+                                         return_numpy=return_numpy)
         if not return_numpy:
             return list(outs)
         return [np.asarray(o) for o in outs]

@@ -1,0 +1,475 @@
+"""Runtime observability: thread-safe metrics registry + always-on span ring.
+
+The reference framework ships a first-class observability tier — RAII
+``RecordEvent`` spans (platform/profiler.h:82), the chrome-trace timeline
+(tools/timeline.py), per-op stats. This module is its serving-era analog:
+the Prometheus-style counter/gauge/histogram surface a production deployment
+scrapes, plus the lightweight span recorder the profiler drains.
+
+Three export surfaces:
+
+- ``monitor.snapshot()``          -> plain dict (tests, bench rows, debuggers)
+- ``monitor.export_prometheus()`` -> text exposition format (scrape endpoint)
+- ``FLAGS_monitor_log=<path>``    -> periodic JSON-lines snapshots appended to
+                                     the file (flags.py wires it; interval via
+                                     ``PADDLE_MONITOR_LOG_INTERVAL_S``,
+                                     default 60 s, plus one immediate line and
+                                     a final line at interpreter exit)
+
+Spans: ``monitor.span(name)`` records into a bounded ring buffer
+(``PADDLE_MONITOR_SPAN_CAP``, default 4096 spans) with real pid/tid, ALWAYS
+— no session to start — so ``profiler.export_chrome_tracing`` can emit the
+executor's compile/run spans even when no explicit profiler session is
+active. The ring bound makes always-on safe for long-lived processes.
+
+Label cardinality is capped per metric name (``PADDLE_MONITOR_MAX_SERIES``,
+default 64): overflowing label sets collapse into the reserved series
+``{other="true"}`` and bump the ``monitor_series_dropped`` counter, so an
+unbounded label (a per-request id, say) degrades into one aggregate series
+instead of leaking memory.
+
+Metric catalog (what the executor/predictor instrumentation emits) lives in
+docs/observability.md.
+"""
+import bisect
+import collections
+import json
+import os
+import threading
+import time
+
+__all__ = ['inc', 'set_gauge', 'observe', 'span', 'spans', 'clear_spans',
+           'snapshot', 'export_prometheus', 'counters', 'counter_delta',
+           'configure_logging', 'log_snapshot', 'reset']
+
+_lock = threading.RLock()
+_counters = {}          # name -> {label_key: float}
+_gauges = {}            # name -> {label_key: float}
+_hists = {}             # name -> {label_key: _Hist}
+
+# reserved series absorbing label sets beyond the cardinality cap
+_OVERFLOW_KEY = (('other', 'true'),)
+_DROPPED = 'monitor_series_dropped'
+
+# 1-2-5 log-scale latency bounds, 1 us .. 500 s (seconds). Generic enough
+# for any nonnegative observation; latency is the designed-for case.
+_BOUNDS = tuple(m * (10.0 ** e) for e in range(-6, 3) for m in (1, 2, 5))
+
+
+def _env_int(name, default):
+    try:
+        return max(1, int(os.environ.get(name, '') or default))
+    except ValueError:
+        return default
+
+
+def _max_series():
+    return _env_int('PADDLE_MONITOR_MAX_SERIES', 64)
+
+
+class _Hist(object):
+    """Fixed-bucket latency histogram: O(1) observe, percentiles by linear
+    interpolation inside the owning bucket (same estimator Prometheus'
+    histogram_quantile uses)."""
+
+    __slots__ = ('counts', 'n', 'total', 'vmin', 'vmax')
+
+    def __init__(self):
+        self.counts = [0] * (len(_BOUNDS) + 1)   # +1: > last bound
+        self.n = 0
+        self.total = 0.0
+        self.vmin = None
+        self.vmax = None
+
+    def add(self, v):
+        self.counts[bisect.bisect_left(_BOUNDS, v)] += 1
+        self.n += 1
+        self.total += v
+        self.vmin = v if self.vmin is None else min(self.vmin, v)
+        self.vmax = v if self.vmax is None else max(self.vmax, v)
+
+    def quantile(self, q):
+        if not self.n:
+            return None
+        target = q * self.n
+        cum = 0.0
+        for i, c in enumerate(self.counts):
+            if not c:
+                continue
+            if cum + c >= target:
+                lo = _BOUNDS[i - 1] if i > 0 else 0.0
+                hi = _BOUNDS[i] if i < len(_BOUNDS) else self.vmax
+                est = lo + (hi - lo) * (target - cum) / c
+                return min(max(est, self.vmin), self.vmax)
+            cum += c
+        return self.vmax
+
+    def stats(self):
+        if not self.n:
+            return {'count': 0, 'sum': 0.0}
+        return {'count': self.n, 'sum': self.total,
+                'min': self.vmin, 'max': self.vmax,
+                'avg': self.total / self.n,
+                'p50': self.quantile(0.5), 'p90': self.quantile(0.9),
+                'p99': self.quantile(0.99)}
+
+
+def _labels_key(labels):
+    if not labels:
+        return ()
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def _capped_key(series, key):
+    """Resolve `key` inside one metric's series dict, honoring the
+    cardinality cap. Callers hold _lock."""
+    if key in series or len(series) < _max_series():
+        return key
+    d = _counters.setdefault(_DROPPED, {})
+    d[()] = d.get((), 0.0) + 1
+    return _OVERFLOW_KEY
+
+
+def inc(name, value=1.0, labels=None):
+    """Add `value` (default 1) to counter `name`; labels: optional dict."""
+    key = _labels_key(labels)
+    value = float(value)    # numpy scalars must not poison JSON export
+    with _lock:
+        series = _counters.setdefault(name, {})
+        key = _capped_key(series, key)
+        series[key] = series.get(key, 0.0) + value
+
+
+def set_gauge(name, value, labels=None):
+    """Set gauge `name` to `value` (last write wins)."""
+    key = _labels_key(labels)
+    with _lock:
+        series = _gauges.setdefault(name, {})
+        key = _capped_key(series, key)
+        series[key] = float(value)
+
+
+def observe(name, value, labels=None):
+    """Record one observation (seconds, for latencies) into histogram
+    `name`."""
+    key = _labels_key(labels)
+    with _lock:
+        series = _hists.setdefault(name, {})
+        key = _capped_key(series, key)
+        h = series.get(key)
+        if h is None:
+            h = series[key] = _Hist()
+        h.add(float(value))
+
+
+# ---------------------------------------------------------------------------
+# span ring buffer
+
+
+def _new_ring():
+    return collections.deque(maxlen=_env_int('PADDLE_MONITOR_SPAN_CAP', 4096))
+
+
+_spans = _new_ring()
+# monotonic count of spans ever appended — lets the profiler detect that a
+# session outgrew the ring (eviction = silently truncated session trace)
+_n_spans = [0]
+
+# getpid() is a cached libc call on bare metal but a full (seccomp-filtered)
+# syscall in sandboxed containers — measured ~30 us/call on the CI box, which
+# would dominate the whole span. Cache it; refresh in forked children.
+_PID = os.getpid()
+
+
+def _refresh_pid():
+    global _PID
+    _PID = os.getpid()
+
+
+if hasattr(os, 'register_at_fork'):
+    os.register_at_fork(after_in_child=_refresh_pid)
+
+
+class _Span(object):
+    """Plain __enter__/__exit__ object, not @contextmanager: the generator
+    protocol costs ~2-3 us per span on the hot path for nothing. Each
+    span(name) call returns a fresh single-use instance; calling it on a
+    function uses it as a decorator (a fresh span per invocation), matching
+    the old contextlib-based record_event."""
+
+    __slots__ = ('name', 'ts', 't0')
+
+    def __init__(self, name):
+        self.name = name
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            with _Span(self.name):
+                return fn(*args, **kwargs)
+        return wrapped
+
+    def __enter__(self):
+        self.ts = time.time() * 1e6
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        rec = {'name': self.name, 'ts': self.ts,
+               'dur': (time.perf_counter() - self.t0) * 1e6,
+               'pid': _PID, 'tid': threading.get_ident()}
+        # appended under the registry lock so spans() can iterate the deque
+        # without racing a concurrent append (deque iteration raises on
+        # mutation); deque.append alone is atomic but iteration is not
+        with _lock:
+            _spans.append(rec)
+            _n_spans[0] += 1
+        return False
+
+
+def span(name):
+    """RAII span: wall-clock start (us) + duration (us) + REAL pid/tid, so
+    multi-threaded serving traces keep one row per thread. Always recorded;
+    the bounded ring makes that safe."""
+    return _Span(name)
+
+
+class _TimedSpan(_Span):
+    """Span that also feeds its duration into a latency histogram — the
+    one-liner behind every instrumented run path (span + histogram from a
+    single perf_counter pair, recorded even when the body raises, so
+    failing runs stay visible in the latency data)."""
+
+    __slots__ = ('hist',)
+
+    def __init__(self, name, hist):
+        _Span.__init__(self, name)
+        self.hist = hist
+
+    def __exit__(self, *exc):
+        dur_s = time.perf_counter() - self.t0
+        _Span.__exit__(self, *exc)
+        observe(self.hist, dur_s)
+        return False
+
+
+def timed_span(name, histogram):
+    """span(name) that also observes its duration (seconds) into
+    `histogram`. Not exported via __all__ — an instrumentation-internal
+    helper, not a stable public surface."""
+    return _TimedSpan(name, histogram)
+
+
+def spans():
+    """Snapshot of the span ring (oldest first)."""
+    with _lock:
+        return list(_spans)
+
+
+def clear_spans():
+    with _lock:
+        _spans.clear()
+
+
+def span_seq():
+    """Monotonic count of spans ever recorded — lets a session-scoped
+    consumer (the profiler) detect that the bounded ring evicted spans
+    from its window."""
+    return _n_spans[0]
+
+
+def span_cap():
+    """Current capacity of the span ring."""
+    return _spans.maxlen
+
+
+# ---------------------------------------------------------------------------
+# export surfaces
+
+
+def _fmt(name, key):
+    if not key:
+        return name
+    return '%s{%s}' % (name, ','.join('%s=%s' % kv for kv in key))
+
+
+def _num(v):
+    return int(v) if float(v).is_integer() else v
+
+
+def counters():
+    """Flat {'name' or 'name{k=v}': value} dict of all counters."""
+    with _lock:
+        return {_fmt(n, k): _num(v)
+                for n, series in _counters.items()
+                for k, v in series.items()}
+
+
+def counter_delta(before, after=None):
+    """Counter movement since `before` (a counters() snapshot): only keys
+    that changed, as after - before."""
+    if after is None:
+        after = counters()
+    return {k: _num(v - before.get(k, 0))
+            for k, v in after.items() if v != before.get(k, 0)}
+
+
+def snapshot():
+    """Plain-dict view of every metric (the tests/bench surface)."""
+    with _lock:
+        return {
+            'ts': time.time(),
+            'counters': {_fmt(n, k): _num(v)
+                         for n, s in _counters.items()
+                         for k, v in s.items()},
+            'gauges': {_fmt(n, k): v
+                       for n, s in _gauges.items() for k, v in s.items()},
+            'histograms': {_fmt(n, k): h.stats()
+                           for n, s in _hists.items()
+                           for k, h in s.items()},
+            'spans_recorded': len(_spans),
+        }
+
+
+def _prom_labels(key, extra=()):
+    items = tuple(key) + tuple(extra)
+    if not items:
+        return ''
+    def esc(v):
+        return str(v).replace('\\', '\\\\').replace('"', '\\"') \
+            .replace('\n', '\\n')
+    return '{%s}' % ','.join('%s="%s"' % (k, esc(v)) for k, v in items)
+
+
+def export_prometheus():
+    """Text exposition format (the /metrics scrape body)."""
+    lines = []
+    with _lock:
+        for name in sorted(_counters):
+            lines.append('# TYPE %s counter' % name)
+            for key, v in sorted(_counters[name].items()):
+                lines.append('%s%s %s' % (name, _prom_labels(key), _num(v)))
+        for name in sorted(_gauges):
+            lines.append('# TYPE %s gauge' % name)
+            for key, v in sorted(_gauges[name].items()):
+                lines.append('%s%s %s' % (name, _prom_labels(key), v))
+        for name in sorted(_hists):
+            lines.append('# TYPE %s histogram' % name)
+            for key, h in sorted(_hists[name].items()):
+                cum = 0
+                for bound, c in zip(_BOUNDS, h.counts):
+                    cum += c
+                    lines.append('%s_bucket%s %d' % (
+                        name, _prom_labels(key, (('le', '%g' % bound),)),
+                        cum))
+                lines.append('%s_bucket%s %d' % (
+                    name, _prom_labels(key, (('le', '+Inf'),)), h.n))
+                lines.append('%s_sum%s %s' % (name, _prom_labels(key),
+                                              h.total))
+                lines.append('%s_count%s %d' % (name, _prom_labels(key),
+                                                h.n))
+    return '\n'.join(lines) + '\n'
+
+
+def reset():
+    """Clear every metric and the span ring (test isolation; the logging
+    thread, if any, keeps running)."""
+    global _spans
+    with _lock:
+        _counters.clear()
+        _gauges.clear()
+        _hists.clear()
+        _spans = _new_ring()
+
+
+# ---------------------------------------------------------------------------
+# FLAGS_monitor_log JSON-lines writer
+
+
+_log = {'path': None, 'stop': None, 'thread': None, 'interval': None}
+_atexit_hooked = [False]
+
+
+def log_snapshot(path=None):
+    """Append one snapshot as a JSON line to `path` (default: the
+    configured FLAGS_monitor_log file). No-op when neither is set."""
+    path = path or _log['path']
+    if not path:
+        return
+    line = json.dumps(snapshot(), sort_keys=True)
+    with open(path, 'a') as f:
+        f.write(line + '\n')
+
+
+def _log_loop(path, interval_s, stop):
+    while not stop.wait(interval_s):
+        try:
+            log_snapshot(path)
+        except Exception:
+            # a transient failure (full disk, rotated-away directory, an
+            # unserializable value) must not kill periodic logging
+            # permanently — count it and retry next interval;
+            # configure-time validation already proved the path writable
+            inc('monitor_log_write_errors')
+
+
+def _final_flush():
+    if _log['path']:
+        try:
+            log_snapshot()
+        except OSError:
+            pass            # interpreter teardown: nothing to raise into
+
+
+def configure_logging(path, interval_s=None):
+    """(Re)start or stop the periodic JSON-lines writer. `path` falsy stops
+    it. Writes one line immediately — which also validates the path LOUDLY
+    (an unwritable FLAGS_monitor_log raises here, at configure time, not
+    silently in a background thread). A failed configure leaves the
+    previous logging state untouched."""
+    path = path or None
+    if path is not None:
+        if interval_s is None:
+            try:
+                interval_s = float(os.environ.get(
+                    'PADDLE_MONITOR_LOG_INTERVAL_S', '') or 60.0)
+            except ValueError:
+                interval_s = 60.0
+        # a zero/negative interval would busy-loop the writer thread
+        interval_s = max(1.0, interval_s)
+    with _lock:
+        unchanged = path == _log['path'] and (
+            path is None
+            or (_log['thread'] is not None
+                and _log['thread'].is_alive()
+                and interval_s == _log['interval']))
+    if unchanged:
+        return              # no-op only when NOTHING changed
+    if path is not None:
+        # immediate line + path validation, BEFORE any state commits: a bad
+        # path must not stick around to poison later reconfigures. Written
+        # OUTSIDE the registry lock — a hung filesystem here must not
+        # freeze every inc/observe/span in the process
+        log_snapshot(path)
+    with _lock:
+        if _log['stop'] is not None:
+            _log['stop'].set()
+        _log['path'] = path
+        _log['stop'] = None
+        _log['thread'] = None
+        _log['interval'] = None
+        if path is None:
+            return
+        stop = threading.Event()
+        t = threading.Thread(target=_log_loop, args=(path, interval_s, stop),
+                             name='paddle-monitor-log', daemon=True)
+        _log['stop'] = stop
+        _log['thread'] = t
+        _log['interval'] = interval_s
+        if not _atexit_hooked[0]:
+            import atexit
+            atexit.register(_final_flush)
+            _atexit_hooked[0] = True
+        t.start()

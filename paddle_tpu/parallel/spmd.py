@@ -10,11 +10,14 @@ inserts psum/reduce-scatter collectives over ICI for the gradient reductions —
 semantically identical to AllReduce mode with CoeffNumDevice scaling (the
 global-batch mean IS the 1/N-scaled allreduce).
 """
+import time
+
 import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from .. import monitor
 from ..core import lowering
 from ..framework import Variable
 from .mesh import data_mesh
@@ -184,10 +187,15 @@ class DataParallelRunner(object):
                executor._feed_signature(feed, static_lods),
                tuple(fetch_names))
         entry = self._cache.get(key)
-        if entry is None:
+        fresh_compile = entry is None
+        if fresh_compile:
+            monitor.inc('compile_cache_miss')
+            t_compile = time.perf_counter()
             entry = self._compile(feed, fetch_names,
                                   feed_lods=static_lods)
             self._cache[key] = entry
+        else:
+            monitor.inc('compile_cache_hit')
 
         ro_state = {n: executor._state_value(scope, n, program)
                     for n in entry.ro_names}
@@ -232,8 +240,18 @@ class DataParallelRunner(object):
         prev, _papi._ACTIVE_MESH = _papi._ACTIVE_MESH, self._mesh
         try:
             with self._mesh:
-                fetches, new_state = entry.fn(feed, ro_state, rw_state,
-                                              key_arr)
+                if fresh_compile:
+                    # like the serial executor: jax.jit is lazy, the XLA
+                    # compile happens inside the FIRST call — compile wall
+                    # time must cover it, not just the jit construction
+                    with monitor.span('compile'):
+                        fetches, new_state = entry.fn(feed, ro_state,
+                                                      rw_state, key_arr)
+                    monitor.observe('compile_seconds',
+                                    time.perf_counter() - t_compile)
+                else:
+                    fetches, new_state = entry.fn(feed, ro_state, rw_state,
+                                                  key_arr)
         finally:
             _papi._ACTIVE_MESH = prev
         from .. import flags as _flags

@@ -6,6 +6,7 @@ TPU-native: a thin veneer over CompiledProgram.with_data_parallel — the SPMD
 mesh path. Kept because reference user scripts and tests construct it
 directly.
 """
+from . import monitor
 from .compiler import CompiledProgram, BuildStrategy, ExecutionStrategy
 from .framework import default_main_program
 from .executor import Executor, global_scope
@@ -31,9 +32,16 @@ class ParallelExecutor(object):
 
     def run(self, fetch_list, feed=None, feed_dict=None, return_numpy=True):
         feed = feed if feed is not None else feed_dict
-        return self._executor.run(self._compiled, feed=feed,
-                                  fetch_list=fetch_list, scope=self._scope,
-                                  return_numpy=return_numpy)
+        # run latency/compile metrics are recorded downstream (serial
+        # programs in Executor._run_impl, data-parallel ones at the
+        # CompiledProgram delegation + spmd runner); this counter + span
+        # only tag the traffic as the SPMD path
+        monitor.inc('parallel_executor_run_total')
+        with monitor.span('parallel_executor.run'):
+            return self._executor.run(self._compiled, feed=feed,
+                                      fetch_list=fetch_list,
+                                      scope=self._scope,
+                                      return_numpy=return_numpy)
 
     @property
     def device_count(self):

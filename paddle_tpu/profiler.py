@@ -3,19 +3,24 @@
 TPU-native: wraps jax.profiler (xplane traces, viewable in TensorBoard /
 Perfetto — the chrome-trace analog of reference tools/timeline.py) plus a
 lightweight host-side span recorder mirroring RecordEvent RAII spans
-(platform/profiler.h:82).
+(platform/profiler.h:82). Host spans live in monitor.py's always-on bounded
+ring — the executor's compile/run spans and user record_event spans share
+one timeline, with real pid/tid, so export_chrome_tracing works even when
+no profiler session was ever started.
 """
 import contextlib
 import json
-import time
+
+from . import monitor
 
 __all__ = ['cuda_profiler', 'reset_profiler', 'profiler', 'start_profiler',
            'stop_profiler', 'record_event', 'export_chrome_tracing']
 
-_events = []
 _active = False
 _trace_dir = None
 _depth = 0
+_session_ts = None      # wall-clock us of the outermost start_profiler
+_session_seq = 0        # monitor._n_spans at session start (overflow check)
 
 
 @contextlib.contextmanager
@@ -26,14 +31,13 @@ def cuda_profiler(output_file, output_mode=None, config=None):
 
 
 def reset_profiler():
-    global _events
-    _events = []
+    monitor.clear_spans()
 
 
 def start_profiler(state='All', tracer_option=None, trace_dir=None):
     """Errors from the device tracer propagate — a typo'd trace dir must
     fail loudly, not produce a silently empty profile."""
-    global _active, _trace_dir, _depth
+    global _active, _trace_dir, _depth, _session_ts, _session_seq
     if _active:
         # already profiling (reference start_profiler returns early when
         # enabled) — don't clobber a running device trace; the matching
@@ -48,6 +52,9 @@ def start_profiler(state='All', tracer_option=None, trace_dir=None):
         _trace_dir = trace_dir
     _active = True
     _depth = 1
+    import time
+    _session_ts = time.time() * 1e6
+    _session_seq = monitor.span_seq()
 
 
 def stop_profiler(sorted_key=None, profile_path='/tmp/profile'):
@@ -62,7 +69,18 @@ def stop_profiler(sorted_key=None, profile_path='/tmp/profile'):
         import jax
         _trace_dir = None
         jax.profiler.stop_trace()
-    export_chrome_tracing(profile_path)
+    # session exports cover the profiled WINDOW, not the whole always-on
+    # ring (a process may hold hours of pre-session spans)
+    appended = monitor.span_seq() - _session_seq
+    cap = monitor.span_cap()
+    if cap and appended > cap:
+        import warnings
+        warnings.warn(
+            "profiler session recorded %d host spans but the ring keeps "
+            "only %d — the exported trace is truncated to the newest %d; "
+            "raise PADDLE_MONITOR_SPAN_CAP (before import) to cover the "
+            "whole session" % (appended, cap, cap), stacklevel=2)
+    export_chrome_tracing(profile_path, since_ts=_session_ts)
 
 
 @contextlib.contextmanager
@@ -75,25 +93,30 @@ def profiler(state='All', sorted_key=None, profile_path='/tmp/profile',
         stop_profiler(sorted_key, profile_path)
 
 
-@contextlib.contextmanager
 def record_event(name):
-    """RAII span (reference platform/profiler.h:82 RecordEvent)."""
-    t0 = time.time()
-    try:
-        yield
-    finally:
-        if _active:
-            _events.append({'name': name, 'ts': t0 * 1e6,
-                            'dur': (time.time() - t0) * 1e6})
+    """RAII span (reference platform/profiler.h:82 RecordEvent). Recorded
+    unconditionally into monitor's bounded span ring — with the real
+    process id and thread id — so multi-threaded serving traces keep one
+    row per thread and no session needs to be active. Returns monitor's
+    plain context-manager object directly (no generator layer on the hot
+    path)."""
+    return monitor.span(name)
 
 
-def export_chrome_tracing(path):
-    """chrome://tracing JSON of host spans (reference tools/timeline.py:115)."""
+def export_chrome_tracing(path, since_ts=None):
+    """chrome://tracing JSON of host spans (reference tools/timeline.py:115).
+
+    Exports the whole always-on ring by default (works with no session);
+    `since_ts` (wall-clock us) keeps only spans that END at or after it —
+    how stop_profiler scopes a session export to the profiled window. A
+    bad path raises (fail-loudly doctrine — same contract as the device
+    tracer in start_profiler); it must not produce a silently missing
+    trace."""
+    events = monitor.spans()
+    if since_ts is not None:
+        events = [e for e in events if e['ts'] + e['dur'] >= since_ts]
     trace = {'traceEvents': [
         {'name': e['name'], 'ph': 'X', 'ts': e['ts'], 'dur': e['dur'],
-         'pid': 0, 'tid': 0} for e in _events]}
-    try:
-        with open(path, 'w') as f:
-            json.dump(trace, f)
-    except OSError:
-        pass
+         'pid': e['pid'], 'tid': e['tid']} for e in events]}
+    with open(path, 'w') as f:
+        json.dump(trace, f)

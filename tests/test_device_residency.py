@@ -153,10 +153,25 @@ def test_fingerprint_stable_across_rebuilds():
     assert m2._fingerprint() != fp
 
 
+def test_fingerprint_tracks_random_seed_mutation():
+    """random_seed is baked into the trace but is a plain attribute (no
+    version bump) — mutating it must still change the fingerprint, or the
+    process-wide compile cache serves an entry traced with the old seed."""
+    m, _, _ = _build_fixed_name_program()
+    fp0 = m._fingerprint()
+    m.random_seed = 7
+    assert m._fingerprint() != fp0
+    m.random_seed = 0
+    assert m._fingerprint() == fp0
+
+
 def test_compile_cache_hit_in_fresh_executor(monkeypatch):
     """Second identical lowering in a FRESH Executor must be a cache hit:
     lowering.build_callable is not called again (tier-1 stand-in for the
-    cross-process persistent-cache acceptance, which needs two processes)."""
+    cross-process persistent-cache acceptance, which needs two processes)
+    — and the monitor's compile_cache_hit/miss counters must say the same
+    thing without a monkeypatch (the observability-layer contract)."""
+    from paddle_tpu import monitor
     calls = []
     real = lowering_mod.build_callable
 
@@ -169,6 +184,7 @@ def test_compile_cache_hit_in_fresh_executor(monkeypatch):
     m2, s2, l2 = _build_fixed_name_program()
     feed = {'x': np.ones((2, 4), 'float32')}
 
+    pre1 = monitor.counters()
     exe1 = fluid.Executor(fluid.CPUPlace())
     sc1 = fluid.Scope()
     with fluid.scope_guard(sc1):
@@ -176,7 +192,10 @@ def test_compile_cache_hit_in_fresh_executor(monkeypatch):
         out1 = exe1.run(m1, feed=feed, fetch_list=[l1.name], scope=sc1)
     n_compiles = len(calls)
     assert n_compiles >= 1
+    d1 = monitor.counter_delta(pre1)
+    assert d1.get('compile_cache_miss', 0) >= 1
 
+    pre2 = monitor.counters()
     exe2 = fluid.Executor(fluid.CPUPlace())     # fresh executor, fresh scope
     sc2 = fluid.Scope()
     with fluid.scope_guard(sc2):
@@ -184,6 +203,11 @@ def test_compile_cache_hit_in_fresh_executor(monkeypatch):
         out2 = exe2.run(m2, feed=feed, fetch_list=[l2.name], scope=sc2)
     assert len(calls) == n_compiles, \
         "identical rebuilt program recompiled instead of hitting the cache"
+    d2 = monitor.counter_delta(pre2)
+    # rebuilt startup + rebuilt main: both answered by the fingerprint
+    # cache, and the counters prove no silent recompile happened
+    assert d2.get('compile_cache_hit', 0) >= 2
+    assert d2.get('compile_cache_miss', 0) == 0
     np.testing.assert_allclose(np.asarray(out1[0]), np.asarray(out2[0]),
                                rtol=1e-6)
 

@@ -1,0 +1,366 @@
+"""Observability layer tests (docs/observability.md).
+
+Covers the metrics registry contract (thread-safety, label cardinality cap,
+histogram percentiles), the three export surfaces (snapshot, prometheus
+text, FLAGS_monitor_log JSON-lines), the always-on span ring + chrome-trace
+unification (real pid/tid, fail-loudly export), and the ISSUE-2 acceptance
+scenario: a CPU smoke model whose compile-cache hit/miss counters, run
+latency histograms, and compile/run trace spans are all asserted from one
+scripted run.
+"""
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import monitor
+
+
+@pytest.fixture(autouse=True)
+def _fresh_monitor():
+    """Metrics are process-global: each test starts from a clean registry
+    and leaves no logging thread behind."""
+    monitor.reset()
+    yield
+    monitor.configure_logging(None)
+    monitor.reset()
+
+
+class TestRegistry(object):
+    def test_counters_gauges_and_labels(self):
+        monitor.inc('reqs_total')
+        monitor.inc('reqs_total', 2)
+        monitor.inc('reqs_total', labels={'path': 'run'})
+        monitor.set_gauge('queue_depth', 7)
+        snap = monitor.snapshot()
+        assert snap['counters']['reqs_total'] == 3
+        assert snap['counters']['reqs_total{path=run}'] == 1
+        assert snap['gauges']['queue_depth'] == 7.0
+
+    def test_thread_safety_exact_totals(self):
+        n_threads, per_thread = 8, 500
+
+        def work():
+            for _ in range(per_thread):
+                monitor.inc('t_total')
+                monitor.observe('t_seconds', 0.001)
+                with monitor.span('t_span'):
+                    pass
+
+        threads = [threading.Thread(target=work) for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        snap = monitor.snapshot()
+        assert snap['counters']['t_total'] == n_threads * per_thread
+        assert snap['histograms']['t_seconds']['count'] == \
+            n_threads * per_thread
+
+    def test_label_cardinality_cap(self, monkeypatch):
+        monkeypatch.setenv('PADDLE_MONITOR_MAX_SERIES', '4')
+        for i in range(20):
+            monitor.inc('capped_total', labels={'user': 'u%d' % i})
+        snap = monitor.snapshot()
+        series = [k for k in snap['counters']
+                  if k.startswith('capped_total')]
+        # 4 real series + the reserved {other=true} overflow series
+        assert len(series) == 5
+        assert snap['counters']['capped_total{other=true}'] == 16
+        assert snap['counters']['monitor_series_dropped'] == 16
+        # an existing series keeps accumulating even past the cap
+        monitor.inc('capped_total', labels={'user': 'u0'})
+        assert monitor.counters()['capped_total{user=u0}'] == 2
+
+    def test_histogram_percentiles(self):
+        for v in [0.001] * 50 + [0.004] * 30 + [0.03] * 15 + [0.3] * 5:
+            monitor.observe('lat_seconds', v)
+        h = monitor.snapshot()['histograms']['lat_seconds']
+        assert h['count'] == 100
+        assert h['min'] == 0.001 and h['max'] == 0.3
+        assert abs(h['sum'] - (0.05 + 0.12 + 0.45 + 1.5)) < 1e-9
+        # bucketed estimates: right bucket, clamped to observed min/max
+        assert 0.0005 <= h['p50'] <= 0.002
+        assert 0.002 <= h['p90'] <= 0.05
+        assert 0.1 <= h['p99'] <= 0.3
+
+    def test_inc_coerces_numpy_scalars(self):
+        monitor.inc('np_total', np.float32(0.5))
+        monitor.inc('np_total', np.int64(2))
+        json.dumps(monitor.snapshot())      # registry stays JSON-clean
+        assert monitor.counters()['np_total'] == 2.5
+
+    def test_span_usable_as_decorator(self):
+        @fluid.profiler.record_event('decorated_span')
+        def f(a, b):
+            return a + b
+
+        assert f(2, 3) == 5 and f(1, 1) == 2
+        names = [s['name'] for s in monitor.spans()]
+        assert names.count('decorated_span') == 2
+
+    def test_counter_delta(self):
+        monitor.inc('d_total', 5)
+        before = monitor.counters()
+        monitor.inc('d_total', 2)
+        monitor.inc('new_total')
+        delta = monitor.counter_delta(before)
+        assert delta == {'d_total': 2, 'new_total': 1}
+
+    def test_prometheus_exposition(self):
+        monitor.inc('hits_total', 3, labels={'path': 'run'})
+        monitor.set_gauge('up', 1)
+        monitor.observe('rt_seconds', 0.002)
+        text = monitor.export_prometheus()
+        assert '# TYPE hits_total counter' in text
+        assert 'hits_total{path="run"} 3' in text
+        assert '# TYPE up gauge' in text
+        assert '# TYPE rt_seconds histogram' in text
+        assert 'rt_seconds_bucket{le="+Inf"} 1' in text
+        assert 'rt_seconds_count 1' in text
+        assert 'rt_seconds_sum 0.002' in text
+
+
+class TestSpans(object):
+    def test_ring_is_bounded(self, monkeypatch):
+        monkeypatch.setenv('PADDLE_MONITOR_SPAN_CAP', '16')
+        monitor.reset()        # re-reads the cap
+        for i in range(50):
+            with monitor.span('s%d' % i):
+                pass
+        spans = monitor.spans()
+        assert len(spans) == 16
+        assert spans[-1]['name'] == 's49'      # newest kept, oldest dropped
+
+    def test_spans_carry_real_pid_tid(self, tmp_path):
+        with fluid.profiler.record_event('tid_span'):
+            pass
+        path = str(tmp_path / 'trace.json')
+        fluid.profiler.export_chrome_tracing(path)
+        with open(path) as f:
+            evs = json.load(f)['traceEvents']
+        ev = [e for e in evs if e['name'] == 'tid_span']
+        assert ev, 'span recorded without an active profiler session'
+        assert ev[0]['pid'] == os.getpid()
+        assert ev[0]['tid'] == threading.get_ident()
+        assert ev[0]['tid'] != 0
+
+    def test_export_chrome_tracing_bad_path_raises(self, tmp_path):
+        with pytest.raises(OSError):
+            fluid.profiler.export_chrome_tracing(
+                str(tmp_path / 'no_such_dir' / 'trace.json'))
+
+    def test_session_export_scopes_to_window(self, tmp_path):
+        """A profiler SESSION export covers the profiled window only —
+        pre-session spans from the always-on ring stay out."""
+        import time as _time
+        with monitor.span('before_session'):
+            pass
+        _time.sleep(0.01)
+        path = str(tmp_path / 'prof.json')
+        fluid.profiler.start_profiler()
+        with fluid.profiler.record_event('inside_session'):
+            pass
+        fluid.profiler.stop_profiler(profile_path=path)
+        with open(path) as f:
+            names = {e['name'] for e in json.load(f)['traceEvents']}
+        assert 'inside_session' in names
+        assert 'before_session' not in names
+        # sessionless export still dumps the whole ring (no session needed)
+        full = str(tmp_path / 'full.json')
+        fluid.profiler.export_chrome_tracing(full)
+        with open(full) as f:
+            names = {e['name'] for e in json.load(f)['traceEvents']}
+        assert 'before_session' in names
+
+    def test_session_outgrowing_ring_warns(self, monkeypatch, tmp_path):
+        monkeypatch.setenv('PADDLE_MONITOR_SPAN_CAP', '8')
+        monitor.reset()         # re-reads the cap
+        fluid.profiler.start_profiler()
+        for _ in range(20):
+            with monitor.span('s'):
+                pass
+        with pytest.warns(UserWarning, match='truncated'):
+            fluid.profiler.stop_profiler(
+                profile_path=str(tmp_path / 'p.json'))
+
+
+class TestFlagWiring(object):
+    def test_monitor_log_jsonl(self, tmp_path, monkeypatch):
+        # long interval: only the immediate line + the explicit one below
+        monkeypatch.setenv('PADDLE_MONITOR_LOG_INTERVAL_S', '3600')
+        path = str(tmp_path / 'mon.jsonl')
+        fluid.set_flags('monitor_log', path)
+        try:
+            assert fluid.get_flags('FLAGS_monitor_log') == path
+            monitor.inc('logged_total')
+            monitor.log_snapshot()
+            with open(path) as f:
+                lines = [json.loads(l) for l in f if l.strip()]
+            assert len(lines) >= 2
+            assert 'counters' in lines[0] and 'histograms' in lines[0]
+            assert lines[-1]['counters']['logged_total'] == 1
+        finally:
+            fluid.set_flags('monitor_log', '')
+
+    def test_monitor_log_bad_path_raises_at_configure(self, tmp_path):
+        with pytest.raises(OSError):
+            fluid.set_flags('monitor_log',
+                            str(tmp_path / 'nope' / 'mon.jsonl'))
+        # the rejected value must not stick: the flag rolls back and
+        # UNRELATED set_flags calls (which re-run side effects) still work
+        assert fluid.get_flags('FLAGS_monitor_log') == ''
+        fluid.set_flags('benchmark', True)
+        fluid.set_flags('benchmark', False)
+
+    def test_bad_env_monitor_log_warns_instead_of_crashing_import(
+            self, monkeypatch, tmp_path):
+        """A stale FLAGS_monitor_log env var must not turn every
+        `import paddle_tpu` into a crash: the import-time path warns and
+        runs without logging (explicit set_flags still raises, above)."""
+        from paddle_tpu import flags as flags_mod
+        bad = str(tmp_path / 'nope' / 'mon.jsonl')
+        monkeypatch.setenv('FLAGS_monitor_log', bad)
+        monkeypatch.setitem(flags_mod._flags, 'monitor_log', bad)
+        with pytest.warns(UserWarning, match='monitor logging'):
+            flags_mod._apply_side_effects(import_time=True)
+        # the bad value is cleared, so later UNRELATED set_flags calls
+        # (which re-run side effects with import_time=False) don't raise
+        assert flags_mod._flags['monitor_log'] == ''
+        fluid.set_flags('benchmark', True)
+        fluid.set_flags('benchmark', False)
+
+    def test_interval_change_restarts_writer(self, tmp_path, monkeypatch):
+        monkeypatch.setenv('PADDLE_MONITOR_LOG_INTERVAL_S', '3600')
+        path = str(tmp_path / 'mon.jsonl')
+        monitor.configure_logging(path)
+        assert monitor._log['interval'] == 3600.0
+        t1 = monitor._log['thread']
+        monitor.configure_logging(path)            # nothing changed: no-op
+        assert monitor._log['thread'] is t1
+        monitor.configure_logging(path, interval_s=120)
+        assert monitor._log['interval'] == 120.0
+        assert monitor._log['thread'] is not t1
+
+    def test_benchmark_flag_flows_into_sync_histogram(self):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        loss = fluid.layers.mean(x)
+        exe = fluid.Executor(fluid.CPUPlace())
+        main = fluid.default_main_program()
+        fluid.set_flags('benchmark', True)
+        try:
+            for _ in range(2):
+                exe.run(main, feed={'x': np.zeros((2, 4), 'float32')},
+                        fetch_list=[loss])
+        finally:
+            fluid.set_flags('benchmark', False)
+        h = monitor.snapshot()['histograms']
+        assert h['executor_sync_seconds']['count'] == 2
+        assert h['executor_run_seconds']['count'] == 2
+
+
+def _build_smoke():
+    """CPU smoke model with a RESET name generator so a second build is
+    structurally identical (fresh _uid, same fingerprint). Sizes/names are
+    deliberately distinct from every other test's programs: this test
+    asserts EXACT process-wide cache-counter deltas."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        with fluid.unique_name.guard():
+            x = fluid.layers.data(name='obs_x', shape=[6], dtype='float32')
+            h = fluid.layers.fc(input=x, size=5, act='relu')
+            loss = fluid.layers.mean(h)
+    return main, startup, loss
+
+
+class TestAcceptance(object):
+    def test_smoke_model_counters_and_trace(self, tmp_path):
+        """ISSUE 2 acceptance: first compile -> miss == 1; rebuilt
+        identical program in a FRESH Executor -> hit >= 1; nonzero
+        run-latency histogram; chrome trace carries both compile and run
+        spans."""
+        m1, s1, l1 = _build_smoke()
+        m2, s2, l2 = _build_smoke()
+        assert m1._uid != m2._uid
+        feed = {'obs_x': np.ones((3, 6), 'float32')}
+
+        exe1 = fluid.Executor(fluid.CPUPlace())
+        sc1 = fluid.Scope()
+        with fluid.scope_guard(sc1):
+            exe1.run(s1, scope=sc1)
+        # counters start clean AFTER the startup compile: the scenario
+        # under test is main-program compile -> rebuilt-program reuse
+        monitor.reset()
+        with fluid.scope_guard(sc1):
+            out1 = exe1.run(m1, feed=feed, fetch_list=[l1.name], scope=sc1)
+
+        exe2 = fluid.Executor(fluid.CPUPlace())    # fresh executor + scope
+        sc2 = fluid.Scope()
+        with fluid.scope_guard(sc2):
+            exe2.run(s2, scope=sc2)                # rebuilt startup: hit
+            out2 = exe2.run(m2, feed=feed, fetch_list=[l2.name], scope=sc2)
+
+        snap = monitor.snapshot()
+        assert snap['counters'].get('compile_cache_miss') == 1
+        assert snap['counters'].get('compile_cache_hit', 0) >= 1
+        assert snap['counters'].get('donation_run_total', 0) >= 1
+        assert snap['counters'].get('feed_host_bytes', 0) > 0
+        assert snap['histograms']['executor_run_seconds']['count'] >= 3
+        assert snap['histograms']['compile_seconds']['count'] == 1
+
+        path = str(tmp_path / 'trace.json')
+        fluid.profiler.export_chrome_tracing(path)
+        with open(path) as f:
+            names = {e['name'] for e in json.load(f)['traceEvents']}
+        assert 'compile' in names and 'run' in names
+        np.testing.assert_allclose(np.asarray(out1[0]),
+                                   np.asarray(out2[0]), rtol=1e-6)
+
+    def test_predictor_reuses_hooks(self, tmp_path):
+        x = fluid.layers.data(name='px', shape=[4], dtype='float32')
+        out = fluid.layers.fc(x, size=2)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        d = str(tmp_path / 'model')
+        fluid.io.save_inference_model(
+            d, ['px'], [out], exe,
+            main_program=fluid.default_main_program())
+        pred = fluid.create_predictor(d)
+        monitor.reset()
+        pred.run({'px': np.ones((1, 4), 'float32')})
+        pred.run({'px': np.ones((1, 4), 'float32')})
+        snap = monitor.snapshot()
+        assert snap['counters']['predictor_run_total'] == 2
+        assert snap['counters']['executor_run_total'] == 2
+        assert snap['counters']['compile_cache_hit'] >= 1
+        assert any(s['name'] == 'predictor.run' for s in monitor.spans())
+
+
+class TestObsReport(object):
+    def test_pretty_prints_snapshot_log_and_trace(self, tmp_path, capsys):
+        import sys
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))), 'tools'))
+        try:
+            import obsreport
+        finally:
+            sys.path.pop(0)
+        monitor.inc('feed_host_bytes', 4096)
+        monitor.observe('executor_run_seconds', 0.005)
+        log = str(tmp_path / 'mon.jsonl')
+        monitor.log_snapshot(log)
+        obsreport.main([log])
+        out = capsys.readouterr().out
+        assert 'feed_host_bytes' in out and '4.0KiB' in out
+        assert 'executor_run_seconds' in out
+
+        with monitor.span('traced'):
+            pass
+        trace = str(tmp_path / 'trace.json')
+        fluid.profiler.export_chrome_tracing(trace)
+        obsreport.main([trace])
+        out = capsys.readouterr().out
+        assert 'traced' in out and 'total_ms' in out

@@ -1,0 +1,138 @@
+"""Pretty-print observability dumps (docs/observability.md).
+
+Two input shapes, auto-detected:
+
+- a FLAGS_monitor_log JSON-lines file (each line one monitor.snapshot()):
+  prints the newest snapshot — counters, gauges, histogram percentiles —
+  or every line with --all;
+- a chrome-trace JSON from profiler.export_chrome_tracing: prints a per-span
+  aggregate table (count, total/mean/max ms, threads) sorted by total time.
+
+Usage:
+    python tools/obsreport.py runlog.jsonl
+    python tools/obsreport.py runlog.jsonl --all
+    python tools/obsreport.py trace.json
+"""
+import argparse
+import json
+import sys
+
+
+def _fmt_seconds(s):
+    if s is None:
+        return '-'
+    if s < 1e-3:
+        return '%.1fus' % (s * 1e6)
+    if s < 1.0:
+        return '%.2fms' % (s * 1e3)
+    return '%.3fs' % s
+
+
+def _fmt_bytes(n):
+    for unit in ('B', 'KiB', 'MiB', 'GiB'):
+        if abs(n) < 1024 or unit == 'GiB':
+            return '%.1f%s' % (n, unit) if unit != 'B' else '%d%s' % (n, unit)
+        n /= 1024.0
+    return '%d' % n
+
+
+def print_snapshot(snap, out=sys.stdout):
+    w = out.write
+    if snap.get('ts'):
+        w('snapshot @ %s\n' % snap['ts'])
+    counters = snap.get('counters') or {}
+    if counters:
+        w('\ncounters:\n')
+        width = max(len(k) for k in counters)
+        for k in sorted(counters):
+            v = counters[k]
+            shown = _fmt_bytes(v) if k.split('{')[0].endswith('_bytes') \
+                else '%g' % v
+            w('  %-*s %s\n' % (width, k, shown))
+    gauges = snap.get('gauges') or {}
+    if gauges:
+        w('\ngauges:\n')
+        width = max(len(k) for k in gauges)
+        for k in sorted(gauges):
+            w('  %-*s %g\n' % (width, k, gauges[k]))
+    hists = snap.get('histograms') or {}
+    if hists:
+        w('\nhistograms:\n')
+        width = max(len(k) for k in hists)
+        w('  %-*s %8s %10s %10s %10s %10s %10s\n'
+          % (width, '', 'count', 'avg', 'p50', 'p90', 'p99', 'max'))
+        for k in sorted(hists):
+            h = hists[k]
+            w('  %-*s %8d %10s %10s %10s %10s %10s\n' % (
+                width, k, h.get('count', 0),
+                _fmt_seconds(h.get('avg')), _fmt_seconds(h.get('p50')),
+                _fmt_seconds(h.get('p90')), _fmt_seconds(h.get('p99')),
+                _fmt_seconds(h.get('max'))))
+    if 'spans_recorded' in snap:
+        w('\nspans in ring: %d\n' % snap['spans_recorded'])
+
+
+def print_trace(trace, out=sys.stdout):
+    events = trace.get('traceEvents', [])
+    agg = {}
+    for e in events:
+        if e.get('ph') != 'X':
+            continue
+        a = agg.setdefault(e.get('name', '?'),
+                           {'n': 0, 'total': 0.0, 'max': 0.0,
+                            'tids': set()})
+        dur = float(e.get('dur', 0.0))
+        a['n'] += 1
+        a['total'] += dur
+        a['max'] = max(a['max'], dur)
+        a['tids'].add(e.get('tid'))
+    w = out.write
+    w('%d spans, %d distinct names\n\n' % (len(events), len(agg)))
+    if not agg:
+        return
+    width = max(len(n) for n in agg)
+    w('%-*s %8s %12s %12s %12s %8s\n'
+      % (width, 'span', 'count', 'total_ms', 'mean_ms', 'max_ms',
+         'threads'))
+    for name, a in sorted(agg.items(), key=lambda kv: -kv[1]['total']):
+        w('%-*s %8d %12.2f %12.3f %12.3f %8d\n' % (
+            width, name, a['n'], a['total'] / 1e3,
+            a['total'] / a['n'] / 1e3, a['max'] / 1e3, len(a['tids'])))
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description='Pretty-print a monitor snapshot log or chrome-trace '
+                    'dump')
+    p.add_argument('path', help='JSON-lines snapshot log (FLAGS_monitor_log)'
+                                ' or chrome-trace JSON')
+    p.add_argument('--all', action='store_true',
+                   help='print every snapshot line, not just the newest')
+    args = p.parse_args(argv)
+
+    with open(args.path) as f:
+        first = f.read(1)
+        f.seek(0)
+        if not first:
+            raise SystemExit('%s: empty file' % args.path)
+        # a trace dump is one JSON object with traceEvents; a monitor log
+        # is JSON-lines of snapshots — try the object shape first
+        try:
+            doc = json.load(f)
+        except ValueError:
+            doc = None
+        if isinstance(doc, dict) and 'traceEvents' in doc:
+            print_trace(doc)
+            return
+        f.seek(0)
+        snaps = [json.loads(line) for line in f if line.strip()]
+    if not snaps:
+        raise SystemExit('%s: no snapshot lines' % args.path)
+    for snap in (snaps if args.all else snaps[-1:]):
+        print_snapshot(snap)
+        if args.all:
+            sys.stdout.write('\n' + '-' * 60 + '\n')
+
+
+if __name__ == '__main__':
+    main()
