@@ -56,6 +56,8 @@ from . import models
 from . import transpiler
 from . import parallel
 from . import monitor
+from . import resilience
+from .resilience import TrainingGuard
 from . import profiler
 from . import flags
 from .flags import get_flags, set_flags

@@ -14,15 +14,33 @@ no gather-to-host, no pserver round-trips.
     fluid.checkpoint.load_checkpoint(dirname, main_program, scope=scope)
 
 Plain numpy values round-trip too, so single-host users get the same API.
+
+Hardened write path (docs/resilience.md): single-host checkpoints are
+written to a sibling tmp directory, stamped with a manifest carrying
+per-tensor crc32s, fsynced, and published by one atomic rename — a crash
+(or an injected ``ckpt_write`` fault) at any point leaves either the old
+checkpoint or the new one, never a torn directory. ``step=`` checkpoints
+rotate (keep-last-N, ``PADDLE_CKPT_KEEP``), and ``load_latest_valid``
+walks them newest-first, skipping corrupt/partial ones (each skip counts
+into the ``ckpt_fallback_total`` monitor series).
 """
 import os
+import re
+import shutil
+import time
 
 import numpy as np
 
+from . import monitor
+from . import resilience
 from .framework import default_main_program
 from .executor import global_scope
 
-__all__ = ['save_checkpoint', 'load_checkpoint']
+__all__ = ['save_checkpoint', 'load_checkpoint', 'load_latest_valid',
+           'list_checkpoints']
+
+_STEP_RE = re.compile(r'^step_(\d+)$')
+_TMP_SUFFIX = '.paddle-tmp'
 
 
 def _persistable_state(program, scope, strict=True):
@@ -41,10 +59,97 @@ def _persistable_state(program, scope, strict=True):
     return state
 
 
-def save_checkpoint(dirname, main_program=None, scope=None, step=None):
+def _tmp_pid(name):
+    """Trailing pid of a tmp-dir name, or None."""
+    tail = name.rsplit('.', 1)[-1]
+    return int(tail) if tail.isdigit() else None
+
+
+def _writer_live(path, name, ttl_override=False):
+    """Is the tmp dir's writer still at it? pid liveness
+    (resilience.pid_alive). With ttl_override — used ONLY for '.old.'
+    swap dirs, whose legitimate window is the milliseconds between the
+    two swap renames — a recycled pid after a reboot must not block
+    crash-recovery forever, so anything older than PADDLE_CKPT_TMP_TTL_S
+    (default 1 h) counts as dead. Plain in-progress tmp dirs get NO ttl:
+    a multi-hour orbax write with a live pid is a writer, not a crash."""
+    if not resilience.pid_alive(_tmp_pid(name)):
+        return False
+    if not ttl_override:
+        return True
+    try:
+        age = time.time() - os.path.getmtime(path)
+    except OSError:
+        return False
+    ttl = resilience._env_float('PADDLE_CKPT_TMP_TTL_S', 3600.0)
+    return age < ttl
+
+
+def _clean_stale_tmp(parent, only_base=None):
+    """Recover from crashed writers, then sweep their leftovers.
+
+    A crash between _save_hardened's two swap renames leaves the COMPLETE
+    previous checkpoint under ``<path>.paddle-tmp.old.<pid>`` with no
+    ``<path>`` — restore it FIRST (deleting it would violate the
+    'old or new always survives' invariant). Remaining tmp dirs whose
+    writer pid is dead are swept; a live pid means a concurrent writer
+    mid-save (an async eval saver next to the trainer) — leave its tmp
+    alone.
+
+    only_base: restrict to tmp entries of ONE checkpoint basename —
+    required when sweeping a parent directory that may hold unrelated
+    jobs' data (the bare-layout sweep in load_latest_valid)."""
+    try:
+        names = os.listdir(parent)
+    except OSError:
+        return
+    if only_base is not None:
+        names = [n for n in names
+                 if n.split(_TMP_SUFFIX)[0] == only_base]
+    old_marker = _TMP_SUFFIX + '.old.'
+    for n in names:
+        src = os.path.join(parent, n)
+        if old_marker in n:
+            if _writer_live(src, n, ttl_override=True):
+                continue        # a LIVE writer mid-swap: restoring its
+                # .old dir would make its tmp->path rename fail and its
+                # cleanup destroy the fully-written new checkpoint
+            final = os.path.join(parent, n.split(_TMP_SUFFIX)[0])
+            if not os.path.exists(final):
+                try:
+                    os.rename(src, final)   # crash-recovery: restore old
+                    continue
+                except OSError:
+                    pass
+            shutil.rmtree(src, ignore_errors=True)
+    ttl = resilience._env_float('PADDLE_CKPT_TMP_TTL_S', 3600.0)
+    for n in names:
+        if _TMP_SUFFIX in n and old_marker not in n:
+            src = os.path.join(parent, n)
+            if _writer_live(src, n):
+                continue
+            # pid liveness is host-local: on shared storage another
+            # HOST's in-progress write looks pid-dead here — the age
+            # guard is what actually protects it (same rationale as
+            # resilience.sweep_stale_tmp_files)
+            try:
+                if time.time() - os.path.getmtime(src) < ttl:
+                    continue
+            except OSError:
+                pass
+            shutil.rmtree(src, ignore_errors=True)
+
+
+def save_checkpoint(dirname, main_program=None, scope=None, step=None,
+                    keep_last_n=None):
     """Write every persistable var of `main_program` found in `scope`.
     Sharded jax.Arrays (multi-host or Reduce-mode state) are written
-    per-shard in parallel by orbax. Returns the checkpoint path."""
+    per-shard in parallel by orbax. Returns the checkpoint path.
+
+    step: write under ``dirname/step_<step>`` (the rotating layout
+    load_latest_valid expects). keep_last_n (default: env
+    ``PADDLE_CKPT_KEEP``, unset = keep all): after a successful step-mode
+    write, delete the oldest step checkpoints beyond N."""
     import orbax.checkpoint as ocp
 
     main_program = main_program if main_program is not None else \
@@ -54,7 +159,8 @@ def save_checkpoint(dirname, main_program=None, scope=None, step=None):
     if not state:
         raise RuntimeError("save_checkpoint: nothing persistable to save")
     import jax
-    if jax.process_count() > 1:
+    multihost = jax.process_count() > 1
+    if multihost:
         # orbax multi-host serialization needs GLOBAL arrays; values that
         # never went through a mesh (learning-rate scalars, counters) are
         # host-local and identical on every process — promote them to
@@ -74,30 +180,109 @@ def save_checkpoint(dirname, main_program=None, scope=None, step=None):
 
     path = os.path.abspath(dirname if step is None
                            else os.path.join(dirname, 'step_%d' % step))
-    with ocp.StandardCheckpointer() as ckpt:
-        ckpt.save(path, state, force=True)
-        ckpt.wait_until_finished()
+    with monitor.timed_span('ckpt_write', 'ckpt_write_seconds'):
+        if multihost:
+            # orbax's own commit protocol (tmp + success marker) provides
+            # cross-process atomicity; per-tensor crc32s are not computable
+            # for non-addressable shards, so multi-host checkpoints carry
+            # no manifest (load_latest_valid still validates via orbax)
+            resilience.maybe_fault('ckpt_write')
+            with ocp.StandardCheckpointer() as ckpt:
+                ckpt.save(path, state, force=True)
+                ckpt.wait_until_finished()
+        else:
+            _save_hardened(path, state, step)
+    monitor.inc('ckpt_write_total')
+    if step is not None and os.path.isdir(os.path.dirname(path)):
+        if keep_last_n is None:
+            env = os.environ.get('PADDLE_CKPT_KEEP', '')
+            try:
+                keep_last_n = int(env) if env else None
+            except ValueError:
+                # a typo'd knob must not fail a save that already
+                # published — run without rotation and say so
+                import warnings
+                warnings.warn("PADDLE_CKPT_KEEP=%r is not an integer; "
+                              "rotation disabled" % env, stacklevel=2)
+                keep_last_n = None
+        # rank-gated: on shared storage every process sees the same step
+        # dirs — concurrent rmtrees strand half-deleted checkpoints (and
+        # inflate ckpt_rotate_total world-size-fold). Non-positive keep
+        # (the '-1 = unlimited' convention) means keep all — slicing
+        # [:-keep] with keep=-1 would delete the checkpoint just written.
+        if keep_last_n is not None and int(keep_last_n) > 0 \
+                and jax.process_index() == 0:
+            _rotate(os.path.dirname(path), int(keep_last_n))
     return path
 
 
-def load_checkpoint(dirname, main_program=None, scope=None, step=None):
-    """Restore persistable vars into `scope`. Arrays come back with the
-    shardings they were saved with (orbax restores the layout); numpy
-    values restore as numpy. Returns the list of restored names."""
+def _save_hardened(path, state, step):
+    """Single-host write: orbax into a sibling tmp dir, manifest with
+    per-tensor crc32s, fsync, one atomic rename into place. The
+    ``ckpt_write`` fault site fires between the tmp write and the rename —
+    the worst crash point — so injected faults prove no torn checkpoint
+    can be published."""
     import orbax.checkpoint as ocp
+    parent = os.path.dirname(path) or '.'
+    os.makedirs(parent, exist_ok=True)
+    # scoped to THIS checkpoint's tmp entries: pid liveness is host-local,
+    # so an unscoped sweep on shared storage could destroy another host's
+    # in-progress write of a sibling checkpoint
+    _clean_stale_tmp(parent, only_base=os.path.basename(path))
+    tmp = path + _TMP_SUFFIX + '.%d' % os.getpid()
+    old = path + _TMP_SUFFIX + '.old.%d' % os.getpid()
+    try:
+        with ocp.StandardCheckpointer() as ckpt:
+            ckpt.save(tmp, state, force=True)
+            ckpt.wait_until_finished()
+        resilience.write_manifest(tmp, resilience.build_manifest(
+            state, step=step))
+        resilience.fsync_dir(tmp)
+        resilience.maybe_fault('ckpt_write')
+        if os.path.exists(path):
+            # a directory rename cannot replace a non-empty target:
+            # swap via a tmp name, removing the old tree only after the
+            # new one is in place
+            os.rename(path, old)
+        os.rename(tmp, path)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        if os.path.isdir(old) and not os.path.exists(path):
+            os.rename(old, path)        # crash mid-swap: restore the old
+        raise
+    finally:
+        shutil.rmtree(old, ignore_errors=True)
+    resilience.fsync_dir(parent)
 
-    main_program = main_program if main_program is not None else \
-        default_main_program()
-    scope = scope if scope is not None else global_scope()
-    path = os.path.abspath(dirname if step is None
-                           else os.path.join(dirname, 'step_%d' % step))
-    if not os.path.exists(path):
-        raise IOError("load_checkpoint: %r does not exist" % path)
+
+def _rotate(dirname, keep):
+    for step_n, path in list_checkpoints(dirname)[:-keep]:
+        shutil.rmtree(path, ignore_errors=True)
+        monitor.inc('ckpt_rotate_total')
+
+
+def list_checkpoints(dirname):
+    """[(step, path)] of step-layout checkpoints under `dirname`, oldest
+    first. Tmp dirs and non-step entries are ignored."""
+    out = []
+    try:
+        names = os.listdir(dirname)
+    except OSError:
+        return out
+    for n in names:
+        m = _STEP_RE.match(n)
+        if m and os.path.isdir(os.path.join(dirname, n)):
+            out.append((int(m.group(1)), os.path.join(dirname, n)))
+    return sorted(out)
+
+
+def _restore(path, main_program, scope, verify=True):
+    """Restore `path` into `scope`; raises on any validation failure
+    (missing vars, crc mismatch against the manifest)."""
+    import orbax.checkpoint as ocp
 
     with ocp.StandardCheckpointer() as ckpt:
         restored = ckpt.restore(path)
-    # scope the restore to the program's persistables and validate the
-    # checkpoint matches (the symmetric contract of save_checkpoint)
     wanted = set(v.name for v in main_program.list_vars() if v.persistable)
     missing = wanted - set(restored)
     if missing:
@@ -105,6 +290,15 @@ def load_checkpoint(dirname, main_program=None, scope=None, step=None):
             "load_checkpoint: checkpoint at %r is missing persistable "
             "vars %s of the given program — wrong checkpoint/program "
             "pair?" % (path, sorted(missing)))
+    if verify:
+        manifest = resilience.read_manifest(path)
+        if manifest is not None:
+            bad = resilience.verify_manifest(manifest, restored)
+            if bad:
+                raise RuntimeError(
+                    "load_checkpoint: checkpoint at %r fails crc/shape "
+                    "verification for %s — the checkpoint is corrupt"
+                    % (path, sorted(bad)))
     names = []
     for name, val in restored.items():
         if name not in wanted:
@@ -112,3 +306,66 @@ def load_checkpoint(dirname, main_program=None, scope=None, step=None):
         scope.set(name, val)
         names.append(name)
     return sorted(names)
+
+
+def load_checkpoint(dirname, main_program=None, scope=None, step=None):
+    """Restore persistable vars into `scope`. Arrays come back with the
+    shardings they were saved with (orbax restores the layout); numpy
+    values restore as numpy. Returns the list of restored names. When the
+    checkpoint carries a manifest (hardened single-host writes), restored
+    bytes are crc-verified and a mismatch raises — use load_latest_valid
+    to fall back to an older checkpoint instead."""
+    main_program = main_program if main_program is not None else \
+        default_main_program()
+    scope = scope if scope is not None else global_scope()
+    path = os.path.abspath(dirname if step is None
+                           else os.path.join(dirname, 'step_%d' % step))
+    if not os.path.exists(path):
+        raise IOError("load_checkpoint: %r does not exist" % path)
+    return _restore(path, main_program, scope)
+
+
+def load_latest_valid(dirname, main_program=None, scope=None):
+    """Restore the NEWEST uncorrupted checkpoint under `dirname`.
+
+    Walks ``step_<n>`` checkpoints newest-first (plus `dirname` itself
+    when it is a bare checkpoint), skipping any that fail to restore or
+    fail manifest crc verification; each skip increments
+    ``ckpt_fallback_total``. Returns ``(path, restored_names)``. Raises
+    IOError when nothing valid remains — at that point operator
+    intervention beats silently training from scratch."""
+    main_program = main_program if main_program is not None else \
+        default_main_program()
+    scope = scope if scope is not None else global_scope()
+    dirname = os.path.abspath(dirname)
+    # recover checkpoints stranded mid-swap by a crashed writer before
+    # enumerating. Step layout: the tmp dirs live inside dirname. Bare
+    # layout (dirname itself is the checkpoint): beside it — sweep the
+    # parent RESTRICTED to this checkpoint's basename, since the parent
+    # may hold unrelated jobs' data (and pid liveness is host-local, so
+    # a broad sweep on shared storage could destroy another host's
+    # in-progress write)
+    _clean_stale_tmp(dirname)
+    candidates = [p for _, p in reversed(list_checkpoints(dirname))]
+    if not candidates:
+        _clean_stale_tmp(os.path.dirname(dirname),
+                         only_base=os.path.basename(dirname))
+        candidates = [p for _, p in reversed(list_checkpoints(dirname))]
+    if not candidates and os.path.isdir(dirname):
+        candidates = [dirname]
+    errors = []
+    for i, path in enumerate(candidates):
+        try:
+            names = _restore(path, main_program, scope)
+        except Exception as e:          # noqa: BLE001 — corrupt ckpt
+            errors.append('%s: %s' % (os.path.basename(path), e))
+            monitor.inc('ckpt_fallback_total')
+            continue
+        # how far back the restore landed — 0 resets the gauge after a
+        # clean newest-checkpoint restore, so dashboards stop showing a
+        # recovered job as limping
+        monitor.set_gauge('ckpt_fallback_depth', float(i))
+        return path, names
+    raise IOError(
+        "load_latest_valid: no valid checkpoint under %r (tried %d): %s"
+        % (dirname, len(candidates), '; '.join(errors) or 'none found'))

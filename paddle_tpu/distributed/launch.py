@@ -19,14 +19,26 @@ Each worker sees:
   PADDLE_CURRENT_ENDPOINT  this worker's ip:port
   PADDLE_TRAINER_ENDPOINTS comma list of all endpoints
   PADDLE_COORDINATOR       jax.distributed coordinator 'ip:port'
+
+Failure detection (docs/resilience.md): ``wait_procs`` replaces the bare
+wait loop — a worker dying mid-run kills the survivors and raises a
+WorkerFailedError NAMING the dead rank within seconds, instead of the
+classic "7 of 8 workers hang in the next collective until the job
+timeout". Worker-side, ``init_from_env`` bounds the jax.distributed
+rendezvous with ``PADDLE_RENDEZVOUS_DEADLINE_S`` (default 300) and raises
+an actionable error naming this rank, the coordinator, and the expected
+endpoint list when peers never show up.
 """
 import argparse
 import os
 import socket
 import subprocess
 import sys
+import threading
+import time
 
-__all__ = ['launch_procs', 'init_from_env', 'main']
+__all__ = ['launch_procs', 'init_from_env', 'wait_procs',
+           'WorkerFailedError', 'main']
 
 
 def _free_ports(n, ip='127.0.0.1'):
@@ -109,23 +121,187 @@ def launch_procs(entrypoint, entrypoint_args=(), nproc_per_node=1,
             logs.append(f)
             out = f
         cmd = [sys.executable, '-u', entrypoint] + list(entrypoint_args)
-        procs.append(subprocess.Popen(cmd, env=env, stdout=out,
-                                      stderr=subprocess.STDOUT
-                                      if out else None))
+        p = subprocess.Popen(cmd, env=env, stdout=out,
+                             stderr=subprocess.STDOUT if out else None)
+        p.paddle_rank = rank            # wait_procs names ranks from this
+        procs.append(p)
     return procs
 
 
-def init_from_env():
+class WorkerFailedError(RuntimeError):
+    """One worker of a multi-process launch died (or the launch deadline
+    expired). .rank / .returncode identify the first failure; .running
+    lists ranks that were still alive (and were killed) at raise time."""
+
+    def __init__(self, message, rank=None, returncode=None, running=()):
+        RuntimeError.__init__(self, message)
+        self.rank = rank
+        self.returncode = returncode
+        self.running = list(running)
+
+
+def _rank_of(p, i):
+    return getattr(p, 'paddle_rank', i)
+
+
+def wait_procs(procs, deadline_s=None, poll_s=0.2, kill_survivors=True):
+    """Wait for every launched worker; FAIL FAST with a rank-naming error.
+
+    - a worker exits nonzero -> the survivors are killed (they would hang
+      in their next collective waiting for the dead rank) and
+      WorkerFailedError names the dead rank and exit code;
+    - `deadline_s` (default env PADDLE_LAUNCH_DEADLINE_S, unset = no
+      deadline) elapses -> everything is killed and the error names the
+      ranks that were still running.
+
+    Returns the list of exit codes (all zero) on success."""
+    if deadline_s is None:
+        env = os.environ.get('PADDLE_LAUNCH_DEADLINE_S', '')
+        deadline_s = float(env) if env else None
+
+    def _kill_and_reap(pending, do_kill):
+        """Name still-running ranks, then (optionally) kill + reap them —
+        a rank that exited within this poll sweep is dead, not 'still
+        running', and long-lived callers must not accumulate zombies."""
+        running = sorted(_rank_of(q, procs.index(q))
+                         for q in pending if q.poll() is None)
+        if do_kill:
+            for q in pending:
+                if q.poll() is None:
+                    q.kill()
+            for q in pending:
+                try:
+                    q.wait(timeout=10)
+                except Exception:
+                    pass
+        return running
+
+    t0 = time.monotonic()
+    pending = list(procs)
+    while pending:
+        for p in list(pending):
+            rc = p.poll()
+            if rc is None:
+                continue
+            pending.remove(p)
+            if rc != 0:
+                running = _kill_and_reap(pending, kill_survivors)
+                from .. import monitor
+                monitor.inc('worker_failure_total')
+                if not running:
+                    detail = "no other workers were running"
+                elif kill_survivors:
+                    detail = ("killed still-running ranks %s (they would "
+                              "have hung at the next collective)" % running)
+                else:
+                    detail = ("ranks %s are STILL RUNNING "
+                              "(kill_survivors=False)" % running)
+                raise WorkerFailedError(
+                    "worker rank %d exited with code %s; %s"
+                    % (_rank_of(p, procs.index(p)), rc, detail),
+                    rank=_rank_of(p, procs.index(p)), returncode=rc,
+                    running=running)
+        if pending and deadline_s is not None and \
+                time.monotonic() - t0 > deadline_s:
+            running = _kill_and_reap(pending, True)
+            from .. import monitor
+            # its own series: a deadline kill of HEALTHY-but-slow workers
+            # is not a worker crash — alerts keyed on worker_failure_total
+            # must not fire for it
+            monitor.inc('launch_deadline_total')
+            raise WorkerFailedError(
+                "launch deadline (%.1fs) expired with ranks %s still "
+                "running — killed them; inspect their logs for the hang"
+                % (deadline_s, running), running=running)
+        if pending:
+            time.sleep(poll_s)
+    return [p.returncode for p in procs]
+
+
+def init_from_env(rendezvous_deadline_s=None):
     """Worker-side bootstrap: read the launcher's env contract and
     initialize jax.distributed; returns (rank, world_size). No-op (0, 1)
-    when not launched by the launcher."""
+    when not launched by the launcher.
+
+    The rendezvous is bounded by `rendezvous_deadline_s` (default env
+    PADDLE_RENDEZVOUS_DEADLINE_S, 300 s): when peers never connect —
+    a worker crashed before rendezvous, a typo'd coordinator — this
+    raises an error naming this rank, the coordinator, and the expected
+    endpoints instead of hanging until the cluster scheduler's timeout.
+    Transient connect errors retry under the 'collective' site policy."""
     world = int(os.environ.get('PADDLE_TRAINERS_NUM', '1'))
     rank = int(os.environ.get('PADDLE_TRAINER_ID', '0'))
     coordinator = os.environ.get('PADDLE_COORDINATOR')
     if world > 1 and coordinator:
+        if rendezvous_deadline_s is None:
+            env = os.environ.get('PADDLE_RENDEZVOUS_DEADLINE_S', '')
+            if env:
+                rendezvous_deadline_s = float(env)
+            else:
+                from .. import flags as _flags
+                rendezvous_deadline_s = _flags.get_flags(
+                    'rendezvous_deadline_secs') or 300.0
         from ..parallel import collective
-        collective.init_distributed(coordinator_address=coordinator,
-                                    num_processes=world, process_id=rank)
+        from .. import resilience
+
+        done = threading.Event()
+        cancelled = threading.Event()
+        errs = []
+        outcome = []                    # ['ok'] | ['cancelled']
+
+        def _connect():
+            try:
+                resilience.retry_call(
+                    lambda: collective.init_distributed(
+                        coordinator_address=coordinator,
+                        num_processes=world, process_id=rank),
+                    site='collective')
+                if cancelled.is_set():
+                    # the caller already raised the deadline error: a
+                    # late success must not leave live jax.distributed
+                    # global state behind (a re-init attempt would die on
+                    # 'initialize should only be called once')
+                    import jax
+                    try:
+                        jax.distributed.shutdown()
+                    except Exception:
+                        pass
+                    outcome.append('cancelled')
+                else:
+                    outcome.append('ok')
+            except Exception as e:      # noqa: BLE001 — re-raised below
+                errs.append(e)
+            finally:
+                done.set()
+
+        t = threading.Thread(target=_connect, daemon=True)
+        t.start()
+        if not done.wait(rendezvous_deadline_s):
+            cancelled.set()
+            # close the success/timeout race: the thread may have
+            # finished init between our wait timing out and cancelled
+            # being set (in which case it skipped the shutdown) — give
+            # it a beat and honor a clean 'ok' as success
+            if done.wait(1.0):
+                if outcome == ['ok'] and not errs:
+                    return rank, world
+                if errs:
+                    # the thread failed for a REAL reason in the grace
+                    # window — surface it, not a misleading generic
+                    # "peer never connected"
+                    raise errs[0]
+            from .. import monitor
+            monitor.inc('rendezvous_timeout_total')
+            raise RuntimeError(
+                "rank %d: jax.distributed rendezvous at %s did not "
+                "complete within %.1fs — of the %d expected workers "
+                "(endpoints %s) at least one never connected. Check the "
+                "launcher logs for a dead rank (wait_procs names it), "
+                "then restart the job."
+                % (rank, coordinator, rendezvous_deadline_s, world,
+                   os.environ.get('PADDLE_TRAINER_ENDPOINTS', '?')))
+        if errs:
+            raise errs[0]
     return rank, world
 
 
@@ -149,10 +325,12 @@ def main(argv=None):
         node_ips=[s for s in args.node_ips.split(',') if s] or None,
         node_id=args.node_id, log_dir=args.log_dir,
         devices_per_proc=args.devices_per_proc or None)
-    rc = 0
-    for p in procs:
-        rc |= p.wait()
-    sys.exit(rc)
+    try:
+        wait_procs(procs)
+    except WorkerFailedError as e:
+        sys.stderr.write('paddle_tpu.distributed.launch: %s\n' % e)
+        sys.exit(1)
+    sys.exit(0)
 
 
 if __name__ == '__main__':

@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 
 from . import monitor
+from . import resilience
 from .framework import (Program, Variable, default_main_program, CPUPlace,
                         TPUPlace)
 from .core import lowering
@@ -667,17 +668,24 @@ class Executor(object):
             # executor must stay free of backend initialization (io-only
             # executors, relay clients where client creation takes seconds)
             _wire_persistent_cache()
-            read, written = lowering.analyze_state(program, fetch_names)
-            # only require state that is read before being written this run
-            needed = self._read_before_write(program, read, written,
-                                             set(feed), fetch_names)
-            lod_out = {}
-            fn, ro_names, rw_names = lowering.build_callable(
-                program, fetch_names, needed, written,
-                static_lods=static_lods, static_feed=static_feed,
-                lod_out=lod_out, donate=donate)
-            entry = _CompiledEntry(fn, fetch_names, ro_names, rw_names,
-                                   written, program, lod_out)
+
+            def _build():
+                resilience.maybe_fault('compile')
+                read, written = lowering.analyze_state(program, fetch_names)
+                # only require state read before being written this run
+                needed = self._read_before_write(program, read, written,
+                                                 set(feed), fetch_names)
+                lod_out = {}
+                fn, ro_names, rw_names = lowering.build_callable(
+                    program, fetch_names, needed, written,
+                    static_lods=static_lods, static_feed=static_feed,
+                    lod_out=lod_out, donate=donate)
+                return _CompiledEntry(fn, fetch_names, ro_names, rw_names,
+                                      written, program, lod_out)
+            try:
+                entry = _build()
+            except Exception as e:      # noqa: BLE001 — classified inside
+                entry = resilience.retry_after(e, _build, site='compile')
             if use_program_cache:
                 self._cache_put(key, entry)
         else:
@@ -694,14 +702,32 @@ class Executor(object):
                            self._run_counter)
         if fresh_compile:
             # jax.jit is lazy: the XLA compile happens inside the FIRST
-            # call, so honest compile wall time spans lowering + that call
-            with monitor.span('compile'):
-                fetches, new_state = entry.fn(feed, ro_state, rw_state,
-                                              key_arr)
+            # call, so honest compile wall time spans lowering + that call.
+            # A transient XLA failure here (RESOURCE_EXHAUSTED, relay
+            # hiccup) retries under the 'compile' site policy.
+            def _first_call():
+                with monitor.span('compile'):
+                    return entry.fn(feed, ro_state, rw_state, key_arr)
+            try:
+                fetches, new_state = _first_call()
+            except Exception as e:      # noqa: BLE001 — classified inside
+                fetches, new_state = resilience.retry_after(
+                    e, _first_call, site='compile', state=rw_state)
             monitor.observe('compile_seconds',
                             time.perf_counter() - t_compile)
         else:
-            fetches, new_state = entry.fn(feed, ro_state, rw_state, key_arr)
+            # steady-state dispatch: the success path pays one fault-site
+            # check and a try frame; retry machinery engages only after an
+            # exception actually escaped (and never with consumed donated
+            # buffers — resilience._buffers_alive guards the re-invoke)
+            def _dispatch():
+                resilience.maybe_fault('run')
+                return entry.fn(feed, ro_state, rw_state, key_arr)
+            try:
+                fetches, new_state = _dispatch()
+            except Exception as e:      # noqa: BLE001 — classified inside
+                fetches, new_state = resilience.retry_after(
+                    e, _dispatch, site='run', state=rw_state)
         if os.environ.get('PADDLE_OPTEST_COLLECT_DIR'):
             # TPU second-place validation (reference op_test.py:304
             # check_output_with_place / the mkldnn-suite reuse pattern):
@@ -783,34 +809,37 @@ class Executor(object):
         if lo < len(ops):
             parts.append(('dev', lo, len(ops)))
 
-        def _reads(part_ops):
-            """Names read by the ops (incl. nested control-flow blocks,
-            whose bodies read parent vars not listed on the parent op)."""
-            acc = set()
-            produced = set()
+        def _rw_sets(part_ops):
+            """(reads, writes) of the ops incl. nested control-flow blocks
+            (whose bodies touch parent vars not listed on the parent op);
+            reads exclude names the part itself produced first."""
+            reads, writes = set(), set()
 
             from .framework import SUB_BLOCK_ATTRS
 
             def _walk(op_list):
                 for op in op_list:
-                    acc.update(n for n in op.input_arg_names
-                               if n not in produced)
+                    reads.update(n for n in op.input_arg_names
+                                 if n not in writes)
                     for a in SUB_BLOCK_ATTRS:
                         idx = getattr(op, 'attrs', {}).get(a)
                         if idx is not None:
                             _walk(program.block(int(idx)).ops)
-                    produced.update(op.output_arg_names)
+                    writes.update(op.output_arg_names)
             _walk(part_ops)
-            return acc
+            return reads, writes
 
+        part_rw = [_rw_sets(ops[plo:phi]) for _, plo, phi in parts]
         plan = []
         for k, (kind, plo, phi) in enumerate(parts):
             sub = program.clone()
             sub.global_block().ops = sub.global_block().ops[plo:phi]
-            ins = _reads(ops[plo:phi])
+            ins = part_rw[k][0]
             later_ins = set()
-            for _, qlo, qhi in parts[k + 1:]:
-                later_ins |= _reads(ops[qlo:qhi])
+            later_written = set()
+            for reads_q, writes_q in part_rw[k + 1:]:
+                later_ins |= reads_q
+                later_written |= writes_q
             produced = set()
             for op in ops[plo:phi]:
                 produced.update(op.output_arg_names)
@@ -821,7 +850,8 @@ class Executor(object):
                 and not (gb._find_var_recursive(n) is not None
                          and gb._find_var_recursive(n).persistable))
             plan.append({'kind': kind, 'sub': sub, 'ins': ins,
-                         'crossing': crossing, 'lo': plo})
+                         'crossing': crossing, 'lo': plo,
+                         'later_written': later_written})
         return plan
 
     def _run_segmented(self, program, feed, fetch_names, scope,
@@ -856,37 +886,52 @@ class Executor(object):
             if entry is None:
                 t_compile = time.perf_counter()
                 _wire_persistent_cache()
-                read, written = lowering.analyze_state(sub, seg_fetch)
-                needed = self._read_before_write(
-                    sub, read, written, set(seg_feed), seg_fetch)
-                lod_out = {}
-                # op_offset = the segment's slice start in the original
-                # block, so every op derives the SAME per-op PRNG key as
-                # the unsegmented program (rng streams must not depend on
-                # where host ops split the program, and two RNG ops at
-                # equal within-segment indices must not collide)
-                if seg['kind'] == 'dev':
-                    fn, ro_names, rw_names = lowering.build_callable(
-                        sub, seg_fetch, needed, written,
-                        static_lods=lod_env, static_feed=static_feed,
-                        lod_out=lod_out, donate=donate,
-                        lower_params={'op_offset': seg['lo']})
-                else:
-                    fn, ro_names, rw_names = lowering.build_fn(
-                        sub, seg_fetch, needed, written,
-                        static_lods=lod_env, static_feed=static_feed,
-                        lod_out=lod_out,
-                        lower_params={'host_eager': True,
-                                      'op_offset': seg['lo']})
-                entry = _CompiledEntry(fn, seg_fetch, ro_names, rw_names,
-                                       written, sub, lod_out)
+
+                def _build_segment():
+                    resilience.maybe_fault('compile')
+                    read, written = lowering.analyze_state(sub, seg_fetch)
+                    needed = self._read_before_write(
+                        sub, read, written, set(seg_feed), seg_fetch)
+                    lod_out = {}
+                    # op_offset = the segment's slice start in the
+                    # original block, so every op derives the SAME per-op
+                    # PRNG key as the unsegmented program (rng streams
+                    # must not depend on where host ops split the
+                    # program, and two RNG ops at equal within-segment
+                    # indices must not collide)
+                    if seg['kind'] == 'dev':
+                        fn, ro_names, rw_names = lowering.build_callable(
+                            sub, seg_fetch, needed, written,
+                            static_lods=lod_env, static_feed=static_feed,
+                            lod_out=lod_out, donate=donate,
+                            lower_params={'op_offset': seg['lo']})
+                    else:
+                        fn, ro_names, rw_names = lowering.build_fn(
+                            sub, seg_fetch, needed, written,
+                            static_lods=lod_env, static_feed=static_feed,
+                            lod_out=lod_out,
+                            lower_params={'host_eager': True,
+                                          'op_offset': seg['lo']})
+                    return _CompiledEntry(fn, seg_fetch, ro_names,
+                                          rw_names, written, sub, lod_out)
+                try:
+                    entry = _build_segment()
+                except Exception as e:  # noqa: BLE001 — classified inside
+                    entry = resilience.retry_after(e, _build_segment,
+                                                   site='compile')
                 seg['entry'] = entry
                 # segment build cost (the jit compile itself is lazy and
                 # lands in this segment's first call below; device-segment
                 # granularity is close enough for the rare hostseg path)
                 monitor.observe('compile_seconds',
                                 time.perf_counter() - t_compile)
-            ro = {n: self._state_value(scope, n, program)
+            # cache=False also for names a LATER segment writes: caching
+            # would freeze the caller's init buffer writeable=False even
+            # though the scope is rebound right after that later segment —
+            # the rw-path exemption applies program-wide, not per-segment
+            later_w = seg.get('later_written', ())
+            ro = {n: self._state_value(scope, n, program,
+                                       cache=n not in later_w)
                   for n in entry.ro_names}
             rw = {n: self._state_value(scope, n, program, cache=False)
                   for n in entry.rw_names}
@@ -906,10 +951,36 @@ class Executor(object):
                         jax.local_devices(backend='cpu')[0])
                 except Exception:
                     guard = contextlib.nullcontext()
-                with guard:
-                    fetches, new_state = entry.fn(seg_feed, ro, rw, key_arr)
+
+                def _host_dispatch():
+                    resilience.maybe_fault('host_relay')
+                    with guard:
+                        return entry.fn(seg_feed, ro, rw, key_arr)
+
+                def _boundary_fault(e):
+                    # host segments run callbacks with SIDE EFFECTS
+                    # (py_func appending to files, print): a failure
+                    # after the callback ran is not safely re-invocable.
+                    # Only boundary-injected faults — raised BEFORE the
+                    # segment executed — retry; real mid-segment
+                    # transients propagate.
+                    return isinstance(e, resilience.InjectedFault) \
+                        and e.transient
+                try:
+                    fetches, new_state = _host_dispatch()
+                except Exception as e:  # noqa: BLE001 — classified inside
+                    fetches, new_state = resilience.retry_after(
+                        e, _host_dispatch, site='host_relay',
+                        retryable=_boundary_fault)
             else:
-                fetches, new_state = entry.fn(seg_feed, ro, rw, key_arr)
+                def _seg_dispatch():
+                    resilience.maybe_fault('run')
+                    return entry.fn(seg_feed, ro, rw, key_arr)
+                try:
+                    fetches, new_state = _seg_dispatch()
+                except Exception as e:  # noqa: BLE001 — classified inside
+                    fetches, new_state = resilience.retry_after(
+                        e, _seg_dispatch, site='run', state=rw)
             # scope rebinds before the nan-check for the same donated-buffer
             # reason as run(): a raise must not strand deleted arrays
             scope.update(new_state)
@@ -1086,12 +1157,21 @@ class Executor(object):
             monitor.inc('compile_cache_miss')
             t_compile = time.perf_counter()
             _wire_persistent_cache()
-            read, written = lowering.analyze_state(program, fetch_names)
-            needed = self._read_before_write(program, read, written,
-                                             set(feed0), fetch_names)
-            fn, ro_names, rw_names = lowering.build_fn(
-                program, fetch_names, needed, written,
-                static_lods=static_lods)
+
+            def _build_fused():
+                resilience.maybe_fault('compile')
+                read, written = lowering.analyze_state(program, fetch_names)
+                needed = self._read_before_write(program, read, written,
+                                                 set(feed0), fetch_names)
+                fn, ro_names, rw_names = lowering.build_fn(
+                    program, fetch_names, needed, written,
+                    static_lods=static_lods)
+                return fn, ro_names, rw_names, written
+            try:
+                fn, ro_names, rw_names, written = _build_fused()
+            except Exception as e:      # noqa: BLE001 — classified inside
+                fn, ro_names, rw_names, written = resilience.retry_after(
+                    e, _build_fused, site='compile')
 
             def fused(stacked_feed, ro, rw, base_key):
                 # carry: ONE merged state dict (all written persistables,
@@ -1150,15 +1230,27 @@ class Executor(object):
         key_arr = _run_key(program.random_seed, _next_program_run(program),
                            self._run_counter)
         if fresh_compile:
-            # as in run(): jax.jit compiles inside the first call
-            with monitor.span('compile'):
-                fetches, new_state = entry.fn(stacked, ro_state, rw_state,
-                                              key_arr)
+            # as in run(): jax.jit compiles inside the first call;
+            # transient XLA failures retry under the 'compile' site
+            def _first_call():
+                with monitor.span('compile'):
+                    return entry.fn(stacked, ro_state, rw_state, key_arr)
+            try:
+                fetches, new_state = _first_call()
+            except Exception as e:      # noqa: BLE001 — classified inside
+                fetches, new_state = resilience.retry_after(
+                    e, _first_call, site='compile', state=rw_state)
             monitor.observe('compile_seconds',
                             time.perf_counter() - t_compile)
         else:
-            fetches, new_state = entry.fn(stacked, ro_state, rw_state,
-                                          key_arr)
+            def _dispatch():
+                resilience.maybe_fault('run')
+                return entry.fn(stacked, ro_state, rw_state, key_arr)
+            try:
+                fetches, new_state = _dispatch()
+            except Exception as e:      # noqa: BLE001 — classified inside
+                fetches, new_state = resilience.retry_after(
+                    e, _dispatch, site='run', state=rw_state)
         scope.update(new_state)
         # checkpoint_notify: same host-side save contract as run()
         for cn_dir in entry.notify_dirs:

@@ -25,6 +25,10 @@ owns memory and kernels); what remains is the debugging/determinism tier:
                            parallel.collective.barrier_with_timeout, the
                            failure-detection knob (reference
                            FLAGS_rpc_deadline, distributed RPC tier)
+- FLAGS_rendezvous_deadline_secs  default bound on the jax.distributed
+                           rendezvous in distributed.launch.init_from_env
+                           (PADDLE_RENDEZVOUS_DEADLINE_S overrides; the
+                           hung-worker detection knob, docs/resilience.md)
 - FLAGS_monitor_log        path for periodic JSON-lines monitor snapshots
                            (monitor.configure_logging; interval via
                            PADDLE_MONITOR_LOG_INTERVAL_S, default 60 s) —
@@ -37,7 +41,8 @@ __all__ = ['get_flags', 'set_flags']
 
 _BOOL = ('check_nan_inf', 'debug_nans', 'cpu_deterministic', 'benchmark',
          'deterministic_compile')
-_FLOAT = ('eager_delete_tensor_gb', 'barrier_deadline_secs')
+_FLOAT = ('eager_delete_tensor_gb', 'barrier_deadline_secs',
+          'rendezvous_deadline_secs')
 _INT = ('paddle_num_threads',)
 _STR = ('monitor_log',)
 

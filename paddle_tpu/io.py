@@ -64,14 +64,23 @@ def save_vars(executor, dirname, main_program=None, vars=None,
         # explicit host materialization point: scope values stay
         # device-resident across runs and are only pulled host-side here
         arrays[name] = np.asarray(val)
+    # atomic tmp+fsync+rename publication (resilience.atomic_file): a
+    # crash — or an injected ckpt_write fault — mid-save leaves the old
+    # params file or none, never a torn one the loader would half-read.
+    # Sweep dead writers' leftovers first so crashes don't accumulate
+    # full-size partial files until the directory hits ENOSPC.
+    from . import resilience
+    resilience.sweep_stale_tmp_files(dirname)
     if filename is not None:
         if not filename.endswith('.npz'):
             filename += '.npz'  # np.savez appends it anyway; keep load in sync
-        np.savez(os.path.join(dirname, filename), **arrays)
+        with resilience.atomic_file(os.path.join(dirname, filename)) as tmp:
+            np.savez(tmp, **arrays)
     else:
         for name, arr in arrays.items():
-            np.save(os.path.join(dirname, name.replace('/', '%2F') + '.npy'),
-                    arr)
+            path = os.path.join(dirname, name.replace('/', '%2F') + '.npy')
+            with resilience.atomic_file(path) as tmp:
+                np.save(tmp, arr)
 
 
 def save_params(executor, dirname, main_program=None, filename=None):

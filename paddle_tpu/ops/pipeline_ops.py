@@ -16,6 +16,18 @@ import jax.numpy as jnp
 
 from ..core.registry import register_op
 
+_composition_logged = set()
+
+
+def _log_once(key, message):
+    """One-time composition diagnostics: silent fallbacks to full-batch
+    replication are correct but lose the sharding win — say so, once."""
+    if key in _composition_logged:
+        return
+    _composition_logged.add(key)
+    import logging
+    logging.getLogger('paddle_tpu.pipeline').warning(message)
+
 
 def _bindings(op):
     slot_names = list(op.attr('slot_names'))
@@ -109,13 +121,46 @@ def _gpipe_run(ctx, op):
     # compose with data parallelism when the mesh carries a 'data' axis:
     # microbatch rows shard over it and param cotangents psum over it
     # (parallel/pipeline.py batch_axis) — falls back to replication when
-    # the per-microbatch row count does not divide the axis
+    # the per-microbatch row count does not divide the axis. The axis-name
+    # contract ('data', literally) and the divisibility rule are
+    # documented in docs/parallelism.md.
     n_micro = int(op.attr('num_microbatches') or 0) or n_stages
     batch_axis = None
     if mesh.shape.get('data', 1) > 1:
         b0 = int(jnp.shape(act[0])[0])
         if b0 % n_micro == 0 and (b0 // n_micro) % mesh.shape['data'] == 0:
             batch_axis = 'data'
+    gated = False
+    if batch_axis is not None:
+        from ..parallel.ring_attention import shard_map_supports_axis_names
+        beyond = set(mesh.axis_names) - {'pipe', 'data'}
+        if beyond and not shard_map_supports_axis_names():
+            # manual-over-all fallback with axes OUTSIDE the manual set:
+            # cotangent psum semantics for those axes are jax-version-
+            # dependent — gate composition off (replicate: correct but
+            # duplicated compute) rather than risk silently wrong grads
+            _log_once(('gated', tuple(sorted(mesh.axis_names))),
+                      "gpipe_run: batch_axis composition DISABLED — this "
+                      "jax's shard_map lacks axis_names and the mesh has "
+                      "axes %s beyond {pipe, data}; the batch replicates "
+                      "over non-pipe axes (correct, duplicated compute). "
+                      "Upgrade jax for manual-over-subset shard_map."
+                      % sorted(beyond))
+            batch_axis = None
+            gated = True
+    # (skip when gated: the axis qualified — the cause was shard_map
+    # support, already diagnosed above; a second "name it 'data'" log
+    # would send the operator after the wrong fix)
+    if batch_axis is None and not gated and any(
+            mesh.shape[a] > 1 for a in mesh.axis_names if a != 'pipe'):
+        _log_once(('noengage', tuple(sorted(mesh.axis_names)), n_micro),
+                  "gpipe_run: mesh %s has a >1 non-pipe axis but batch "
+                  "composition did NOT engage — it requires an axis "
+                  "literally named 'data' whose size divides "
+                  "B//num_microbatches (see docs/parallelism.md). The "
+                  "batch is replicated per non-pipe device: correct "
+                  "math, duplicated compute."
+                  % dict(mesh.shape))
     out = gpipe(stage_fn, stacked, act, mesh,
                 num_microbatches=n_micro, extra=shared_vals,
                 batch_axis=batch_axis)

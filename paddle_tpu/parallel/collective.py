@@ -54,12 +54,24 @@ def ppermute_shift(x, axis_name, shift=1):
 def init_distributed(coordinator_address=None, num_processes=None,
                      process_id=None):
     """Multi-host bootstrap — replaces gen_nccl_id + PADDLE_TRAINER_ENDPOINTS
-    env plumbing (reference transpiler nccl2 mode)."""
+    env plumbing (reference transpiler nccl2 mode). On failure the partial
+    jax.distributed global state is torn down so a retry (launch.py's
+    rendezvous policy) re-initializes cleanly instead of dying on
+    'initialize should only be called once'."""
+    from .. import resilience
+    resilience.maybe_fault('collective')
     kwargs = {}
     if coordinator_address is not None:
         kwargs.update(coordinator_address=coordinator_address,
                       num_processes=num_processes, process_id=process_id)
-    jax.distributed.initialize(**kwargs)
+    try:
+        jax.distributed.initialize(**kwargs)
+    except Exception:
+        try:
+            jax.distributed.shutdown()
+        except Exception:
+            pass
+        raise
 
 
 def barrier(name='barrier'):
@@ -92,6 +104,8 @@ def barrier_with_timeout(name='paddle_tpu_barrier', timeout_s=None,
     if timeout_s is None:
         from .. import flags as _flags
         timeout_s = _flags.get_flags('barrier_deadline_secs') or 60.0
+    from .. import resilience
+    resilience.maybe_fault('collective')
     import threading
     done = threading.Event()
     errs = []
@@ -111,10 +125,13 @@ def barrier_with_timeout(name='paddle_tpu_barrier', timeout_s=None,
     if not done.wait(timeout_s):
         if on_timeout is not None:
             on_timeout()
+        from .. import monitor
+        monitor.inc('barrier_timeout_total')
         raise RuntimeError(
-            "barrier %r timed out after %.1fs: one or more of the %d "
-            "hosts is unresponsive (checkpoint-resume + job restart is "
-            "the recovery path, SURVEY §5)"
-            % (name, timeout_s, jax.process_count()))
+            "barrier %r timed out after %.1fs on rank %d: one or more of "
+            "the %d hosts is unresponsive — the launcher's wait_procs "
+            "names the dead rank; checkpoint-resume + job restart is the "
+            "recovery path (SURVEY §5)"
+            % (name, timeout_s, jax.process_index(), jax.process_count()))
     if errs:
         raise errs[0]

@@ -1,0 +1,799 @@
+"""Fault-tolerant runtime layer: fault injection, retry/backoff, hardened
+checkpoint primitives, and non-finite-step recovery.
+
+The reference Fluid runtime survives real fleets through PADDLE_ENFORCE
+error chains, parameter-server retry loops, and checkpoint_notify
+(operators/checkpoint_notify_op.cc); its TPU-native rebuild compiles and
+observes well but — before this layer — died on the first transient
+compile failure, corrupted checkpoint, hung rendezvous, or NaN step.
+Four cooperating pieces:
+
+- **Fault injection** (``PADDLE_FAULT_SPEC``): raise controlled
+  ``InjectedFault`` errors at the compile / run / host-relay / collective /
+  checkpoint-write boundaries so every recovery path below is actually
+  testable. Grammar (';'-separated clauses)::
+
+      site:trigger[,kind=fatal]
+      compile:p=0.5        # each compile fails with probability 0.5
+      run:nth=3            # exactly the 3rd run dispatch fails
+      run:n=2              # the first 2 dispatches fail (then recover)
+      ckpt_write:always    # every checkpoint write fails
+      collective:every=4   # every 4th collective boundary fails
+
+  Faults are transient (retryable) unless ``kind=fatal``. The env var is
+  re-read at every site check, so tests can flip it mid-process.
+
+- **Retry policy**: exponential backoff + full jitter + a wall-clock
+  deadline, applied by the executor to transient compile/dispatch errors
+  (RESOURCE_EXHAUSTED, UNAVAILABLE, connection resets — the TF-style
+  transient taxonomy) and by the distributed bootstrap to rendezvous.
+  Knobs: ``PADDLE_RETRY_MAX_ATTEMPTS`` (default 4), ``PADDLE_RETRY_BASE_S``
+  (0.05), ``PADDLE_RETRY_MAX_S`` (2.0), ``PADDLE_RETRY_DEADLINE_S`` (30).
+
+- **Checkpoint hardening helpers** (crc32 manifests, atomic tmp+fsync+
+  rename writes) used by checkpoint.py / io.py; see
+  ``checkpoint.load_latest_valid`` for the fallback-restore contract.
+
+- **TrainingGuard**: a step wrapper that detects a non-finite loss, rolls
+  the scope back to the pre-step state, backs off an optional loss scale,
+  and escalates to a raise after N consecutive bad steps.
+
+Every recovery event increments a monitor counter (``retry_attempt_total``
+``{site}``, ``retry_giveup_total{site}``, ``fault_injected_total{site}``,
+``ckpt_fallback_total``, ``nonfinite_skip_total``) so the observability
+layer answers "is this job limping" without a debugger. Full catalog:
+docs/resilience.md.
+"""
+import os
+import random
+import threading
+import time
+import zlib
+
+import numpy as np
+
+from . import monitor
+
+__all__ = ['InjectedFault', 'NonFiniteError', 'RetryPolicy', 'TrainingGuard',
+           'maybe_fault', 'install_fault', 'clear_faults', 'fault_spec',
+           'is_transient', 'retry_call', 'retry_after']
+
+
+# ---------------------------------------------------------------------------
+# fault injection
+
+
+class InjectedFault(RuntimeError):
+    """Controlled fault raised at a runtime boundary by PADDLE_FAULT_SPEC /
+    install_fault. Transient by default so the retry layer engages; fatal
+    faults (kind=fatal) must propagate un-retried."""
+
+    def __init__(self, site, message, transient=True):
+        RuntimeError.__init__(self, message)
+        self.site = site
+        self.transient = transient
+
+
+class NonFiniteError(RuntimeError):
+    """Raised by TrainingGuard after max_bad_steps consecutive non-finite
+    steps — the escalation path when skipping stops being recovery and
+    starts being denial."""
+
+
+class _FaultRule(object):
+    __slots__ = ('site', 'mode', 'value', 'fatal', 'calls', 'rng')
+
+    def __init__(self, site, mode, value, fatal):
+        self.site = site
+        self.mode = mode          # 'always' | 'p' | 'nth' | 'n' | 'every'
+        self.value = value
+        self.fatal = fatal
+        self.calls = 0
+        # deterministic per-rule stream: reproducible fault schedules
+        # without perturbing global random state
+        seed = int(os.environ.get('PADDLE_FAULT_SEED', '0') or 0)
+        self.rng = random.Random((zlib.crc32(site.encode()) << 1) ^ seed)
+
+    def fire(self):
+        self.calls += 1
+        if self.mode == 'always':
+            return True
+        if self.mode == 'p':
+            return self.rng.random() < self.value
+        if self.mode == 'nth':
+            return self.calls == int(self.value)
+        if self.mode == 'n':
+            return self.calls <= int(self.value)
+        if self.mode == 'every':
+            return self.calls % int(self.value) == 0
+        return False
+
+
+def _parse_spec(spec):
+    """'compile:p=0.5;run:nth=3,kind=fatal' -> {site: _FaultRule}. Raises
+    ValueError on a malformed clause — a typo'd fault spec silently doing
+    nothing would defeat the whole point of injecting faults."""
+    rules = {}
+    for clause in spec.split(';'):
+        clause = clause.strip()
+        if not clause:
+            continue
+        if ':' not in clause:
+            raise ValueError(
+                "PADDLE_FAULT_SPEC clause %r: expected 'site:trigger'"
+                % clause)
+        site, _, rest = clause.partition(':')
+        site = site.strip()
+        fatal = False
+        mode, value = None, None
+        for part in rest.split(','):
+            part = part.strip()
+            if not part:
+                continue
+            if part == 'always':
+                mode, value = 'always', None
+            elif part.startswith('kind='):
+                kind = part[5:]
+                if kind not in ('transient', 'fatal'):
+                    raise ValueError(
+                        "PADDLE_FAULT_SPEC site %r: unknown kind=%r "
+                        "(transient|fatal)" % (site, kind))
+                fatal = kind == 'fatal'
+            elif '=' in part:
+                k, _, v = part.partition('=')
+                if k not in ('p', 'nth', 'n', 'every'):
+                    raise ValueError(
+                        "PADDLE_FAULT_SPEC site %r: unknown trigger %r "
+                        "(always|p=|nth=|n=|every=)" % (site, k))
+                try:
+                    mode, value = k, float(v)
+                except ValueError:
+                    raise ValueError(
+                        "PADDLE_FAULT_SPEC site %r: non-numeric trigger "
+                        "value %r" % (site, v))
+                if k != 'p' and value < 1:
+                    raise ValueError(
+                        "PADDLE_FAULT_SPEC site %r: %s=%s must be >= 1"
+                        % (site, k, v))
+            else:
+                raise ValueError(
+                    "PADDLE_FAULT_SPEC site %r: unparseable part %r"
+                    % (site, part))
+        if mode is None:
+            raise ValueError(
+                "PADDLE_FAULT_SPEC site %r: no trigger (always|p=|nth=|"
+                "n=|every=)" % site)
+        rules[site] = _FaultRule(site, mode, value, fatal)
+    return rules
+
+
+_fault_lock = threading.Lock()
+_env_rules = (None, {})         # (spec string it was parsed from, rules)
+_prog_rules = {}                # install_fault() registrations (tests)
+
+
+def maybe_fault(site):
+    """Raise an InjectedFault at `site` if the active fault spec says so.
+    The no-fault fast path is one env read + a falsy check — cheap enough
+    for the executor hot path."""
+    global _env_rules
+    spec = os.environ.get('PADDLE_FAULT_SPEC', '')
+    if not spec and not _prog_rules:
+        return
+    with _fault_lock:
+        rule = _prog_rules.get(site)
+        if rule is None and spec:
+            if _env_rules[0] != spec:
+                # counters survive only within one spec string; a changed
+                # spec is a new fault schedule
+                _env_rules = (spec, _parse_spec(spec))
+            rule = _env_rules[1].get(site)
+        if rule is None or not rule.fire():
+            return
+        transient = not rule.fatal
+    monitor.inc('fault_injected_total', labels={'site': site})
+    raise InjectedFault(
+        site, "injected fault at %r (call %d of spec %r)%s"
+        % (site, rule.calls, spec or '<install_fault>',
+           '' if transient else ' [fatal]'),
+        transient=transient)
+
+
+def install_fault(site, mode='always', value=None, fatal=False):
+    """Programmatic fault registration (tests): overrides any
+    PADDLE_FAULT_SPEC clause for `site`."""
+    with _fault_lock:
+        _prog_rules[site] = _FaultRule(site, mode, value, fatal)
+
+
+def clear_faults():
+    """Drop programmatic registrations and the parsed-env cache."""
+    global _env_rules
+    with _fault_lock:
+        _prog_rules.clear()
+        _env_rules = (None, {})
+
+
+class fault_spec(object):
+    """Context manager scoping a PADDLE_FAULT_SPEC string to a block::
+
+        with resilience.fault_spec('ckpt_write:always'):
+            ...
+    """
+
+    def __init__(self, spec):
+        self._spec = spec
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = os.environ.get('PADDLE_FAULT_SPEC')
+        os.environ['PADDLE_FAULT_SPEC'] = self._spec
+        return self
+
+    def __exit__(self, *exc):
+        if self._prev is None:
+            os.environ.pop('PADDLE_FAULT_SPEC', None)
+        else:
+            os.environ['PADDLE_FAULT_SPEC'] = self._prev
+        clear_faults()
+        return False
+
+
+# ---------------------------------------------------------------------------
+# transient-error taxonomy + retry policy
+
+
+# substrings marking an error worth retrying: the XLA/gRPC status codes a
+# transient infrastructure failure surfaces as (TF's retry taxonomy), plus
+# socket-level connect noise from the relay/coordinator paths
+_TRANSIENT_MARKERS = (
+    'RESOURCE_EXHAUSTED', 'UNAVAILABLE', 'DEADLINE_EXCEEDED', 'ABORTED',
+    'CANCELLED', 'connection reset', 'connection refused', 'broken pipe',
+    'socket closed', 'failed to connect', 'transient',
+)
+
+
+def is_transient(exc):
+    """Is `exc` worth retrying? InjectedFault carries its own flag;
+    connection-level OSErrors and status-code-bearing messages match the
+    marker list; everything else (shape errors, user bugs) is permanent."""
+    if isinstance(exc, InjectedFault):
+        return exc.transient
+    if isinstance(exc, (ConnectionError, TimeoutError)):
+        return True
+    msg = str(exc).lower()
+    return any(m.lower() in msg for m in _TRANSIENT_MARKERS)
+
+
+def _env_float(name, default):
+    try:
+        return float(os.environ.get(name, '') or default)
+    except ValueError:
+        return default
+
+
+class RetryPolicy(object):
+    """Exponential backoff with full jitter and a wall-clock deadline.
+
+    max_attempts counts TOTAL tries (first + retries). Delay before retry
+    k (1-based) is ``min(max_delay, base * multiplier**(k-1))`` scaled by
+    a uniform jitter in [1-jitter, 1+jitter]; the deadline bounds the sum
+    of sleeps so a retry loop can never outlive its caller's patience.
+    Defaults come from PADDLE_RETRY_* env vars at construction time."""
+
+    def __init__(self, max_attempts=None, base_delay_s=None, max_delay_s=None,
+                 multiplier=2.0, jitter=0.25, deadline_s=None):
+        self.max_attempts = int(max_attempts if max_attempts is not None
+                                else _env_float('PADDLE_RETRY_MAX_ATTEMPTS', 4))
+        self.base_delay_s = (base_delay_s if base_delay_s is not None
+                             else _env_float('PADDLE_RETRY_BASE_S', 0.05))
+        self.max_delay_s = (max_delay_s if max_delay_s is not None
+                            else _env_float('PADDLE_RETRY_MAX_S', 2.0))
+        self.multiplier = float(multiplier)
+        self.jitter = float(jitter)
+        self.deadline_s = (deadline_s if deadline_s is not None
+                           else _env_float('PADDLE_RETRY_DEADLINE_S', 30.0))
+        # shared jittered stream; seeded RNG keeps schedules reproducible
+        # under PADDLE_FAULT_SEED without touching global random state
+        seed = os.environ.get('PADDLE_FAULT_SEED')
+        self._rng = random.Random(int(seed)) if seed else random.Random()
+
+    def delay(self, attempt):
+        """Backoff before retry `attempt` (1-based), jittered."""
+        d = min(self.max_delay_s,
+                self.base_delay_s * (self.multiplier ** (attempt - 1)))
+        if self.jitter:
+            d *= 1.0 + self.jitter * (2.0 * self._rng.random() - 1.0)
+        return max(0.0, d)
+
+    def call(self, fn, site='generic', retryable=None, state=None):
+        """Run fn(); on a transient error, back off and re-invoke until
+        success, a permanent error, attempt exhaustion, or the deadline.
+        See retry_after for `state` (donated-buffer guard)."""
+        try:
+            return fn()
+        except Exception as e:          # noqa: BLE001 — classified below
+            return self.resume(e, fn, site=site, retryable=retryable,
+                               state=state)
+
+    def resume(self, exc, fn, site='generic', retryable=None, state=None):
+        """The except-block half of call(): given an already-raised `exc`,
+        retry fn() under this policy. Re-raises `exc` unchanged when it is
+        not retryable — the zero-overhead pattern for hot paths that only
+        pay for retry logic once something actually failed."""
+        check = retryable if retryable is not None else is_transient
+        if not check(exc):
+            raise exc
+
+        def _donated_giveup(cause):
+            monitor.inc('retry_giveup_total', labels={'site': site})
+            return RuntimeError(
+                "cannot retry %r after %s: the failed attempt consumed "
+                "donated input buffers (set PADDLE_DONATE=0 to trade peak "
+                "memory for retryability of mid-run faults)"
+                % (site, type(cause).__name__))
+
+        if state is not None and not _buffers_alive(state):
+            raise _donated_giveup(exc) from exc
+        t0 = time.monotonic()
+        last = exc
+        for attempt in range(1, self.max_attempts):
+            d = self.delay(attempt)
+            if time.monotonic() + d - t0 > self.deadline_s:
+                break
+            monitor.inc('retry_attempt_total', labels={'site': site})
+            with monitor.span('retry_backoff:%s' % site):
+                time.sleep(d)
+            try:
+                return fn()
+            except Exception as e:      # noqa: BLE001 — classified below
+                last = e
+                if not check(e):
+                    raise
+                if state is not None and not _buffers_alive(state):
+                    # name the real blocker, not the last transient error
+                    raise _donated_giveup(e) from e
+        monitor.inc('retry_giveup_total', labels={'site': site})
+        raise last
+
+
+def _buffers_alive(state):
+    """False if any value in `state` is a donated (deleted) jax buffer —
+    re-invoking a compiled fn with consumed inputs would only mask the
+    original error with jax's opaque deleted-buffer message."""
+    for v in state.values():
+        d = getattr(v, 'is_deleted', None)
+        if callable(d):
+            try:
+                if d():
+                    return False
+            except Exception:
+                return False
+    return True
+
+
+def retry_call(fn, site='generic', policy=None, retryable=None, state=None):
+    """Run fn() under `policy` (default: env-configured RetryPolicy)."""
+    return (policy or RetryPolicy()).call(fn, site=site, retryable=retryable,
+                                          state=state)
+
+
+def retry_after(exc, fn, site='generic', policy=None, retryable=None,
+                state=None):
+    """Except-block entry point: re-raise `exc` if permanent, else retry
+    fn() with backoff. Keeps the success path of hot callers completely
+    free of retry machinery."""
+    return (policy or RetryPolicy()).resume(exc, fn, site=site,
+                                            retryable=retryable, state=state)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint hardening primitives (used by checkpoint.py / io.py)
+
+
+MANIFEST_NAME = 'paddle_manifest.json'
+
+
+def array_crc32(arr):
+    """Stable content digest of one tensor: crc32 over dtype/shape header +
+    raw bytes (C order). Cheap enough to run at every checkpoint write."""
+    arr = np.ascontiguousarray(arr)
+    head = ('%s|%s|' % (arr.dtype.str, arr.shape)).encode()
+    return zlib.crc32(arr.tobytes(), zlib.crc32(head)) & 0xFFFFFFFF
+
+
+def build_manifest(state, step=None, extra=None):
+    """Manifest dict for a state pytree: per-tensor shape/dtype/crc32.
+    Values that are not fully host-readable (multi-host sharded arrays)
+    record crc32=None — present-and-well-formed is still checked.
+
+    Cost note: crc computation pulls every tensor host-side AGAIN (orbax
+    already did one D2H to serialize) and crc32s all bytes (~1 GB/s).
+    Fine for small/medium state; for multi-GB state where the doubled
+    host traffic matters, ``PADDLE_CKPT_CRC=0`` keeps the structural
+    manifest (names/shapes/dtypes verified at restore) without crcs."""
+    want_crc = os.environ.get('PADDLE_CKPT_CRC', '1') != '0'
+    tensors = {}
+    for name, v in state.items():
+        ent = {'crc32': None, 'shape': None, 'dtype': None}
+        try:
+            if getattr(v, 'is_fully_addressable', True):
+                if want_crc:
+                    arr = np.asarray(v)
+                    ent = {'crc32': array_crc32(arr),
+                           'shape': list(arr.shape),
+                           'dtype': str(arr.dtype)}
+                else:
+                    # metadata without the D2H copy; python scalars
+                    # (no .shape/.dtype) go through tiny np.asarray
+                    if hasattr(v, 'shape') and hasattr(v, 'dtype'):
+                        ent = {'crc32': None, 'shape': list(v.shape),
+                               'dtype': str(v.dtype)}
+                    else:
+                        arr = np.asarray(v)
+                        ent = {'crc32': None, 'shape': list(arr.shape),
+                               'dtype': str(arr.dtype)}
+        except Exception:
+            pass                        # unreadable value: structural only
+        tensors[name] = ent
+    out = {'format': 'paddle_tpu_ckpt', 'version': 1, 'step': step,
+           'tensors': tensors}
+    if extra:
+        out.update(extra)
+    return out
+
+
+def verify_manifest(manifest, restored):
+    """Names whose restored bytes do not match the manifest (missing,
+    shape/dtype drift, or crc mismatch). Empty list == valid."""
+    bad = []
+    for name, ent in manifest.get('tensors', {}).items():
+        if name not in restored:
+            bad.append(name)
+            continue
+        if ent.get('shape') is None:
+            continue                    # recorded as unverifiable at save
+        arr = np.asarray(restored[name])
+        if (list(arr.shape) != ent.get('shape')
+                or str(arr.dtype) != ent.get('dtype')):
+            bad.append(name)
+        elif ent.get('crc32') is not None and \
+                array_crc32(arr) != ent['crc32']:
+            bad.append(name)
+    return bad
+
+
+def fsync_dir(path):
+    """fsync a DIRECTORY so a rename into it survives power loss; no-op on
+    filesystems/platforms without directory fds."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_write_bytes(path, data):
+    """tmp + fsync + rename publication of one file: readers observe the
+    old content or the new content, never a torn write. The ckpt_write
+    fault site fires BETWEEN write and publish — the worst crash point —
+    and the tmp file is always cleaned up."""
+    tmp = path + '.tmp.%d' % os.getpid()
+    try:
+        with open(tmp, 'wb') as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        maybe_fault('ckpt_write')
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    fsync_dir(os.path.dirname(os.path.abspath(path)))
+
+
+def pid_alive(pid):
+    """Best-effort liveness probe shared by the tmp-sweep paths (here and
+    checkpoint._clean_stale_tmp): EPERM counts as alive."""
+    if pid is None:
+        return False
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except OSError:
+        return True
+
+
+def sweep_stale_tmp_files(dirname):
+    """Remove '*.tmp.<pid>[.npy|.npz]' leftovers from crashed
+    atomic_file/atomic_write_bytes writers — without a sweep they
+    accumulate full-size partial files across every crash of a
+    long-lived job until the save directory hits ENOSPC. A file is
+    swept only when its writer pid is gone AND it is older than
+    PADDLE_CKPT_TMP_TTL_S (default 1 h): pid liveness is host-local, so
+    on shared storage another HOST's in-flight write looks pid-dead —
+    the age guard is what actually protects it (an atomic publish window
+    is seconds; leftovers age indefinitely)."""
+    try:
+        names = os.listdir(dirname)
+    except OSError:
+        return
+    ttl = _env_float('PADDLE_CKPT_TMP_TTL_S', 3600.0)
+    for n in names:
+        if '.tmp.' not in n:
+            continue
+        pid_part = n.split('.tmp.', 1)[1].split('.', 1)[0]
+        if not pid_part.isdigit() or pid_alive(int(pid_part)):
+            continue
+        path = os.path.join(dirname, n)
+        try:
+            if not os.path.isfile(path) or \
+                    time.time() - os.path.getmtime(path) < ttl:
+                continue
+            os.unlink(path)
+        except OSError:
+            pass
+
+
+class atomic_file(object):
+    """Context manager for tmp+fsync+rename file publication::
+
+        with resilience.atomic_file(path) as tmp:
+            np.savez(tmp, **arrays)
+
+    The body writes to `tmp`; on success the file is fsynced, the
+    ``ckpt_write`` fault site is checked, and the tmp is renamed over
+    `path` (readers never observe a torn file). On failure the tmp is
+    removed and nothing is published."""
+
+    def __init__(self, path):
+        self._path = path
+        self._tmp = path + '.tmp.%d' % os.getpid()
+
+    def __enter__(self):
+        return self._tmp
+
+    def _resolve_tmp(self):
+        # np.save/np.savez append .npy/.npz when missing — accept either
+        # the exact tmp name or the extended one
+        if not os.path.exists(self._tmp):
+            for ext in ('.npy', '.npz'):
+                if os.path.exists(self._tmp + ext):
+                    return self._tmp + ext
+        return self._tmp
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is not None:
+            # the body may have written the EXTENDED name before failing
+            # (np.savez mid-write ENOSPC) — remove whichever exists
+            try:
+                os.unlink(self._resolve_tmp())
+            except OSError:
+                pass
+            return False
+        tmp = self._resolve_tmp()
+        try:
+            with open(tmp, 'rb') as f:
+                os.fsync(f.fileno())
+            maybe_fault('ckpt_write')
+            os.replace(tmp, self._path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        fsync_dir(os.path.dirname(os.path.abspath(self._path)))
+        return False
+
+
+def write_manifest(dirname, manifest):
+    import json
+    atomic_write_bytes(os.path.join(dirname, MANIFEST_NAME),
+                       json.dumps(manifest, sort_keys=True).encode())
+
+
+def read_manifest(dirname):
+    """Manifest dict, or None when absent/unreadable (pre-hardening
+    checkpoints stay loadable; they just can't be crc-verified)."""
+    import json
+    path = os.path.join(dirname, MANIFEST_NAME)
+    try:
+        with open(path, 'rb') as f:
+            return json.loads(f.read().decode())
+    except (OSError, ValueError):
+        return None
+
+
+# ---------------------------------------------------------------------------
+# non-finite-step recovery
+
+
+def _finite(value):
+    arr = np.asarray(value)
+    return arr.dtype.kind != 'f' or bool(np.isfinite(arr).all())
+
+
+# Guarded steps flip the process-global PADDLE_DONATE env var for the
+# duration of the run (donation must be off so the rollback snapshot
+# survives). The lock serializes guarded steps so an interleaved pair
+# can never clobber the user's original value. Known limitation: an
+# UNGUARDED executor run on another thread during a guarded step reads
+# donation OFF for that window — conservative (correct numerics, 2x
+# peak state memory for that run).
+_donate_env_lock = threading.Lock()
+
+
+class TrainingGuard(object):
+    """Step wrapper that survives non-finite losses.
+
+    ::
+
+        guard = resilience.TrainingGuard(exe, main_prog, loss_name=loss.name,
+                                         scope=scope, max_bad_steps=3)
+        for batch in data:
+            fetches = guard.step(feed=batch, fetch_list=[loss])
+            if guard.last_step_skipped:
+                continue            # optimizer update was rolled back
+
+    Before each step the guard snapshots (by reference) every persistable
+    the program writes; if the fetched loss — or any float fetch, or, with
+    ``check_state=True``, any written state entry — comes back non-finite,
+    the scope is rolled back to the snapshot (bit-identical: the old device
+    buffers are simply re-bound), ``nonfinite_skip_total`` is incremented,
+    and an optional loss-scale scalar (``loss_scale_name``) is multiplied
+    by ``backoff_factor``. After ``max_bad_steps`` CONSECUTIVE bad steps it
+    raises NonFiniteError — at that point the data or the model is broken
+    and silently spinning would hide it. A finite step resets the streak
+    and, when ``growth_interval`` > 0, doubles the loss scale every that
+    many good steps (bounded by ``max_loss_scale``).
+
+    Guarded runs force buffer donation OFF (PADDLE_DONATE=0 for the
+    duration of the run) so the pre-step snapshot stays alive for
+    rollback; peak state memory is 2x during the step — the standard cost
+    of any rollback-capable trainer. The guard composes with
+    FLAGS_check_nan_inf: the executor's NaN raise is caught and treated
+    as a bad step (the scope rebind happens before that raise, so the
+    rollback still sees live buffers).
+    """
+
+    def __init__(self, executor, program, loss_name=None, scope=None,
+                 max_bad_steps=3, loss_scale_name=None, backoff_factor=0.5,
+                 growth_interval=0, growth_factor=2.0,
+                 max_loss_scale=2.0 ** 15, check_state=False):
+        if max_bad_steps < 1:
+            raise ValueError("max_bad_steps must be >= 1")
+        self._exe = executor
+        self._program = program
+        self._loss_name = loss_name
+        self._scope = scope
+        self.max_bad_steps = int(max_bad_steps)
+        self.loss_scale_name = loss_scale_name
+        self.backoff_factor = float(backoff_factor)
+        self.growth_interval = int(growth_interval)
+        self.growth_factor = float(growth_factor)
+        self.max_loss_scale = float(max_loss_scale)
+        self.check_state = bool(check_state)
+        self.bad_steps = 0              # consecutive
+        self.total_skipped = 0
+        self.last_step_skipped = False
+        self._good_streak = 0
+        self._written_cache = None      # (program version, names)
+
+    def _written_names(self):
+        cached = self._written_cache
+        if cached is not None and cached[0] == self._program._version:
+            return cached[1]
+        from .core import lowering
+        _, written = lowering.analyze_state(self._program, [])
+        names = sorted(written)
+        self._written_cache = (self._program._version, names)
+        return names
+
+    def _scale_adjust(self, scope, factor):
+        if not self.loss_scale_name or not scope.has(self.loss_scale_name):
+            return
+        cur = np.asarray(scope.get(self.loss_scale_name))
+        new = np.minimum(cur * factor, self.max_loss_scale).astype(cur.dtype)
+        scope.set(self.loss_scale_name, new)
+
+    def step(self, feed=None, fetch_list=None, **run_kw):
+        """One guarded executor run; returns the fetches of the requested
+        fetch_list (loss is fetched internally when not already listed).
+        On a skipped step the returned fetches are the BAD values (for
+        logging) and the scope holds the rolled-back state."""
+        from .executor import global_scope
+        scope = self._scope if self._scope is not None else global_scope()
+        fetch_list = list(fetch_list or [])
+        names = [v if isinstance(v, str) else v.name for v in fetch_list]
+        extra_loss = (self._loss_name is not None
+                      and self._loss_name not in names)
+        run_fetch = fetch_list + ([self._loss_name] if extra_loss else [])
+
+        snap = {}
+        for n in self._written_names():
+            if scope.has(n):
+                snap[n] = scope.get(n)
+        snap_lods = dict(getattr(scope, '_lods', {}))
+
+        bad = False
+        fetches = []
+        with _donate_env_lock:
+            prev_donate = os.environ.get('PADDLE_DONATE')
+            os.environ['PADDLE_DONATE'] = '0'
+            try:
+                fetches = self._exe.run(self._program, feed=feed,
+                                        fetch_list=run_fetch, scope=scope,
+                                        **run_kw)
+            except (RuntimeError, FloatingPointError) as e:
+                # FLAGS_check_nan_inf / jax debug_nans surface the bad
+                # step as a raise; anything else propagates untouched
+                if not isinstance(e, FloatingPointError) and \
+                        'NaN/Inf' not in str(e):
+                    raise
+                bad = True
+                # the raise swallowed the fetch values; keep the
+                # documented "bad values for logging" return shape with
+                # NaN stand-ins so `guard.step(...)[0]` survives the
+                # step it exists to survive. 1-element ARRAYS, not 0-d
+                # scalars: scalar-loss fetches are shaped arrays on the
+                # normal path, and `out[0][0]`-style logging must not
+                # die on exactly the step the guard exists to survive
+                fetches = [np.full((1,), np.nan, np.float32)
+                           for _ in run_fetch]
+            finally:
+                if prev_donate is None:
+                    os.environ.pop('PADDLE_DONATE', None)
+                else:
+                    os.environ['PADDLE_DONATE'] = prev_donate
+
+        if not bad:
+            check_vals = list(fetches)
+            bad = not all(_finite(v) for v in check_vals)
+            if not bad and self.check_state:
+                bad = not all(
+                    _finite(scope.get(n)) for n in self._written_names()
+                    if scope.has(n))
+
+        if bad:
+            scope.update(snap)
+            scope._lods = snap_lods
+            # drop state the bad step CREATED (not present pre-step): a
+            # half-written first step must not survive the rollback
+            for n in self._written_names():
+                if n not in snap and scope.has(n):
+                    scope.drop(n)
+            self._scale_adjust(scope, self.backoff_factor)
+            self.bad_steps += 1
+            self.total_skipped += 1
+            self._good_streak = 0
+            self.last_step_skipped = True
+            monitor.inc('nonfinite_skip_total')
+            if self.bad_steps >= self.max_bad_steps:
+                monitor.inc('nonfinite_escalate_total')
+                raise NonFiniteError(
+                    "TrainingGuard: %d consecutive non-finite steps "
+                    "(loss %r) — the optimizer update was skipped each "
+                    "time; inspect the data pipeline / lower the learning "
+                    "rate / check loss scaling"
+                    % (self.bad_steps,
+                       self._loss_name or '<unnamed>'))
+        else:
+            self.bad_steps = 0
+            self.last_step_skipped = False
+            self._good_streak += 1
+            if self.growth_interval and \
+                    self._good_streak % self.growth_interval == 0:
+                self._scale_adjust(scope, self.growth_factor)
+
+        return fetches[:len(fetch_list)] if extra_loss else fetches

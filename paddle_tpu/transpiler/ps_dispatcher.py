@@ -1,6 +1,7 @@
 """Parameter placement dispatchers (reference transpiler/ps_dispatcher.py:
 18,46,70 RoundRobin / HashName). On TPU these choose which mesh-shard index
 a parameter block maps to; kept primarily for API/test parity."""
+import zlib
 
 __all__ = ['PSDispatcher', 'RoundRobin', 'HashName']
 
@@ -33,7 +34,10 @@ class RoundRobin(PSDispatcher):
 class HashName(PSDispatcher):
     @staticmethod
     def _hash_block(block_str, total):
-        return hash(block_str) % total
+        # stable digest, NOT python hash(): str hashing is salted per
+        # process (PYTHONHASHSEED), so placement computed independently by
+        # trainers/pservers — or across a restart — must not depend on it
+        return zlib.crc32(str(block_str).encode('utf-8')) % total
 
     def dispatch(self, varlist):
         out = []

@@ -1,0 +1,577 @@
+"""Fault-tolerant runtime (docs/resilience.md): fault injection, retry to
+success, hardened checkpoints with fallback restore, non-finite-step
+recovery, and rank-naming multi-process failure detection."""
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import monitor, resilience
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    """Every test starts and ends with no fault spec and small backoffs
+    (retries must not stall the suite)."""
+    monkeypatch.delenv('PADDLE_FAULT_SPEC', raising=False)
+    monkeypatch.setenv('PADDLE_RETRY_BASE_S', '0.001')
+    monkeypatch.setenv('PADDLE_RETRY_MAX_S', '0.01')
+    resilience.clear_faults()
+    yield
+    resilience.clear_faults()
+
+
+def _counter(name):
+    return monitor.counters().get(name, 0)
+
+
+def _inc_model():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        w = fluid.layers.create_global_var(
+            [4], value=0.0, dtype='float32', persistable=True,
+            name='res_w')
+        fluid.layers.increment(w)
+    return main, startup
+
+
+def _train_model(seed=5):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = seed
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[8], dtype='float32')
+        y = fluid.layers.data(name='y', shape=[1], dtype='int64')
+        h = fluid.layers.fc(x, size=16, act='relu')
+        p = fluid.layers.fc(h, size=4, act='softmax')
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(p, y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    return main, startup, loss
+
+
+def _data():
+    rng = np.random.RandomState(0)
+    return (rng.randn(16, 8).astype('float32'),
+            rng.randint(0, 4, (16, 1)).astype('int64'))
+
+
+# ---------------------------------------------------------------------------
+# fault spec
+
+
+def test_fault_spec_grammar():
+    rules = resilience._parse_spec('compile:p=0.5;run:nth=3,kind=fatal; '
+                                   'ckpt_write:always;host_relay:n=2')
+    assert rules['compile'].mode == 'p' and rules['compile'].value == 0.5
+    assert rules['run'].mode == 'nth' and rules['run'].fatal
+    assert rules['ckpt_write'].mode == 'always'
+    assert rules['host_relay'].mode == 'n'
+    for bad in ('compile', 'compile:wat=1', 'run:nth=x', 'run:kind=fatal',
+                'run:nth=0'):
+        with pytest.raises(ValueError):
+            resilience._parse_spec(bad)
+
+
+def test_fault_triggers(monkeypatch):
+    monkeypatch.setenv('PADDLE_FAULT_SPEC', 'a:nth=2;b:n=2;c:every=3')
+    resilience.clear_faults()
+    hits = {}
+    for site in 'abc':
+        hits[site] = []
+        for i in range(6):
+            try:
+                resilience.maybe_fault(site)
+                hits[site].append(False)
+            except resilience.InjectedFault:
+                hits[site].append(True)
+    assert hits['a'] == [False, True, False, False, False, False]
+    assert hits['b'] == [True, True, False, False, False, False]
+    assert hits['c'] == [False, False, True, False, False, True]
+
+
+def test_fault_spec_env_change_mid_process(monkeypatch):
+    monkeypatch.setenv('PADDLE_FAULT_SPEC', 'x:always')
+    resilience.clear_faults()
+    with pytest.raises(resilience.InjectedFault):
+        resilience.maybe_fault('x')
+    monkeypatch.delenv('PADDLE_FAULT_SPEC')
+    resilience.maybe_fault('x')         # no spec -> no fault
+
+
+# ---------------------------------------------------------------------------
+# retry policy
+
+
+def test_retry_transient_then_success():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ConnectionResetError("connection reset by peer")
+        return 'ok'
+
+    before = _counter('retry_attempt_total{site=unit}')
+    policy = resilience.RetryPolicy(max_attempts=5, base_delay_s=0.001,
+                                    jitter=0.0)
+    assert policy.call(flaky, site='unit') == 'ok'
+    assert len(calls) == 3
+    assert _counter('retry_attempt_total{site=unit}') - before == 2
+
+
+def test_retry_permanent_error_not_retried():
+    calls = []
+
+    def broken():
+        calls.append(1)
+        raise ValueError("shape mismatch — a user bug, permanent")
+
+    with pytest.raises(ValueError):
+        resilience.retry_call(broken, site='unit2')
+    assert len(calls) == 1
+
+
+def test_retry_gives_up_and_counts():
+    before = _counter('retry_giveup_total{site=unit3}')
+
+    def always():
+        raise TimeoutError("still down")
+
+    policy = resilience.RetryPolicy(max_attempts=3, base_delay_s=0.001,
+                                    jitter=0.0)
+    with pytest.raises(TimeoutError):
+        policy.call(always, site='unit3')
+    assert _counter('retry_giveup_total{site=unit3}') - before == 1
+
+
+def test_retry_deadline_bounds_backoff():
+    policy = resilience.RetryPolicy(max_attempts=100, base_delay_s=0.2,
+                                    multiplier=1.0, jitter=0.0,
+                                    deadline_s=0.5)
+    t0 = time.monotonic()
+    with pytest.raises(TimeoutError):
+        policy.call(lambda: (_ for _ in ()).throw(TimeoutError("down")),
+                    site='unit4')
+    assert time.monotonic() - t0 < 2.0
+
+
+# ---------------------------------------------------------------------------
+# executor integration
+
+
+def test_injected_compile_fault_retried_to_success(monkeypatch):
+    main, startup = _inc_model()
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    before = _counter('retry_attempt_total{site=compile}')
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        monkeypatch.setenv('PADDLE_FAULT_SPEC', 'compile:n=1')
+        resilience.clear_faults()
+        exe.run(main, scope=scope)
+        monkeypatch.delenv('PADDLE_FAULT_SPEC')
+        resilience.clear_faults()
+        exe.run(main, scope=scope)
+        np.testing.assert_allclose(np.asarray(scope.get('res_w')),
+                                   np.full([4], 2.0, 'float32'))
+    assert _counter('retry_attempt_total{site=compile}') - before >= 1
+
+
+def test_injected_run_fault_retried_to_success(monkeypatch):
+    main, startup = _inc_model()
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        exe.run(main, scope=scope)          # compile (no faults yet)
+        before = _counter('retry_attempt_total{site=run}')
+        monkeypatch.setenv('PADDLE_FAULT_SPEC', 'run:nth=1')
+        resilience.clear_faults()
+        exe.run(main, scope=scope)          # faulted once, retried
+        monkeypatch.delenv('PADDLE_FAULT_SPEC')
+        resilience.clear_faults()
+        np.testing.assert_allclose(np.asarray(scope.get('res_w')),
+                                   np.full([4], 2.0, 'float32'))
+    assert _counter('retry_attempt_total{site=run}') - before == 1
+
+
+def test_fatal_fault_not_retried(monkeypatch):
+    main, startup = _inc_model()
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        exe.run(main, scope=scope)
+        before = _counter('retry_attempt_total{site=run}')
+        monkeypatch.setenv('PADDLE_FAULT_SPEC', 'run:always,kind=fatal')
+        resilience.clear_faults()
+        with pytest.raises(resilience.InjectedFault):
+            exe.run(main, scope=scope)
+    assert _counter('retry_attempt_total{site=run}') - before == 0
+
+
+# ---------------------------------------------------------------------------
+# hardened checkpoints
+
+
+def test_ckpt_write_fault_leaves_no_partial_and_falls_back(
+        tmp_path, monkeypatch):
+    """Acceptance: an injected checkpoint-write fault publishes nothing,
+    and load_latest_valid resumes from the prior checkpoint with
+    bit-identical state."""
+    X, Y = _data()
+    main, startup, loss = _train_model()
+    exe = fluid.Executor()
+    s1 = fluid.Scope()
+    ck = str(tmp_path / 'ck')
+    with fluid.scope_guard(s1):
+        exe.run(startup, scope=s1)
+        exe.run(main, feed={'x': X, 'y': Y}, fetch_list=[loss], scope=s1)
+        fluid.checkpoint.save_checkpoint(ck, main, scope=s1, step=1)
+        saved = {n: np.asarray(s1.get(n)).copy() for n in s1.names()}
+        exe.run(main, feed={'x': X, 'y': Y}, fetch_list=[loss], scope=s1)
+        monkeypatch.setenv('PADDLE_FAULT_SPEC', 'ckpt_write:always')
+        resilience.clear_faults()
+        with pytest.raises(resilience.InjectedFault):
+            fluid.checkpoint.save_checkpoint(ck, main, scope=s1, step=2)
+        monkeypatch.delenv('PADDLE_FAULT_SPEC')
+        resilience.clear_faults()
+    # no partial publication: only the intact step_1 remains, no tmp litter
+    assert sorted(os.listdir(ck)) == ['step_1']
+    s2 = fluid.Scope()
+    with fluid.scope_guard(s2):
+        path, names = fluid.checkpoint.load_latest_valid(ck, main, scope=s2)
+    assert path.endswith('step_1') and names
+    for n in names:
+        assert np.array_equal(np.asarray(s2.get(n)), saved[n]), n
+
+
+def test_corrupt_newest_falls_back_to_older(tmp_path):
+    X, Y = _data()
+    main, startup, loss = _train_model(seed=7)
+    exe = fluid.Executor()
+    s1 = fluid.Scope()
+    ck = str(tmp_path / 'ck')
+    with fluid.scope_guard(s1):
+        exe.run(startup, scope=s1)
+        exe.run(main, feed={'x': X, 'y': Y}, fetch_list=[loss], scope=s1)
+        fluid.checkpoint.save_checkpoint(ck, main, scope=s1, step=1)
+        step1 = {n: np.asarray(s1.get(n)).copy() for n in s1.names()}
+        exe.run(main, feed={'x': X, 'y': Y}, fetch_list=[loss], scope=s1)
+        fluid.checkpoint.save_checkpoint(ck, main, scope=s1, step=2)
+    # corrupt one array payload of step_2 (not the manifest)
+    flipped = False
+    for root, _, files in os.walk(os.path.join(ck, 'step_2')):
+        for f in files:
+            p = os.path.join(root, f)
+            if 'manifest' in f or os.path.getsize(p) <= 64:
+                continue
+            with open(p, 'r+b') as fh:
+                fh.seek(32)
+                fh.write(b'\xde\xad\xbe\xef')
+            flipped = True
+            break
+        if flipped:
+            break
+    assert flipped, "found no payload file to corrupt"
+    before = _counter('ckpt_fallback_total')
+    s2 = fluid.Scope()
+    with fluid.scope_guard(s2):
+        path, names = fluid.checkpoint.load_latest_valid(ck, main, scope=s2)
+    assert path.endswith('step_1')
+    assert _counter('ckpt_fallback_total') - before >= 1
+    for n in names:
+        assert np.array_equal(np.asarray(s2.get(n)), step1[n]), n
+
+
+def test_ckpt_rotation_keeps_last_n(tmp_path):
+    main, startup = _inc_model()
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    ck = str(tmp_path / 'ck')
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        for step in range(5):
+            exe.run(main, scope=scope)
+            fluid.checkpoint.save_checkpoint(ck, main, scope=scope,
+                                             step=step, keep_last_n=2)
+    assert sorted(os.listdir(ck)) == ['step_3', 'step_4']
+    assert [s for s, _ in fluid.checkpoint.list_checkpoints(ck)] == [3, 4]
+
+
+def test_load_checkpoint_verifies_crc(tmp_path):
+    main, startup = _inc_model()
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    ck = str(tmp_path / 'ck')
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        fluid.checkpoint.save_checkpoint(ck, main, scope=scope)
+    manifest = resilience.read_manifest(ck)
+    assert manifest and manifest['tensors']['res_w']['crc32'] is not None
+    # poison the manifest crc: the strict loader must refuse
+    manifest['tensors']['res_w']['crc32'] ^= 0xFFFF
+    resilience.write_manifest(ck, manifest)
+    s2 = fluid.Scope()
+    with fluid.scope_guard(s2):
+        with pytest.raises(RuntimeError, match='crc'):
+            fluid.checkpoint.load_checkpoint(ck, main, scope=s2)
+
+
+def test_save_vars_atomic_under_fault(tmp_path, monkeypatch):
+    """io.save_persistables (the checkpoint_notify write path) publishes
+    atomically: a mid-write fault leaves the previous file intact."""
+    main, startup = _inc_model()
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    d = str(tmp_path / 'params')
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        fluid.io.save_persistables(exe, d, main, filename='params')
+        first = np.load(os.path.join(d, 'params.npz'))['res_w'].copy()
+        exe.run(main, scope=scope)
+        monkeypatch.setenv('PADDLE_FAULT_SPEC', 'ckpt_write:always')
+        resilience.clear_faults()
+        with pytest.raises(resilience.InjectedFault):
+            fluid.io.save_persistables(exe, d, main, filename='params')
+        monkeypatch.delenv('PADDLE_FAULT_SPEC')
+        resilience.clear_faults()
+    assert sorted(os.listdir(d)) == ['params.npz']   # no tmp litter
+    np.testing.assert_array_equal(
+        np.load(os.path.join(d, 'params.npz'))['res_w'], first)
+
+
+# ---------------------------------------------------------------------------
+# TrainingGuard
+
+
+def test_nonfinite_step_skipped_and_training_converges():
+    """Acceptance: a forced-NaN step is skipped (bit-identical rollback)
+    and training converges afterward."""
+    X, Y = _data()
+    Xbad = X.copy()
+    Xbad[0, 0] = np.nan
+    main, startup, loss = _train_model()
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    before = _counter('nonfinite_skip_total')
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        guard = fluid.TrainingGuard(exe, main, loss_name=loss.name,
+                                    scope=scope, max_bad_steps=3)
+        guard.step(feed={'x': X, 'y': Y}, fetch_list=[loss])
+        w_pre = np.asarray(scope.get('fc_0.w_0')).copy()
+        guard.step(feed={'x': Xbad, 'y': Y}, fetch_list=[loss])
+        assert guard.last_step_skipped and guard.total_skipped == 1
+        assert np.array_equal(np.asarray(scope.get('fc_0.w_0')), w_pre)
+        losses = [float(np.asarray(guard.step(
+            feed={'x': X, 'y': Y}, fetch_list=[loss])[0]).reshape(()))
+            for _ in range(6)]
+    assert guard.bad_steps == 0
+    assert losses[-1] < losses[0]           # converges after the skip
+    assert all(np.isfinite(losses))
+    assert _counter('nonfinite_skip_total') - before == 1
+
+
+def test_nonfinite_escalates_after_max_bad_steps():
+    X, Y = _data()
+    X[:, :] = np.nan
+    main, startup, loss = _train_model()
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        guard = fluid.TrainingGuard(exe, main, loss_name=loss.name,
+                                    scope=scope, max_bad_steps=2)
+        guard.step(feed={'x': X, 'y': Y}, fetch_list=[loss])
+        assert guard.bad_steps == 1
+        with pytest.raises(resilience.NonFiniteError, match='consecutive'):
+            guard.step(feed={'x': X, 'y': Y}, fetch_list=[loss])
+
+
+def test_training_guard_loss_scale_backoff():
+    X, Y = _data()
+    Xbad = X.copy()
+    Xbad[0, 0] = np.inf
+    main, startup, loss = _train_model()
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        scope.set('loss_scaling', np.float32(1024.0))
+        guard = fluid.TrainingGuard(exe, main, loss_name=loss.name,
+                                    scope=scope, max_bad_steps=5,
+                                    loss_scale_name='loss_scaling',
+                                    backoff_factor=0.5, growth_interval=2)
+        guard.step(feed={'x': Xbad, 'y': Y}, fetch_list=[loss])
+        assert float(np.asarray(scope.get('loss_scaling'))) == 512.0
+        guard.step(feed={'x': X, 'y': Y}, fetch_list=[loss])
+        guard.step(feed={'x': X, 'y': Y}, fetch_list=[loss])
+        # two good steps with growth_interval=2 -> one doubling
+        assert float(np.asarray(scope.get('loss_scaling'))) == 1024.0
+
+
+def test_guard_composes_with_check_nan_inf():
+    """FLAGS_check_nan_inf raises inside the executor; the guard treats
+    that as a bad step and still rolls back."""
+    X, Y = _data()
+    Xbad = X.copy()
+    Xbad[0, 0] = np.nan
+    main, startup, loss = _train_model()
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    fluid.set_flags({'FLAGS_check_nan_inf': True})
+    try:
+        with fluid.scope_guard(scope):
+            exe.run(startup, scope=scope)
+            guard = fluid.TrainingGuard(exe, main, loss_name=loss.name,
+                                        scope=scope, max_bad_steps=3)
+            guard.step(feed={'x': X, 'y': Y}, fetch_list=[loss])
+            w_pre = np.asarray(scope.get('fc_0.w_0')).copy()
+            guard.step(feed={'x': Xbad, 'y': Y}, fetch_list=[loss])
+            assert guard.last_step_skipped
+            assert np.array_equal(np.asarray(scope.get('fc_0.w_0')), w_pre)
+    finally:
+        fluid.set_flags({'FLAGS_check_nan_inf': False})
+
+
+# ---------------------------------------------------------------------------
+# multi-process failure detection
+
+
+def test_killed_worker_yields_rank_naming_error_not_hang(tmp_path):
+    """Acceptance: a killed multihost worker yields a rank-naming error
+    within the deadline, not a hang."""
+    from paddle_tpu.distributed import launch_procs
+    from paddle_tpu.distributed.launch import wait_procs, WorkerFailedError
+
+    script = tmp_path / 'worker.py'
+    script.write_text("import time\ntime.sleep(600)\n")
+    procs = launch_procs(str(script), nproc_per_node=2)
+    try:
+        time.sleep(0.3)
+        procs[1].kill()
+        t0 = time.monotonic()
+        with pytest.raises(WorkerFailedError) as ei:
+            wait_procs(procs, deadline_s=60)
+        assert time.monotonic() - t0 < 30
+        assert ei.value.rank == 1
+        assert 'rank 1' in str(ei.value)
+        # survivors were killed, not left to hang
+        for p in procs:
+            p.wait(timeout=10)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+
+def test_launch_deadline_names_hung_ranks(tmp_path):
+    from paddle_tpu.distributed import launch_procs
+    from paddle_tpu.distributed.launch import wait_procs, WorkerFailedError
+
+    script = tmp_path / 'worker.py'
+    script.write_text("import time\ntime.sleep(600)\n")
+    procs = launch_procs(str(script), nproc_per_node=2)
+    try:
+        with pytest.raises(WorkerFailedError, match='deadline'):
+            wait_procs(procs, deadline_s=1.0)
+        assert all(p.wait(timeout=10) != 0 for p in procs)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+
+def test_rendezvous_deadline_actionable_error():
+    """A worker whose peers never connect raises a deadline error naming
+    rank/coordinator instead of hanging in jax.distributed.initialize."""
+    import subprocess
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.update({
+        'PYTHONPATH': repo + os.pathsep + env.get('PYTHONPATH', ''),
+        'JAX_PLATFORMS': 'cpu',
+        'PADDLE_TRAINERS_NUM': '2',
+        'PADDLE_TRAINER_ID': '1',
+        'PADDLE_COORDINATOR': '127.0.0.1:1',     # nothing listens here
+        'PADDLE_TRAINER_ENDPOINTS': '127.0.0.1:6170,127.0.0.1:6171',
+        'PADDLE_RENDEZVOUS_DEADLINE_S': '3',
+        'PADDLE_RETRY_BASE_S': '0.05',
+    })
+    code = ("from paddle_tpu.distributed import init_from_env\n"
+            "init_from_env()\n")
+    p = subprocess.run([sys.executable, '-c', code], env=env,
+                       capture_output=True, text=True, timeout=120)
+    assert p.returncode != 0
+    out = p.stdout + p.stderr
+    assert 'rendezvous' in out and 'rank 1' in out, out[-2000:]
+
+
+# ---------------------------------------------------------------------------
+# segmented-run freeze regression (ADVICE r5, executor.py satellite)
+
+
+def test_segmented_run_does_not_freeze_later_written_param(monkeypatch):
+    """A persistable read by an early segment but written by a LATER
+    segment must not have its caller-side numpy buffer frozen
+    writeable=False: the scope rebinds after the later segment, so the
+    rw-path freeze exemption applies program-wide."""
+    monkeypatch.setenv('PADDLE_SEGMENT_HOST_OPS', '1')
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        w = fluid.layers.create_global_var(
+            [4], value=0.0, dtype='float32', persistable=True,
+            name='seg_w')
+        z = fluid.layers.scale(w, scale=2.0)       # segment 1: reads w
+        fluid.layers.Print(z)                      # host op splits here
+        fluid.layers.increment(w)                  # segment 2: writes w
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    init = np.zeros([4], dtype='float32')
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        scope.set('seg_w', init)
+        exe.run(main, scope=scope)
+    assert init.flags.writeable, \
+        "init buffer of a later-written param was frozen by segment 1"
+    np.testing.assert_allclose(np.asarray(scope.get('seg_w')),
+                               np.full([4], 1.0, 'float32'))
+
+
+def test_crash_mid_swap_recovers_old_checkpoint(tmp_path):
+    """A hard crash between _save_hardened's two swap renames leaves the
+    complete old checkpoint under <path>.paddle-tmp.old.<pid> and no
+    <path>; the next load_latest_valid (or save) must RESTORE it, never
+    sweep it — 'old or new always survives'."""
+    main, startup = _inc_model()
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    ck = str(tmp_path / 'ck')
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        exe.run(main, scope=scope)
+        fluid.checkpoint.save_checkpoint(ck, main, scope=scope, step=1)
+        w1 = np.asarray(scope.get('res_w')).copy()
+    # simulate the crash window — use a spawned-and-reaped child's pid,
+    # which is guaranteed dead (a literal like 999999 can be a live pid
+    # on hosts with a raised kernel.pid_max)
+    import subprocess
+    child = subprocess.Popen([sys.executable, '-c', 'pass'])
+    child.wait()
+    os.rename(os.path.join(ck, 'step_1'),
+              os.path.join(ck, 'step_1.paddle-tmp.old.%d' % child.pid))
+    s2 = fluid.Scope()
+    with fluid.scope_guard(s2):
+        path, names = fluid.checkpoint.load_latest_valid(ck, main,
+                                                         scope=s2)
+    assert path.endswith('step_1')
+    assert np.array_equal(np.asarray(s2.get('res_w')), w1)
+    # a LIVE concurrent writer's tmp dir must survive the next save's sweep
+    live = os.path.join(ck, 'step_7.paddle-tmp.%d' % os.getpid())
+    os.makedirs(live)
+    with fluid.scope_guard(scope):
+        fluid.checkpoint.save_checkpoint(ck, main, scope=scope, step=2)
+    assert os.path.isdir(live)
