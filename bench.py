@@ -700,6 +700,17 @@ def _child(mode):
         run_overhead = {'error': '%s: %s' % (type(e).__name__,
                                              str(e)[:200])}
 
+    # serving-engine row: dynamic-batching request throughput vs
+    # sequential Predictor.run on a mixed-shape concurrent load, p50/p99
+    # latency, recompiles-after-warmup (contract: 0), shed behavior.
+    # best-of-rounds minima on both sides (tools/servebench.py)
+    try:
+        from tools.servebench import measure_serving
+        serving = measure_serving(rounds=3 if on_tpu else 5,
+                                  requests_per_client=20 if on_tpu else 40)
+    except Exception as e:
+        serving = {'error': '%s: %s' % (type(e).__name__, str(e)[:200])}
+
     if on_tpu:
         flagship_cfg = dict(vocab_size=32000, seq_len=512, d_model=512,
                             n_head=8, n_layer=6, d_ff=2048, dropout=0.1,
@@ -790,6 +801,7 @@ def _child(mode):
         'compile_s': flag['compile_s'],
         'sync_ms': sync_ms,
         'run_overhead': run_overhead,
+        'serving': serving,
         'final_loss': flag['final_loss'],
         'amp': bool(on_tpu),
         'flash_attention': True,

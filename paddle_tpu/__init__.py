@@ -81,5 +81,7 @@ from .parallel_executor import ParallelExecutor
 from .parallel_executor import ExecutionStrategy, BuildStrategy
 from . import inference
 from .inference import Predictor, PredictorConfig, create_predictor
+from . import serving
+from .serving import ServingConfig, ServingEngine
 
 __version__ = '0.1.0'

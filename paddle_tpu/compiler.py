@@ -84,13 +84,18 @@ class CompiledProgram(object):
         return self
 
     # duck-typed hook called by Executor.run
-    def _executor_run(self, executor, feed, fetch_list, scope, return_numpy):
+    def _executor_run(self, executor, feed, fetch_list, scope, return_numpy,
+                      donate=None):
         if not self._is_data_parallel:
             # recurses into Executor.run, which carries the observability
             # instrumentation — no metrics here or they'd double-count
             return executor.run(self._program, feed=feed,
                                 fetch_list=fetch_list, scope=scope,
-                                return_numpy=return_numpy)
+                                return_numpy=return_numpy, donate=donate)
+        # the SPMD runner manages donation itself (sharded jit with
+        # donate_argnums baked in); a per-call donate override does not
+        # apply on this path — same as the historical PADDLE_DONATE env,
+        # which it never consulted either
         from .parallel import spmd
         if self._spmd is None:
             self._spmd = spmd.DataParallelRunner(

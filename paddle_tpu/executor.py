@@ -193,20 +193,24 @@ def _callbacks_supported():
     return _cb_supported[0]
 
 
-def _donation_enabled(fused=False):
+def _donation_enabled(fused=False, override=None):
     """Default-ON buffer donation for the rw-state pytree: parameter updates
     alias their input buffers instead of holding old+new state simultaneously
-    (2x peak HBM). Escape hatches: PADDLE_DONATE=0 disables both run paths —
-    callers that keep reading a stale reference to a pre-run scope value need
-    it (the scope itself is always rebound to the new state right after the
-    call, so normal callers never observe a donated buffer);
-    PADDLE_FUSED_DONATE overrides for run_fused only (its historical opt-in
-    name). Guards: through the axon host-relay backend — detected as "no
-    host-callback support", the same probe the segmenting path uses —
-    donated buffers are round-tripped host-side on every call (~1.5 s/call
-    measured on resnet50's ~400 MB state), so donation defaults OFF there;
-    and optest collection records the pre-run rw state after the call, which
-    donation would have deleted.
+    (2x peak HBM). Escape hatches: a per-call ``donate=`` override on
+    Executor.run / run_fused (`override` here) wins over everything except
+    optest collection — TrainingGuard's rollback snapshot and the serving
+    pool's cached params both need donation off for ONE call without
+    touching any other thread's runs; PADDLE_DONATE=0 disables both run
+    paths process-wide — callers that keep reading a stale reference to a
+    pre-run scope value need it (the scope itself is always rebound to the
+    new state right after the call, so normal callers never observe a
+    donated buffer); PADDLE_FUSED_DONATE overrides for run_fused only (its
+    historical opt-in name). Guards: through the axon host-relay backend —
+    detected as "no host-callback support", the same probe the segmenting
+    path uses — donated buffers are round-tripped host-side on every call
+    (~1.5 s/call measured on resnet50's ~400 MB state), so donation
+    defaults OFF there; and optest collection records the pre-run rw state
+    after the call, which donation would have deleted.
 
     Every resolution is counted: donation_run_total when ON,
     donation_fallback_total{reason} when OFF — so "did donation silently
@@ -215,6 +219,13 @@ def _donation_enabled(fused=False):
     if os.environ.get('PADDLE_OPTEST_COLLECT_DIR'):
         monitor.inc('donation_fallback_total',
                     labels={'reason': 'optest_collect'})
+        return False
+    if override is not None:
+        if override:
+            monitor.inc('donation_run_total')
+            return True
+        monitor.inc('donation_fallback_total',
+                    labels={'reason': 'per_call_opt_out'})
         return False
     env = None
     if fused:
@@ -588,7 +599,12 @@ class Executor(object):
 
     def run(self, program=None, feed=None, fetch_list=None, feed_var_name='feed',
             fetch_var_name='fetch', scope=None, return_numpy=True,
-            use_program_cache=True):
+            use_program_cache=True, donate=None):
+        """donate: per-call override of the buffer-donation default for
+        THIS run only (None = resolve from env/backend as usual). False is
+        the rollback/serving contract — the pre-run state buffers stay
+        alive after the call — without flipping the process-global
+        PADDLE_DONATE env var under other threads' runs."""
         if program is None:
             program = default_main_program()
         # started py_readers supply their variables when not explicitly fed
@@ -602,7 +618,7 @@ class Executor(object):
         # CompiledProgram support is injected by compiler.py via duck-typing:
         if hasattr(program, '_executor_run'):
             return program._executor_run(self, feed, fetch_list, scope,
-                                         return_numpy)
+                                         return_numpy, donate=donate)
         # instrumented from here down: 'run' span + per-run wall-latency
         # histogram (the delegating paths above recurse into run() and
         # would double-count). The counter counts ATTEMPTS — a run that
@@ -610,10 +626,10 @@ class Executor(object):
         with monitor.timed_span('run', 'executor_run_seconds'):
             monitor.inc('executor_run_total')
             return self._run_impl(program, feed, fetch_list, scope,
-                                  return_numpy, use_program_cache)
+                                  return_numpy, use_program_cache, donate)
 
     def _run_impl(self, program, feed, fetch_list, scope, return_numpy,
-                  use_program_cache):
+                  use_program_cache, donate_override=None):
         if scope is None:
             scope = global_scope()
         feed, feed_lods = self._prepare_feed(program, feed or {})
@@ -652,9 +668,9 @@ class Executor(object):
                               or not _callbacks_supported()):
                 return self._run_segmented(
                     program, feed, fetch_names, scope, return_numpy,
-                    static_lods, static_feed)
+                    static_lods, static_feed, donate_override)
 
-        donate = _donation_enabled()
+        donate = _donation_enabled(override=donate_override)
         key = (program._fingerprint(),
                self._feed_signature(feed, static_lods, static_feed),
                tuple(fetch_names), donate)
@@ -855,13 +871,14 @@ class Executor(object):
         return plan
 
     def _run_segmented(self, program, feed, fetch_names, scope,
-                       return_numpy, static_lods, static_feed):
+                       return_numpy, static_lods, static_feed,
+                       donate_override=None):
         """Heterogeneous execution for backends without host callbacks: see
         _HOST_SEGMENT_OPS. Device segments are compiled and cached like
         normal runs; host ops run eagerly on the CPU backend with only the
         crossing vars transferred."""
         monitor.inc('executor_run_segmented_total')
-        donate = _donation_enabled()
+        donate = _donation_enabled(override=donate_override)
         key = ('hostseg', program._fingerprint(),
                self._feed_signature(feed, static_lods, static_feed),
                tuple(fetch_names), donate)
@@ -1023,7 +1040,7 @@ class Executor(object):
     # ------------------------------------------------------------------
     def run_fused(self, program=None, feed_list=None, fetch_list=None,
                   scope=None, return_numpy=True, steps=None,
-                  _prepared=None):
+                  donate=None, _prepared=None):
         """Run len(feed_list) consecutive steps in ONE compiled call.
 
         The step function is iterated on-device with lax.fori_loop over the
@@ -1050,7 +1067,8 @@ class Executor(object):
         (the input-pipeline staging an async py_reader would do). Returns
         the LAST step's fetches; all K state updates land in the scope.
         `steps` (run more scan iterations than staged batches, cycling
-        them) requires a uniform-LoD feed_list.
+        them) requires a uniform-LoD feed_list. `donate` overrides the
+        donation default for this call only, like Executor.run.
         """
         if not feed_list:
             return []
@@ -1058,10 +1076,10 @@ class Executor(object):
             monitor.inc('executor_run_fused_total')
             return self._run_fused_impl(program, feed_list, fetch_list,
                                         scope, return_numpy, steps,
-                                        _prepared)
+                                        donate, _prepared)
 
     def _run_fused_impl(self, program, feed_list, fetch_list, scope,
-                        return_numpy, steps, _prepared):
+                        return_numpy, steps, donate_override, _prepared):
         import jax
         from jax import lax
         if program is None:
@@ -1121,7 +1139,7 @@ class Executor(object):
                             out = self._run_fused_impl(
                                 program, feed_list[lo:lo + size],
                                 fetch_list, scope, return_numpy, None,
-                                prepared[lo:lo + size])
+                                donate_override, prepared[lo:lo + size])
                             lo += size
                         seg_lo = i
                 return out
@@ -1147,7 +1165,7 @@ class Executor(object):
         static_lods.update(lods0)
 
         n_steps = int(steps) if steps else k_steps
-        donate = _donation_enabled(fused=True)
+        donate = _donation_enabled(fused=True, override=donate_override)
         cache_key = ('fused', k_steps, n_steps, program._fingerprint(),
                      self._feed_signature(feed0, static_lods, ()),
                      tuple(fetch_names), donate)

@@ -48,10 +48,12 @@ class Predictor(object):
     def get_output_names(self):
         return [v.name for v in self.fetch_vars]
 
-    def run(self, feed, return_numpy=True):
+    def run(self, feed, return_numpy=True, donate=None):
         """feed: dict name->array, or list of arrays in feed_names order.
         Returns list of numpy arrays in fetch order
-        (AnalysisPredictor::Run analog).
+        (AnalysisPredictor::Run analog). Feed names are validated against
+        get_input_names() up front: a missing or extra key raises KeyError
+        naming the offenders instead of failing deep inside dispatch.
 
         Params stay device-resident across calls (the executor caches the
         device copy into the predictor's private scope on first use), so
@@ -68,9 +70,14 @@ class Predictor(object):
                     "expected %d inputs %s, got %d"
                     % (len(self.feed_names), self.feed_names, len(arrays)))
             feed = dict(zip(self.feed_names, arrays))
-        missing = [n for n in self.feed_names if n not in feed]
-        if missing:
-            raise ValueError("missing feeds: %s" % missing)
+        missing = sorted(n for n in self.feed_names if n not in feed)
+        extra = sorted(k for k in feed if k not in self.feed_names)
+        if missing or extra:
+            raise KeyError(
+                "Predictor.run feed does not match get_input_names() %s:%s%s"
+                % (self.feed_names,
+                   ' missing %s' % missing if missing else '',
+                   ' unexpected %s' % extra if extra else ''))
         # rides the executor's own run/compile instrumentation; the
         # predictor-level counter + span separate serving traffic from
         # training runs in the same process
@@ -79,7 +86,8 @@ class Predictor(object):
             with scope_guard(self.scope):
                 outs = self.executor.run(self.program, feed=feed,
                                          fetch_list=self.fetch_vars,
-                                         return_numpy=return_numpy)
+                                         return_numpy=return_numpy,
+                                         donate=donate)
         if not return_numpy:
             return list(outs)
         return [np.asarray(o) for o in outs]

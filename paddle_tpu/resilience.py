@@ -624,16 +624,6 @@ def _finite(value):
     return arr.dtype.kind != 'f' or bool(np.isfinite(arr).all())
 
 
-# Guarded steps flip the process-global PADDLE_DONATE env var for the
-# duration of the run (donation must be off so the rollback snapshot
-# survives). The lock serializes guarded steps so an interleaved pair
-# can never clobber the user's original value. Known limitation: an
-# UNGUARDED executor run on another thread during a guarded step reads
-# donation OFF for that window — conservative (correct numerics, 2x
-# peak state memory for that run).
-_donate_env_lock = threading.Lock()
-
-
 class TrainingGuard(object):
     """Step wrapper that survives non-finite losses.
 
@@ -658,10 +648,12 @@ class TrainingGuard(object):
     and, when ``growth_interval`` > 0, doubles the loss scale every that
     many good steps (bounded by ``max_loss_scale``).
 
-    Guarded runs force buffer donation OFF (PADDLE_DONATE=0 for the
-    duration of the run) so the pre-step snapshot stays alive for
-    rollback; peak state memory is 2x during the step — the standard cost
-    of any rollback-capable trainer. The guard composes with
+    Guarded runs force buffer donation OFF for that one call (the
+    executor's per-call ``donate=False`` override — no process-global env
+    flipping, so concurrent unguarded runs on other threads keep their own
+    donation behavior) so the pre-step snapshot stays alive for rollback;
+    peak state memory is 2x during the step — the standard cost of any
+    rollback-capable trainer. The guard composes with
     FLAGS_check_nan_inf: the executor's NaN raise is caught and treated
     as a bad step (the scope rebind happens before that raise, so the
     rollback still sees live buffers).
@@ -728,34 +720,30 @@ class TrainingGuard(object):
 
         bad = False
         fetches = []
-        with _donate_env_lock:
-            prev_donate = os.environ.get('PADDLE_DONATE')
-            os.environ['PADDLE_DONATE'] = '0'
-            try:
-                fetches = self._exe.run(self._program, feed=feed,
-                                        fetch_list=run_fetch, scope=scope,
-                                        **run_kw)
-            except (RuntimeError, FloatingPointError) as e:
-                # FLAGS_check_nan_inf / jax debug_nans surface the bad
-                # step as a raise; anything else propagates untouched
-                if not isinstance(e, FloatingPointError) and \
-                        'NaN/Inf' not in str(e):
-                    raise
-                bad = True
-                # the raise swallowed the fetch values; keep the
-                # documented "bad values for logging" return shape with
-                # NaN stand-ins so `guard.step(...)[0]` survives the
-                # step it exists to survive. 1-element ARRAYS, not 0-d
-                # scalars: scalar-loss fetches are shaped arrays on the
-                # normal path, and `out[0][0]`-style logging must not
-                # die on exactly the step the guard exists to survive
-                fetches = [np.full((1,), np.nan, np.float32)
-                           for _ in run_fetch]
-            finally:
-                if prev_donate is None:
-                    os.environ.pop('PADDLE_DONATE', None)
-                else:
-                    os.environ['PADDLE_DONATE'] = prev_donate
+        # donation off for THIS call only (the rollback snapshot must
+        # outlive the run) via the executor's per-call override — runs on
+        # other threads, guarded or not, are untouched
+        run_kw.setdefault('donate', False)
+        try:
+            fetches = self._exe.run(self._program, feed=feed,
+                                    fetch_list=run_fetch, scope=scope,
+                                    **run_kw)
+        except (RuntimeError, FloatingPointError) as e:
+            # FLAGS_check_nan_inf / jax debug_nans surface the bad
+            # step as a raise; anything else propagates untouched
+            if not isinstance(e, FloatingPointError) and \
+                    'NaN/Inf' not in str(e):
+                raise
+            bad = True
+            # the raise swallowed the fetch values; keep the
+            # documented "bad values for logging" return shape with
+            # NaN stand-ins so `guard.step(...)[0]` survives the
+            # step it exists to survive. 1-element ARRAYS, not 0-d
+            # scalars: scalar-loss fetches are shaped arrays on the
+            # normal path, and `out[0][0]`-style logging must not
+            # die on exactly the step the guard exists to survive
+            fetches = [np.full((1,), np.nan, np.float32)
+                       for _ in run_fetch]
 
         if not bad:
             check_vals = list(fetches)

@@ -1,0 +1,21 @@
+"""In-process serving engine: dynamic batching, shape-bucketed compile
+warmup, and a load-shedding predictor pool over `inference.Predictor`.
+
+The throughput-oriented request path the single-request Predictor lacks:
+concurrent callers submit, compatible requests coalesce into padded
+bucket-shaped batches, a worker pool executes them through the shared
+compile cache (pre-warmed by `ServingEngine.warmup`), and a bounded queue
+sheds overload with structured errors instead of unbounded latency. See
+docs/serving.md for architecture and tuning.
+"""
+from .bucketing import BucketLadder
+from .batcher import (ServingError, LoadShedError, DeadlineExceededError,
+                      EngineStoppedError, Request, RequestQueue)
+from .engine import ServingConfig, ServingEngine, create_engine
+
+__all__ = [
+    'BucketLadder', 'Request', 'RequestQueue',
+    'ServingError', 'LoadShedError', 'DeadlineExceededError',
+    'EngineStoppedError',
+    'ServingConfig', 'ServingEngine', 'create_engine',
+]

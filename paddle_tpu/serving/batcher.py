@@ -1,0 +1,197 @@
+"""Request queue + dynamic batcher for the serving engine.
+
+A `Request` is one caller's feed (leading dim = its row count) wrapped in
+a future the caller blocks on. The `RequestQueue` is BOUNDED: a full queue
+sheds new load immediately with a structured `LoadShedError` (reason,
+depth, cap) instead of growing latency without bound — the reject is the
+backpressure signal a closed-loop client needs to slow down.
+
+Batch formation (`take_batch`) is the classic two-knob policy: starting
+from the oldest compatible request, coalesce same-bucket requests until
+the next one would overflow ``max_rows`` or ``max_wait_s`` has elapsed
+since formation began, whichever first. Compatibility is the
+`BucketLadder.request_shape` key — identical feed names/dtypes/padded
+shapes — so a formed batch concatenates along axis 0 without any shape
+negotiation. Incompatible requests stay queued IN ORDER for the next
+worker; expired ones are completed with `DeadlineExceededError` at
+collection time so a dead request never occupies accelerator time.
+"""
+import threading
+import time
+
+__all__ = ['ServingError', 'LoadShedError', 'DeadlineExceededError',
+           'EngineStoppedError', 'Request', 'RequestQueue']
+
+
+class ServingError(RuntimeError):
+    """Base class of serving-engine request failures."""
+
+
+class LoadShedError(ServingError):
+    """The bounded queue rejected this request. Fields carry the
+    structured reason a client/load-balancer routes on."""
+
+    def __init__(self, reason, queue_depth, queue_cap):
+        ServingError.__init__(
+            self, "request shed (%s): queue depth %d at cap %d — retry "
+            "against another replica or back off" %
+            (reason, queue_depth, queue_cap))
+        self.reason = reason
+        self.queue_depth = queue_depth
+        self.queue_cap = queue_cap
+
+
+class DeadlineExceededError(ServingError):
+    """The request's deadline passed before (or while) it was served."""
+
+
+class EngineStoppedError(ServingError):
+    """The engine was stopped while the request was queued."""
+
+
+class Request(object):
+    """One in-flight request: feed + bucket metadata + a one-shot
+    future. Workers call done()/fail(); the submitting thread blocks in
+    result()."""
+
+    __slots__ = ('feed', 'n_rows', 'seq_len', 'key', 'deadline',
+                 'enqueue_t', '_event', '_result', '_error')
+
+    def __init__(self, feed, n_rows, seq_len, key, deadline):
+        self.feed = feed
+        self.n_rows = n_rows
+        self.seq_len = seq_len
+        self.key = key
+        self.deadline = deadline
+        self.enqueue_t = time.monotonic()
+        self._event = threading.Event()
+        self._result = None
+        self._error = None
+
+    def expired(self, now=None):
+        return self.deadline is not None and \
+            (now if now is not None else time.monotonic()) > self.deadline
+
+    def done(self, result):
+        self._result = result
+        self._event.set()
+
+    def fail(self, error):
+        self._error = error
+        self._event.set()
+
+    def result(self, timeout=None):
+        """Block until served; raises the per-request error on failure.
+        The default timeout is the request's own deadline plus a grace
+        second (a caller must never hang past its deadline)."""
+        if timeout is None and self.deadline is not None:
+            timeout = max(0.0, self.deadline - time.monotonic()) + 1.0
+        if not self._event.wait(timeout):
+            raise DeadlineExceededError(
+                "request not served within %.3fs" % (timeout or 0.0))
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+class RequestQueue(object):
+    """Bounded FIFO of Requests with condition-variable handoff to the
+    worker pool."""
+
+    def __init__(self, cap):
+        self._cap = max(1, int(cap))
+        self._q = []
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._closed = False
+
+    @property
+    def cap(self):
+        return self._cap
+
+    def depth(self):
+        with self._lock:
+            return len(self._q)
+
+    def put(self, req):
+        """Enqueue or shed. Raises LoadShedError when full (the caller
+        surfaces it synchronously — shedding must cost nothing but the
+        check) and EngineStoppedError after close()."""
+        with self._lock:
+            if self._closed:
+                raise EngineStoppedError("serving engine is stopped")
+            if len(self._q) >= self._cap:
+                raise LoadShedError('queue_full', len(self._q), self._cap)
+            self._q.append(req)
+            self._cond.notify()
+
+    def close(self):
+        """Stop accepting requests and fail everything still queued —
+        a stopped engine must not leave callers blocked forever."""
+        with self._lock:
+            self._closed = True
+            drained, self._q = self._q, []
+            self._cond.notify_all()
+        for r in drained:
+            r.fail(EngineStoppedError("serving engine stopped while the "
+                                      "request was queued"))
+        return len(drained)
+
+    @property
+    def closed(self):
+        return self._closed
+
+    def take_batch(self, max_rows, max_wait_s, poll_s=0.1):
+        """Form one batch: [compatible requests], or (None, expired) when
+        the queue stayed empty for poll_s (callers loop; lets workers
+        observe shutdown). Returns (batch, expired) — `expired` requests
+        were dropped at collection and must be failed by the caller
+        OUTSIDE the queue lock."""
+        expired = []
+        with self._lock:
+            if not self._q and not self._closed:
+                self._cond.wait(poll_s)
+            first = self._pop_live(None, expired)
+            if first is None:
+                return None, expired
+            batch = [first]
+            rows = first.n_rows
+            t_close = time.monotonic() + max_wait_s
+            while rows < max_rows:
+                got = self._pop_live(first, expired,
+                                     max_rows=max_rows - rows)
+                if got is not None:
+                    batch.append(got)
+                    rows += got.n_rows
+                    continue
+                remaining = t_close - time.monotonic()
+                if remaining <= 0 or self._closed:
+                    break
+                self._cond.wait(remaining)
+        return batch, expired
+
+    def _pop_live(self, proto, expired, max_rows=None):
+        """Pop the oldest live request compatible with `proto` (None =
+        any); collects expired requests into `expired` as it scans.
+        Callers hold the lock."""
+        now = time.monotonic()
+        for i, r in enumerate(self._q):
+            if r.expired(now):
+                continue
+            if proto is not None and (
+                    r.key != proto.key or
+                    (max_rows is not None and r.n_rows > max_rows)):
+                continue
+            # sweep expired entries sitting ahead of the pick so they
+            # fail fast instead of rotting until a compatible scan
+            keep = []
+            for j, s in enumerate(self._q):
+                if j == i:
+                    continue
+                (expired if s.expired(now) else keep).append(s)
+            self._q = keep
+            return r
+        kept = [r for r in self._q if not r.expired(now)]
+        expired.extend(r for r in self._q if r.expired(now))
+        self._q = kept
+        return None

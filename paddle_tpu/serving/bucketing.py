@@ -1,0 +1,161 @@
+"""Shape-bucket ladder for the serving engine: bounded compile count.
+
+XLA compiles per feed signature, so an engine that dispatched each
+dynamically-formed batch at its natural size would recompile on every new
+(batch rows, sequence length) pair — unbounded compiles under organic
+traffic, each a multi-second latency spike. The remedy is the same
+canonical-padding recipe `reader/bucketing.py` applies to ragged training
+batches, lifted to the request path: every batch is padded UP to a fixed
+ladder of (batch-rows bucket, sequence bucket) cells, so the steady state
+executes at most ``len(batch_buckets) * len(seq_buckets)`` distinct
+signatures — all of which ``ServingEngine.warmup()`` compiles ahead of
+traffic, making the steady state hit the PR 1 fingerprint compile cache
+with zero recompiles.
+
+Row padding replicates the LAST real row (real data keeps every model
+numerically well-behaved — an all-zeros row can hit log(0)/division paths)
+and the padded rows' outputs are discarded at un-batching time. Sequence
+padding appends ``pad_value`` columns (token-id padding); outputs whose
+sequence axis still carries the padded length are sliced back to each
+request's real length on the way out.
+"""
+import numpy as np
+
+from ..reader.bucketing import bucketize
+
+__all__ = ['BucketLadder']
+
+
+class BucketLadder(object):
+    """The serving engine's shape policy.
+
+    batch_buckets: ascending ladder of total-batch row counts; a formed
+      batch of N rows pads to the smallest bucket >= N, and the batcher
+      never coalesces past the largest bucket.
+    seq_buckets: optional ladder for a variable sequence axis. A request's
+      sequence length is the ``seq_axis`` extent of its feed arrays (every
+      feed array whose rank exceeds ``seq_axis`` and whose ``seq_axis``
+      extent equals the request's longest such extent is padded; arrays
+      with other extents — fixed-size side inputs — pass through and
+      become part of the bucket key instead).
+    """
+
+    def __init__(self, batch_buckets, seq_buckets=None, seq_axis=1,
+                 pad_value=0):
+        if not batch_buckets:
+            raise ValueError("batch_buckets must be a non-empty ladder")
+        self.batch_buckets = sorted(set(int(b) for b in batch_buckets))
+        if any(b < 1 for b in self.batch_buckets):
+            raise ValueError("batch_buckets must be >= 1: %r"
+                             % (batch_buckets,))
+        self.seq_buckets = (sorted(set(int(s) for s in seq_buckets))
+                            if seq_buckets else None)
+        if int(seq_axis) < 1:
+            raise ValueError("seq_axis must be >= 1 (axis 0 is the batch "
+                             "row dimension)")
+        self.seq_axis = int(seq_axis)
+        self.pad_value = pad_value
+
+    @property
+    def max_rows(self):
+        return self.batch_buckets[-1]
+
+    def batch_bucket(self, n_rows):
+        return bucketize(n_rows, self.batch_buckets)
+
+    def seq_bucket(self, length):
+        if self.seq_buckets is None:
+            return None
+        return bucketize(length, self.seq_buckets)
+
+    # ------------------------------------------------------------------
+    def request_shape(self, feed):
+        """Classify one request's feed: returns (n_rows, seq_len, key).
+
+        n_rows: leading-dim row count shared by every feed array.
+        seq_len: the request's real sequence extent (None without
+          seq_buckets or when no array has a ``seq_axis`` dimension).
+        key: the BUCKET-GROUP key — requests coalesce into one batch iff
+          their keys are equal, i.e. identical feed names, dtypes,
+          per-row shapes AFTER sequence padding, and seq bucket. The key
+          is also the compile-signature identity warmup() enumerates.
+        Raises ValueError (with a structured message) for feeds the
+        ladder cannot serve — over-long sequences, over-wide requests,
+        mismatched leading dims.
+        """
+        if not feed:
+            raise ValueError("serving request: empty feed")
+        arrays = {n: np.asarray(v) for n, v in feed.items()}
+        rows = {a.shape[0] if a.ndim else None for a in arrays.values()}
+        if None in rows or len(rows) != 1:
+            raise ValueError(
+                "serving request: every feed array needs the same leading "
+                "batch dim; got %s"
+                % {n: tuple(a.shape) for n, a in arrays.items()})
+        n_rows = rows.pop()
+        if n_rows < 1:
+            raise ValueError("serving request: zero-row feed")
+        if n_rows > self.max_rows:
+            raise ValueError(
+                "serving request: %d rows exceed the largest batch bucket "
+                "%d — split the request or widen the ladder"
+                % (n_rows, self.max_rows))
+
+        seq_len = None
+        if self.seq_buckets is not None:
+            lens = [a.shape[self.seq_axis] for a in arrays.values()
+                    if a.ndim > self.seq_axis]
+            if lens:
+                seq_len = max(lens)
+                if seq_len > self.seq_buckets[-1]:
+                    raise ValueError(
+                        "serving request: sequence length %d exceeds the "
+                        "largest seq bucket %d — trim the input or widen "
+                        "the ladder" % (seq_len, self.seq_buckets[-1]))
+        sb = self.seq_bucket(seq_len) if seq_len is not None else None
+
+        key_parts = []
+        for name in sorted(arrays):
+            a = arrays[name]
+            shape = list(a.shape[1:])
+            if sb is not None and a.ndim > self.seq_axis and \
+                    a.shape[self.seq_axis] == seq_len:
+                shape[self.seq_axis - 1] = sb
+            key_parts.append((name, str(a.dtype), tuple(shape)))
+        return n_rows, seq_len, (sb, tuple(key_parts))
+
+    def pad_request(self, feed, seq_len):
+        """Pad one request's sequence axes up to the bucket (row count
+        untouched). Returns {name: ndarray}."""
+        if seq_len is None:
+            return {n: np.asarray(v) for n, v in feed.items()}
+        sb = self.seq_bucket(seq_len)
+        out = {}
+        for name, v in feed.items():
+            a = np.asarray(v)
+            if a.ndim > self.seq_axis and a.shape[self.seq_axis] == seq_len \
+                    and sb > seq_len:
+                pad = [(0, 0)] * a.ndim
+                pad[self.seq_axis] = (0, sb - seq_len)
+                a = np.pad(a, pad, mode='constant',
+                           constant_values=self.pad_value)
+            out[name] = a
+        return out
+
+    def pad_rows(self, stacked, n_rows):
+        """Pad a concatenated {name: [N, ...]} batch up to the batch
+        bucket by replicating the last real row; returns (padded_feed,
+        padded_rows)."""
+        b = self.batch_bucket(n_rows)
+        if b == n_rows:
+            return stacked, b
+        out = {}
+        for name, a in stacked.items():
+            fill = np.repeat(a[-1:], b - n_rows, axis=0)
+            out[name] = np.concatenate([a, fill], axis=0)
+        return out, b
+
+    def bucket_grid(self):
+        """Every (batch_bucket, seq_bucket) cell warmup() must compile."""
+        seqs = self.seq_buckets if self.seq_buckets is not None else [None]
+        return [(bb, sb) for bb in self.batch_buckets for sb in seqs]

@@ -231,6 +231,100 @@ def test_pipeline_composes_with_data_parallel():
     np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
 
 
+def _lowered_gpipe_fn(num_stages=4, hid=8, n_layer=4, seed=31):
+    """Minimized LOWERING-LEVEL harness for the gpipe-under-2-axis-mesh
+    divergence (ROADMAP open item): a 4-layer fc/tanh stack — no
+    attention, no optimizer, no MeshRunner — transpiled to one gpipe_run
+    and lowered with core.lowering.build_fn. Returns (fn, feed, state,
+    serial_loss): calling fn under an active mesh(data=2, pipe=4)
+    reproduces (or refutes) the bug in ~2 s instead of the full LM
+    compose test."""
+    from paddle_tpu.core import lowering
+
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = seed
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data(name='x', shape=[hid], dtype='float32')
+            h = fluid.layers.scale(x, scale=1.0, bias=0.1)
+            for k in range(n_layer):
+                z = fluid.layers.fc(h, size=hid, bias_attr=False,
+                                    param_attr='gplow_w%d' % k)
+                h = fluid.layers.tanh(z)
+            loss = fluid.layers.mean(fluid.layers.square(h))
+        return main, startup, loss
+
+    rng = np.random.RandomState(0)
+    feed = {'x': rng.randn(8, hid).astype('float32')}
+    exe = fluid.Executor()
+
+    main, startup, loss = build()
+    s1 = fluid.Scope()
+    with fluid.scope_guard(s1):
+        exe.run(startup, scope=s1)
+        ref = float(exe.run(main, feed=feed, fetch_list=[loss],
+                            scope=s1)[0].reshape(()))
+
+    main2, startup2, loss2 = build()
+    fluid.transpiler.PipelineTranspiler().transpile(main2,
+                                                    num_stages=num_stages)
+    s2 = fluid.Scope()
+    with fluid.scope_guard(s2):
+        exe.run(startup2, scope=s2)
+        state = {n: np.asarray(s2.get(n)) for n in s2.names()}
+
+    fetch = [loss2.name]
+    read, written = lowering.analyze_state(main2, fetch)
+    needed = fluid.Executor._read_before_write(main2, read, written,
+                                               {'x'}, fetch)
+
+    def call(wrap):
+        from paddle_tpu.parallel import make_mesh
+        from paddle_tpu.parallel import api as papi
+        mesh = make_mesh([('data', 2), ('pipe', num_stages)])
+        prev = papi._ACTIVE_MESH
+        papi._ACTIVE_MESH = mesh      # what MeshRunner.run sets up
+        try:
+            fn, ro_names, rw_names = lowering.build_fn(
+                main2, fetch, needed, written)
+            ro = {n: state[n] for n in ro_names}
+            rw = {n: state[n] for n in rw_names}
+            with mesh:
+                fetches, _ = wrap(fn)(feed, ro, rw, jax.random.PRNGKey(0))
+        finally:
+            papi._ACTIVE_MESH = prev
+        return float(np.asarray(fetches[0]).reshape(()))
+
+    return call, ref
+
+
+def test_gpipe_2axis_mesh_lowering_eager_is_exact():
+    """Control for the xfail below: the SAME lowered gpipe_run under the
+    SAME mesh(data=2, pipe=4), called eagerly (no surrounding jit), is
+    exact — the bug lives in the jit-of-manual-over-all-shard_map
+    interaction, not in the pipeline schedule itself."""
+    call, ref = _lowered_gpipe_fn()
+    got = call(lambda fn: fn)
+    np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-7)
+
+
+@pytest.mark.xfail(
+    strict=True,
+    reason="gpipe-under-2-axis-mesh FORWARD divergence (ROADMAP open "
+           "item): jax.jit of a program whose gpipe_run lowers through "
+           "the manual-over-ALL shard_map fallback (jax 0.4.37, "
+           "check_rep=False) under a mesh carrying an unused-by-manual "
+           "'data' axis computes a wrong forward (~3.5x relerr on this "
+           "4-layer fc stack; eager call of the SAME fn is exact — see "
+           "the control test above). Deterministic; fix likely needs "
+           "manual-over-subset shard_map (jax upgrade) or replicating "
+           "the gpipe operands explicitly before entry.")
+def test_gpipe_2axis_mesh_lowering_jit_matches_serial():
+    call, ref = _lowered_gpipe_fn()
+    got = call(jax.jit)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
 def test_program_pipeline_engages_batch_axis(monkeypatch):
     """The gpipe_run lowering must actually pass batch_axis='data' under
     a data x pipe mesh — trajectory equality alone cannot distinguish a

@@ -1,0 +1,279 @@
+"""Serving engine: dynamic batching, bucket warmup, load shedding,
+deadlines, and fault-injected retry at the run boundary (docs/serving.md).
+
+The model is tiny (2 fc layers) and saved ONCE per module; every engine
+in the file rebuilds an identical program, so the process-wide
+fingerprint compile cache keeps per-test warmups at milliseconds."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import monitor, resilience
+from paddle_tpu.serving import (BucketLadder, DeadlineExceededError,
+                                EngineStoppedError, LoadShedError,
+                                ServingConfig, ServingEngine)
+
+
+@pytest.fixture(scope='module')
+def model_dir(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp('serving_model'))
+    main_p, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup):
+        with fluid.unique_name.guard():
+            x = fluid.layers.data(name='x', shape=[6], dtype='float32')
+            h = fluid.layers.fc(x, size=12, act='relu')
+            y = fluid.layers.fc(h, size=3)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        fluid.save_inference_model(d, ['x'], [y], exe, main_program=main_p)
+    return d
+
+
+def _rows(n, seed=0):
+    return np.random.RandomState(seed).randn(n, 6).astype('float32')
+
+
+def _engine(model_dir, **kw):
+    kw.setdefault('max_batch_size', 4)
+    kw.setdefault('max_wait_ms', 5)
+    kw.setdefault('num_workers', 2)
+    kw.setdefault('queue_cap', 64)
+    return ServingEngine(ServingConfig(model_dir, **kw))
+
+
+# ---------------------------------------------------------------------------
+# bucket ladder
+
+
+def test_bucket_ladder_keys_and_padding():
+    lad = BucketLadder([2, 4], seq_buckets=[8, 16], seq_axis=1)
+    f1 = {'t': np.zeros((1, 5), 'int64')}
+    f2 = {'t': np.zeros((2, 7), 'int64')}
+    f3 = {'t': np.zeros((1, 12), 'int64')}
+    n1, l1, k1 = lad.request_shape(f1)
+    n2, l2, k2 = lad.request_shape(f2)
+    n3, l3, k3 = lad.request_shape(f3)
+    assert (n1, l1) == (1, 5) and (n2, l2) == (2, 7)
+    assert k1 == k2            # same seq bucket (8) -> coalescible
+    assert k3 != k1            # bucket 16 is another cell
+    padded = lad.pad_request(f1, 5)
+    assert padded['t'].shape == (1, 8)
+    stacked, b = lad.pad_rows({'t': np.zeros((3, 8), 'int64')}, 3)
+    assert b == 4 and stacked['t'].shape == (4, 8)
+    # grid covers every (batch, seq) cell
+    assert len(lad.bucket_grid()) == 4
+
+
+def test_bucket_ladder_rejects_unservable():
+    lad = BucketLadder([2, 4], seq_buckets=[8], seq_axis=1)
+    with pytest.raises(ValueError, match='exceed'):
+        lad.request_shape({'t': np.zeros((8, 4), 'int64')})   # too wide
+    with pytest.raises(ValueError, match='seq bucket'):
+        lad.request_shape({'t': np.zeros((1, 9), 'int64')})   # too long
+    with pytest.raises(ValueError, match='leading batch dim'):
+        lad.request_shape({'a': np.zeros((1, 4)), 'b': np.zeros((2, 4))})
+
+
+# ---------------------------------------------------------------------------
+# engine request path
+
+
+def test_batched_results_match_sequential(model_dir):
+    pred = fluid.Predictor(model_dir)
+    xs = [_rows(1, i) for i in range(8)] + [_rows(2, 90), _rows(4, 91)]
+    refs = [pred.run({'x': v})[0] for v in xs]
+    eng = _engine(model_dir)
+    eng.warmup({'x': xs[0]})
+    with eng:
+        futs = [eng.submit({'x': v}) for v in xs]
+        outs = [f.result(30) for f in futs]
+    for o, r in zip(outs, refs):
+        assert o[0].shape == r.shape
+        np.testing.assert_allclose(o[0], r, rtol=1e-5, atol=1e-6)
+
+
+def test_warmup_then_mixed_load_zero_recompiles(model_dir):
+    """After warmup(), a concurrent load spanning >= 3 bucket sizes (1, 2,
+    4 rows) must record a compile_cache_miss delta of exactly 0."""
+    eng = _engine(model_dir)
+    warm = eng.warmup({'x': _rows(1)})
+    assert warm['buckets'] == 3            # ladder [1, 2, 4]
+    before = monitor.counters()
+    with eng:
+        futs = [eng.submit({'x': _rows(r, seed=r * 7 + i)})
+                for i, r in enumerate([1, 2, 4] * 4)]
+        for f in futs:
+            f.result(30)
+    delta = monitor.counter_delta(before)
+    assert not any(k.startswith('compile_cache_miss') for k in delta), delta
+    assert delta.get('serving_request_total{outcome=ok}') == 12
+    assert delta.get('serving_batch_total', 0) >= 1
+
+
+def test_load_shed_structured_reason_and_counter(model_dir):
+    eng = _engine(model_dir, queue_cap=2)   # workers never started
+    before = monitor.counters()
+    eng.submit({'x': _rows(1)})
+    eng.submit({'x': _rows(1)})
+    with pytest.raises(LoadShedError) as ei:
+        eng.submit({'x': _rows(1)})
+    assert ei.value.reason == 'queue_full'
+    assert ei.value.queue_depth == 2 and ei.value.queue_cap == 2
+    delta = monitor.counter_delta(before)
+    assert delta.get('serving_request_total{outcome=shed}') == 1
+    eng.stop()                              # queued requests fail, not hang
+
+
+def test_feed_name_validation_and_ladder_reject(model_dir):
+    eng = _engine(model_dir)
+    with pytest.raises(KeyError, match="missing.*unexpected|unexpected"):
+        eng.submit({'bogus': _rows(1)})
+    before = monitor.counters()
+    with pytest.raises(ValueError, match='exceed'):
+        eng.submit({'x': _rows(64)})        # over the widest bucket
+    assert monitor.counter_delta(before).get(
+        'serving_request_total{outcome=rejected}') == 1
+    eng.stop()
+
+
+def test_deadline_never_hangs_caller(model_dir):
+    """A request whose deadline passes while queued is failed with
+    DeadlineExceededError by the worker — and even with NO worker alive
+    the caller's result() self-deadlines instead of hanging."""
+    eng = _engine(model_dir, num_workers=1)
+    r = eng.submit({'x': _rows(1)}, deadline_s=0.05)
+    t0 = time.monotonic()
+    with pytest.raises(DeadlineExceededError):
+        r.result()
+    assert time.monotonic() - t0 < 5.0
+    # expired-in-queue: the worker must count + fail it on collection
+    before = monitor.counters()
+    time.sleep(0.06)                        # r is now long expired
+    eng.start()
+    live = eng.submit({'x': _rows(1)}, deadline_s=10.0)
+    assert live.result(30) is not None
+    eng.stop()
+    delta = monitor.counter_delta(before)
+    assert delta.get('serving_request_total{outcome=deadline}') == 1
+
+
+def test_stop_fails_queued_requests(model_dir):
+    eng = _engine(model_dir)                # not started
+    r = eng.submit({'x': _rows(1)})
+    eng.stop()
+    with pytest.raises(EngineStoppedError):
+        r.result(5)
+    with pytest.raises(EngineStoppedError):
+        eng.submit({'x': _rows(1)})
+
+
+# ---------------------------------------------------------------------------
+# fault injection at the run boundary (PADDLE_FAULT_SPEC / install_fault)
+
+
+def test_transient_run_faults_retry_to_success(model_dir):
+    """Injected transient faults at the run boundary: the executor's
+    RetryPolicy retries the dispatch, the request still succeeds, and
+    retry_attempt_total{site=run} advances."""
+    eng = _engine(model_dir, num_workers=1)
+    eng.warmup({'x': _rows(1)})             # faults must not hit warmup
+    before = monitor.counters()
+    resilience.install_fault('run', mode='n', value=2)
+    try:
+        with eng:
+            out = eng.run({'x': _rows(1)}, deadline_s=30.0, timeout=30.0)
+    finally:
+        resilience.clear_faults()
+    assert np.asarray(out[0]).shape == (1, 3)
+    delta = monitor.counter_delta(before)
+    assert delta.get('retry_attempt_total{site=run}', 0) >= 1
+    assert delta.get('fault_injected_total{site=run}', 0) >= 1
+    assert delta.get('serving_request_total{outcome=ok}') == 1
+
+
+def test_exhausted_retries_surface_per_request_not_pool_death(
+        model_dir, monkeypatch):
+    """run:always exhausts the retry budget: the batch's requests get the
+    error, the worker pool survives, and the next (fault-free) request
+    succeeds on the same engine."""
+    monkeypatch.setenv('PADDLE_RETRY_MAX_ATTEMPTS', '2')
+    monkeypatch.setenv('PADDLE_RETRY_BASE_S', '0.01')
+    eng = _engine(model_dir, num_workers=1)
+    eng.warmup({'x': _rows(1)})
+    before = monitor.counters()
+    resilience.install_fault('run', mode='always')
+    try:
+        with eng:
+            r = eng.submit({'x': _rows(1)}, deadline_s=30.0)
+            with pytest.raises(resilience.InjectedFault):
+                r.result(30.0)
+            resilience.clear_faults()
+            out = eng.run({'x': _rows(1)}, deadline_s=30.0, timeout=30.0)
+    finally:
+        resilience.clear_faults()
+    assert np.asarray(out[0]).shape == (1, 3)
+    delta = monitor.counter_delta(before)
+    assert delta.get('retry_giveup_total{site=run}', 0) >= 1
+    assert delta.get('serving_request_total{outcome=error}') == 1
+    assert delta.get('serving_request_total{outcome=ok}') == 1
+
+
+def test_fault_spec_env_grammar_reaches_serving(model_dir):
+    """The env-var grammar (not just install_fault) drives the same
+    boundary: one injected+retried fault, request still served."""
+    eng = _engine(model_dir, num_workers=1)
+    eng.warmup({'x': _rows(1)})
+    before = monitor.counters()
+    with resilience.fault_spec('run:n=1'):
+        with eng:
+            out = eng.run({'x': _rows(1)}, deadline_s=30.0, timeout=30.0)
+    assert np.asarray(out[0]).shape == (1, 3)
+    delta = monitor.counter_delta(before)
+    assert delta.get('fault_injected_total{site=run}', 0) >= 1
+    assert delta.get('serving_request_total{outcome=ok}') == 1
+
+
+# ---------------------------------------------------------------------------
+# satellites living nearby
+
+
+def test_predictor_run_validates_feed_names(model_dir):
+    pred = fluid.Predictor(model_dir)
+    with pytest.raises(KeyError, match="missing \\['x'\\]"):
+        pred.run({'y': _rows(1)})
+    with pytest.raises(KeyError, match="unexpected \\['extra'\\]"):
+        pred.run({'x': _rows(1), 'extra': _rows(1)})
+
+
+def test_per_call_donate_override_counts_and_behaves():
+    """Executor.run(donate=...) overrides the process default for one
+    call; no env var is touched."""
+    import os
+    main_p, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup):
+        with fluid.unique_name.guard():
+            w = fluid.layers.create_global_var(
+                [4], value=0.0, dtype='float32', persistable=True,
+                name='serving_donate_w')
+            fluid.layers.increment(w)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        before = monitor.counters()
+        exe.run(main_p, scope=scope, donate=False)
+        d = monitor.counter_delta(before)
+        assert d.get(
+            'donation_fallback_total{reason=per_call_opt_out}') == 1
+        assert 'PADDLE_DONATE' not in os.environ or \
+            os.environ['PADDLE_DONATE'] != '0'
+        before = monitor.counters()
+        exe.run(main_p, scope=scope, donate=True)
+        d = monitor.counter_delta(before)
+        assert d.get('donation_run_total') == 1
+        assert float(np.asarray(scope.get('serving_donate_w'))[0]) == 2.0

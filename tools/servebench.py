@@ -1,0 +1,253 @@
+"""Serving-engine load generator: closed-loop + open-loop measurement.
+
+Answers the three questions the serving layer (paddle_tpu/serving/,
+docs/serving.md) makes measurable promises about:
+
+- batching win: request throughput of a mixed-shape CONCURRENT load
+  (requests spanning >= 3 bucket sizes) through the engine vs the same
+  requests as sequential single-request `Predictor.run` calls. The
+  contract is >= 3x at batchable concurrency; both sides report the
+  best-of-`rounds` window (the CI box is noisy — compare minima).
+- warm steady state: `compile_cache_miss` delta across the measured
+  window after `warmup()` — the bucket ladder's whole point is that this
+  is 0.
+- overload behavior: an OPEN-LOOP burst past the queue bound must shed
+  (structured LoadShedError, counted) while every accepted request still
+  completes within its deadline — never unbounded queueing.
+
+Usage: python tools/servebench.py [rounds] (prints one JSON line);
+importable `measure_serving()` (bench.py's serving row reuses it).
+"""
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _build_model(dirname):
+    """Small 3-layer MLP saved as an inference model: big enough that a
+    batched dispatch does real work, small enough to compile in ~100 ms
+    per bucket on CPU."""
+    import numpy as np
+    import paddle_tpu as fluid
+    main_p, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup):
+        with fluid.unique_name.guard():
+            x = fluid.layers.data(name='x', shape=[64], dtype='float32')
+            h = fluid.layers.fc(x, size=128, act='relu')
+            h = fluid.layers.fc(h, size=128, act='relu')
+            y = fluid.layers.fc(h, size=16)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        fluid.save_inference_model(dirname, ['x'], [y], exe,
+                                   main_program=main_p)
+    return 'x', 64
+
+
+def _mixed_requests(feed_name, width, n, seed=0):
+    """Request stream spanning 3 batch-bucket sizes (1/2/4 rows)."""
+    import numpy as np
+    rng = np.random.RandomState(seed)
+    rows_cycle = (1, 2, 4)
+    return [{feed_name: rng.randn(rows_cycle[i % 3], width)
+             .astype('float32')} for i in range(n)]
+
+
+def _quantile(sorted_vals, q):
+    if not sorted_vals:
+        return None
+    i = min(len(sorted_vals) - 1, int(q * len(sorted_vals)))
+    return sorted_vals[i]
+
+
+def measure_serving(rounds=5, clients=8, requests_per_client=40,
+                    max_batch_size=64, max_wait_ms=2.0, num_workers=2,
+                    model_dir=None):
+    """Returns the serving-row dict (see module docstring). A model is
+    built in a temp dir unless `model_dir` points at a saved one with a
+    single 2-D float32 feed.
+
+    Clients are PIPELINED: each client thread submits its whole request
+    stream and then drains the futures in order — the "batchable
+    concurrency" shape (an async frontend keeping its pipeline full, not
+    one blocked caller per thread whose turnaround is dominated by
+    python thread wakeup latency under the GIL)."""
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu import monitor
+    from paddle_tpu.serving import ServingConfig, ServingEngine, \
+        LoadShedError
+
+    tmp = None
+    if model_dir is None:
+        tmp = tempfile.mkdtemp(prefix='servebench_')
+        feed_name, width = _build_model(tmp)
+        model_dir = tmp
+    else:
+        pred0 = fluid.Predictor(model_dir)
+        feed_name = pred0.get_input_names()[0]
+        width = None          # caller-provided model: derive from program
+        for v in pred0.program.global_block().vars.values():
+            if v.name == feed_name and v.shape:
+                width = int(v.shape[-1])
+        if width is None or width < 1:
+            raise ValueError(
+                "servebench cannot derive the feed width of %r from %s "
+                "(var missing or dynamic last dim %r) — it drives models "
+                "with one 2-D float32 feed of static width"
+                % (feed_name, model_dir, width))
+        del pred0
+
+    n_requests = clients * requests_per_client
+    reqs = _mixed_requests(feed_name, width, n_requests)
+
+    # --- sequential baseline: the same rows, one Predictor.run each ---
+    pred = fluid.Predictor(model_dir)
+    pred.run(reqs[0])                                   # compile
+    seq_best = float('inf')
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        for r in reqs:
+            pred.run(r)
+        seq_best = min(seq_best, time.perf_counter() - t0)
+    seq_rps = n_requests / seq_best
+
+    # --- engine closed loop: `clients` pipelined submitter threads ---
+    cfg = ServingConfig(model_dir, max_batch_size=max_batch_size,
+                        max_wait_ms=max_wait_ms, num_workers=num_workers,
+                        queue_cap=n_requests + clients)
+    engine = ServingEngine(cfg)
+    warm = engine.warmup({feed_name: reqs[0][feed_name][:1]})
+    lat_lock = threading.Lock()
+    latencies = []
+    errors = [0]
+
+    def client(cid, barrier):
+        mine = reqs[cid::clients]
+        barrier.wait()
+        futs = []
+        for r in mine:
+            try:
+                futs.append((time.perf_counter(),
+                             engine.submit(r, deadline_s=60.0)))
+            except Exception:
+                with lat_lock:
+                    errors[0] += 1
+        for t0, f in futs:
+            try:
+                f.result(60.0)
+            except Exception:
+                with lat_lock:
+                    errors[0] += 1
+                continue
+            dt = time.perf_counter() - t0
+            with lat_lock:
+                latencies.append(dt)
+
+    eng_best, miss_delta = float('inf'), 0
+    engine.start()
+    try:
+        # latencies ACCUMULATE across rounds (p50/p99 over every measured
+        # request); throughput is still best-of-rounds — reporting the
+        # last round's percentiles next to the best round's rps would mix
+        # windows and read as a latency regression on a noisy box
+        for _ in range(rounds):
+            before = monitor.counters()
+            barrier = threading.Barrier(clients + 1)
+            threads = [threading.Thread(target=client, args=(c, barrier),
+                                        daemon=True)
+                       for c in range(clients)]
+            for t in threads:
+                t.start()
+            barrier.wait()
+            t0 = time.perf_counter()
+            for t in threads:
+                t.join()
+            elapsed = time.perf_counter() - t0
+            delta = monitor.counter_delta(before)
+            miss_delta = max(miss_delta, sum(
+                v for k, v in delta.items()
+                if k.startswith('compile_cache_miss')))
+            eng_best = min(eng_best, elapsed)
+        lat = sorted(latencies)
+
+        # --- open loop: burst 4x the queue bound, expect sheds, and no
+        # accepted request may outlive its deadline ---
+        shed, ok, max_lat = 0, 0, 0.0
+        burst_cfg = ServingConfig(model_dir, max_batch_size=max_batch_size,
+                                  max_wait_ms=max_wait_ms, num_workers=1,
+                                  queue_cap=8)
+        burst = ServingEngine(burst_cfg, predictor=pred)
+        burst.start()
+        try:
+            # one waiter thread per accepted future records COMPLETION
+            # latency (draining sequentially would charge a request the
+            # time spent blocked on earlier futures and fake a deadline
+            # violation)
+            stats_lock = threading.Lock()
+
+            def waiter(t0, f):
+                nonlocal ok, max_lat
+                try:
+                    f.result(15.0)
+                except Exception:
+                    return
+                dt = time.perf_counter() - t0
+                with stats_lock:
+                    ok += 1
+                    max_lat = max(max_lat, dt)
+
+            # submit the WHOLE burst back-to-back first (spawning a
+            # thread per accept would yield the GIL and let the worker
+            # drain, hiding the overload), then start the waiters
+            accepted = []
+            for i in range(64):
+                try:
+                    accepted.append((time.perf_counter(),
+                                     burst.submit(reqs[i % len(reqs)],
+                                                  deadline_s=10.0)))
+                except LoadShedError:
+                    shed += 1
+            waiters = [threading.Thread(target=waiter, args=(t0, f),
+                                        daemon=True)
+                       for t0, f in accepted]
+            for t in waiters:
+                t.start()
+            for t in waiters:
+                t.join(20.0)
+        finally:
+            burst.stop()
+    finally:
+        engine.stop()
+        if tmp is not None:
+            import shutil
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    eng_rps = n_requests / eng_best
+    return {
+        'requests': n_requests,
+        'clients': clients,
+        'bucket_sizes_spanned': 3,
+        'sequential_rps': round(seq_rps, 1),
+        'engine_rps': round(eng_rps, 1),
+        'speedup': round(eng_rps / seq_rps, 2),
+        'latency_p50_ms': round(1e3 * (_quantile(lat, 0.5) or 0), 2),
+        'latency_p99_ms': round(1e3 * (_quantile(lat, 0.99) or 0), 2),
+        'errors': errors[0],
+        'warmup': warm,
+        'recompiles_after_warmup': int(miss_delta),
+        'open_loop': {'submitted': 64, 'ok': ok, 'shed': shed,
+                      'max_latency_ms': round(1e3 * max_lat, 1)},
+        'rounds': rounds,
+    }
+
+
+if __name__ == '__main__':
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 5
+    print(json.dumps(measure_serving(rounds=n)))
