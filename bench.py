@@ -108,6 +108,29 @@ def _measure_steps(exe, program, scope, batches, loss_var, k_per_call,
     return best / steps, loss, compile_s
 
 
+def _program_cost_row(program, memory=False):
+    """XLA analytics columns for one bench row: per-step flops / bytes
+    accessed from the registered executable (normalized by the fused
+    scan length), plus buffer-assignment peak bytes when `memory` (costs
+    one extra XLA compile — CPU rows only; TPU compiles are minutes)."""
+    try:
+        from paddle_tpu import analysis
+        rec = analysis.lookup(program, memory=memory)
+        if rec is None:
+            return {}
+        steps = max(1, rec.steps or 1)
+        out = {}
+        if rec.flops is not None:
+            out['flops'] = rec.flops / steps
+            out['bytes_accessed'] = rec.bytes_accessed / steps
+        if rec.peak_bytes is not None:
+            out['peak_bytes'] = rec.peak_bytes
+        return out
+    except Exception as e:  # noqa: BLE001 — advisory columns only
+        return {'analytics_error': '%s: %s' % (type(e).__name__,
+                                               str(e)[:120])}
+
+
 def _bench_lm(cfg_kwargs, batch, k_per_call, rounds, amp,
               steps_per_call=None):
     import numpy as np
@@ -136,7 +159,7 @@ def _bench_lm(cfg_kwargs, batch, k_per_call, rounds, amp,
         sec_step, loss, compile_s = _measure_steps(
             exe, main_p, scope, batches, avg_loss, k_per_call, rounds,
             steps=steps_per_call or max(120, k_per_call))
-    return {
+    row = {
         'tokens_per_sec': round(batch * cfg.seq_len / sec_step, 1),
         'step_ms': round(sec_step * 1000, 2),
         'compile_s': round(compile_s, 1),
@@ -146,6 +169,8 @@ def _bench_lm(cfg_kwargs, batch, k_per_call, rounds, amp,
             cfg.n_layer, cfg.d_model, cfg.d_ff, cfg.vocab_size,
             cfg.seq_len, batch),
     }
+    row.update(_program_cost_row(main_p))
+    return row
 
 
 def _bench_image_model(build_fn, label_str, batch, k_per_call, rounds,
@@ -200,13 +225,15 @@ def _bench_image_model(build_fn, label_str, batch, k_per_call, rounds,
         sec_step, loss, compile_s = _measure_steps(
             exe, main_p, scope, batches, avg_cost, k_per_call, rounds,
             steps=max(240, k_per_call))
-    return {
+    row = {
         'images_per_sec': round(batch / sec_step, 1),
         'step_ms': round(sec_step * 1000, 2),
         'compile_s': round(compile_s, 1),
         'final_loss': round(loss, 4),
         'config': '%s %s b%d' % (label_str, dataset, batch),
     }
+    row.update(_program_cost_row(main_p))
+    return row
 
 
 def _bench_resnet50(batch, k_per_call, rounds, amp):
@@ -711,6 +738,19 @@ def _child(mode):
     except Exception as e:
         serving = {'error': '%s: %s' % (type(e).__name__, str(e)[:200])}
 
+    # XLA cost/memory analytics smoke (tools/costreport.py — the
+    # Executor.explain CLI): flops + buffer-assignment peak for the
+    # mnist-mlp reference programs. Memory stats cost one extra XLA
+    # compile per program — cheap on CPU, minutes on TPU, so the TPU
+    # line keeps cost analysis only.
+    try:
+        from tools.costreport import measure_costreport
+        costreport = measure_costreport(batch=64 if on_tpu else 8,
+                                        memory=not on_tpu)
+    except Exception as e:
+        costreport = {'error': '%s: %s' % (type(e).__name__,
+                                           str(e)[:200])}
+
     if on_tpu:
         flagship_cfg = dict(vocab_size=32000, seq_len=512, d_model=512,
                             n_head=8, n_layer=6, d_ff=2048, dropout=0.1,
@@ -802,6 +842,9 @@ def _child(mode):
         'sync_ms': sync_ms,
         'run_overhead': run_overhead,
         'serving': serving,
+        'costreport': costreport,
+        'flops': flag.get('flops'),
+        'peak_bytes': flag.get('peak_bytes'),
         'final_loss': flag['final_loss'],
         'amp': bool(on_tpu),
         'flash_attention': True,

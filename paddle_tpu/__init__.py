@@ -56,6 +56,7 @@ from . import models
 from . import transpiler
 from . import parallel
 from . import monitor
+from . import analysis
 from . import resilience
 from .resilience import TrainingGuard
 from . import profiler

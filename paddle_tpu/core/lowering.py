@@ -17,12 +17,45 @@ Random ops draw keys deterministically from a per-run base key folded with the
 op's index, so replaying a segment inside the vjp closure sees identical
 randomness (dropout masks match between forward env and grad closure).
 """
+import contextlib
+import threading
+
 import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
 
 from .registry import get_op
+
+
+# ---------------------------------------------------------------------------
+# op lowering hook (analysis.py: op-level attribution + NaN provenance)
+#
+# A hook wraps every op lowering as `hook(ctx, op, thunk)` where `thunk()`
+# performs the actual lowering (and, when the program runs EAGERLY — the
+# interpreting path analysis.run_profiled uses — the actual computation).
+# Thread-local so one thread's profiling replay never instruments another
+# thread's trace; checked once per op at TRACE time, so compiled steady-state
+# dispatch pays nothing.
+
+_op_hook_tls = threading.local()
+
+
+def _active_op_hook():
+    return getattr(_op_hook_tls, 'fn', None)
+
+
+@contextlib.contextmanager
+def op_hook(fn):
+    """Install `fn(ctx, op, thunk)` around every op lowered on THIS thread
+    for the duration of the block (hooks do not nest — the inner hook
+    wins, the outer is restored on exit)."""
+    prev = getattr(_op_hook_tls, 'fn', None)
+    _op_hook_tls.fn = fn
+    try:
+        yield
+    finally:
+        _op_hook_tls.fn = prev
 
 
 class LowerContext(object):
@@ -211,12 +244,16 @@ class LowerContext(object):
 
 
 def lower_ops(ctx, ops, lo, hi):
+    hook = _active_op_hook()
     for i in range(lo, hi):
         ctx.op_index = i
         op = ops[i]
         ctx._static_written = set()
         ctx._twin_written = set()
-        get_op(op.type).lower(ctx, op)
+        if hook is None:
+            get_op(op.type).lower(ctx, op)
+        else:
+            hook(ctx, op, lambda op=op: get_op(op.type).lower(ctx, op))
         for n in op.output_arg_names:
             if n not in ctx._static_written:
                 ctx.statics.pop(n, None)
@@ -272,6 +309,21 @@ def lower_block(ctx, lo=0):
         return
 
     bop = ops[b]
+    ctx.op_index = b
+    hook = _active_op_hook()
+    if hook is None:
+        _lower_backward(ctx, ops, lo, b, bop)
+    else:
+        # the whole differentiated span (forward-under-vjp + pullback +
+        # grad binding) attributes to the `backward` op: its interior ops
+        # execute under jax.vjp tracing, so per-op hooks inside see
+        # tracers — analysis.py's provenance pass scouts the forward
+        # segment concretely on its own when it needs op-exact blame
+        hook(ctx, bop, lambda: _lower_backward(ctx, ops, lo, b, bop))
+    lower_block(ctx, b + 1)
+
+
+def _lower_backward(ctx, ops, lo, b, bop):
     loss_name = bop.input('Loss')[0]
     wrt_names = list(bop.attr('wrt_names'))
     sparse_set = set(bop.attr('sparse_wrt') or ())
@@ -362,7 +414,6 @@ def lower_block(ctx, lo=0):
         else:
             g = grads[n]
         ctx.env[gname] = g
-    lower_block(ctx, b + 1)
 
 
 def _lower_with_remat(ctx, ops, lo, b, ckpt_names):

@@ -106,6 +106,20 @@ def launch_procs(entrypoint, entrypoint_args=(), nproc_per_node=1,
             'PADDLE_TRAINER_ENDPOINTS': ','.join(endpoints),
             'PADDLE_COORDINATOR': coordinator,
         })
+        # fleet telemetry (docs/observability.md): every worker writes its
+        # own rank-suffixed FLAGS_monitor_log file (snapshot lines carry
+        # 'rank' too) so `tools/obsreport.py --merge <log>.rank*` can
+        # aggregate the fleet; N workers appending one JSON-lines file
+        # would interleave torn lines
+        mlog = env.get('FLAGS_monitor_log')
+        if mlog:
+            env['FLAGS_monitor_log'] = '%s.rank%d' % (mlog, rank)
+        # ... and serves /metrics on PADDLE_METRICS_PORT+rank (port 0 =
+        # every worker picks an ephemeral port; init_from_env starts the
+        # endpoint after rendezvous)
+        mport = env.get('PADDLE_METRICS_PORT')
+        if mport and mport.strip().isdigit() and int(mport) != 0:
+            env['PADDLE_METRICS_PORT'] = str(int(mport) + rank)
         if devices_per_proc:
             # virtual-device CPU runs (tests / laptops): give each worker
             # its own device slice
@@ -302,7 +316,35 @@ def init_from_env(rendezvous_deadline_s=None):
                    os.environ.get('PADDLE_TRAINER_ENDPOINTS', '?')))
         if errs:
             raise errs[0]
+    _maybe_serve_metrics()
     return rank, world
+
+
+_metrics_server = [None]
+
+
+def _maybe_serve_metrics():
+    """Start this worker's /metrics endpoint when the launcher's env
+    contract asks for one (PADDLE_METRICS_PORT, already offset per rank
+    by launch_procs). Idempotent across repeated init_from_env calls; a
+    bind failure warns instead of killing the worker — telemetry must
+    never take the job down."""
+    if _metrics_server[0] is not None:
+        return _metrics_server[0]
+    port = os.environ.get('PADDLE_METRICS_PORT', '')
+    if port == '':
+        return None
+    from .. import monitor
+    try:
+        _metrics_server[0] = monitor.serve_metrics(int(port))
+    except Exception as e:              # noqa: BLE001 — telemetry only
+        import warnings
+        warnings.warn(
+            "rank %s: could not serve /metrics on PADDLE_METRICS_PORT=%s "
+            "(%s); continuing without the endpoint"
+            % (os.environ.get('PADDLE_TRAINER_ID', '?'), port, e),
+            stacklevel=2)
+    return _metrics_server[0]
 
 
 def main(argv=None):

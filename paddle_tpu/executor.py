@@ -33,6 +33,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from . import analysis
 from . import monitor
 from . import resilience
 from .framework import (Program, Variable, default_main_program, CPUPlace,
@@ -193,7 +194,7 @@ def _callbacks_supported():
     return _cb_supported[0]
 
 
-def _donation_enabled(fused=False, override=None):
+def _donation_enabled(fused=False, override=None, record=True):
     """Default-ON buffer donation for the rw-state pytree: parameter updates
     alias their input buffers instead of holding old+new state simultaneously
     (2x peak HBM). Escape hatches: a per-call ``donate=`` override on
@@ -216,16 +217,23 @@ def _donation_enabled(fused=False, override=None):
     donation_fallback_total{reason} when OFF — so "did donation silently
     fall back through the host relay" is a snapshot read, not a debugger
     session."""
+    def _count(name, labels=None):
+        # record=False: a policy QUERY (Executor.explain resolving the
+        # donation default for its cache key), not a run — must not move
+        # the donation run/fallback rates
+        if record:
+            monitor.inc(name, labels=labels)
+
     if os.environ.get('PADDLE_OPTEST_COLLECT_DIR'):
-        monitor.inc('donation_fallback_total',
-                    labels={'reason': 'optest_collect'})
+        _count('donation_fallback_total',
+               labels={'reason': 'optest_collect'})
         return False
     if override is not None:
         if override:
-            monitor.inc('donation_run_total')
+            _count('donation_run_total')
             return True
-        monitor.inc('donation_fallback_total',
-                    labels={'reason': 'per_call_opt_out'})
+        _count('donation_fallback_total',
+               labels={'reason': 'per_call_opt_out'})
         return False
     env = None
     if fused:
@@ -234,15 +242,15 @@ def _donation_enabled(fused=False, override=None):
         env = os.environ.get('PADDLE_DONATE')
     if env is not None:
         if env != '0':
-            monitor.inc('donation_run_total')
+            _count('donation_run_total')
             return True
-        monitor.inc('donation_fallback_total',
-                    labels={'reason': 'env_opt_out'})
+        _count('donation_fallback_total',
+               labels={'reason': 'env_opt_out'})
         return False
     if _callbacks_supported():
-        monitor.inc('donation_run_total')
+        _count('donation_run_total')
         return True
-    monitor.inc('donation_fallback_total', labels={'reason': 'host_relay'})
+    _count('donation_fallback_total', labels={'reason': 'host_relay'})
     return False
 
 
@@ -541,7 +549,7 @@ class Executor(object):
             return np.asarray(value), normalize_lod(value.lod())
         return value, ()
 
-    def _prepare_feed(self, program, feed):
+    def _prepare_feed(self, program, feed, count=True):
         out, lods = {}, {}
         host_bytes = 0
         gb = program.global_block()
@@ -576,9 +584,31 @@ class Executor(object):
                         "recursive_sequence_lengths)"
                         % (name, [list(l) for l in lod], arr.shape[0]))
                 lods[name] = lod
-        if host_bytes:
+        if host_bytes and count:
+            # count=False: metadata-only callers (Executor.explain, the
+            # NaN-provenance replay) stage nothing host->device
             monitor.inc('feed_host_bytes', host_bytes)
         return out, lods
+
+    def _prepare_run_inputs(self, program, feed, scope, fetch_list,
+                            count=True):
+        """Shared feed/fetch/static preparation for every run-shaped
+        entry point (_run_impl, Executor.explain, the profiled and
+        NaN-provenance replays in analysis.py). The compile-cache key is
+        built from these values, so they MUST be produced identically
+        everywhere — explain seeding the run cache depends on it.
+        Returns (feed, fetch_names, static_feed, static_lods)."""
+        feed, feed_lods = self._prepare_feed(program, feed or {},
+                                             count=count)
+        fetch_names = [v.name if isinstance(v, Variable) else v
+                       for v in (fetch_list or [])]
+        static_names = self._static_feed_names(program)
+        static_feed = {n: np.asarray(feed[n]) for n in static_names
+                       if n in feed}
+        static_lods = {n: normalize_lod(l)
+                       for n, l in getattr(scope, '_lods', {}).items() if l}
+        static_lods.update(feed_lods)
+        return feed, fetch_names, static_feed, static_lods
 
     @staticmethod
     def _static_feed_names(program):
@@ -625,6 +655,12 @@ class Executor(object):
         # raises (nan check, bad feed) must not vanish from the rate
         with monitor.timed_span('run', 'executor_run_seconds'):
             monitor.inc('executor_run_total')
+            if analysis.profile_ops_active():
+                # op-attribution mode (PADDLE_PROFILE_OPS / profile_ops()):
+                # interpret the program op by op with per-op timing
+                return analysis.run_profiled(self, program, feed,
+                                             fetch_list, scope,
+                                             return_numpy)
             return self._run_impl(program, feed, fetch_list, scope,
                                   return_numpy, use_program_cache, donate)
 
@@ -632,17 +668,8 @@ class Executor(object):
                   use_program_cache, donate_override=None):
         if scope is None:
             scope = global_scope()
-        feed, feed_lods = self._prepare_feed(program, feed or {})
-        fetch_names = [v.name if isinstance(v, Variable) else v
-                       for v in (fetch_list or [])]
-
-        static_names = self._static_feed_names(program)
-        static_feed = {n: np.asarray(feed[n]) for n in static_names
-                       if n in feed}
-        scope_lods = {n: normalize_lod(l)
-                      for n, l in getattr(scope, '_lods', {}).items() if l}
-        static_lods = dict(scope_lods)
-        static_lods.update(feed_lods)
+        feed, fetch_names, static_feed, static_lods = \
+            self._prepare_run_inputs(program, feed, scope, fetch_list)
 
         seg_mode = os.environ.get('PADDLE_SEGMENT_HOST_OPS', 'auto')
         if seg_mode != '0':
@@ -670,6 +697,12 @@ class Executor(object):
                     program, feed, fetch_names, scope, return_numpy,
                     static_lods, static_feed, donate_override)
 
+        if donate_override is None and analysis.nan_localization_enabled():
+            from . import flags as _flags
+            if _flags.get_flags('check_nan_inf'):
+                # the opt-in provenance replay re-runs this step against
+                # the PRE-run state, so its buffers must survive the call
+                donate_override = False
         donate = _donation_enabled(override=donate_override)
         key = (program._fingerprint(),
                self._feed_signature(feed, static_lods, static_feed),
@@ -716,6 +749,9 @@ class Executor(object):
         self._run_counter += 1
         key_arr = _run_key(program.random_seed, _next_program_run(program),
                            self._run_counter)
+        # the step's PRNG key, kept for debug replays (TrainingGuard's
+        # NaN-provenance pass must reproduce the failed step's randomness)
+        program._last_run_key = key_arr
         if fresh_compile:
             # jax.jit is lazy: the XLA compile happens inside the FIRST
             # call, so honest compile wall time spans lowering + that call.
@@ -731,6 +767,11 @@ class Executor(object):
                     e, _first_call, site='compile', state=rw_state)
             monitor.observe('compile_seconds',
                             time.perf_counter() - t_compile)
+            # register the executable for XLA cost/memory analytics
+            # (lazy: mined when snapshot/explain/costreport first looks)
+            analysis.record_compiled(entry.fn, program,
+                                     (feed, ro_state, rw_state, key_arr),
+                                     kind='run', donate=donate)
         else:
             # steady-state dispatch: the success path pays one fault-site
             # check and a try frame; retry machinery engages only after an
@@ -759,7 +800,25 @@ class Executor(object):
         scope.update(new_state)
         from . import flags as _flags
         if _flags.get_flags('check_nan_inf'):
-            _check_nan_inf(new_state, dict(zip(entry.fetch_names, fetches)))
+            try:
+                _check_nan_inf(new_state,
+                               dict(zip(entry.fetch_names, fetches)))
+            except RuntimeError as e:
+                # PADDLE_NAN_LOCALIZE=1: replay the step op-by-op against
+                # the still-alive pre-run state and name the first op
+                # that produced a non-finite value (no-op when disabled)
+                info = analysis.localize_nonfinite(
+                    program, feed, ro_state, rw_state, key_arr,
+                    static_lods, static_feed)
+                if info is not None:
+                    err = RuntimeError('%s; %s' % (
+                        e, analysis.format_localization(info)))
+                    # carried for TrainingGuard: the guard must reuse
+                    # this localization, not pay a second replay (and
+                    # double-count nonfinite_localized_total)
+                    err.nonfinite_localization = info
+                    raise err from None
+                raise
         if _flags.get_flags('benchmark'):
             # block on the new state too: timing only fetches under-measures
             # steps whose outputs are all state writes (pure-train steps
@@ -893,6 +952,9 @@ class Executor(object):
         self._run_counter += 1
         key_arr = _run_key(program.random_seed, _next_program_run(program),
                            self._run_counter)
+        # kept for debug replays, as in _run_impl (TrainingGuard's NaN
+        # provenance must not fall back to PRNGKey(0) for host-op programs)
+        program._last_run_key = key_arr
         val_env = dict(feed)
         lod_env = dict(static_lods)
         for seg in plan:
@@ -1247,6 +1309,7 @@ class Executor(object):
         self._run_counter += 1
         key_arr = _run_key(program.random_seed, _next_program_run(program),
                            self._run_counter)
+        program._last_run_key = key_arr
         if fresh_compile:
             # as in run(): jax.jit compiles inside the first call;
             # transient XLA failures retry under the 'compile' site
@@ -1260,6 +1323,12 @@ class Executor(object):
                     e, _first_call, site='compile', state=rw_state)
             monitor.observe('compile_seconds',
                             time.perf_counter() - t_compile)
+            # fused analytics count the WHOLE k-step scan; `steps` lets
+            # readers (bench rows, costreport) normalize to per-step
+            analysis.record_compiled(entry.fn, program,
+                                     (stacked, ro_state, rw_state, key_arr),
+                                     kind='fused', donate=donate,
+                                     steps=n_steps)
         else:
             def _dispatch():
                 resilience.maybe_fault('run')
@@ -1284,6 +1353,36 @@ class Executor(object):
         return list(fetches)
 
     # ------------------------------------------------------------------
+    def explain(self, program=None, feed=None, fetch_list=None, scope=None,
+                memory=True):
+        """Compile-time cost/memory report for `program` at this feed
+        signature — WITHOUT executing it (state shapes are read from the
+        scope as metadata; nothing is uploaded or run).
+
+        Returns a dict: ``flops``, ``transcendentals``,
+        ``bytes_accessed`` (XLA HloCostAnalysis), ``argument_bytes`` /
+        ``output_bytes`` / ``temp_bytes`` / ``alias_bytes`` /
+        ``peak_bytes`` (XLA buffer assignment; ``memory=False`` skips
+        them and the extra XLA compile they cost), plus ``op_count`` /
+        ``ops`` / ``fingerprint``. The compiled trace is shared with the
+        run cache, so ``explain`` before ``run`` prices one trace, not
+        two. CLI twin: ``tools/costreport.py``."""
+        return analysis.explain_program(self, program, feed=feed,
+                                        fetch_list=fetch_list, scope=scope,
+                                        memory=memory)
+
+    # ------------------------------------------------------------------
+    def _state_ref(self, scope, name):
+        """Scope value for aval/metadata purposes only — no device upload,
+        no caching, same not-initialized error contract as _state_value."""
+        v = scope.get(name)
+        if v is None:
+            raise RuntimeError(
+                "persistable variable %r is not initialized in the scope — "
+                "run the startup program first (reference: EnforceNotMet "
+                "'Var is not initialized')" % name)
+        return v
+
     def _state_value(self, scope, name, program, cache=True):
         v = scope.get(name)
         if v is None:

@@ -34,13 +34,15 @@ docs/observability.md.
 import bisect
 import collections
 import json
+import math
 import os
 import threading
 import time
 
 __all__ = ['inc', 'set_gauge', 'observe', 'span', 'spans', 'clear_spans',
            'snapshot', 'export_prometheus', 'counters', 'counter_delta',
-           'configure_logging', 'log_snapshot', 'reset']
+           'configure_logging', 'log_snapshot', 'reset',
+           'serve_metrics', 'MetricsServer']
 
 _lock = threading.RLock()
 _counters = {}          # name -> {label_key: float}
@@ -82,6 +84,13 @@ class _Hist(object):
         self.vmax = None
 
     def add(self, v):
+        if not math.isfinite(v):
+            # a NaN observation would poison sum/min/max (and bisect
+            # against NaN lands in an arbitrary bucket), making every
+            # later export emit NaN — drop it loudly instead
+            d = _counters.setdefault('monitor_nonfinite_observations', {})
+            d[()] = d.get((), 0.0) + 1
+            return
         self.counts[bisect.bisect_left(_BOUNDS, v)] += 1
         self.n += 1
         self.total += v
@@ -140,13 +149,47 @@ def inc(name, value=1.0, labels=None):
         series[key] = series.get(key, 0.0) + value
 
 
+# Gauges whose value changes are ALSO recorded into the span ring as
+# chrome-trace counter samples ('ph': 'C'), so exported traces show
+# memory/load curves alongside spans. Matched by exact name or suffix.
+# Queue-depth gauges move PER REQUEST at serving throughput (thousands/s)
+# — unthrottled they would churn the whole 4096-entry ring in under a
+# second and evict every duration span — so each track is sampled at most
+# once per _COUNTER_TRACK_MIN_S.
+_COUNTER_TRACK_NAMES = ('program_peak_bytes', 'program_flops')
+_COUNTER_TRACK_SUFFIXES = ('queue_depth', 'inflight_batches')
+_COUNTER_TRACK_MIN_S = 0.005            # <= 200 samples/s per track
+_track_last_ts = {}                     # track name -> last sample time
+
+
+def _counter_tracked(name):
+    return name in _COUNTER_TRACK_NAMES or \
+        name.endswith(_COUNTER_TRACK_SUFFIXES)
+
+
 def set_gauge(name, value, labels=None):
-    """Set gauge `name` to `value` (last write wins)."""
+    """Set gauge `name` to `value` (last write wins). Gauges on the
+    counter-track list additionally drop a 'C' sample into the span ring
+    for profiler.export_chrome_tracing's counter tracks."""
     key = _labels_key(labels)
+    value = float(value)
     with _lock:
         series = _gauges.setdefault(name, {})
         key = _capped_key(series, key)
-        series[key] = float(value)
+        series[key] = value
+        if _counter_tracked(name):
+            # label values ride in the event name so two programs'
+            # program_peak_bytes samples land on SEPARATE chrome counter
+            # tracks instead of sawtoothing on one
+            track = '%s:%s' % (name, ','.join(v for _, v in key)) \
+                if key else name
+            now = time.time()
+            if now - _track_last_ts.get(track, 0.0) >= _COUNTER_TRACK_MIN_S:
+                _track_last_ts[track] = now
+                _spans.append({'name': track, 'ph': 'C', 'ts': now * 1e6,
+                               'value': value, 'pid': _PID,
+                               'tid': threading.get_ident()})
+                _n_spans[0] += 1
 
 
 def observe(name, value, labels=None):
@@ -316,11 +359,43 @@ def counter_delta(before, after=None):
             for k, v in after.items() if v != before.get(k, 0)}
 
 
+# Hooks run (outside the lock) before snapshot()/export_prometheus()
+# assemble their view — analysis.py registers its lazy-analytics flush
+# here, so program_flops/peak_bytes gauges exist whenever anyone looks.
+_presnapshot_hooks = []
+
+
+def add_presnapshot_hook(fn):
+    _presnapshot_hooks.append(fn)
+
+
+def _run_presnapshot_hooks():
+    for fn in list(_presnapshot_hooks):
+        try:
+            fn()
+        except Exception:
+            # an analytics hiccup must never break metrics export; inc()
+            # takes _lock — a raw dict write here could resize _counters
+            # under a concurrent scrape's iteration
+            inc('monitor_presnapshot_errors')
+
+
 def snapshot():
-    """Plain-dict view of every metric (the tests/bench surface)."""
+    """Plain-dict view of every metric (the tests/bench surface). Tagged
+    with the worker rank when launched under distributed.launch (the
+    PADDLE_TRAINER_ID env contract) so merged fleet logs stay
+    attributable — tools/obsreport.py --merge keys on it."""
+    _run_presnapshot_hooks()
+    try:
+        rank = int(os.environ.get('PADDLE_TRAINER_ID', ''))
+    except ValueError:
+        # a non-numeric rank ('chief', garbage) must not turn every
+        # snapshot/log write into a crash — telemetry never kills the job
+        rank = None
     with _lock:
         return {
             'ts': time.time(),
+            'rank': rank,
             'counters': {_fmt(n, k): _num(v)
                          for n, s in _counters.items()
                          for k, v in s.items()},
@@ -345,6 +420,7 @@ def _prom_labels(key, extra=()):
 
 def export_prometheus():
     """Text exposition format (the /metrics scrape body)."""
+    _run_presnapshot_hooks()
     lines = []
     with _lock:
         for name in sorted(_counters):
@@ -356,8 +432,14 @@ def export_prometheus():
             for key, v in sorted(_gauges[name].items()):
                 lines.append('%s%s %s' % (name, _prom_labels(key), v))
         for name in sorted(_hists):
+            # a series whose every observation was dropped (non-finite
+            # guard) has n == 0: emitting its sum/buckets would be noise
+            # at best and NaN at worst — skip empties entirely
+            live = [(k, h) for k, h in sorted(_hists[name].items()) if h.n]
+            if not live:
+                continue
             lines.append('# TYPE %s histogram' % name)
-            for key, h in sorted(_hists[name].items()):
+            for key, h in live:
                 cum = 0
                 for bound, c in zip(_BOUNDS, h.counts):
                     cum += c
@@ -381,6 +463,7 @@ def reset():
         _counters.clear()
         _gauges.clear()
         _hists.clear()
+        _track_last_ts.clear()
         _spans = _new_ring()
 
 
@@ -473,3 +556,82 @@ def configure_logging(path, interval_s=None):
             atexit.register(_final_flush)
             _atexit_hooked[0] = True
         t.start()
+
+
+# ---------------------------------------------------------------------------
+# fleet telemetry: the /metrics scrape endpoint
+
+
+class MetricsServer(object):
+    """Stdlib-HTTP Prometheus endpoint serving this process's registry.
+
+    ``GET /metrics`` returns ``export_prometheus()`` (content type
+    ``text/plain; version=0.0.4``), ``GET /healthz`` returns ``ok`` —
+    enough for a Prometheus scrape config plus a liveness probe, with
+    zero dependencies. The server runs on a daemon thread; ``close()``
+    shuts it down and releases the port. Use via ``serve_metrics()``."""
+
+    def __init__(self, port=0, host='127.0.0.1'):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(self):          # noqa: N802 — stdlib contract
+                if self.path.split('?')[0] in ('/metrics', '/'):
+                    body = export_prometheus().encode()
+                    ctype = 'text/plain; version=0.0.4; charset=utf-8'
+                elif self.path == '/healthz':
+                    body, ctype = b'ok\n', 'text/plain'
+                else:
+                    self.send_error(404)
+                    return
+                self.send_response(200)
+                self.send_header('Content-Type', ctype)
+                self.send_header('Content-Length', str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):
+                pass                    # scrapes must not spam stderr
+
+        self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, kwargs={'poll_interval': 0.2},
+            name='paddle-metrics-%d' % self.port, daemon=True)
+        self._thread.start()
+        set_gauge('metrics_server_port', float(self.port))
+
+    @property
+    def url(self):
+        return 'http://%s:%d/metrics' % (self.host, self.port)
+
+    def close(self, timeout_s=5.0):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout_s)
+
+    stop = close
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def serve_metrics(port=None, host='127.0.0.1'):
+    """Start the Prometheus scrape endpoint; returns a `MetricsServer`
+    (``.port`` holds the bound port). ``port=None`` reads
+    ``PADDLE_METRICS_PORT``; 0 (the default) binds an ephemeral port.
+    Callers own the returned server's lifetime (``close()``); the serving
+    engine and distributed launch wire it automatically — see
+    docs/observability.md."""
+    if port is None:
+        try:
+            port = int(os.environ.get('PADDLE_METRICS_PORT', '') or 0)
+        except ValueError:
+            port = 0
+    return MetricsServer(port=port, host=host)

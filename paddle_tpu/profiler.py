@@ -14,7 +14,8 @@ import json
 from . import monitor
 
 __all__ = ['cuda_profiler', 'reset_profiler', 'profiler', 'start_profiler',
-           'stop_profiler', 'record_event', 'export_chrome_tracing']
+           'stop_profiler', 'record_event', 'export_chrome_tracing',
+           'profile_ops']
 
 _active = False
 _trace_dir = None
@@ -103,20 +104,49 @@ def record_event(name):
     return monitor.span(name)
 
 
+@contextlib.contextmanager
+def profile_ops():
+    """Op-level attribution mode (the context-manager twin of
+    ``PADDLE_PROFILE_OPS=1``): every ``Executor.run`` inside the block
+    executes through the interpreting path with per-op wall time, call
+    count, and output-bytes accounting. Yields the ``analysis`` module —
+    read ``analysis.op_profile()`` for the structured table or
+    ``analysis.format_op_profile()`` for the Fluid-style sorted report.
+    The accumulated table is reset on entry and KEPT on exit (so it can
+    be read after the block). ~10-100x slower than compiled execution —
+    a debugging mode, not a serving mode."""
+    from . import analysis
+    analysis.reset_op_profile()
+    analysis.push_profiling()
+    try:
+        yield analysis
+    finally:
+        analysis.pop_profiling()
+
+
 def export_chrome_tracing(path, since_ts=None):
     """chrome://tracing JSON of host spans (reference tools/timeline.py:115).
 
     Exports the whole always-on ring by default (works with no session);
     `since_ts` (wall-clock us) keeps only spans that END at or after it —
-    how stop_profiler scopes a session export to the profiled window. A
-    bad path raises (fail-loudly doctrine — same contract as the device
-    tracer in start_profiler); it must not produce a silently missing
-    trace."""
+    how stop_profiler scopes a session export to the profiled window.
+    Gauge samples the monitor's counter-track list recorded (memory /
+    queue depth) are emitted as chrome counter events (``"ph": "C"``), so
+    the trace shows load curves alongside spans. A bad path raises
+    (fail-loudly doctrine — same contract as the device tracer in
+    start_profiler); it must not produce a silently missing trace."""
     events = monitor.spans()
     if since_ts is not None:
-        events = [e for e in events if e['ts'] + e['dur'] >= since_ts]
-    trace = {'traceEvents': [
-        {'name': e['name'], 'ph': 'X', 'ts': e['ts'], 'dur': e['dur'],
-         'pid': e['pid'], 'tid': e['tid']} for e in events]}
+        events = [e for e in events
+                  if e['ts'] + e.get('dur', 0.0) >= since_ts]
+    out = []
+    for e in events:
+        if e.get('ph') == 'C':
+            out.append({'name': e['name'], 'ph': 'C', 'ts': e['ts'],
+                        'pid': e['pid'],
+                        'args': {e['name']: e['value']}})
+        else:
+            out.append({'name': e['name'], 'ph': 'X', 'ts': e['ts'],
+                        'dur': e['dur'], 'pid': e['pid'], 'tid': e['tid']})
     with open(path, 'w') as f:
-        json.dump(trace, f)
+        json.dump({'traceEvents': out}, f)

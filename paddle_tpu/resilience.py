@@ -679,6 +679,10 @@ class TrainingGuard(object):
         self.bad_steps = 0              # consecutive
         self.total_skipped = 0
         self.last_step_skipped = False
+        # PADDLE_NAN_LOCALIZE=1: info dict of the op the last bad step's
+        # non-finite value was localized to (analysis.localize_nonfinite),
+        # None when localization is off / found nothing / step was good
+        self.last_localization = None
         self._good_streak = 0
         self._written_cache = None      # (program version, names)
 
@@ -719,6 +723,7 @@ class TrainingGuard(object):
         snap_lods = dict(getattr(scope, '_lods', {}))
 
         bad = False
+        run_localization = None     # executor-side provenance, if it ran
         fetches = []
         # donation off for THIS call only (the rollback snapshot must
         # outlive the run) via the executor's per-call override — runs on
@@ -735,6 +740,7 @@ class TrainingGuard(object):
                     'NaN/Inf' not in str(e):
                 raise
             bad = True
+            run_localization = getattr(e, 'nonfinite_localization', None)
             # the raise swallowed the fetch values; keep the
             # documented "bad values for logging" return shape with
             # NaN stand-ins so `guard.step(...)[0]` survives the
@@ -761,6 +767,19 @@ class TrainingGuard(object):
             for n in self._written_names():
                 if n not in snap and scope.has(n):
                     scope.drop(n)
+            # opt-in NaN provenance (PADDLE_NAN_LOCALIZE=1): reuse the
+            # localization the executor's check_nan_inf path already paid
+            # for when it raised; otherwise replay the failed step against
+            # the just-restored pre-step state, with the SAME rng key, and
+            # record which op went non-finite first
+            if run_localization is not None:
+                self.last_localization = run_localization
+            else:
+                from . import analysis
+                prog = getattr(self._program, '_program', self._program)
+                self.last_localization = analysis.localize_from_scope(
+                    self._exe, prog, feed, scope,
+                    getattr(prog, '_last_run_key', None))
             self._scale_adjust(scope, self.backoff_factor)
             self.bad_steps += 1
             self.total_skipped += 1
@@ -769,16 +788,21 @@ class TrainingGuard(object):
             monitor.inc('nonfinite_skip_total')
             if self.bad_steps >= self.max_bad_steps:
                 monitor.inc('nonfinite_escalate_total')
+                where = ''
+                if self.last_localization:
+                    where = '; ' + analysis.format_localization(
+                        self.last_localization)
                 raise NonFiniteError(
                     "TrainingGuard: %d consecutive non-finite steps "
                     "(loss %r) — the optimizer update was skipped each "
                     "time; inspect the data pipeline / lower the learning "
-                    "rate / check loss scaling"
+                    "rate / check loss scaling%s"
                     % (self.bad_steps,
-                       self._loss_name or '<unnamed>'))
+                       self._loss_name or '<unnamed>', where))
         else:
             self.bad_steps = 0
             self.last_step_skipped = False
+            self.last_localization = None
             self._good_streak += 1
             if self.growth_interval and \
                     self._good_streak % self.growth_interval == 0:

@@ -31,6 +31,7 @@ Instrumentation (monitor.py): ``serving_request_total{outcome}``
 ``serving.execute`` spans on the monitor ring. Full catalog + tuning
 guide: docs/serving.md.
 """
+import os
 import threading
 import time
 
@@ -62,13 +63,18 @@ class ServingConfig(object):
     - queue_cap: bounded-queue depth in REQUESTS; beyond it submissions
       shed with `LoadShedError`.
     - default_deadline_s: per-request deadline when submit() gives none.
+    - metrics_port: start a Prometheus ``/metrics`` endpoint
+      (``monitor.serve_metrics``) with the engine; 0 binds an ephemeral
+      port (read it back from ``engine.metrics_port``), None (default)
+      falls back to the ``PADDLE_METRICS_PORT`` env var, and no endpoint
+      is started when neither is set.
     """
 
     def __init__(self, model_dir=None, model_filename=None,
                  params_filename=None, max_batch_size=8, max_wait_ms=2.0,
                  batch_buckets=None, seq_buckets=None, seq_axis=1,
                  pad_value=0, num_workers=2, queue_cap=64,
-                 default_deadline_s=30.0):
+                 default_deadline_s=30.0, metrics_port=None):
         self.model_dir = model_dir
         self.model_filename = model_filename
         self.params_filename = params_filename
@@ -87,6 +93,7 @@ class ServingConfig(object):
         self.num_workers = max(1, int(num_workers))
         self.queue_cap = int(queue_cap)
         self.default_deadline_s = default_deadline_s
+        self.metrics_port = metrics_port
 
 
 class ServingEngine(object):
@@ -126,7 +133,30 @@ class ServingEngine(object):
         self._lock = threading.Lock()
         self._inflight_n = 0
         self._inflight_lock = threading.Lock()
+        self._metrics_server = None
         monitor.set_gauge('serving_queue_depth', 0.0)
+
+    @property
+    def metrics_port(self):
+        """Bound port of the engine's /metrics endpoint (None when not
+        serving metrics — see ServingConfig.metrics_port)."""
+        return self._metrics_server.port if self._metrics_server else None
+
+    @property
+    def metrics_url(self):
+        return self._metrics_server.url if self._metrics_server else None
+
+    def _resolve_metrics_port(self):
+        port = self.config.metrics_port
+        if port is None:
+            env = os.environ.get('PADDLE_METRICS_PORT', '')
+            if env == '':
+                return None
+            try:
+                port = int(env)
+            except ValueError:
+                return None
+        return int(port)
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -139,6 +169,22 @@ class ServingEngine(object):
                     "a stopped ServingEngine cannot restart — build a "
                     "fresh engine (the queue already failed its callers)")
             self._started = True
+            port = self._resolve_metrics_port()
+            if port is not None and self._metrics_server is None:
+                # scrape endpoint rides the engine lifecycle: up before
+                # the first batch, down with stop() — a fleet scheduler
+                # pointing Prometheus at PADDLE_METRICS_PORT sees every
+                # serving_* series without extra wiring. A bind failure
+                # must not leave the engine half-started (queue open,
+                # _started set, zero workers): warn and serve without it
+                try:
+                    self._metrics_server = monitor.serve_metrics(port)
+                except Exception as e:      # noqa: BLE001 — telemetry only
+                    import warnings
+                    warnings.warn(
+                        "ServingEngine: could not serve /metrics on port "
+                        "%s (%s); continuing without the endpoint"
+                        % (port, e), stacklevel=2)
             for i in range(self.config.num_workers):
                 t = threading.Thread(target=self._worker_loop,
                                      name='paddle-serving-%d' % i,
@@ -159,6 +205,9 @@ class ServingEngine(object):
         for t in self._workers:
             t.join(timeout_s)
         self._workers = []
+        if self._metrics_server is not None:
+            self._metrics_server.close()
+            self._metrics_server = None
 
     def __enter__(self):
         return self.start()

@@ -339,6 +339,178 @@ class TestAcceptance(object):
         assert any(s['name'] == 'predictor.run' for s in monitor.spans())
 
 
+_PROM_LINE = r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? -?[0-9][0-9eE+.\-]*$'
+
+
+def _assert_prometheus_parses(text):
+    """Every sample line must match the exposition grammar with a FINITE
+    value (a NaN/Inf sample is exactly the regression this guards)."""
+    import re
+    lines = [l for l in text.splitlines() if l and not l.startswith('#')]
+    assert lines
+    for line in lines:
+        assert re.match(_PROM_LINE, line), line
+        value = float(line.rsplit(' ', 1)[1])
+        assert np.isfinite(value), line
+
+
+class TestHistHardening(object):
+    def test_empty_hist_quantile_none_and_zero_stats(self):
+        h = monitor._Hist()
+        assert h.quantile(0.5) is None
+        assert h.quantile(0.99) is None
+        assert h.stats() == {'count': 0, 'sum': 0.0}
+
+    def test_nonfinite_observations_dropped_loudly(self):
+        monitor.observe('poisoned_seconds', float('nan'))
+        monitor.observe('poisoned_seconds', float('inf'))
+        monitor.observe('poisoned_seconds', 0.002)
+        h = monitor.snapshot()['histograms']['poisoned_seconds']
+        assert h['count'] == 1
+        assert h['sum'] == 0.002 and h['min'] == h['max'] == 0.002
+        assert monitor.counters()['monitor_nonfinite_observations'] == 2
+
+    def test_export_prometheus_skips_empty_hists(self):
+        """A histogram whose every observation was dropped (or that was
+        never observed) must vanish from the scrape body — no NaN, no
+        zero-bucket noise."""
+        monitor.observe('all_dropped_seconds', float('nan'))
+        monitor.observe('live_seconds', 0.004)
+        text = monitor.export_prometheus()
+        assert 'all_dropped_seconds' not in text
+        assert 'live_seconds_count 1' in text
+        _assert_prometheus_parses(text)
+
+
+class TestChromeCounterTracks(object):
+    def test_counter_gauges_become_counter_events(self, tmp_path):
+        """Satellite: program_peak_bytes / queue-depth gauge writes land
+        in exported traces as chrome counter events ('ph': 'C') with the
+        {name: value} args schema; plain gauges stay off the ring."""
+        monitor.set_gauge('program_peak_bytes', 123456.0,
+                          labels={'fingerprint': 'abcdef012345'})
+        monitor.set_gauge('program_peak_bytes', 777.0,
+                          labels={'fingerprint': 'feedbeef0123'})
+        monitor.set_gauge('serving_queue_depth', 3.0)
+        monitor.set_gauge('plain_gauge', 9.0)           # not counter-tracked
+        with monitor.span('work'):
+            pass
+        path = str(tmp_path / 'trace.json')
+        fluid.profiler.export_chrome_tracing(path)
+        with open(path) as f:
+            evs = json.load(f)['traceEvents']
+        counters = [e for e in evs if e.get('ph') == 'C']
+        names = {e['name'] for e in counters}
+        # labeled gauges get per-label-value tracks (two programs must
+        # not sawtooth one 'program_peak_bytes' track)
+        assert 'program_peak_bytes:abcdef012345' in names
+        assert 'program_peak_bytes:feedbeef0123' in names
+        assert 'serving_queue_depth' in names
+        assert 'plain_gauge' not in names
+        for e in counters:
+            assert set(e) == {'name', 'ph', 'ts', 'pid', 'args'}
+            assert e['pid'] == os.getpid()
+            assert isinstance(e['args'], dict)
+            assert e['args'] == {e['name']: e['args'][e['name']]}
+            assert isinstance(e['args'][e['name']], float)
+        spans = [e for e in evs if e.get('ph') == 'X']
+        assert any(e['name'] == 'work' for e in spans)
+        for e in spans:                 # duration schema untouched
+            assert {'name', 'ph', 'ts', 'dur', 'pid', 'tid'} <= set(e)
+
+
+class TestServeMetrics(object):
+    def test_endpoint_serves_and_closes(self):
+        from urllib.request import urlopen
+        monitor.inc('endpoint_smoke_total', 3)
+        with monitor.serve_metrics(port=0) as srv:
+            assert srv.port > 0
+            body = urlopen(srv.url, timeout=5).read().decode()
+            assert 'endpoint_smoke_total 3' in body
+            _assert_prometheus_parses(body)
+            health = urlopen('http://127.0.0.1:%d/healthz' % srv.port,
+                             timeout=5).read()
+            assert health == b'ok\n'
+            assert monitor.snapshot()['gauges'][
+                'metrics_server_port'] == float(srv.port)
+        with pytest.raises(OSError):
+            urlopen('http://127.0.0.1:%d/metrics' % srv.port, timeout=1)
+
+    def test_scrape_during_live_serving_engine(self, tmp_path):
+        """Satellite acceptance: scrape /metrics while a ServingEngine
+        handles traffic — serving_request_total appears, the exposition
+        parses, and the endpoint dies with the engine's stop()."""
+        from urllib.request import urlopen
+        from paddle_tpu.serving import ServingConfig, ServingEngine
+
+        d = str(tmp_path / 'model')
+        main_p, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main_p, startup):
+            with fluid.unique_name.guard():
+                x = fluid.layers.data(name='smx', shape=[6],
+                                      dtype='float32')
+                y = fluid.layers.fc(x, size=3)
+        exe = fluid.Executor()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup, scope=scope)
+            fluid.save_inference_model(d, ['smx'], [y], exe,
+                                       main_program=main_p)
+
+        cfg = ServingConfig(d, max_batch_size=2, max_wait_ms=1,
+                            num_workers=1, metrics_port=0)
+        engine = ServingEngine(cfg)
+        assert engine.metrics_port is None      # endpoint rides start()
+        with engine:
+            port = engine.metrics_port
+            assert port and port > 0
+            engine.run({'smx': np.ones((1, 6), 'float32')})
+            body = urlopen(engine.metrics_url, timeout=5).read().decode()
+        assert 'serving_request_total{outcome="ok"} 1' in body
+        assert 'serving_queue_depth' in body
+        _assert_prometheus_parses(body)
+        assert engine.metrics_port is None      # released by stop()
+        with pytest.raises(OSError):
+            urlopen('http://127.0.0.1:%d/metrics' % port, timeout=1)
+
+    def test_bind_failure_warns_but_engine_serves(self, tmp_path):
+        """A taken metrics port must not half-start the engine (queue
+        open, zero workers): it warns and serves without the endpoint."""
+        from paddle_tpu.serving import ServingConfig, ServingEngine
+
+        d = str(tmp_path / 'model')
+        main_p, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main_p, startup):
+            with fluid.unique_name.guard():
+                x = fluid.layers.data(name='bfx', shape=[6],
+                                      dtype='float32')
+                y = fluid.layers.fc(x, size=3)
+        exe = fluid.Executor()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup, scope=scope)
+            fluid.save_inference_model(d, ['bfx'], [y], exe,
+                                       main_program=main_p)
+
+        with monitor.serve_metrics(port=0) as taken:
+            cfg = ServingConfig(d, max_batch_size=2, max_wait_ms=1,
+                                num_workers=1, metrics_port=taken.port)
+            engine = ServingEngine(cfg)
+            with pytest.warns(UserWarning, match='could not serve'):
+                engine.start()
+            try:
+                assert engine.metrics_port is None
+                out = engine.run({'bfx': np.ones((1, 6), 'float32')},
+                                 timeout=30)
+                assert np.asarray(out[0]).shape == (1, 3)
+            finally:
+                engine.stop()
+
+    def test_snapshot_tolerates_nonnumeric_rank(self, monkeypatch):
+        monkeypatch.setenv('PADDLE_TRAINER_ID', 'chief')
+        assert monitor.snapshot()['rank'] is None
+
+
 class TestObsReport(object):
     def test_pretty_prints_snapshot_log_and_trace(self, tmp_path, capsys):
         import sys
@@ -364,3 +536,42 @@ class TestObsReport(object):
         obsreport.main([trace])
         out = capsys.readouterr().out
         assert 'traced' in out and 'total_ms' in out
+
+    def test_merge_aggregates_rank_tagged_logs(self, tmp_path, capsys,
+                                               monkeypatch):
+        """Fleet mode: per-rank logs (the files distributed.launch writes)
+        merge into one report — counters summed, gauges as min/max
+        spread, histogram counts combined, ranks listed."""
+        import sys
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))), 'tools'))
+        try:
+            import obsreport
+        finally:
+            sys.path.pop(0)
+        paths = []
+        for rank in (0, 1):
+            monkeypatch.setenv('PADDLE_TRAINER_ID', str(rank))
+            monitor.reset()
+            monitor.inc('steps_total', 10 + rank)
+            monitor.set_gauge('queue_depth', float(rank))
+            monitor.observe('step_seconds', 0.01 * (rank + 1))
+            p = str(tmp_path / ('mon.jsonl.rank%d' % rank))
+            monitor.log_snapshot(p)
+            paths.append(p)
+        monkeypatch.delenv('PADDLE_TRAINER_ID')
+        snap = json.loads(open(paths[1]).read().splitlines()[-1])
+        assert snap['rank'] == 1                # snapshot carries the rank
+        obsreport.main(['--merge'] + paths)
+        out = capsys.readouterr().out
+        assert '2 workers (ranks [0, 1])' in out
+        assert '21' in out                      # counters summed: 10 + 11
+        assert '0 .. 1' in out                  # gauge min..max spread
+        merged = obsreport.merge_snapshots(
+            [obsreport._last_snapshot(p) for p in paths])
+        assert merged['counters']['steps_total'] == 21
+        assert merged['histograms']['step_seconds']['count'] == 2
+        assert merged['histograms']['step_seconds']['min'] == \
+            pytest.approx(0.01)
+        assert merged['histograms']['step_seconds']['max'] == \
+            pytest.approx(0.02)

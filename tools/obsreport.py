@@ -8,10 +8,19 @@ Two input shapes, auto-detected:
 - a chrome-trace JSON from profiler.export_chrome_tracing: prints a per-span
   aggregate table (count, total/mean/max ms, threads) sorted by total time.
 
+Fleet mode: ``--merge`` takes the per-worker rank-tagged log files
+``distributed.launch`` writes (FLAGS_monitor_log becomes
+``<path>.rank<N>`` per worker) and prints ONE aggregated report — counters
+summed across workers, gauges as min/max spread, histograms merged on
+their mergeable stats (count/sum/min/max; per-worker percentiles don't
+compose, so they are dropped).
+
 Usage:
     python tools/obsreport.py runlog.jsonl
     python tools/obsreport.py runlog.jsonl --all
     python tools/obsreport.py trace.json
+    python tools/obsreport.py --merge runlog.jsonl.rank0 runlog.jsonl.rank1
+    python tools/obsreport.py --merge logs/run.jsonl.rank*
 """
 import argparse
 import json
@@ -36,10 +45,13 @@ def _fmt_bytes(n):
     return '%d' % n
 
 
-def print_snapshot(snap, out=sys.stdout):
-    w = out.write
+def print_snapshot(snap, out=None):
+    w = (out or sys.stdout).write
     if snap.get('ts'):
-        w('snapshot @ %s\n' % snap['ts'])
+        w('snapshot @ %s%s\n' % (
+            snap['ts'],
+            ' (rank %d)' % snap['rank']
+            if snap.get('rank') is not None else ''))
     counters = snap.get('counters') or {}
     if counters:
         w('\ncounters:\n')
@@ -72,7 +84,7 @@ def print_snapshot(snap, out=sys.stdout):
         w('\nspans in ring: %d\n' % snap['spans_recorded'])
 
 
-def print_trace(trace, out=sys.stdout):
+def print_trace(trace, out=None):
     events = trace.get('traceEvents', [])
     agg = {}
     for e in events:
@@ -86,7 +98,7 @@ def print_trace(trace, out=sys.stdout):
         a['total'] += dur
         a['max'] = max(a['max'], dur)
         a['tids'].add(e.get('tid'))
-    w = out.write
+    w = (out or sys.stdout).write
     w('%d spans, %d distinct names\n\n' % (len(events), len(agg)))
     if not agg:
         return
@@ -100,15 +112,105 @@ def print_trace(trace, out=sys.stdout):
             a['total'] / a['n'] / 1e3, a['max'] / 1e3, len(a['tids'])))
 
 
+def _last_snapshot(path):
+    last = None
+    with open(path) as f:
+        for line in f:
+            if line.strip():
+                last = json.loads(line)
+    if last is None:
+        raise SystemExit('%s: no snapshot lines' % path)
+    return last
+
+
+def merge_snapshots(snaps):
+    """Aggregate per-worker snapshots into one fleet view: counters sum,
+    gauges keep (min, max) across workers, histograms merge their
+    mergeable stats (count/sum/min/max — percentiles don't compose)."""
+    merged = {'workers': len(snaps),
+              'ranks': sorted(s.get('rank') for s in snaps
+                              if s.get('rank') is not None),
+              'ts': max((s.get('ts') or 0) for s in snaps),
+              'counters': {}, 'gauges': {}, 'histograms': {},
+              'spans_recorded': sum(s.get('spans_recorded', 0)
+                                    for s in snaps)}
+    for s in snaps:
+        for k, v in (s.get('counters') or {}).items():
+            merged['counters'][k] = merged['counters'].get(k, 0) + v
+        for k, v in (s.get('gauges') or {}).items():
+            lo, hi = merged['gauges'].get(k, (v, v))
+            merged['gauges'][k] = (min(lo, v), max(hi, v))
+        for k, h in (s.get('histograms') or {}).items():
+            m = merged['histograms'].setdefault(
+                k, {'count': 0, 'sum': 0.0, 'min': None, 'max': None})
+            m['count'] += h.get('count', 0)
+            m['sum'] += h.get('sum', 0.0)
+            for agg, fn in (('min', min), ('max', max)):
+                v = h.get(agg)
+                if v is not None:
+                    m[agg] = v if m[agg] is None else fn(m[agg], v)
+    for k, m in merged['histograms'].items():
+        if m['count']:
+            m['avg'] = m['sum'] / m['count']
+    return merged
+
+
+def print_merged(merged, out=None):
+    w = (out or sys.stdout).write
+    w('fleet: %d workers (ranks %s), newest ts %s\n'
+      % (merged['workers'], merged['ranks'] or '?', merged['ts']))
+    counters = merged['counters']
+    if counters:
+        w('\ncounters (summed):\n')
+        width = max(len(k) for k in counters)
+        for k in sorted(counters):
+            v = counters[k]
+            shown = _fmt_bytes(v) if k.split('{')[0].endswith('_bytes') \
+                else '%g' % v
+            w('  %-*s %s\n' % (width, k, shown))
+    gauges = merged['gauges']
+    if gauges:
+        w('\ngauges (min .. max across workers):\n')
+        width = max(len(k) for k in gauges)
+        for k in sorted(gauges):
+            lo, hi = gauges[k]
+            w('  %-*s %g .. %g\n' % (width, k, lo, hi))
+    hists = merged['histograms']
+    if hists:
+        w('\nhistograms (merged):\n')
+        width = max(len(k) for k in hists)
+        w('  %-*s %8s %10s %10s %10s\n'
+          % (width, '', 'count', 'avg', 'min', 'max'))
+        for k in sorted(hists):
+            h = hists[k]
+            w('  %-*s %8d %10s %10s %10s\n' % (
+                width, k, h.get('count', 0), _fmt_seconds(h.get('avg')),
+                _fmt_seconds(h.get('min')), _fmt_seconds(h.get('max'))))
+    w('\nspans in rings: %d\n' % merged['spans_recorded'])
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(
         description='Pretty-print a monitor snapshot log or chrome-trace '
                     'dump')
-    p.add_argument('path', help='JSON-lines snapshot log (FLAGS_monitor_log)'
-                                ' or chrome-trace JSON')
+    p.add_argument('paths', nargs='+',
+                   help='JSON-lines snapshot log(s) (FLAGS_monitor_log) '
+                        'or a chrome-trace JSON')
     p.add_argument('--all', action='store_true',
                    help='print every snapshot line, not just the newest')
+    p.add_argument('--merge', action='store_true',
+                   help='aggregate the newest snapshot of EACH file into '
+                        'one fleet report (per-rank logs from '
+                        'distributed.launch)')
     args = p.parse_args(argv)
+
+    if args.merge:
+        print_merged(merge_snapshots([_last_snapshot(p)
+                                      for p in args.paths]))
+        return
+    if len(args.paths) != 1:
+        raise SystemExit('multiple paths require --merge')
+    args.path = args.paths[0]
 
     with open(args.path) as f:
         first = f.read(1)
