@@ -43,7 +43,8 @@ from .core.lod import normalize_lod
 from .core.registry import get_op, has_op
 from .core.types import convert_np_dtype_to_dtype_
 
-__all__ = ['Executor', 'Scope', 'global_scope', 'scope_guard']
+__all__ = ['Executor', 'Scope', 'BoundProgram', 'global_scope',
+           'scope_guard']
 
 
 class _TensorShim(object):
@@ -471,6 +472,80 @@ def _fetched(arr, lod):
     out = np.asarray(arr).view(FetchedTensor)
     out._lod = normalize_lod(lod)
     return out
+
+
+class BoundProgram(object):
+    """A fixed-signature dispatch handle from `Executor.bind`: per-call
+    work is state staging from the scope, one fault-site check, the
+    compiled call, and the scope rebind. No cache-key hashing, no feed
+    re-preparation, no span machinery — the per-token host tax of a
+    decode loop. FLAGS_check_nan_inf raises at the program boundary as
+    in run() (the op-level localization replay stays a run() feature).
+    Calls are NOT thread-safe against each other (the decode loop owns
+    its engine's executor thread)."""
+
+    __slots__ = ('_exe', '_entry', '_program', '_scope', '_needs_rng',
+                 '_key0', 'first_out', 'fetch_names', 'example_feed')
+
+    def __init__(self, exe, entry, program, scope, needs_rng, first_out,
+                 example_feed=None):
+        self._exe = exe
+        self._entry = entry
+        self._program = program
+        self._scope = scope
+        self._needs_rng = needs_rng
+        # RNG-free programs reuse one key — building a PRNGKey is itself
+        # a device dispatch, pure waste for is_test decode steps
+        self._key0 = jax.random.PRNGKey(program.random_seed or 0)
+        self.first_out = first_out
+        self.fetch_names = tuple(entry.fetch_names)
+        # the PREPARED bind-time feed (LoD tuples flattened, dtypes
+        # normalized): callers that dispatch a constant feed every call —
+        # bench timing loops — pass it back verbatim instead of
+        # re-preparing per call
+        self.example_feed = example_feed
+
+    def __call__(self, feed, return_numpy=True):
+        entry = self._entry
+        scope = self._scope
+        monitor.inc('executor_bound_run_total')
+        ro_state, rw_state = {}, {}
+        exe = self._exe
+        # _state_value, not a bare scope.get: it raises the clear
+        # not-initialized error, uploads host-written state once with the
+        # lossless-conversion + writeable-freeze guards, and skips
+        # caching for read-written names (new_state rebinds those)
+        for n in entry.ro_names:
+            ro_state[n] = exe._state_value(scope, n, self._program)
+        for n in entry.rw_names:
+            rw_state[n] = exe._state_value(scope, n, self._program,
+                                           cache=False)
+        if self._needs_rng:
+            self._exe._run_counter += 1
+            key_arr = _run_key(self._program.random_seed,
+                               _next_program_run(self._program),
+                               self._exe._run_counter)
+        else:
+            key_arr = self._key0
+
+        def _dispatch():
+            resilience.maybe_fault('run')
+            return entry.fn(feed, ro_state, rw_state, key_arr)
+        try:
+            fetches, new_state = _dispatch()
+        except Exception as e:          # noqa: BLE001 — classified inside
+            fetches, new_state = resilience.retry_after(
+                e, _dispatch, site='run', state=rw_state)
+        scope.update(new_state)
+        from . import flags as _flags
+        if _flags.get_flags('check_nan_inf'):
+            # same program-boundary check as run(); the op-level
+            # localization replay is a run() feature — rebind through
+            # run() to localize a poisoned step
+            _check_nan_inf(new_state, dict(zip(self.fetch_names, fetches)))
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return list(fetches)
 
 
 class _FeedSpec(object):
@@ -1351,6 +1426,55 @@ class Executor(object):
                             sum(int(f.nbytes) for f in out))
             return out
         return list(fetches)
+
+    # ------------------------------------------------------------------
+    def bind(self, program, feed, fetch_list=None, scope=None, donate=None):
+        """Prepare a FIXED-SIGNATURE run for a hot dispatch loop: one
+        normal `run()` (compiling and caching as usual), then return a
+        `BoundProgram` whose calls skip the per-run key work — feed
+        preparation, fingerprint/signature hashing, cache lookup and span
+        bookkeeping — and go straight to state staging + compiled
+        dispatch. Built for token-decode loops (serving/generate.py),
+        where `run()`'s ~200 µs host tax is paid once per generated token
+        engine-wide.
+
+        Contract: every subsequent call must feed the SAME names, shapes
+        and dtypes as `feed` (the bound executable is never re-keyed); the
+        program must be host-op-free (no segmented execution) and not
+        under op-attribution profiling. Programs without RNG-consuming ops
+        reuse one PRNG key across calls — is_test decode programs; a
+        program WITH rng ops derives a fresh per-call key exactly like
+        run(). Fault injection and retry at the 'run' site behave as in
+        run(); `donate` resolves once at bind time."""
+        if scope is None:
+            scope = global_scope()
+        if donate is None and analysis.nan_localization_enabled():
+            from . import flags as _flags
+            if _flags.get_flags('check_nan_inf'):
+                # mirror _run_impl's localize force-off so the key below
+                # matches the entry the run() actually cached
+                donate = False
+        first_out = self.run(program, feed=feed, fetch_list=fetch_list,
+                             scope=scope, donate=donate)
+        feed2, fetch_names, static_feed, static_lods = \
+            self._prepare_run_inputs(program, feed, scope, fetch_list,
+                                     count=False)
+        donate_flag = _donation_enabled(override=donate, record=False)
+        key = (program._fingerprint(),
+               self._feed_signature(feed2, static_lods, static_feed),
+               tuple(fetch_names), donate_flag)
+        entry = self._cache_get(key)
+        if entry is None:
+            raise RuntimeError(
+                "Executor.bind: no cached compiled entry for this "
+                "(program, feed, fetch) signature — bind() supports "
+                "host-op-free programs outside profile_ops mode only "
+                "(the run above went through a different execution path)")
+        needs_rng = any(
+            has_op(op.type) and get_op(op.type).needs_rng
+            for block in program.blocks for op in block.ops)
+        return BoundProgram(self, entry, program, scope, needs_rng,
+                            first_out, example_feed=feed2)
 
     # ------------------------------------------------------------------
     def explain(self, program=None, feed=None, fetch_list=None, scope=None,

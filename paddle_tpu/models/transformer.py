@@ -9,10 +9,15 @@ rules match for tensor parallelism.
 import numpy as np
 
 from .. import layers
+# decode steps gather rows of the SAME sinusoid table the
+# add_position_encoding op applies during prefill — sharing the builder
+# keeps a token's embedding bit-identical on both paths (re-exported)
+from ..ops.tensor_ops import position_encoding_table  # noqa: F401
 from ..param_attr import ParamAttr
 
 __all__ = ['multi_head_attention', 'transformer_block', 'build_lm',
-           'LMConfig']
+           'LMConfig', 'position_encoding_table', 'build_lm_prefill',
+           'build_lm_decode_step']
 
 
 class LMConfig(object):
@@ -175,3 +180,235 @@ def build_lm(cfg=None, is_test=False):
     loss = layers.softmax_with_cross_entropy(flat_logits, flat_labels)
     avg_loss = layers.mean(loss)
     return tokens, labels, logits, avg_loss
+
+
+# ---------------------------------------------------------------------------
+# Generative decode programs (serving/generate.py)
+#
+# Two program shapes drive autoregressive generation against a persistent
+# device-resident KV cache ([slots, layers, heads, max_len, head_dim]
+# persistable buffers shared BY NAME with every program in the engine's
+# scope — like params, the cache is ordinary executor state, so donation
+# updates it in place):
+#
+# - build_lm_prefill: one compiled signature per prompt bucket. Runs the
+#   full causal forward of ONE prompt (padded to the bucket), deposits its
+#   K/V rows into the request's cache slot, and emits the first generated
+#   token (argmax at the last REAL position).
+# - build_lm_decode_step: ONE compiled signature per engine. Advances every
+#   slot one token: deposits each slot's new K/V at its own position and
+#   attends against its cached history. All ops are slot-row-independent,
+#   so requests admitted/evicted at token boundaries never perturb their
+#   neighbors' numerics (the parity contract tests/test_generate.py pins).
+#
+# Parameter names match build_lm exactly — a scope trained (or loaded) for
+# the LM serves decode without any renaming.
+# ---------------------------------------------------------------------------
+
+KV_CACHE_K = 'gen_kv_k'
+KV_CACHE_V = 'gen_kv_v'
+
+
+def _declare_kv_caches(block, cfg, slots, max_len):
+    dh = cfg.d_model // cfg.n_head
+    shape = (slots, cfg.n_layer, cfg.n_head, max_len, dh)
+    kc = block.create_var(name=KV_CACHE_K, shape=shape, dtype='float32',
+                          persistable=True, stop_gradient=True)
+    vc = block.create_var(name=KV_CACHE_V, shape=shape, dtype='float32',
+                          persistable=True, stop_gradient=True)
+    return kc, vc
+
+
+def _cache_write(block, op_type, cache, new, index_var, layer):
+    """Append a cache-write op whose output IS the cache var (read-modify-
+    write persistable state: the executor returns it as new state and
+    donation aliases the update in place)."""
+    index_slot = 'Slot' if op_type == 'kv_cache_prefill' else 'Positions'
+    block.append_op(
+        type=op_type,
+        inputs={'Cache': [cache], 'New': [new], index_slot: [index_var]},
+        outputs={'Out': [cache]},
+        attrs={'layer': int(layer)})
+    return cache
+
+
+def _qkv_split_step(qkv, cfg):
+    """[S, 3d] -> three [S, H, dh], with the same 3/h/dh unpacking order as
+    build_lm's reshape (q first, then k, then v)."""
+    h, dh = cfg.n_head, cfg.d_model // cfg.n_head
+    qkv = layers.reshape(qkv, shape=[-1, 3, h, dh])
+    parts = []
+    for i in range(3):
+        parts.append(layers.squeeze(
+            layers.slice(qkv, axes=[1], starts=[i], ends=[i + 1]),
+            axes=[1]))
+    return parts
+
+
+def build_lm_decode_step(cfg, slots, max_len):
+    """Single-token decode step over ALL cache slots.
+
+    Feeds: 'gen_tokens' [slots, 1] int64 (each slot's last token),
+    'gen_pos' [slots, 1] int64 (the position each slot writes this step).
+    Returns {'tokens', 'pos', 'logits', 'next_tokens', 'k_cache',
+    'v_cache'} — fetch 'next_tokens' ([slots] int64 greedy argmax)."""
+    d, h = cfg.d_model, cfg.n_head
+    dh = d // h
+    tokens = layers.data(name='gen_tokens', shape=[1], dtype='int64')
+    pos = layers.data(name='gen_pos', shape=[1], dtype='int64')
+    block = tokens.block
+    kc, vc = _declare_kv_caches(block, cfg, slots, max_len)
+
+    x = layers.embedding(
+        tokens, size=[cfg.vocab_size, d], dtype='float32',
+        param_attr=ParamAttr(name='tok_emb.w'))              # [S, d]
+    pe = layers.assign(position_encoding_table(max_len, d))
+    x = layers.elementwise_add(x, layers.gather(pe, pos))
+
+    for i in range(cfg.n_layer):
+        p = 'layer_%d' % i
+        ln1 = layers.layer_norm(
+            x, begin_norm_axis=1,
+            param_attr=ParamAttr(name=p + '.ln1.w'),
+            bias_attr=ParamAttr(name=p + '.ln1.b'))
+        qkv = layers.fc(ln1, size=3 * d,
+                        param_attr=ParamAttr(name=p + '.attn.qkv.w'),
+                        bias_attr=ParamAttr(name=p + '.attn.qkv.b'))
+        q, k, v = _qkv_split_step(qkv, cfg)                  # [S, H, dh]
+        kc = _cache_write(block, 'kv_cache_update', kc, k, pos, i)
+        vc = _cache_write(block, 'kv_cache_update', vc, v, pos, i)
+        ctx = block.create_var(name=p + '.kv_ctx',
+                               shape=(-1, h, dh), dtype='float32')
+        block.append_op(
+            type='kv_decode_attention',
+            inputs={'Q': [q], 'KCache': [kc], 'VCache': [vc],
+                    'Positions': [pos]},
+            outputs={'Out': [ctx]},
+            attrs={'layer': i, 'scale': dh ** -0.5})
+        attn = layers.fc(layers.reshape(ctx, shape=[-1, d]), size=d,
+                         param_attr=ParamAttr(name=p + '.attn.proj.w'),
+                         bias_attr=ParamAttr(name=p + '.attn.proj.b'))
+        x = layers.elementwise_add(x, attn)
+        ln2 = layers.layer_norm(
+            x, begin_norm_axis=1,
+            param_attr=ParamAttr(name=p + '.ln2.w'),
+            bias_attr=ParamAttr(name=p + '.ln2.b'))
+        ff1 = layers.fc(ln2, size=cfg.d_ff, act='gelu',
+                        param_attr=ParamAttr(name=p + '.ffn1.w'),
+                        bias_attr=ParamAttr(name=p + '.ffn1.b'))
+        ff2 = layers.fc(ff1, size=d,
+                        param_attr=ParamAttr(name=p + '.ffn2.w'),
+                        bias_attr=ParamAttr(name=p + '.ffn2.b'))
+        x = layers.elementwise_add(x, ff2)
+
+    x = layers.layer_norm(x, begin_norm_axis=1,
+                          param_attr=ParamAttr(name='final_ln.w'),
+                          bias_attr=ParamAttr(name='final_ln.b'))
+    logits = layers.fc(x, size=cfg.vocab_size,
+                       param_attr=ParamAttr(name='lm_head.w'),
+                       bias_attr=False)                      # [S, V]
+    next_tokens = layers.argmax(logits, axis=1)              # [S]
+    return {'tokens': tokens, 'pos': pos, 'logits': logits,
+            'next_tokens': next_tokens, 'k_cache': kc, 'v_cache': vc}
+
+
+def build_lm_prefill(cfg, prompt_len, slots, max_len):
+    """Prefill ONE prompt (padded to `prompt_len`, a bucket cell) into one
+    cache slot and emit the first generated token.
+
+    Feeds: 'gen_prompt' [1, prompt_len] int64, 'gen_slot' [1, 1] int64,
+    'gen_len' [1, 1] int64 (real prompt length; pad rows beyond it are
+    causal-masked out of the answer and overwritten by later decode
+    steps). Returns {'prompt', 'slot', 'length', 'logits', 'first_token',
+    'k_cache', 'v_cache'} — fetch 'first_token' ([1] int64)."""
+    if prompt_len > max_len:
+        raise ValueError(
+            "prompt bucket %d exceeds the KV cache width max_len=%d"
+            % (prompt_len, max_len))
+    d, h = cfg.d_model, cfg.n_head
+    dh = d // h
+    T = int(prompt_len)
+    prompt = layers.data(name='gen_prompt', shape=[-1, T], dtype='int64')
+    slot = layers.data(name='gen_slot', shape=[1], dtype='int64')
+    length = layers.data(name='gen_len', shape=[1], dtype='int64')
+    block = prompt.block
+    kc, vc = _declare_kv_caches(block, cfg, slots, max_len)
+
+    emb = layers.embedding(
+        prompt, size=[cfg.vocab_size, d], dtype='float32',
+        param_attr=ParamAttr(name='tok_emb.w'))              # [1, T, d]
+    x = layers.add_position_encoding(emb, alpha=1.0, beta=1.0)
+
+    use_flash = bool(getattr(cfg, 'use_flash_attention', False))
+    mask_var = None
+    if not use_flash:
+        causal_mask = np.triu(np.full((T, T), -1e9, dtype='float32'), k=1)
+        mask_var = layers.assign(causal_mask)
+
+    for i in range(cfg.n_layer):
+        p = 'layer_%d' % i
+        ln1 = layers.layer_norm(
+            x, begin_norm_axis=2,
+            param_attr=ParamAttr(name=p + '.ln1.w'),
+            bias_attr=ParamAttr(name=p + '.ln1.b'))
+        qkv = layers.fc(ln1, size=3 * d, num_flatten_dims=2,
+                        param_attr=ParamAttr(name=p + '.attn.qkv.w'),
+                        bias_attr=ParamAttr(name=p + '.attn.qkv.b'))
+        qkv = layers.reshape(qkv, shape=[0, T, 3, h, dh])
+        qkv = layers.transpose(qkv, perm=[2, 0, 3, 1, 4])    # (3,1,H,T,dh)
+        q = layers.squeeze(layers.slice(qkv, axes=[0], starts=[0],
+                                        ends=[1]), axes=[0])
+        k = layers.squeeze(layers.slice(qkv, axes=[0], starts=[1],
+                                        ends=[2]), axes=[0])
+        v = layers.squeeze(layers.slice(qkv, axes=[0], starts=[2],
+                                        ends=[3]), axes=[0])
+        kc = _cache_write(block, 'kv_cache_prefill', kc, k, slot, i)
+        vc = _cache_write(block, 'kv_cache_prefill', vc, v, slot, i)
+        if use_flash:
+            ctx = block.create_var(name=p + '.prefill_flash_out',
+                                   shape=(-1, h, T, dh), dtype='float32')
+            block.append_op(
+                type='flash_attention',
+                inputs={'Q': [q], 'K': [k], 'V': [v]},
+                outputs={'Out': [ctx]},
+                attrs={'scale': dh ** -0.5, 'causal': True,
+                       'ring_zigzag': False})
+        else:
+            logits_a = layers.matmul(q, k, transpose_y=True,
+                                     alpha=dh ** -0.5)
+            logits_a = layers.elementwise_add(logits_a, mask_var)
+            weights = layers.softmax(logits_a)
+            ctx = layers.matmul(weights, v)                  # (1,H,T,dh)
+        ctx = layers.transpose(ctx, perm=[0, 2, 1, 3])
+        ctx = layers.reshape(ctx, shape=[0, T, d])
+        attn = layers.fc(ctx, size=d, num_flatten_dims=2,
+                         param_attr=ParamAttr(name=p + '.attn.proj.w'),
+                         bias_attr=ParamAttr(name=p + '.attn.proj.b'))
+        x = layers.elementwise_add(x, attn)
+        ln2 = layers.layer_norm(
+            x, begin_norm_axis=2,
+            param_attr=ParamAttr(name=p + '.ln2.w'),
+            bias_attr=ParamAttr(name=p + '.ln2.b'))
+        ff1 = layers.fc(ln2, size=cfg.d_ff, num_flatten_dims=2, act='gelu',
+                        param_attr=ParamAttr(name=p + '.ffn1.w'),
+                        bias_attr=ParamAttr(name=p + '.ffn1.b'))
+        ff2 = layers.fc(ff1, size=d, num_flatten_dims=2,
+                        param_attr=ParamAttr(name=p + '.ffn2.w'),
+                        bias_attr=ParamAttr(name=p + '.ffn2.b'))
+        x = layers.elementwise_add(x, ff2)
+
+    x = layers.layer_norm(x, begin_norm_axis=2,
+                          param_attr=ParamAttr(name='final_ln.w'),
+                          bias_attr=ParamAttr(name='final_ln.b'))
+    # only the last REAL row feeds the LM head: one [1, d] x [d, V] matmul
+    # instead of projecting all T rows to vocab
+    x_flat = layers.reshape(x, shape=[-1, d])                # [T, d]
+    one = layers.fill_constant(shape=[1], dtype='int64', value=1)
+    last = layers.gather(x_flat, layers.elementwise_sub(length, one))
+    logits = layers.fc(last, size=cfg.vocab_size,
+                       param_attr=ParamAttr(name='lm_head.w'),
+                       bias_attr=False)                      # [1, V]
+    first_token = layers.argmax(logits, axis=1)              # [1]
+    return {'prompt': prompt, 'slot': slot, 'length': length,
+            'logits': logits, 'first_token': first_token,
+            'k_cache': kc, 'v_cache': vc}

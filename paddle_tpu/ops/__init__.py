@@ -23,6 +23,7 @@ from . import vision_ops
 from . import quant_ops
 from . import misc_ops
 from . import attention_ops
+from . import kv_cache_ops
 from . import fused_ops
 from . import dist_ops
 from . import pipeline_ops

@@ -1,0 +1,90 @@
+"""Device-resident KV-cache ops for the generative decode engine.
+
+The serving-side decode path (serving/generate.py) keeps one pair of
+persistable cache buffers per engine, laid out
+
+    [slots, layers, heads, max_len, head_dim]
+
+and compiles exactly TWO program shapes per engine: a per-prompt-bucket
+prefill and a single-token decode step. The cache vars are read-AND-written
+persistables, so the executor's donation path (PR 1) aliases each step's
+updated cache onto the previous buffer — the whole multi-hundred-MB cache
+never doubles in HBM and never crosses the host. Three ops make that
+expressible in program IR:
+
+- ``kv_cache_prefill``: write a whole prompt's K (or V) rows
+  ``[1, H, T, dh]`` into one slot's cache at positions ``0:T`` (the slot id
+  is a runtime feed — one compiled prefill serves every slot).
+- ``kv_cache_update``: the decode-step write — every slot deposits its new
+  token's K (or V) row ``[S, H, dh]`` at its OWN position (a ``[S]`` feed),
+  one scatter for the whole in-flight batch.
+- ``kv_decode_attention``: one-query attention of every slot against its
+  cached keys/values, masked at each slot's current length. Positions past
+  a slot's write head carry stale garbage from earlier tenants of the slot;
+  the mask zeroes their weights EXACTLY (post-softmax ``where``), so a
+  slot's output is bit-identical whatever previously occupied the cache —
+  the property the continuous batcher's parity contract
+  (tests/test_generate.py) rests on.
+
+All three are slot-row-independent: no op mixes data across the slot axis,
+which is what makes admitting/evicting requests at token boundaries safe
+while other slots are mid-sequence.
+"""
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op
+
+_NEG_INF = -1e30
+
+
+@register_op('kv_cache_prefill', share_lod=False)
+def _kv_cache_prefill(ctx, op):
+    """Cache[slot, layer, :, 0:T, :] = New[0]  (T = prompt bucket)."""
+    cache = ctx.in1(op, 'Cache')                # [S, Ln, H, M, dh]
+    new = ctx.in1(op, 'New')                    # [1, H, T, dh]
+    slot = ctx.in1(op, 'Slot').reshape(-1).astype(jnp.int32)
+    layer = int(op.attr('layer'))
+    upd = new[:, None].astype(cache.dtype)      # [1, 1, H, T, dh]
+    zero = jnp.int32(0)
+    out = lax.dynamic_update_slice(
+        cache, upd, (slot[0], jnp.int32(layer), zero, zero, zero))
+    ctx.out(op, 'Out', out)
+
+
+@register_op('kv_cache_update', share_lod=False)
+def _kv_cache_update(ctx, op):
+    """Cache[s, layer, :, Positions[s], :] = New[s] for every slot s."""
+    cache = ctx.in1(op, 'Cache')                # [S, Ln, H, M, dh]
+    new = ctx.in1(op, 'New')                    # [S, H, dh]
+    pos = ctx.in1(op, 'Positions').reshape(-1).astype(jnp.int32)
+    layer = int(op.attr('layer'))
+    s = jnp.arange(cache.shape[0])
+    out = cache.at[s, layer, :, pos, :].set(new.astype(cache.dtype))
+    ctx.out(op, 'Out', out)
+
+
+@register_op('kv_decode_attention', share_lod=False)
+def _kv_decode_attention(ctx, op):
+    """One-query attention per slot over its cached K/V, masked to each
+    slot's positions 0..Positions[s] (inclusive: the step's own token was
+    just deposited at Positions[s] by kv_cache_update)."""
+    q = ctx.in1(op, 'Q')                        # [S, H, dh]
+    kc = ctx.in1(op, 'KCache')                  # [S, Ln, H, M, dh]
+    vc = ctx.in1(op, 'VCache')
+    pos = ctx.in1(op, 'Positions').reshape(-1)  # [S]
+    layer = int(op.attr('layer'))
+    scale = op.attr('scale', 1.0)
+    k = kc[:, layer]                            # [S, H, M, dh]
+    v = vc[:, layer]
+    scores = jnp.einsum('shd,shmd->shm', q, k,
+                        preferred_element_type=jnp.float32) * scale
+    m = jnp.arange(k.shape[2])[None, None, :] <= pos[:, None, None]
+    scores = jnp.where(m, scores, _NEG_INF)
+    w = jax.nn.softmax(scores, axis=-1)
+    # exact zero for masked positions: stale cache rows must contribute
+    # 0 * garbage = 0 bit-exactly, not exp(-1e30 - max) * garbage
+    w = jnp.where(m, w, 0.0)
+    ctx.out(op, 'Out',
+            jnp.einsum('shm,shmd->shd', w.astype(v.dtype), v))

@@ -391,18 +391,29 @@ def _label_smooth(ctx, op):
     ctx.out(op, 'Out', out)
 
 
+def position_encoding_table(max_len, d_model):
+    """The sinusoid table add_position_encoding applies, as a
+    [max_len, d_model] float32 array. ALSO gathered row-wise by the
+    generative decode path (models/transformer.py): a token's embedding
+    must be identical whether it entered via a full prefill forward or a
+    single decode step, so both paths MUST build the table through this
+    one function."""
+    pos = np.arange(max_len)[:, None]
+    half = d_model // 2
+    freq = np.power(10000.0, -np.arange(half) / float(half))
+    enc = np.zeros((max_len, d_model), dtype=np.float32)
+    enc[:, :half] = np.sin(pos * freq)
+    enc[:, half:2 * half] = np.cos(pos * freq)
+    return enc
+
+
 @register_op('add_position_encoding')
 def _add_position_encoding(ctx, op):
     x = ctx.in1(op, 'X')  # (N, L, D)
     alpha = op.attr('alpha', 1.0)
     beta = op.attr('beta', 1.0)
     n, l, d = x.shape
-    pos = np.arange(l)[:, None]
-    half = d // 2
-    freq = np.power(10000.0, -np.arange(half) / float(half))
-    enc = np.zeros((l, d), dtype=np.float32)
-    enc[:, :half] = np.sin(pos * freq)
-    enc[:, half:2 * half] = np.cos(pos * freq)
+    enc = position_encoding_table(l, d)
     ctx.out(op, 'Out', alpha * x + beta * jnp.asarray(enc))
 
 

@@ -12,10 +12,12 @@ from .bucketing import BucketLadder
 from .batcher import (ServingError, LoadShedError, DeadlineExceededError,
                       EngineStoppedError, Request, RequestQueue)
 from .engine import ServingConfig, ServingEngine, create_engine
+from .generate import GenerateConfig, GenerateEngine, GenerateRequest
 
 __all__ = [
     'BucketLadder', 'Request', 'RequestQueue',
     'ServingError', 'LoadShedError', 'DeadlineExceededError',
     'EngineStoppedError',
     'ServingConfig', 'ServingEngine', 'create_engine',
+    'GenerateConfig', 'GenerateEngine', 'GenerateRequest',
 ]

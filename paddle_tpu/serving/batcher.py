@@ -23,6 +23,40 @@ __all__ = ['ServingError', 'LoadShedError', 'DeadlineExceededError',
            'EngineStoppedError', 'Request', 'RequestQueue']
 
 
+def resolve_metrics_port(configured):
+    """Shared ServingConfig/GenerateConfig `metrics_port` resolution: an
+    explicit config value wins; else PADDLE_METRICS_PORT (unset or
+    unparsable -> None, i.e. no endpoint)."""
+    if configured is not None:
+        return int(configured)
+    import os
+    env = os.environ.get('PADDLE_METRICS_PORT', '')
+    if env == '':
+        return None
+    try:
+        return int(env)
+    except ValueError:
+        return None
+
+
+def start_metrics_server(port, owner):
+    """Start the scrape endpoint that rides an engine's lifecycle: up
+    before the first batch, down with stop(). A bind failure must not
+    leave the engine half-started (queue open, zero workers): warn and
+    serve without the endpoint. Returns the server or None."""
+    if port is None:
+        return None
+    from .. import monitor
+    try:
+        return monitor.serve_metrics(port)
+    except Exception as e:          # noqa: BLE001 — telemetry only
+        import warnings
+        warnings.warn(
+            "%s: could not serve /metrics on port %s (%s); continuing "
+            "without the endpoint" % (owner, port, e), stacklevel=3)
+        return None
+
+
 class ServingError(RuntimeError):
     """Base class of serving-engine request failures."""
 
@@ -55,14 +89,19 @@ class Request(object):
     result()."""
 
     __slots__ = ('feed', 'n_rows', 'seq_len', 'key', 'deadline',
-                 'enqueue_t', '_event', '_result', '_error')
+                 'enqueue_t', 'return_numpy', '_event', '_result',
+                 '_error')
 
-    def __init__(self, feed, n_rows, seq_len, key, deadline):
+    def __init__(self, feed, n_rows, seq_len, key, deadline,
+                 return_numpy=True):
         self.feed = feed
         self.n_rows = n_rows
         self.seq_len = seq_len
         self.key = key
         self.deadline = deadline
+        # False keeps this request's sliced fetches device-resident —
+        # the engine only materializes numpy per request on delivery
+        self.return_numpy = return_numpy
         self.enqueue_t = time.monotonic()
         self._event = threading.Event()
         self._result = None

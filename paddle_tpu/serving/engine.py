@@ -31,7 +31,6 @@ Instrumentation (monitor.py): ``serving_request_total{outcome}``
 ``serving.execute`` spans on the monitor ring. Full catalog + tuning
 guide: docs/serving.md.
 """
-import os
 import threading
 import time
 
@@ -41,7 +40,8 @@ from .. import monitor
 from .. import resilience
 from ..inference import Predictor, PredictorConfig
 from .batcher import (ServingError, LoadShedError, DeadlineExceededError,
-                      EngineStoppedError, Request, RequestQueue)
+                      EngineStoppedError, Request, RequestQueue,
+                      resolve_metrics_port, start_metrics_server)
 from .bucketing import BucketLadder
 
 __all__ = ['ServingConfig', 'ServingEngine', 'create_engine']
@@ -147,16 +147,7 @@ class ServingEngine(object):
         return self._metrics_server.url if self._metrics_server else None
 
     def _resolve_metrics_port(self):
-        port = self.config.metrics_port
-        if port is None:
-            env = os.environ.get('PADDLE_METRICS_PORT', '')
-            if env == '':
-                return None
-            try:
-                port = int(env)
-            except ValueError:
-                return None
-        return int(port)
+        return resolve_metrics_port(self.config.metrics_port)
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -169,22 +160,12 @@ class ServingEngine(object):
                     "a stopped ServingEngine cannot restart — build a "
                     "fresh engine (the queue already failed its callers)")
             self._started = True
-            port = self._resolve_metrics_port()
-            if port is not None and self._metrics_server is None:
-                # scrape endpoint rides the engine lifecycle: up before
-                # the first batch, down with stop() — a fleet scheduler
-                # pointing Prometheus at PADDLE_METRICS_PORT sees every
-                # serving_* series without extra wiring. A bind failure
-                # must not leave the engine half-started (queue open,
-                # _started set, zero workers): warn and serve without it
-                try:
-                    self._metrics_server = monitor.serve_metrics(port)
-                except Exception as e:      # noqa: BLE001 — telemetry only
-                    import warnings
-                    warnings.warn(
-                        "ServingEngine: could not serve /metrics on port "
-                        "%s (%s); continuing without the endpoint"
-                        % (port, e), stacklevel=2)
+            if self._metrics_server is None:
+                # a fleet scheduler pointing Prometheus at
+                # PADDLE_METRICS_PORT sees every serving_* series
+                # without extra wiring
+                self._metrics_server = start_metrics_server(
+                    self._resolve_metrics_port(), 'ServingEngine')
             for i in range(self.config.num_workers):
                 t = threading.Thread(target=self._worker_loop,
                                      name='paddle-serving-%d' % i,
@@ -218,12 +199,18 @@ class ServingEngine(object):
 
     # ------------------------------------------------------------------
     # request path
-    def submit(self, feed, deadline_s=None):
+    def submit(self, feed, deadline_s=None, return_numpy=True):
         """Enqueue one request; returns the `Request` future. Raises
         synchronously for feeds the engine can never serve (KeyError for
         name mismatches — Predictor.run's contract — ValueError for
         ladder violations) and `LoadShedError` when the bounded queue is
-        full; both count into ``serving_request_total``."""
+        full; both count into ``serving_request_total``.
+
+        `return_numpy=False` delivers DEVICE-RESIDENT fetch slices (no
+        host sync) for callers that chain results into another device
+        computation; the default materializes numpy per request — and
+        only this request's rows ever cross to the host (batch padding
+        stays on device either way)."""
         names = self.predictor.get_input_names()
         missing = sorted(n for n in names if n not in feed)
         extra = sorted(k for k in feed if k not in names)
@@ -244,7 +231,8 @@ class ServingEngine(object):
             deadline_s = self.config.default_deadline_s
         deadline = (time.monotonic() + deadline_s
                     if deadline_s is not None else None)
-        req = Request(feed, n_rows, seq_len, key, deadline)
+        req = Request(feed, n_rows, seq_len, key, deadline,
+                      return_numpy=return_numpy)
         try:
             self.queue.put(req)
         except LoadShedError:
@@ -253,10 +241,12 @@ class ServingEngine(object):
         monitor.set_gauge('serving_queue_depth', self.queue.depth())
         return req
 
-    def run(self, feed, deadline_s=None, timeout=None):
+    def run(self, feed, deadline_s=None, timeout=None, return_numpy=True):
         """Blocking convenience: submit + result. Returns the fetch list
-        (numpy, rows sliced back to this request)."""
-        return self.submit(feed, deadline_s=deadline_s).result(timeout)
+        (rows sliced back to this request; numpy unless
+        return_numpy=False)."""
+        return self.submit(feed, deadline_s=deadline_s,
+                           return_numpy=return_numpy).result(timeout)
 
     # ------------------------------------------------------------------
     # warmup
@@ -320,11 +310,16 @@ class ServingEngine(object):
         via env — other threads may be training in this process).
         Transient dispatch faults retry inside the executor under the
         'run' site RetryPolicy; what escapes here is either permanent or
-        retry-exhausted and becomes a per-request error upstream."""
+        retry-exhausted and becomes a per-request error upstream.
+
+        Fetches stay DEVICE-RESIDENT (return_numpy=False): un-batching
+        slices them on device and only each request's own rows are
+        materialized at delivery (see _slice_result) — the padded batch
+        never round-trips through the host."""
         p = self.predictor
         return p.executor.run(p.program, feed=feed,
                               fetch_list=p.fetch_vars, scope=p.scope,
-                              return_numpy=True, donate=False)
+                              return_numpy=False, donate=False)
 
     def _worker_loop(self):
         poll = 0.05
@@ -371,6 +366,13 @@ class ServingEngine(object):
                 try:
                     with monitor.span('serving.execute'):
                         outs = self._execute(stacked)
+                        # fetches are device-resident now; sync here so
+                        # execute_seconds still measures device completion,
+                        # not async dispatch
+                        import jax
+                        jax.block_until_ready(
+                            [o for o in outs if not isinstance(o,
+                                                               np.ndarray)])
                 finally:
                     monitor.set_gauge('serving_inflight_batches',
                                       self._inflight(-1))
@@ -385,6 +387,18 @@ class ServingEngine(object):
                                 labels={'outcome': 'error'})
                     r.fail(e)
                 return
+        # batch-level fetches (no padded leading dim) are shared whole by
+        # every request in the batch: materialize them host-side ONCE
+        # here, not once per request in _slice_result
+        shared_bytes = 0
+        for i, o in enumerate(outs):
+            if not (getattr(o, 'ndim', 0) and
+                    getattr(o, 'shape', (None,))[0] == padded_rows) \
+                    and not isinstance(o, np.ndarray):
+                outs[i] = np.asarray(o)
+                shared_bytes += int(outs[i].nbytes)
+        if shared_bytes:
+            monitor.inc('fetch_host_bytes', shared_bytes)
         off = 0
         for r in batch:
             # per-request delivery is individually guarded: one request
@@ -409,11 +423,19 @@ class ServingEngine(object):
     def _slice_result(self, outs, off, req, padded_rows):
         """Un-batch: slice each fetch back to this request's rows, and
         un-pad sequence columns the bucket added. Fetches without the
-        batched leading dim (batch-level scalars) are returned whole."""
+        batched leading dim (batch-level scalars) are returned whole, as
+        numpy — the worker loop materialized them once for the batch.
+
+        Slicing happens on DEVICE (the executor handed us device-resident
+        fetches): padded rows and other requests' rows never cross to the
+        host. Only when the request asked for numpy (the default) are its
+        own rows materialized — previously every request pulled the whole
+        padded batch host-side per fetch."""
         out = []
+        host_bytes = 0
         for o in outs:
-            a = np.asarray(o)
-            if a.ndim and a.shape[0] == padded_rows:
+            a = o
+            if getattr(a, 'ndim', 0) and a.shape[0] == padded_rows:
                 a = a[off:off + req.n_rows]
                 if req.seq_len is not None:
                     sb = self.ladder.seq_bucket(req.seq_len)
@@ -423,7 +445,17 @@ class ServingEngine(object):
                         sl = [slice(None)] * a.ndim
                         sl[ax] = slice(0, req.seq_len)
                         a = a[tuple(sl)]
+            if req.return_numpy and not isinstance(a, np.ndarray):
+                # batch-level fetches arrive pre-materialized (worker
+                # loop, once per batch) — only this request's own sliced
+                # rows cross here
+                a = np.asarray(a)
+                host_bytes += int(a.nbytes)
             out.append(a)
+        if host_bytes:
+            # the executor no longer counts these (return_numpy=False on
+            # the batched run); the engine counts what actually crossed
+            monitor.inc('fetch_host_bytes', host_bytes)
         return out
 
 

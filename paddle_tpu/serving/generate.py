@@ -1,0 +1,609 @@
+"""Continuous-batching generative decode engine with a device-resident
+KV cache.
+
+The PR 4 `ServingEngine` batches fixed-signature SINGLE-CALL predictors:
+work is admitted at batch boundaries, so decode throughput of an
+autoregressive model is bounded by the slowest sentence in each batch.
+This module is the decode-native path:
+
+- **Persistent device-resident KV cache.** One pair of persistable
+  ``[slots, layers, heads, max_len, head_dim]`` buffers
+  (models/transformer.py ``KV_CACHE_K``/``KV_CACHE_V``) lives in the
+  engine's scope like any other executor state: the decode step reads AND
+  writes them, so the PR 1 donation path aliases each step's update in
+  place — the cache never doubles in HBM and never crosses the host.
+- **Two compiled signatures, fixed forever.** A per-prompt-bucket
+  ``prefill`` (prompt lengths pad onto ``prompt_buckets``, the
+  reader/bucketing ladder idiom) and ONE single-token ``decode step``
+  over all slots. ``warmup()`` compiles every cell through the PR 1
+  fingerprint cache; steady-state traffic of ANY prompt/output-length mix
+  re-executes exactly that set — ``recompiles_after_warmup = 0``.
+- **In-flight (continuous) batching.** New requests are admitted into
+  free cache slots at TOKEN boundaries — between decode steps — and
+  finished / deadline-expired requests are evicted per step, so a long
+  generation never holds short ones hostage. Every op in the step program
+  is slot-row-independent (ops/kv_cache_ops.py), so co-residents never
+  perturb each other's numerics: tests/test_generate.py pins exact parity
+  between concurrent and sequential execution.
+- **Streaming responses.** Each `GenerateRequest` is a future AND a token
+  stream (``for tok in req.stream()``); per-request deadlines ride the
+  PR 4 bounded `RequestQueue` (structured `LoadShedError` backpressure)
+  and are enforced both in the queue and mid-generation.
+
+Dispatch rides `Executor.bind` (PR 6): the per-token host tax is state
+staging + one compiled call, with fault injection and retry at the 'run'
+site exactly as `Executor.run` (a transient fault retries inside the
+step; an exhausted retry fails the RESIDENT requests and the engine keeps
+serving).
+
+Monitor series: ``decode_tokens_total``, ``kv_slot_occupancy``,
+``decode_step_seconds``, ``prefill_seconds``,
+``generate_request_total{outcome=ok|error|shed|deadline|rejected|stopped}``,
+``generate_queue_depth``, ``generate_step_error_total``,
+``generate_warmup_total``. Full catalog: docs/observability.md; tuning
+guide: docs/serving.md.
+"""
+import queue as _pyqueue
+import threading
+import time
+
+import numpy as np
+
+from .. import monitor
+from .. import unique_name
+from ..executor import Executor, Scope, scope_guard
+from ..framework import Program, TPUPlace, program_guard
+from ..models.transformer import (KV_CACHE_K, KV_CACHE_V, LMConfig,
+                                  build_lm_decode_step, build_lm_prefill)
+from ..reader.bucketing import bucketize
+from .batcher import (DeadlineExceededError, EngineStoppedError,
+                      LoadShedError, Request, RequestQueue,
+                      resolve_metrics_port, start_metrics_server)
+
+__all__ = ['GenerateConfig', 'GenerateEngine', 'GenerateRequest']
+
+_DONE = object()
+
+
+class GenerateConfig(object):
+    """Decode-engine knobs.
+
+    - model: an `LMConfig` (decode programs share parameter names with
+      `build_lm`, so a scope trained for the LM serves directly).
+    - slots: KV-cache width — the max number of in-flight sequences.
+    - max_len: cache length per slot; prompt + generated tokens beyond it
+      end the request with finish_reason='cache_full'.
+    - prompt_buckets: ascending prompt-length ladder; one prefill program
+      compiles per bucket. Default: powers of two from 16 up to max_len/2.
+    - eos_id: token ending a sequence (None = length-bounded only).
+    - max_new_tokens: per-request generation cap when submit() gives none.
+    - queue_cap / default_deadline_s: PR 4 bounded-queue semantics.
+    - seed: parameter-init seed (two engines built with equal seeds hold
+      identical weights — the parity-test contract).
+    - metrics_port: as ServingConfig.metrics_port (None falls back to
+      PADDLE_METRICS_PORT; the endpoint rides start()/stop()).
+    """
+
+    def __init__(self, model=None, slots=8, max_len=256,
+                 prompt_buckets=None, eos_id=None, max_new_tokens=64,
+                 pad_id=0, queue_cap=256, default_deadline_s=60.0,
+                 seed=0, metrics_port=None, idle_poll_s=0.02):
+        self.model = model or LMConfig()
+        self.slots = int(slots)
+        self.max_len = int(max_len)
+        if self.slots < 1:
+            raise ValueError("slots must be >= 1")
+        if self.max_len < 2:
+            raise ValueError("max_len must be >= 2")
+        if prompt_buckets is None:
+            prompt_buckets, b = [], 16
+            while b <= self.max_len // 2:
+                prompt_buckets.append(b)
+                b *= 2
+            if not prompt_buckets:
+                prompt_buckets = [self.max_len // 2 or 1]
+        self.prompt_buckets = sorted(set(int(b) for b in prompt_buckets))
+        if not self.prompt_buckets:
+            raise ValueError("prompt_buckets must not be empty")
+        if self.prompt_buckets[0] < 1 or \
+                self.prompt_buckets[-1] > self.max_len:
+            raise ValueError(
+                "prompt_buckets %r must lie in [1, max_len=%d]"
+                % (prompt_buckets, self.max_len))
+        self.eos_id = eos_id
+        self.max_new_tokens = int(max_new_tokens)
+        self.pad_id = int(pad_id)
+        self.queue_cap = int(queue_cap)
+        self.default_deadline_s = default_deadline_s
+        self.seed = int(seed)
+        self.metrics_port = metrics_port
+        self.idle_poll_s = float(idle_poll_s)
+
+
+class GenerateRequest(Request):
+    """One prompt in flight: the PR 4 future contract (`result()`,
+    `fail()`, deadline) plus a per-token stream. `result()` returns the
+    full generated-token list; ``for tok in req.stream()`` consumes
+    tokens as decode steps deliver them. `finish_reason` is
+    'eos' | 'length' | 'cache_full' after a normal finish."""
+
+    __slots__ = ('prompt', 'max_new_tokens', 'tokens', 'finish_reason',
+                 '_stream_q')
+
+    def __init__(self, prompt, seq_len, bucket, deadline, max_new_tokens):
+        Request.__init__(self, {'prompt': prompt}, 1, seq_len, bucket,
+                         deadline)
+        self.prompt = prompt
+        self.max_new_tokens = max_new_tokens
+        self.tokens = []
+        self.finish_reason = None
+        self._stream_q = _pyqueue.Queue()
+
+    # engine-side delivery ------------------------------------------------
+    def _emit(self, tok):
+        self.tokens.append(tok)
+        self._stream_q.put(tok)
+
+    def _finish(self, reason):
+        self.finish_reason = reason
+        Request.done(self, list(self.tokens))
+        self._stream_q.put(_DONE)
+
+    def fail(self, error):
+        Request.fail(self, error)
+        self._stream_q.put(_DONE)
+
+    # consumer side -------------------------------------------------------
+    def stream(self, timeout=None):
+        """Yield generated tokens as they arrive; on a failed request the
+        error raises AFTER the tokens already delivered. `timeout` bounds
+        the wait for EACH token; with no explicit timeout the request's
+        own deadline (+1s grace) bounds every wait instead — a consumer
+        must never hang past its deadline, even on an engine that was
+        never started (the result() contract)."""
+        while True:
+            t = timeout
+            if t is None and self.deadline is not None:
+                t = max(0.0, self.deadline - time.monotonic()) + 1.0
+            try:
+                item = self._stream_q.get(timeout=t)
+            except _pyqueue.Empty:
+                raise DeadlineExceededError(
+                    "no token within %.3fs" % (t or 0.0))
+            if item is _DONE:
+                if self._error is not None:
+                    raise self._error
+                return
+            yield item
+
+
+class _Slot(object):
+    __slots__ = ('req', 'pos', 'generated', 'last')
+
+    def __init__(self, req, pos, last):
+        self.req = req
+        self.pos = pos          # cache position the NEXT step writes
+        self.generated = 1      # prefill already emitted the first token
+        self.last = last        # last generated token (next step's input)
+
+
+class GenerateEngine(object):
+    """In-process continuous-batching decode engine. ::
+
+        cfg = fluid.serving.GenerateConfig(
+            model=LMConfig(...), slots=8, max_len=256, eos_id=1)
+        engine = fluid.serving.GenerateEngine(cfg)
+        engine.warmup()                      # compiles every signature
+        with engine:                         # start()/stop()
+            req = engine.submit(prompt_ids, max_new_tokens=32)
+            for tok in req.stream():         # streams per decode step
+                ...
+            full = engine.submit(p2).result()
+
+    Pass ``scope=`` to serve already-trained parameters (names match
+    build_lm); otherwise the engine initializes fresh parameters from
+    ``config.seed``.
+    """
+
+    def __init__(self, config=None, scope=None):
+        self.config = config or GenerateConfig()
+        self.scope = scope if scope is not None else Scope()
+        self.executor = Executor(TPUPlace(0))
+        self._build_programs()
+        self._init_state()
+        self.queue = RequestQueue(self.config.queue_cap)
+        self._slots = [None] * self.config.slots
+        self._free = list(range(self.config.slots))[::-1]
+        self._prefill_bound = {}
+        self._step_bound = None
+        self._thread = None
+        self._started = False
+        self._stop_evt = threading.Event()
+        self._lock = threading.Lock()
+        self._metrics_server = None
+        self._decode_steps = 0
+        self._decode_tokens = 0
+        self._occ_sum = 0.0
+        self._occ_peak = 0.0
+        monitor.set_gauge('kv_slot_occupancy', 0.0)
+        monitor.set_gauge('generate_queue_depth', 0.0)
+
+    # ------------------------------------------------------------------
+    # build + state
+    def _build_programs(self):
+        cfg, c = self.config.model, self.config
+        self._step_prog, self._startup = Program(), Program()
+        self._startup.random_seed = c.seed
+        self._step_prog.random_seed = c.seed
+        with program_guard(self._step_prog, self._startup):
+            with unique_name.guard():
+                self._step_vars = build_lm_decode_step(cfg, c.slots,
+                                                       c.max_len)
+        self._prefill = {}
+        for b in c.prompt_buckets:
+            main, start = Program(), Program()
+            main.random_seed = c.seed
+            with program_guard(main, start):
+                with unique_name.guard():
+                    v = build_lm_prefill(cfg, b, c.slots, c.max_len)
+            self._prefill[b] = (main, v)
+
+    def _init_state(self):
+        import jax.numpy as jnp
+        cfg, c = self.config.model, self.config
+        with scope_guard(self.scope):
+            if not self.scope.has('tok_emb.w'):
+                # fresh engine: init params from config.seed; a provided
+                # scope with trained weights skips this entirely
+                self.executor.run(self._startup, scope=self.scope)
+        if not self.scope.has(KV_CACHE_K):
+            dh = cfg.d_model // cfg.n_head
+            shape = (c.slots, cfg.n_layer, cfg.n_head, c.max_len, dh)
+            self.scope.set(KV_CACHE_K, jnp.zeros(shape, 'float32'))
+            self.scope.set(KV_CACHE_V, jnp.zeros(shape, 'float32'))
+
+    # ------------------------------------------------------------------
+    # warmup
+    def warmup(self):
+        """Bind + compile every signature the engine will ever dispatch:
+        one prefill per prompt bucket and the decode step. Returns
+        {'buckets', 'compiles', 'seconds'}; `compiles` is the
+        compile_cache_miss delta — 0 when a structurally identical engine
+        already warmed the process-wide fingerprint cache."""
+        if self._started:
+            # bind() EXECUTES each program once: re-warming a live engine
+            # would zero cache rows of resident slots mid-generation
+            raise RuntimeError(
+                "warmup() executes the decode programs against the live "
+                "KV cache and must not race the started engine loop — "
+                "warm up before start() (start() warms up automatically)")
+        t0 = time.perf_counter()
+        before = monitor.counters()
+        S = self.config.slots
+        with monitor.span('generate.warmup'):
+            for b, (prog, v) in sorted(self._prefill.items()):
+                feed = {'gen_prompt': np.zeros((1, b), 'int64'),
+                        'gen_slot': np.zeros((1, 1), 'int64'),
+                        'gen_len': np.ones((1, 1), 'int64')}
+                self._prefill_bound[b] = self.executor.bind(
+                    prog, feed, fetch_list=[v['first_token']],
+                    scope=self.scope)
+            feed = {'gen_tokens': np.zeros((S, 1), 'int64'),
+                    'gen_pos': np.zeros((S, 1), 'int64')}
+            self._step_bound = self.executor.bind(
+                self._step_prog, feed,
+                fetch_list=[self._step_vars['next_tokens']],
+                scope=self.scope)
+        delta = monitor.counter_delta(before)
+        compiles = sum(v for k, v in delta.items()
+                       if k.startswith('compile_cache_miss'))
+        monitor.inc('generate_warmup_total')
+        return {'buckets': len(self._prefill_bound),
+                'compiles': int(compiles),
+                'seconds': round(time.perf_counter() - t0, 3)}
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    def start(self):
+        with self._lock:
+            if self._started:
+                return self
+            if self.queue.closed:
+                raise EngineStoppedError(
+                    "a stopped GenerateEngine cannot restart — build a "
+                    "fresh engine (the queue already failed its callers)")
+            if self._step_bound is None:
+                self.warmup()
+            self._started = True
+            if self._metrics_server is None:
+                self._metrics_server = start_metrics_server(
+                    self._resolve_metrics_port(), 'GenerateEngine')
+            self._stop_evt.clear()
+            self._thread = threading.Thread(target=self._loop,
+                                            name='paddle-generate',
+                                            daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self, timeout_s=10.0):
+        """Close the queue (queued requests fail with EngineStoppedError),
+        fail resident generations, join the decode loop."""
+        with self._lock:
+            self._started = False
+        self._stop_evt.set()
+        drained = self.queue.close()
+        if drained:
+            monitor.inc('generate_request_total', drained,
+                        labels={'outcome': 'stopped'})
+        if self._thread is not None:
+            self._thread.join(timeout_s)
+            self._thread = None
+        if self._metrics_server is not None:
+            self._metrics_server.close()
+            self._metrics_server = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def _resolve_metrics_port(self):
+        return resolve_metrics_port(self.config.metrics_port)
+
+    @property
+    def metrics_port(self):
+        return self._metrics_server.port if self._metrics_server else None
+
+    # ------------------------------------------------------------------
+    # request path
+    def submit(self, prompt, max_new_tokens=None, deadline_s=None):
+        """Enqueue one prompt (1-D int token ids); returns the
+        `GenerateRequest` stream/future. Raises ValueError synchronously
+        for prompts the ladder cannot serve and `LoadShedError` when the
+        bounded queue is full."""
+        prompt = np.asarray(prompt, dtype='int64').reshape(-1)
+        buckets = self.config.prompt_buckets
+        if prompt.size < 1 or prompt.size > buckets[-1]:
+            monitor.inc('generate_request_total',
+                        labels={'outcome': 'rejected'})
+            raise ValueError(
+                "prompt length %d outside [1, %d] (largest prompt "
+                "bucket) — trim the prompt or widen prompt_buckets"
+                % (prompt.size, buckets[-1]))
+        if max_new_tokens is None:
+            max_new_tokens = self.config.max_new_tokens
+        if int(max_new_tokens) < 1:
+            monitor.inc('generate_request_total',
+                        labels={'outcome': 'rejected'})
+            raise ValueError("max_new_tokens must be >= 1")
+        if deadline_s is None:
+            deadline_s = self.config.default_deadline_s
+        deadline = (time.monotonic() + deadline_s
+                    if deadline_s is not None else None)
+        req = GenerateRequest(prompt, prompt.size,
+                              bucketize(prompt.size, buckets), deadline,
+                              int(max_new_tokens))
+        try:
+            self.queue.put(req)
+        except LoadShedError:
+            monitor.inc('generate_request_total',
+                        labels={'outcome': 'shed'})
+            raise
+        monitor.set_gauge('generate_queue_depth', self.queue.depth())
+        return req
+
+    def generate(self, prompt, max_new_tokens=None, deadline_s=None,
+                 timeout=None):
+        """Blocking convenience: submit + result (the generated tokens)."""
+        return self.submit(prompt, max_new_tokens=max_new_tokens,
+                           deadline_s=deadline_s).result(timeout)
+
+    def generate_once(self, prompt, max_new_tokens=None):
+        """Synchronous single-prompt greedy decode on slot 0, driving the
+        SAME compiled prefill/step programs step by step — the sequential
+        reference the parity tests compare the continuous batcher
+        against, and a zero-thread debug path. Only valid while the
+        engine is NOT started (it shares the loop's cache slots)."""
+        if self._started:
+            raise RuntimeError(
+                "generate_once drives the decode programs inline and "
+                "must not race the started engine loop — use submit()")
+        if self._step_bound is None:
+            self.warmup()
+        prompt = np.asarray(prompt, dtype='int64').reshape(-1)
+        if max_new_tokens is None:
+            max_new_tokens = self.config.max_new_tokens
+        c = self.config
+        first = self._run_prefill(0, prompt)
+        tokens, last, pos = [first], first, prompt.size
+        while (len(tokens) < max_new_tokens and pos < c.max_len and
+               (c.eos_id is None or last != c.eos_id)):
+            S = c.slots
+            toks = np.zeros((S, 1), 'int64')
+            posf = np.zeros((S, 1), 'int64')
+            toks[0], posf[0] = last, pos
+            out = self._step_bound({'gen_tokens': toks, 'gen_pos': posf})
+            last = int(np.asarray(out[0]).reshape(-1)[0])
+            tokens.append(last)
+            pos += 1
+        return tokens
+
+    # ------------------------------------------------------------------
+    # decode loop
+    def _loop(self):
+        poll = self.config.idle_poll_s
+        while not self._stop_evt.is_set():
+            self._evict_expired()
+            self._admit()
+            if not any(s is not None for s in self._slots):
+                # idle: block briefly for new work instead of spinning
+                batch, expired = self.queue.take_batch(1, 0.0,
+                                                       poll_s=poll)
+                self._fail_expired(expired)
+                if batch:
+                    self._admit_one(batch[0])
+                monitor.set_gauge('generate_queue_depth',
+                                  self.queue.depth())
+                continue
+            self._step()
+        # shutdown: a resident generation must not leave its caller
+        # blocked forever
+        for i, st in enumerate(self._slots):
+            if st is not None:
+                self._release(i)
+                monitor.inc('generate_request_total',
+                            labels={'outcome': 'stopped'})
+                st.req.fail(EngineStoppedError(
+                    "engine stopped after %d generated tokens"
+                    % st.generated))
+        self._set_occupancy()
+
+    def _admit(self):
+        while self._free and not self._stop_evt.is_set():
+            batch, expired = self.queue.take_batch(1, 0.0, poll_s=0.0)
+            self._fail_expired(expired)
+            if not batch:
+                return
+            self._admit_one(batch[0])
+            monitor.set_gauge('generate_queue_depth', self.queue.depth())
+
+    def _admit_one(self, req):
+        slot = self._free.pop()
+        t0 = time.perf_counter()
+        try:
+            first = self._run_prefill(slot, req.prompt)
+        except Exception as e:  # noqa: BLE001 — delivered per-request
+            self._free.append(slot)
+            monitor.inc('generate_request_total',
+                        labels={'outcome': 'error'})
+            req.fail(e)
+            return
+        monitor.observe('prefill_seconds', time.perf_counter() - t0)
+        monitor.inc('decode_tokens_total')
+        self._decode_tokens += 1
+        req._emit(first)
+        st = _Slot(req, pos=req.prompt.size, last=first)
+        reason = self._finish_reason(st)
+        if reason:
+            self._free.append(slot)
+            monitor.inc('generate_request_total',
+                        labels={'outcome': 'ok'})
+            req._finish(reason)
+        else:
+            self._slots[slot] = st
+        self._set_occupancy()
+
+    def _run_prefill(self, slot, prompt):
+        b = bucketize(prompt.size, self.config.prompt_buckets)
+        padded = np.full((1, b), self.config.pad_id, 'int64')
+        padded[0, :prompt.size] = prompt
+        out = self._prefill_bound[b]({
+            'gen_prompt': padded,
+            'gen_slot': np.array([[slot]], 'int64'),
+            'gen_len': np.array([[prompt.size]], 'int64')})
+        return int(np.asarray(out[0]).reshape(-1)[0])
+
+    def _step(self):
+        S = self.config.slots
+        toks = np.zeros((S, 1), 'int64')
+        pos = np.zeros((S, 1), 'int64')
+        active = []
+        for i, st in enumerate(self._slots):
+            if st is None:
+                continue
+            toks[i], pos[i] = st.last, st.pos
+            active.append((i, st))
+        t0 = time.perf_counter()
+        try:
+            out = self._step_bound({'gen_tokens': toks, 'gen_pos': pos})
+        except Exception as e:  # noqa: BLE001 — delivered per-request
+            # an exhausted retry (or permanent fault) fails the RESIDENT
+            # requests; the loop and the engine live on — the decode
+            # analog of the PR 4 "pool never dies" contract
+            monitor.inc('generate_step_error_total')
+            for i, st in active:
+                self._release(i)
+                monitor.inc('generate_request_total',
+                            labels={'outcome': 'error'})
+                st.req.fail(e)
+            self._set_occupancy()
+            return
+        monitor.observe('decode_step_seconds', time.perf_counter() - t0)
+        nxt = np.asarray(out[0]).reshape(-1)
+        n = len(active)
+        self._decode_steps += 1
+        self._decode_tokens += n
+        self._occ_sum += n / float(S)
+        monitor.inc('decode_tokens_total', n)
+        for i, st in active:
+            st.pos += 1
+            st.generated += 1
+            st.last = int(nxt[i])
+            st.req._emit(st.last)
+            reason = self._finish_reason(st)
+            if reason:
+                self._release(i)
+                monitor.inc('generate_request_total',
+                            labels={'outcome': 'ok'})
+                st.req._finish(reason)
+        self._set_occupancy()
+
+    def _finish_reason(self, st):
+        c = self.config
+        if c.eos_id is not None and st.last == c.eos_id:
+            return 'eos'
+        if st.generated >= st.req.max_new_tokens:
+            return 'length'
+        if st.pos >= c.max_len:
+            # the cache has no row left for this token's K/V — stepping
+            # further would attend past the buffer
+            return 'cache_full'
+        return None
+
+    def _evict_expired(self):
+        now = time.monotonic()
+        for i, st in enumerate(self._slots):
+            if st is not None and st.req.expired(now):
+                self._release(i)
+                monitor.inc('generate_request_total',
+                            labels={'outcome': 'deadline'})
+                st.req.fail(DeadlineExceededError(
+                    "deadline passed mid-generation after %d tokens"
+                    % st.generated))
+        self._set_occupancy()
+
+    def _fail_expired(self, expired):
+        now = time.monotonic()
+        for r in expired:
+            monitor.inc('generate_request_total',
+                        labels={'outcome': 'deadline'})
+            r.fail(DeadlineExceededError(
+                "deadline passed after %.3fs in queue"
+                % (now - r.enqueue_t)))
+
+    def _release(self, i):
+        self._slots[i] = None
+        self._free.append(i)
+
+    def _set_occupancy(self):
+        occ = sum(1 for s in self._slots if s is not None) \
+            / float(len(self._slots))
+        self._occ_peak = max(self._occ_peak, occ)
+        monitor.set_gauge('kv_slot_occupancy', occ)
+
+    # ------------------------------------------------------------------
+    def stats(self):
+        """Decode-loop statistics since construction."""
+        steps = self._decode_steps
+        return {
+            'slots': self.config.slots,
+            'active': sum(1 for s in self._slots if s is not None),
+            'queue_depth': self.queue.depth(),
+            'decode_steps': steps,
+            'decode_tokens': self._decode_tokens,
+            'peak_slot_occupancy': round(self._occ_peak, 4),
+            'mean_slot_occupancy': round(self._occ_sum / steps, 4)
+            if steps else 0.0,
+        }

@@ -20,6 +20,33 @@ import jax
 jax.config.update('jax_platforms', 'cpu')
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        'markers',
+        "slow: heavy measurement tests excluded from tier-1 "
+        "(-m 'not slow'); the nightly/full run includes them")
+
+
+def pytest_collection_modifyitems(config, items):
+    # decode-engine marker split (ISSUE 6 CI satellite): whenever the
+    # generate suite is collected AS A WHOLE, its heavy throughput
+    # measurement must be @slow AND at least one fast smoke variant must
+    # remain unmarked, so tier-1 keeps coverage without the
+    # re-traced-baseline compiles. Node-id selection collects a subset
+    # by design — the split is unobservable there, don't assert on it.
+    if any('::' in a for a in config.args):
+        return
+    gen = [it for it in items
+           if os.path.basename(str(it.fspath)) == 'test_generate.py']
+    if gen:
+        slow = [it for it in gen if it.get_closest_marker('slow')]
+        fast = [it for it in gen if not it.get_closest_marker('slow')]
+        assert slow, ('test_generate.py lost its @slow-marked heavy '
+                      'measurement test')
+        assert fast, ('test_generate.py lost its fast tier-1 smoke '
+                      'variants')
+
+
 @pytest.fixture(autouse=True)
 def _fresh_programs():
     """Each test gets fresh default programs + scope + name generator,
