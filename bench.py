@@ -752,6 +752,18 @@ def _child(mode):
     except Exception as e:
         generate = {'error': '%s: %s' % (type(e).__name__, str(e)[:200])}
 
+    # async-pipeline row: overlapped input pipeline (DevicePrefetcher ->
+    # run_async, bounded in-flight window) vs the synchronous step loop
+    # on an input-bound workload (tools/pipebench.py; contract: >=1.3x
+    # steps/sec at recompiles_after_warmup=0 with exact trajectory
+    # parity)
+    try:
+        from tools.pipebench import measure_pipeline
+        async_pipeline = measure_pipeline(rounds=2 if on_tpu else 3)
+    except Exception as e:
+        async_pipeline = {'error': '%s: %s' % (type(e).__name__,
+                                               str(e)[:200])}
+
     # XLA cost/memory analytics smoke (tools/costreport.py — the
     # Executor.explain CLI): flops + buffer-assignment peak for the
     # mnist-mlp reference programs. Memory stats cost one extra XLA
@@ -857,6 +869,7 @@ def _child(mode):
         'run_overhead': run_overhead,
         'serving': serving,
         'generate': generate,
+        'async_pipeline': async_pipeline,
         'costreport': costreport,
         'flops': flag.get('flops'),
         'peak_bytes': flag.get('peak_bytes'),

@@ -31,7 +31,8 @@ from .framework import (Program, Block, Operator, Variable, Parameter,
                         default_main_program, default_startup_program,
                         program_guard, CPUPlace, TPUPlace, CUDAPlace,
                         cpu_places, tpu_places, cuda_places)
-from .executor import Executor, Scope, global_scope, scope_guard
+from .executor import (Executor, Scope, StepFuture, global_scope,
+                       scope_guard)
 from .backward import append_backward, calc_gradient, gradients
 from . import layers
 from . import initializer
@@ -51,6 +52,8 @@ from . import lod_tensor
 from .lod_tensor import (LoDTensor, create_lod_tensor,
                          create_random_int_lodtensor)
 from . import reader
+from . import pipeline
+from .pipeline import DataLoader, train_loop
 from . import dataset
 from . import models
 from . import transpiler

@@ -19,6 +19,7 @@ MultiSlotDataFeed::ParseOneInstance): each line is one sample; for every
 slot in order: <n> <v_1> ... <v_n>, uint64 slots ragged (fed with LoD),
 float dense slots fixed-width.
 """
+import collections
 import queue
 import threading
 
@@ -283,6 +284,32 @@ class AsyncExecutor(object):
             t.start()
 
         results = []
+        pending = collections.deque()
+
+        def _harvest(all_steps=False):
+            # materialize completed steps eagerly (futures finish in
+            # submission order): fetches never accrue device-side past
+            # the in-flight window on a long filelist, and a failed step
+            # raises HERE — fetch_list or not, exactly like the old
+            # synchronous loop (result() on a fetch-less step returns []
+            # but still surfaces its error)
+            try:
+                while pending and (all_steps or pending[0].done()):
+                    out = pending.popleft().result()
+                    if fetch_list:
+                        results.append(out)
+                        if debug:
+                            print("AsyncExecutor step %d: %s"
+                                  % (len(results),
+                                     [np.asarray(o).reshape(-1)[:1]
+                                      for o in out]))
+            except BaseException:
+                # don't leave in-flight futures pinning device fetches
+                # behind the raise — a caller that catches and lives on
+                # (the pool-never-dies idiom) must not leak the window
+                self.executor.drain_async()
+                raise
+
         alive = lambda: any(t.is_alive() for t in threads)
         done = False
         while True:
@@ -290,6 +317,7 @@ class AsyncExecutor(object):
                 feed = batches.get(timeout=0.05)
             except queue.Empty:
                 if errors:
+                    self.executor.drain_async()
                     raise errors[0]
                 if done:
                     break
@@ -297,15 +325,18 @@ class AsyncExecutor(object):
                     # parsers finished; drain anything enqueued between
                     # the timeout and the liveness check before exiting
                     done = True
+                _harvest()
                 continue
-            out = self.executor.run(program, feed=feed,
-                                    fetch_list=fetch_list, scope=scope)
-            if fetch_list:
-                results.append(out)
-                if debug:
-                    print("AsyncExecutor step %d: %s"
-                          % (len(results), [np.asarray(o).reshape(-1)[:1]
-                                            for o in out]))
+            # async dispatch: the parser pool assembles the NEXT batches
+            # while the device computes this step — the reference's
+            # many-threads-per-AsyncExecutor overlap, natively, with the
+            # executor's bounded in-flight window capping pending steps
+            pending.append(self.executor.run_async(program, feed=feed,
+                                                   fetch_list=fetch_list,
+                                                   scope=scope))
+            _harvest()
+        self.executor.drain_async()
         if errors:
             raise errors[0]
+        _harvest(all_steps=True)
         return results

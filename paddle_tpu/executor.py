@@ -43,8 +43,8 @@ from .core.lod import normalize_lod
 from .core.registry import get_op, has_op
 from .core.types import convert_np_dtype_to_dtype_
 
-__all__ = ['Executor', 'Scope', 'BoundProgram', 'global_scope',
-           'scope_guard']
+__all__ = ['Executor', 'Scope', 'BoundProgram', 'StepFuture',
+           'global_scope', 'scope_guard']
 
 
 class _TensorShim(object):
@@ -228,6 +228,15 @@ def _donation_enabled(fused=False, override=None, record=True):
     if os.environ.get('PADDLE_OPTEST_COLLECT_DIR'):
         _count('donation_fallback_total',
                labels={'reason': 'optest_collect'})
+        return False
+    if isinstance(override, str):
+        # a named forced fallback — run_async passes 'inflight' when the
+        # resolved default WOULD have donated: under overlapped execution
+        # a donated buffer could still be referenced by an earlier
+        # in-flight step's un-materialized results, so donation is forced
+        # off and the reason recorded (the pay-for-overlap HBM tradeoff,
+        # docs/executor_performance.md)
+        _count('donation_fallback_total', labels={'reason': override})
         return False
     if override is not None:
         if override:
@@ -474,6 +483,18 @@ def _fetched(arr, lod):
     return out
 
 
+class _DeferredFetch(object):
+    """A LoD-carrying fetch whose `_fetched` wrap is postponed to
+    `StepFuture` materialization: wrapping at dispatch time would
+    np.asarray — and so block on — the still-running async step."""
+
+    __slots__ = ('arr', 'lod')
+
+    def __init__(self, arr, lod):
+        self.arr = arr
+        self.lod = lod
+
+
 class BoundProgram(object):
     """A fixed-signature dispatch handle from `Executor.bind`: per-call
     work is state staging from the scope, one fault-site check, the
@@ -548,6 +569,102 @@ class BoundProgram(object):
         return list(fetches)
 
 
+class StepFuture(object):
+    """Handle to one `Executor.run_async` step: device-resident fetches
+    plus lazy host materialization.
+
+    JAX dispatch is asynchronous, so the submitting call returns as soon
+    as the step is staged; the device computes in the background while
+    the host stages the next batch. ``result()`` blocks until the step
+    completed and returns the fetch list (numpy by default;
+    ``return_numpy=False`` keeps the fetches device-resident).
+    ``wait()`` blocks without materializing. Any error — an injected
+    run-site fault, a retry-exhausted dispatch, an async XLA runtime
+    failure — surfaces HERE, on the future, never on the submitting
+    ``run_async`` call.
+
+    Futures complete in submission order (one device stream); waiting on
+    a later future implies every earlier one finished."""
+
+    __slots__ = ('_exe', '_outs', '_error', '_sync', '_done')
+
+    def __init__(self, exe, outs, sync=None, error=None):
+        self._exe = exe
+        self._outs = outs
+        self._error = error
+        self._sync = sync if sync is not None else outs
+        self._done = error is not None
+
+    def _ready_nonblock(self):
+        if self._done:
+            return True
+        try:
+            for leaf in jax.tree_util.tree_leaves(self._sync):
+                ready = getattr(leaf, 'is_ready', None)
+                if ready is not None and not ready():
+                    return False
+            return True
+        except Exception:
+            return False
+
+    def done(self):
+        """Non-blocking: has the step's device work completed (or
+        failed)?"""
+        return self._ready_nonblock()
+
+    def wait(self):
+        """Block until the step's device work completed; idempotent.
+        Releases this future's slot in the executor's in-flight window.
+        Returns self (so ``fut.wait().result()`` chains)."""
+        if not self._done:
+            if self._error is None:
+                try:
+                    jax.block_until_ready(self._sync)
+                except Exception as e:  # noqa: BLE001 — surfaced in result
+                    # async runtime failure: deliver on result(), exactly
+                    # like a dispatch-time fault
+                    self._error = e
+            self._done = True
+            self._exe._inflight_discard(self)
+        return self
+
+    def result(self, return_numpy=True):
+        """The step's fetch list. Blocks until complete; raises the
+        step's error if it failed. ``return_numpy=True`` materializes
+        host-side (counted into ``fetch_host_bytes``, like ``run``);
+        ``return_numpy=False`` returns the device arrays."""
+        self.wait()
+        if self._error is not None:
+            raise self._error
+        if not return_numpy:
+            # mirror run(return_numpy=False): device arrays, except
+            # lod-carrying results whose FetchedTensor wrap (deferred at
+            # dispatch) is the point of asking for them
+            return [_fetched(f.arr, f.lod) if isinstance(f, _DeferredFetch)
+                    else f for f in self._outs]
+        out, host_bytes = [], 0
+        for f in self._outs:
+            if isinstance(f, _DeferredFetch):
+                a = _fetched(f.arr, f.lod)
+                host_bytes += int(a.nbytes)
+                out.append(a)
+            elif isinstance(f, np.ndarray):
+                out.append(f)
+            else:
+                a = np.asarray(f)
+                host_bytes += int(a.nbytes)
+                out.append(a)
+        if host_bytes:
+            monitor.inc('fetch_host_bytes', host_bytes)
+        return out
+
+    def exception(self):
+        """Block until complete; return the step's error (None on
+        success) instead of raising it."""
+        self.wait()
+        return self._error
+
+
 class _FeedSpec(object):
     """Shape/dtype stand-in for a staged run_fused batch — enough for
     _feed_signature (np.shape reads .shape, _dtype reads .dtype) without
@@ -571,12 +688,74 @@ class Executor(object):
         self.place = place if place is not None else TPUPlace(0)
         self._cache = _LRUCache()
         self._run_counter = 0
+        # run_async bookkeeping: the sliding window of dispatched-but-not-
+        # known-complete StepFutures (bounded by PADDLE_MAX_INFLIGHT_STEPS)
+        self._inflight = collections.deque()
+        self._async_cv = threading.Condition(threading.Lock())
+        self._pending_submit = 0        # reserved-but-not-yet-appended
+        self._inflight_peak = 0
 
     def close(self):
+        # flush any in-flight async steps first — their device work may
+        # still reference compiled entries
+        self.drain_async()
         # drops this executor's view only; the process-wide fingerprint
         # cache keeps entries alive for other executors (it is LRU-bounded,
         # so close() is no longer load-bearing for memory)
         self._cache.clear()
+
+    @staticmethod
+    def _py_reader_feed(program, feed):
+        """Started py_readers supply their variables when not explicitly
+        fed (reference create_py_reader_op pulling the blocking queue) —
+        shared by run() and run_async() so the two paths cannot
+        diverge."""
+        src_prog = getattr(program, '_program', program)  # CompiledProgram
+        for rd in getattr(src_prog, '_py_readers', []):
+            if rd._thread is not None and not any(
+                    v.name in (feed or {}) for v in rd._vars):
+                feed = dict(feed or {})
+                feed.update(rd._next_feed())
+        return feed
+
+    # ------------------------------------------------------------------
+    # async pipeline bookkeeping
+    @staticmethod
+    def _max_inflight():
+        """Window size for run_async: how many dispatched steps may be
+        pending at once. 2 (the double-buffer classic) overlaps step
+        N+1's host staging with step N's device compute while bounding
+        extra HBM to one step's working set."""
+        try:
+            return max(1, int(os.environ.get('PADDLE_MAX_INFLIGHT_STEPS',
+                                             '') or 2))
+        except ValueError:
+            return 2
+
+    def _inflight_discard(self, fut):
+        with self._async_cv:
+            try:
+                self._inflight.remove(fut)
+            except ValueError:
+                return
+            # gauge published under the lock: a descheduled writer must
+            # not overwrite a newer depth with its stale value
+            monitor.set_gauge('executor_inflight',
+                              float(len(self._inflight)))
+            self._async_cv.notify_all()
+
+    def drain_async(self):
+        """Wait for every in-flight `run_async` step (oldest first);
+        returns how many were waited on. Errors stay on their futures —
+        draining never raises."""
+        n = 0
+        while True:
+            with self._async_cv:
+                if not self._inflight:
+                    return n
+                fut = self._inflight[0]
+            fut.wait()
+            n += 1
 
     # ------------------------------------------------------------------
     def _cache_get(self, key):
@@ -638,13 +817,24 @@ class Executor(object):
             arr = value if isinstance(value, jax.Array) else np.asarray(value)
             if var is not None and var.dtype is not None and \
                     arr.dtype != var.dtype:
+                tgt = np.dtype(var.dtype)
+                if isinstance(arr, jax.Array):
+                    # device-resident feed (a prefetcher-staged batch):
+                    # x64-disabled jax already narrowed 64-bit dtypes at
+                    # device_put, so coerce toward what the device can
+                    # actually hold — an astype back to int64 would be a
+                    # no-op that warns on every run
+                    from jax import dtypes as _jax_dtypes
+                    tgt = np.dtype(_jax_dtypes.canonicalize_dtype(tgt))
                 # feeding python lists of ints to a float var etc.
-                if arr.dtype.kind in 'iub' and np.dtype(var.dtype).kind in 'iub':
-                    arr = arr.astype(var.dtype)
-                elif arr.dtype.kind == 'f' and np.dtype(var.dtype).kind == 'f':
-                    arr = arr.astype(var.dtype)
+                if arr.dtype == tgt:
+                    pass
+                elif arr.dtype.kind in 'iub' and tgt.kind in 'iub':
+                    arr = arr.astype(tgt)
+                elif arr.dtype.kind == 'f' and tgt.kind == 'f':
+                    arr = arr.astype(tgt)
                 elif arr.dtype == np.float64:
-                    arr = arr.astype(var.dtype)
+                    arr = arr.astype(tgt)
             out[name] = arr
             if not isinstance(arr, jax.Array):
                 # host-staged feed bytes (device jax.Array feeds pass
@@ -712,14 +902,7 @@ class Executor(object):
         PADDLE_DONATE env var under other threads' runs."""
         if program is None:
             program = default_main_program()
-        # started py_readers supply their variables when not explicitly fed
-        # (reference create_py_reader_op pulling the blocking queue)
-        src_prog = getattr(program, '_program', program)  # CompiledProgram
-        for rd in getattr(src_prog, '_py_readers', []):
-            if rd._thread is not None and not any(
-                    v.name in (feed or {}) for v in rd._vars):
-                feed = dict(feed or {})
-                feed.update(rd._next_feed())
+        feed = self._py_reader_feed(program, feed)
         # CompiledProgram support is injected by compiler.py via duck-typing:
         if hasattr(program, '_executor_run'):
             return program._executor_run(self, feed, fetch_list, scope,
@@ -739,8 +922,114 @@ class Executor(object):
             return self._run_impl(program, feed, fetch_list, scope,
                                   return_numpy, use_program_cache, donate)
 
+    # ------------------------------------------------------------------
+    def run_async(self, program=None, feed=None, fetch_list=None,
+                  scope=None, donate=None, use_program_cache=True):
+        """Dispatch one step WITHOUT waiting for its results: returns a
+        `StepFuture` (device-resident fetches + lazy host
+        materialization) as soon as the step is staged, so the host can
+        assemble batch N+1 — or a `DevicePrefetcher` can device_put it —
+        while the device computes step N.
+
+        The pipeline depth is bounded: at most ``PADDLE_MAX_INFLIGHT_STEPS``
+        (default 2) dispatched steps may be pending per executor. A
+        submission against a full window first waits for the OLDEST
+        in-flight step (counted in ``executor_pipeline_stall_total``,
+        timed in ``step_wait_seconds``), so device memory holds at most
+        window+1 steps' feeds/results — async dispatch never turns into
+        unbounded HBM growth. ``executor_inflight`` /
+        ``executor_inflight_peak`` gauges expose the live depth;
+        ``stage_seconds`` times the host-side staging of each submission.
+
+        Donation interacts with overlap: a donated rw buffer from step N
+        could still back step N-1's un-materialized fetches, so when the
+        resolved donation policy would be ON this path forces it OFF and
+        counts ``donation_fallback_total{reason=inflight}`` — run_async
+        trades one extra state copy in HBM for overlap. The computed
+        TRAJECTORY is identical to `run`'s (same RNG stream, same
+        compiled math): tests pin bit-equality.
+
+        Failures — injected run-site faults, retry-exhausted dispatches,
+        async XLA errors — surface on ``StepFuture.result()``, never on
+        this call. FLAGS_check_nan_inf still checks at the program
+        boundary, which materializes state host-side and forfeits most
+        overlap (debugging flag — documented tradeoff)."""
+        if program is None:
+            program = default_main_program()
+        feed = self._py_reader_feed(program, feed)
+        window = self._max_inflight()
+        while True:
+            with self._async_cv:
+                # the reservation (not the append) claims the slot, so
+                # concurrent submitters on one executor can never exceed
+                # the window between check and append
+                if len(self._inflight) + self._pending_submit < window:
+                    self._pending_submit += 1
+                    break
+                oldest = self._inflight[0] if self._inflight else None
+            if oldest is None:
+                # window held entirely by other threads' reservations:
+                # wait for their dispatches to land
+                with self._async_cv:
+                    self._async_cv.wait(0.05)
+                continue
+            if oldest._ready_nonblock():
+                oldest.wait()       # already complete: free the slot
+                continue
+            # genuine stall: the window is full of still-running steps
+            monitor.inc('executor_pipeline_stall_total')
+            t0 = time.perf_counter()
+            oldest.wait()
+            monitor.observe('step_wait_seconds',
+                            time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        monitor.inc('executor_run_async_total')
+        donate_override = donate
+        if _donation_enabled(override=donate, record=False):
+            donate_override = 'inflight'
+        sync_out = []
+        try:
+            with monitor.span('run_async'):
+                if hasattr(program, '_executor_run'):
+                    # CompiledProgram delegation has its own dispatch
+                    # path; run it synchronously and hand back a
+                    # completed future (correct, without overlap)
+                    outs = program._executor_run(
+                        self, feed, fetch_list, scope, False,
+                        donate=False if donate_override == 'inflight'
+                        else donate)
+                elif analysis.profile_ops_active():
+                    outs = analysis.run_profiled(self, program, feed,
+                                                 fetch_list, scope, False)
+                else:
+                    outs = self._run_impl(program, feed, fetch_list,
+                                          scope, False, use_program_cache,
+                                          donate_override,
+                                          _sync_out=sync_out)
+        except Exception as e:      # noqa: BLE001 — delivered on the future
+            with self._async_cv:
+                self._pending_submit -= 1
+                self._async_cv.notify_all()
+            monitor.observe('stage_seconds', time.perf_counter() - t0)
+            return StepFuture(self, None, error=e)
+        fut = StepFuture(self, outs, sync=(outs, sync_out))
+        with self._async_cv:
+            self._pending_submit -= 1
+            self._inflight.append(fut)
+            n = len(self._inflight)
+            if n > self._inflight_peak:
+                self._inflight_peak = n
+            # gauges published under the lock (stale-writer-last would
+            # understate the peak the window tests assert on)
+            monitor.set_gauge('executor_inflight', float(n))
+            monitor.set_gauge('executor_inflight_peak',
+                              float(self._inflight_peak))
+            self._async_cv.notify_all()
+        monitor.observe('stage_seconds', time.perf_counter() - t0)
+        return fut
+
     def _run_impl(self, program, feed, fetch_list, scope, return_numpy,
-                  use_program_cache, donate_override=None):
+                  use_program_cache, donate_override=None, _sync_out=None):
         if scope is None:
             scope = global_scope()
         feed, fetch_names, static_feed, static_lods = \
@@ -873,6 +1162,12 @@ class Executor(object):
         # would leave the scope pointing at deleted arrays — a NaN state is
         # at least readable/checkpointable for debugging
         scope.update(new_state)
+        if _sync_out is not None and new_state:
+            # one state leaf as the async completion token: fetch-less
+            # steps still give StepFuture.wait something device-side to
+            # block on (the single device stream orders everything else
+            # behind it)
+            _sync_out.append(next(iter(new_state.values())))
         from . import flags as _flags
         if _flags.get_flags('check_nan_inf'):
             try:
@@ -935,11 +1230,21 @@ class Executor(object):
             return out
         # return_numpy=False keeps fetches device-resident (no host sync);
         # only lod-carrying results are wrapped, since the LoD metadata is
-        # the point of asking for them
-        return [
-            _fetched(f, entry.lod_out[n]) if entry.lod_out.get(n) else f
-            for n, f in zip(entry.fetch_names, fetches)
-        ]
+        # the point of asking for them. Under async dispatch the wrap is
+        # deferred (np.asarray here would block the submission on the
+        # device step); the raw array joins the completion token list so
+        # StepFuture.wait covers it
+        out = []
+        for n, f in zip(entry.fetch_names, fetches):
+            lod = entry.lod_out.get(n)
+            if not lod:
+                out.append(f)
+            elif _sync_out is None:
+                out.append(_fetched(f, lod))
+            else:
+                _sync_out.append(f)
+                out.append(_DeferredFetch(f, lod))
+        return out
 
     # ------------------------------------------------------------------
     def _segment_plan(self, program, fetch_names):

@@ -172,10 +172,21 @@ def read_file(reader):
 
 
 def double_buffer(reader, place=None, name=None):
-    """reference layers/io.py:1005 double_buffer. The dispatch pipeline
-    already overlaps host->device copies with compute (async dispatch), so
-    this is the identity on the reader object."""
-    return reader
+    """reference layers/io.py:1005 double_buffer: wrap `reader` in a
+    capacity-bounded `DevicePrefetcher` stage so batches are staged onto
+    the device (honoring `place`) by a background worker while the
+    consumer computes — the buffered_reader double-buffer contract.
+
+    `reader` may be a callable batch generator, any iterable (including a
+    `PyReader` / another prefetcher), and yields feed dicts (or tuples,
+    passed through untouched for downstream zipping). Returns an
+    ITERABLE reader whose items are device-resident; its `close()`
+    cancels the staging worker (also invoked by abandoning iteration)."""
+    from ..reader.prefetch import DevicePrefetcher
+    if isinstance(reader, DevicePrefetcher):
+        return reader                        # already a prefetch stage
+    src = reader if callable(reader) else (lambda: iter(reader))
+    return DevicePrefetcher(src, capacity=2, device=place)
 
 
 def create_py_reader_by_data(capacity, feed_list, name=None,

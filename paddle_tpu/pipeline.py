@@ -1,0 +1,133 @@
+"""Overlapped training pipeline: DevicePrefetcher → Executor.run_async.
+
+The TPU-native composition of Fluid's AsyncExecutor + buffered_reader
+(double-buffer to device): a background worker parses/stages batches onto
+the device (`reader.DevicePrefetcher`) while the executor keeps a bounded
+window of dispatched steps in flight (`Executor.run_async`). Host input
+work — python parsing, batch assembly, host→device transfer — overlaps
+device compute on both sides of the queue, so an input-bound step loop
+approaches max(host_time, device_time) instead of their sum.
+
+Quickstart::
+
+    loader = fluid.DataLoader(train_reader, feed_list=[x, y], capacity=4)
+    for fut in fluid.train_loop(exe, main_prog, loader,
+                                fetch_list=[loss], scope=scope):
+        futures.append(fut)              # submit-side never blocks on
+    losses = [f.result()[0] for f in futures]      # ... materialization
+
+Sizing, donation interaction, and when NOT to use the async path:
+docs/executor_performance.md. Monitor series (``executor_inflight``,
+``stage_seconds``, ``step_wait_seconds``,
+``executor_pipeline_stall_total``): docs/observability.md.
+"""
+from .reader.prefetch import DevicePrefetcher, device_of
+
+__all__ = ['DataLoader', 'train_loop']
+
+
+class DataLoader(object):
+    """Iterable of device-resident feed dicts over a batch reader — the
+    thin user-facing wrapper of `reader.DevicePrefetcher` (reference
+    fluid.io.DataLoader.from_generator, capacity/places semantics).
+
+    ``reader`` is a callable returning an iterator of batches: feed
+    dicts, or tuples zipped against ``feed_list`` names. ``places``
+    (a framework Place or jax device) pins the staging target; None
+    stages onto the default device. `close()` cancels the in-flight
+    prefetch pass (early-exiting consumers leak no worker thread)."""
+
+    def __init__(self, reader, feed_list=None, capacity=2, places=None,
+                 feeder=None):
+        # set_batch_generator / set_sample_list_generator read these on
+        # ANY DataLoader, not just from_generator-built ones
+        self._feed_list = feed_list
+        self._capacity = capacity
+        feed_names = None
+        if feed_list is not None:
+            feed_names = [v.name if hasattr(v, 'name') else v
+                          for v in feed_list]
+        place = places[0] if isinstance(places, (list, tuple)) else places
+        self._prefetcher = DevicePrefetcher(
+            reader, feed_names=feed_names, capacity=capacity,
+            device=place, feeder=feeder)
+
+    @classmethod
+    def from_generator(cls, feed_list=None, capacity=2):
+        """Reference-style two-step construction: build, then
+        ``set_batch_generator(reader, places)``."""
+        self = cls.__new__(cls)
+        self._feed_list = feed_list
+        self._capacity = capacity
+        self._prefetcher = None
+        return self
+
+    def set_batch_generator(self, reader, places=None):
+        DataLoader.__init__(self, reader, feed_list=self._feed_list,
+                            capacity=self._capacity, places=places)
+        return self
+
+    def set_sample_list_generator(self, reader, places=None):
+        """reader yields SAMPLE lists (DataFeeder rows), not ready
+        batches — assembled by a DataFeeder over ``feed_list``."""
+        from .data_feeder import DataFeeder
+        place = places[0] if isinstance(places, (list, tuple)) else places
+        self._prefetcher = DevicePrefetcher(
+            reader, capacity=self._capacity, device=place,
+            feeder=DataFeeder(self._feed_list))
+        return self
+
+    def __iter__(self):
+        if self._prefetcher is None:
+            raise ValueError(
+                "DataLoader has no data source — construct it with a "
+                "reader or call set_batch_generator first")
+        return iter(self._prefetcher)
+
+    def close(self, timeout_s=2.0):
+        if self._prefetcher is not None:
+            self._prefetcher.close(timeout_s)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def train_loop(exe, program, data, fetch_list=None, scope=None,
+               capacity=2, place=None, feed_names=None, donate=None):
+    """Drive ``program`` over ``data`` with the full async pipeline;
+    yields one `StepFuture` per batch, in order.
+
+    ``data`` may be a `DataLoader`, a `DevicePrefetcher`, a callable
+    reader (wrapped in a prefetcher of ``capacity``, staged onto
+    ``place``), or any iterable of feed dicts (already-device feeds pass
+    through without host staging). The generator owns the prefetch pass:
+    closing it early — ``break`` — cancels the staging worker.
+
+    The in-flight window (``PADDLE_MAX_INFLIGHT_STEPS``) is enforced by
+    ``run_async`` itself, so iterating this generator to exhaustion
+    without touching the futures still bounds device memory; materialize
+    results whenever convenient (``fut.result()``). Trajectory equals
+    the equivalent synchronous ``run`` loop bit-for-bit."""
+    owned = None
+    if isinstance(data, (DataLoader, DevicePrefetcher)):
+        src = data
+    else:
+        reader = data if callable(data) else (lambda: iter(data))
+        src = owned = DevicePrefetcher(reader, feed_names=feed_names,
+                                       capacity=capacity,
+                                       device=device_of(place))
+    it = iter(src)
+    try:
+        for feed in it:
+            yield exe.run_async(program, feed=feed, fetch_list=fetch_list,
+                                scope=scope, donate=donate)
+    finally:
+        close_m = getattr(it, 'close', None)
+        if close_m is not None:
+            close_m()
+        if owned is not None:
+            owned.close()

@@ -322,10 +322,24 @@ class ServingEngine(object):
                               return_numpy=False, donate=False)
 
     def _worker_loop(self):
+        """Pipelined worker: while batch K executes on the device, this
+        thread forms batch K+1 (padding, stacking, bucket math) — the
+        executor's async path makes the dispatch non-blocking, the
+        worker-local `pending` slot keeps delivery in order. Delivery of
+        an in-flight batch is never deferred behind an EMPTY queue: when
+        there is nothing to form, the pending batch finishes
+        immediately, so a lone request still sees dispatch-latency
+        delivery."""
         poll = 0.05
+        pending = None
         while True:
             if self.queue.closed and self.queue.depth() == 0:
+                if pending is not None:
+                    self._finish_batch(pending)
                 return
+            if pending is not None and self.queue.depth() == 0:
+                self._finish_batch(pending)
+                pending = None
             batch, expired = self.queue.take_batch(
                 self.ladder.max_rows, self.config.max_wait_ms / 1000.0,
                 poll_s=poll)
@@ -337,11 +351,24 @@ class ServingEngine(object):
                     "deadline passed after %.3fs in queue"
                     % (now - r.enqueue_t)))
             if not batch:
+                if pending is not None:
+                    self._finish_batch(pending)
+                    pending = None
                 continue
             monitor.set_gauge('serving_queue_depth', self.queue.depth())
-            self._serve_batch(batch)
+            nxt = self._dispatch_batch(batch)
+            if pending is not None:
+                # batch K+1 is dispatched: finishing K now overlaps its
+                # delivery (host-side slicing/materialization) with K+1's
+                # device execution
+                self._finish_batch(pending)
+            pending = nxt
 
-    def _serve_batch(self, batch):
+    def _dispatch_batch(self, batch):
+        """Form one padded batch and dispatch it asynchronously. Returns
+        the pending (future, batch, padded_rows, t0) record for
+        `_finish_batch`, or None when formation failed (those requests
+        are already failed — the pool never dies)."""
         with monitor.span('serving.batch'):
             n_rows = sum(r.n_rows for r in batch)
             for r in batch:
@@ -354,39 +381,55 @@ class ServingEngine(object):
                     name: np.concatenate([p[name] for p in padded], axis=0)
                     for name in padded[0]}
                 stacked, padded_rows = self.ladder.pad_rows(stacked, n_rows)
-                monitor.observe('serving_batch_rows', n_rows)
-                monitor.observe('serving_batch_fill',
-                                n_rows / float(padded_rows))
-                monitor.inc('serving_batch_total')
-                monitor.inc('serving_batch_padded_rows',
-                            padded_rows - n_rows)
-                t0 = time.perf_counter()
-                monitor.set_gauge('serving_inflight_batches',
-                                  self._inflight(1))
-                try:
-                    with monitor.span('serving.execute'):
-                        outs = self._execute(stacked)
-                        # fetches are device-resident now; sync here so
-                        # execute_seconds still measures device completion,
-                        # not async dispatch
-                        import jax
-                        jax.block_until_ready(
-                            [o for o in outs if not isinstance(o,
-                                                               np.ndarray)])
-                finally:
-                    monitor.set_gauge('serving_inflight_batches',
-                                      self._inflight(-1))
-                monitor.observe('serving_execute_seconds',
-                                time.perf_counter() - t0)
             except Exception as e:      # noqa: BLE001 — delivered per-request
-                # a failed batch fails ITS requests; the worker and the
-                # pool live on (retry-exhausted transients land here too)
                 monitor.inc('serving_batch_error_total')
                 for r in batch:
                     monitor.inc('serving_request_total',
                                 labels={'outcome': 'error'})
                     r.fail(e)
-                return
+                return None
+            monitor.observe('serving_batch_rows', n_rows)
+            monitor.observe('serving_batch_fill',
+                            n_rows / float(padded_rows))
+            monitor.inc('serving_batch_total')
+            monitor.inc('serving_batch_padded_rows', padded_rows - n_rows)
+            t0 = time.perf_counter()
+            monitor.set_gauge('serving_inflight_batches', self._inflight(1))
+            p = self.predictor
+            # donation stays off per call (shared cached params); faults
+            # and retry-exhaustion surface on the future, failed below
+            fut = p.executor.run_async(p.program, feed=stacked,
+                                       fetch_list=p.fetch_vars,
+                                       scope=p.scope, donate=False)
+            return (fut, batch, padded_rows, t0)
+
+    def _finish_batch(self, pending):
+        """Wait for a dispatched batch, then deliver per-request slices.
+        serving_execute_seconds spans dispatch→device completion (it may
+        include host time the worker spent forming the NEXT batch — the
+        overlap is the point)."""
+        fut, batch, padded_rows, t0 = pending
+        try:
+            try:
+                with monitor.span('serving.execute'):
+                    # device-resident fetches; result() blocks until the
+                    # device completed, so the histogram still measures
+                    # completion, not async dispatch
+                    outs = fut.result(return_numpy=False)
+            finally:
+                monitor.set_gauge('serving_inflight_batches',
+                                  self._inflight(-1))
+            monitor.observe('serving_execute_seconds',
+                            time.perf_counter() - t0)
+        except Exception as e:      # noqa: BLE001 — delivered per-request
+            # a failed batch fails ITS requests; the worker and the
+            # pool live on (retry-exhausted transients land here too)
+            monitor.inc('serving_batch_error_total')
+            for r in batch:
+                monitor.inc('serving_request_total',
+                            labels={'outcome': 'error'})
+                r.fail(e)
+            return
         # batch-level fetches (no padded leading dim) are shared whole by
         # every request in the batch: materialize them host-side ONCE
         # here, not once per request in _slice_result

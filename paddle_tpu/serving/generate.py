@@ -447,7 +447,20 @@ class GenerateEngine(object):
                 monitor.set_gauge('generate_queue_depth',
                                   self.queue.depth())
                 continue
-            self._step()
+            pending = self._step_dispatch()
+            if pending is not None:
+                # overlap: admit queued prompts (queue pops + prefill
+                # staging) while the dispatched step computes on device.
+                # Eviction stays OUT of this window — releasing a slot
+                # the in-flight step's snapshot references would let a
+                # new tenant double-book it before completion lands.
+                t_adm = time.perf_counter()
+                self._admit()
+                # admission time is observed as prefill_seconds already;
+                # exclude it so decode_step_seconds stays a per-token
+                # signal instead of double-counting the overlap window
+                self._step_complete(pending,
+                                    exclude_s=time.perf_counter() - t_adm)
         # shutdown: a resident generation must not leave its caller
         # blocked forever
         for i, st in enumerate(self._slots):
@@ -506,6 +519,18 @@ class GenerateEngine(object):
         return int(np.asarray(out[0]).reshape(-1)[0])
 
     def _step(self):
+        """One decode step, dispatch + completion back to back (the
+        inline/debug path; the engine loop splits the two so admission
+        overlaps the device time)."""
+        pending = self._step_dispatch()
+        if pending is not None:
+            self._step_complete(pending)
+
+    def _step_dispatch(self):
+        """Snapshot the resident slots and dispatch one decode step
+        WITHOUT materializing its next-token fetch — JAX's async
+        dispatch returns as soon as the step is staged, so the caller
+        can do host work (admission) while the device computes."""
         S = self.config.slots
         toks = np.zeros((S, 1), 'int64')
         pos = np.zeros((S, 1), 'int64')
@@ -515,27 +540,44 @@ class GenerateEngine(object):
                 continue
             toks[i], pos[i] = st.last, st.pos
             active.append((i, st))
+        if not active:
+            return None
         t0 = time.perf_counter()
         try:
-            out = self._step_bound({'gen_tokens': toks, 'gen_pos': pos})
+            out = self._step_bound({'gen_tokens': toks, 'gen_pos': pos},
+                                   return_numpy=False)
         except Exception as e:  # noqa: BLE001 — delivered per-request
-            # an exhausted retry (or permanent fault) fails the RESIDENT
-            # requests; the loop and the engine live on — the decode
-            # analog of the PR 4 "pool never dies" contract
-            monitor.inc('generate_step_error_total')
-            for i, st in active:
-                self._release(i)
-                monitor.inc('generate_request_total',
-                            labels={'outcome': 'error'})
-                st.req.fail(e)
-            self._set_occupancy()
+            self._fail_step(active, e)
+            return None
+        return (out, active, t0)
+
+    def _fail_step(self, active, e):
+        # an exhausted retry (or permanent fault) fails the RESIDENT
+        # requests; the loop and the engine live on — the decode
+        # analog of the PR 4 "pool never dies" contract
+        monitor.inc('generate_step_error_total')
+        for i, st in active:
+            self._release(i)
+            monitor.inc('generate_request_total',
+                        labels={'outcome': 'error'})
+            st.req.fail(e)
+        self._set_occupancy()
+
+    def _step_complete(self, pending, exclude_s=0.0):
+        out, active, t0 = pending
+        try:
+            # materialization = device completion; an async runtime
+            # failure surfaces here and fails the step's residents
+            nxt = np.asarray(out[0]).reshape(-1)
+        except Exception as e:  # noqa: BLE001 — delivered per-request
+            self._fail_step(active, e)
             return
-        monitor.observe('decode_step_seconds', time.perf_counter() - t0)
-        nxt = np.asarray(out[0]).reshape(-1)
+        monitor.observe('decode_step_seconds',
+                        max(0.0, time.perf_counter() - t0 - exclude_s))
         n = len(active)
         self._decode_steps += 1
         self._decode_tokens += n
-        self._occ_sum += n / float(S)
+        self._occ_sum += n / float(self.config.slots)
         monitor.inc('decode_tokens_total', n)
         for i, st in active:
             st.pos += 1

@@ -181,7 +181,8 @@ def test_slot_eviction_on_deadline_expiry_frees_slot():
     eng = GenerateEngine(_cfg())
     eng.warmup()
     orig = eng._step_bound
-    eng._step_bound = lambda feed: (time.sleep(0.02), orig(feed))[1]
+    eng._step_bound = lambda feed, **kw: (time.sleep(0.02),
+                                          orig(feed, **kw))[1]
     before = monitor.counters()
     with eng:
         req = eng.submit(_prompt(4, seed=9), max_new_tokens=40,

@@ -1,0 +1,182 @@
+"""Async-pipeline micro-bench: sync step loop vs overlapped input pipeline.
+
+Measures the contract docs/executor_performance.md makes for
+`Executor.run_async` + `DevicePrefetcher` (paddle_tpu.pipeline.train_loop):
+on an INPUT-BOUND workload — batches arrive with a per-batch read latency
+(``io_wait_s``, the remote-storage stall a CTR trainer sees) and must be
+python-parsed (sparse idx:val text, the MultiSlotDataFeed shape of work)
+before they can feed the step — the overlapped pipeline approaches
+max(input_time, compute_time) per step while the synchronous loop pays
+their sum. Reported:
+
+- steps_per_sec_sync:  parse batch -> Executor.run -> materialize loss,
+  serially (what AsyncExecutor did before PR 7);
+- steps_per_sec_async: a DevicePrefetcher worker parses + device_puts
+  batches while train_loop dispatches run_async steps; losses materialize
+  from the StepFutures at the end;
+- speedup, pipeline stall/inflight counters, recompiles_after_warmup
+  (contract: 0), and exact trajectory parity between the two loops
+  (contract: True — same seed, same math, bit-equal losses).
+
+Both loops parse identical text; best-of-`rounds` minima on both sides
+(this box's noise calls for comparing minima — see BASELINE notes).
+
+Usage: python tools/pipebench.py [rounds]      (prints one JSON line)
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _make_texts(n_batches, batch, dim, seed=0):
+    """Pre-rendered text batches: one blob per step, one sample per line —
+    the parse cost is the measured host work, so it must be identical
+    for both loops and every round."""
+    import numpy as np
+    rng = np.random.RandomState(seed)
+    texts = []
+    for _ in range(n_batches):
+        x = rng.randn(batch, dim).astype('float32')
+        y = (x.sum(axis=1) > 0).astype('int64')
+        lines = []
+        for row, lab in zip(x, y):
+            # sparse idx:val tokens (the CTR/MultiSlot text idiom) — the
+            # parser must split each pair, the realistic host cost
+            lines.append('%d %s' % (lab, ' '.join(
+                '%d:%.4f' % (i, v) for i, v in enumerate(row))))
+        texts.append('\n'.join(lines))
+    return texts
+
+
+def _parse(text, dim):
+    """Python tokenizer (the MultiSlotDataFeed idiom): label + dim floats
+    per line. Deliberately python-level work — the input-bound half."""
+    import numpy as np
+    xs, ys = [], []
+    for line in text.split('\n'):
+        toks = line.split()
+        ys.append(int(toks[0]))
+        row = [0.0] * dim
+        for t in toks[1:]:
+            i, _, v = t.partition(':')
+            row[int(i)] = float(v)
+        xs.append(row)
+    return {'pb_x': np.asarray(xs, 'float32'),
+            'pb_y': np.asarray(ys, 'int64').reshape(-1, 1)}
+
+
+def _build(dim, hidden):
+    import paddle_tpu as fluid
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 1234
+    with fluid.program_guard(main, startup):
+        with fluid.unique_name.guard():
+            x = fluid.layers.data(name='pb_x', shape=[dim], dtype='float32')
+            y = fluid.layers.data(name='pb_y', shape=[1], dtype='int64')
+            h = fluid.layers.fc(x, size=hidden, act='relu')
+            h = fluid.layers.fc(h, size=hidden, act='relu')
+            p = fluid.layers.fc(h, size=2, act='softmax')
+            loss = fluid.layers.mean(fluid.layers.cross_entropy(p, y))
+            fluid.optimizer.SGD(0.1).minimize(loss)
+    return main, startup, loss
+
+
+def measure_pipeline(rounds=3, n_batches=24, batch=64, dim=192,
+                     hidden=1024, io_wait_s=0.01):
+    """Returns the async_pipeline bench row (importable; bench.py uses
+    it for the smoke path)."""
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu import monitor
+
+    texts = _make_texts(n_batches, batch, dim)
+
+    def reader():
+        for t in texts:
+            # the read stall: waiting on the next chunk of a remote
+            # file. time.sleep models it exactly (GIL-free wait), and
+            # BOTH loops pay it identically
+            time.sleep(io_wait_s)
+            yield _parse(t, dim)
+
+    def fresh():
+        import jax
+        main, startup, loss = _build(dim, hidden)
+        exe = fluid.Executor(fluid.TPUPlace(0))
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup, scope=scope)
+            # warm both compiled entries so neither timed loop pays a
+            # compile: the sync loop's host-staged signature
+            # (donate-default) and the async loop's prefetcher-staged
+            # signature (device arrays, x64-narrowed ints, donate-off)
+            exe.run(main, feed=_parse(texts[0], dim), fetch_list=[loss],
+                    scope=scope)
+            dev_feed = {k: jax.device_put(v)
+                        for k, v in _parse(texts[0], dim).items()}
+            exe.run_async(main, feed=dev_feed, fetch_list=[loss],
+                          scope=scope).result()
+        return main, exe, scope, loss
+
+    def run_sync():
+        main, exe, scope, loss = fresh()
+        t0 = time.perf_counter()
+        out = []
+        with fluid.scope_guard(scope):
+            for feed in reader():
+                out.append(exe.run(main, feed=feed, fetch_list=[loss],
+                                   scope=scope)[0])
+        return time.perf_counter() - t0, out
+
+    def run_async():
+        main, exe, scope, loss = fresh()
+        t0 = time.perf_counter()
+        with fluid.scope_guard(scope):
+            futs = list(fluid.train_loop(exe, main, reader,
+                                         fetch_list=[loss], scope=scope))
+            out = [f.result()[0] for f in futs]
+        return time.perf_counter() - t0, out
+
+    # one un-timed warmup primes the process-wide fingerprint cache with
+    # all three entries (startup, sync donate-default run, async
+    # donate-off run); every later fresh() must hit it
+    fresh()
+    before = monitor.counters()
+    sync_best = async_best = None
+    sync_out = async_out = None
+    for _ in range(rounds):
+        t, out = run_sync()
+        if sync_best is None or t < sync_best:
+            sync_best, sync_out = t, out
+        t, out = run_async()
+        if async_best is None or t < async_best:
+            async_best, async_out = t, out
+    delta = monitor.counter_delta(before)
+    parity = len(sync_out) == len(async_out) == n_batches and all(
+        np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(sync_out, async_out))
+    snap = monitor.snapshot()
+    return {
+        'steps': n_batches,
+        'batch': batch,
+        'dim': dim,
+        'rounds': rounds,
+        'steps_per_sec_sync': round(n_batches / sync_best, 2),
+        'steps_per_sec_async': round(n_batches / async_best, 2),
+        'speedup': round(sync_best / async_best, 3),
+        'window': fluid.Executor._max_inflight(),
+        'inflight_peak': snap['gauges'].get('executor_inflight_peak'),
+        'pipeline_stalls': delta.get('executor_pipeline_stall_total', 0),
+        'donation_fallback_inflight': delta.get(
+            'donation_fallback_total{reason=inflight}', 0),
+        'recompiles_after_warmup': int(delta.get('compile_cache_miss', 0)),
+        'trajectory_parity': bool(parity),
+    }
+
+
+if __name__ == '__main__':
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 3
+    print(json.dumps(measure_pipeline(rounds=n)))
