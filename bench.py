@@ -764,6 +764,18 @@ def _child(mode):
         async_pipeline = {'error': '%s: %s' % (type(e).__name__,
                                                str(e)[:200])}
 
+    # elastic-resume chaos row: a fatal fault kills a training step
+    # mid-run; elastic_train_loop restores the latest checkpoint
+    # RESHARDED onto half the devices and replays
+    # (tools/chaosbench.py; contract: trajectory_parity True — the
+    # recovered run bit-matches the uninterrupted one)
+    try:
+        from tools.chaosbench import measure_elastic_resume
+        elastic_resume = measure_elastic_resume()
+    except Exception as e:
+        elastic_resume = {'error': '%s: %s' % (type(e).__name__,
+                                               str(e)[:200])}
+
     # XLA cost/memory analytics smoke (tools/costreport.py — the
     # Executor.explain CLI): flops + buffer-assignment peak for the
     # mnist-mlp reference programs. Memory stats cost one extra XLA
@@ -870,6 +882,7 @@ def _child(mode):
         'serving': serving,
         'generate': generate,
         'async_pipeline': async_pipeline,
+        'elastic_resume': elastic_resume,
         'costreport': costreport,
         'flops': flag.get('flops'),
         'peak_bytes': flag.get('peak_bytes'),

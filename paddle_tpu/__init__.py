@@ -61,7 +61,7 @@ from . import parallel
 from . import monitor
 from . import analysis
 from . import resilience
-from .resilience import TrainingGuard
+from .resilience import TrainingGuard, elastic_train_loop
 from . import profiler
 from . import flags
 from .flags import get_flags, set_flags
@@ -73,6 +73,7 @@ from . import compat
 from . import net_drawer
 from . import default_scope_funcs
 from . import checkpoint
+from .checkpoint import CheckpointManager
 from . import average
 from .average import WeightedAverage
 from . import contrib

@@ -23,6 +23,19 @@ checkpoint or the new one, never a torn directory. ``step=`` checkpoints
 rotate (keep-last-N, ``PADDLE_CKPT_KEEP``), and ``load_latest_valid``
 walks them newest-first, skipping corrupt/partial ones (each skip counts
 into the ``ckpt_fallback_total`` monitor series).
+
+Elastic (topology-independent) checkpoints: every save also records a
+**sharding manifest** (``paddle_shardings.json``) — per-variable global
+shape, dtype, mesh axis names/sizes, and PartitionSpec — so the
+checkpoint is not welded to the mesh it was written on. Restoring with
+``mesh=`` (``load_checkpoint`` / ``load_latest_valid`` /
+``CheckpointManager.restore_latest``) rebuilds each array as a GLOBAL
+value directly onto the target mesh's equivalent NamedSharding: mesh
+axes the new mesh lacks replicate, divisibility is checked with
+actionable errors, and numpy/scalar state restores untouched. A
+checkpoint written on ``mesh(data=8)`` resumes bit-identically on
+``mesh(data=4)`` or a single device — the substrate for
+``resilience.elastic_train_loop``'s preemption-aware shrink/grow resume.
 """
 import os
 import re
@@ -37,10 +50,11 @@ from .framework import default_main_program
 from .executor import global_scope
 
 __all__ = ['save_checkpoint', 'load_checkpoint', 'load_latest_valid',
-           'list_checkpoints']
+           'list_checkpoints', 'read_shardings', 'CheckpointManager']
 
 _STEP_RE = re.compile(r'^step_(\d+)$')
 _TMP_SUFFIX = '.paddle-tmp'
+SHARDING_NAME = 'paddle_shardings.json'
 
 
 def _persistable_state(program, scope, strict=True):
@@ -140,6 +154,61 @@ def _clean_stale_tmp(parent, only_base=None):
             shutil.rmtree(src, ignore_errors=True)
 
 
+def _sharding_manifest(state, main_program=None):
+    """Topology-independent sharding record for a state pytree: per-var
+    kind (jax | numpy | scalar), global shape/dtype, and — for jax
+    arrays — the mesh axes + PartitionSpec (parallel.mesh
+    sharding_to_manifest). Also carries the program's RNG run counter so
+    a resumed job replays the SAME random stream the interrupted one
+    would have used (trajectory-exact resume for programs with dropout)."""
+    import jax
+    from .parallel import mesh as mesh_mod
+    tensors = {}
+    ndev = 1
+    for name, v in state.items():
+        if isinstance(v, jax.Array):
+            ent = mesh_mod.sharding_to_manifest(v.sharding, len(v.shape))
+            ent.update({'kind': 'jax', 'shape': list(v.shape),
+                        'dtype': str(v.dtype)})
+            n = int(np.prod(ent['mesh_shape'])) if ent['mesh_shape'] \
+                else int(ent.get('device_count', 1))
+            ndev = max(ndev, n)
+        elif isinstance(v, np.ndarray):
+            ent = {'kind': 'numpy', 'shape': list(v.shape),
+                   'dtype': str(v.dtype)}
+        else:
+            # python / np.float64 scalars (orbax stores them as json
+            # scalars); record enough to rebuild a restore placeholder
+            ent = {'kind': 'scalar',
+                   'pytype': 'int' if isinstance(v, int) else 'float'}
+        tensors[name] = ent
+    return {'format': 'paddle_tpu_shardings', 'version': 1,
+            'device_count': ndev,
+            'rng_run_counter': int(getattr(main_program,
+                                           '_rng_run_counter', 0) or 0),
+            'tensors': tensors}
+
+
+def _write_shardings(path, shard_man):
+    import json
+    resilience.atomic_write_bytes(
+        os.path.join(path, SHARDING_NAME),
+        json.dumps(shard_man, sort_keys=True).encode())
+
+
+def read_shardings(dirname):
+    """Sharding manifest dict of a checkpoint, or None when absent
+    (pre-elastic checkpoints restore fine — arrays just replicate when a
+    target mesh is given, since their saved layout is unknown)."""
+    import json
+    try:
+        with open(os.path.join(dirname, SHARDING_NAME), 'rb') as f:
+            man = json.loads(f.read().decode())
+    except (OSError, ValueError):
+        return None
+    return man if isinstance(man, dict) and man.get('tensors') else None
+
+
 def save_checkpoint(dirname, main_program=None, scope=None, step=None,
                     keep_last_n=None):
     """Write every persistable var of `main_program` found in `scope`.
@@ -180,6 +249,7 @@ def save_checkpoint(dirname, main_program=None, scope=None, step=None,
 
     path = os.path.abspath(dirname if step is None
                            else os.path.join(dirname, 'step_%d' % step))
+    shard_man = _sharding_manifest(state, main_program)
     with monitor.timed_span('ckpt_write', 'ckpt_write_seconds'):
         if multihost:
             # orbax's own commit protocol (tmp + success marker) provides
@@ -190,8 +260,14 @@ def save_checkpoint(dirname, main_program=None, scope=None, step=None,
             with ocp.StandardCheckpointer() as ckpt:
                 ckpt.save(path, state, force=True)
                 ckpt.wait_until_finished()
+            # the sharding manifest IS computable multi-host (a global
+            # array's sharding is process-independent); process 0 stamps
+            # it after the orbax commit — a reader landing between commit
+            # and stamp just restores without reshard metadata
+            if jax.process_index() == 0:
+                _write_shardings(path, shard_man)
         else:
-            _save_hardened(path, state, step)
+            _save_hardened(path, state, step, shard_man)
     monitor.inc('ckpt_write_total')
     if step is not None and os.path.isdir(os.path.dirname(path)):
         if keep_last_n is None:
@@ -216,12 +292,13 @@ def save_checkpoint(dirname, main_program=None, scope=None, step=None,
     return path
 
 
-def _save_hardened(path, state, step):
-    """Single-host write: orbax into a sibling tmp dir, manifest with
-    per-tensor crc32s, fsync, one atomic rename into place. The
+def _save_hardened(path, state, step, shard_man=None):
+    """Single-host write: orbax into a sibling tmp dir, sharding manifest
+    + crc manifest, fsync, one atomic rename into place. The
     ``ckpt_write`` fault site fires between the tmp write and the rename —
     the worst crash point — so injected faults prove no torn checkpoint
-    can be published."""
+    can be published (the manifest files ride the same all-or-nothing
+    rename as the orbax payload)."""
     import orbax.checkpoint as ocp
     parent = os.path.dirname(path) or '.'
     os.makedirs(parent, exist_ok=True)
@@ -235,6 +312,8 @@ def _save_hardened(path, state, step):
         with ocp.StandardCheckpointer() as ckpt:
             ckpt.save(tmp, state, force=True)
             ckpt.wait_until_finished()
+        if shard_man is not None:
+            _write_shardings(tmp, shard_man)
         resilience.write_manifest(tmp, resilience.build_manifest(
             state, step=step))
         resilience.fsync_dir(tmp)
@@ -276,13 +355,82 @@ def list_checkpoints(dirname):
     return sorted(out)
 
 
-def _restore(path, main_program, scope, verify=True):
+def _resolve_mesh(mesh, reshard):
+    """Normalize the (mesh, reshard) pair: reshard truthy without a mesh
+    targets a data mesh over every visible device — the 'restore onto
+    whatever this host has' one-liner."""
+    if reshard not in (None, True, 'auto', 'replicate'):
+        raise ValueError("reshard=%r: expected True, 'auto' or "
+                         "'replicate'" % (reshard,))
+    if mesh is None and reshard is not None:
+        from .parallel.mesh import data_mesh
+        mesh = data_mesh()
+    if mesh is not None and reshard in (None, True):
+        reshard = 'auto'
+    return mesh, reshard
+
+
+def _restore_target(shard_man, mesh, reshard):
+    """Abstract orbax restore target mapping every saved entry onto
+    `mesh`: jax arrays become ShapeDtypeStructs carrying the target
+    NamedSharding (orbax then reads each device's shards directly — no
+    gather through a host copy, and no need for the SAVED mesh to even be
+    constructible on this topology), numpy/scalars restore as-is. Returns
+    None when any entry lacks the metadata (legacy fallback)."""
+    import jax
+    from jax.sharding import NamedSharding
+    from .parallel import mesh as mesh_mod
+    target = {}
+    for name, ent in shard_man['tensors'].items():
+        kind = ent.get('kind')
+        if kind == 'jax' and ent.get('shape') is not None:
+            shape = tuple(ent['shape'])
+            if reshard == 'replicate':
+                spec = mesh_mod.PartitionSpec()
+            else:
+                spec = mesh_mod.spec_from_manifest(ent, mesh, shape, name)
+            target[name] = jax.ShapeDtypeStruct(
+                shape, np.dtype(ent['dtype']),
+                sharding=NamedSharding(mesh, spec))
+        elif kind == 'numpy' and ent.get('shape') is not None:
+            target[name] = np.empty(tuple(ent['shape']),
+                                    np.dtype(ent['dtype']))
+        elif kind == 'scalar':
+            target[name] = 0 if ent.get('pytype') == 'int' else 0.0
+        else:
+            return None
+    return target
+
+
+def _restore(path, main_program, scope, verify=True, mesh=None,
+             reshard=None, restore_rng=True):
     """Restore `path` into `scope`; raises on any validation failure
-    (missing vars, crc mismatch against the manifest)."""
+    (missing vars, crc mismatch against the manifest). With `mesh`,
+    arrays land on the target mesh's equivalent NamedSharding (see
+    load_checkpoint)."""
     import orbax.checkpoint as ocp
 
+    resilience.maybe_fault('ckpt_restore')
+    t0 = time.perf_counter()
+    target = None
+    shard_man = read_shardings(path)
+    if mesh is not None and shard_man is not None:
+        target = _restore_target(shard_man, mesh, reshard)
     with ocp.StandardCheckpointer() as ckpt:
-        restored = ckpt.restore(path)
+        restored = ckpt.restore(path, target) if target is not None \
+            else ckpt.restore(path)
+    if mesh is not None and target is None:
+        # no (or partial) sharding manifest — a pre-elastic checkpoint.
+        # The saved layout is unknowable, so arrays replicate onto the
+        # target mesh after a plain restore (which needs the saved
+        # topology to still exist — the price of the missing manifest).
+        import jax
+        from jax.sharding import NamedSharding
+        from .parallel.mesh import PartitionSpec
+        repl = NamedSharding(mesh, PartitionSpec())
+        restored = {k: (jax.device_put(np.asarray(v), repl)
+                        if isinstance(v, jax.Array) else v)
+                    for k, v in restored.items()}
     wanted = set(v.name for v in main_program.list_vars() if v.persistable)
     missing = wanted - set(restored)
     if missing:
@@ -305,16 +453,49 @@ def _restore(path, main_program, scope, verify=True):
             continue          # extra entries from a superset program
         scope.set(name, val)
         names.append(name)
+    if restore_rng and shard_man is not None and \
+            main_program is not None and \
+            shard_man.get('rng_run_counter') is not None:
+        # resume replays the SAME per-run RNG stream the interrupted job
+        # would have drawn (dropout etc. stay trajectory-exact); programs
+        # without random ops are unaffected. `is not None`, not truthy: a
+        # force-saved init checkpoint records counter 0, and a resume
+        # from it must rewind to 0, not keep the crashed run's counter
+        main_program._rng_run_counter = int(shard_man['rng_run_counter'])
+    if mesh is not None:
+        saved_n = int(shard_man.get('device_count', 1)) if shard_man else 1
+        target_n = int(mesh.devices.size)
+        direction = ('shrink' if target_n < saved_n else
+                     'grow' if target_n > saved_n else 'same')
+        monitor.inc('ckpt_reshard_total', labels={'direction': direction})
+    monitor.observe('ckpt_restore_seconds', time.perf_counter() - t0)
     return sorted(names)
 
 
-def load_checkpoint(dirname, main_program=None, scope=None, step=None):
-    """Restore persistable vars into `scope`. Arrays come back with the
-    shardings they were saved with (orbax restores the layout); numpy
-    values restore as numpy. Returns the list of restored names. When the
-    checkpoint carries a manifest (hardened single-host writes), restored
-    bytes are crc-verified and a mismatch raises — use load_latest_valid
-    to fall back to an older checkpoint instead."""
+def load_checkpoint(dirname, main_program=None, scope=None, step=None,
+                    mesh=None, reshard=None, restore_rng=True):
+    """Restore persistable vars into `scope`. Returns the list of
+    restored names. When the checkpoint carries a manifest (hardened
+    single-host writes), restored bytes are crc-verified and a mismatch
+    raises — use load_latest_valid to fall back to an older checkpoint
+    instead.
+
+    Side effect: the PROGRAM's RNG run counter is rewound to the save
+    point (resume then replays the exact random stream — dropout etc.
+    stay trajectory-exact). Loading an OLD checkpoint into a side scope
+    mid-training (evaluation of earlier weights) would rewind the live
+    run's stream too — pass ``restore_rng=False`` there.
+
+    Topology: by default arrays come back with the shardings they were
+    saved with (orbax restores the layout). With ``mesh=`` the restore is
+    **topology-independent**: each saved array is rebuilt as a global
+    value directly onto the target mesh's equivalent NamedSharding (via
+    the checkpoint's sharding manifest) — saved mesh axes missing on the
+    new mesh replicate, kept axes must divide the dimension they shard
+    (actionable ValueError otherwise), numpy/scalar state restores
+    untouched. ``reshard='replicate'`` ignores the saved specs and fully
+    replicates every array on `mesh`; ``reshard=True`` without a mesh
+    targets a data mesh over all visible devices."""
     main_program = main_program if main_program is not None else \
         default_main_program()
     scope = scope if scope is not None else global_scope()
@@ -322,21 +503,27 @@ def load_checkpoint(dirname, main_program=None, scope=None, step=None):
                            else os.path.join(dirname, 'step_%d' % step))
     if not os.path.exists(path):
         raise IOError("load_checkpoint: %r does not exist" % path)
-    return _restore(path, main_program, scope)
+    mesh, reshard = _resolve_mesh(mesh, reshard)
+    return _restore(path, main_program, scope, mesh=mesh, reshard=reshard,
+                    restore_rng=restore_rng)
 
 
-def load_latest_valid(dirname, main_program=None, scope=None):
+def load_latest_valid(dirname, main_program=None, scope=None, mesh=None,
+                      reshard=None, restore_rng=True):
     """Restore the NEWEST uncorrupted checkpoint under `dirname`.
 
     Walks ``step_<n>`` checkpoints newest-first (plus `dirname` itself
     when it is a bare checkpoint), skipping any that fail to restore or
-    fail manifest crc verification; each skip increments
-    ``ckpt_fallback_total``. Returns ``(path, restored_names)``. Raises
-    IOError when nothing valid remains — at that point operator
-    intervention beats silently training from scratch."""
+    fail manifest crc verification — including injected ``ckpt_restore``
+    faults; each skip increments ``ckpt_fallback_total``. Returns
+    ``(path, restored_names)``. Raises IOError when nothing valid
+    remains — at that point operator intervention beats silently
+    training from scratch. ``mesh=`` / ``reshard=`` / ``restore_rng=``
+    behave exactly as in load_checkpoint."""
     main_program = main_program if main_program is not None else \
         default_main_program()
     scope = scope if scope is not None else global_scope()
+    mesh, reshard = _resolve_mesh(mesh, reshard)
     dirname = os.path.abspath(dirname)
     # recover checkpoints stranded mid-swap by a crashed writer before
     # enumerating. Step layout: the tmp dirs live inside dirname. Bare
@@ -356,7 +543,8 @@ def load_latest_valid(dirname, main_program=None, scope=None):
     errors = []
     for i, path in enumerate(candidates):
         try:
-            names = _restore(path, main_program, scope)
+            names = _restore(path, main_program, scope, mesh=mesh,
+                             reshard=reshard, restore_rng=restore_rng)
         except Exception as e:          # noqa: BLE001 — corrupt ckpt
             errors.append('%s: %s' % (os.path.basename(path), e))
             monitor.inc('ckpt_fallback_total')
@@ -369,3 +557,98 @@ def load_latest_valid(dirname, main_program=None, scope=None):
     raise IOError(
         "load_latest_valid: no valid checkpoint under %r (tried %d): %s"
         % (dirname, len(candidates), '; '.join(errors) or 'none found'))
+
+
+class CheckpointManager(object):
+    """Cadenced checkpointing + topology-independent resume — the driver
+    object ``resilience.elastic_train_loop`` saves through and restores
+    from::
+
+        mgr = fluid.checkpoint.CheckpointManager(
+            'ckpts', main_prog, scope=scope, every_steps=50, keep_last_n=3)
+        for step, batch in enumerate(reader()):
+            exe.run(main_prog, feed=batch, scope=scope)
+            mgr.save(step)                  # no-op off-cadence
+        ...
+        step, path, names = mgr.restore_latest(mesh=new_mesh)
+
+    ``save(step)`` writes ``dirname/step_<step>`` when the cadence says
+    so (every ``every_steps`` steps, and/or at most once per ``every_s``
+    seconds — either trigger suffices; no cadence given means every
+    step; ``force=True`` always writes) and rotates to ``keep_last_n``. ``restore_latest`` walks checkpoints
+    newest-first past corrupt/partial ones (load_latest_valid) and
+    returns ``(step, path, restored_names)`` — with ``mesh=`` the state
+    reshards onto the new topology (shrink/grow after a worker loss)."""
+
+    def __init__(self, dirname, main_program=None, scope=None,
+                 every_steps=None, every_s=None, keep_last_n=None):
+        if every_steps is not None and int(every_steps) < 1:
+            raise ValueError("every_steps must be >= 1 (or None)")
+        if every_steps is None and every_s is None:
+            # no cadence given: save every step. Deliberately NOT the
+            # default when every_s is set — 'checkpoint every 10 min'
+            # must not silently also checkpoint every step
+            every_steps = 1
+        self.dirname = dirname
+        self._program = main_program
+        self._scope = scope
+        self.every_steps = None if every_steps is None else int(every_steps)
+        self.every_s = None if every_s is None else float(every_s)
+        self.keep_last_n = keep_last_n
+        self.last_saved_step = None
+        self._last_save_t = None
+
+    def _resolve(self, scope):
+        prog = self._program if self._program is not None else \
+            default_main_program()
+        scope = scope if scope is not None else (
+            self._scope if self._scope is not None else global_scope())
+        return prog, scope
+
+    def should_save(self, step):
+        """Does the cadence call for a save after `step`? Step cadence
+        counts from the first step (step 0 saves when every_steps == 1,
+        step every_steps-1 always saves); time cadence fires when
+        every_s elapsed since the last save by THIS manager."""
+        if self.every_steps is not None and \
+                (int(step) + 1) % self.every_steps == 0:
+            return True
+        if self.every_s is not None:
+            now = time.monotonic()
+            if self._last_save_t is None or \
+                    now - self._last_save_t >= self.every_s:
+                return True
+        return False
+
+    def save(self, step, force=False, scope=None):
+        """Checkpoint after `step` if the cadence (or `force`) says so;
+        returns the written path or None when skipped."""
+        if not (force or self.should_save(step)):
+            return None
+        prog, scope = self._resolve(scope)
+        path = save_checkpoint(self.dirname, prog, scope=scope,
+                               step=int(step), keep_last_n=self.keep_last_n)
+        self.last_saved_step = int(step)
+        self._last_save_t = time.monotonic()
+        return path
+
+    def latest_step(self):
+        """Newest on-disk step number, or None when no checkpoint exists
+        (validity is only established by actually restoring)."""
+        cks = list_checkpoints(self.dirname)
+        return cks[-1][0] if cks else None
+
+    def restore_latest(self, mesh=None, reshard=None, scope=None,
+                       restore_rng=True):
+        """Restore the newest valid checkpoint (falling back past corrupt
+        ones), optionally resharded onto `mesh`; returns
+        ``(step, path, restored_names)``. Raises IOError when nothing
+        valid exists."""
+        prog, scope = self._resolve(scope)
+        path, names = load_latest_valid(self.dirname, prog, scope,
+                                        mesh=mesh, reshard=reshard,
+                                        restore_rng=restore_rng)
+        m = _STEP_RE.match(os.path.basename(path))
+        step = int(m.group(1)) if m else None
+        self.last_saved_step = step
+        return step, path, names

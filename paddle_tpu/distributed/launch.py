@@ -28,16 +28,28 @@ timeout". Worker-side, ``init_from_env`` bounds the jax.distributed
 rendezvous with ``PADDLE_RENDEZVOUS_DEADLINE_S`` (default 300) and raises
 an actionable error naming this rank, the coordinator, and the expected
 endpoint list when peers never show up.
+
+Elastic mode (docs/resilience.md "Elastic checkpointing"): with
+``wait_procs(procs, elastic=True)`` a dead worker does NOT take the
+survivors down — the call **returns** the failure (a WorkerFailedError
+value naming the dead rank and the ranks still alive) so the driver can
+drain the survivors and respawn at a smaller world size instead of
+kill-and-restart. ``run_elastic`` is that driver: it relaunches at
+``len(survivors)`` workers (down to ``min_nproc``), stamping each
+incarnation with ``PADDLE_ELASTIC_RESTART=<n>`` /
+``PADDLE_ELASTIC_RESUME=1`` so workers know to
+``checkpoint.load_latest_valid(..., reshard=True)``. CLI: ``--elastic``.
 """
 import argparse
 import os
+import signal
 import socket
 import subprocess
 import sys
 import threading
 import time
 
-__all__ = ['launch_procs', 'init_from_env', 'wait_procs',
+__all__ = ['launch_procs', 'init_from_env', 'wait_procs', 'run_elastic',
            'WorkerFailedError', 'main']
 
 
@@ -158,7 +170,8 @@ def _rank_of(p, i):
     return getattr(p, 'paddle_rank', i)
 
 
-def wait_procs(procs, deadline_s=None, poll_s=0.2, kill_survivors=True):
+def wait_procs(procs, deadline_s=None, poll_s=0.2, kill_survivors=True,
+               elastic=False):
     """Wait for every launched worker; FAIL FAST with a rank-naming error.
 
     - a worker exits nonzero -> the survivors are killed (they would hang
@@ -168,7 +181,14 @@ def wait_procs(procs, deadline_s=None, poll_s=0.2, kill_survivors=True):
       deadline) elapses -> everything is killed and the error names the
       ranks that were still running.
 
-    Returns the list of exit codes (all zero) on success."""
+    Returns the list of exit codes (all zero) on success.
+
+    elastic=True: a dead worker neither kills the survivors nor raises —
+    the WorkerFailedError is **returned** (``.rank`` = the dead rank,
+    ``.running`` = ranks still alive) so an elastic driver (run_elastic)
+    can drain the survivors and respawn at a smaller world size. Only
+    the deadline still kills everything and raises: a hung fleet has
+    nothing left to shrink around."""
     if deadline_s is None:
         env = os.environ.get('PADDLE_LAUNCH_DEADLINE_S', '')
         deadline_s = float(env) if env else None
@@ -199,10 +219,14 @@ def wait_procs(procs, deadline_s=None, poll_s=0.2, kill_survivors=True):
                 continue
             pending.remove(p)
             if rc != 0:
-                running = _kill_and_reap(pending, kill_survivors)
+                running = _kill_and_reap(
+                    pending, kill_survivors and not elastic)
                 from .. import monitor
                 monitor.inc('worker_failure_total')
-                if not running:
+                if elastic:
+                    detail = ("ranks %s left RUNNING for elastic respawn"
+                              % running)
+                elif not running:
                     detail = "no other workers were running"
                 elif kill_survivors:
                     detail = ("killed still-running ranks %s (they would "
@@ -210,11 +234,14 @@ def wait_procs(procs, deadline_s=None, poll_s=0.2, kill_survivors=True):
                 else:
                     detail = ("ranks %s are STILL RUNNING "
                               "(kill_survivors=False)" % running)
-                raise WorkerFailedError(
+                err = WorkerFailedError(
                     "worker rank %d exited with code %s; %s"
                     % (_rank_of(p, procs.index(p)), rc, detail),
                     rank=_rank_of(p, procs.index(p)), returncode=rc,
                     running=running)
+                if elastic:
+                    return err
+                raise err
         if pending and deadline_s is not None and \
                 time.monotonic() - t0 > deadline_s:
             running = _kill_and_reap(pending, True)
@@ -230,6 +257,96 @@ def wait_procs(procs, deadline_s=None, poll_s=0.2, kill_survivors=True):
         if pending:
             time.sleep(poll_s)
     return [p.returncode for p in procs]
+
+
+def _drain(procs, grace_s=10.0):
+    """Terminate still-running workers gently (SIGTERM -> grace -> kill)
+    and reap them — the elastic driver's pre-respawn drain. A SIGTERM'd
+    trainer gets the chance to flush its last checkpoint; a kill-only
+    drain would routinely throw away the newest step."""
+    for p in procs:
+        if p.poll() is None:
+            try:
+                p.send_signal(signal.SIGTERM)
+            except OSError:
+                pass
+    t0 = time.monotonic()
+    for p in procs:
+        if p.poll() is None:
+            try:
+                p.wait(timeout=max(0.1, grace_s -
+                                   (time.monotonic() - t0)))
+            except Exception:
+                p.kill()
+    for p in procs:
+        try:
+            p.wait(timeout=10)
+        except Exception:
+            pass
+
+
+def run_elastic(entrypoint, entrypoint_args=(), nproc_per_node=1,
+                min_nproc=1, max_restarts=None, deadline_s=None,
+                log_dir=None, env_extra=None, devices_per_proc=None,
+                **launch_kw):
+    """Elastic launch driver: spawn `nproc_per_node` workers, and when one
+    dies, SHRINK instead of dying — drain the survivors (SIGTERM, so they
+    can flush a final checkpoint), then respawn the job at
+    ``len(survivors)`` workers, repeating down to `min_nproc`. Every
+    incarnation after the first sees ``PADDLE_ELASTIC_RESTART=<n>`` (the
+    restart ordinal) and ``PADDLE_ELASTIC_RESUME=1`` in its env — the
+    worker-side cue to restore the latest valid checkpoint with
+    ``reshard=True`` before training (docs/resilience.md).
+
+    Returns ``(exit_codes, restarts)`` on success. Raises the final
+    WorkerFailedError when the world would shrink below `min_nproc` or
+    `max_restarts` (default env PADDLE_ELASTIC_MAX_RESTARTS, else 8) is
+    exhausted."""
+    if max_restarts is None:
+        env = os.environ.get('PADDLE_ELASTIC_MAX_RESTARTS', '')
+        max_restarts = int(env) if env else 8
+    from .. import monitor
+    nproc = int(nproc_per_node)
+    restarts = 0
+    while True:
+        extra = dict(env_extra or {})
+        if restarts:
+            extra['PADDLE_ELASTIC_RESTART'] = str(restarts)
+            extra['PADDLE_ELASTIC_RESUME'] = '1'
+        # each incarnation logs into its own subdir: launch_procs opens
+        # workerlog.<rank> with mode 'w', and truncating the FAILED
+        # incarnation's logs would destroy exactly the crash evidence an
+        # operator needs when ranks keep dying
+        ld = log_dir if not (log_dir and restarts) else \
+            os.path.join(log_dir, 'restart_%d' % restarts)
+        procs = launch_procs(
+            entrypoint, entrypoint_args, nproc_per_node=nproc,
+            log_dir=ld, env_extra=extra,
+            devices_per_proc=devices_per_proc, **launch_kw)
+        try:
+            res = wait_procs(procs, deadline_s=deadline_s, elastic=True)
+        except BaseException:
+            _drain(procs)
+            raise
+        if not isinstance(res, WorkerFailedError):
+            return res, restarts
+        _drain(procs)
+        survivors = len(res.running)
+        restarts += 1
+        if survivors < int(min_nproc) or restarts > int(max_restarts):
+            monitor.inc('elastic_giveup_total')
+            raise WorkerFailedError(
+                "elastic launch giving up after %d restart(s): %s (next "
+                "world size %d < min_nproc %d or max_restarts %d "
+                "exhausted)" % (restarts, res, survivors, min_nproc,
+                                max_restarts),
+                rank=res.rank, returncode=res.returncode,
+                running=res.running)
+        monitor.inc('elastic_resume_total')
+        sys.stderr.write(
+            'paddle_tpu.distributed.launch: rank %s died; elastic respawn '
+            '#%d at world size %d\n' % (res.rank, restarts, survivors))
+        nproc = survivors
 
 
 def init_from_env(rendezvous_deadline_s=None):
@@ -358,9 +475,36 @@ def main(argv=None):
     ap.add_argument('--log_dir', default=None)
     ap.add_argument('--devices_per_proc', type=int, default=0,
                     help='virtual CPU devices per worker (testing)')
+    ap.add_argument('--elastic', action='store_true',
+                    help='on worker death, respawn at a smaller world '
+                         'size instead of failing (run_elastic)')
+    ap.add_argument('--min_nproc', type=int, default=1,
+                    help='elastic mode: smallest world size to shrink to')
     ap.add_argument('entrypoint')
     ap.add_argument('entrypoint_args', nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)
+    if args.elastic:
+        # elastic respawn relaunches the whole node group at the new
+        # world size — single-node only (multi-node membership needs an
+        # external coordinator to agree on the surviving node set). One
+        # --node_ips entry IS single-node: treat it as the node_ip.
+        nips = [s for s in args.node_ips.split(',') if s]
+        if len(nips) > 1:
+            ap.error('--elastic supports single-node launches only')
+        try:
+            _, restarts = run_elastic(
+                args.entrypoint, args.entrypoint_args,
+                nproc_per_node=args.nproc_per_node,
+                min_nproc=args.min_nproc, log_dir=args.log_dir,
+                node_ip=nips[0] if nips else args.node_ip,
+                devices_per_proc=args.devices_per_proc or None)
+        except WorkerFailedError as e:
+            sys.stderr.write('paddle_tpu.distributed.launch: %s\n' % e)
+            sys.exit(1)
+        if restarts:
+            sys.stderr.write('paddle_tpu.distributed.launch: finished '
+                             'after %d elastic respawn(s)\n' % restarts)
+        sys.exit(0)
     procs = launch_procs(
         args.entrypoint, args.entrypoint_args,
         nproc_per_node=args.nproc_per_node, node_ip=args.node_ip,

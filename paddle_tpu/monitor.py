@@ -157,7 +157,7 @@ def inc(name, value=1.0, labels=None):
 # second and evict every duration span — so each track is sampled at most
 # once per _COUNTER_TRACK_MIN_S.
 _COUNTER_TRACK_NAMES = ('program_peak_bytes', 'program_flops',
-                        'executor_inflight')
+                        'executor_inflight', 'elastic_world_size')
 _COUNTER_TRACK_SUFFIXES = ('queue_depth', 'inflight_batches')
 _COUNTER_TRACK_MIN_S = 0.005            # <= 200 samples/s per track
 _track_last_ts = {}                     # track name -> last sample time

@@ -3,12 +3,22 @@
 The Mesh replaces the reference's Place lists + NCCLContextMap
 (platform/nccl_helper.h:86): axes are logical ('data', 'model', 'pipe',
 'seq', 'expert'), laid out so the innermost axes ride ICI.
+
+Elastic-checkpointing helpers (docs/resilience.md): a sharding is
+serialized into a topology-independent manifest entry
+(``sharding_to_manifest``) at save time and mapped back onto whatever
+mesh the restoring job actually has (``spec_from_manifest`` — axes the
+new mesh lacks replicate; divisibility is checked with actionable
+errors). ``surviving_mesh`` rebuilds a mesh of the same axis structure
+over the device set that survived a worker loss, shrinking (or growing)
+the 'data' axis.
 """
 import numpy as np
 import jax
 from jax.sharding import Mesh, PartitionSpec, NamedSharding
 
-__all__ = ['default_device_count', 'make_mesh', 'data_mesh',
+__all__ = ['default_device_count', 'make_mesh', 'data_mesh', 'mesh_axes',
+           'sharding_to_manifest', 'spec_from_manifest', 'surviving_mesh',
            'PartitionSpec', 'NamedSharding', 'Mesh']
 
 
@@ -42,3 +52,100 @@ def data_mesh(num_devices=None, devices=None):
     if num_devices is not None:
         devices = devices[:num_devices]
     return make_mesh([('data', len(devices))], devices)
+
+
+def mesh_axes(mesh):
+    """{axis name: size} of a Mesh, in axis order."""
+    return dict(mesh.shape)
+
+
+def sharding_to_manifest(sharding, ndim):
+    """Topology-independent record of one array's sharding: mesh axis
+    names/sizes plus a per-dimension PartitionSpec (each dim: None or the
+    list of axis names sharding it). SingleDeviceSharding — the serial
+    executor's device-resident state — and any sharding type we cannot
+    introspect record as fully replicated (device_count still captured so
+    restore can count shrink/grow)."""
+    if isinstance(sharding, NamedSharding):
+        axes = mesh_axes(sharding.mesh)
+        spec = []
+        for d in range(ndim):
+            ent = sharding.spec[d] if d < len(sharding.spec) else None
+            if ent is None:
+                spec.append(None)
+            elif isinstance(ent, (tuple, list)):
+                spec.append([str(a) for a in ent])
+            else:
+                spec.append([str(ent)])
+        return {'mesh_axes': list(axes), 'mesh_shape': list(axes.values()),
+                'spec': spec}
+    try:
+        ndev = len(sharding.device_set)
+    except Exception:
+        ndev = 1
+    return {'mesh_axes': [], 'mesh_shape': [],
+            'spec': [None] * ndim, 'device_count': ndev}
+
+
+def spec_from_manifest(entry, mesh, shape, name='<var>'):
+    """Map a saved sharding-manifest entry onto `mesh`: axes the target
+    mesh lacks are dropped (those dims replicate); kept axes must divide
+    the dimension they shard, checked with an error that names the fix."""
+    axes = mesh_axes(mesh)
+    spec = entry.get('spec') or []
+    out = []
+    for d, dim in enumerate(shape):
+        saved = spec[d] if d < len(spec) else None
+        kept = [a for a in (saved or []) if a in axes]
+        if not kept:
+            out.append(None)
+            continue
+        total = int(np.prod([axes[a] for a in kept]))
+        if dim % total != 0:
+            raise ValueError(
+                "reshard %r: dim %d of shape %s is sharded over mesh "
+                "axes %s (total %d) on the target mesh %s, but %d %% %d "
+                "!= 0 — pick a mesh whose %s sizes divide the dimension, "
+                "or pad the variable, or restore with reshard='replicate'"
+                % (name, d, tuple(shape), kept, total, dict(axes),
+                   dim, total, '*'.join(kept)))
+        out.append(kept[0] if len(kept) == 1 else tuple(kept))
+    return PartitionSpec(*out)
+
+
+def surviving_mesh(mesh, devices=None, shrink_axis=None):
+    """Rebuild `mesh`'s axis structure over a (usually smaller) surviving
+    device set: every axis keeps its size except `shrink_axis` (default
+    'data' when present, else the first axis), which absorbs the new
+    device count. The elastic resume path uses this after a worker loss
+    to keep model/pipe parallel degrees intact while data parallelism
+    shrinks."""
+    devices = list(devices if devices is not None else jax.devices())
+    axes = mesh_axes(mesh)
+    if not axes:
+        raise ValueError("surviving_mesh: mesh has no axes")
+    if shrink_axis is None:
+        shrink_axis = 'data' if 'data' in axes else next(iter(axes))
+    if shrink_axis not in axes:
+        raise ValueError("surviving_mesh: axis %r not in mesh axes %s"
+                         % (shrink_axis, list(axes)))
+    fixed = int(np.prod([s for a, s in axes.items() if a != shrink_axis]))
+    new_size = len(devices) // fixed
+    if new_size < 1:
+        raise ValueError(
+            "surviving_mesh: %d surviving devices cannot carry mesh %s — "
+            "the non-%s axes alone need %d devices; shrink those axes "
+            "explicitly (model/pipe parallel degree must fit the "
+            "surviving fleet) or restore onto fewer axes"
+            % (len(devices), dict(axes), shrink_axis, fixed))
+    new_axes = [(a, (new_size if a == shrink_axis else s))
+                for a, s in axes.items()]
+    if new_size * fixed < len(devices):
+        import warnings
+        warnings.warn(
+            "surviving_mesh: using %d of %d surviving devices — the "
+            "non-%s axes (%d-way) don't divide the survivor count, so "
+            "the remainder sits idle until the next resize"
+            % (new_size * fixed, len(devices), shrink_axis, fixed),
+            RuntimeWarning, stacklevel=2)
+    return make_mesh(new_axes, devices)

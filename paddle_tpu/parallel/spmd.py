@@ -201,6 +201,38 @@ class DataParallelRunner(object):
                     for n in entry.ro_names}
         rw_state = {n: executor._state_value(scope, n, program)
                     for n in entry.rw_names}
+        if nproc == 1:
+            # state committed to a DIFFERENT device set — e.g. restored
+            # by checkpoint.load_checkpoint(mesh=...) onto the shrunken
+            # post-preemption mesh while this runner was (re)built over
+            # it, or a leftover from a previous larger mesh — migrates
+            # onto this runner's sharding instead of failing jit's
+            # incompatible-devices check
+            mesh_devs = set(self._mesh.devices.flat)
+
+            def _conform(n, v):
+                # COMMITTED arrays only: uncommitted single-device state
+                # (fresh jnp.asarray uploads) is moved freely by jit
+                # itself — explicitly migrating those would re-transfer
+                # read-only state every run. A committed subset-of-mesh
+                # placement empirically dispatches fine on jax 0.4.37,
+                # but is migrated anyway: that tolerance is undocumented
+                # jit behavior, not a contract
+                if isinstance(v, jax.Array) and v.is_fully_addressable \
+                        and getattr(v, '_committed', False) \
+                        and set(v.sharding.device_set) != mesh_devs:
+                    monitor.inc('spmd_state_migrated_total')
+                    out = jax.device_put(v, entry.state_shardings[n])
+                    # rebind the migrated copy: written names are rebound
+                    # by new_state anyway, but READ-ONLY state (lr
+                    # scalars, frozen weights) would otherwise re-pay
+                    # this transfer on every run
+                    scope.set(n, out)
+                    return out
+                return v
+
+            ro_state = {n: _conform(n, v) for n, v in ro_state.items()}
+            rw_state = {n: _conform(n, v) for n, v in rw_state.items()}
         if nproc > 1:
             # assemble global arrays from per-process host-local data
             # (feeds: local batch shard; state: every process holds the

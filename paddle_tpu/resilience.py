@@ -10,14 +10,15 @@ Four cooperating pieces:
 
 - **Fault injection** (``PADDLE_FAULT_SPEC``): raise controlled
   ``InjectedFault`` errors at the compile / run / host-relay / collective /
-  checkpoint-write boundaries so every recovery path below is actually
-  testable. Grammar (';'-separated clauses)::
+  checkpoint-write / checkpoint-restore boundaries so every recovery path
+  below is actually testable. Grammar (';'-separated clauses)::
 
       site:trigger[,kind=fatal]
       compile:p=0.5        # each compile fails with probability 0.5
       run:nth=3            # exactly the 3rd run dispatch fails
       run:n=2              # the first 2 dispatches fail (then recover)
       ckpt_write:always    # every checkpoint write fails
+      ckpt_restore:nth=1   # the newest checkpoint fails to restore
       collective:every=4   # every 4th collective boundary fails
 
   Faults are transient (retryable) unless ``kind=fatal``. The env var is
@@ -38,6 +39,13 @@ Four cooperating pieces:
   the scope back to the pre-step state, backs off an optional loss scale,
   and escalates to a raise after N consecutive bad steps.
 
+- **elastic_train_loop**: the preemption-aware driver — on a worker loss
+  (``WorkerFailedError``), a TrainingGuard escalation (``NonFiniteError``)
+  or a fatal injected fault (the chaos-drill stand-in for a kill), it
+  rebuilds a mesh from the surviving device set, restores the latest
+  valid checkpoint **resharded onto it** (checkpoint.py ``mesh=`` path)
+  and replays from the checkpointed step instead of dying.
+
 Every recovery event increments a monitor counter (``retry_attempt_total``
 ``{site}``, ``retry_giveup_total{site}``, ``fault_injected_total{site}``,
 ``ckpt_fallback_total``, ``nonfinite_skip_total``) so the observability
@@ -56,7 +64,8 @@ from . import monitor
 
 __all__ = ['InjectedFault', 'NonFiniteError', 'RetryPolicy', 'TrainingGuard',
            'maybe_fault', 'install_fault', 'clear_faults', 'fault_spec',
-           'is_transient', 'retry_call', 'retry_after']
+           'is_transient', 'retry_call', 'retry_after',
+           'elastic_train_loop']
 
 
 # ---------------------------------------------------------------------------
@@ -809,3 +818,157 @@ class TrainingGuard(object):
                 self._scale_adjust(scope, self.growth_factor)
 
         return fetches[:len(fetch_list)] if extra_loss else fetches
+
+
+# ---------------------------------------------------------------------------
+# preemption-aware (elastic) training
+
+
+def elastic_train_loop(step_fn, manager, num_steps, start_step=0, mesh=None,
+                       devices_fn=None, reshard=None, max_resumes=3,
+                       on_resume=None):
+    """Run ``step_fn(step, mesh)`` for ``num_steps`` steps, checkpointing
+    through `manager` (a ``checkpoint.CheckpointManager``) — and SURVIVE
+    preemptions: a ``WorkerFailedError`` (dead rank), a ``NonFiniteError``
+    (TrainingGuard escalation) or a fatal ``InjectedFault`` (the chaos
+    drill's stand-in for a mid-step kill) escaping a step triggers an
+    elastic resume instead of a crash:
+
+    1. the surviving device set is re-read (``devices_fn()``, default
+       ``jax.devices()``),
+    2. a mesh with the same axis structure is rebuilt over it
+       (``parallel.mesh.surviving_mesh`` — 'data' shrinks or grows, other
+       axes keep their degree; no prior mesh means a fresh data mesh),
+    3. the newest valid checkpoint is restored **resharded onto that
+       mesh** (``manager.restore_latest(mesh=...)`` — corrupt/partial
+       checkpoints are skipped, injected ``ckpt_restore`` faults
+       included), and
+    4. the loop replays from the checkpointed step.
+
+    Cadenced saves run under the ``ckpt_write`` retry policy; a save that
+    still fails only warns (``elastic_save_skipped_total``) — a broken
+    checkpoint disk degrades the recovery point, it does not stop
+    training. Transient faults never reach this loop (the executor's
+    retry layer absorbs them); one that does means retries were
+    exhausted — a worker-grade failure. After ``max_resumes`` resumes
+    WITHOUT forward progress the error propagates (completing a step at
+    or past the failure point resets the budget, so sparse preemptions
+    over a long job never exhaust it): at that point the fleet is dying
+    faster than it can recover and an operator should look. A failure before the first checkpoint exists is
+    re-raised with that diagnosis rather than silently restarting from
+    scratch.
+
+    Returns the list of per-step ``step_fn`` outputs (length
+    ``num_steps``); replayed steps overwrite their first attempt, so the
+    result reads as one uninterrupted trajectory. Each resume increments
+    ``elastic_resume_total`` and updates the ``elastic_world_size``
+    gauge; ``on_resume(step, mesh, exc)`` is called before the first
+    replayed step."""
+    from .distributed.launch import WorkerFailedError
+    from .parallel import mesh as mesh_mod
+
+    outputs = [None] * int(num_steps)
+    step = int(start_step)
+    resumes = 0
+    fail_step = None        # step of the last failure; progress past it
+    # resets the resume budget — max_resumes bounds failures WITHOUT
+    # forward progress, not lifetime preemptions of a month-long job
+    while step < num_steps:
+        try:
+            out = step_fn(step, mesh)
+        except (WorkerFailedError, NonFiniteError, InjectedFault) as e:
+            resumes += 1
+            if resumes > max_resumes:
+                monitor.inc('elastic_giveup_total')
+                raise
+            fail_step = step
+            import jax
+            devices = list(devices_fn()) if devices_fn is not None \
+                else list(jax.devices())
+            if mesh is not None:
+                mesh = mesh_mod.surviving_mesh(mesh, devices)
+            else:
+                mesh = mesh_mod.data_mesh(devices=devices)
+            try:
+                rstep, path, _names = manager.restore_latest(
+                    mesh=mesh, reshard=reshard)
+            except IOError as restore_err:
+                if manager.latest_step() is None:
+                    raise RuntimeError(
+                        "elastic_train_loop: step %d failed (%s: %s) "
+                        "before any restorable checkpoint existed under "
+                        "%r — save at least one checkpoint "
+                        "(manager.save(step, force=True) after init) to "
+                        "make the job preemption-safe"
+                        % (step, type(e).__name__, e, manager.dirname)
+                    ) from restore_err
+                if reshard is None:
+                    # checkpoints EXIST but none restored onto the
+                    # rebuilt mesh — possibly a divisibility failure
+                    # (e.g. 8 devices shrank to 5 and a dim sharded over
+                    # 'data' no longer divides), which full replication
+                    # always survives; a replicated resume beats a dead
+                    # job, and the spec-mapped layout returns at the next
+                    # save/restore on a divisible fleet.
+                    import warnings
+                    warnings.warn(
+                        "elastic_train_loop: no checkpoint restored onto "
+                        "the rebuilt mesh with its saved specs (%s); "
+                        "retrying fully replicated" % restore_err,
+                        stacklevel=2)
+                    monitor.inc('elastic_replicate_fallback_total')
+                    try:
+                        rstep, path, _names = manager.restore_latest(
+                            mesh=mesh, reshard='replicate')
+                    except IOError as rep_err:
+                        # replication failing too means the checkpoints
+                        # themselves are bad (corruption), not the mesh
+                        raise RuntimeError(
+                            "elastic_train_loop: checkpoints exist under "
+                            "%r but none restored even fully replicated "
+                            "— they are corrupt/unreadable, not merely "
+                            "indivisible (%s)"
+                            % (manager.dirname, rep_err)) from rep_err
+                else:
+                    raise RuntimeError(
+                        "elastic_train_loop: checkpoints exist under %r "
+                        "but none restored onto the rebuilt mesh (%s)"
+                        % (manager.dirname, restore_err)) from restore_err
+            if rstep is not None and rstep >= step:
+                # this loop only checkpoints COMPLETED steps, so a
+                # restored step at or past the one that just failed can
+                # only come from some other run's leftovers — resuming
+                # "past the end" would silently return a trajectory with
+                # holes
+                raise RuntimeError(
+                    "elastic_train_loop: restored checkpoint step_%d from "
+                    "%r is not from this run (the failure was at step %d) "
+                    "— the checkpoint dir holds a newer/foreign run; "
+                    "point the CheckpointManager at a fresh directory"
+                    % (rstep, manager.dirname, step))
+            step = (rstep + 1) if rstep is not None else int(start_step)
+            monitor.inc('elastic_resume_total')
+            monitor.set_gauge('elastic_world_size',
+                              float(mesh.devices.size))
+            if on_resume is not None:
+                on_resume(step, mesh, e)
+            continue
+        outputs[step] = out
+        if fail_step is not None and step >= fail_step:
+            resumes = 0         # replay caught up past the failure point
+            fail_step = None
+        try:
+            retry_call(lambda: manager.save(step), site='ckpt_write')
+        except Exception as save_err:   # noqa: BLE001 — degrade, don't die
+            # a failed SAVE is not a preemption: training continues, the
+            # recovery point just stays at the previous checkpoint (loudly
+            # — silent RPO decay would be worse than the warning spam)
+            import warnings
+            monitor.inc('elastic_save_skipped_total')
+            warnings.warn(
+                "elastic_train_loop: checkpoint save after step %d failed "
+                "(%s: %s); continuing — recovery falls back to the "
+                "previous checkpoint" % (step, type(save_err).__name__,
+                                         save_err), stacklevel=2)
+        step += 1
+    return outputs

@@ -1,6 +1,8 @@
 """Sharded checkpoint/resume via orbax (SURVEY §5 checkpoint contract:
 'everything persistable is the checkpoint'; reference save/load ops +
 distributed checkpoint_notify)."""
+import os
+
 import numpy as np
 import pytest
 
@@ -98,3 +100,268 @@ def test_missing_checkpoint_raises(tmp_path):
     main, startup, loss = _model()
     with pytest.raises(IOError, match="does not exist"):
         fluid.checkpoint.load_checkpoint(str(tmp_path / "nope"), main)
+
+
+# ---------------------------------------------------------------------------
+# elastic (topology-independent) checkpoints — docs/resilience.md
+
+
+def _host_state(scope):
+    return {n: np.asarray(scope.get(n)).copy() for n in scope.names()}
+
+
+def test_reshard_parity_matrix(tmp_path):
+    """Acceptance: a checkpoint saved from sharded state over the
+    8-device data mesh (largest divisible dim of each trained var
+    sharded, the ZeRO layout; plus a 2x4 data/model mesh) restores onto
+    mesh(data=4), mesh(data=2), and a single device with BITWISE-
+    identical state; saved mesh axes map onto the target mesh and axes
+    the target lacks replicate. (The Reduce-mode save/restore round-trip
+    itself is covered by test_sharded_state_roundtrip — this test buys
+    the reshard matrix without a second SPMD compile.)"""
+    import jax
+    from jax.sharding import NamedSharding
+    from paddle_tpu.parallel.mesh import make_mesh, PartitionSpec as P
+
+    X, Y = _data()
+    main, startup, loss = _model()   # seed 5: shares the
+    # compile-cache fingerprint with the resume test's program
+    exe = fluid.Executor()
+    s1 = fluid.Scope()
+    ck8 = str(tmp_path / 'ck8')
+    m8 = make_mesh([('data', 8)], jax.devices())
+    with fluid.scope_guard(s1):
+        exe.run(startup, scope=s1)
+        for _ in range(2):
+            exe.run(main, feed={'x': X, 'y': Y}, fetch_list=[loss],
+                    scope=s1)
+        # lay the trained state out the way a ZeRO run would: each var's
+        # largest 8-divisible dim sharded over 'data', rest replicated
+        n_sharded = 0
+        for n in list(s1.names()):
+            v = np.asarray(s1.get(n))
+            spec = [None] * v.ndim
+            for ax, d in sorted(enumerate(v.shape), key=lambda t: -t[1]):
+                if d % 8 == 0:
+                    spec[ax] = 'data'
+                    n_sharded += 1
+                    break
+            s1.set(n, jax.device_put(v, NamedSharding(m8, P(*spec))))
+        assert n_sharded >= 6       # weights, biases, Adam moments
+        fluid.checkpoint.save_checkpoint(ck8, main, scope=s1)
+        saved = _host_state(s1)
+    shard_man = fluid.checkpoint.read_shardings(ck8)
+    assert shard_man and shard_man['device_count'] == 8
+    assert any(any(dim and 'data' in dim for dim in e.get('spec') or [])
+               for e in shard_man['tensors'].values())
+
+    targets = [make_mesh([('data', 4)], jax.devices()[:4]),
+               make_mesh([('data', 2)], jax.devices()[:2]),
+               make_mesh([('data', 1)], jax.devices()[:1])]
+    for mesh in targets:
+        ndev = int(mesh.devices.size)
+        s2 = fluid.Scope()
+        with fluid.scope_guard(s2):
+            names = fluid.checkpoint.load_checkpoint(ck8, main, scope=s2,
+                                                     mesh=mesh)
+        assert names
+        for n in names:
+            assert np.array_equal(np.asarray(s2.get(n)), saved[n]), \
+                (n, ndev)
+        w2 = s2.get('fc_0.w_0')
+        assert isinstance(w2, jax.Array)
+        assert w2.sharding.device_set <= set(mesh.devices.flat)
+        if ndev > 1:                # spec carried over, still sharded
+            assert not w2.sharding.is_fully_replicated
+
+    # multi-axis save: state laid out over mesh(data=2, model=4); the
+    # 'model' axis does not exist on the pure-data targets -> replicates
+    m24 = make_mesh([('data', 2), ('model', 4)], jax.devices())
+    ck24 = str(tmp_path / 'ck24')
+    with fluid.scope_guard(s1):
+        s1.set('fc_0.w_0', jax.device_put(
+            saved['fc_0.w_0'], NamedSharding(m24, P('model'))))
+        s1.set('fc_1.w_0', jax.device_put(
+            saved['fc_1.w_0'], NamedSharding(m24, P(('data', 'model')))))
+        fluid.checkpoint.save_checkpoint(ck24, main, scope=s1)
+    ent = fluid.checkpoint.read_shardings(ck24)['tensors']['fc_0.w_0']
+    assert ent['mesh_axes'] == ['data', 'model']
+    m4 = make_mesh([('data', 4)], jax.devices()[:4])
+    s3 = fluid.Scope()
+    with fluid.scope_guard(s3):
+        names = fluid.checkpoint.load_checkpoint(ck24, main, scope=s3,
+                                                 mesh=m4)
+    for n in names:
+        assert np.array_equal(np.asarray(s3.get(n)), saved[n]), n
+    # P('model') entirely replicates (axis missing); P(('data','model'))
+    # keeps only 'data' -> sharded over 4
+    w0 = s3.get('fc_0.w_0')
+    assert len(w0.sharding.device_set) == 4   # on the mesh, replicated
+    assert w0.sharding.is_fully_replicated
+
+
+def test_reshard_one_further_step_matches_same_shape(tmp_path):
+    """Restore-with-reshard is not just bit-preserving at rest: ONE more
+    optimizer step from the resharded state (replicated onto a 4-device
+    mesh) bit-matches the same-shape restore's step — same math,
+    different topology."""
+    import jax
+    from paddle_tpu.parallel.mesh import make_mesh
+
+    X, Y = _data()
+    main, startup, loss = _model()
+    exe = fluid.Executor()
+    s1 = fluid.Scope()
+    ck = str(tmp_path / 'ck')
+    with fluid.scope_guard(s1):
+        exe.run(startup, scope=s1)
+        for _ in range(2):
+            exe.run(main, feed={'x': X, 'y': Y}, fetch_list=[loss],
+                    scope=s1)
+        fluid.checkpoint.save_checkpoint(ck, main, scope=s1)
+
+    def one_step(mesh):
+        s = fluid.Scope()
+        with fluid.scope_guard(s):
+            fluid.checkpoint.load_checkpoint(ck, main, scope=s, mesh=mesh)
+            out = np.asarray(exe.run(main, feed={'x': X, 'y': Y},
+                                     fetch_list=[loss], scope=s)[0]).copy()
+        return out, _host_state(s)
+
+    ref_loss, ref_state = one_step(None)          # same-shape restore
+    mesh = make_mesh([('data', 4)], jax.devices()[:4])
+    got_loss, got_state = one_step(mesh)
+    assert np.array_equal(got_loss, ref_loss)
+    for n, v in ref_state.items():
+        assert np.array_equal(got_state[n], v), n
+
+
+def test_crash_recovery_sweep_write_boundaries(tmp_path):
+    """'Old or new always survives' holds at EVERY write boundary of the
+    hardened save — including the new sharding-manifest file: a crash
+    after the orbax payload, after the sharding manifest, after the crc
+    manifest (pre-swap), or mid-swap leaves step_1 fully restorable WITH
+    reshard metadata, and a later clean save publishes intact."""
+    import paddle_tpu.checkpoint as ckpt_mod
+    from paddle_tpu import resilience as res
+
+    # 1-var increment model: the sweep exercises WRITE boundaries, not
+    # model math — small state keeps 6 orbax saves cheap in tier-1.
+    # Distinct var name: sharing res_w's program fingerprint would turn
+    # test_resilience's compile-fault test into a cache hit (no compile,
+    # no compile-site fault check)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        w = fluid.layers.create_global_var(
+            [4], value=0.0, dtype='float32', persistable=True,
+            name='sweep_w')
+        fluid.layers.increment(w)
+    exe = fluid.Executor()
+    s1 = fluid.Scope()
+    ck = str(tmp_path / 'ck')
+    with fluid.scope_guard(s1):
+        exe.run(startup, scope=s1)
+        exe.run(main, scope=s1)
+        fluid.checkpoint.save_checkpoint(ck, main, scope=s1, step=1)
+        saved = _host_state(s1)
+        exe.run(main, scope=s1)
+
+        boundaries = []
+
+        def crash_after_payload(mp):
+            mp.setattr(ckpt_mod, '_write_shardings',
+                       lambda *a, **k: (_ for _ in ()).throw(
+                           OSError('crash after orbax payload')))
+        boundaries.append((crash_after_payload, OSError))
+
+        def crash_after_shardings(mp):
+            mp.setattr(res, 'write_manifest',
+                       lambda *a, **k: (_ for _ in ()).throw(
+                           OSError('crash after sharding manifest')))
+        boundaries.append((crash_after_shardings, OSError))
+
+        def crash_pre_swap(mp):
+            # nth=3: shardings write (1) + crc manifest write (2) pass,
+            # the explicit pre-swap site check (3) fires
+            res.install_fault('ckpt_write', 'nth', 3)
+        boundaries.append((crash_pre_swap, res.InjectedFault))
+
+        def crash_mid_swap(mp):
+            real = os.rename
+
+            def failing(src, dst):
+                if src.endswith('.paddle-tmp.%d' % os.getpid()):
+                    raise OSError('crash mid-swap')
+                return real(src, dst)
+            mp.setattr(os, 'rename', failing)
+        boundaries.append((crash_mid_swap, OSError))
+
+        for arm, exc_type in boundaries:
+            with pytest.MonkeyPatch.context() as mp:
+                arm(mp)
+                with pytest.raises(exc_type):
+                    fluid.checkpoint.save_checkpoint(ck, main, scope=s1,
+                                                     step=2)
+            res.clear_faults()
+            assert sorted(os.listdir(ck)) == ['step_1'], \
+                ('torn state after %s' % arm.__name__)
+            assert fluid.checkpoint.read_shardings(
+                os.path.join(ck, 'step_1')) is not None
+
+    s2 = fluid.Scope()
+    with fluid.scope_guard(s2):
+        path, names = fluid.checkpoint.load_latest_valid(
+            ck, main, scope=s2, reshard=True)
+    assert path.endswith('step_1') and names
+    for n in names:
+        assert np.array_equal(np.asarray(s2.get(n)), saved[n]), n
+    # and a clean save afterwards publishes a complete step_2
+    with fluid.scope_guard(s1):
+        fluid.checkpoint.save_checkpoint(ck, main, scope=s1, step=2)
+    assert sorted(os.listdir(ck)) == ['step_1', 'step_2']
+    assert fluid.checkpoint.read_shardings(
+        os.path.join(ck, 'step_2')) is not None
+
+
+def test_checkpoint_manager_cadence_and_restore(tmp_path):
+    """CheckpointManager: every_steps cadence, rotation, restore_latest
+    returning the step, and the RNG-run-counter round-trip that keeps
+    resumed random streams trajectory-exact."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        w = fluid.layers.create_global_var(
+            [4], value=0.0, dtype='float32', persistable=True, name='mg_w')
+        fluid.layers.increment(w)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    ck = str(tmp_path / 'ck')
+    mgr = fluid.CheckpointManager(ck, main, scope=scope, every_steps=2,
+                                  keep_last_n=2)
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        for step in range(6):
+            exe.run(main, scope=scope)
+            path = mgr.save(step)
+            assert (path is not None) == mgr.should_save(step)
+            assert (path is not None) == ((step + 1) % 2 == 0)
+    # cadence saved steps 1,3,5; keep_last_n=2 rotated 1 away
+    assert [s for s, _ in fluid.checkpoint.list_checkpoints(ck)] == [3, 5]
+    assert mgr.latest_step() == 5
+    counter_at_save = main._rng_run_counter
+    exe.run(main, scope=scope)                 # advances the counter
+    assert main._rng_run_counter == counter_at_save + 1
+    s2 = fluid.Scope()
+    with fluid.scope_guard(s2):
+        step, path, names = mgr.restore_latest(scope=s2)
+    assert step == 5 and path.endswith('step_5') and names == ['mg_w']
+    np.testing.assert_allclose(np.asarray(s2.get('mg_w')),
+                               np.full([4], 6.0, 'float32'))
+    # restore rewound the program's RNG run counter to the save point
+    assert main._rng_run_counter == counter_at_save
+    # cadence defaults: no cadence -> every step; every_s ALONE must not
+    # silently also save every step
+    import time as _time
+    assert fluid.CheckpointManager(ck, main).should_save(0)
+    tmgr = fluid.CheckpointManager(ck, main, every_s=3600)
+    tmgr._last_save_t = _time.monotonic()
+    assert not tmgr.should_save(0) and not tmgr.should_save(1)

@@ -575,3 +575,277 @@ def test_crash_mid_swap_recovers_old_checkpoint(tmp_path):
     with fluid.scope_guard(scope):
         fluid.checkpoint.save_checkpoint(ck, main, scope=scope, step=2)
     assert os.path.isdir(live)
+
+
+# ---------------------------------------------------------------------------
+# elastic checkpointing: ckpt_restore faults, elastic_train_loop, launcher
+
+
+def test_load_latest_valid_falls_back_past_restore_fault(tmp_path):
+    """Satellite: an injected ckpt_restore fault on the newest checkpoint
+    is counted and FALLEN PAST — the restore lands on the older one; with
+    every restore faulted, the IOError names the attempts."""
+    main, startup = _inc_model()
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    ck = str(tmp_path / 'ck')
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        exe.run(main, scope=scope)
+        fluid.checkpoint.save_checkpoint(ck, main, scope=scope, step=1)
+        w1 = np.asarray(scope.get('res_w')).copy()
+        exe.run(main, scope=scope)
+        fluid.checkpoint.save_checkpoint(ck, main, scope=scope, step=2)
+    before = _counter('ckpt_fallback_total')
+    resilience.install_fault('ckpt_restore', 'nth', 1)
+    s2 = fluid.Scope()
+    with fluid.scope_guard(s2):
+        path, names = fluid.checkpoint.load_latest_valid(ck, main, scope=s2)
+    assert path.endswith('step_1')
+    assert _counter('ckpt_fallback_total') - before == 1
+    assert np.array_equal(np.asarray(s2.get('res_w')), w1)
+    resilience.clear_faults()
+    resilience.install_fault('ckpt_restore')       # always
+    with pytest.raises(IOError, match='no valid checkpoint'):
+        with fluid.scope_guard(s2):
+            fluid.checkpoint.load_latest_valid(ck, main, scope=s2)
+    resilience.clear_faults()
+    # strict load_checkpoint surfaces the injected fault directly
+    resilience.install_fault('ckpt_restore')
+    with pytest.raises(resilience.InjectedFault):
+        with fluid.scope_guard(s2):
+            fluid.checkpoint.load_checkpoint(ck, main, scope=s2, step=2)
+
+
+def test_elastic_train_loop_chaos_drill(tmp_path):
+    """Acceptance: a PADDLE_FAULT_SPEC-style fatal kill mid-run resumes
+    on a RESHAPED mesh (8 -> 4 simulated host devices) from the latest
+    checkpoint, and the final loss trajectory BIT-MATCHES the
+    uninterrupted run — the elastic-fleet contract."""
+    import jax
+    from paddle_tpu.parallel.mesh import data_mesh
+
+    X, Y = _data()
+
+    def build():
+        fluid.unique_name.switch()     # identical var names across builds
+        return _train_model()    # seed 5: compile-cache shared
+
+    # uninterrupted baseline
+    main, startup, loss = build()
+    exe = fluid.Executor()
+    s0 = fluid.Scope()
+    base = []
+    with fluid.scope_guard(s0):
+        exe.run(startup, scope=s0)
+        for _ in range(6):
+            base.append(np.asarray(exe.run(
+                main, feed={'x': X, 'y': Y}, fetch_list=[loss],
+                scope=s0)[0]).copy())
+
+    # elastic run: killed at step 4, resumed from step_3 on 4 devices
+    main, startup, loss = build()
+    s1 = fluid.Scope()
+    ck = str(tmp_path / 'ck')
+    before = _counter('elastic_resume_total')
+    with fluid.scope_guard(s1):
+        exe.run(startup, scope=s1)
+        mgr = fluid.CheckpointManager(ck, main, scope=s1, every_steps=2,
+                                      keep_last_n=3)
+
+        def step_fn(step, mesh):
+            return np.asarray(exe.run(
+                main, feed={'x': X, 'y': Y}, fetch_list=[loss],
+                scope=s1)[0]).copy()
+
+        resilience.install_fault('run', 'nth', 5, fatal=True)
+        events = []
+        out = resilience.elastic_train_loop(
+            step_fn, mgr, 6, mesh=data_mesh(8),
+            devices_fn=lambda: jax.devices()[:4],
+            on_resume=lambda st, m, e: events.append((st, dict(m.shape))))
+        resilience.clear_faults()
+    assert events == [(4, {'data': 4})]     # step_3 ckpt -> replay from 4
+    assert _counter('elastic_resume_total') - before == 1
+    assert len(out) == 6 and all(o is not None for o in out)
+    for i, (a, b) in enumerate(zip(base, out)):
+        assert np.array_equal(a, b), 'trajectory diverged at step %d' % i
+    # the resumed state actually lives on the shrunken mesh
+    import jax as _jax
+    w = s1.get('fc_0.w_0')
+    assert isinstance(w, _jax.Array) and len(w.sharding.device_set) == 4
+
+
+def test_elastic_loop_gives_up_after_max_resumes(tmp_path):
+    main, startup = _inc_model()
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    ck = str(tmp_path / 'ck')
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        mgr = fluid.CheckpointManager(ck, main, scope=scope, every_steps=1)
+
+        def step_fn(step, mesh):
+            out = exe.run(main, scope=scope)
+            if step == 2:
+                raise resilience.InjectedFault('run', 'simulated kill',
+                                               transient=False)
+            return out
+
+        with pytest.raises(resilience.InjectedFault):
+            resilience.elastic_train_loop(step_fn, mgr, 6, max_resumes=2)
+
+
+def test_wait_procs_elastic_returns_dead_rank(tmp_path):
+    """elastic=True: a dead worker is RETURNED (rank + survivors), the
+    survivors keep running for the driver to drain and respawn around."""
+    from paddle_tpu.distributed import launch_procs
+    from paddle_tpu.distributed.launch import wait_procs, WorkerFailedError
+
+    script = tmp_path / 'worker.py'
+    script.write_text("import time\ntime.sleep(600)\n")
+    procs = launch_procs(str(script), nproc_per_node=2)
+    try:
+        time.sleep(0.3)
+        procs[1].kill()
+        res = wait_procs(procs, deadline_s=60, elastic=True)
+        assert isinstance(res, WorkerFailedError)
+        assert res.rank == 1 and res.running == [0]
+        assert procs[0].poll() is None      # survivor NOT killed
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+            p.wait(timeout=10)
+
+
+def test_run_elastic_respawns_at_smaller_world(tmp_path):
+    """The elastic driver relaunches at len(survivors) with the
+    PADDLE_ELASTIC_RESTART/RESUME env cues after a worker death."""
+    from paddle_tpu.distributed.launch import run_elastic
+
+    marker = str(tmp_path / 'm')
+    script = tmp_path / 'worker.py'
+    script.write_text(
+        "import os, sys, time\n"
+        "marker = sys.argv[1]\n"
+        "rank = int(os.environ['PADDLE_TRAINER_ID'])\n"
+        "world = int(os.environ['PADDLE_TRAINERS_NUM'])\n"
+        "restart = os.environ.get('PADDLE_ELASTIC_RESTART', '0')\n"
+        "resume = os.environ.get('PADDLE_ELASTIC_RESUME', '')\n"
+        "open('%s.r%s.rank%d' % (marker, restart, rank), 'w').write(\n"
+        "    'world=%d resume=%s' % (world, resume))\n"
+        "if restart == '0' and rank == world - 1:\n"
+        "    sys.exit(3)\n"          # dies at once; survivors outlive
+        "time.sleep(0.6)\n"          # the detection poll by a wide margin
+        )
+    codes, restarts = run_elastic(str(script), (marker,),
+                                  nproc_per_node=3, min_nproc=1)
+    assert codes == [0, 0] and restarts == 1
+    import glob
+    second = sorted(glob.glob(marker + '.r1.rank*'))
+    assert len(second) == 2                  # respawned at world size 2
+    assert open(second[0]).read() == 'world=2 resume=1'
+
+
+def test_elastic_loop_survives_save_failure(tmp_path):
+    """A failed cadenced SAVE degrades the recovery point (warning +
+    counter), it does not stop training — the loop's job is surviving
+    faults, including the checkpoint disk's."""
+    main, startup = _inc_model()
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    ck = str(tmp_path / 'ck')
+    before = _counter('elastic_save_skipped_total')
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        mgr = fluid.CheckpointManager(ck, main, scope=scope, every_steps=1)
+
+        def step_fn(step, mesh):
+            exe.run(main, scope=scope)
+            return step
+
+        resilience.install_fault('ckpt_write', 'nth', 1, fatal=True)
+        with pytest.warns(UserWarning, match='save after step 0 failed'):
+            out = resilience.elastic_train_loop(step_fn, mgr, 3)
+        resilience.clear_faults()
+    assert out == [0, 1, 2]
+    assert _counter('elastic_save_skipped_total') - before == 1
+    # later saves published fine
+    assert [s for s, _ in fluid.checkpoint.list_checkpoints(ck)] == [1, 2]
+
+
+def test_elastic_loop_replicate_fallback_on_indivisible_shrink(tmp_path):
+    """8 devices shrink to 5: a dim saved sharded over 'data' (16) no
+    longer divides, so every spec-mapped restore fails — the loop must
+    fall back to a REPLICATED restore and keep training, not die with a
+    'no valid checkpoint' misdiagnosis."""
+    import jax
+    from jax.sharding import NamedSharding
+    from paddle_tpu.parallel.mesh import make_mesh, data_mesh, \
+        PartitionSpec as P
+
+    X, Y = _data()
+    main, startup, loss = _train_model()
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    ck = str(tmp_path / 'ck')
+    before = _counter('elastic_replicate_fallback_total')
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        m8 = make_mesh([('data', 8)], jax.devices())
+        mgr = fluid.CheckpointManager(ck, main, scope=scope, every_steps=2)
+        resumed = []
+
+        def step_fn(step, mesh):
+            out = np.asarray(exe.run(
+                main, feed={'x': X, 'y': Y}, fetch_list=[loss],
+                scope=scope)[0]).copy()
+            # keep a var sharded over 'data' pre-kill so the shrunken
+            # restore actually faces the divisibility wall (16 % 5 != 0);
+            # post-resume the state must stay on the surviving mesh (a
+            # step_fn re-sharding onto dead devices is user error)
+            if not resumed:
+                scope.set('fc_0.b_0', jax.device_put(
+                    np.asarray(scope.get('fc_0.b_0')),
+                    NamedSharding(m8, P('data'))))
+            return out
+
+        resilience.install_fault('run', 'nth', 4, fatal=True)
+        with pytest.warns(UserWarning, match='retrying fully replicated'):
+            out = resilience.elastic_train_loop(
+                step_fn, mgr, 5, mesh=data_mesh(8),
+                devices_fn=lambda: jax.devices()[:5],
+                on_resume=lambda st, m, e: resumed.append(st))
+        resilience.clear_faults()
+    assert len(out) == 5 and all(o is not None for o in out)
+    # the kill lands on step 3 (warm compile cache) or 4 (cold: the
+    # lazily-compiling first call skips the dispatch fault site), so the
+    # resume replays from the step_1 or step_3 checkpoint respectively
+    assert resumed in ([2], [4])
+    assert _counter('elastic_replicate_fallback_total') - before == 1
+    b = scope.get('fc_0.b_0')
+    assert b.sharding.device_set <= set(jax.devices()[:5])
+
+
+def test_elastic_loop_rejects_foreign_newer_checkpoint(tmp_path):
+    """A checkpoint dir holding a NEWER run's step must fail loudly on
+    resume, not silently return a trajectory with holes."""
+    main, startup = _inc_model()
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    ck = str(tmp_path / 'ck')
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        fluid.checkpoint.save_checkpoint(ck, main, scope=scope, step=9)
+        mgr = fluid.CheckpointManager(ck, main, scope=scope, every_steps=1)
+
+        def step_fn(step, mesh):
+            exe.run(main, scope=scope)
+            if step == 1:
+                raise resilience.InjectedFault('run', 'kill',
+                                               transient=False)
+            return step
+
+        with pytest.raises(RuntimeError, match='newer/foreign'):
+            resilience.elastic_train_loop(step_fn, mgr, 4)

@@ -1,0 +1,163 @@
+"""Chaos drill bench: time-to-recover after a mid-run kill, with elastic
+(reshard-on-load) resume.
+
+Measures the contract docs/resilience.md makes for
+``resilience.elastic_train_loop`` + topology-independent checkpoints: a
+``PADDLE_FAULT_SPEC``-style fatal fault kills a training step mid-run;
+the loop rebuilds a mesh over a SHRUNKEN device set (half the visible
+devices — the 8 -> 4 simulated-host drill on the CPU test mesh),
+restores the newest valid checkpoint resharded onto it, and replays.
+Reported:
+
+- time_to_recover_s: wall clock from the kill to the completion of the
+  first successful post-resume step (checkpoint restore + reshard +
+  recompile for the new device set + one step);
+- steps_lost: how many optimizer steps had to be replayed (kill step -
+  resume step; bounded by the checkpoint cadence);
+- trajectory_parity: the elastic run's per-step losses bit-match an
+  uninterrupted same-math baseline (contract: True);
+- devices '8->4', checkpoint cadence, and the elastic_resume /
+  ckpt_reshard counter deltas.
+
+Usage: python tools/chaosbench.py [steps] [kill_at]   (prints one JSON
+line; PADDLE_FAULT_SPEC-equivalent faults are installed
+programmatically so the drill is self-contained).
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _build_model(seed):
+    import paddle_tpu as fluid
+    fluid.unique_name.switch()          # same var names on every build
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = seed
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[16], dtype='float32')
+        y = fluid.layers.data(name='y', shape=[1], dtype='int64')
+        h = fluid.layers.fc(x, size=32, act='relu')
+        p = fluid.layers.fc(h, size=4, act='softmax')
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(p, y))
+        fluid.optimizer.Adam(0.05).minimize(loss)
+    return main, startup, loss
+
+
+def _batches(n, batch=32, dim=16, seed=0):
+    import numpy as np
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        x = rng.randn(batch, dim).astype('float32')
+        y = rng.randint(0, 4, (batch, 1)).astype('int64')
+        out.append({'x': x, 'y': y})
+    return out
+
+
+def measure_elastic_resume(steps=10, kill_at=7, every_steps=2,
+                           ckpt_dir=None, seed=31):
+    """One full drill; returns the bench row dict. kill_at is the 0-based
+    step whose dispatch is killed (a fatal run-site fault — exactly what
+    PADDLE_FAULT_SPEC='run:nth=<k>,kind=fatal' would inject)."""
+    import numpy as np
+    import jax
+    import paddle_tpu as fluid
+    from paddle_tpu import monitor, resilience
+    from paddle_tpu.parallel.mesh import data_mesh
+
+    import shutil
+    import tempfile
+    own_dir = ckpt_dir is None
+    ckpt_dir = ckpt_dir or tempfile.mkdtemp(prefix='chaosbench_')
+    feeds = _batches(steps, seed=seed)
+
+    def _run(exe, main, loss, scope, feed):
+        return np.asarray(exe.run(main, feed=feed, fetch_list=[loss],
+                                  scope=scope)[0]).copy()
+
+    # uninterrupted same-math baseline
+    main, startup, loss = _build_model(seed)
+    exe = fluid.Executor()
+    s0 = fluid.Scope()
+    base = []
+    with fluid.scope_guard(s0):
+        exe.run(startup, scope=s0)
+        for i in range(steps):
+            base.append(_run(exe, main, loss, s0, feeds[i]))
+
+    devices = jax.devices()
+    shrink = max(1, len(devices) // 2)
+    main, startup, loss = _build_model(seed)
+    s1 = fluid.Scope()
+    t_fail = [None]
+    t_first_ok = [None]
+    resumed_at = [None]
+    before = monitor.counters()
+    try:
+        with fluid.scope_guard(s1):
+            exe.run(startup, scope=s1)
+            mgr = fluid.CheckpointManager(ckpt_dir, main, scope=s1,
+                                          every_steps=every_steps,
+                                          keep_last_n=3)
+
+            def step_fn(step, mesh):
+                try:
+                    out = _run(exe, main, loss, s1, feeds[step])
+                except BaseException:
+                    t_fail[0] = time.perf_counter()
+                    raise
+                if resumed_at[0] is not None and t_first_ok[0] is None:
+                    t_first_ok[0] = time.perf_counter()
+                return out
+
+            def on_resume(step, mesh, exc):
+                resumed_at[0] = step
+
+            # the kill: (kill_at+1)-th run-site check after the startup
+            # run, fatal so the retry layer steps aside
+            resilience.install_fault('run', 'nth', kill_at + 1,
+                                     fatal=True)
+            t0 = time.perf_counter()
+            out = resilience.elastic_train_loop(
+                step_fn, mgr, steps, mesh=data_mesh(len(devices)),
+                devices_fn=lambda: devices[:shrink],
+                on_resume=on_resume)
+            wall = time.perf_counter() - t0
+    finally:
+        resilience.clear_faults()
+        if own_dir:     # a caller-supplied dir is theirs to keep/inspect
+            shutil.rmtree(ckpt_dir, ignore_errors=True)
+
+    delta = monitor.counter_delta(before)
+    parity = all(np.array_equal(a, b) for a, b in zip(base, out))
+    return {
+        'steps': steps,
+        'kill_at_step': kill_at,
+        'ckpt_every_steps': every_steps,
+        'devices': '%d->%d' % (len(devices), shrink),
+        'time_to_recover_s': round(t_first_ok[0] - t_fail[0], 3)
+        if t_first_ok[0] and t_fail[0] else None,
+        'steps_lost': (kill_at - resumed_at[0])
+        if resumed_at[0] is not None else None,
+        'resumed_at_step': resumed_at[0],
+        'trajectory_parity': bool(parity),
+        'elastic_wall_s': round(wall, 3),
+        'counters': {k: v for k, v in delta.items()
+                     if k.startswith(('elastic_', 'ckpt_reshard',
+                                      'ckpt_fallback', 'fault_injected'))},
+    }
+
+
+def main(argv):
+    steps = int(argv[1]) if len(argv) > 1 else 10
+    kill_at = int(argv[2]) if len(argv) > 2 else 7
+    row = measure_elastic_resume(steps=steps, kill_at=kill_at)
+    print(json.dumps({'metric': 'elastic_resume', **row}))
+    return 0 if row['trajectory_parity'] else 1
+
+
+if __name__ == '__main__':
+    sys.exit(main(sys.argv))
