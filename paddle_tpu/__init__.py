@@ -59,6 +59,7 @@ from . import models
 from . import transpiler
 from . import parallel
 from . import monitor
+from . import trace
 from . import analysis
 from . import resilience
 from .resilience import TrainingGuard, elastic_train_loop

@@ -106,10 +106,18 @@ def launch_procs(entrypoint, entrypoint_args=(), nproc_per_node=1,
 
     if log_dir:
         os.makedirs(log_dir, exist_ok=True)
+    # causal tracing across the spawn boundary: when the launcher runs
+    # under a trace (run_elastic's incarnation trace, or any caller's),
+    # workers inherit its id via env — their trace records carry it as
+    # 'parent', so tracereport joins a whole incarnation from rank logs
+    from .. import trace as _trace
+    _cur = _trace.current()
     procs, logs = [], []
     for i in range(nproc_per_node):
         rank = node_id * nproc_per_node + i
         env = dict(os.environ)
+        if _cur is not None:
+            env['PADDLE_TRACE_PARENT'] = _cur.trace_id
         env.update(env_extra or {})
         env.update({
             'PADDLE_TRAINER_ID': str(rank),
@@ -306,47 +314,68 @@ def run_elastic(entrypoint, entrypoint_args=(), nproc_per_node=1,
         env = os.environ.get('PADDLE_ELASTIC_MAX_RESTARTS', '')
         max_restarts = int(env) if env else 8
     from .. import monitor
+    from .. import trace as trace_mod
     nproc = int(nproc_per_node)
     restarts = 0
-    while True:
-        extra = dict(env_extra or {})
-        if restarts:
-            extra['PADDLE_ELASTIC_RESTART'] = str(restarts)
-            extra['PADDLE_ELASTIC_RESUME'] = '1'
-        # each incarnation logs into its own subdir: launch_procs opens
-        # workerlog.<rank> with mode 'w', and truncating the FAILED
-        # incarnation's logs would destroy exactly the crash evidence an
-        # operator needs when ranks keep dying
-        ld = log_dir if not (log_dir and restarts) else \
-            os.path.join(log_dir, 'restart_%d' % restarts)
-        procs = launch_procs(
-            entrypoint, entrypoint_args, nproc_per_node=nproc,
-            log_dir=ld, env_extra=extra,
-            devices_per_proc=devices_per_proc, **launch_kw)
-        try:
-            res = wait_procs(procs, deadline_s=deadline_s, elastic=True)
-        except BaseException:
+    # the incarnation trace: one id across every respawn of this job,
+    # stamped into each worker's env (PADDLE_TRACE_PARENT) by
+    # launch_procs — a post-mortem joins the driver's respawn events
+    # with every incarnation's worker-side traces on this one id
+    tr = trace_mod.start('incarnation',
+                         name=os.path.basename(str(entrypoint)),
+                         sampled=True)
+    with trace_mod.activate(tr):
+        while True:
+            extra = dict(env_extra or {})
+            if restarts:
+                extra['PADDLE_ELASTIC_RESTART'] = str(restarts)
+                extra['PADDLE_ELASTIC_RESUME'] = '1'
+            # each incarnation logs into its own subdir: launch_procs opens
+            # workerlog.<rank> with mode 'w', and truncating the FAILED
+            # incarnation's logs would destroy exactly the crash evidence
+            # an operator needs when ranks keep dying
+            ld = log_dir if not (log_dir and restarts) else \
+                os.path.join(log_dir, 'restart_%d' % restarts)
+            procs = launch_procs(
+                entrypoint, entrypoint_args, nproc_per_node=nproc,
+                log_dir=ld, env_extra=extra,
+                devices_per_proc=devices_per_proc, **launch_kw)
+            try:
+                res = wait_procs(procs, deadline_s=deadline_s,
+                                 elastic=True)
+            except BaseException as e:
+                _drain(procs)
+                tr.finish('error', error=e, restarts=restarts)
+                raise
+            if not isinstance(res, WorkerFailedError):
+                tr.finish('ok', restarts=restarts, world_size=nproc)
+                return res, restarts
             _drain(procs)
-            raise
-        if not isinstance(res, WorkerFailedError):
-            return res, restarts
-        _drain(procs)
-        survivors = len(res.running)
-        restarts += 1
-        if survivors < int(min_nproc) or restarts > int(max_restarts):
-            monitor.inc('elastic_giveup_total')
-            raise WorkerFailedError(
-                "elastic launch giving up after %d restart(s): %s (next "
-                "world size %d < min_nproc %d or max_restarts %d "
-                "exhausted)" % (restarts, res, survivors, min_nproc,
-                                max_restarts),
-                rank=res.rank, returncode=res.returncode,
-                running=res.running)
-        monitor.inc('elastic_resume_total')
-        sys.stderr.write(
-            'paddle_tpu.distributed.launch: rank %s died; elastic respawn '
-            '#%d at world size %d\n' % (res.rank, restarts, survivors))
-        nproc = survivors
+            survivors = len(res.running)
+            restarts += 1
+            if survivors < int(min_nproc) or restarts > int(max_restarts):
+                monitor.inc('elastic_giveup_total')
+                tr.event('elastic_giveup', restarts=restarts,
+                         dead_rank=res.rank, world_size=survivors,
+                         min_nproc=int(min_nproc))
+                err = WorkerFailedError(
+                    "elastic launch giving up after %d restart(s): %s "
+                    "(next world size %d < min_nproc %d or max_restarts "
+                    "%d exhausted)" % (restarts, res, survivors,
+                                       min_nproc, max_restarts),
+                    rank=res.rank, returncode=res.returncode,
+                    running=res.running)
+                tr.finish('error', error=err, restarts=restarts)
+                raise err
+            monitor.inc('elastic_resume_total')
+            tr.event('elastic_respawn', restart=restarts,
+                     dead_rank=res.rank, returncode=res.returncode,
+                     world_size=survivors)
+            sys.stderr.write(
+                'paddle_tpu.distributed.launch: rank %s died; elastic '
+                'respawn #%d at world size %d\n'
+                % (res.rank, restarts, survivors))
+            nproc = survivors
 
 
 def init_from_env(rendezvous_deadline_s=None):

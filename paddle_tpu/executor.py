@@ -36,6 +36,7 @@ import jax.numpy as jnp
 from . import analysis
 from . import monitor
 from . import resilience
+from . import trace as trace_mod
 from .framework import (Program, Variable, default_main_program, CPUPlace,
                         TPUPlace)
 from .core import lowering
@@ -584,16 +585,38 @@ class StepFuture(object):
     ``run_async`` call.
 
     Futures complete in submission order (one device stream); waiting on
-    a later future implies every earlier one finished."""
+    a later future implies every earlier one finished.
 
-    __slots__ = ('_exe', '_outs', '_error', '_sync', '_done')
+    ``timing`` (after completion) is the step's structured latency
+    breakdown: ``stage_s`` (host staging), ``execute_s`` (dispatch ->
+    device completion, measured at the first wait), ``sync_s`` (host
+    materialization in ``result(return_numpy=True)``), ``total_s``, and
+    ``trace_id`` when the step carried a trace
+    (docs/observability.md "Request & step tracing")."""
 
-    def __init__(self, exe, outs, sync=None, error=None):
+    __slots__ = ('_exe', '_outs', '_error', '_sync', '_done', '_trace',
+                 '_tclaim', '_t0', '_wall0', '_stage_s', '_exec_s',
+                 '_sync_s')
+
+    def __init__(self, exe, outs, sync=None, error=None, trace=None,
+                 stage_s=None):
         self._exe = exe
         self._outs = outs
         self._error = error
         self._sync = sync if sync is not None else outs
         self._done = error is not None
+        self._trace = trace
+        # single-element claim box: list.pop() is GIL-atomic, so exactly
+        # ONE of two concurrent waiters (producer blocked in window
+        # backpressure + consumer in result()) completes the trace —
+        # both passing the unsynchronized _done check must not
+        # double-count the execute stage or write the trace line twice
+        self._tclaim = [trace] if trace is not None else []
+        self._t0 = None if self._done else time.perf_counter()
+        self._wall0 = time.time() * 1e6
+        self._stage_s = stage_s
+        self._exec_s = None
+        self._sync_s = None
 
     def _ready_nonblock(self):
         if self._done:
@@ -625,7 +648,24 @@ class StepFuture(object):
                     # like a dispatch-time fault
                     self._error = e
             self._done = True
+            if self._t0 is not None and self._exec_s is None:
+                self._exec_s = time.perf_counter() - self._t0
             self._exe._inflight_discard(self)
+            try:
+                tr = self._tclaim.pop()
+            except IndexError:
+                tr = None
+            if tr is not None:
+                # the completion thread closes the step's trace: an
+                # 'execute' stage spanning dispatch->device-complete plus
+                # a span on THIS thread (which may not be the submitter —
+                # the flow event links the hop in exported traces)
+                if self._exec_s is not None:
+                    tr.add_stage('execute', self._exec_s)
+                    monitor.record_span('step.execute', self._wall0,
+                                        self._exec_s * 1e6, trace=tr)
+                tr.finish('error' if self._error is not None else 'ok',
+                          error=self._error)
         return self
 
     def result(self, return_numpy=True):
@@ -642,6 +682,7 @@ class StepFuture(object):
             # dispatch) is the point of asking for them
             return [_fetched(f.arr, f.lod) if isinstance(f, _DeferredFetch)
                     else f for f in self._outs]
+        t_sync = time.perf_counter()
         out, host_bytes = [], 0
         for f in self._outs:
             if isinstance(f, _DeferredFetch):
@@ -656,6 +697,8 @@ class StepFuture(object):
                 out.append(a)
         if host_bytes:
             monitor.inc('fetch_host_bytes', host_bytes)
+        if self._sync_s is None:
+            self._sync_s = time.perf_counter() - t_sync
         return out
 
     def exception(self):
@@ -663,6 +706,21 @@ class StepFuture(object):
         success) instead of raising it."""
         self.wait()
         return self._error
+
+    @property
+    def timing(self):
+        """Structured latency breakdown of this step (None until the
+        step completed): stage_s / execute_s / sync_s / total_s, plus
+        trace_id when the step carried a trace."""
+        if not self._done:
+            return None
+        parts = [s for s in (self._stage_s, self._exec_s, self._sync_s)
+                 if s is not None]
+        d = {'stage_s': self._stage_s, 'execute_s': self._exec_s,
+             'sync_s': self._sync_s, 'total_s': sum(parts)}
+        if self._trace is not None:
+            d['trace_id'] = self._trace.trace_id
+        return d
 
 
 class _FeedSpec(object):
@@ -910,17 +968,22 @@ class Executor(object):
         # instrumented from here down: 'run' span + per-run wall-latency
         # histogram (the delegating paths above recurse into run() and
         # would double-count). The counter counts ATTEMPTS — a run that
-        # raises (nan check, bad feed) must not vanish from the rate
-        with monitor.timed_span('run', 'executor_run_seconds'):
-            monitor.inc('executor_run_total')
-            if analysis.profile_ops_active():
-                # op-attribution mode (PADDLE_PROFILE_OPS / profile_ops()):
-                # interpret the program op by op with per-op timing
-                return analysis.run_profiled(self, program, feed,
-                                             fetch_list, scope,
-                                             return_numpy)
-            return self._run_impl(program, feed, fetch_list, scope,
-                                  return_numpy, use_program_cache, donate)
+        # raises (nan check, bad feed) must not vanish from the rate.
+        # step_scope: a bare run with no ambient trace may start its own
+        # head-sampled 'step' trace (PADDLE_TRACE_SAMPLE); the sampled-out
+        # path costs one env read + one thread-local read + one random()
+        with trace_mod.step_scope('step'):
+            with monitor.timed_span('run', 'executor_run_seconds'):
+                monitor.inc('executor_run_total')
+                if analysis.profile_ops_active():
+                    # op-attribution mode (PADDLE_PROFILE_OPS /
+                    # profile_ops()): interpret the program op by op
+                    return analysis.run_profiled(self, program, feed,
+                                                 fetch_list, scope,
+                                                 return_numpy)
+                return self._run_impl(program, feed, fetch_list, scope,
+                                      return_numpy, use_program_cache,
+                                      donate)
 
     # ------------------------------------------------------------------
     def run_async(self, program=None, feed=None, fetch_list=None,
@@ -982,6 +1045,10 @@ class Executor(object):
             oldest.wait()
             monitor.observe('step_wait_seconds',
                             time.perf_counter() - t0)
+        # a bare async step with no ambient trace may start its own
+        # head-sampled trace; it travels on the future and is finished by
+        # whichever thread completes the step (wait/result)
+        own = trace_mod.maybe_trace('step')
         t0 = time.perf_counter()
         monitor.inc('executor_run_async_total')
         donate_override = donate
@@ -989,30 +1056,46 @@ class Executor(object):
             donate_override = 'inflight'
         sync_out = []
         try:
-            with monitor.span('run_async'):
-                if hasattr(program, '_executor_run'):
-                    # CompiledProgram delegation has its own dispatch
-                    # path; run it synchronously and hand back a
-                    # completed future (correct, without overlap)
-                    outs = program._executor_run(
-                        self, feed, fetch_list, scope, False,
-                        donate=False if donate_override == 'inflight'
-                        else donate)
-                elif analysis.profile_ops_active():
-                    outs = analysis.run_profiled(self, program, feed,
-                                                 fetch_list, scope, False)
-                else:
-                    outs = self._run_impl(program, feed, fetch_list,
-                                          scope, False, use_program_cache,
-                                          donate_override,
-                                          _sync_out=sync_out)
+            with trace_mod.activate(own):
+                with monitor.span('run_async'):
+                    if hasattr(program, '_executor_run'):
+                        # CompiledProgram delegation has its own dispatch
+                        # path; run it synchronously and hand back a
+                        # completed future (correct, without overlap)
+                        outs = program._executor_run(
+                            self, feed, fetch_list, scope, False,
+                            donate=False if donate_override == 'inflight'
+                            else donate)
+                    elif analysis.profile_ops_active():
+                        outs = analysis.run_profiled(self, program, feed,
+                                                     fetch_list, scope,
+                                                     False)
+                    else:
+                        outs = self._run_impl(program, feed, fetch_list,
+                                              scope, False,
+                                              use_program_cache,
+                                              donate_override,
+                                              _sync_out=sync_out)
         except Exception as e:      # noqa: BLE001 — delivered on the future
             with self._async_cv:
                 self._pending_submit -= 1
                 self._async_cv.notify_all()
-            monitor.observe('stage_seconds', time.perf_counter() - t0)
-            return StepFuture(self, None, error=e)
-        fut = StepFuture(self, outs, sync=(outs, sync_out))
+            stage_s = time.perf_counter() - t0
+            monitor.observe('stage_seconds', stage_s)
+            if own is not None:
+                # a staging failure never reaches wait(): close the
+                # trace here so the error is kept (keep-errors); the
+                # future still carries it so fut.timing names the
+                # trace_id (wait() never re-finishes a _done future)
+                own.add_stage('stage', stage_s)
+                own.finish('error', error=e)
+            return StepFuture(self, None, error=e, trace=own,
+                              stage_s=stage_s)
+        stage_s = time.perf_counter() - t0
+        if own is not None:
+            own.add_stage('stage', stage_s)
+        fut = StepFuture(self, outs, sync=(outs, sync_out), trace=own,
+                         stage_s=stage_s)
         with self._async_cv:
             self._pending_submit -= 1
             self._inflight.append(fut)
@@ -1025,7 +1108,7 @@ class Executor(object):
             monitor.set_gauge('executor_inflight_peak',
                               float(self._inflight_peak))
             self._async_cv.notify_all()
-        monitor.observe('stage_seconds', time.perf_counter() - t0)
+        monitor.observe('stage_seconds', stage_s)
         return fut
 
     def _run_impl(self, program, feed, fetch_list, scope, return_numpy,

@@ -33,6 +33,7 @@ docs/observability.md.
 """
 import bisect
 import collections
+import itertools
 import json
 import math
 import os
@@ -48,6 +49,22 @@ _lock = threading.RLock()
 _counters = {}          # name -> {label_key: float}
 _gauges = {}            # name -> {label_key: float}
 _hists = {}             # name -> {label_key: _Hist}
+
+# Causal-trace context (trace.py binds/unbinds it): when a trace is
+# active on a thread, _trace_ctx[tid] = (Trace, parent_span_id) and — if
+# the trace is sampled — every span recorded there annotates with
+# trace_id/span_id/parent_id. Lives here, not in trace.py, so the span
+# hot path needs no cross-module import. A plain dict keyed by thread
+# id, NOT threading.local: local's getattr costs ~0.7 us in sandboxed
+# containers vs ~0.15 us for dict.get(get_ident()), and this read is on
+# every span and every run (get/set of one key are GIL-atomic; entries
+# are popped when a context deactivates, so dead threads don't leak).
+_trace_ctx = {}
+_span_ids = itertools.count(1)
+
+
+def _new_span_id():
+    return next(_span_ids)
 
 # reserved series absorbing label sets beyond the cardinality cap
 _OVERFLOW_KEY = (('other', 'true'),)
@@ -69,12 +86,28 @@ def _max_series():
     return _env_int('PADDLE_MONITOR_MAX_SERIES', 64)
 
 
+# exact-quantile sample ring per histogram series: while a series has
+# seen <= this many observations, p50/p90/p99 are computed exactly from
+# the retained samples instead of bucket interpolation (short-lived test
+# runs and per-request latencies get exact numbers); past it the fixed
+# buckets take over and the ring only bounds memory
+_HIST_RING = 512
+
+
+def _rank_idx(q, n):
+    """Nearest-rank quantile index: the smallest i with (i+1)/n >= q."""
+    return min(n - 1, max(0, int(math.ceil(q * n)) - 1))
+
+
 class _Hist(object):
-    """Fixed-bucket latency histogram: O(1) observe, percentiles by linear
-    interpolation inside the owning bucket (same estimator Prometheus'
+    """Fixed log-spaced-bucket latency histogram: O(1) observe. The
+    bucket counts COMPOSE across processes (obsreport --merge sums them
+    and recovers true fleet percentiles); quantiles are exact from the
+    sample ring while it still holds every observation, else by linear
+    interpolation inside the owning bucket (the estimator Prometheus'
     histogram_quantile uses)."""
 
-    __slots__ = ('counts', 'n', 'total', 'vmin', 'vmax')
+    __slots__ = ('counts', 'n', 'total', 'vmin', 'vmax', 'ring')
 
     def __init__(self):
         self.counts = [0] * (len(_BOUNDS) + 1)   # +1: > last bound
@@ -82,6 +115,7 @@ class _Hist(object):
         self.total = 0.0
         self.vmin = None
         self.vmax = None
+        self.ring = []
 
     def add(self, v):
         if not math.isfinite(v):
@@ -92,6 +126,10 @@ class _Hist(object):
             d[()] = d.get((), 0.0) + 1
             return
         self.counts[bisect.bisect_left(_BOUNDS, v)] += 1
+        if len(self.ring) < _HIST_RING:
+            self.ring.append(v)
+        else:
+            self.ring[self.n % _HIST_RING] = v
         self.n += 1
         self.total += v
         self.vmin = v if self.vmin is None else min(self.vmin, v)
@@ -100,6 +138,9 @@ class _Hist(object):
     def quantile(self, q):
         if not self.n:
             return None
+        if self.n <= len(self.ring):
+            srt = sorted(self.ring[:self.n])
+            return srt[_rank_idx(q, self.n)]
         target = q * self.n
         cum = 0.0
         for i, c in enumerate(self.counts):
@@ -113,14 +154,33 @@ class _Hist(object):
             cum += c
         return self.vmax
 
+    def bucket_pairs(self):
+        """Nonzero buckets as [upper_bound, count] pairs; the overflow
+        bucket's bound is None (JSON has no +Inf). This is the composable
+        representation snapshot logs carry for cross-rank percentiles."""
+        out = [[_BOUNDS[i], c] for i, c in
+               enumerate(self.counts[:-1]) if c]
+        if self.counts[-1]:
+            out.append([None, self.counts[-1]])
+        return out
+
     def stats(self):
         if not self.n:
             return {'count': 0, 'sum': 0.0}
+        if self.n <= len(self.ring):
+            srt = sorted(self.ring[:self.n])
+
+            def q(p):
+                return srt[_rank_idx(p, self.n)]
+            p50, p90, p99 = q(0.5), q(0.9), q(0.99)
+        else:
+            p50, p90, p99 = (self.quantile(0.5), self.quantile(0.9),
+                             self.quantile(0.99))
         return {'count': self.n, 'sum': self.total,
                 'min': self.vmin, 'max': self.vmax,
                 'avg': self.total / self.n,
-                'p50': self.quantile(0.5), 'p90': self.quantile(0.9),
-                'p99': self.quantile(0.99)}
+                'p50': p50, 'p90': p90, 'p99': p99,
+                'buckets': self.bucket_pairs()}
 
 
 def _labels_key(labels):
@@ -239,9 +299,14 @@ class _Span(object):
     protocol costs ~2-3 us per span on the hot path for nothing. Each
     span(name) call returns a fresh single-use instance; calling it on a
     function uses it as a decorator (a fresh span per invocation), matching
-    the old contextlib-based record_event."""
+    the old contextlib-based record_event.
 
-    __slots__ = ('name', 'ts', 't0')
+    When a SAMPLED trace is bound to this thread (trace.activate), the
+    span records trace_id/span_id/parent_id and becomes the parent of
+    spans nested inside it — the causality export_chrome_tracing turns
+    into flow events. The no-trace fast path pays one thread-local read."""
+
+    __slots__ = ('name', 'ts', 't0', '_tctx', '_sid')
 
     def __init__(self, name):
         self.name = name
@@ -256,14 +321,30 @@ class _Span(object):
         return wrapped
 
     def __enter__(self):
+        tid = threading.get_ident()
+        ctx = _trace_ctx.get(tid)
+        if ctx is not None and ctx[0].sampled:
+            self._tctx = ctx
+            self._sid = _new_span_id()
+            _trace_ctx[tid] = (ctx[0], self._sid)   # nested spans chain
+        else:
+            self._tctx = None
         self.ts = time.time() * 1e6
         self.t0 = time.perf_counter()
         return self
 
     def __exit__(self, *exc):
+        tid = threading.get_ident()
         rec = {'name': self.name, 'ts': self.ts,
                'dur': (time.perf_counter() - self.t0) * 1e6,
-               'pid': _PID, 'tid': threading.get_ident()}
+               'pid': _PID, 'tid': tid}
+        ctx = self._tctx
+        if ctx is not None:
+            _trace_ctx[tid] = ctx                   # pop this span
+            rec['trace_id'] = ctx[0].trace_id
+            rec['span_id'] = self._sid
+            if ctx[1] is not None:
+                rec['parent_id'] = ctx[1]
         # appended under the registry lock so spans() can iterate the deque
         # without racing a concurrent append (deque iteration raises on
         # mutation); deque.append alone is atomic but iteration is not
@@ -278,6 +359,36 @@ def span(name):
     multi-threaded serving traces keep one row per thread. Always recorded;
     the bounded ring makes that safe."""
     return _Span(name)
+
+
+def record_span(name, ts_us, dur_us, tid=None, trace=None, parent_id=None,
+                span_id=None):
+    """Retrospective span: append a ready-made record to the ring. The
+    serving engines use this to stamp per-request stage spans (queue wait,
+    batch formation, execute, sync) AFTER the fact, on whatever thread
+    processed the stage — with `tid` naming the thread the stage
+    conceptually belongs to (the submitter's tid for queue wait). With
+    `trace` (a sampled trace.Trace), the record carries causality:
+    span_id fresh unless given, parent defaulting to the trace's root."""
+    if trace is not None and not trace.sampled:
+        # an unsampled unit must cost NOTHING on the ring — at serving
+        # throughput, per-request stage spans would churn the whole
+        # 4096-entry ring in seconds (checked before any allocation:
+        # this is the dominant path at 1% sampling)
+        return
+    rec = {'name': name, 'ts': float(ts_us), 'dur': float(dur_us),
+           'pid': _PID,
+           'tid': tid if tid is not None else threading.get_ident()}
+    if trace is not None:
+        sid = span_id if span_id is not None else _new_span_id()
+        rec['trace_id'] = trace.trace_id
+        rec['span_id'] = sid
+        if sid != trace.root_id:
+            rec['parent_id'] = parent_id if parent_id is not None \
+                else trace.root_id
+    with _lock:
+        _spans.append(rec)
+        _n_spans[0] += 1
 
 
 class _TimedSpan(_Span):

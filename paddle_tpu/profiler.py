@@ -132,21 +132,58 @@ def export_chrome_tracing(path, since_ts=None):
     how stop_profiler scopes a session export to the profiled window.
     Gauge samples the monitor's counter-track list recorded (memory /
     queue depth) are emitted as chrome counter events (``"ph": "C"``), so
-    the trace shows load curves alongside spans. A bad path raises
-    (fail-loudly doctrine — same contract as the device tracer in
-    start_profiler); it must not produce a silently missing trace."""
+    the trace shows load curves alongside spans.
+
+    Spans recorded under a sampled trace (docs/observability.md
+    "Request & step tracing") carry ``args: {trace_id, span_id,
+    parent_id}``, and each trace's thread hops become chrome FLOW events
+    (``"ph": "s"``/``"f"``): consecutive spans of one trace on different
+    tids are linked by an arrow, so a request's path through the submit
+    thread, the batcher pool, and the completion thread reads as one
+    causal chain on the timeline.
+
+    A bad path raises (fail-loudly doctrine — same contract as the
+    device tracer in start_profiler); it must not produce a silently
+    missing trace."""
     events = monitor.spans()
     if since_ts is not None:
         events = [e for e in events
                   if e['ts'] + e.get('dur', 0.0) >= since_ts]
     out = []
+    traced = {}                 # trace_id -> [(ts, dur, tid)]
     for e in events:
         if e.get('ph') == 'C':
             out.append({'name': e['name'], 'ph': 'C', 'ts': e['ts'],
                         'pid': e['pid'],
                         'args': {e['name']: e['value']}})
         else:
-            out.append({'name': e['name'], 'ph': 'X', 'ts': e['ts'],
-                        'dur': e['dur'], 'pid': e['pid'], 'tid': e['tid']})
+            ev = {'name': e['name'], 'ph': 'X', 'ts': e['ts'],
+                  'dur': e['dur'], 'pid': e['pid'], 'tid': e['tid']}
+            if 'trace_id' in e:
+                args = {'trace_id': e['trace_id'],
+                        'span_id': e['span_id']}
+                if 'parent_id' in e:
+                    args['parent_id'] = e['parent_id']
+                ev['args'] = args
+                traced.setdefault(e['trace_id'], []).append(
+                    (e['ts'], e.get('dur', 0.0), e['tid'], e['pid']))
+            out.append(ev)
+    # flow events: link one trace's spans across thread hops so the
+    # request reads as a causal chain, not disconnected slices
+    for trace_id, spans_ in traced.items():
+        spans_.sort()
+        k = 0
+        for (ts0, d0, tid0, pid0), (ts1, d1, tid1, pid1) in \
+                zip(spans_, spans_[1:]):
+            if tid0 == tid1:
+                continue
+            k += 1
+            fid = '%s.%d' % (trace_id, k)
+            s_ts = min(ts0 + d0, ts1)   # arrow start inside the source
+            out.append({'name': 'trace', 'cat': 'trace', 'ph': 's',
+                        'id': fid, 'ts': s_ts, 'pid': pid0, 'tid': tid0})
+            out.append({'name': 'trace', 'cat': 'trace', 'ph': 'f',
+                        'bp': 'e', 'id': fid, 'ts': ts1, 'pid': pid1,
+                        'tid': tid1})
     with open(path, 'w') as f:
         json.dump({'traceEvents': out}, f)

@@ -61,6 +61,7 @@ import zlib
 import numpy as np
 
 from . import monitor
+from . import trace as trace_mod
 
 __all__ = ['InjectedFault', 'NonFiniteError', 'RetryPolicy', 'TrainingGuard',
            'maybe_fault', 'install_fault', 'clear_faults', 'fault_spec',
@@ -336,6 +337,8 @@ class RetryPolicy(object):
 
         def _donated_giveup(cause):
             monitor.inc('retry_giveup_total', labels={'site': site})
+            trace_mod.note('retry_giveup', site=site, reason='donated',
+                           error=type(cause).__name__)
             return RuntimeError(
                 "cannot retry %r after %s: the failed attempt consumed "
                 "donated input buffers (set PADDLE_DONATE=0 to trade peak "
@@ -363,6 +366,8 @@ class RetryPolicy(object):
                     # name the real blocker, not the last transient error
                     raise _donated_giveup(e) from e
         monitor.inc('retry_giveup_total', labels={'site': site})
+        trace_mod.note('retry_giveup', site=site, reason='exhausted',
+                       error=type(last).__name__)
         raise last
 
 
@@ -863,10 +868,36 @@ def elastic_train_loop(step_fn, manager, num_steps, start_step=0, mesh=None,
     result reads as one uninterrupted trajectory. Each resume increments
     ``elastic_resume_total`` and updates the ``elastic_world_size``
     gauge; ``on_resume(step, mesh, exc)`` is called before the first
-    replayed step."""
+    replayed step.
+
+    The whole run is one trace (kind ``elastic``, always kept): every
+    resume, replicate-fallback, save-skip, and give-up lands in the
+    trace log as a structured event stamped with the incarnation's
+    trace ID — a post-mortem reconstructs the full recovery sequence
+    (who died, which direction the reshard went, what world size came
+    back) from one ``tools/tracereport.py`` read. See
+    docs/observability.md."""
     from .distributed.launch import WorkerFailedError
     from .parallel import mesh as mesh_mod
 
+    tr = trace_mod.start('elastic', name='elastic_train_loop',
+                         sampled=True)
+    with trace_mod.activate(tr):
+        try:
+            outputs = _elastic_loop_body(
+                step_fn, manager, num_steps, start_step, mesh, devices_fn,
+                reshard, max_resumes, on_resume, tr, WorkerFailedError,
+                mesh_mod)
+        except BaseException as e:
+            tr.finish('error', error=e)
+            raise
+    tr.finish('ok', steps=int(num_steps))
+    return outputs
+
+
+def _elastic_loop_body(step_fn, manager, num_steps, start_step, mesh,
+                       devices_fn, reshard, max_resumes, on_resume, tr,
+                       WorkerFailedError, mesh_mod):
     outputs = [None] * int(num_steps)
     step = int(start_step)
     resumes = 0
@@ -880,15 +911,25 @@ def elastic_train_loop(step_fn, manager, num_steps, start_step=0, mesh=None,
             resumes += 1
             if resumes > max_resumes:
                 monitor.inc('elastic_giveup_total')
+                tr.event('elastic_giveup', step=step, resumes=resumes,
+                         failure=type(e).__name__)
                 raise
             fail_step = step
             import jax
             devices = list(devices_fn()) if devices_fn is not None \
                 else list(jax.devices())
+            old_size = int(mesh.devices.size) if mesh is not None else None
             if mesh is not None:
                 mesh = mesh_mod.surviving_mesh(mesh, devices)
             else:
                 mesh = mesh_mod.data_mesh(devices=devices)
+            new_size = int(mesh.devices.size)
+            if old_size is None:
+                direction = 'fresh'
+            elif new_size == old_size:
+                direction = 'same'
+            else:
+                direction = 'shrink' if new_size < old_size else 'grow'
             try:
                 rstep, path, _names = manager.restore_latest(
                     mesh=mesh, reshard=reshard)
@@ -917,6 +958,8 @@ def elastic_train_loop(step_fn, manager, num_steps, start_step=0, mesh=None,
                         "retrying fully replicated" % restore_err,
                         stacklevel=2)
                     monitor.inc('elastic_replicate_fallback_total')
+                    tr.event('elastic_replicate_fallback', step=step,
+                             world_size=new_size)
                     try:
                         rstep, path, _names = manager.restore_latest(
                             mesh=mesh, reshard='replicate')
@@ -950,6 +993,10 @@ def elastic_train_loop(step_fn, manager, num_steps, start_step=0, mesh=None,
             monitor.inc('elastic_resume_total')
             monitor.set_gauge('elastic_world_size',
                               float(mesh.devices.size))
+            tr.event('elastic_resume', step=fail_step,
+                     failure=type(e).__name__, world_size=new_size,
+                     reshard_direction=direction, restored_step=rstep,
+                     resume_step=step)
             if on_resume is not None:
                 on_resume(step, mesh, e)
             continue
@@ -965,6 +1012,8 @@ def elastic_train_loop(step_fn, manager, num_steps, start_step=0, mesh=None,
             # — silent RPO decay would be worse than the warning spam)
             import warnings
             monitor.inc('elastic_save_skipped_total')
+            tr.event('elastic_save_skipped', step=step,
+                     error=type(save_err).__name__)
             warnings.warn(
                 "elastic_train_loop: checkpoint save after step %d failed "
                 "(%s: %s); continuing — recovery falls back to the "

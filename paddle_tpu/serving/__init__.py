@@ -12,7 +12,8 @@ from .bucketing import BucketLadder
 from .batcher import (ServingError, LoadShedError, DeadlineExceededError,
                       EngineStoppedError, Request, RequestQueue)
 from .engine import ServingConfig, ServingEngine, create_engine
-from .generate import GenerateConfig, GenerateEngine, GenerateRequest
+from .generate import (GenerateConfig, GenerateEngine, GenerateRequest,
+                       GenerateResult)
 
 __all__ = [
     'BucketLadder', 'Request', 'RequestQueue',
@@ -20,4 +21,5 @@ __all__ = [
     'EngineStoppedError',
     'ServingConfig', 'ServingEngine', 'create_engine',
     'GenerateConfig', 'GenerateEngine', 'GenerateRequest',
+    'GenerateResult',
 ]

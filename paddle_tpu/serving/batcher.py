@@ -19,6 +19,8 @@ collection time so a dead request never occupies accelerator time.
 import threading
 import time
 
+from .. import trace as trace_mod
+
 __all__ = ['ServingError', 'LoadShedError', 'DeadlineExceededError',
            'EngineStoppedError', 'Request', 'RequestQueue']
 
@@ -83,14 +85,31 @@ class EngineStoppedError(ServingError):
     """The engine was stopped while the request was queued."""
 
 
+def _trace_outcome(error):
+    """Map a request failure to its trace/metric outcome label."""
+    if isinstance(error, DeadlineExceededError):
+        return 'deadline'
+    if isinstance(error, LoadShedError):
+        return 'shed'
+    if isinstance(error, EngineStoppedError):
+        return 'stopped'
+    return 'error'
+
+
 class Request(object):
     """One in-flight request: feed + bucket metadata + a one-shot
     future. Workers call done()/fail(); the submitting thread blocks in
-    result()."""
+    result().
+
+    `trace` (set by the engine at submit) is the request's causal trace
+    (trace.py): the engine accumulates the latency-budget stages
+    (queue/batch/execute/sync) on it, and done()/fail() finish it with
+    the right outcome — the flattened breakdown lands on ``timing``
+    (``{'trace_id', 'total_s', 'queue_s', ...}``)."""
 
     __slots__ = ('feed', 'n_rows', 'seq_len', 'key', 'deadline',
-                 'enqueue_t', 'return_numpy', '_event', '_result',
-                 '_error')
+                 'enqueue_t', 'enqueue_wall', 'return_numpy', 'trace',
+                 'timing', '_tid', '_event', '_result', '_error')
 
     def __init__(self, feed, n_rows, seq_len, key, deadline,
                  return_numpy=True):
@@ -103,6 +122,10 @@ class Request(object):
         # the engine only materializes numpy per request on delivery
         self.return_numpy = return_numpy
         self.enqueue_t = time.monotonic()
+        self.enqueue_wall = time.time() * 1e6
+        self.trace = None
+        self.timing = None
+        self._tid = threading.get_ident()   # submitter (queue-span owner)
         self._event = threading.Event()
         self._result = None
         self._error = None
@@ -111,12 +134,25 @@ class Request(object):
         return self.deadline is not None and \
             (now if now is not None else time.monotonic()) > self.deadline
 
+    def _finish_trace(self, outcome, error=None):
+        tr = self.trace
+        if tr is None or self.timing is not None:
+            return
+        if 'queue' not in tr.stages:
+            # never dispatched (expired/shed/stopped in queue): its whole
+            # life was queue wait — account it so the breakdown composes
+            tr.add_stage('queue', max(0.0,
+                                      time.monotonic() - self.enqueue_t))
+        self.timing = trace_mod.flat_timing(tr.finish(outcome, error=error))
+
     def done(self, result):
         self._result = result
+        self._finish_trace('ok')
         self._event.set()
 
     def fail(self, error):
         self._error = error
+        self._finish_trace(_trace_outcome(error), error)
         self._event.set()
 
     def result(self, timeout=None):

@@ -38,6 +38,7 @@ import numpy as np
 
 from .. import monitor
 from .. import resilience
+from .. import trace as trace_mod
 from ..inference import Predictor, PredictorConfig
 from .batcher import (ServingError, LoadShedError, DeadlineExceededError,
                       EngineStoppedError, Request, RequestQueue,
@@ -233,10 +234,19 @@ class ServingEngine(object):
                     if deadline_s is not None else None)
         req = Request(feed, n_rows, seq_len, key, deadline,
                       return_numpy=return_numpy)
+        # every request is a traced unit of work: stage accounting (the
+        # timing breakdown on req.timing) is unconditional; span-level
+        # recording and the trace-log line ride head sampling
+        req.trace = trace_mod.start('serving')
         try:
             self.queue.put(req)
-        except LoadShedError:
-            monitor.inc('serving_request_total', labels={'outcome': 'shed'})
+        except (LoadShedError, EngineStoppedError) as e:
+            # finishes the trace with the right outcome (keep-errors: a
+            # rejected request is never invisible in the trace log)
+            monitor.inc('serving_request_total', labels={
+                'outcome': 'shed' if isinstance(e, LoadShedError)
+                else 'stopped'})
+            req.fail(e)
             raise
         monitor.set_gauge('serving_queue_depth', self.queue.depth())
         return req
@@ -366,14 +376,29 @@ class ServingEngine(object):
 
     def _dispatch_batch(self, batch):
         """Form one padded batch and dispatch it asynchronously. Returns
-        the pending (future, batch, padded_rows, t0) record for
+        the pending (future, batch, padded_rows, t0, wall_us) record for
         `_finish_batch`, or None when formation failed (those requests
-        are already failed — the pool never dies)."""
+        are already failed — the pool never dies).
+
+        Trace accounting: each request's 'queue' stage closes here
+        (enqueue -> this worker picking it up, co-rider wait included)
+        and the shared formation time lands as its 'batch' stage; for
+        sampled traces the matching spans are stamped retrospectively —
+        the queue span on the SUBMITTER's tid, formation on this
+        worker's — so exported traces show the thread hop."""
         with monitor.span('serving.batch'):
+            t_form0 = time.perf_counter()
+            form_wall = time.time() * 1e6
+            now_m = time.monotonic()
             n_rows = sum(r.n_rows for r in batch)
             for r in batch:
-                monitor.observe('serving_queue_seconds',
-                                time.monotonic() - r.enqueue_t)
+                qs = max(0.0, now_m - r.enqueue_t)
+                monitor.observe('serving_queue_seconds', qs)
+                if r.trace is not None:
+                    r.trace.add_stage('queue', qs)
+                    monitor.record_span('request.queue', r.enqueue_wall,
+                                        qs * 1e6, tid=r._tid,
+                                        trace=r.trace)
             try:
                 padded = [self.ladder.pad_request(r.feed, r.seq_len)
                           for r in batch]
@@ -393,6 +418,12 @@ class ServingEngine(object):
                             n_rows / float(padded_rows))
             monitor.inc('serving_batch_total')
             monitor.inc('serving_batch_padded_rows', padded_rows - n_rows)
+            form_s = time.perf_counter() - t_form0
+            for r in batch:
+                if r.trace is not None:
+                    r.trace.add_stage('batch', form_s)
+                    monitor.record_span('request.batch', form_wall,
+                                        form_s * 1e6, trace=r.trace)
             t0 = time.perf_counter()
             monitor.set_gauge('serving_inflight_batches', self._inflight(1))
             p = self.predictor
@@ -401,14 +432,14 @@ class ServingEngine(object):
             fut = p.executor.run_async(p.program, feed=stacked,
                                        fetch_list=p.fetch_vars,
                                        scope=p.scope, donate=False)
-            return (fut, batch, padded_rows, t0)
+            return (fut, batch, padded_rows, t0, time.time() * 1e6)
 
     def _finish_batch(self, pending):
         """Wait for a dispatched batch, then deliver per-request slices.
         serving_execute_seconds spans dispatch→device completion (it may
         include host time the worker spent forming the NEXT batch — the
         overlap is the point)."""
-        fut, batch, padded_rows, t0 = pending
+        fut, batch, padded_rows, t0, disp_wall = pending
         try:
             try:
                 with monitor.span('serving.execute'):
@@ -419,13 +450,21 @@ class ServingEngine(object):
             finally:
                 monitor.set_gauge('serving_inflight_batches',
                                   self._inflight(-1))
-            monitor.observe('serving_execute_seconds',
-                            time.perf_counter() - t0)
+            exec_s = time.perf_counter() - t0
+            monitor.observe('serving_execute_seconds', exec_s)
+            for r in batch:
+                if r.trace is not None:
+                    r.trace.add_stage('execute', exec_s)
+                    monitor.record_span('request.execute', disp_wall,
+                                        exec_s * 1e6, trace=r.trace)
         except Exception as e:      # noqa: BLE001 — delivered per-request
             # a failed batch fails ITS requests; the worker and the
             # pool live on (retry-exhausted transients land here too)
             monitor.inc('serving_batch_error_total')
             for r in batch:
+                if r.trace is not None:
+                    r.trace.add_stage('execute',
+                                      time.perf_counter() - t0)
                 monitor.inc('serving_request_total',
                             labels={'outcome': 'error'})
                 r.fail(e)
@@ -449,7 +488,15 @@ class ServingEngine(object):
             # the rest of the batch or kill the worker — "the pool never
             # dies" covers the un-batch path too
             try:
-                r.done(self._slice_result(outs, off, r, padded_rows))
+                t_sync0 = time.perf_counter()
+                sync_wall = time.time() * 1e6
+                res = self._slice_result(outs, off, r, padded_rows)
+                if r.trace is not None:
+                    sync_s = time.perf_counter() - t_sync0
+                    r.trace.add_stage('sync', sync_s)
+                    monitor.record_span('request.sync', sync_wall,
+                                        sync_s * 1e6, trace=r.trace)
+                r.done(res)
                 monitor.inc('serving_request_total',
                             labels={'outcome': 'ok'})
             except Exception as e:      # noqa: BLE001 — delivered per-request

@@ -50,6 +50,7 @@ import time
 import numpy as np
 
 from .. import monitor
+from .. import trace as trace_mod
 from .. import unique_name
 from ..executor import Executor, Scope, scope_guard
 from ..framework import Program, TPUPlace, program_guard
@@ -60,9 +61,34 @@ from .batcher import (DeadlineExceededError, EngineStoppedError,
                       LoadShedError, Request, RequestQueue,
                       resolve_metrics_port, start_metrics_server)
 
-__all__ = ['GenerateConfig', 'GenerateEngine', 'GenerateRequest']
+__all__ = ['GenerateConfig', 'GenerateEngine', 'GenerateRequest',
+           'GenerateResult']
 
 _DONE = object()
+
+
+class GenerateResult(list):
+    """What ``GenerateRequest.result()`` returns: the generated token ids
+    (it IS a list — equality/iteration/len behave like the token list)
+    plus the structured completion metadata a caller routing on latency
+    needs:
+
+    - ``finish_reason``: 'eos' | 'length' | 'cache_full'
+    - ``timing``: the request's latency budget — ``queue_s``,
+      ``prefill_s``, ``decode_step_s`` (sum over steps), ``total_s``,
+      ``tokens``, ``step_s_mean`` / ``step_s_p99`` (per-token decode
+      gaps), and the ``trace_id`` joining it to the trace log
+      (docs/observability.md).
+    """
+
+    def __init__(self, tokens, finish_reason=None, timing=None):
+        list.__init__(self, tokens)
+        self.finish_reason = finish_reason
+        self.timing = timing
+
+    @property
+    def tokens(self):
+        return list(self)
 
 
 class GenerateConfig(object):
@@ -122,13 +148,15 @@ class GenerateConfig(object):
 
 class GenerateRequest(Request):
     """One prompt in flight: the PR 4 future contract (`result()`,
-    `fail()`, deadline) plus a per-token stream. `result()` returns the
-    full generated-token list; ``for tok in req.stream()`` consumes
-    tokens as decode steps deliver them. `finish_reason` is
+    `fail()`, deadline) plus a per-token stream. `result()` returns a
+    `GenerateResult` — the generated-token list enriched with
+    ``finish_reason`` and the ``timing`` breakdown (queue/prefill/
+    per-token decode); ``for tok in req.stream()`` consumes tokens as
+    decode steps deliver them. `finish_reason` is
     'eos' | 'length' | 'cache_full' after a normal finish."""
 
     __slots__ = ('prompt', 'max_new_tokens', 'tokens', 'finish_reason',
-                 '_stream_q')
+                 'step_s', '_stream_q')
 
     def __init__(self, prompt, seq_len, bucket, deadline, max_new_tokens):
         Request.__init__(self, {'prompt': prompt}, 1, seq_len, bucket,
@@ -137,7 +165,8 @@ class GenerateRequest(Request):
         self.max_new_tokens = max_new_tokens
         self.tokens = []
         self.finish_reason = None
-        self._stream_q = _pyqueue.Queue()
+        self.step_s = []        # per-token decode gaps (bounded by
+        self._stream_q = _pyqueue.Queue()   # max_new_tokens)
 
     # engine-side delivery ------------------------------------------------
     def _emit(self, tok):
@@ -146,7 +175,20 @@ class GenerateRequest(Request):
 
     def _finish(self, reason):
         self.finish_reason = reason
-        Request.done(self, list(self.tokens))
+        tr = self.trace
+        if tr is not None and self.timing is None:
+            rec = tr.finish('ok', tokens=len(self.tokens))
+            t = trace_mod.flat_timing(rec)
+            t['tokens'] = len(self.tokens)
+            t['finish_reason'] = reason
+            if self.step_s:
+                srt = sorted(self.step_s)
+                t['step_s_mean'] = sum(srt) / len(srt)
+                t['step_s_p99'] = srt[monitor._rank_idx(0.99, len(srt))]
+            self.timing = t
+        Request.done(self, GenerateResult(self.tokens,
+                                          finish_reason=reason,
+                                          timing=self.timing))
         self._stream_q.put(_DONE)
 
     def fail(self, error):
@@ -178,13 +220,15 @@ class GenerateRequest(Request):
 
 
 class _Slot(object):
-    __slots__ = ('req', 'pos', 'generated', 'last')
+    __slots__ = ('req', 'pos', 'generated', 'last', 'last_t', 'wall0')
 
     def __init__(self, req, pos, last):
         self.req = req
         self.pos = pos          # cache position the NEXT step writes
         self.generated = 1      # prefill already emitted the first token
         self.last = last        # last generated token (next step's input)
+        self.last_t = time.perf_counter()   # previous token's completion
+        self.wall0 = time.time() * 1e6      # decode-phase start (us)
 
 
 class GenerateEngine(object):
@@ -385,11 +429,15 @@ class GenerateEngine(object):
         req = GenerateRequest(prompt, prompt.size,
                               bucketize(prompt.size, buckets), deadline,
                               int(max_new_tokens))
+        req.trace = trace_mod.start('generate')
         try:
             self.queue.put(req)
-        except LoadShedError:
-            monitor.inc('generate_request_total',
-                        labels={'outcome': 'shed'})
+        except (LoadShedError, EngineStoppedError) as e:
+            # finishes the trace with the right outcome (keep-errors)
+            monitor.inc('generate_request_total', labels={
+                'outcome': 'shed' if isinstance(e, LoadShedError)
+                else 'stopped'})
+            req.fail(e)
             raise
         monitor.set_gauge('generate_queue_depth', self.queue.depth())
         return req
@@ -484,7 +532,16 @@ class GenerateEngine(object):
 
     def _admit_one(self, req):
         slot = self._free.pop()
+        qs = max(0.0, time.monotonic() - req.enqueue_t)
+        if req.trace is not None:
+            # queue stage closes at admission; the span rides the
+            # SUBMITTER's tid so the trace shows the thread hop into
+            # this decode loop
+            req.trace.add_stage('queue', qs)
+            monitor.record_span('request.queue', req.enqueue_wall,
+                                qs * 1e6, tid=req._tid, trace=req.trace)
         t0 = time.perf_counter()
+        pf_wall = time.time() * 1e6
         try:
             first = self._run_prefill(slot, req.prompt)
         except Exception as e:  # noqa: BLE001 — delivered per-request
@@ -493,7 +550,12 @@ class GenerateEngine(object):
                         labels={'outcome': 'error'})
             req.fail(e)
             return
-        monitor.observe('prefill_seconds', time.perf_counter() - t0)
+        pf_s = time.perf_counter() - t0
+        monitor.observe('prefill_seconds', pf_s)
+        if req.trace is not None:
+            req.trace.add_stage('prefill', pf_s)
+            monitor.record_span('request.prefill', pf_wall, pf_s * 1e6,
+                                trace=req.trace)
         monitor.inc('decode_tokens_total')
         self._decode_tokens += 1
         req._emit(first)
@@ -574,6 +636,7 @@ class GenerateEngine(object):
             return
         monitor.observe('decode_step_seconds',
                         max(0.0, time.perf_counter() - t0 - exclude_s))
+        now = time.perf_counter()
         n = len(active)
         self._decode_steps += 1
         self._decode_tokens += n
@@ -583,12 +646,25 @@ class GenerateEngine(object):
             st.pos += 1
             st.generated += 1
             st.last = int(nxt[i])
+            # per-request inter-token gap (WALL, overlap included): these
+            # compose the request's 'decode_step' stage so queue +
+            # prefill + decode sums to its end-to-end latency
+            dt = max(0.0, now - st.last_t)
+            st.last_t = now
+            if st.req.trace is not None:
+                st.req.trace.add_stage('decode_step', dt)
+                st.req.step_s.append(dt)
             st.req._emit(st.last)
             reason = self._finish_reason(st)
             if reason:
                 self._release(i)
                 monitor.inc('generate_request_total',
                             labels={'outcome': 'ok'})
+                if st.req.trace is not None and st.req.trace.sampled \
+                        and st.req.step_s:
+                    monitor.record_span('request.decode', st.wall0,
+                                        sum(st.req.step_s) * 1e6,
+                                        trace=st.req.trace)
                 st.req._finish(reason)
         self._set_occupancy()
 

@@ -382,6 +382,123 @@ class TestHistHardening(object):
         _assert_prometheus_parses(text)
 
 
+class TestComposableHists(object):
+    def test_snapshot_carries_bucket_pairs(self):
+        """Satellite: histogram stats expose the fixed log-spaced bucket
+        counts as [upper_bound, count] pairs (None = +Inf overflow) —
+        the composable representation cross-rank merges recover true
+        percentiles from."""
+        for v in (0.0005, 0.003, 0.003, 0.04, 1e9):
+            monitor.observe('bkt_seconds', v)
+        h = monitor.snapshot()['histograms']['bkt_seconds']
+        pairs = h['buckets']
+        assert sum(c for _, c in pairs) == h['count'] == 5
+        bounds = [b for b, _ in pairs]
+        assert bounds[-1] is None               # 1e9 > last bound
+        finite = [b for b in bounds if b is not None]
+        assert finite == sorted(finite)
+        for b, c in pairs:
+            assert c > 0                        # sparse: nonzero only
+
+    def test_exact_quantiles_from_sample_ring(self):
+        """While a series has <= ring-cap observations the percentiles
+        are EXACT (nearest-rank over retained samples), not bucket
+        interpolations — single-process reports stop being estimates."""
+        for v in [0.0011, 0.0012, 0.0013, 0.0014, 0.0019]:
+            monitor.observe('ring_seconds', v)
+        h = monitor.snapshot()['histograms']['ring_seconds']
+        # all five values share the (0.001, 0.002] bucket: interpolation
+        # could not distinguish them, the ring can
+        assert h['p50'] == 0.0013
+        assert h['p99'] == 0.0019
+
+    def test_prometheus_bucket_round_trip(self):
+        """Satellite: the cumulative _bucket{le} exposition round-trips —
+        parsing it back recovers the per-bucket counts exactly, with a
+        monotone cumulative series and le="+Inf" equal to _count."""
+        import re
+        values = [0.0005, 0.003, 0.003, 0.04, 2.0]
+        for v in values:
+            monitor.observe('rt_bkt_seconds', v)
+        text = monitor.export_prometheus()
+        cum, inf_count, total = [], None, None
+        for line in text.splitlines():
+            m = re.match(r'rt_bkt_seconds_bucket\{le="([^"]+)"\} (\d+)',
+                         line)
+            if m:
+                if m.group(1) == '+Inf':
+                    inf_count = int(m.group(2))
+                else:
+                    cum.append((float(m.group(1)), int(m.group(2))))
+            m = re.match(r'rt_bkt_seconds_count (\d+)', line)
+            if m:
+                total = int(m.group(1))
+        assert total == len(values) and inf_count == total
+        assert [c for _, c in cum] == sorted(c for _, c in cum)
+        # de-cumulate and compare against the ground-truth placement
+        bounds = [b for b, _ in cum]
+        per_bucket = [cum[0][1]] + [cum[i][1] - cum[i - 1][1]
+                                    for i in range(1, len(cum))]
+        import bisect
+        expect = [0] * len(bounds)
+        for v in values:
+            expect[bisect.bisect_left(bounds, v)] += 1
+        assert per_bucket == expect
+
+    def test_merge_composes_true_percentiles(self, monkeypatch):
+        """Satellite acceptance: obsreport --merge recovers fleet
+        p50/p95/p99 from summed bucket counts — the PR 5 'percentiles
+        dropped as non-composable' limitation is gone."""
+        import sys
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))), 'tools'))
+        try:
+            import obsreport
+        finally:
+            sys.path.pop(0)
+        snaps = []
+        for rank, values in ((0, [0.0015] * 90), (1, [0.15] * 10)):
+            monkeypatch.setenv('PADDLE_TRAINER_ID', str(rank))
+            monitor.reset()
+            for v in values:
+                monitor.observe('fleet_seconds', v)
+            snaps.append(monitor.snapshot())
+        monkeypatch.delenv('PADDLE_TRAINER_ID')
+        merged = obsreport.merge_snapshots(snaps)
+        h = merged['histograms']['fleet_seconds']
+        assert h['count'] == 100
+        # 90% of mass sits in the (0.001, 0.002] bucket, the top 10% in
+        # (0.1, 0.2]: composed percentiles must land in those buckets —
+        # neither worker alone could produce this split
+        assert 0.001 <= h['p50'] <= 0.002
+        # the owning bucket's LOWER edge must come from the dense ladder
+        # (0.1), not from the last nonzero bucket (0.002) — interpolating
+        # across the empty gap would report p95 ~0.101 instead of ~0.15
+        assert h['p95'] == pytest.approx(0.15, rel=0.05)
+        assert h['p99'] == pytest.approx(0.15, rel=0.05)
+
+    def test_obsreport_skips_trace_lines(self, tmp_path):
+        """Trace records share the monitor-log channel: obsreport must
+        read past them to the newest SNAPSHOT line."""
+        import sys
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))), 'tools'))
+        try:
+            import obsreport
+        finally:
+            sys.path.pop(0)
+        log = str(tmp_path / 'mixed.jsonl')
+        monitor.inc('mixed_total', 7)
+        monitor.log_snapshot(log)
+        with open(log, 'a') as f:
+            f.write(json.dumps({'trace_id': 'abc123', 'kind': 'serving',
+                                'ts': 1.0, 'dur_s': 0.01,
+                                'outcome': 'ok', 'sampled': True,
+                                'stages': {}}) + '\n')
+        snap = obsreport._last_snapshot(log)
+        assert snap['counters']['mixed_total'] == 7
+
+
 class TestChromeCounterTracks(object):
     def test_counter_gauges_become_counter_events(self, tmp_path):
         """Satellite: program_peak_bytes / queue-depth gauge writes land

@@ -43,6 +43,7 @@ def iter_api():
         ('paddle_tpu.clip', fluid.clip),
         ('paddle_tpu.metrics', fluid.metrics),
         ('paddle_tpu.monitor', fluid.monitor),
+        ('paddle_tpu.trace', fluid.trace),
         ('paddle_tpu.analysis', fluid.analysis),
         ('paddle_tpu.resilience', fluid.resilience),
         ('paddle_tpu.evaluator', fluid.evaluator),

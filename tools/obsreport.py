@@ -12,8 +12,12 @@ Fleet mode: ``--merge`` takes the per-worker rank-tagged log files
 ``distributed.launch`` writes (FLAGS_monitor_log becomes
 ``<path>.rank<N>`` per worker) and prints ONE aggregated report — counters
 summed across workers, gauges as min/max spread, histograms merged on
-their mergeable stats (count/sum/min/max; per-worker percentiles don't
-compose, so they are dropped).
+count/sum/min/max AND their fixed log-spaced bucket counts, which compose
+across workers into true fleet p50/p95/p99 (bucket-interpolated; logs
+predating the bucket pairs fall back to count/sum/min/max only).
+
+Trace JSON lines (paddle_tpu.trace shares the monitor-log channel) are
+skipped; ``tools/tracereport.py`` reads that side.
 
 Usage:
     python tools/obsreport.py runlog.jsonl
@@ -112,21 +116,78 @@ def print_trace(trace, out=None):
             a['total'] / a['n'] / 1e3, a['max'] / 1e3, len(a['tids'])))
 
 
+def _is_snapshot(rec):
+    # trace records (paddle_tpu.trace) share the monitor-log channel and
+    # carry a trace_id; snapshot lines never do — tools/tracereport.py
+    # reads the trace side, this tool reads the snapshot side
+    return isinstance(rec, dict) and 'trace_id' not in rec
+
+
 def _last_snapshot(path):
     last = None
     with open(path) as f:
         for line in f:
             if line.strip():
-                last = json.loads(line)
+                rec = json.loads(line)
+                if _is_snapshot(rec):
+                    last = rec
     if last is None:
         raise SystemExit('%s: no snapshot lines' % path)
     return last
 
 
+# The monitor's fixed histogram ladder (1-2-5 log-spaced, 1 us..500 s) —
+# duplicated here because this tool is standalone-importable; the log
+# format's bucket bounds ARE this ladder (docs/observability.md).
+_HIST_BOUNDS = tuple(m * (10.0 ** e) for e in range(-6, 3)
+                     for m in (1, 2, 5))
+
+
+def _bucket_lower_edge(bound):
+    """Lower edge of the bucket whose upper bound is `bound`, from the
+    DENSE ladder — the sparse merged pairs drop empty buckets, so the
+    previous nonzero bucket's bound is NOT the owning bucket's edge
+    (using it would bias percentiles low across gaps in bimodal data)."""
+    if bound is None:
+        return _HIST_BOUNDS[-1]         # overflow bucket
+    import bisect
+    i = bisect.bisect_left(_HIST_BOUNDS, bound)
+    return _HIST_BOUNDS[i - 1] if i > 0 else 0.0
+
+
+def _merged_quantile(buckets, q, count, vmin, vmax):
+    """Percentile from merged bucket counts ({upper_bound_or_None: n}) by
+    linear interpolation inside the owning bucket — the same estimator
+    monitor._Hist uses, so fleet percentiles match what each worker
+    would report past its sample ring."""
+    if not count:
+        return None
+    target = q * count
+    cum = 0.0
+    for bound, c in sorted(buckets.items(),
+                           key=lambda kv: (kv[0] is None, kv[0])):
+        if not c:
+            continue
+        if cum + c >= target:
+            lo = _bucket_lower_edge(bound)
+            hi = bound if bound is not None else (vmax or lo)
+            est = lo + (hi - lo) * (target - cum) / c
+            if vmin is not None:
+                est = max(est, vmin)
+            if vmax is not None:
+                est = min(est, vmax)
+            return est
+        cum += c
+    return vmax
+
+
 def merge_snapshots(snaps):
     """Aggregate per-worker snapshots into one fleet view: counters sum,
-    gauges keep (min, max) across workers, histograms merge their
-    mergeable stats (count/sum/min/max — percentiles don't compose)."""
+    gauges keep (min, max) across workers, histograms merge count/sum/
+    min/max AND their fixed log-spaced bucket counts — buckets compose
+    across workers, so the merged report carries TRUE fleet p50/p95/p99
+    (bucket-interpolated; pre-bucket legacy logs fall back to
+    count/sum/min/max only)."""
     merged = {'workers': len(snaps),
               'ranks': sorted(s.get('rank') for s in snaps
                               if s.get('rank') is not None),
@@ -142,16 +203,26 @@ def merge_snapshots(snaps):
             merged['gauges'][k] = (min(lo, v), max(hi, v))
         for k, h in (s.get('histograms') or {}).items():
             m = merged['histograms'].setdefault(
-                k, {'count': 0, 'sum': 0.0, 'min': None, 'max': None})
+                k, {'count': 0, 'sum': 0.0, 'min': None, 'max': None,
+                    'buckets': {}})
             m['count'] += h.get('count', 0)
             m['sum'] += h.get('sum', 0.0)
             for agg, fn in (('min', min), ('max', max)):
                 v = h.get(agg)
                 if v is not None:
                     m[agg] = v if m[agg] is None else fn(m[agg], v)
+            for bound, c in (h.get('buckets') or []):
+                m['buckets'][bound] = m['buckets'].get(bound, 0) + c
     for k, m in merged['histograms'].items():
         if m['count']:
             m['avg'] = m['sum'] / m['count']
+        if m['buckets'] and \
+                sum(m['buckets'].values()) == m['count']:
+            # every worker's log carried buckets: percentiles compose
+            for name, q in (('p50', 0.5), ('p95', 0.95), ('p99', 0.99)):
+                m[name] = _merged_quantile(m['buckets'], q, m['count'],
+                                           m['min'], m['max'])
+        m.pop('buckets')
     return merged
 
 
@@ -177,14 +248,17 @@ def print_merged(merged, out=None):
             w('  %-*s %g .. %g\n' % (width, k, lo, hi))
     hists = merged['histograms']
     if hists:
-        w('\nhistograms (merged):\n')
+        w('\nhistograms (merged; p* from composed buckets):\n')
         width = max(len(k) for k in hists)
-        w('  %-*s %8s %10s %10s %10s\n'
-          % (width, '', 'count', 'avg', 'min', 'max'))
+        w('  %-*s %8s %10s %10s %10s %10s %10s %10s\n'
+          % (width, '', 'count', 'avg', 'p50', 'p95', 'p99', 'min',
+             'max'))
         for k in sorted(hists):
             h = hists[k]
-            w('  %-*s %8d %10s %10s %10s\n' % (
+            w('  %-*s %8d %10s %10s %10s %10s %10s %10s\n' % (
                 width, k, h.get('count', 0), _fmt_seconds(h.get('avg')),
+                _fmt_seconds(h.get('p50')), _fmt_seconds(h.get('p95')),
+                _fmt_seconds(h.get('p99')),
                 _fmt_seconds(h.get('min')), _fmt_seconds(h.get('max'))))
     w('\nspans in rings: %d\n' % merged['spans_recorded'])
 
@@ -227,7 +301,8 @@ def main(argv=None):
             print_trace(doc)
             return
         f.seek(0)
-        snaps = [json.loads(line) for line in f if line.strip()]
+        snaps = [s for s in (json.loads(line) for line in f
+                             if line.strip()) if _is_snapshot(s)]
     if not snaps:
         raise SystemExit('%s: no snapshot lines' % args.path)
     for snap in (snaps if args.all else snaps[-1:]):

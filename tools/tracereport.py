@@ -1,0 +1,224 @@
+"""Per-stage latency breakdowns from trace JSON-lines (docs/observability.md
+"Request & step tracing").
+
+Reads the trace records `paddle_tpu.trace` appends to the monitor-log
+channel (``PADDLE_TRACE_LOG`` / ``FLAGS_monitor_log`` — snapshot lines
+from the metrics writer are skipped automatically) and prints:
+
+- per-kind, per-stage p50/p95/p99 breakdowns (queue / batch / prefill /
+  decode_step / execute / sync ...) with each stage's share of total
+  latency and the stage-sum coverage of end-to-end time;
+- outcome counts (ok / error / deadline / shed / stopped) — keep-errors
+  sampling means failures are always present;
+- the slowest-trace exemplars with their full stage budgets (the "why
+  was THIS request slow" answer);
+- lifecycle events (elastic restarts, reshard direction, retry
+  give-ups) grouped per trace in time order — the post-mortem view;
+- ``--slo <ms>``: SLO-violation summary (count, rate, and the stage
+  that dominated the violators).
+
+Usage:
+    python tools/tracereport.py run.jsonl
+    python tools/tracereport.py run.jsonl --slo 50 --top 5
+    python tools/tracereport.py --merge run.jsonl.rank0 run.jsonl.rank1
+    python tools/tracereport.py --merge logs/run.jsonl.rank*
+"""
+import argparse
+import json
+import math
+import sys
+
+
+def _fmt_s(s):
+    if s is None:
+        return '-'
+    if s < 1e-3:
+        return '%.1fus' % (s * 1e6)
+    if s < 1.0:
+        return '%.2fms' % (s * 1e3)
+    return '%.3fs' % s
+
+
+def _pct(values, q):
+    """Nearest-rank percentile of a sorted list."""
+    if not values:
+        return None
+    return values[min(len(values) - 1,
+                      max(0, int(math.ceil(q * len(values))) - 1))]
+
+
+def read_records(paths):
+    """(traces, events) from trace JSON-lines files; monitor snapshot
+    lines (no trace_id) and unparsable lines are skipped."""
+    traces, events = [], []
+    for path in paths:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if not isinstance(rec, dict) or 'trace_id' not in rec:
+                    continue
+                if 'event' in rec:
+                    events.append(rec)
+                elif 'dur_s' in rec:
+                    traces.append(rec)
+    return traces, events
+
+
+def stage_table(traces):
+    """{kind: {stage: [per-trace stage seconds]}} plus per-kind
+    durations — the data behind the breakdown table."""
+    by_kind = {}
+    for t in traces:
+        k = by_kind.setdefault(t.get('kind', '?'),
+                               {'durs': [], 'stages': {}, 'n_by': {}})
+        k['durs'].append(t['dur_s'])
+        for name, st in (t.get('stages') or {}).items():
+            k['stages'].setdefault(name, []).append(st['s'])
+            k['n_by'][name] = k['n_by'].get(name, 0) + st.get('n', 1)
+    return by_kind
+
+
+def print_breakdown(traces, out=None):
+    w = (out or sys.stdout).write
+    by_kind = stage_table(traces)
+    for kind in sorted(by_kind):
+        k = by_kind[kind]
+        durs = sorted(k['durs'])
+        total = sum(durs)
+        w('\n%s: %d traces, total %s, p50 %s, p95 %s, p99 %s\n'
+          % (kind, len(durs), _fmt_s(total), _fmt_s(_pct(durs, 0.5)),
+             _fmt_s(_pct(durs, 0.95)), _fmt_s(_pct(durs, 0.99))))
+        if not k['stages']:
+            continue
+        width = max(len(s) for s in k['stages'])
+        w('  %-*s %7s %10s %10s %10s %7s\n'
+          % (width, 'stage', 'count', 'p50', 'p95', 'p99', 'share'))
+        stage_sum = 0.0
+        for name in sorted(k['stages'],
+                           key=lambda s: -sum(k['stages'][s])):
+            vals = sorted(k['stages'][name])
+            ssum = sum(vals)
+            stage_sum += ssum
+            w('  %-*s %7d %10s %10s %10s %6.1f%%\n'
+              % (width, name, k['n_by'][name],
+                 _fmt_s(_pct(vals, 0.5)), _fmt_s(_pct(vals, 0.95)),
+                 _fmt_s(_pct(vals, 0.99)),
+                 100.0 * ssum / total if total else 0.0))
+        if total:
+            w('  stage sum covers %.1f%% of end-to-end time\n'
+              % (100.0 * stage_sum / total))
+
+
+def print_outcomes(traces, out=None):
+    w = (out or sys.stdout).write
+    counts = {}
+    for t in traces:
+        key = (t.get('kind', '?'), t.get('outcome', '?'))
+        counts[key] = counts.get(key, 0) + 1
+    w('\noutcomes:\n')
+    for (kind, outcome), n in sorted(counts.items()):
+        w('  %-12s %-10s %d\n' % (kind, outcome, n))
+
+
+def print_slowest(traces, top, out=None):
+    w = (out or sys.stdout).write
+    slow = sorted(traces, key=lambda t: -t['dur_s'])[:top]
+    if not slow:
+        return
+    w('\nslowest traces:\n')
+    for t in slow:
+        stages = ' '.join(
+            '%s=%s' % (n, _fmt_s(st['s']))
+            for n, st in sorted((t.get('stages') or {}).items(),
+                                key=lambda kv: -kv[1]['s']))
+        w('  %s %-9s %-8s %8s  %s%s\n'
+          % (t['trace_id'], t.get('kind', '?'), t.get('outcome', '?'),
+             _fmt_s(t['dur_s']), stages,
+             ' rank=%s' % t['rank'] if t.get('rank') is not None else ''))
+
+
+def print_slo(traces, slo_s, out=None):
+    w = (out or sys.stdout).write
+    bad = [t for t in traces if t['dur_s'] > slo_s]
+    w('\nSLO %s: %d/%d traces over (%.1f%%)\n'
+      % (_fmt_s(slo_s), len(bad), len(traces),
+         100.0 * len(bad) / len(traces) if traces else 0.0))
+    if not bad:
+        return
+    # which stage dominates the violators — where the budget went
+    agg = {}
+    for t in bad:
+        for n, st in (t.get('stages') or {}).items():
+            agg[n] = agg.get(n, 0.0) + st['s']
+    if agg:
+        top = max(agg.items(), key=lambda kv: kv[1])
+        w('  dominant stage among violators: %s (%s of %s attributed)\n'
+          % (top[0], _fmt_s(top[1]), _fmt_s(sum(agg.values()))))
+    worst = max(bad, key=lambda t: t['dur_s'])
+    w('  worst: %s %s %s\n' % (worst['trace_id'], worst.get('kind', '?'),
+                               _fmt_s(worst['dur_s'])))
+
+
+def print_events(events, out=None):
+    w = (out or sys.stdout).write
+    if not events:
+        return
+    w('\nlifecycle events (per trace, time order):\n')
+    by_trace = {}
+    for e in events:
+        by_trace.setdefault(e['trace_id'], []).append(e)
+    for tid in sorted(by_trace, key=lambda t: by_trace[t][0].get('ts', 0)):
+        w('  trace %s:\n' % tid)
+        for e in sorted(by_trace[tid], key=lambda e: e.get('ts', 0)):
+            fields = ' '.join(
+                '%s=%s' % (k, v) for k, v in sorted(e.items())
+                if k not in ('trace_id', 'event', 'ts', 'kind'))
+            w('    %.3f %-26s %s\n'
+              % (e.get('ts', 0.0), e.get('event', '?'), fields))
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description='Per-stage latency breakdowns, exemplars, and SLO '
+                    'summaries from trace JSON-lines')
+    p.add_argument('paths', nargs='+',
+                   help='trace log file(s) (PADDLE_TRACE_LOG / '
+                        'FLAGS_monitor_log; rank-suffixed under '
+                        'distributed.launch)')
+    p.add_argument('--merge', action='store_true',
+                   help='aggregate several rank files into one report '
+                        '(multiple paths imply it)')
+    p.add_argument('--slo', type=float, default=None, metavar='MS',
+                   help='flag traces slower than this many milliseconds')
+    p.add_argument('--top', type=int, default=3,
+                   help='how many slowest-trace exemplars to print')
+    args = p.parse_args(argv)
+    if len(args.paths) > 1 and not args.merge:
+        args.merge = True           # several files only make sense merged
+
+    traces, events = read_records(args.paths)
+    ranks = sorted({t['rank'] for t in traces + events
+                    if t.get('rank') is not None})
+    sys.stdout.write('%d traces, %d events from %d file(s)%s\n'
+                     % (len(traces), len(events), len(args.paths),
+                        ' (ranks %s)' % ranks if ranks else ''))
+    if not traces and not events:
+        raise SystemExit('no trace records found — is sampling off? '
+                         '(PADDLE_TRACE_SAMPLE, docs/observability.md)')
+    if traces:
+        print_breakdown(traces)
+        print_outcomes(traces)
+        print_slowest(traces, args.top)
+        if args.slo is not None:
+            print_slo(traces, args.slo / 1e3)
+    print_events(events)
+
+
+if __name__ == '__main__':
+    main()
