@@ -36,7 +36,9 @@ def _find_sparse_params(program, param_names):
             for n in op.input_arg_names:
                 if n not in candidates:
                     continue
-                ok = (block.idx == 0 and op.type == 'lookup_table'
+                ok = (block.idx == 0
+                      and op.type in ('lookup_table',
+                                      'fused_embedding_gather')
                       and op.attr('is_sparse', False)
                       and n in op.input('W'))
                 if ok:

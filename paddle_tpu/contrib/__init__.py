@@ -9,7 +9,7 @@ from . import slim  # noqa: F401
 from . import memory_usage_calc  # noqa: F401
 from .memory_usage_calc import memory_usage  # noqa: F401
 from . import op_frequence  # noqa: F401
-from .op_frequence import op_freq_statistic  # noqa: F401
+from .op_frequence import op_freq_statistic, top_offenders  # noqa: F401
 from . import hdfs_utils  # noqa: F401
 from . import decoder  # noqa: F401
 from . import float16  # noqa: F401

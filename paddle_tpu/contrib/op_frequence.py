@@ -1,13 +1,27 @@
 """Op-frequency statistics (reference
-python/paddle/fluid/contrib/op_frequence.py op_freq_statistic)."""
+python/paddle/fluid/contrib/op_frequence.py op_freq_statistic) + measured
+top-offender ranking backed by the analysis attribution tables.
+
+`op_freq_statistic` keeps the reference's STATIC census (how often each
+op type appears in the program) — useful for program-shape questions, but
+a count is not a cost. The fused-kernel tier is evidence-driven, so
+"which ops burn the cycles" must come from ONE source of truth: the
+measured per-op attribution table (`paddle_tpu.analysis.op_profile()`,
+filled by ``PADDLE_PROFILE_OPS=1`` / ``profiler.profile_ops()`` runs).
+`top_offenders` joins that table with the static census and REFUSES to
+rank from counts alone — no silent fallback that would dress a census up
+as a measurement.
+"""
 from collections import OrderedDict
 
-__all__ = ['op_freq_statistic']
+__all__ = ['op_freq_statistic', 'top_offenders']
 
 
 def op_freq_statistic(program):
     """Returns (uni_op_freq, adj_op_freq): single-op counts and adjacent
-    op-pair counts over the global block, most frequent first."""
+    op-pair counts over the program's blocks, most frequent first.
+    STATIC program census — for measured cost ranking use
+    :func:`top_offenders`."""
     uni, adj = {}, {}
     prev = None
     for block in program.blocks:
@@ -21,3 +35,31 @@ def op_freq_statistic(program):
     uni = OrderedDict(sorted(uni.items(), key=lambda kv: -kv[1]))
     adj = OrderedDict(sorted(adj.items(), key=lambda kv: -kv[1]))
     return uni, adj
+
+
+def top_offenders(program=None, profile=None, limit=None):
+    """Measured top offenders: rows from the analysis op-attribution
+    table (total/avg seconds, calls, out_bytes, time ratio), optionally
+    joined with the static op count of `program`.
+
+    `profile` defaults to the live ``analysis.op_profile()`` — run the
+    workload under ``PADDLE_PROFILE_OPS=1`` (or ``profiler.profile_ops()``)
+    first. Raises RuntimeError when no attribution data exists instead of
+    silently ranking by static count: a census cannot name the ops that
+    burn the cycles."""
+    from .. import analysis
+    p = profile if profile is not None else analysis.op_profile()
+    if not p.get('ops'):
+        raise RuntimeError(
+            "top_offenders: the op-attribution table is empty — run the "
+            "workload under PADDLE_PROFILE_OPS=1 (or inside "
+            "profiler.profile_ops()) so there is measured per-op time to "
+            "rank by; op_freq_statistic() gives the static census only")
+    counts = op_freq_statistic(program)[0] if program is not None else {}
+    rows = []
+    for r in p['ops'][:limit]:
+        row = dict(r)
+        if counts:
+            row['program_count'] = counts.get(r['type'], 0)
+        rows.append(row)
+    return rows

@@ -192,6 +192,69 @@ class QuantizeTranspiler(object):
         program._bump_version()
         return program
 
+    def convert_to_int8_program(self, program, place=None, scope=None):
+        """Weight-only int8 INFERENCE rewrite: the executing program reads
+        int8 weight blobs (int8(weight)/fp32(act) — int8 storage and HBM
+        traffic, fp32 matmuls; XLA fuses the dequant cast into the GEMM).
+
+        For each weight a quantizable op consumes, the fp32 param is
+        replaced by '<w>.int8' (int8 persistable) + '<w>.int8_scale' and a
+        `fake_dequantize_max_abs` op rematerializes fp32 just-in-time —
+        the existing ops/quant_ops.py pipeline, now fed by a REAL int8
+        blob. Works on a frozen QAT program (trained quant numerics) or a
+        plain inference program (plain abs-max PTQ of the weights).
+        save_inference_model then exports the int8 blobs and DROPS the
+        unused fp32 originals, so the artifact shrinks ~4x on the
+        quantized weights; the loaded program serves through the
+        Predictor/ServingEngine warmup path with zero recompiles like any
+        other program. Returns {param_name: (int8 blob, scale)}."""
+        from ..executor import global_scope
+        from .. import monitor
+        scope = scope if scope is not None else global_scope()
+        blobs = self.convert_to_int8(program, place=place, scope=scope)
+        if not blobs:
+            return blobs
+        bin_cnt = (1 << (self.weight_bits - 1)) - 1
+        for block in program.blocks:
+            i = 0
+            while i < len(block.ops):
+                op = block.ops[i]
+                if op.type in _QUANTIZABLE_OP_TYPES:
+                    for name in list(op.input_arg_names):
+                        base = name[:-len('.dequantized')] \
+                            if name.endswith('.dequantized') else name
+                        if base not in blobs:
+                            continue
+                        w8, scale = blobs[base]
+                        w8n, sn, dqn = (base + '.int8',
+                                        base + '.int8_scale',
+                                        base + '.int8_deq')
+                        if block._find_var_recursive(w8n) is None:
+                            block.create_var(name=w8n, shape=w8.shape,
+                                             dtype='int8', persistable=True)
+                            block.create_var(name=sn, shape=(1,),
+                                             dtype='float32',
+                                             persistable=True)
+                            block.create_var(name=dqn, shape=w8.shape,
+                                             dtype='float32')
+                            scope.set(w8n, w8)
+                            scope.set(sn, np.asarray([scale], 'float32'))
+                            block._insert_op(
+                                i, type='fake_dequantize_max_abs',
+                                inputs={'X': [w8n], 'Scale': [sn]},
+                                outputs={'Out': [dqn]},
+                                attrs={'max_range': float(bin_cnt)})
+                            i += 1
+                        op._rename_input(name, dqn)
+                i += 1
+        # the weight's old fake-quant chain (ending in the '.dequantized'
+        # name nothing consumes after the rename) is left to XLA DCE at
+        # lowering and to _prune on export — no graph surgery needed
+        program._bump_version()
+        monitor.inc('quantized_program_total',
+                    labels={'kind': 'weight_only_int8'})
+        return blobs
+
     def convert_to_int8(self, program, place=None, scope=None):
         """Quantize the weights of quantizable ops to int8 (reference
         convert_to_int8): w_int8 = round(w / scale * bin_cnt). Returns
@@ -255,10 +318,13 @@ def post_training_quantize(exe, program, scope, feed_batches,
     mul op into quantize(int8) -> quantized_matmul(int8 x int8 -> int32 ->
     rescale). Returns the list of rewritten op indices.
 
-    Eligible: 2-D mul ops whose Y is a parameter (the fc hot path). Other
+    Eligible: mul ops whose Y is a 2-D parameter and whose X flattens to
+    rows at x_num_col_dims (the fc hot path — including the rank-3
+    [B, L, d] fc's of BERT/transformer stacks, x_num_col_dims=2). Other
     ops stay fp32 — mixed int8/fp32 inference like the reference's
     quantize/dequantize sandwiches.
     """
+    from .. import monitor
     block = program.global_block()
     bin_max = float((1 << (weight_bits - 1)) - 1)      # 127
 
@@ -268,14 +334,16 @@ def post_training_quantize(exe, program, scope, feed_batches,
     for idx, op in enumerate(block.ops):
         if op.type != 'mul':
             continue
-        if int(op.attr('x_num_col_dims', 1)) != 1:
-            continue
+        xnc = int(op.attr('x_num_col_dims', 1))
         x_name = op.input('X')[0]
         w_name = op.input('Y')[0]
-        if w_name not in params:
+        if w_name not in params or int(op.attr('y_num_col_dims', 1)) != 1:
             continue
         xv = block._find_var_recursive(x_name)
-        if xv is not None and xv.shape and len(xv.shape) != 2:
+        if xv is not None and xv.shape and len(xv.shape) != xnc + 1:
+            continue
+        wv = block._find_var_recursive(w_name)
+        if wv is not None and wv.shape and len(wv.shape) != 2:
             continue
         targets.append((idx, op, x_name, w_name))
     if not targets:
@@ -314,6 +382,7 @@ def post_training_quantize(exe, program, scope, feed_batches,
             outputs={'Output': [x8_name]},
             attrs={'Scale': sx, 'is_negative_input': True})
     program._bump_version()
+    monitor.inc('quantized_program_total', labels={'kind': 'ptq_int8'})
     # indices shift with each insertion: report the FINAL positions
     return [i for i, o in enumerate(block.ops)
             if o.type == 'quantized_matmul']

@@ -15,7 +15,7 @@ __all__ = ['QuantizationStrategy']
 class QuantizationStrategy(Strategy):
     def __init__(self, start_epoch=0, end_epoch=1000, weight_bits=8,
                  activation_bits=8, activation_quantize_type='abs_max',
-                 freeze_on_end=True):
+                 freeze_on_end=True, int8_on_end=True):
         super(QuantizationStrategy, self).__init__(start_epoch, end_epoch)
         from ..quantize import QuantizeTranspiler
         self._transpiler = QuantizeTranspiler(
@@ -23,7 +23,14 @@ class QuantizationStrategy(Strategy):
             activation_quantize_type=activation_quantize_type)
         self._applied = False
         self._freeze = freeze_on_end
+        # int8_on_end additionally produces int8_program: the frozen
+        # program with REAL int8 weight blobs in the scope
+        # (QuantizeTranspiler.convert_to_int8_program — int8(weight)/
+        # fp32(act) execution, exportable via save_inference_model)
+        self._int8 = int8_on_end
         self.freeze_program = None
+        self.int8_program = None
+        self.int8_blobs = None
 
     def on_compress_begin(self, context):
         # fake-quant insertion must precede backward construction, so the
@@ -42,3 +49,8 @@ class QuantizationStrategy(Strategy):
             for_test=True)
         self._transpiler.freeze_program(prog, scope=context.scope)
         self.freeze_program = prog
+        if self._int8:
+            int8 = prog.clone(for_test=True)
+            self.int8_blobs = self._transpiler.convert_to_int8_program(
+                int8, scope=context.scope)
+            self.int8_program = int8

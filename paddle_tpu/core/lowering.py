@@ -410,6 +410,18 @@ def _lower_backward(ctx, ops, lo, b, bop):
             else:
                 rows = jnp.concatenate([p[0] for p in pairs])
                 vals = jnp.concatenate([p[1] for p in pairs])
+                if len(pairs) > 1:
+                    # XLA SPMD (jax 0.4.37) miscompiles a scatter-add whose
+                    # indices/updates are a CONCAT of batch-sharded vectors
+                    # when the operand is sharded on dim 0: shard-0 updates
+                    # land at stride-N_shard global rows and other shards'
+                    # vanish (repro: tests/test_sharded_embedding.py
+                    # test_sharded_scatter_concat_partitioner). Pinning the
+                    # concatenated rows AND values replicated restores the
+                    # single-site partitioning, which is exact; rows/vals
+                    # are batch-sized, never [vocab]-sized, so the
+                    # all-gather is cheap next to the table itself.
+                    rows, vals = _replicate_under_mesh(rows, vals)
                 g = SelectedRows(rows, vals, height)
         else:
             g = grads[n]
@@ -523,6 +535,20 @@ def _lower_segment(ctx, ops, s, e):
         ctx.env.update(zip(produced, results))
         return
     lower_ops(ctx, ops, s, e)
+
+
+def _replicate_under_mesh(*arrays):
+    """Pin values to a fully-replicated sharding when tracing under an
+    active MeshRunner mesh; identity otherwise (single-device traces and
+    plain jit must not see mesh-less constraints)."""
+    from ..parallel.api import get_active_mesh
+    mesh = get_active_mesh()
+    if mesh is None or mesh.size <= 1:
+        return arrays if len(arrays) > 1 else arrays[0]
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    sh = NamedSharding(mesh, P())
+    out = tuple(lax.with_sharding_constraint(a, sh) for a in arrays)
+    return out if len(out) > 1 else out[0]
 
 
 def _vjp_with_aux(f, primal):

@@ -845,7 +845,13 @@ class Executor(object):
         lod_sig = tuple(sorted(feed_lods.items()))
         static_sig = tuple(sorted(
             (k, v.tobytes()) for k, v in static_feed.items()))
-        return sig, lod_sig, static_sig
+        # the fused-kernel tier changes how fusable ops LOWER, so it keys
+        # the compiled entry (flipping PADDLE_FUSED_TIER recompiles instead
+        # of serving stale kernels). cache_token() is one env-dict read —
+        # the whole per-run cost of the tier on the hot path; resolution
+        # and the dispatch counters happen at trace time only.
+        from .ops.kernel_tier import cache_token
+        return sig, lod_sig, static_sig, cache_token()
 
     @staticmethod
     def _split_lod_feed(value):

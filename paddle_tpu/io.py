@@ -213,6 +213,15 @@ def load_inference_model(dirname, executor, model_filename=None,
                       filename=params_filename or PARAMS_FILENAME)
     fetch_vars = [program.global_block().var(n)
                   for n in blob['fetch_names']]
+    if any(op.type in ('quantized_matmul', 'quantize')
+           or (op.type == 'fake_dequantize_max_abs'
+               and op.input('X')
+               and op.input('X')[0].endswith('.int8'))
+           for op in program.global_block().ops):
+        # a serving process loading an int8 artifact counts it: obsreport/
+        # bench deltas show quantized programs actually serving
+        from . import monitor
+        monitor.inc('quantized_program_total', labels={'kind': 'loaded'})
     return program, blob['feed_names'], fetch_vars
 
 

@@ -23,6 +23,9 @@ from . import vision_ops
 from . import quant_ops
 from . import misc_ops
 from . import attention_ops
+from . import ce_ops
+from . import embedding_ops
+from . import kernel_tier
 from . import kv_cache_ops
 from . import fused_ops
 from . import dist_ops

@@ -1,0 +1,215 @@
+"""Fused softmax-cross-entropy over logits: the V=32000 lm_head tail as one
+blocked kernel (softmax + label gather + NLL in a single pass).
+
+Motivation (BENCH r03-r05 + PADDLE_PROFILE_OPS attribution): the lm_*
+rows' flat MFU sits in the loss tail — ``softmax_with_cross_entropy`` over
+``[B*L, 32000]`` logits. The unfused lowering materializes a full
+probability/one-hot intermediate on the backward pass; this kernel streams
+vocab blocks through VMEM keeping only per-row running max / running
+denominator / picked-logit scratch (FlashAttention's online-softmax trick
+applied to the loss), and the backward recomputes the probability TILE
+from (logits, LSE) — O(N) residuals, no ``[N, V]`` one-hot ever exists.
+
+Tiers (ops/kernel_tier.py):
+- off:       nn_ops._ce_hard (bit-identical legacy path);
+- xla:       one-hot-free jnp emission (scatter-subtract backward), XLA
+             fuses the forward reduction chain;
+- pallas:    the blocked kernels below;
+- interpret: the same kernels through the Pallas interpreter (CPU tests).
+
+Both fused tiers keep the ``ignore_index`` contract: ignored rows emit 0
+loss and 0 gradient.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG_INF = -1e30
+
+
+def _pick_block(n, pref, mult):
+    """Largest power-of-two tile <= pref that divides n and is a multiple
+    of mult; None when no such tile exists (caller falls back a tier)."""
+    b = pref
+    while b >= mult:
+        if n % b == 0:
+            return b
+        b //= 2
+    return None
+
+
+def pallas_shapes_ok(n, v):
+    """Can the kernels tile [n, v] logits? (the per-op fallback rule)"""
+    return _pick_block(n, 256, 128) is not None and \
+        _pick_block(v, 2048, 128) is not None
+
+
+# --------------------------------------------------------------------------
+# forward kernel: loss + lse in one sweep over vocab blocks
+# --------------------------------------------------------------------------
+
+def _fwd_kernel(nj, ignore_index, *refs):
+    import jax.experimental.pallas as pl
+    (x_ref, lab_ref, loss_ref, lse_ref, m_scr, l_scr, pick_scr) = refs
+    j = pl.program_id(1)
+    bn, bv = x_ref.shape
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full(m_scr.shape, _NEG_INF, jnp.float32)
+        l_scr[...] = jnp.zeros(l_scr.shape, jnp.float32)
+        pick_scr[...] = jnp.zeros(pick_scr.shape, jnp.float32)
+
+    s = x_ref[...].astype(jnp.float32)
+    m_prev = m_scr[:, :1]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    l_scr[...] = jnp.broadcast_to(
+        l_scr[:, :1] * jnp.exp(m_prev - m_new)
+        + jnp.sum(jnp.exp(s - m_new), axis=-1, keepdims=True), l_scr.shape)
+    m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+    cols = j * bv + lax.broadcasted_iota(jnp.int32, (bn, bv), 1)
+    lab = lab_ref[0]                                   # [bn] int32
+    hit = cols == lab[:, None]
+    # each row's label lands in exactly one vocab block, so += accumulates
+    # one real value (ignore_index never matches: it is outside [0, V))
+    pick_scr[...] += jnp.broadcast_to(
+        jnp.sum(jnp.where(hit, s, 0.0), axis=-1, keepdims=True),
+        pick_scr.shape)
+
+    @pl.when(j == nj - 1)
+    def _finish():
+        lse = m_scr[:, 0] + jnp.log(jnp.maximum(l_scr[:, 0], 1e-30))
+        lse_ref[0] = lse
+        loss = lse - pick_scr[:, 0]
+        loss_ref[0] = jnp.where(lab_ref[0] != ignore_index, loss, 0.0)
+
+
+def _fused_ce_fwd_pallas(logits, labels, ignore_index, interpret):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    from .attention_ops import _compiler_params
+    n, v = logits.shape
+    bn = _pick_block(n, 256, 128)
+    bv = _pick_block(v, 2048, 128)
+    nj = v // bv
+    lab2 = labels.astype(jnp.int32)[None, :]
+    loss, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, nj, int(ignore_index)),
+        grid=(n // bn, nj),
+        in_specs=[pl.BlockSpec((bn, bv), lambda i, j: (i, j)),
+                  pl.BlockSpec((1, bn), lambda i, j: (0, i))],
+        out_specs=[pl.BlockSpec((1, bn), lambda i, j: (0, i)),
+                   pl.BlockSpec((1, bn), lambda i, j: (0, i))],
+        out_shape=[jax.ShapeDtypeStruct((1, n), jnp.float32),
+                   jax.ShapeDtypeStruct((1, n), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((bn, 128), jnp.float32),
+                        pltpu.VMEM((bn, 128), jnp.float32),
+                        pltpu.VMEM((bn, 128), jnp.float32)],
+        compiler_params=_compiler_params(
+            pltpu, ("parallel", "arbitrary")),
+        interpret=interpret,
+    )(logits, lab2)
+    return loss[0], lse[0]
+
+
+# --------------------------------------------------------------------------
+# backward kernel: dlogits tile recomputed from (logits, lse) — no
+# [N, V] softmax/one-hot residual
+# --------------------------------------------------------------------------
+
+def _bwd_kernel(ignore_index, x_ref, lab_ref, lse_ref, ct_ref, dx_ref):
+    import jax.experimental.pallas as pl
+    j = pl.program_id(1)
+    bn, bv = x_ref.shape
+    s = x_ref[...].astype(jnp.float32)
+    lab = lab_ref[0]
+    ct = jnp.where(lab != ignore_index, ct_ref[0], 0.0)    # [bn]
+    p = jnp.exp(s - lse_ref[0][:, None])
+    cols = j * bv + lax.broadcasted_iota(jnp.int32, (bn, bv), 1)
+    hit = cols == lab[:, None]
+    dx_ref[...] = ((p - jnp.where(hit, 1.0, 0.0))
+                   * ct[:, None]).astype(dx_ref.dtype)
+
+
+def _fused_ce_bwd_pallas(logits, labels, lse, ct, ignore_index, interpret):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    from .attention_ops import _compiler_params
+    n, v = logits.shape
+    bn = _pick_block(n, 256, 128)
+    bv = _pick_block(v, 2048, 128)
+    lab2 = labels.astype(jnp.int32)[None, :]
+    return pl.pallas_call(
+        functools.partial(_bwd_kernel, int(ignore_index)),
+        grid=(n // bn, v // bv),
+        in_specs=[pl.BlockSpec((bn, bv), lambda i, j: (i, j)),
+                  pl.BlockSpec((1, bn), lambda i, j: (0, i)),
+                  pl.BlockSpec((1, bn), lambda i, j: (0, i)),
+                  pl.BlockSpec((1, bn), lambda i, j: (0, i))],
+        out_specs=[pl.BlockSpec((bn, bv), lambda i, j: (i, j))],
+        out_shape=[jax.ShapeDtypeStruct((n, v), logits.dtype)],
+        compiler_params=_compiler_params(
+            pltpu, ("parallel", "arbitrary")),
+        interpret=interpret,
+    )(logits, lab2, lse[None, :], ct.astype(jnp.float32)[None, :])[0]
+
+
+# --------------------------------------------------------------------------
+# xla tier: one-hot-free jnp emission
+# --------------------------------------------------------------------------
+
+def _ce_fwd_xla(logits, labels, ignore_index):
+    x = logits.astype(jnp.float32)
+    m = jnp.max(x, axis=-1, keepdims=True)
+    lse = (m[:, 0] + jnp.log(jnp.sum(jnp.exp(x - m), axis=-1)))
+    safe = jnp.clip(labels, 0, x.shape[-1] - 1)
+    picked = jnp.take_along_axis(x, safe[:, None], axis=-1)[:, 0]
+    loss = jnp.where(labels != ignore_index, lse - picked, 0.0)
+    return loss, lse
+
+
+def _ce_bwd_xla(logits, labels, lse, ct, ignore_index):
+    x = logits.astype(jnp.float32)
+    ct_eff = jnp.where(labels != ignore_index, ct, 0.0)
+    g = jnp.exp(x - lse[:, None]) * ct_eff[:, None]
+    safe = jnp.clip(labels, 0, x.shape[-1] - 1)
+    # scatter-subtract at the label column instead of building a [N, V]
+    # one-hot (the memory the fused tier exists to avoid)
+    g = g.at[jnp.arange(g.shape[0]), safe].add(-ct_eff)
+    return g.astype(logits.dtype)
+
+
+# --------------------------------------------------------------------------
+# custom_vjp wrapper
+# --------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def fused_softmax_ce(logits, labels, ignore_index, impl):
+    """loss [N] for logits [N, V], int labels [N]. ``impl`` in
+    'xla' | 'pallas' | 'interpret' (the 'off' tier never reaches here)."""
+    return _fused_fwd(logits, labels, ignore_index, impl)[0]
+
+
+def _fused_fwd(logits, labels, ignore_index, impl):
+    labels = labels.astype(jnp.int32)
+    if impl in ('pallas', 'interpret'):
+        loss, lse = _fused_ce_fwd_pallas(logits, labels, ignore_index,
+                                         impl == 'interpret')
+    else:
+        loss, lse = _ce_fwd_xla(logits, labels, ignore_index)
+    return loss, (logits, labels, lse)
+
+
+def _fused_ce_bwd(ignore_index, impl, res, ct):
+    logits, labels, lse = res
+    if impl in ('pallas', 'interpret'):
+        g = _fused_ce_bwd_pallas(logits, labels, lse, ct, ignore_index,
+                                 impl == 'interpret')
+    else:
+        g = _ce_bwd_xla(logits, labels, lse, ct, ignore_index)
+    return g, None
+
+
+fused_softmax_ce.defvjp(_fused_fwd, _fused_ce_bwd)

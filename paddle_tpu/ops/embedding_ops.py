@@ -1,0 +1,148 @@
+"""Fused embedding gather(+bias) — the sparse-path kernel tier.
+
+The reference serves embedding lookups through lookup_table_op.cc (dense
+gather) and the distributed prefetch pipeline; here the gather itself
+becomes a Pallas kernel when the tier allows: ids are SCALAR-PREFETCHED
+(pltpu.PrefetchScalarGridSpec) so each grid step's BlockSpec index_map
+picks the table row to DMA — the classic Pallas embedding idiom: row
+fetches pipeline back-to-back without materializing an index tensor on
+the vector unit, and the optional per-feature bias adds inside the same
+kernel (one HBM pass instead of gather-then-add).
+
+Gradients: the dense path carries a custom_vjp whose backward is the
+scatter-add transpose (XLA's native scatter — already a single fused HLO,
+which is why there is no Pallas scatter tier; the fallback rule is
+documented in docs/executor_performance.md). The SPARSE path
+(is_sparse=True embeddings) never differentiates through the gather at
+all: core/lowering.py's scout/dummy mechanism holds the table out of AD,
+so the kernel simply gathers stop_gradient rows — composing with
+SelectedRows grads unchanged.
+
+Used by the lookup_table lowering (tensor_ops) and the program-level
+``fused_embedding_gather`` op registered here (W, Ids, optional Bias).
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+def pallas_shapes_ok(w, n_ids):
+    """Kernel tiling rule: features must fill whole lanes (the row DMA is
+    [1, D]); any id count works (grid is per-id)."""
+    return w.ndim == 2 and w.shape[1] % 128 == 0 and n_ids >= 1 and \
+        w.dtype == jnp.float32
+
+
+def _gather_kernel(has_bias, *refs):
+    if has_bias:
+        ids_ref, row_ref, bias_ref, out_ref = refs
+        out_ref[...] = row_ref[...] + bias_ref[...]
+    else:
+        ids_ref, row_ref, out_ref = refs
+        out_ref[...] = row_ref[...]
+
+
+def _gather_pallas(w, flat_ids, bias, interpret):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    n = flat_ids.shape[0]
+    d = w.shape[1]
+    has_bias = bias is not None
+    # clamp like jnp.take's default TPU behavior (out-of-range ids clamp)
+    ids32 = jnp.clip(flat_ids.astype(jnp.int32), 0, w.shape[0] - 1)
+    in_specs = [pl.BlockSpec((1, d), lambda i, ids: (ids[i], 0))]
+    ins = [w]
+    if has_bias:
+        in_specs.append(pl.BlockSpec((1, d), lambda i, ids: (0, 0)))
+        ins.append(bias.reshape(1, d))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n,),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, d), lambda i, ids: (i, 0)),
+    )
+    return pl.pallas_call(
+        functools.partial(_gather_kernel, has_bias),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((n, d), w.dtype),
+        interpret=interpret,
+    )(ids32, *ins)
+
+
+def _gather_ref(w, flat_ids, bias):
+    out = jnp.take(w, flat_ids, axis=0)
+    return out if bias is None else out + bias.reshape(1, -1)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _gather_grad(w, flat_ids, bias, impl, w_shape, w_dtype_str):
+    return _gather_impl(w, flat_ids, bias, impl)
+
+
+def _gather_impl(w, flat_ids, bias, impl):
+    if impl in ('pallas', 'interpret'):
+        return _gather_pallas(w, flat_ids, bias, impl == 'interpret')
+    return _gather_ref(w, flat_ids, bias)
+
+
+def _gather_grad_fwd(w, flat_ids, bias, impl, w_shape, w_dtype_str):
+    return _gather_impl(w, flat_ids, bias, impl), \
+        (flat_ids, bias is not None)
+
+
+def _gather_grad_bwd(impl, w_shape, w_dtype_str, res, ct):
+    flat_ids, has_bias = res
+    dw = jnp.zeros(w_shape, w_dtype_str).at[flat_ids].add(
+        ct.astype(w_dtype_str), mode='drop')
+    db = jnp.sum(ct, axis=0) if has_bias else None
+    return dw, None, db
+
+
+_gather_grad.defvjp(_gather_grad_fwd, _gather_grad_bwd)
+
+
+def embedding_gather(w, flat_ids, bias=None, impl='off', differentiable=True):
+    """Rows of ``w`` at ``flat_ids`` (+ optional per-feature ``bias``).
+
+    impl: 'off'/'xla' -> plain jnp gather (+add) with jnp's own AD (the
+    transpose IS XLA's scatter-add — bitwise today's path);
+    'pallas'/'interpret' -> the scalar-prefetch kernel, wrapped in a
+    custom_vjp whose backward is the same scatter-add transpose.
+    ``differentiable=False`` skips the vjp wrapper (the sparse scout/apply
+    path holds w out of AD already)."""
+    flat_ids = flat_ids.astype(jnp.int32)
+    if impl in ('pallas', 'interpret'):
+        if differentiable:
+            return _gather_grad(w, flat_ids, bias, impl,
+                                tuple(w.shape), str(w.dtype))
+        return _gather_pallas(w, flat_ids, bias, impl == 'interpret')
+    return _gather_ref(w, flat_ids, bias)
+
+
+@register_op('fused_embedding_gather')
+def _fused_embedding_gather(ctx, op):
+    """Program-level fused gather+bias: inputs W [V, D], Ids (any shape,
+    trailing 1 folds like lookup_table), optional Bias [D]; output
+    Out [..., D]. Rides the same sparse scout/apply mechanism as
+    lookup_table when W is an is_sparse wrt table."""
+    from . import kernel_tier
+    from .tensor_ops import embedding_epilogue, lookup_gather
+    from ..parallel.api import get_active_mesh
+    w = ctx.in1(op, 'W')
+    ids = ctx.in1(op, 'Ids')
+    bias = ctx.in1(op, 'Bias')
+    flat = ids.reshape(-1).astype(jnp.int32)
+    mesh = get_active_mesh()
+    impl = kernel_tier.dispatch(
+        'fused_embedding_gather',
+        # same rule as lookup_table: a pallas custom call cannot be
+        # auto-partitioned under a >1-device mesh
+        pallas_ok=(mesh is None or mesh.size == 1)
+        and pallas_shapes_ok(w, int(flat.shape[0])),
+        count=getattr(ctx, 'sparse_mode', None) != 'scout')
+    out = lookup_gather(ctx, op, w, flat, bias=bias, impl=impl)
+    ctx.out(op, 'Out', embedding_epilogue(
+        out, flat, ids, w, op.attr('padding_idx', -1)))

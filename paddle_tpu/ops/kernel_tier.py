@@ -1,0 +1,95 @@
+"""Fused-kernel tier selection: PADDLE_FUSED_TIER + per-op dispatch.
+
+The kernel tier decides HOW a fusable op lowers (SURVEY §2.4: the
+reference's operators/fused/ + jit/ runtime-codegen layer picks a kernel
+per op; here one knob picks the lowering family for every fused unit):
+
+- ``off``      — the unfused composition, bit-identical to the lowering
+                 that existed before the fused tier (the parity anchor:
+                 ``PADDLE_FUSED_TIER=off`` reproduces legacy numerics).
+- ``xla``      — a restructured single-expression emission that avoids
+                 materializing large intermediates and leans on XLA's own
+                 fusion (e.g. the one-hot-free cross-entropy backward, the
+                 flattened whole-parameter-set Adam update). Also accepted
+                 as ``xla-fused``.
+- ``pallas``   — the hand-written Pallas kernels (TPU).
+- ``interpret``— the same Pallas kernels through the interpreter
+                 (CPU-testable cross-check, like attention's
+                 ``use_pallas='interpret'``).
+
+Default (unset/auto): ``pallas`` on a TPU backend, ``off`` elsewhere — CPU
+test suites see legacy numerics unless they opt in.
+
+Dispatch is resolved at TRACE time (op lowerings consult it while the
+program compiles), so steady-state dispatch costs nothing per run; the
+executor folds :func:`cache_token` — one env read — into its compile-cache
+keys so flipping the knob recompiles instead of serving stale kernels.
+Every resolution lands in the ``fused_kernel_dispatch_total{op,impl}``
+counter, so bench counter deltas and obsreport show which tier actually
+ran (and when a shape forced a per-op fallback).
+"""
+import os
+
+import jax
+
+from .. import monitor
+
+__all__ = ['resolve_tier', 'dispatch', 'cache_token', 'TIERS']
+
+TIERS = ('off', 'xla', 'pallas', 'interpret')
+
+_ALIASES = {
+    '': None, 'auto': None, 'default': None,
+    'off': 'off', '0': 'off', 'none': 'off',
+    'xla': 'xla', 'xla-fused': 'xla', 'xla_fused': 'xla', '1': 'xla',
+    'pallas': 'pallas',
+    'interpret': 'interpret',
+}
+
+
+def resolve_tier():
+    """The requested tier: env override, else pallas on TPU / off on CPU."""
+    raw = os.environ.get('PADDLE_FUSED_TIER', '')
+    tier = _ALIASES.get(str(raw).strip().lower(), '__bad__')
+    if tier == '__bad__':
+        raise ValueError(
+            "PADDLE_FUSED_TIER=%r: expected one of off|xla|pallas|interpret"
+            % (raw,))
+    if tier is not None:
+        return tier
+    return 'pallas' if jax.default_backend() == 'tpu' else 'off'
+
+
+def cache_token():
+    """The NORMALIZED tier spelling, for compile-cache keys (env read +
+    one alias-dict read — the only per-run cost of the fused tier on the
+    Executor hot path; backend probing and counters happen at trace
+    time). Normalizing means 'off'/'0'/'none' (or ''/'auto') share cache
+    entries instead of forcing a recompile over a spelling change; an
+    unknown value keys as itself and raises at the next trace."""
+    raw = os.environ.get('PADDLE_FUSED_TIER', '')
+    return _ALIASES.get(str(raw).strip().lower(), raw)
+
+
+def dispatch(op, pallas_ok=True, xla_ok=True, tier=None, count=True):
+    """Resolve the impl for one fused unit and count the decision.
+
+    ``pallas_ok``: the shapes tile for the Pallas kernel (when False, a
+    pallas/interpret request degrades to the xla tier — the per-op
+    fallback rule); ``xla_ok``: the restructured emission supports this
+    op instance (else everything degrades to 'off'). ``count=False``
+    skips the counter — used by lowerings re-entered on the sparse-grad
+    SCOUT pass (core/lowering.py lowers the forward segment twice for
+    is_sparse programs; counting both would double every dispatch the
+    bench deltas report). Returns one of
+    'off' | 'xla' | 'pallas' | 'interpret'.
+    """
+    impl = tier if tier is not None else resolve_tier()
+    if impl in ('pallas', 'interpret') and not pallas_ok:
+        impl = 'xla'
+    if impl == 'xla' and not xla_ok:
+        impl = 'off'
+    if count:
+        monitor.inc('fused_kernel_dispatch_total',
+                    labels={'op': op, 'impl': impl})
+    return impl

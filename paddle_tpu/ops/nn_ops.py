@@ -91,7 +91,26 @@ def _softmax_with_ce(ctx, op):
         ctx.out(op, 'Loss', loss)
         return
     lab = label.reshape(-1).astype(jnp.int32)
-    loss = _ce_hard(logits, lab, ignore_index)
+    impl = 'off'
+    if logits.ndim == 2:
+        from . import kernel_tier
+        from .ce_ops import fused_softmax_ce, pallas_shapes_ok
+        from ..parallel.api import get_active_mesh
+        mesh = get_active_mesh()
+        impl = kernel_tier.dispatch(
+            'softmax_with_cross_entropy',
+            # a pallas custom call cannot be auto-partitioned: under an
+            # active >1-device mesh the xla emission partitions instead
+            pallas_ok=(mesh is None or mesh.size == 1)
+            and pallas_shapes_ok(logits.shape[0], logits.shape[1]),
+            count=getattr(ctx, 'sparse_mode', None) != 'scout')
+    if impl == 'off':
+        loss = _ce_hard(logits, lab, ignore_index)
+    else:
+        # fused tier (ops/ce_ops.py): online-softmax single pass, backward
+        # recomputed from (logits, lse) — no [N, V] one-hot/softmax
+        # residual ever materializes
+        loss = fused_softmax_ce(logits, lab, ignore_index, impl)
     ctx.out(op, 'Loss', loss[:, None])
     # the Softmax output only materializes if the program consumes it
     if op.output('Softmax'):

@@ -82,8 +82,12 @@ def _fake_quantize_range_abs_max(ctx, op):
 
 @register_op('fake_dequantize_max_abs')
 def _fake_dequantize_max_abs(ctx, op):
-    """Out = X * Scale / max_range (reference FakeDequantizeMaxAbsKernel)."""
-    x = ctx.in1(op, 'X')
+    """Out = X * Scale / max_range (reference FakeDequantizeMaxAbsKernel).
+    X may be a REAL int8 blob (the weight-only int8 inference path,
+    QuantizeTranspiler.convert_to_int8_program): the cast to f32 happens
+    here and XLA fuses it into the consuming matmul — int8 storage/HBM
+    traffic, fp32 compute."""
+    x = ctx.in1(op, 'X').astype(jnp.float32)
     scale = ctx.in1(op, 'Scale').reshape(())
     max_range = op.attr('max_range')
     ctx.out(op, 'Out', x * lax.stop_gradient(scale) / max_range)

@@ -275,6 +275,28 @@ def _lookup_table(ctx, op):
         from .dist_ops import table_sharding_constraint
         w = table_sharding_constraint(w)
 
+    from . import kernel_tier
+    from .embedding_ops import pallas_shapes_ok
+    from ..parallel.api import get_active_mesh
+    mesh = get_active_mesh()
+    impl = kernel_tier.dispatch(
+        'lookup_table',
+        # a pallas custom call cannot be auto-partitioned: under an active
+        # >1-device mesh the gather stays on XLA (which partitions it into
+        # shard-local masked gathers + psum — the dist_ops pipeline)
+        pallas_ok=(mesh is None or mesh.size == 1)
+        and pallas_shapes_ok(w, int(flat.shape[0])),
+        xla_ok=False,   # no distinct xla tier: the gather IS one HLO
+        count=getattr(ctx, 'sparse_mode', None) != 'scout')
+    out = lookup_gather(ctx, op, w, flat, impl=impl)
+    ctx.out(op, 'Out', embedding_epilogue(out, flat, ids, w, padding_idx))
+
+
+def lookup_gather(ctx, op, w, flat, bias=None, impl='off'):
+    """Shared lookup_table / fused_embedding_gather gather body: routes
+    the is_sparse scout/apply mechanism (core/lowering.py sparse grads)
+    around whichever gather impl the kernel tier picked."""
+    from .embedding_ops import embedding_gather
     w_name = op.input('W')[0]
     sparse = w_name in getattr(ctx, 'sparse_tables', ())
     mode = getattr(ctx, 'sparse_mode', None)
@@ -283,11 +305,19 @@ def _lookup_table(ctx, op):
     if sparse and mode == 'apply':
         k = ctx.sparse_counter[0]
         ctx.sparse_counter[0] += 1
-        out = jnp.take(lax.stop_gradient(w), flat, axis=0) \
+        # bias adds OUTSIDE the differentiable=False kernel: the table is
+        # stop_gradient'd but a trainable Bias is not, and jax cannot
+        # transpose through a raw pallas_call — the add after the gather
+        # keeps the bias on plain-jnp AD while the dummy carries the
+        # table's sparse grad
+        out = embedding_gather(lax.stop_gradient(w), flat,
+                               impl=impl, differentiable=False) \
             + ctx.env['@sparse%d' % k]
+        if bias is not None:
+            out = out + bias.reshape(1, -1)
     else:
-        out = jnp.take(w, flat, axis=0)
-    ctx.out(op, 'Out', embedding_epilogue(out, flat, ids, w, padding_idx))
+        out = embedding_gather(w, flat, bias=bias, impl=impl)
+    return out
 
 
 def embedding_epilogue(out, flat, ids, w, padding_idx):

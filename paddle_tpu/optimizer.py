@@ -129,11 +129,16 @@ class Optimizer(object):
             self._create_global_learning_rate()
             block = program.global_block()
             self._create_accumulators(block, [pg[0] for pg in params_grads])
-            optimize_ops = []
-            for pg in params_grads:
-                optimize_ops.append(self._append_optimize_op(block, pg))
+            optimize_ops = self._append_optimize_ops(block, params_grads)
             self._finish_update(block, params_grads)
         return optimize_ops
+
+    def _append_optimize_ops(self, block, params_grads):
+        """Emit the update op(s) for the clipped/regularized param-grad
+        list. Default: one op per parameter; optimizers that fuse the
+        whole set (Adam fuse=True) override THIS hook so the prologue
+        (sort/clip/regularize/lr/accumulators/role) stays one copy."""
+        return [self._append_optimize_op(block, pg) for pg in params_grads]
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
@@ -250,7 +255,7 @@ class AdamOptimizer(Optimizer):
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, regularization=None, name=None,
-                 lazy_mode=False):
+                 lazy_mode=False, fuse=False):
         super(AdamOptimizer, self).__init__(learning_rate, regularization,
                                             name)
         self.type = 'adam'
@@ -258,6 +263,54 @@ class AdamOptimizer(Optimizer):
         self._beta2 = beta2
         self._epsilon = epsilon
         self._lazy_mode = lazy_mode
+        # fuse=True emits ONE fused_adam op over the whole parameter set
+        # (ops/optimizer_ops.py) instead of N per-param adam ops: the
+        # update applies through a single flattened-segment kernel under
+        # the pallas/xla tiers (PADDLE_FUSED_TIER) and attributes as one
+        # unit under PADDLE_PROFILE_OPS. Numerics: bit-identical per-param
+        # expressions under tier 'off'; params carrying a per-param lr
+        # multiplier keep their individual adam op.
+        self._fuse = bool(fuse)
+
+    def _append_optimize_ops(self, block, params_grads):
+        if not self._fuse:
+            return super(AdamOptimizer, self)._append_optimize_ops(
+                block, params_grads)
+        plain, custom_lr = [], []
+        for pg in params_grads:
+            lr_mult = getattr(pg[0], 'optimize_attr', {}).get(
+                'learning_rate', 1.0)
+            (plain if not isinstance(lr_mult, Variable)
+             and lr_mult == 1.0 else custom_lr).append(pg)
+        optimize_ops = []
+        if plain:
+            acc = self._get_accumulator
+            inputs = {
+                'Params': [pg[0] for pg in plain],
+                'Grads': [pg[1] for pg in plain],
+                'Moment1s': [acc(self._moment1_acc_str, pg[0])
+                             for pg in plain],
+                'Moment2s': [acc(self._moment2_acc_str, pg[0])
+                             for pg in plain],
+                'Beta1Pows': [acc(self._beta1_pow_acc_str, pg[0])
+                              for pg in plain],
+                'Beta2Pows': [acc(self._beta2_pow_acc_str, pg[0])
+                              for pg in plain],
+                'LearningRate': [self._global_learning_rate],
+            }
+            optimize_ops.append(block.append_op(
+                type='fused_adam',
+                inputs=inputs,
+                outputs={'ParamsOut': inputs['Params'],
+                         'Moment1sOut': inputs['Moment1s'],
+                         'Moment2sOut': inputs['Moment2s'],
+                         'Beta1PowsOut': inputs['Beta1Pows'],
+                         'Beta2PowsOut': inputs['Beta2Pows']},
+                attrs={'beta1': self._beta1, 'beta2': self._beta2,
+                       'epsilon': self._epsilon}))
+        for pg in custom_lr:
+            optimize_ops.append(self._append_optimize_op(block, pg))
+        return optimize_ops
 
     def _create_accumulators(self, block, parameters):
         for p in parameters:

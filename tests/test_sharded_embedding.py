@@ -113,6 +113,45 @@ def test_distributed_embedding_big_vocab_compiles():
     assert l1 < l0          # sgd applied through the sharded scatter
 
 
+@pytest.mark.xfail(
+    strict=True,
+    reason="jax 0.4.37 XLA SPMD partitioner: scatter-add whose indices/"
+           "updates CONCAT batch-sharded vectors into a dim-0-sharded "
+           "operand misplaces shard-0 updates at stride-N rows and drops "
+           "the rest. core/lowering.py works around it by pinning the "
+           "concatenated SelectedRows rows/values replicated; when a jax "
+           "upgrade makes this test XPASS, the pin can be dropped.")
+def test_sharded_scatter_concat_partitioner():
+    """Minimized raw-jax repro of the bug behind the (formerly failing)
+    sharded-embedding trajectory divergence — no paddle_tpu machinery."""
+    from jax import lax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    vocab, dim, slots, batch = 64, 8, 4, 8
+    rng = np.random.RandomState(0)
+    w0 = rng.randn(vocab, dim).astype('float32')
+    ids = rng.randint(0, vocab, (batch, slots)).astype('int32')
+    lab = rng.randint(0, 2, (batch, 1)).astype('float32')
+
+    def step(w, ids, lab):
+        sites = [ids[:, s].reshape(-1) for s in range(slots)]
+        vals = [jnp.take(w, s_, axis=0) * lab for s_ in sites]
+        rows = jnp.concatenate(sites)
+        v = jnp.concatenate(vals)
+        return w.at[rows].add(-0.1 * v, mode='drop')
+
+    ref = jax.jit(step)(w0, ids, lab)
+    devs = np.array(jax.devices()).reshape(2, 4)
+    with Mesh(devs, ('data', 'model')) as mesh:
+        sh_w = NamedSharding(mesh, P('model', None))
+        sh_b = NamedSharding(mesh, P('data', None))
+        got = jax.jit(step, in_shardings=(sh_w, sh_b, sh_b),
+                      out_shardings=sh_w)(
+            jax.device_put(w0, sh_w), jax.device_put(ids, sh_b),
+            jax.device_put(lab, sh_b))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-6, atol=1e-6)
+
+
 # ---------------------------------------------------------------------------
 # op tail
 # ---------------------------------------------------------------------------

@@ -1,0 +1,170 @@
+"""Per-op fused-vs-unfused microbench for the kernel tier.
+
+For each fused unit (softmax_ce / fused_adam / embedding_gather) this
+builds a small program that isolates the op, compiles it under each
+requested PADDLE_FUSED_TIER, and reports steady-state wall time
+(best-of-rounds minima over k dispatches — the box-noise protocol from
+BASELINE notes) next to the XLA cost-analysis columns mined from the
+analysis registry (flops / bytes_accessed per compiled program), so a
+tier's win or loss shows up with its bandwidth story attached.
+
+Usage: python tools/kernbench.py [--tiers off,xla,interpret]
+       [--cases softmax_ce,fused_adam,embedding_gather] [--rounds 5]
+       [--size small|bench]   (prints one JSON line)
+
+On CPU the 'pallas' tier runs through the interpreter (pass 'interpret');
+its wall time is NOT meaningful — the interpret rows exist to check the
+kernels dispatch and to carry the analytics columns. Real pallas timing
+needs the TPU box (tools/tpu_smoke.py environment).
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _build_softmax_ce(size):
+    import numpy as np
+    import paddle_tpu as fluid
+    n, v = (256, 512) if size == 'small' else (4096, 32000)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data(name='kx', shape=[v], dtype='float32')
+        y = fluid.layers.data(name='ky', shape=[1], dtype='int64')
+        # a [v] bias parameter makes the backward run THROUGH the CE unit
+        # without adding a matmul that would swamp the measurement
+        b = fluid.layers.create_parameter([v], 'float32')
+        logits = fluid.layers.elementwise_add(x, b)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    rng = np.random.RandomState(0)
+    feed = {'kx': rng.randn(n, v).astype('float32'),
+            'ky': rng.randint(0, v, (n, 1)).astype('int64')}
+    return main, startup, feed, loss
+
+
+def _build_fused_adam(size):
+    import numpy as np
+    import paddle_tpu as fluid
+    d = 64 if size == 'small' else 1024
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data(name='ax', shape=[d], dtype='float32')
+        h = x
+        for _ in range(4):
+            h = fluid.layers.fc(h, size=d, act='relu')
+        loss = fluid.layers.mean(h)
+        fluid.optimizer.Adam(1e-3, fuse=True).minimize(loss)
+    rng = np.random.RandomState(0)
+    feed = {'ax': rng.randn(32, d).astype('float32')}
+    return main, startup, feed, loss
+
+
+def _build_embedding_gather(size):
+    import numpy as np
+    import paddle_tpu as fluid
+    v, d, n = (1024, 128, 512) if size == 'small' else (100000, 256, 8192)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        ids = fluid.layers.data(name='ei', shape=[1], dtype='int64')
+        emb = fluid.layers.embedding(ids, size=[v, d])
+        out = fluid.layers.reduce_sum(emb)
+    rng = np.random.RandomState(0)
+    feed = {'ei': rng.randint(0, v, (n, 1)).astype('int64')}
+    return main, startup, feed, out
+
+
+_CASES = {
+    'softmax_ce': _build_softmax_ce,
+    'fused_adam': _build_fused_adam,
+    'embedding_gather': _build_embedding_gather,
+}
+
+
+def _measure(build, tier, rounds, k, size):
+    import numpy as np
+    import jax
+    import paddle_tpu as fluid
+    from paddle_tpu import analysis
+
+    prev = os.environ.get('PADDLE_FUSED_TIER')
+    if tier is None:
+        os.environ.pop('PADDLE_FUSED_TIER', None)
+    else:
+        os.environ['PADDLE_FUSED_TIER'] = tier
+    try:
+        main, startup, feed, fetch = build(size)
+        exe = fluid.Executor(fluid.TPUPlace(0))
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            t0 = time.time()
+            exe.run(startup, scope=scope)
+            out = exe.run(main, feed=feed, fetch_list=[fetch], scope=scope)
+            jax.block_until_ready(
+                [np.asarray(o, copy=False) if not hasattr(o, 'block_until_ready')
+                 else o for o in out])
+            compile_s = time.time() - t0
+            best = float('inf')
+            for _ in range(rounds):
+                t0 = time.time()
+                for _ in range(k):
+                    out = exe.run(main, feed=feed, fetch_list=[fetch],
+                                  scope=scope, return_numpy=False)
+                jax.block_until_ready(list(out))
+                best = min(best, (time.time() - t0) / k)
+        row = {'wall_us': round(best * 1e6, 1),
+               'compile_s': round(compile_s, 3)}
+        rec = analysis.lookup(main)
+        if rec is not None and rec.flops is not None:
+            row['flops'] = rec.flops
+            row['bytes_accessed'] = rec.bytes_accessed
+        return row
+    finally:
+        if prev is None:
+            os.environ.pop('PADDLE_FUSED_TIER', None)
+        else:
+            os.environ['PADDLE_FUSED_TIER'] = prev
+
+
+def measure_kernbench(cases=None, tiers=None, rounds=5, k=10,
+                      size='small'):
+    """Importable entry (the tier-1 smoke test runs one tiny case)."""
+    cases = list(cases or _CASES)
+    tiers = list(tiers or ['off', 'xla', 'interpret'])
+    out = {}
+    for case in cases:
+        out[case] = {}
+        for tier in tiers:
+            try:
+                out[case][tier] = _measure(_CASES[case], tier, rounds, k,
+                                           size)
+            except Exception as e:      # noqa: BLE001 — advisory tool
+                out[case][tier] = {'error': '%s: %s' % (
+                    type(e).__name__, str(e)[:200])}
+        off = out[case].get('off', {}).get('wall_us')
+        for tier, row in out[case].items():
+            if off and row.get('wall_us'):
+                row['vs_off'] = round(off / row['wall_us'], 3)
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument('--cases', default=','.join(_CASES))
+    ap.add_argument('--tiers', default='off,xla,interpret')
+    ap.add_argument('--rounds', type=int, default=5)
+    ap.add_argument('--k', type=int, default=10)
+    ap.add_argument('--size', default='small',
+                    choices=('small', 'bench'))
+    args = ap.parse_args()
+    res = measure_kernbench(args.cases.split(','), args.tiers.split(','),
+                            args.rounds, args.k, args.size)
+    print(json.dumps(res))
+
+
+if __name__ == '__main__':
+    main()
