@@ -899,6 +899,35 @@ def _child(mode):
         costreport = {'error': '%s: %s' % (type(e).__name__,
                                            str(e)[:200])}
 
+    # mesh-partitioned fused-kernel smoke (tools/kernbench.py --mesh 2):
+    # each fused unit must dispatch its PARTITIONED impl under
+    # mesh(data=2) — the mesh_dispatch sub-dicts carry the
+    # fused_kernel_dispatch_total{...,mesh=n} proof rows. Tiny configs:
+    # this is a dispatch/coverage row, not a timing row. On a
+    # single-device host it runs as a SUBPROCESS of the kernbench CLI
+    # (which forces its own virtual multi-device CPU) so this child's
+    # topology — and every other row's timing — stays untouched.
+    try:
+        if len(jax.devices()) >= 2:
+            from tools.kernbench import measure_kernbench
+            kernbench_mesh = measure_kernbench(
+                tiers=['off', 'pallas' if on_tpu else 'interpret'],
+                rounds=1, k=2, size='small', mesh=2)
+        else:
+            res = subprocess.run(
+                [sys.executable,
+                 os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              'tools', 'kernbench.py'),
+                 '--tiers', 'off,interpret', '--rounds', '1', '--k', '2',
+                 '--mesh', '2'],
+                capture_output=True, text=True, timeout=600,
+                env=dict(os.environ))
+            kernbench_mesh = json.loads(
+                (res.stdout or '').strip().splitlines()[-1])
+    except Exception as e:
+        kernbench_mesh = {'error': '%s: %s' % (type(e).__name__,
+                                               str(e)[:200])}
+
     if on_tpu:
         flagship_cfg = dict(vocab_size=32000, seq_len=512, d_model=512,
                             n_head=8, n_layer=6, d_ff=2048, dropout=0.1,
@@ -994,6 +1023,7 @@ def _child(mode):
         'async_pipeline': async_pipeline,
         'elastic_resume': elastic_resume,
         'costreport': costreport,
+        'kernbench_mesh': kernbench_mesh,
         'flops': flag.get('flops'),
         'peak_bytes': flag.get('peak_bytes'),
         'final_loss': flag['final_loss'],

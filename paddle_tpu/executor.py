@@ -146,6 +146,36 @@ def _check_nan_inf(new_state, fetches):
             "run" % sorted(set(bad)))
 
 
+def _feed_from_spec(feed_spec):
+    """Normalize a precompile/warmfarm feed spec into concrete arrays:
+    real arrays/scalars pass through; (shape, dtype) tuples and
+    ShapeDtypeStruct-likes become zero arrays. ONE implementation shared
+    by Executor.precompile and warmfarm.signature so the two can never
+    disagree on what a spec hashes to."""
+    def _dtype_like(v):
+        try:
+            np.dtype(v)
+            return True
+        except TypeError:
+            return False
+
+    feed = {}
+    for name, spec in (feed_spec or {}).items():
+        if isinstance(spec, (np.ndarray, jax.Array)) or np.isscalar(spec):
+            feed[name] = spec
+        elif isinstance(spec, (tuple, list)) and len(spec) == 2 and \
+                not hasattr(spec, 'dtype') and _dtype_like(spec[1]):
+            # (shape, dtype) — the dtype-like check keeps a 2-element
+            # DATA list ([1.0, 2.0]) on the array path below
+            feed[name] = np.zeros(spec[0], dtype=spec[1])
+        elif hasattr(spec, 'shape') and hasattr(spec, 'dtype'):
+            # jax.ShapeDtypeStruct or anything aval-like
+            feed[name] = np.zeros(spec.shape, dtype=spec.dtype)
+        else:
+            feed[name] = np.asarray(spec)      # plain lists: real data
+    return feed
+
+
 def _run_key(random_seed, program_runs, global_counter):
     """PRNG base key for one executor run.
 
@@ -1869,6 +1899,105 @@ class Executor(object):
             for block in program.blocks for op in block.ops)
         return BoundProgram(self, entry, program, scope, needs_rng,
                             first_out, example_feed=feed2)
+
+    # ------------------------------------------------------------------
+    def precompile(self, program=None, feed_spec=None, fetch_list=None,
+                   scope=None, donate=None):
+        """AOT lowered-artifact reuse: lower + XLA-compile the (program,
+        feed signature, fetch set) entry ahead of traffic, keyed by the
+        SAME fingerprint compile cache ``run()`` uses — the first real
+        dispatch then hits both the entry cache and the jitted
+        executable. Unlike a warmup ``run()``, nothing observable
+        happens: the compile executes against zero-filled feeds and
+        COPIES of the scope's read-write state (donation consumes the
+        copies), the scope is never updated, and the PRNG run counters
+        do not advance — a precompiled training program replays the
+        exact trajectory it would have without precompile.
+
+        ``feed_spec``: {name: array | (shape, dtype) | ShapeDtypeStruct}.
+        Pass real arrays for shape-bearing (static) feeds — zeros bind as
+        the trace-time constant otherwise. Returns {'compiled', 'seconds',
+        'cached'}; a second precompile (or any run) of the same signature
+        is a cache hit with seconds ≈ 0 — the contract
+        tools/warmfarm.py builds the cross-worker warmup farm on."""
+        if program is None:
+            program = default_main_program()
+        if scope is None:
+            scope = global_scope()
+        if analysis.profile_ops_active():
+            return {'compiled': False, 'cached': False, 'seconds': 0.0,
+                    'skipped': 'profile_ops'}
+        feed = _feed_from_spec(feed_spec)
+        feed, fetch_names, static_feed, static_lods = \
+            self._prepare_run_inputs(program, feed, scope, fetch_list,
+                                     count=False)
+        seg_mode = os.environ.get('PADDLE_SEGMENT_HOST_OPS', 'auto')
+        if seg_mode != '0' and any(op.type in _HOST_SEGMENT_OPS for op in
+                                   program.global_block().ops):
+            # segmented (host-op) programs compile per segment inside
+            # run(); an AOT pass would have to execute host callbacks on
+            # fabricated data — not a warmup farm's contract
+            return {'compiled': False, 'cached': False, 'seconds': 0.0,
+                    'skipped': 'host_ops'}
+        if donate is None and analysis.nan_localization_enabled():
+            from . import flags as _flags
+            if _flags.get_flags('check_nan_inf'):
+                # mirror _run_impl's localize force-off so the key below
+                # matches the entry the real run() will look up
+                donate = False
+        # record=False: this is a policy QUERY for the cache key (like
+        # bind's) — an AOT pass must not inflate donation counters
+        donate_flag = _donation_enabled(override=donate, record=False)
+        key = (program._fingerprint(),
+               self._feed_signature(feed, static_lods, static_feed),
+               tuple(fetch_names), donate_flag)
+        monitor.inc('precompile_total')
+        if self._cache_get(key) is not None:
+            monitor.inc('compile_cache_hit')
+            return {'compiled': False, 'cached': True, 'seconds': 0.0}
+        monitor.inc('compile_cache_miss')
+        t0 = time.perf_counter()
+        _wire_persistent_cache()
+
+        def _build():
+            resilience.maybe_fault('compile')
+            read, written = lowering.analyze_state(program, fetch_names)
+            needed = self._read_before_write(program, read, written,
+                                             set(feed), fetch_names)
+            lod_out = {}
+            fn, ro_names, rw_names = lowering.build_callable(
+                program, fetch_names, needed, written,
+                static_lods=static_lods, static_feed=static_feed,
+                lod_out=lod_out, donate=donate_flag)
+            return _CompiledEntry(fn, fetch_names, ro_names, rw_names,
+                                  written, program, lod_out)
+        try:
+            entry = _build()
+        except Exception as e:          # noqa: BLE001 — classified inside
+            entry = resilience.retry_after(e, _build, site='compile')
+        self._cache_put(key, entry)
+        ro_state = {n: self._state_value(scope, n, program)
+                    for n in entry.ro_names}
+        # rw state is DONATED by the compiled fn: hand it throwaway
+        # copies so the scope's live buffers survive precompilation
+        rw_state = {n: jnp.array(
+            self._state_value(scope, n, program, cache=False), copy=True)
+            for n in entry.rw_names}
+        key_arr = _run_key(program.random_seed, 0, 0)
+
+        def _first_call():
+            with monitor.span('compile'):
+                return entry.fn(feed, ro_state, rw_state, key_arr)
+        try:
+            fetches, new_state = _first_call()
+        except Exception as e:          # noqa: BLE001 — classified inside
+            fetches, new_state = resilience.retry_after(
+                e, _first_call, site='compile', state=rw_state)
+        del fetches, new_state          # scope stays untouched
+        seconds = time.perf_counter() - t0
+        monitor.observe('compile_seconds', seconds)
+        return {'compiled': True, 'cached': False,
+                'seconds': round(seconds, 4)}
 
     # ------------------------------------------------------------------
     def explain(self, program=None, feed=None, fetch_list=None, scope=None,

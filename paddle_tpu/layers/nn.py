@@ -14,6 +14,7 @@ __all__ = [
     'square_error_cost', 'softmax_with_cross_entropy',
     'sigmoid_cross_entropy_with_logits', 'conv2d', 'conv3d',
     'conv2d_transpose', 'pool2d', 'pool3d', 'batch_norm', 'layer_norm',
+    'fused_layer_norm_residual',
     'group_norm', 'data_norm', 'l2_normalize', 'matmul', 'mul', 'topk',
     'reshape', 'squeeze', 'unsqueeze', 'flatten', 'transpose', 'split',
     'reduce_sum', 'reduce_mean', 'reduce_max', 'reduce_min', 'reduce_prod',
@@ -493,6 +494,38 @@ def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
                      attrs={'epsilon': epsilon,
                             'begin_norm_axis': begin_norm_axis})
     return helper.append_activation(out)
+
+
+def fused_layer_norm_residual(input, residual, begin_norm_axis=1,
+                              epsilon=1e-5, param_attr=None,
+                              bias_attr=None, name=None):
+    """Fused residual-add + LayerNorm pair (kernel-tier unit,
+    ops/nn_ops.py fused_ln_residual): returns ``(normed, summed)`` where
+    ``summed = input + residual`` and ``normed = LN(summed)*scale+bias``.
+    PADDLE_FUSED_TIER selects the lowering; tier 'off' reproduces
+    elementwise_add + layer_norm bitwise, so wiring this pair into a
+    model never changes legacy numerics."""
+    helper = LayerHelper('fused_ln_residual', param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    dtype = input.dtype
+    norm_shape = [_prod(input.shape[begin_norm_axis:])]
+    s = helper.create_parameter(attr=helper.param_attr, shape=norm_shape,
+                                dtype=dtype,
+                                default_initializer=Constant(1.0))
+    b = helper.create_parameter(attr=helper.bias_attr or ParamAttr(),
+                                shape=norm_shape, dtype=dtype,
+                                is_bias=True)
+    out = helper.create_variable_for_type_inference(dtype,
+                                                    shape=input.shape)
+    summed = helper.create_variable_for_type_inference(dtype,
+                                                       shape=input.shape)
+    helper.append_op(type='fused_ln_residual',
+                     inputs={'X': [input], 'Residual': [residual],
+                             'Scale': [s], 'Bias': [b]},
+                     outputs={'Y': [out], 'ResidualOut': [summed]},
+                     attrs={'epsilon': epsilon,
+                            'begin_norm_axis': begin_norm_axis})
+    return out, summed
 
 
 def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,

@@ -114,10 +114,13 @@ def transformer_block(x, cfg, prefix, mask_var=None, is_test=False,
                                 mask_var=mask_var, is_test=is_test,
                                 causal=causal,
                                 key_padding_bias=key_padding_bias)
-    x = layers.elementwise_add(x, attn)
-    ln2 = layers.layer_norm(x, begin_norm_axis=2,
-                            param_attr=ParamAttr(name=prefix + '.ln2.w'),
-                            bias_attr=ParamAttr(name=prefix + '.ln2.b'))
+    # fused residual-add + LayerNorm pair (kernel-tier unit): computes
+    # x = x + attn and ln2 = LN(x) in one lowering — tier 'off' is
+    # bitwise elementwise_add + layer_norm, so legacy numerics hold
+    ln2, x = layers.fused_layer_norm_residual(
+        x, attn, begin_norm_axis=2,
+        param_attr=ParamAttr(name=prefix + '.ln2.w'),
+        bias_attr=ParamAttr(name=prefix + '.ln2.b'))
     ff1 = layers.fc(input=ln2, size=cfg.d_ff, num_flatten_dims=2,
                     act='gelu',
                     param_attr=ParamAttr(name=prefix + '.ffn1.w'),

@@ -481,16 +481,17 @@ def flash_attention(q, k, v, scale=None, causal=True, use_pallas=None,
 # --------------------------------------------------------------------------
 
 def _shard_map(fn, mesh, in_specs, out_specs):
-    from ..parallel.ring_attention import _shard_map as impl
-    return impl(fn, mesh, in_specs, out_specs)
+    # shared fused-tier wrapper (ops/kernel_tier.partitioned_call) — this
+    # module's original helper, extracted so CE/adam/embedding/layernorm
+    # partition the same way
+    from .kernel_tier import partitioned_call
+    return partitioned_call(fn, mesh, in_specs, out_specs)
 
 
 def _mesh_axis(mesh, name, dim_size):
     """Axis name if present, >1, and divides dim_size; else None."""
-    if name in mesh.axis_names and mesh.shape[name] > 1 \
-            and dim_size % mesh.shape[name] == 0:
-        return name
-    return None
+    from .kernel_tier import mesh_axis
+    return mesh_axis(mesh, name, dim_size)
 
 
 def flash_attention_spmd(q, k, v, mesh, scale=None, causal=True,

@@ -36,6 +36,22 @@ def pallas_shapes_ok(w, n_ids):
         w.dtype == jnp.float32
 
 
+def spmd_gather_ok(mesh, w, n_ids, w_spec=None):
+    """Mesh-partitioning rule for the gather kernel: ids partition over
+    'data' (kernel per shard via kernel_tier.partitioned_call, table
+    replicated into each shard) — so the TABLE itself must be replicated.
+    A sharded table (`w_spec` names a mesh axis, or the is_distributed
+    vocab-sharded pin) keeps the XLA gather, which the SPMD partitioner
+    turns into shard-local masked gathers + psum; an explicitly
+    replicated spec (P() or P(None, ...)) stays eligible."""
+    if w_spec is not None and any(e is not None for e in tuple(w_spec)):
+        return False
+    from .kernel_tier import mesh_axis
+    data_ax = mesh_axis(mesh, 'data', n_ids)
+    n_loc = n_ids // mesh.shape[data_ax] if data_ax else n_ids
+    return pallas_shapes_ok(w, n_loc)
+
+
 def _gather_kernel(has_bias, *refs):
     if has_bias:
         ids_ref, row_ref, bias_ref, out_ref = refs
@@ -104,6 +120,13 @@ def _gather_grad_bwd(impl, w_shape, w_dtype_str, res, ct):
 _gather_grad.defvjp(_gather_grad_fwd, _gather_grad_bwd)
 
 
+def _gather_dispatch(w, flat_ids, bias, impl, differentiable):
+    if differentiable:
+        return _gather_grad(w, flat_ids, bias, impl,
+                            tuple(w.shape), str(w.dtype))
+    return _gather_pallas(w, flat_ids, bias, impl == 'interpret')
+
+
 def embedding_gather(w, flat_ids, bias=None, impl='off', differentiable=True):
     """Rows of ``w`` at ``flat_ids`` (+ optional per-feature ``bias``).
 
@@ -112,13 +135,35 @@ def embedding_gather(w, flat_ids, bias=None, impl='off', differentiable=True):
     'pallas'/'interpret' -> the scalar-prefetch kernel, wrapped in a
     custom_vjp whose backward is the same scatter-add transpose.
     ``differentiable=False`` skips the vjp wrapper (the sparse scout/apply
-    path holds w out of AD already)."""
+    path holds w out of AD already).
+
+    Under an active >1-device mesh the kernel runs PER SHARD via
+    kernel_tier.partitioned_call: ids partition over 'data', the table
+    rides replicated into every shard (dispatch only picks pallas here
+    when the table IS replicated — spmd_gather_ok), and the dense
+    backward's scatter-add cotangent psums across the data axis through
+    shard_map's transpose. The sparse path's replicated-rows pin
+    (core/lowering.py) is untouched — it operates on the optimizer-side
+    SelectedRows scatter, not this gather."""
     flat_ids = flat_ids.astype(jnp.int32)
     if impl in ('pallas', 'interpret'):
-        if differentiable:
-            return _gather_grad(w, flat_ids, bias, impl,
-                                tuple(w.shape), str(w.dtype))
-        return _gather_pallas(w, flat_ids, bias, impl == 'interpret')
+        from ..parallel.api import get_active_mesh
+        mesh = get_active_mesh()
+        if mesh is not None and mesh.size > 1:
+            from jax.sharding import PartitionSpec as P
+            from .kernel_tier import partitioned_call, mesh_axis
+            data_ax = mesh_axis(mesh, 'data', flat_ids.shape[0])
+            has_bias = bias is not None
+
+            def inner(wl, il, *mb):
+                return _gather_dispatch(wl, il, mb[0] if mb else None,
+                                        impl, differentiable)
+
+            in_specs = [P(), P(data_ax)] + ([P()] if has_bias else [])
+            args = [w, flat_ids] + ([bias] if has_bias else [])
+            return partitioned_call(inner, mesh, tuple(in_specs),
+                                    P(data_ax, None))(*args)
+        return _gather_dispatch(w, flat_ids, bias, impl, differentiable)
     return _gather_ref(w, flat_ids, bias)
 
 
@@ -130,18 +175,23 @@ def _fused_embedding_gather(ctx, op):
     lookup_table when W is an is_sparse wrt table."""
     from . import kernel_tier
     from .tensor_ops import embedding_epilogue, lookup_gather
-    from ..parallel.api import get_active_mesh
+    from ..parallel.api import get_active_mesh, get_active_param_spec
     w = ctx.in1(op, 'W')
     ids = ctx.in1(op, 'Ids')
     bias = ctx.in1(op, 'Bias')
     flat = ids.reshape(-1).astype(jnp.int32)
     mesh = get_active_mesh()
+    if mesh is not None and mesh.size > 1:
+        # mesh-native: ids partition over 'data' via partitioned_call
+        # (embedding_gather routes through shard_map); a SHARDED table
+        # falls back to the XLA gather the partitioner can split
+        spec_fn = get_active_param_spec()
+        w_spec = spec_fn(op.input('W')[0]) if spec_fn else None
+        ok = spmd_gather_ok(mesh, w, int(flat.shape[0]), w_spec)
+    else:
+        ok = pallas_shapes_ok(w, int(flat.shape[0]))
     impl = kernel_tier.dispatch(
-        'fused_embedding_gather',
-        # same rule as lookup_table: a pallas custom call cannot be
-        # auto-partitioned under a >1-device mesh
-        pallas_ok=(mesh is None or mesh.size == 1)
-        and pallas_shapes_ok(w, int(flat.shape[0])),
+        'fused_embedding_gather', pallas_ok=ok, mesh=mesh,
         count=getattr(ctx, 'sparse_mode', None) != 'scout')
     out = lookup_gather(ctx, op, w, flat, bias=bias, impl=impl)
     ctx.out(op, 'Out', embedding_epilogue(

@@ -24,9 +24,16 @@ Dispatch is resolved at TRACE time (op lowerings consult it while the
 program compiles), so steady-state dispatch costs nothing per run; the
 executor folds :func:`cache_token` — one env read — into its compile-cache
 keys so flipping the knob recompiles instead of serving stale kernels.
-Every resolution lands in the ``fused_kernel_dispatch_total{op,impl}``
-counter, so bench counter deltas and obsreport show which tier actually
+Every resolution lands in the ``fused_kernel_dispatch_total{op,impl,mesh}``
+counter (``mesh='1'`` single-device, ``'n'`` under an active >1-device
+mesh), so bench counter deltas and obsreport show which tier actually
 ran (and when a shape forced a per-op fallback).
+
+Mesh-native fused units partition through :func:`partitioned_call` — the
+shard_map-over-mesh wrapper extracted from ops/attention_ops.py (riding
+parallel/ring_attention._shard_map), so every fused unit shards the way
+flash attention already does instead of falling back to the xla tier the
+moment a mesh is active.
 """
 import os
 
@@ -34,7 +41,8 @@ import jax
 
 from .. import monitor
 
-__all__ = ['resolve_tier', 'dispatch', 'cache_token', 'TIERS']
+__all__ = ['resolve_tier', 'dispatch', 'cache_token', 'TIERS',
+           'partitioned_call', 'mesh_axis']
 
 TIERS = ('off', 'xla', 'pallas', 'interpret')
 
@@ -71,7 +79,8 @@ def cache_token():
     return _ALIASES.get(str(raw).strip().lower(), raw)
 
 
-def dispatch(op, pallas_ok=True, xla_ok=True, tier=None, count=True):
+def dispatch(op, pallas_ok=True, xla_ok=True, tier=None, count=True,
+             mesh=None):
     """Resolve the impl for one fused unit and count the decision.
 
     ``pallas_ok``: the shapes tile for the Pallas kernel (when False, a
@@ -81,8 +90,10 @@ def dispatch(op, pallas_ok=True, xla_ok=True, tier=None, count=True):
     skips the counter — used by lowerings re-entered on the sparse-grad
     SCOUT pass (core/lowering.py lowers the forward segment twice for
     is_sparse programs; counting both would double every dispatch the
-    bench deltas report). Returns one of
-    'off' | 'xla' | 'pallas' | 'interpret'.
+    bench deltas report). ``mesh``: the active mesh (or None) — labels
+    the counter ``mesh='n'`` when the decision ran under a >1-device
+    mesh, so sharded bench rows prove which impl actually partitioned.
+    Returns one of 'off' | 'xla' | 'pallas' | 'interpret'.
     """
     impl = tier if tier is not None else resolve_tier()
     if impl in ('pallas', 'interpret') and not pallas_ok:
@@ -90,6 +101,37 @@ def dispatch(op, pallas_ok=True, xla_ok=True, tier=None, count=True):
     if impl == 'xla' and not xla_ok:
         impl = 'off'
     if count:
+        meshed = mesh is not None and getattr(mesh, 'size', 1) > 1
         monitor.inc('fused_kernel_dispatch_total',
-                    labels={'op': op, 'impl': impl})
+                    labels={'op': op, 'impl': impl,
+                            'mesh': 'n' if meshed else '1'})
     return impl
+
+
+# ---------------------------------------------------------------------------
+# SPMD: the shared shard_map-over-mesh wrapper (extracted from
+# ops/attention_ops.py so every fused unit partitions the way flash
+# attention does)
+# ---------------------------------------------------------------------------
+
+def partitioned_call(fn, mesh, in_specs, out_specs):
+    """shard_map ``fn`` over ``mesh`` with the given PartitionSpecs — one
+    kernel invocation per shard, XLA stitching the shards back together.
+    Rides parallel/ring_attention._shard_map (manual-over-all-axes with
+    the jax-version fallbacks handled there); axes a spec does not name
+    see replicated data, so e.g. a data-only spec under
+    mesh(data=2, model=2) runs the same per-shard kernel on both model
+    rows. A pallas custom call cannot be auto-partitioned by the XLA
+    SPMD partitioner — this wrapper is what lets the fused tier survive
+    an active mesh at all."""
+    from ..parallel.ring_attention import _shard_map
+    return _shard_map(fn, mesh, in_specs, out_specs)
+
+
+def mesh_axis(mesh, name, dim_size):
+    """Mesh axis ``name`` if present, >1, and divides ``dim_size``; else
+    None (the caller leaves that dimension unsharded)."""
+    if name in mesh.axis_names and mesh.shape[name] > 1 \
+            and dim_size % mesh.shape[name] == 0:
+        return name
+    return None

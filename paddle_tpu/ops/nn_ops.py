@@ -92,24 +92,37 @@ def _softmax_with_ce(ctx, op):
         return
     lab = label.reshape(-1).astype(jnp.int32)
     impl = 'off'
+    meshed = False
     if logits.ndim == 2:
         from . import kernel_tier
-        from .ce_ops import fused_softmax_ce, pallas_shapes_ok
+        from .ce_ops import (fused_softmax_ce, fused_softmax_ce_spmd,
+                             pallas_shapes_ok, spmd_shapes_ok)
         from ..parallel.api import get_active_mesh
         mesh = get_active_mesh()
+        meshed = mesh is not None and mesh.size > 1
+        if meshed:
+            # the kernel runs PER SHARD via kernel_tier.partitioned_call
+            # (a pallas custom call cannot be auto-partitioned), so the
+            # tiling rule applies to the post-partitioning local block
+            pallas_ok = spmd_shapes_ok(mesh, logits.shape[0],
+                                       logits.shape[1])
+        else:
+            pallas_ok = pallas_shapes_ok(logits.shape[0], logits.shape[1])
         impl = kernel_tier.dispatch(
-            'softmax_with_cross_entropy',
-            # a pallas custom call cannot be auto-partitioned: under an
-            # active >1-device mesh the xla emission partitions instead
-            pallas_ok=(mesh is None or mesh.size == 1)
-            and pallas_shapes_ok(logits.shape[0], logits.shape[1]),
+            'softmax_with_cross_entropy', pallas_ok=pallas_ok, mesh=mesh,
             count=getattr(ctx, 'sparse_mode', None) != 'scout')
     if impl == 'off':
         loss = _ce_hard(logits, lab, ignore_index)
+    elif meshed and impl in ('pallas', 'interpret'):
+        # mesh-partitioned kernels: batch rows over 'data' (comms-free),
+        # lse-aware all-reduce when 'model' shards the vocab
+        loss = fused_softmax_ce_spmd(logits, lab, mesh, ignore_index,
+                                     impl)
     else:
         # fused tier (ops/ce_ops.py): online-softmax single pass, backward
         # recomputed from (logits, lse) — no [N, V] one-hot/softmax
-        # residual ever materializes
+        # residual ever materializes. The xla emission is plain jnp, so
+        # under a mesh the XLA SPMD partitioner shards it natively.
         loss = fused_softmax_ce(logits, lab, ignore_index, impl)
     ctx.out(op, 'Loss', loss[:, None])
     # the Softmax output only materializes if the program consumes it
@@ -411,6 +424,230 @@ def _layer_norm(ctx, op):
     ctx.out(op, 'Y', y)
     ctx.out(op, 'Mean', m.reshape(x.shape[:bna]).reshape(-1))
     ctx.out(op, 'Variance', v.reshape(x.shape[:bna]).reshape(-1))
+
+
+# ---------------------------------------------------------------------------
+# Fused LayerNorm + residual-add — the 4th kernel-tier unit
+# (ops/kernel_tier.py). The pre-norm transformer block pays this pair
+# twice per layer (residual add feeding the next norm); fusing them keeps
+# the summed row in VMEM across both (one HBM pass), the fwd computes
+# mean/rstddev in that same sweep, and the bwd recomputes x_hat from the
+# saved O(N) stats instead of residualizing any normalized [N, D] tensor.
+# ---------------------------------------------------------------------------
+
+def ln_res_shapes_ok(n, d):
+    """Tiling rule: full rows fit one (bn, d) VMEM block (d fills whole
+    lanes, bounded so in+out+grad blocks stay well under VMEM), and the
+    row count tiles a power-of-two block."""
+    from .ce_ops import _pick_block
+    return d % 128 == 0 and d <= 8192 and \
+        _pick_block(n, 128, 8) is not None
+
+
+def ln_res_spmd_ok(mesh, n, d):
+    """Per-shard rule under a mesh: rows partition over 'data'."""
+    from .kernel_tier import mesh_axis
+    ax = mesh_axis(mesh, 'data', n)
+    n_loc = n // mesh.shape[ax] if ax else n
+    return ln_res_shapes_ok(n_loc, d)
+
+
+def _ln_res_fwd_kernel(eps, x_ref, r_ref, sc_ref, b_ref,
+                       s_ref, y_ref, m_ref, rs_ref):
+    s = x_ref[...].astype(jnp.float32) + r_ref[...].astype(jnp.float32)
+    m = jnp.mean(s, axis=-1, keepdims=True)
+    c = s - m
+    rstd = 1.0 / jnp.sqrt(jnp.mean(c * c, axis=-1, keepdims=True) + eps)
+    s_ref[...] = s.astype(s_ref.dtype)
+    y_ref[...] = (c * rstd * sc_ref[...] + b_ref[...]).astype(y_ref.dtype)
+    m_ref[0] = m[:, 0]
+    rs_ref[0] = rstd[:, 0]
+
+
+def _ln_res_fwd_pallas(x, r, scale, bias, eps, interpret):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    from .attention_ops import _compiler_params
+    from .ce_ops import _pick_block
+    n, d = x.shape
+    bn = _pick_block(n, 128, 8)
+    row = pl.BlockSpec((bn, d), lambda i: (i, 0))
+    vec = pl.BlockSpec((1, d), lambda i: (0, 0))
+    stat = pl.BlockSpec((1, bn), lambda i: (0, i))
+    s, y, m, rs = pl.pallas_call(
+        functools.partial(_ln_res_fwd_kernel, float(eps)),
+        grid=(n // bn,),
+        in_specs=[row, row, vec, vec],
+        out_specs=[row, row, stat, stat],
+        out_shape=[jax.ShapeDtypeStruct((n, d), x.dtype),
+                   jax.ShapeDtypeStruct((n, d), x.dtype),
+                   jax.ShapeDtypeStruct((1, n), jnp.float32),
+                   jax.ShapeDtypeStruct((1, n), jnp.float32)],
+        compiler_params=_compiler_params(pltpu, ("arbitrary",)),
+        interpret=interpret,
+    )(x, r, scale.reshape(1, d), bias.reshape(1, d))
+    return s, y, m[0], rs[0]
+
+
+def _ln_res_bwd_kernel(s_ref, m_ref, rs_ref, sc_ref, dy_ref, ds_ref,
+                       dx_ref):
+    s = s_ref[...].astype(jnp.float32)
+    m = m_ref[0][:, None]
+    rstd = rs_ref[0][:, None]
+    xhat = (s - m) * rstd
+    dyw = dy_ref[...].astype(jnp.float32) * sc_ref[...]
+    mean1 = jnp.mean(dyw, axis=-1, keepdims=True)
+    mean2 = jnp.mean(dyw * xhat, axis=-1, keepdims=True)
+    dx = rstd * (dyw - mean1 - xhat * mean2)
+    dx_ref[...] = (dx + ds_ref[...].astype(jnp.float32)).astype(
+        dx_ref.dtype)
+
+
+def _ln_res_bwd_pallas(s, m, rs, scale, dy, ds, interpret):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    from .attention_ops import _compiler_params
+    from .ce_ops import _pick_block
+    n, d = s.shape
+    bn = _pick_block(n, 128, 8)
+    row = pl.BlockSpec((bn, d), lambda i: (i, 0))
+    vec = pl.BlockSpec((1, d), lambda i: (0, 0))
+    stat = pl.BlockSpec((1, bn), lambda i: (0, i))
+    return pl.pallas_call(
+        _ln_res_bwd_kernel,
+        grid=(n // bn,),
+        in_specs=[row, stat, stat, vec, row, row],
+        out_specs=[row],
+        out_shape=[jax.ShapeDtypeStruct((n, d), s.dtype)],
+        compiler_params=_compiler_params(pltpu, ("arbitrary",)),
+        interpret=interpret,
+    )(s, m[None, :], rs[None, :], scale.reshape(1, d), dy, ds)[0]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def fused_ln_residual(x, r, scale, bias, eps, impl):
+    """(y, s) for rows x, r [N, D]: s = x + r, y = LN(s) * scale + bias.
+    ``impl`` in 'xla' | 'pallas' | 'interpret' (the 'off' tier lowers the
+    legacy composition and never reaches here). Both outputs are consumed
+    (y feeds the next sublayer, s carries the residual stream), so the
+    bwd merges both cotangents; x_hat is recomputed from (s, mean, rstd)
+    — O(N) residual stats, no [N, D] normalized tensor saved."""
+    return _ln_res_fwd(x, r, scale, bias, eps, impl)[0]
+
+
+def _ln_res_fwd(x, r, scale, bias, eps, impl):
+    if impl in ('pallas', 'interpret'):
+        s, y, m, rs = _ln_res_fwd_pallas(x, r, scale, bias, eps,
+                                         impl == 'interpret')
+    else:
+        s = x + r
+        sf = s.astype(jnp.float32)
+        m = jnp.mean(sf, axis=-1)
+        c = sf - m[:, None]
+        rs = 1.0 / jnp.sqrt(jnp.mean(c * c, axis=-1) + eps)
+        y = (c * rs[:, None] * scale + bias).astype(x.dtype)
+    return (y, s), (s, m, rs, scale)
+
+
+def _ln_res_bwd(eps, impl, res, cts):
+    dy, ds = cts
+    s, m, rs, scale = res
+    if impl in ('pallas', 'interpret'):
+        dx = _ln_res_bwd_pallas(s, m, rs, scale, dy, ds,
+                                impl == 'interpret')
+    else:
+        sf = s.astype(jnp.float32)
+        xhat = (sf - m[:, None]) * rs[:, None]
+        dyw = dy.astype(jnp.float32) * scale
+        mean1 = jnp.mean(dyw, axis=-1, keepdims=True)
+        mean2 = jnp.mean(dyw * xhat, axis=-1, keepdims=True)
+        dx = (rs[:, None] * (dyw - mean1 - xhat * mean2)
+              + ds.astype(jnp.float32)).astype(s.dtype)
+    # scale/bias grads: plain jnp reductions over the recomputed x_hat —
+    # XLA fuses them into one pass over s; nothing [N, D] is saved
+    xhat_f = (s.astype(jnp.float32) - m[:, None]) * rs[:, None]
+    dscale = jnp.sum(dy.astype(jnp.float32) * xhat_f,
+                     axis=0).astype(scale.dtype)
+    dbias = jnp.sum(dy.astype(jnp.float32), axis=0).astype(scale.dtype)
+    return dx, dx, dscale, dbias
+
+
+fused_ln_residual.defvjp(_ln_res_fwd, _ln_res_bwd)
+
+
+def fused_ln_residual_spmd(x, r, scale, bias, mesh, eps, impl):
+    """Mesh-partitioned LN+residual: rows over 'data' via
+    kernel_tier.partitioned_call — normalization is per-row, so the
+    partitioned kernel needs no comms at all; scale/bias ride replicated
+    and their cotangents psum through shard_map's transpose."""
+    from jax.sharding import PartitionSpec as P
+    from .kernel_tier import partitioned_call, mesh_axis
+    data_ax = mesh_axis(mesh, 'data', x.shape[0])
+    rowp = P(data_ax, None)
+
+    def inner(xl, rl, sc, b):
+        return fused_ln_residual(xl, rl, sc, b, eps, impl)
+
+    return partitioned_call(inner, mesh, (rowp, rowp, P(), P()),
+                            (rowp, rowp))(x, r, scale, bias)
+
+
+@register_op('fused_ln_residual')
+def _fused_ln_residual_op(ctx, op):
+    """Program-level op: Y = layer_norm(X + Residual) * Scale + Bias,
+    ResidualOut = X + Residual (both consumed: Y feeds the next sublayer,
+    ResidualOut carries the residual stream). Attrs epsilon,
+    begin_norm_axis (the normalized tail must be the LAST axis — the
+    transformer wiring's case; anything else falls to 'off'). The 'off'
+    tier reproduces elementwise_add + layer_norm BITWISE."""
+    from . import kernel_tier
+    from ..parallel.api import get_active_mesh
+    x = ctx.in1(op, 'X')
+    r = ctx.in1(op, 'Residual')
+    scale = ctx.in1(op, 'Scale')
+    bias = ctx.in1(op, 'Bias')
+    eps = op.attr('epsilon', 1e-5)
+    bna = op.attr('begin_norm_axis', x.ndim - 1)
+    fusable = scale is not None and bias is not None and \
+        bna == x.ndim - 1 and x.ndim >= 2
+    n = int(np.prod(x.shape[:-1])) if fusable else 0
+    d = x.shape[-1] if fusable else 0
+    mesh = get_active_mesh()
+    meshed = mesh is not None and mesh.size > 1
+    if fusable:
+        pallas_ok = ln_res_spmd_ok(mesh, n, d) if meshed \
+            else ln_res_shapes_ok(n, d)
+    else:
+        pallas_ok = False
+    impl = kernel_tier.dispatch(
+        'fused_ln_residual', pallas_ok=pallas_ok, xla_ok=fusable,
+        mesh=mesh, count=getattr(ctx, 'sparse_mode', None) != 'scout')
+    if impl == 'off':
+        # bitwise legacy: exactly the elementwise_add + layer_norm
+        # lowerings composed (the parity anchor)
+        s = x + r
+        axes = tuple(range(bna, x.ndim))
+        m = jnp.mean(s, axis=axes, keepdims=True)
+        v = jnp.var(s, axis=axes, keepdims=True)
+        y = (s - m) / jnp.sqrt(v + eps)
+        tail = s.shape[bna:]
+        if scale is not None:
+            y = y * scale.reshape((1,) * bna + tail)
+        if bias is not None:
+            y = y + bias.reshape((1,) * bna + tail)
+        ctx.out(op, 'Y', y)
+        ctx.out(op, 'ResidualOut', s)
+        return
+    lead = x.shape[:-1]
+    x2 = x.reshape(n, d)
+    r2 = r.reshape(n, d)
+    if meshed and impl in ('pallas', 'interpret'):
+        y2, s2 = fused_ln_residual_spmd(x2, r2, scale, bias, mesh, eps,
+                                        impl)
+    else:
+        y2, s2 = fused_ln_residual(x2, r2, scale, bias, eps, impl)
+    ctx.out(op, 'Y', y2.reshape(lead + (d,)))
+    ctx.out(op, 'ResidualOut', s2.reshape(lead + (d,)))
 
 
 @register_op('group_norm')

@@ -156,6 +156,67 @@ def _fused_adam_kernel(b1, b2, eps, lrt_ref, p_ref, g_ref, m1_ref, m2_ref,
     m2o_ref[...] = m2o
 
 
+def _mesh_spec_ok(mesh, spec, shape):
+    """True when `spec` evenly tiles `shape` over `mesh` — shard_map's
+    divisibility rule; a param that fails it takes the per-param
+    fallback instead of the partitioned fused path."""
+    entries = tuple(spec) if spec is not None else ()
+    if len(entries) > len(shape):
+        return False
+    for dim, ax in zip(shape, entries):
+        if ax is None:
+            continue
+        axes = ax if isinstance(ax, tuple) else (ax,)
+        size = 1
+        for a in axes:
+            if a not in mesh.axis_names:
+                return False
+            size *= int(mesh.shape[a])
+        if size and dim % size != 0:
+            return False
+    return True
+
+
+def _fused_adam_group_spmd(mesh, spec, ps, gs, m1s, m2s, lr_t, b1, b2,
+                           eps, impl):
+    """One fused Adam pass for a group of params sharing PartitionSpec
+    `spec`, partitioned per shard via kernel_tier.partitioned_call: each
+    shard flattens+concats its LOCAL blocks and runs the elementwise
+    kernel — the update is elementwise, so any partitioning is exact and
+    comms-free (replicated params redundantly update on every device,
+    the replicated path). Returns (params_out, m1_out, m2_out) lists."""
+    from jax.sharding import PartitionSpec as P
+    from .kernel_tier import partitioned_call
+    k = len(ps)
+
+    def inner(lrt, *blocks):
+        lp, lg = blocks[:k], blocks[k:2 * k]
+        lm1, lm2 = blocks[2 * k:3 * k], blocks[3 * k:]
+        shapes = [b.shape for b in lp]
+        sizes = [int(np.prod(s)) for s in shapes]
+        cat = lambda vs: jnp.concatenate([v.reshape(-1) for v in vs]) \
+            if k > 1 else vs[0].reshape(-1)
+        pf, gf, m1f, m2f = cat(lp), cat(lg), cat(lm1), cat(lm2)
+        if impl in ('pallas', 'interpret'):
+            po, m1o, m2o = _fused_adam_flat(pf, gf, m1f, m2f, lrt, b1,
+                                            b2, eps, impl == 'interpret')
+        else:
+            po, m1o, m2o = _adam_dense(pf, gf, m1f, m2f, lrt, b1, b2, eps)
+        outs = []
+        for which in (po, m1o, m2o):
+            off = 0
+            for s, sz in zip(shapes, sizes):
+                outs.append(which[off:off + sz].reshape(s))
+                off += sz
+        return tuple(outs)
+
+    in_specs = (P(),) + (spec,) * (4 * k)
+    out_specs = (spec,) * (3 * k)
+    outs = partitioned_call(inner, mesh, in_specs, out_specs)(
+        lr_t, *(list(ps) + list(gs) + list(m1s) + list(m2s)))
+    return outs[:k], outs[k:2 * k], outs[2 * k:]
+
+
 def _fused_adam_flat(p, g, m1, m2, lr_t, b1, b2, eps, interpret):
     """One elementwise Pallas pass over the flattened-and-concatenated
     parameter set ([L] padded to (R, 128) tiles)."""
@@ -202,9 +263,18 @@ def _fused_adam(ctx, op):
     dense group into one vector so the update is one fused elementwise
     loop; 'pallas'/'interpret' run that vector through one Pallas kernel.
     SelectedRows (sparse) grads always take the per-param row-wise path —
-    the per-op fallback rule. The fused tiers read Beta1Pows[0]/
-    Beta2Pows[0] for the shared lr_t: every program this op is built into
-    initializes and advances all beta-pow accumulators identically.
+    the per-op fallback rule. The fused tiers read the FIRST fused
+    param's beta-pows for the shared lr_t: every program this op is
+    built into initializes and advances all beta-pow accumulators
+    identically.
+
+    Under an active >1-device mesh the update partitions instead of
+    falling back: params group by their own PartitionSpec (the active
+    runner's rules via parallel.api.get_active_param_spec) and each
+    group runs per shard through kernel_tier.partitioned_call — local
+    blocks flattened+concatenated, no all-gather of sharded state;
+    replicated params take the replicated path, and a spec that does
+    not evenly tile its param falls back per-param (_mesh_spec_ok).
     """
     from . import kernel_tier
     names_p = op.input('Params')
@@ -222,41 +292,74 @@ def _fused_adam(ctx, op):
     dense = [i for i, g in enumerate(gs)
              if not isinstance(g, SelectedRows)
              and ps[i].dtype == jnp.float32]
-    from ..parallel.api import get_active_mesh
+    from ..parallel.api import get_active_mesh, get_active_param_spec
     mesh = get_active_mesh()
-    # under a >1-device mesh the per-param path wins: flattening +
-    # concatenating a SHARDED parameter set would force an all-gather per
-    # step (and a pallas call cannot be auto-partitioned at all)
     sharded = mesh is not None and mesh.size > 1
+    groups = None
+    if sharded and dense:
+        # mesh-native path: partition each flattened segment by the
+        # param's OWN PartitionSpec (kernel_tier.partitioned_call per
+        # spec-group) — no all-gather of a sharded parameter set, and
+        # replicated params take the replicated path. A param whose spec
+        # does not evenly tile its shape falls back per-param.
+        from jax.sharding import PartitionSpec as P
+        spec_fn = get_active_param_spec() or (lambda n: P())
+        groups = {}
+        for i in dense:
+            spec = spec_fn(names_p[i]) or P()
+            if _mesh_spec_ok(mesh, spec, ps[i].shape):
+                groups.setdefault(tuple(spec), []).append(i)
+        fusable = sorted(i for idxs in groups.values() for i in idxs)
+    else:
+        fusable = list(dense)
     impl = kernel_tier.dispatch('fused_adam',
-                                pallas_ok=bool(dense) and not sharded,
-                                xla_ok=bool(dense) and not sharded)
+                                pallas_ok=bool(fusable),
+                                xla_ok=bool(fusable), mesh=mesh)
 
-    fused = set(dense) if impl != 'off' else set()
+    fused = set(fusable) if impl != 'off' else set()
     if fused:
-        lr_t0 = lr * jnp.sqrt(1 - b2ps[dense[0]].reshape(())) \
-            / (1 - b1ps[dense[0]].reshape(()))
-        sizes = [int(np.prod(ps[i].shape)) for i in dense]
-        cat = lambda vs: jnp.concatenate(
-            [vs[i].reshape(-1) for i in dense])
-        p_f, g_f = cat(ps), cat([g.astype(jnp.float32) if not
-                                 isinstance(g, SelectedRows) else g
-                                 for g in gs])
-        m1_f, m2_f = cat(m1s), cat(m2s)
-        if impl in ('pallas', 'interpret'):
-            po, m1o, m2o = _fused_adam_flat(
-                p_f, g_f, m1_f, m2_f, lr_t0, b1, b2, eps,
-                impl == 'interpret')
+        first = fusable[0]
+        lr_t0 = lr * jnp.sqrt(1 - b2ps[first].reshape(())) \
+            / (1 - b1ps[first].reshape(()))
+        dense_g = lambda i: gs[i].astype(jnp.float32)
+        if sharded:
+            from jax.sharding import PartitionSpec as P
+            for spec_key, idxs in sorted(groups.items(),
+                                         key=lambda kv: kv[1][0]):
+                po, m1o, m2o = _fused_adam_group_spmd(
+                    mesh, P(*spec_key), [ps[i] for i in idxs],
+                    [dense_g(i) for i in idxs],
+                    [m1s[i] for i in idxs], [m2s[i] for i in idxs],
+                    lr_t0, b1, b2, eps, impl)
+                for j, i in enumerate(idxs):
+                    ctx.out(op, 'ParamsOut', po[j], idx=i)
+                    ctx.out(op, 'Moment1sOut', m1o[j], idx=i)
+                    ctx.out(op, 'Moment2sOut', m2o[j], idx=i)
         else:
-            po, m1o, m2o = _adam_dense(p_f, g_f, m1_f, m2_f, lr_t0,
-                                       b1, b2, eps)
-        off = 0
-        for k, i in enumerate(dense):
-            sl = slice(off, off + sizes[k])
-            ctx.out(op, 'ParamsOut', po[sl].reshape(ps[i].shape), idx=i)
-            ctx.out(op, 'Moment1sOut', m1o[sl].reshape(ps[i].shape), idx=i)
-            ctx.out(op, 'Moment2sOut', m2o[sl].reshape(ps[i].shape), idx=i)
-            off += sizes[k]
+            sizes = [int(np.prod(ps[i].shape)) for i in fusable]
+            cat = lambda vs: jnp.concatenate(
+                [vs[i].reshape(-1) for i in fusable])
+            p_f, g_f = cat(ps), cat([g.astype(jnp.float32) if not
+                                     isinstance(g, SelectedRows) else g
+                                     for g in gs])
+            m1_f, m2_f = cat(m1s), cat(m2s)
+            if impl in ('pallas', 'interpret'):
+                po, m1o, m2o = _fused_adam_flat(
+                    p_f, g_f, m1_f, m2_f, lr_t0, b1, b2, eps,
+                    impl == 'interpret')
+            else:
+                po, m1o, m2o = _adam_dense(p_f, g_f, m1_f, m2_f, lr_t0,
+                                           b1, b2, eps)
+            off = 0
+            for k, i in enumerate(fusable):
+                sl = slice(off, off + sizes[k])
+                ctx.out(op, 'ParamsOut', po[sl].reshape(ps[i].shape),
+                        idx=i)
+                ctx.out(op, 'Moment1sOut', m1o[sl].reshape(ps[i].shape),
+                        idx=i)
+                ctx.out(op, 'Moment2sOut', m2o[sl].reshape(ps[i].shape),
+                        idx=i)
+                off += sizes[k]
 
     for i in range(len(ps)):
         b1p = b1ps[i].reshape(())

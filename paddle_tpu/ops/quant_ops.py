@@ -11,6 +11,7 @@ TPU-native notes:
 - range_abs_max's sliding scale window is functional state: InScale /
   OutScales / Iter are persistable vars updated in the compiled step.
 """
+import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -86,10 +87,23 @@ def _fake_dequantize_max_abs(ctx, op):
     X may be a REAL int8 blob (the weight-only int8 inference path,
     QuantizeTranspiler.convert_to_int8_program): the cast to f32 happens
     here and XLA fuses it into the consuming matmul — int8 storage/HBM
-    traffic, fp32 compute."""
+    traffic, fp32 compute. Scale may be a PER-OUTPUT-CHANNEL vector
+    (size == X.shape[-1], broadcast along the last axis — the fc/mul
+    weight [in, out] layout) instead of a scalar; per-channel scales
+    tighten weight-only parity on wide fc's where one outlier column
+    used to set every column's step."""
     x = ctx.in1(op, 'X').astype(jnp.float32)
-    scale = ctx.in1(op, 'Scale').reshape(())
+    scale = ctx.in1(op, 'Scale')
     max_range = op.attr('max_range')
+    n = int(np.prod(scale.shape)) if getattr(scale, 'shape', None) else 1
+    if n > 1:
+        if n != x.shape[-1]:
+            raise ValueError(
+                "fake_dequantize_max_abs: per-channel Scale of size %d "
+                "must match X's last dim %d" % (n, x.shape[-1]))
+        scale = scale.reshape((1,) * (x.ndim - 1) + (n,))
+    else:
+        scale = scale.reshape(())
     ctx.out(op, 'Out', x * lax.stop_gradient(scale) / max_range)
 
 
@@ -145,8 +159,14 @@ def _quantized_matmul(ctx, op):
     x8 = ctx.in1(op, 'X')                  # int8 [N, K]
     w8 = ctx.in1(op, 'Y')                  # int8 [K, M]
     sx = float(op.attr('scale_x', 1.0))
-    sw = float(op.attr('scale_y', 1.0))
+    # scale_y: scalar (per-tensor) or a per-OUTPUT-CHANNEL list of M
+    # scales (contrib/quantize.py per-channel PTQ) — the rescale then
+    # broadcasts down the output-channel (last) axis
+    sw_attr = op.attr('scale_y', 1.0)
+    sw = np.asarray(sw_attr, dtype=np.float32)
     acc = jax.lax.dot_general(
         x8, w8, (((x8.ndim - 1,), (0,)), ((), ())),
         preferred_element_type=jnp.int32)
+    if sw.ndim:
+        sw = sw.reshape((1,) * (acc.ndim - 1) + (-1,))
     ctx.out(op, 'Out', acc.astype(jnp.float32) / (sx * sw))

@@ -276,17 +276,25 @@ def _lookup_table(ctx, op):
         w = table_sharding_constraint(w)
 
     from . import kernel_tier
-    from .embedding_ops import pallas_shapes_ok
-    from ..parallel.api import get_active_mesh
+    from .embedding_ops import pallas_shapes_ok, spmd_gather_ok
+    from ..parallel.api import get_active_mesh, get_active_param_spec
     mesh = get_active_mesh()
+    if mesh is not None and mesh.size > 1:
+        # mesh-native: the kernel runs per shard (ids over 'data') via
+        # kernel_tier.partitioned_call inside embedding_gather. A SHARDED
+        # table — the is_distributed vocab pin above or a param rule —
+        # keeps the XLA gather the SPMD partitioner splits into
+        # shard-local masked gathers + psum (the dist_ops pipeline).
+        spec_fn = get_active_param_spec()
+        w_spec = spec_fn(op.input('W')[0]) if spec_fn else None
+        ok = not op.attr('is_distributed', False) and \
+            spmd_gather_ok(mesh, w, int(flat.shape[0]), w_spec)
+    else:
+        ok = pallas_shapes_ok(w, int(flat.shape[0]))
     impl = kernel_tier.dispatch(
-        'lookup_table',
-        # a pallas custom call cannot be auto-partitioned: under an active
-        # >1-device mesh the gather stays on XLA (which partitions it into
-        # shard-local masked gathers + psum — the dist_ops pipeline)
-        pallas_ok=(mesh is None or mesh.size == 1)
-        and pallas_shapes_ok(w, int(flat.shape[0])),
+        'lookup_table', pallas_ok=ok,
         xla_ok=False,   # no distinct xla tier: the gather IS one HLO
+        mesh=mesh,
         count=getattr(ctx, 'sparse_mode', None) != 'scout')
     out = lookup_gather(ctx, op, w, flat, impl=impl)
     ctx.out(op, 'Out', embedding_epilogue(out, flat, ids, w, padding_idx))

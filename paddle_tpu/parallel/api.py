@@ -23,15 +23,29 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..core import lowering
 from ..framework import Variable
 
-__all__ = ['ShardingRules', 'MeshRunner', 'get_active_mesh']
+__all__ = ['ShardingRules', 'MeshRunner', 'get_active_mesh',
+           'get_active_param_spec']
 
 # Mesh visible to op lowerings while a MeshRunner traces its program
 # (sharding_constraint ops resolve PartitionSpecs against it).
 _ACTIVE_MESH = None
 
+# name -> PartitionSpec resolver for the runner that activated the mesh
+# (MeshRunner: its ShardingRules; DataParallelRunner: replicated, or the
+# ZeRO-style reduce-mode placement). Mesh-native fused units consult it so
+# e.g. fused_adam partitions each parameter by its OWN spec instead of
+# all-gathering a sharded parameter set (ops/optimizer_ops.py).
+_ACTIVE_PARAM_SPEC = None
+
 
 def get_active_mesh():
     return _ACTIVE_MESH
+
+
+def get_active_param_spec():
+    """The active runner's name->PartitionSpec resolver, or None outside a
+    runner trace (callers treat None as all-replicated)."""
+    return _ACTIVE_PARAM_SPEC
 
 
 class ShardingRules(object):
@@ -167,13 +181,16 @@ class MeshRunner(object):
             karr = np.asarray(key_arr)
             key_arr = jax.make_array_from_callback(
                 karr.shape, self._sharding(P()), lambda idx: karr[idx])
-        global _ACTIVE_MESH
+        global _ACTIVE_MESH, _ACTIVE_PARAM_SPEC
         prev, _ACTIVE_MESH = _ACTIVE_MESH, self._mesh
+        prev_spec, _ACTIVE_PARAM_SPEC = (_ACTIVE_PARAM_SPEC,
+                                         self._rules.spec_for)
         try:
             with self._mesh:
                 fetches, new_state = fn(feed, ro, rw, key_arr)
         finally:
             _ACTIVE_MESH = prev
+            _ACTIVE_PARAM_SPEC = prev_spec
         scope.update(new_state)
         # propagate produced LoDs of written persistables into the scope
         for n in new_state:

@@ -270,6 +270,13 @@ class DataParallelRunner(object):
                 lambda idx: karr[idx])
         from . import api as _papi
         prev, _papi._ACTIVE_MESH = _papi._ACTIVE_MESH, self._mesh
+        _, reduce_mode = self._strategy_knobs()
+        prev_spec = _papi._ACTIVE_PARAM_SPEC
+        # fused units partition state by its actual placement: replicated
+        # in plain DP, the ZeRO-style reduce-mode spec otherwise
+        _papi._ACTIVE_PARAM_SPEC = (
+            lambda n: self._state_sharding(program, n, reduce_mode,
+                                           self._mesh).spec)
         try:
             with self._mesh:
                 if fresh_compile:
@@ -286,6 +293,7 @@ class DataParallelRunner(object):
                                                   key_arr)
         finally:
             _papi._ACTIVE_MESH = prev
+            _papi._ACTIVE_PARAM_SPEC = prev_spec
         from .. import flags as _flags
         if _flags.get_flags('check_nan_inf'):
             from ..executor import _check_nan_inf

@@ -311,9 +311,14 @@ class GenerateEngine(object):
     def warmup(self):
         """Bind + compile every signature the engine will ever dispatch:
         one prefill per prompt bucket and the decode step. Returns
-        {'buckets', 'compiles', 'seconds'}; `compiles` is the
+        {'buckets', 'compiles', 'reused', 'seconds'}; `compiles` is the
         compile_cache_miss delta — 0 when a structurally identical engine
-        already warmed the process-wide fingerprint cache."""
+        already warmed the process-wide fingerprint cache. Signatures
+        register in the warmup farm (paddle_tpu.warmfarm), so `reused`
+        reports how many of this engine's cells were already compiled by
+        an earlier process-sharing consumer (bind() still executes each
+        program once — it must prime THIS engine's KV-cache state — but
+        a reused cell binds at cache-hit speed, compile_seconds ≈ 0)."""
         if self._started:
             # bind() EXECUTES each program once: re-warming a live engine
             # would zero cache rows of resident slots mid-generation
@@ -321,29 +326,46 @@ class GenerateEngine(object):
                 "warmup() executes the decode programs against the live "
                 "KV cache and must not race the started engine loop — "
                 "warm up before start() (start() warms up automatically)")
+        from ..warmfarm import farm
         t0 = time.perf_counter()
         before = monitor.counters()
         S = self.config.slots
+        reused = 0
         with monitor.span('generate.warmup'):
             for b, (prog, v) in sorted(self._prefill.items()):
                 feed = {'gen_prompt': np.zeros((1, b), 'int64'),
                         'gen_slot': np.zeros((1, 1), 'int64'),
                         'gen_len': np.ones((1, 1), 'int64')}
+                key, already = farm.track(self.executor, prog, feed,
+                                          fetch_list=[v['first_token']],
+                                          scope=self.scope)
                 self._prefill_bound[b] = self.executor.bind(
                     prog, feed, fetch_list=[v['first_token']],
                     scope=self.scope)
+                if already:
+                    reused += 1
+                else:
+                    farm.commit(key)
             feed = {'gen_tokens': np.zeros((S, 1), 'int64'),
                     'gen_pos': np.zeros((S, 1), 'int64')}
+            key, already = farm.track(
+                self.executor, self._step_prog, feed,
+                fetch_list=[self._step_vars['next_tokens']],
+                scope=self.scope)
             self._step_bound = self.executor.bind(
                 self._step_prog, feed,
                 fetch_list=[self._step_vars['next_tokens']],
                 scope=self.scope)
+            if already:
+                reused += 1
+            else:
+                farm.commit(key)
         delta = monitor.counter_delta(before)
         compiles = sum(v for k, v in delta.items()
                        if k.startswith('compile_cache_miss'))
         monitor.inc('generate_warmup_total')
         return {'buckets': len(self._prefill_bound),
-                'compiles': int(compiles),
+                'compiles': int(compiles), 'reused': int(reused),
                 'seconds': round(time.perf_counter() - t0, 3)}
 
     # ------------------------------------------------------------------

@@ -96,7 +96,12 @@ def test_recognize_digits_conv(tmp_path):
         infer_feed_names=['img'])
 
 
-@pytest.mark.parametrize('net', ['resnet', 'vgg'])
+@pytest.mark.parametrize('net', [
+    'resnet',
+    # vgg is the second-heaviest tier-1 case (~47 s) and duplicates the
+    # conv-stack coverage resnet already gives this chapter; the
+    # nightly/full run keeps it (ISSUE 11 budget shave)
+    pytest.param('vgg', marks=pytest.mark.slow)])
 def test_image_classification(tmp_path, net):
     """reference tests/book/test_image_classification.py: resnet_cifar10 /
     vgg16 on cifar shapes (tiny 16x16 inputs here)."""

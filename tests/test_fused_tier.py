@@ -294,10 +294,15 @@ def test_fake_quant_dequant_ste_gradient():
 # ---------------------------------------------------------------------------
 
 def test_ptq_int8_rank3_parity_and_predictor_roundtrip(tmp_path):
-    """BERT-shaped rank-3 fc stack: PTQ rewrite -> int8 GEMMs within 2% of
-    fp32; save_inference_model exports int8 blobs (and DROPS the unused
-    fp32 weights); the Predictor serves the loaded artifact bit-identical
-    to the in-process quantized program."""
+    """BERT-shaped rank-3 fc stack: PTQ rewrite -> int8 GEMMs within 1.2%
+    of fp32 — per-OUTPUT-CHANNEL weight scales (the per-tensor scale only
+    held 2%; what remains is the int8 ACTIVATION rounding floor,
+    step/sqrt(12) per element, which no weight-side scale can remove);
+    save_inference_model exports int8 blobs (and DROPS the unused fp32
+    weights); the Predictor serves the loaded artifact bit-identical to
+    the in-process quantized program. The weight-only rewrite of the SAME
+    rank-3 stack — activations fp32, so the weight scales are the whole
+    error — holds the tightened <0.5% bound below."""
     from paddle_tpu.contrib.quantize import post_training_quantize
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup), fluid.unique_name.guard():
@@ -319,7 +324,7 @@ def test_ptq_int8_rank3_parity_and_predictor_roundtrip(tmp_path):
         assert len(idx) == 2            # both rank-3 fc matmuls rewritten
         got, = exe.run(infer, feed=feed, fetch_list=[out.name], scope=scope)
         ref, got = np.asarray(ref), np.asarray(got)
-        assert np.max(np.abs(got - ref)) / (np.abs(ref).max() or 1) < 0.02
+        assert np.max(np.abs(got - ref)) / (np.abs(ref).max() or 1) < 0.012
         d = str(tmp_path / 'int8')
         fluid.io.save_inference_model(
             d, ['qx'], [infer.global_block().var(out.name)], exe,
@@ -336,10 +341,43 @@ def test_ptq_int8_rank3_parity_and_predictor_roundtrip(tmp_path):
     assert delta.get('quantized_program_total{kind=loaded}') == 1
 
 
+def test_weight_only_rank3_per_channel_half_percent():
+    """The satellite's tightened bound: per-OUTPUT-CHANNEL weight scales
+    on the BERT rank-3 fc stack, weight-only (fp32 activations, so the
+    weight quantization IS the error) — parity <0.5%, vs ~2% under the
+    old per-tensor scale. Also pins the scale artifacts: a [out_channels]
+    vector per 2-D weight, threaded through fake_dequantize_max_abs."""
+    from paddle_tpu.contrib.quantize import QuantizeTranspiler
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data(name='qx', shape=[8, 16], dtype='float32')
+        h = fluid.layers.fc(x, size=32, num_flatten_dims=2, act='relu')
+        out = fluid.layers.fc(h, size=4, num_flatten_dims=2)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    feed = {'qx': rng.randn(2, 8, 16).astype('float32')}
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        infer = main.clone(for_test=True)
+        ref, = exe.run(infer, feed=feed, fetch_list=[out.name], scope=scope)
+        blobs = QuantizeTranspiler().convert_to_int8_program(
+            infer, scope=scope)
+        got, = exe.run(infer, feed=feed, fetch_list=[out.name], scope=scope)
+    for name, (blob, scale) in blobs.items():
+        scale = np.asarray(scale)
+        # one scale per output channel of the 2-D fc weight
+        assert scale.shape == (blob.shape[1],), (name, scale.shape)
+        assert np.all(scale > 0)
+    ref, got = np.asarray(ref), np.asarray(got)
+    assert np.max(np.abs(got - ref)) / (np.abs(ref).max() or 1) < 0.005
+
+
 def test_weight_only_int8_program_and_slim_strategy():
     """QuantizeTranspiler.convert_to_int8_program: int8(weight)/fp32(act)
-    execution within quantization tolerance; the slim QuantizationStrategy
-    hands the same artifact back at compress end."""
+    execution within quantization tolerance (per-channel scales hold 1%
+    on this wider stack); the slim QuantizationStrategy hands the same
+    artifact back at compress end."""
     from paddle_tpu.contrib.quantize import QuantizeTranspiler
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup), fluid.unique_name.guard():
@@ -360,7 +398,7 @@ def test_weight_only_int8_program_and_slim_strategy():
         assert all(b.dtype == np.int8 for b, _ in blobs.values())
         got, = exe.run(infer, feed=feed, fetch_list=[out.name], scope=scope)
     ref, got = np.asarray(ref), np.asarray(got)
-    assert np.max(np.abs(got - ref)) / (np.abs(ref).max() or 1) < 0.02
+    assert np.max(np.abs(got - ref)) / (np.abs(ref).max() or 1) < 0.01
 
 
 def test_quantized_program_serves_zero_recompiles(tmp_path):
@@ -491,11 +529,11 @@ def test_dispatch_counter_and_fallback(tier_env):
     assert kt.dispatch('fused_adam', pallas_ok=True) == 'pallas'
     d = monitor.counter_delta(before)
     assert d.get('fused_kernel_dispatch_total'
-                 '{impl=xla,op=softmax_with_cross_entropy}') == 1
+                 '{impl=xla,mesh=1,op=softmax_with_cross_entropy}') == 1
     assert d.get('fused_kernel_dispatch_total'
-                 '{impl=off,op=lookup_table}') == 1
+                 '{impl=off,mesh=1,op=lookup_table}') == 1
     assert d.get('fused_kernel_dispatch_total'
-                 '{impl=pallas,op=fused_adam}') == 1
+                 '{impl=pallas,mesh=1,op=fused_adam}') == 1
 
 
 def test_scout_pass_counts_dispatch_once(tier_env):
@@ -519,18 +557,28 @@ def test_scout_pass_counts_dispatch_once(tier_env):
                 fetch_list=[loss], scope=scope)
     d = monitor.counter_delta(before)
     assert d.get('fused_kernel_dispatch_total'
-                 '{impl=off,op=lookup_table}') == 1, d
+                 '{impl=off,mesh=1,op=lookup_table}') == 1, d
 
 
 def test_kernbench_smoke():
     """tools/kernbench.py runs and produces comparable rows (lean: ONE
-    tiny case, two tiers — the full sweep is a CLI, not a tier-1 cost)."""
+    tiny case, two tiers — the full sweep is a CLI, not a tier-1 cost).
+    The --mesh path runs one case over mesh(data=2) and must carry the
+    fused_kernel_dispatch_total{...,mesh=n} proof row showing the
+    PARTITIONED kernel dispatched."""
     from tools.kernbench import measure_kernbench
     res = measure_kernbench(cases=['fused_adam'], tiers=['off', 'xla'],
                             rounds=1, k=2)
     for tier in ('off', 'xla'):
         assert res['fused_adam'][tier].get('wall_us'), res
     assert res['fused_adam']['xla'].get('vs_off') is not None
+    res = measure_kernbench(cases=['layernorm_residual'],
+                            tiers=['interpret'], rounds=1, k=1, mesh=2)
+    row = res['layernorm_residual']['interpret']
+    assert row.get('wall_us'), res
+    assert row['mesh_dispatch'].get(
+        'fused_kernel_dispatch_total'
+        '{impl=interpret,mesh=n,op=fused_ln_residual}'), res
 
 
 def test_bad_tier_value_raises(tier_env):

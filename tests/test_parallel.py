@@ -108,7 +108,12 @@ def test_sharding_constraint_op_noop_outside_mesh():
     assert out.shape == (4, 8)
 
 
+@pytest.mark.slow
 def test_dryrun_multichip_entry():
+    # ~60 s (heaviest single tier-1 case, ISSUE 11 budget shave): the
+    # driver ALREADY dry-runs multichip separately via
+    # __graft_entry__.dryrun_multichip (see conftest.py), so tier-1 was
+    # paying for duplicate coverage; the nightly/full run keeps it
     import __graft_entry__ as g
     g.dryrun_multichip(8)
 

@@ -39,9 +39,14 @@ def test_transpiler_detects_layer_run():
     assert types.count('gpipe_run') == 1
 
 
+@pytest.mark.slow
 def test_serial_fallback_matches_original():
     """The rewritten program without a pipe mesh must reproduce the
-    original loss trajectory exactly (same math, same op order)."""
+    original loss trajectory exactly (same math, same op order).
+
+    @slow (ISSUE 11 budget shave, ~37 s): two full LM trainings; the
+    transpile structure stays covered by test_transpile_partitions_lm
+    and the mesh trajectory by the moe/gpipe tier-1 tests."""
     feeds = None
     losses = {}
     for pipelined in (False, True):
@@ -62,9 +67,14 @@ def test_serial_fallback_matches_original():
                                rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_pipeline_mesh_matches_serial():
     """mesh(pipe=4) microbatch pipeline == serial trajectory (fwd + bwd +
-    Adam; the reverse pipeline comes from jax.vjp through the schedule)."""
+    Adam; the reverse pipeline comes from jax.vjp through the schedule).
+
+    @slow (ISSUE 11 budget shave, ~31 s): tier-1 keeps the pipe-mesh
+    trajectory via test_program_pipeline_engages_batch_axis and the
+    gpipe tests in test_pipeline_moe.py."""
     from paddle_tpu.parallel import make_mesh, MeshRunner
 
     main, startup, loss, cfg = _lm(11)
@@ -88,9 +98,13 @@ def test_pipeline_mesh_matches_serial():
     np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_pipeline_flash_attention_variant():
     """The flash-attention LM (the flagship config's op mix) also splits
-    and loss-matches under the pipeline."""
+    and loss-matches under the pipeline.
+
+    @slow (ISSUE 11 budget shave, ~27 s): flash-under-mesh stays tier-1
+    covered by tests/test_attention.py::test_spmd_shard_map_kernel."""
     from paddle_tpu.parallel import make_mesh, MeshRunner
 
     main, startup, loss, cfg = _lm(13, flash=True)
@@ -201,10 +215,18 @@ def test_pipeline_rejects_indivisible_stages():
         fluid.transpiler.PipelineTranspiler().transpile(main, num_stages=2)
 
 
+@pytest.mark.slow
 def test_pipeline_composes_with_data_parallel():
     """mesh(data=2, pipe=4): each data replica runs the full microbatch
     pipeline over its batch shard, grads psum over 'data' — the
-    trajectory must still equal the serial run exactly."""
+    trajectory must still equal the serial run exactly.
+
+    @slow (ISSUE 11 budget shave, ~18 s): this is the DETERMINISTIC
+    pre-existing tier-1 failure (jit x manual-over-all shard_map
+    divergence, jax 0.4.37 — ROADMAP triage). The bug stays pinned in
+    tier-1 by the minimized strict xfail
+    test_gpipe_2axis_mesh_lowering_jit_matches_serial (~2 s) below;
+    burning 18 s re-demonstrating it every run bought nothing."""
     from jax.sharding import PartitionSpec as P
     from paddle_tpu.parallel import make_mesh, MeshRunner
 

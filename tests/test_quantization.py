@@ -146,11 +146,16 @@ class TestQuantizeTranspiler(object):
         scope = fluid.global_scope()
         for name, (w, scale) in blobs.items():
             assert w.dtype == np.int8
-            assert scale > 0
+            # per-OUTPUT-CHANNEL scales for 2-D (fc/mul) weights, scalar
+            # for other ranks (contrib/quantize.py convert_to_int8)
+            scale = np.asarray(scale)
+            if w.ndim == 2:
+                assert scale.shape == (w.shape[1],), (name, scale.shape)
+            assert np.all(scale > 0)
             # blob + scale reconstructs the fp32 weight within one level
             orig = np.asarray(scope.get(name))
             recon = w.astype(np.float32) * scale / 127.0
-            assert np.abs(recon - orig).max() <= scale / 127.0 + 1e-6
+            assert np.abs(recon - orig).max() <= scale.max() / 127.0 + 1e-6
 
 
 def test_post_training_quantize_int8_matmul():

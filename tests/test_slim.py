@@ -211,7 +211,7 @@ def test_quantization_strategy():
                                                scope=scope)
         assert blobs
         for blob, scale in blobs.values():
-            assert blob.dtype == np.int8 and scale > 0
+            assert blob.dtype == np.int8 and np.all(np.asarray(scale) > 0)
 
 
 def test_channel_prune_through_reshape_fc():

@@ -1,0 +1,156 @@
+"""Executor.precompile + the warmup farm (ISSUE 11 AOT compile-reuse).
+
+Contracts pinned here:
+- precompile() populates the SAME fingerprint cache run() keys: the
+  first real dispatch after a precompile is a cache hit (no
+  compile_cache_miss), and a second precompile of the signature is a
+  ~0-second cached no-op;
+- precompile() is observationally free: scope state is untouched (rw
+  donation consumes throwaway copies) and PRNG run counters do not
+  advance — a precompiled training run replays the exact trajectory,
+  dropout and all;
+- the warm farm shares a signature set across process consumers: the
+  second consumer's warm() pass shows compiled=0 / compile_cache_miss=0,
+  and a ServingEngine warmup over an already-farmed model skips every
+  cell (compiles=0, reused=buckets) while live traffic still serves
+  with zero recompiles.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import monitor
+
+
+def _save_tiny_model(tmp_path, tag):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data(name='wx', shape=[16], dtype='float32')
+        out = fluid.layers.fc(fluid.layers.fc(x, size=32, act='relu'),
+                              size=4)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        infer = main.clone(for_test=True)
+        d = str(tmp_path / tag)
+        fluid.io.save_inference_model(
+            d, ['wx'], [infer.global_block().var(out.name)], exe,
+            main_program=infer)
+    return d
+
+
+def test_precompile_seeds_run_cache_and_preserves_state():
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 7
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data(name='px', shape=[8], dtype='float32')
+        y = fluid.layers.data(name='py', shape=[1], dtype='float32')
+        p = fluid.layers.fc(x, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(p, y))
+        fluid.optimizer.Adam(0.01).minimize(loss)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    feed = {'px': rng.randn(4, 8).astype('float32'),
+            'py': rng.randn(4, 1).astype('float32')}
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        st0 = {n: np.asarray(scope.get(n)).copy() for n in scope.names()
+               if hasattr(scope.get(n), 'shape')}
+        before = monitor.counters()
+        r = exe.precompile(main, {'px': ((4, 8), 'float32'),
+                                  'py': ((4, 1), 'float32')},
+                           fetch_list=[loss], scope=scope)
+        assert r['compiled'] and not r['cached']
+        # scope state survived the donated compile call bit-for-bit
+        for n in st0:
+            np.testing.assert_array_equal(np.asarray(scope.get(n)),
+                                          st0[n], err_msg=n)
+        # second precompile: cached, ~0 s
+        r2 = exe.precompile(main, feed, fetch_list=[loss], scope=scope)
+        assert r2 == {'compiled': False, 'cached': True, 'seconds': 0.0}
+        # the real run hits the precompiled entry — no new compile
+        mid = monitor.counters()
+        exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
+        d = monitor.counter_delta(mid)
+        assert d.get('compile_cache_miss', 0) == 0, d
+        assert d.get('compile_cache_hit', 0) == 1, d
+    d = monitor.counter_delta(before)
+    assert d.get('precompile_total') == 2
+    assert d.get('compile_cache_miss', 0) == 1, d
+
+
+def test_precompile_does_not_perturb_trajectory():
+    """Dropout RNG rides per-program run counters; precompile must not
+    advance them (a precompiled process replays the exact trajectory)."""
+    rng = np.random.RandomState(0)
+    feeds = [{'tx': rng.randn(4, 8).astype('float32'),
+              'ty': rng.randn(4, 1).astype('float32')} for _ in range(3)]
+
+    def train(precompile):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 7
+        with fluid.program_guard(main, startup), \
+                fluid.unique_name.guard():
+            x = fluid.layers.data(name='tx', shape=[8], dtype='float32')
+            y = fluid.layers.data(name='ty', shape=[1], dtype='float32')
+            h = fluid.layers.dropout(fluid.layers.fc(x, size=8),
+                                     dropout_prob=0.3)
+            p = fluid.layers.fc(h, size=1)
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(p, y))
+            fluid.optimizer.Adam(0.01).minimize(loss)
+        e = fluid.Executor()
+        s = fluid.Scope()
+        out = []
+        with fluid.scope_guard(s):
+            e.run(startup, scope=s)
+            if precompile:
+                e.precompile(main, feeds[0], fetch_list=[loss], scope=s)
+            for f in feeds:
+                l, = e.run(main, feed=f, fetch_list=[loss], scope=s)
+                out.append(float(np.asarray(l).reshape(())))
+        return out
+
+    assert train(False) == train(True)
+
+
+def test_warmfarm_second_consumer_compiles_nothing(tmp_path):
+    from tools.warmfarm import measure_warmfarm
+    d = _save_tiny_model(tmp_path, 'wf')
+    res = measure_warmfarm(d, batches=(1, 2), rounds=2)
+    assert res['passes'][0]['compiled'] == 2, res
+    # the second process-sharing consumer of the signature set:
+    # compile_seconds ≈ 0 — nothing compiled, nothing missed
+    assert res['passes'][1] == {'signatures': 2, 'compiled': 0,
+                                'reused': 2,
+                                'seconds': res['passes'][1]['seconds'],
+                                'wall_s': res['passes'][1]['wall_s'],
+                                'compile_cache_miss': 0}
+    assert res['passes'][1]['seconds'] < 1.0, res
+    assert res['reuse_proof'], res
+
+
+def test_serving_warmup_rides_the_farm(tmp_path):
+    from paddle_tpu.serving import ServingEngine, ServingConfig
+    from tools.warmfarm import measure_warmfarm
+    d = _save_tiny_model(tmp_path, 'wf_srv')
+    measure_warmfarm(d, batches=(1, 2), rounds=1)   # the farm pass
+    rng = np.random.RandomState(0)
+    eng = ServingEngine(ServingConfig(d, max_batch_size=2, max_wait_ms=1.0,
+                                      num_workers=1))
+    before = monitor.counters()
+    w = eng.warmup({'wx': np.zeros((1, 16), 'float32')})
+    # every ladder cell was farm-warm: the engine skipped them all
+    assert w['compiles'] == 0 and w['reused'] == w['buckets'] == 2, w
+    eng.start()
+    try:
+        for b in (1, 2, 1):
+            eng.run({'wx': rng.randn(b, 16).astype('float32')},
+                    timeout=30)
+        d2 = monitor.counter_delta(before)
+        # live traffic after a farm-reused warmup: still zero recompiles
+        assert d2.get('compile_cache_miss', 0) == 0, d2
+    finally:
+        eng.stop()

@@ -1,16 +1,25 @@
 """Per-op fused-vs-unfused microbench for the kernel tier.
 
-For each fused unit (softmax_ce / fused_adam / embedding_gather) this
-builds a small program that isolates the op, compiles it under each
-requested PADDLE_FUSED_TIER, and reports steady-state wall time
-(best-of-rounds minima over k dispatches — the box-noise protocol from
-BASELINE notes) next to the XLA cost-analysis columns mined from the
-analysis registry (flops / bytes_accessed per compiled program), so a
-tier's win or loss shows up with its bandwidth story attached.
+For each fused unit (softmax_ce / fused_adam / embedding_gather /
+layernorm_residual) this builds a small program that isolates the op,
+compiles it under each requested PADDLE_FUSED_TIER, and reports
+steady-state wall time (best-of-rounds minima over k dispatches — the
+box-noise protocol from BASELINE notes) next to the XLA cost-analysis
+columns mined from the analysis registry (flops / bytes_accessed per
+compiled program), so a tier's win or loss shows up with its bandwidth
+story attached.
+
+``--mesh N`` runs every case SPMD over a mesh(data=N) MeshRunner — the
+fused units then dispatch their PARTITIONED (shard_map) kernels, so
+fused-vs-unfused numbers exist for the sharded case too (the
+``fused_kernel_dispatch_total{...,mesh=n}`` counter rows prove which
+impl actually ran). Needs >= N local devices; as a CLI this file forces
+an 8-device virtual CPU host when no accelerator is attached.
 
 Usage: python tools/kernbench.py [--tiers off,xla,interpret]
-       [--cases softmax_ce,fused_adam,embedding_gather] [--rounds 5]
-       [--size small|bench]   (prints one JSON line)
+       [--cases softmax_ce,fused_adam,embedding_gather,layernorm_residual]
+       [--rounds 5] [--size small|bench] [--mesh N]
+       (prints one JSON line)
 
 On CPU the 'pallas' tier runs through the interpreter (pass 'interpret');
 its wall time is NOT meaningful — the interpret rows exist to check the
@@ -78,14 +87,34 @@ def _build_embedding_gather(size):
     return main, startup, feed, out
 
 
+def _build_layernorm_residual(size):
+    import numpy as np
+    import paddle_tpu as fluid
+    n, d = (256, 128) if size == 'small' else (4096, 1024)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data(name='lx', shape=[d], dtype='float32')
+        # a linear branch gives the pair a real residual input and routes
+        # the backward through both of the op's outputs
+        h = fluid.layers.fc(x, size=d)
+        y, s = fluid.layers.fused_layer_norm_residual(x, h,
+                                                      begin_norm_axis=1)
+        loss = fluid.layers.mean(fluid.layers.elementwise_add(y, s))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    rng = np.random.RandomState(0)
+    feed = {'lx': rng.randn(n, d).astype('float32')}
+    return main, startup, feed, loss
+
+
 _CASES = {
     'softmax_ce': _build_softmax_ce,
     'fused_adam': _build_fused_adam,
     'embedding_gather': _build_embedding_gather,
+    'layernorm_residual': _build_layernorm_residual,
 }
 
 
-def _measure(build, tier, rounds, k, size):
+def _measure(build, tier, rounds, k, size, mesh_n=1):
     import numpy as np
     import jax
     import paddle_tpu as fluid
@@ -100,10 +129,29 @@ def _measure(build, tier, rounds, k, size):
         main, startup, feed, fetch = build(size)
         exe = fluid.Executor(fluid.TPUPlace(0))
         scope = fluid.Scope()
+        runner = None
+        if mesh_n and mesh_n > 1:
+            if len(jax.devices()) < mesh_n:
+                raise RuntimeError(
+                    'mesh=%d needs %d local devices, have %d'
+                    % (mesh_n, mesh_n, len(jax.devices())))
+            from jax.sharding import PartitionSpec as P
+            from paddle_tpu.parallel import make_mesh, MeshRunner
+            mesh = make_mesh([('data', mesh_n)])
+            runner = MeshRunner(main, mesh,
+                                feed_specs={n: P('data') for n in feed})
+
+        def run_step(return_numpy=True):
+            if runner is not None:
+                return runner.run(feed, [fetch], scope,
+                                  return_numpy=return_numpy)
+            return exe.run(main, feed=feed, fetch_list=[fetch],
+                           scope=scope, return_numpy=return_numpy)
+
         with fluid.scope_guard(scope):
             t0 = time.time()
             exe.run(startup, scope=scope)
-            out = exe.run(main, feed=feed, fetch_list=[fetch], scope=scope)
+            out = run_step()
             jax.block_until_ready(
                 [np.asarray(o, copy=False) if not hasattr(o, 'block_until_ready')
                  else o for o in out])
@@ -112,8 +160,7 @@ def _measure(build, tier, rounds, k, size):
             for _ in range(rounds):
                 t0 = time.time()
                 for _ in range(k):
-                    out = exe.run(main, feed=feed, fetch_list=[fetch],
-                                  scope=scope, return_numpy=False)
+                    out = run_step(return_numpy=False)
                 jax.block_until_ready(list(out))
                 best = min(best, (time.time() - t0) / k)
         row = {'wall_us': round(best * 1e6, 1),
@@ -131,20 +178,32 @@ def _measure(build, tier, rounds, k, size):
 
 
 def measure_kernbench(cases=None, tiers=None, rounds=5, k=10,
-                      size='small'):
-    """Importable entry (the tier-1 smoke test runs one tiny case)."""
+                      size='small', mesh=1):
+    """Importable entry (the tier-1 smoke test runs one tiny case;
+    ``mesh=N`` runs every case through a mesh(data=N) MeshRunner so the
+    partitioned fused kernels are what gets timed)."""
+    from paddle_tpu import monitor
     cases = list(cases or _CASES)
     tiers = list(tiers or ['off', 'xla', 'interpret'])
     out = {}
     for case in cases:
         out[case] = {}
         for tier in tiers:
+            before = monitor.counters()
             try:
                 out[case][tier] = _measure(_CASES[case], tier, rounds, k,
-                                           size)
+                                           size, mesh_n=mesh)
             except Exception as e:      # noqa: BLE001 — advisory tool
                 out[case][tier] = {'error': '%s: %s' % (
                     type(e).__name__, str(e)[:200])}
+            if mesh and mesh > 1:
+                # which impl ACTUALLY ran under the mesh — the sharded
+                # rows' proof (fused_kernel_dispatch_total{...,mesh=n})
+                out[case][tier]['mesh_dispatch'] = {
+                    kk: v for kk, v in
+                    monitor.counter_delta(before).items()
+                    if kk.startswith('fused_kernel_dispatch_total')
+                    and 'mesh=n' in kk}
         off = out[case].get('off', {}).get('wall_us')
         for tier, row in out[case].items():
             if off and row.get('wall_us'):
@@ -160,9 +219,21 @@ def main():
     ap.add_argument('--k', type=int, default=10)
     ap.add_argument('--size', default='small',
                     choices=('small', 'bench'))
+    ap.add_argument('--mesh', type=int, default=1,
+                    help='run each case SPMD over mesh(data=N)')
     args = ap.parse_args()
+    if args.mesh > 1 and 'jax' not in sys.modules and \
+            '--xla_force_host_platform_device_count' not in \
+            os.environ.get('XLA_FLAGS', ''):
+        # CLI convenience: a virtual multi-device CPU host (must happen
+        # before jax initializes; harmless when a real accelerator wins)
+        os.environ['XLA_FLAGS'] = (
+            os.environ.get('XLA_FLAGS', '') +
+            ' --xla_force_host_platform_device_count=%d'
+            % max(8, args.mesh)).strip()
     res = measure_kernbench(args.cases.split(','), args.tiers.split(','),
-                            args.rounds, args.k, args.size)
+                            args.rounds, args.k, args.size,
+                            mesh=args.mesh)
     print(json.dumps(res))
 
 
