@@ -17,7 +17,7 @@ from ..param_attr import ParamAttr
 
 __all__ = ['multi_head_attention', 'transformer_block', 'build_lm',
            'LMConfig', 'position_encoding_table', 'build_lm_prefill',
-           'build_lm_decode_step']
+           'build_lm_decode_step', 'build_lm_prefill_paged']
 
 
 class LMConfig(object):
@@ -206,6 +206,20 @@ def build_lm(cfg=None, is_test=False):
 #
 # Parameter names match build_lm exactly — a scope trained (or loaded) for
 # the LM serves decode without any renaming.
+#
+# PAGED mode (PR 12): pass block_size/num_blocks to build_lm_decode_step
+# (or use build_lm_prefill_paged) and the cache becomes
+# [num_blocks, layers, heads, block_size, head_dim], addressed through
+# runtime-fed per-slot block tables (ops/kv_cache_ops.py paged variants).
+# The table is an ordinary feed, so the program count and every compiled
+# signature stay fixed — serving/generate.py's allocator decides the
+# physical layout per request at admission time.
+#
+# Both decode-step flavors (and both prefills, for the FIRST token) end
+# in the `sample_next_token` op: per-slot temperature / top-k / top-p
+# feeds plus a host-fed uniform drive sampling; temperature 0 rows take
+# the bitwise argmax branch, so greedy engines are bit-identical to the
+# pre-sampling programs' outputs.
 # ---------------------------------------------------------------------------
 
 KV_CACHE_K = 'gen_kv_k'
@@ -220,6 +234,40 @@ def _declare_kv_caches(block, cfg, slots, max_len):
     vc = block.create_var(name=KV_CACHE_V, shape=shape, dtype='float32',
                           persistable=True, stop_gradient=True)
     return kc, vc
+
+
+def _declare_paged_kv_caches(block, cfg, num_blocks, block_size):
+    dh = cfg.d_model // cfg.n_head
+    shape = (num_blocks, cfg.n_layer, cfg.n_head, block_size, dh)
+    kc = block.create_var(name=KV_CACHE_K, shape=shape, dtype='float32',
+                          persistable=True, stop_gradient=True)
+    vc = block.create_var(name=KV_CACHE_V, shape=shape, dtype='float32',
+                          persistable=True, stop_gradient=True)
+    return kc, vc
+
+
+SAMPLE_FEEDS = ('gen_temp', 'gen_topk', 'gen_topp', 'gen_u')
+
+
+def _sampling_inputs():
+    """Per-row sampling-control feeds ([rows, 1]; [1, 1] in prefill):
+    temperature, top-k, top-p, and the host-drawn uniform."""
+    temp = layers.data(name='gen_temp', shape=[1], dtype='float32')
+    topk = layers.data(name='gen_topk', shape=[1], dtype='int64')
+    topp = layers.data(name='gen_topp', shape=[1], dtype='float32')
+    u = layers.data(name='gen_u', shape=[1], dtype='float32')
+    return temp, topk, topp, u
+
+
+def _append_sample_op(block, logits, sample_vars, out_name):
+    temp, topk, topp, u = sample_vars
+    out = block.create_var(name=out_name, shape=(-1,), dtype='int64')
+    block.append_op(
+        type='sample_next_token',
+        inputs={'Logits': [logits], 'Temp': [temp], 'TopK': [topk],
+                'TopP': [topp], 'U': [u]},
+        outputs={'Out': [out]})
+    return out
 
 
 def _cache_write(block, op_type, cache, new, index_var, layer):
@@ -248,25 +296,49 @@ def _qkv_split_step(qkv, cfg):
     return parts
 
 
-def build_lm_decode_step(cfg, slots, max_len):
+def build_lm_decode_step(cfg, slots, max_len, block_size=None,
+                         num_blocks=None):
     """Single-token decode step over ALL cache slots.
 
     Feeds: 'gen_tokens' [slots, 1] int64 (each slot's last token),
-    'gen_pos' [slots, 1] int64 (the position each slot writes this step).
-    Returns {'tokens', 'pos', 'logits', 'next_tokens', 'k_cache',
-    'v_cache'} — fetch 'next_tokens' ([slots] int64 greedy argmax)."""
+    'gen_pos' [slots, 1] int64 (the position each slot writes this step),
+    the `SAMPLE_FEEDS` quad [slots, 1] (temperature / top-k / top-p /
+    host uniform; all-zero = bitwise greedy), and — paged mode —
+    'gen_btab' [slots, max_len // block_size] int64 per-slot block
+    tables. Returns {'tokens', 'pos', 'logits', 'next_tokens',
+    'k_cache', 'v_cache'} — fetch 'next_tokens' ([slots] int64)."""
+    paged = block_size is not None
     d, h = cfg.d_model, cfg.n_head
     dh = d // h
     tokens = layers.data(name='gen_tokens', shape=[1], dtype='int64')
     pos = layers.data(name='gen_pos', shape=[1], dtype='int64')
+    sample_vars = _sampling_inputs()
     block = tokens.block
-    kc, vc = _declare_kv_caches(block, cfg, slots, max_len)
+    if paged:
+        mb = max_len // block_size
+        btab = layers.data(name='gen_btab', shape=[mb], dtype='int64')
+        kc, vc = _declare_paged_kv_caches(block, cfg, num_blocks,
+                                          block_size)
+    else:
+        kc, vc = _declare_kv_caches(block, cfg, slots, max_len)
 
     x = layers.embedding(
         tokens, size=[cfg.vocab_size, d], dtype='float32',
         param_attr=ParamAttr(name='tok_emb.w'))              # [S, d]
     pe = layers.assign(position_encoding_table(max_len, d))
     x = layers.elementwise_add(x, layers.gather(pe, pos))
+
+    def cache_write(cache, new, layer):
+        if not paged:
+            return _cache_write(block, 'kv_cache_update', cache, new,
+                                pos, layer)
+        block.append_op(
+            type='kv_cache_update_paged',
+            inputs={'Cache': [cache], 'New': [new], 'Positions': [pos],
+                    'BlockTables': [btab]},
+            outputs={'Out': [cache]},
+            attrs={'layer': int(layer), 'block_size': int(block_size)})
+        return cache
 
     for i in range(cfg.n_layer):
         p = 'layer_%d' % i
@@ -278,16 +350,22 @@ def build_lm_decode_step(cfg, slots, max_len):
                         param_attr=ParamAttr(name=p + '.attn.qkv.w'),
                         bias_attr=ParamAttr(name=p + '.attn.qkv.b'))
         q, k, v = _qkv_split_step(qkv, cfg)                  # [S, H, dh]
-        kc = _cache_write(block, 'kv_cache_update', kc, k, pos, i)
-        vc = _cache_write(block, 'kv_cache_update', vc, v, pos, i)
+        kc = cache_write(kc, k, i)
+        vc = cache_write(vc, v, i)
         ctx = block.create_var(name=p + '.kv_ctx',
                                shape=(-1, h, dh), dtype='float32')
+        attn_inputs = {'Q': [q], 'KCache': [kc], 'VCache': [vc],
+                       'Positions': [pos]}
+        attn_attrs = {'layer': i, 'scale': dh ** -0.5}
+        if paged:
+            attn_inputs['BlockTables'] = [btab]
+            attn_attrs['block_size'] = int(block_size)
         block.append_op(
-            type='kv_decode_attention',
-            inputs={'Q': [q], 'KCache': [kc], 'VCache': [vc],
-                    'Positions': [pos]},
+            type='kv_decode_attention_paged' if paged
+            else 'kv_decode_attention',
+            inputs=attn_inputs,
             outputs={'Out': [ctx]},
-            attrs={'layer': i, 'scale': dh ** -0.5})
+            attrs=attn_attrs)
         attn = layers.fc(layers.reshape(ctx, shape=[-1, d]), size=d,
                          param_attr=ParamAttr(name=p + '.attn.proj.w'),
                          bias_attr=ParamAttr(name=p + '.attn.proj.b'))
@@ -310,7 +388,8 @@ def build_lm_decode_step(cfg, slots, max_len):
     logits = layers.fc(x, size=cfg.vocab_size,
                        param_attr=ParamAttr(name='lm_head.w'),
                        bias_attr=False)                      # [S, V]
-    next_tokens = layers.argmax(logits, axis=1)              # [S]
+    next_tokens = _append_sample_op(block, logits, sample_vars,
+                                    'gen_next_tokens')       # [S]
     return {'tokens': tokens, 'pos': pos, 'logits': logits,
             'next_tokens': next_tokens, 'k_cache': kc, 'v_cache': vc}
 
@@ -334,6 +413,7 @@ def build_lm_prefill(cfg, prompt_len, slots, max_len):
     prompt = layers.data(name='gen_prompt', shape=[-1, T], dtype='int64')
     slot = layers.data(name='gen_slot', shape=[1], dtype='int64')
     length = layers.data(name='gen_len', shape=[1], dtype='int64')
+    sample_vars = _sampling_inputs()
     block = prompt.block
     kc, vc = _declare_kv_caches(block, cfg, slots, max_len)
 
@@ -411,7 +491,120 @@ def build_lm_prefill(cfg, prompt_len, slots, max_len):
     logits = layers.fc(last, size=cfg.vocab_size,
                        param_attr=ParamAttr(name='lm_head.w'),
                        bias_attr=False)                      # [1, V]
-    first_token = layers.argmax(logits, axis=1)              # [1]
+    first_token = _append_sample_op(block, logits, sample_vars,
+                                    'gen_first_token')       # [1]
     return {'prompt': prompt, 'slot': slot, 'length': length,
             'logits': logits, 'first_token': first_token,
             'k_cache': kc, 'v_cache': vc}
+
+
+def build_lm_prefill_paged(cfg, prompt_len, num_blocks, block_size,
+                           max_blocks):
+    """Prefill one prompt SUFFIX (padded to the `prompt_len` bucket) into
+    a paged cache slot and emit the first generated token.
+
+    The suffix's query row t sits at global position ctx_len + t: with a
+    shared prefix of ctx_len tokens already cached in the slot's leading
+    block-table entries, only the suffix is embedded, projected and
+    written — the prefix K/V are READ by `kv_prefix_attention`, never
+    recomputed, which is exactly the prefill-compute saving prefix
+    sharing promises. ctx_len = 0 degenerates to the ordinary causal
+    prefill (computed against the cache instead of a local K/V copy).
+
+    Feeds: 'gen_prompt' [1, prompt_len] int64 (suffix tokens),
+    'gen_pos' [1, prompt_len] int64 (global positions ctx_len + t,
+    host-precomputed), 'gen_btab' [1, max_blocks] int64 (the slot's
+    block table), 'gen_len' [1, 1] int64 (REAL suffix length; pad rows
+    write to the trash block), and the `SAMPLE_FEEDS` quad [1, 1].
+    Returns {'prompt', 'positions', 'block_table', 'length', 'logits',
+    'first_token', 'k_cache', 'v_cache'}."""
+    d, h = cfg.d_model, cfg.n_head
+    dh = d // h
+    T = int(prompt_len)
+    prompt = layers.data(name='gen_prompt', shape=[-1, T], dtype='int64')
+    pos = layers.data(name='gen_pos', shape=[-1, T], dtype='int64')
+    btab = layers.data(name='gen_btab', shape=[max_blocks], dtype='int64')
+    length = layers.data(name='gen_len', shape=[1], dtype='int64')
+    sample_vars = _sampling_inputs()
+    block = prompt.block
+    kc, vc = _declare_paged_kv_caches(block, cfg, num_blocks, block_size)
+
+    emb = layers.embedding(
+        prompt, size=[cfg.vocab_size, d], dtype='float32',
+        param_attr=ParamAttr(name='tok_emb.w'))              # [1, T, d]
+    # decode-parity positioning: gather the SAME sinusoid table rows the
+    # contiguous prefill's add_position_encoding applies at offset 0
+    pe = layers.assign(position_encoding_table(
+        max_blocks * block_size, d))
+    pe_rows = layers.reshape(layers.gather(pe, pos), shape=[-1, T, d])
+    x = layers.elementwise_add(emb, pe_rows)
+
+    def cache_write(cache, new, layer):
+        block.append_op(
+            type='kv_cache_prefill_paged',
+            inputs={'Cache': [cache], 'New': [new], 'Positions': [pos],
+                    'BlockTable': [btab], 'Length': [length]},
+            outputs={'Out': [cache]},
+            attrs={'layer': int(layer), 'block_size': int(block_size)})
+        return cache
+
+    for i in range(cfg.n_layer):
+        p = 'layer_%d' % i
+        ln1 = layers.layer_norm(
+            x, begin_norm_axis=2,
+            param_attr=ParamAttr(name=p + '.ln1.w'),
+            bias_attr=ParamAttr(name=p + '.ln1.b'))
+        qkv = layers.fc(ln1, size=3 * d, num_flatten_dims=2,
+                        param_attr=ParamAttr(name=p + '.attn.qkv.w'),
+                        bias_attr=ParamAttr(name=p + '.attn.qkv.b'))
+        qkv = layers.reshape(qkv, shape=[0, T, 3, h, dh])
+        qkv = layers.transpose(qkv, perm=[2, 0, 3, 1, 4])    # (3,1,H,T,dh)
+        q = layers.squeeze(layers.slice(qkv, axes=[0], starts=[0],
+                                        ends=[1]), axes=[0])
+        k = layers.squeeze(layers.slice(qkv, axes=[0], starts=[1],
+                                        ends=[2]), axes=[0])
+        v = layers.squeeze(layers.slice(qkv, axes=[0], starts=[2],
+                                        ends=[3]), axes=[0])
+        kc = cache_write(kc, k, i)
+        vc = cache_write(vc, v, i)
+        ctx = block.create_var(name=p + '.prefix_attn_out',
+                               shape=(-1, h, T, dh), dtype='float32')
+        block.append_op(
+            type='kv_prefix_attention',
+            inputs={'Q': [q], 'KCache': [kc], 'VCache': [vc],
+                    'Positions': [pos], 'BlockTable': [btab]},
+            outputs={'Out': [ctx]},
+            attrs={'layer': i, 'scale': dh ** -0.5,
+                   'block_size': int(block_size)})
+        ctx = layers.transpose(ctx, perm=[0, 2, 1, 3])
+        ctx = layers.reshape(ctx, shape=[0, T, d])
+        attn = layers.fc(ctx, size=d, num_flatten_dims=2,
+                         param_attr=ParamAttr(name=p + '.attn.proj.w'),
+                         bias_attr=ParamAttr(name=p + '.attn.proj.b'))
+        x = layers.elementwise_add(x, attn)
+        ln2 = layers.layer_norm(
+            x, begin_norm_axis=2,
+            param_attr=ParamAttr(name=p + '.ln2.w'),
+            bias_attr=ParamAttr(name=p + '.ln2.b'))
+        ff1 = layers.fc(ln2, size=cfg.d_ff, num_flatten_dims=2, act='gelu',
+                        param_attr=ParamAttr(name=p + '.ffn1.w'),
+                        bias_attr=ParamAttr(name=p + '.ffn1.b'))
+        ff2 = layers.fc(ff1, size=d, num_flatten_dims=2,
+                        param_attr=ParamAttr(name=p + '.ffn2.w'),
+                        bias_attr=ParamAttr(name=p + '.ffn2.b'))
+        x = layers.elementwise_add(x, ff2)
+
+    x = layers.layer_norm(x, begin_norm_axis=2,
+                          param_attr=ParamAttr(name='final_ln.w'),
+                          bias_attr=ParamAttr(name='final_ln.b'))
+    x_flat = layers.reshape(x, shape=[-1, d])                # [T, d]
+    one = layers.fill_constant(shape=[1], dtype='int64', value=1)
+    last = layers.gather(x_flat, layers.elementwise_sub(length, one))
+    logits = layers.fc(last, size=cfg.vocab_size,
+                       param_attr=ParamAttr(name='lm_head.w'),
+                       bias_attr=False)                      # [1, V]
+    first_token = _append_sample_op(block, logits, sample_vars,
+                                    'gen_first_token')       # [1]
+    return {'prompt': prompt, 'positions': pos, 'block_table': btab,
+            'length': length, 'logits': logits,
+            'first_token': first_token, 'k_cache': kc, 'v_cache': vc}

@@ -29,6 +29,36 @@ expressible in program IR:
 All three are slot-row-independent: no op mixes data across the slot axis,
 which is what makes admitting/evicting requests at token boundaries safe
 while other slots are mid-sequence.
+
+PAGED variants (PR 12) break the contiguous row-span reservation: the
+physical cache is ``[num_blocks, layers, heads, block_size, head_dim]``
+and every slot addresses it through a runtime-fed BLOCK TABLE — logical
+position ``p`` lives at ``(table[p // block_size], p % block_size)``.
+The table is an ordinary feed, so ONE compiled program serves any
+allocation pattern (the fixed-signature / zero-recompile contract is
+untouched); HBM is committed block-by-block as sequences actually grow,
+and requests with a common prompt prefix can point their leading table
+entries at the SAME physical blocks (serving/kv_blocks.py refcounts
+them, copy-on-write on the first divergent write). Physical block 0 is
+reserved as the TRASH block: table filler entries and redirected
+pad-row writes land there, so an idle slot's garbage computation can
+never scribble over a live block. Masking keeps the exact-zero parity
+contract of the contiguous ops: a masked (stale / trash / other-tenant)
+position contributes ``0 * garbage = 0`` bit-exactly.
+
+``kv_prefix_attention`` is what makes prefix sharing pay: a prefill
+whose leading ``P`` positions are already cached computes only the
+SUFFIX rows (queries at global positions ``P..P+T-1``) and attends them
+against the block-table cache — prefix K/V are read, never recomputed,
+so shared-prefix traffic buckets by suffix length and skips the shared
+prefill compute entirely.
+
+``sample_next_token`` is the sampling leg: temperature / top-k / top-p
+over the step logits, driven by a HOST-FED per-slot uniform (the
+engine owns one PRNG stream per request), so the op is deterministic,
+``needs_rng``-free (bind's single-PRNGKey fast path still applies), and
+``temperature == 0`` rows take the bitwise argmax branch — greedy stays
+the bitwise default.
 """
 import jax
 import jax.numpy as jnp
@@ -88,3 +118,169 @@ def _kv_decode_attention(ctx, op):
     w = jnp.where(m, w, 0.0)
     ctx.out(op, 'Out',
             jnp.einsum('shm,shmd->shd', w.astype(v.dtype), v))
+
+
+# ---------------------------------------------------------------------------
+# paged (block-table) variants
+
+
+def _block_of(table, pos, block_size):
+    """(block id, in-block offset) of logical position(s) `pos` through a
+    1-D block table. Out-of-range table indices clip to the last entry;
+    unallocated entries hold 0 — the trash block — so a wild position can
+    only ever touch trash."""
+    idx = jnp.clip(pos // block_size, 0, table.shape[0] - 1)
+    return table[idx].astype(jnp.int32), (pos % block_size).astype(jnp.int32)
+
+
+@register_op('kv_cache_prefill_paged', share_lod=False)
+def _kv_cache_prefill_paged(ctx, op):
+    """Cache[table[(P+t)//bs], layer, :, (P+t)%bs, :] = New[0, :, t, :] for
+    suffix rows t < Length; rows at or past the real suffix length are
+    REDIRECTED to the trash block (a contiguous prefill could park pad
+    rows in its own reserved span — a paged slot owns no span, so pad
+    garbage must never land in a real block)."""
+    cache = ctx.in1(op, 'Cache')                # [NB, Ln, H, bs, dh]
+    new = ctx.in1(op, 'New')                    # [1, H, T, dh]
+    table = ctx.in1(op, 'BlockTable').reshape(-1).astype(jnp.int32)
+    pos = ctx.in1(op, 'Positions').reshape(-1).astype(jnp.int32)  # [T]
+    length = ctx.in1(op, 'Length').reshape(-1).astype(jnp.int32)
+    layer = int(op.attr('layer'))
+    bs = int(op.attr('block_size'))
+    rows = jnp.transpose(new[0], (1, 0, 2)).astype(cache.dtype)  # [T,H,dh]
+    blk, off = _block_of(table, pos, bs)
+    real = jnp.arange(rows.shape[0]) < length[0]
+    blk = jnp.where(real, blk, 0)
+    off = jnp.where(real, off, 0)
+    out = cache.at[blk, layer, :, off, :].set(rows)
+    ctx.out(op, 'Out', out)
+
+
+@register_op('kv_cache_update_paged', share_lod=False)
+def _kv_cache_update_paged(ctx, op):
+    """Cache[tables[s][Positions[s]//bs], layer, :, Positions[s]%bs, :]
+    = New[s] for every slot s. Idle slots feed position 0 against an
+    all-zero table row, so their garbage row lands in the trash block."""
+    cache = ctx.in1(op, 'Cache')                # [NB, Ln, H, bs, dh]
+    new = ctx.in1(op, 'New')                    # [S, H, dh]
+    tables = ctx.in1(op, 'BlockTables').astype(jnp.int32)  # [S, MB]
+    pos = ctx.in1(op, 'Positions').reshape(-1).astype(jnp.int32)
+    layer = int(op.attr('layer'))
+    bs = int(op.attr('block_size'))
+    idx = jnp.clip(pos // bs, 0, tables.shape[1] - 1)
+    blk = jnp.take_along_axis(tables, idx[:, None], axis=1)[:, 0]
+    off = (pos % bs).astype(jnp.int32)
+    out = cache.at[blk, layer, :, off, :].set(new.astype(cache.dtype))
+    ctx.out(op, 'Out', out)
+
+
+@register_op('kv_decode_attention_paged', share_lod=False)
+def _kv_decode_attention_paged(ctx, op):
+    """One-query attention per slot over its BLOCK-TABLE-gathered K/V,
+    masked to each slot's positions 0..Positions[s] exactly as the
+    contiguous op: the gathered logical layout is table order x in-block
+    offset, so the mask arithmetic is identical and masked (stale /
+    trash / shared-beyond-prefix) rows contribute exact 0."""
+    q = ctx.in1(op, 'Q')                        # [S, H, dh]
+    kc = ctx.in1(op, 'KCache')                  # [NB, Ln, H, bs, dh]
+    vc = ctx.in1(op, 'VCache')
+    tables = ctx.in1(op, 'BlockTables').astype(jnp.int32)  # [S, MB]
+    pos = ctx.in1(op, 'Positions').reshape(-1)  # [S]
+    layer = int(op.attr('layer'))
+    scale = op.attr('scale', 1.0)
+    bs = int(op.attr('block_size'))
+    S, MB = tables.shape
+    H, dh = kc.shape[2], kc.shape[4]
+
+    def gather(c):
+        # [S, MB, H, bs, dh] -> [S, H, MB*bs, dh] (logical position order)
+        g = c[:, layer][tables]
+        return jnp.transpose(g, (0, 2, 1, 3, 4)).reshape(S, H, MB * bs, dh)
+
+    k = gather(kc)
+    v = gather(vc)
+    scores = jnp.einsum('shd,shmd->shm', q, k,
+                        preferred_element_type=jnp.float32) * scale
+    m = jnp.arange(MB * bs)[None, None, :] <= pos[:, None, None]
+    scores = jnp.where(m, scores, _NEG_INF)
+    w = jax.nn.softmax(scores, axis=-1)
+    w = jnp.where(m, w, 0.0)
+    ctx.out(op, 'Out',
+            jnp.einsum('shm,shmd->shd', w.astype(v.dtype), v))
+
+
+@register_op('kv_prefix_attention', share_lod=False)
+def _kv_prefix_attention(ctx, op):
+    """Multi-query causal attention of one slot's prefill SUFFIX against
+    its block-table cache: query row t sits at global position
+    Positions[t] and attends every cached position <= Positions[t] —
+    the shared prefix (cached by an earlier request) plus the suffix
+    rows the surrounding program just deposited. With no shared prefix
+    (Positions starting at 0) this is exactly the causal prefill
+    attention, computed from the cache instead of a local K/V copy."""
+    q = ctx.in1(op, 'Q')                        # [1, H, T, dh]
+    kc = ctx.in1(op, 'KCache')                  # [NB, Ln, H, bs, dh]
+    vc = ctx.in1(op, 'VCache')
+    table = ctx.in1(op, 'BlockTable').reshape(-1).astype(jnp.int32)
+    pos = ctx.in1(op, 'Positions').reshape(-1)  # [T] global query positions
+    layer = int(op.attr('layer'))
+    scale = op.attr('scale', 1.0)
+    bs = int(op.attr('block_size'))
+    MB = table.shape[0]
+    H, dh = kc.shape[2], kc.shape[4]
+
+    def gather(c):
+        # [MB, H, bs, dh] -> [H, MB*bs, dh]
+        return jnp.transpose(c[:, layer][table],
+                             (1, 0, 2, 3)).reshape(H, MB * bs, dh)
+
+    k = gather(kc)
+    v = gather(vc)
+    scores = jnp.einsum('htd,hmd->htm', q[0], k,
+                        preferred_element_type=jnp.float32) * scale
+    m = jnp.arange(MB * bs)[None, :] <= pos[:, None]       # [T, M]
+    scores = jnp.where(m[None], scores, _NEG_INF)
+    w = jax.nn.softmax(scores, axis=-1)
+    w = jnp.where(m[None], w, 0.0)
+    out = jnp.einsum('htm,hmd->htd', w.astype(v.dtype), v)
+    ctx.out(op, 'Out', out[None])               # [1, H, T, dh]
+
+
+@register_op('sample_next_token', share_lod=False)
+def _sample_next_token(ctx, op):
+    """Per-row temperature / top-k / top-p sampling driven by a host-fed
+    uniform U[s] in [0, 1): sort the temperature-scaled distribution
+    descending, intersect the top-k and top-p (nucleus) keep sets,
+    renormalize, inverse-CDF sample with U. Rows with Temp <= 0 return
+    the bitwise argmax (the greedy default); TopK <= 0 disables top-k,
+    TopP <= 0 or >= 1 disables nucleus. Deterministic given U — the
+    engine owns one host PRNG stream per request, so co-resident slots
+    sample independently and a (seed, prompt) pair replays exactly."""
+    logits = ctx.in1(op, 'Logits').astype(jnp.float32)     # [S, V]
+    temp = ctx.in1(op, 'Temp').reshape(-1)                 # [S]
+    topk = ctx.in1(op, 'TopK').reshape(-1).astype(jnp.int32)
+    topp = ctx.in1(op, 'TopP').reshape(-1)
+    u = ctx.in1(op, 'U').reshape(-1)
+    V = logits.shape[1]
+    greedy = jnp.argmax(logits, axis=1).astype(jnp.int64)
+    t = jnp.where(temp > 0, temp, 1.0)[:, None]
+    order = jnp.argsort(-logits, axis=1)                   # stable: ties
+    sorted_logits = jnp.take_along_axis(logits / t, order, axis=1)
+    probs = jax.nn.softmax(sorted_logits, axis=1)
+    ranks = jnp.arange(V)[None, :]
+    k_eff = jnp.where(topk > 0, topk, V)[:, None]
+    p_on = (topp > 0) & (topp < 1.0)
+    p_eff = jnp.where(p_on, topp, 1.0)[:, None]
+    cum = jnp.cumsum(probs, axis=1)
+    # nucleus keeps the smallest head with mass >= p (the first token
+    # always survives); top-k keeps ranks < k; the sets intersect
+    keep = (ranks < k_eff) & ((cum - probs < p_eff) | (ranks == 0))
+    masked = jnp.where(keep, probs, 0.0)
+    mcum = jnp.cumsum(masked, axis=1)
+    total = mcum[:, -1:]
+    # smallest kept index with cumulative mass > u * total
+    j = jnp.sum(mcum <= u[:, None] * total, axis=1)
+    j = jnp.minimum(j, jnp.sum(keep, axis=1) - 1)
+    sampled = jnp.take_along_axis(order, j[:, None], axis=1)[:, 0]
+    out = jnp.where(temp > 0, sampled.astype(jnp.int64), greedy)
+    ctx.out(op, 'Out', out)

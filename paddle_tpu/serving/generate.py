@@ -36,12 +36,36 @@ site exactly as `Executor.run` (a transient fault retries inside the
 step; an exhausted retry fails the RESIDENT requests and the engine keeps
 serving).
 
+PAGED mode (PR 12, ``GenerateConfig(paged=True)``) replaces the
+per-slot ``max_len`` row reservation with a BLOCK pool: the cache is
+``[num_blocks, layers, heads, block_size, head_dim]`` and each slot
+addresses it through a runtime-fed block table, so HBM is committed as
+sequences actually grow — admission is a blocks-available decision
+(serving/kv_blocks.py), eviction returns blocks, and a pool that runs
+dry finishes the starved request with ``finish_reason='cache_full'``.
+On top of the allocator rides PREFIX SHARING: prompts are chain-hashed
+per full block, a hit maps the request's leading table entries onto the
+blocks already holding that prefix (refcounted; copy-on-write when the
+whole prompt lands on shared blocks), and the prefill buckets by
+SUFFIX length — shared-prefix traffic skips both the duplicate storage
+and the shared prefill compute. Both modes sample: per-request
+temperature / top-k / top-p with an independent host PRNG stream per
+request (``sample_seed`` replays exactly); temperature 0 stays the
+bitwise greedy default, and the program count is unchanged —
+``len(prompt_buckets) + 1`` fixed signatures, zero recompiles after
+warmup under any mixed paged traffic.
+
 Monitor series: ``decode_tokens_total``, ``kv_slot_occupancy``,
 ``decode_step_seconds``, ``prefill_seconds``,
 ``generate_request_total{outcome=ok|error|shed|deadline|rejected|stopped}``,
 ``generate_queue_depth``, ``generate_step_error_total``,
-``generate_warmup_total``. Full catalog: docs/observability.md; tuning
-guide: docs/serving.md.
+``generate_warmup_total``; paged mode adds the block-level capacity
+accounting ``kv_blocks_in_use`` / ``kv_blocks_free`` gauges (these
+replace slot occupancy as the saturation signal — slots no longer bound
+memory) and the ``kv_block_cow_total``,
+``kv_prefix_hit_total{outcome=hit|miss}`` and
+``kv_prefix_tokens_saved_total`` counters. Full catalog:
+docs/observability.md; tuning guide: docs/serving.md.
 """
 import queue as _pyqueue
 import threading
@@ -55,8 +79,10 @@ from .. import unique_name
 from ..executor import Executor, Scope, scope_guard
 from ..framework import Program, TPUPlace, program_guard
 from ..models.transformer import (KV_CACHE_K, KV_CACHE_V, LMConfig,
-                                  build_lm_decode_step, build_lm_prefill)
+                                  build_lm_decode_step, build_lm_prefill,
+                                  build_lm_prefill_paged)
 from ..reader.bucketing import bucketize
+from .kv_blocks import BlockAllocator, PrefixCache, chain_hashes
 from .batcher import (DeadlineExceededError, EngineStoppedError,
                       LoadShedError, Request, RequestQueue,
                       resolve_metrics_port, start_metrics_server)
@@ -65,6 +91,17 @@ __all__ = ['GenerateConfig', 'GenerateEngine', 'GenerateRequest',
            'GenerateResult']
 
 _DONE = object()
+
+
+def _sampling_stream(sample_seed):
+    """One request's private sampling PRNG: a pinned seed replays the
+    stream bit-exactly; None draws a fresh unpredictable one. Shared by
+    submit()-side requests and the generate_once replay path — the
+    'same (seed, prompt) replays the same tokens' contract depends on
+    these two staying byte-identical."""
+    seed = sample_seed if sample_seed is not None \
+        else np.random.SeedSequence().entropy
+    return np.random.Generator(np.random.Philox(int(seed)))
 
 
 class GenerateResult(list):
@@ -108,12 +145,25 @@ class GenerateConfig(object):
       identical weights — the parity-test contract).
     - metrics_port: as ServingConfig.metrics_port (None falls back to
       PADDLE_METRICS_PORT; the endpoint rides start()/stop()).
+    - paged / block_size / num_blocks / prefix_sharing: paged-KV mode.
+      `num_blocks` is the PHYSICAL pool size (block 0 is the reserved
+      trash block, so `num_blocks - 1` blocks are allocatable); the
+      default matches the contiguous cache's HBM exactly
+      (slots * max_len / block_size), which is how the >= 2x-concurrency
+      contract is stated. `prompt_buckets` bucket the prefill SUFFIX in
+      paged mode — with prefix sharing, a request's prefill cost is its
+      un-cached suffix, not its prompt.
+    - temperature / top_k / top_p: engine-wide sampling defaults applied
+      when submit() passes none. 0 / 0 / 0 = bitwise greedy.
     """
 
     def __init__(self, model=None, slots=8, max_len=256,
                  prompt_buckets=None, eos_id=None, max_new_tokens=64,
                  pad_id=0, queue_cap=256, default_deadline_s=60.0,
-                 seed=0, metrics_port=None, idle_poll_s=0.02):
+                 seed=0, metrics_port=None, idle_poll_s=0.02,
+                 paged=False, block_size=16, num_blocks=None,
+                 prefix_sharing=True, temperature=0.0, top_k=0,
+                 top_p=0.0):
         self.model = model or LMConfig()
         self.slots = int(slots)
         self.max_len = int(max_len)
@@ -121,6 +171,29 @@ class GenerateConfig(object):
             raise ValueError("slots must be >= 1")
         if self.max_len < 2:
             raise ValueError("max_len must be >= 2")
+        self.paged = bool(paged)
+        self.block_size = int(block_size)
+        self.prefix_sharing = bool(prefix_sharing) and self.paged
+        if self.paged:
+            if self.block_size < 1:
+                raise ValueError("block_size must be >= 1")
+            if self.max_len % self.block_size:
+                raise ValueError(
+                    "paged mode needs max_len (%d) divisible by "
+                    "block_size (%d) — the block table is "
+                    "max_len/block_size entries wide"
+                    % (self.max_len, self.block_size))
+            if num_blocks is None:
+                num_blocks = self.slots * self.max_len // self.block_size
+            self.num_blocks = int(num_blocks)
+            if self.num_blocks < 2:
+                raise ValueError("num_blocks must be >= 2 (block 0 is "
+                                 "the reserved trash block)")
+        else:
+            self.num_blocks = None
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
         if prompt_buckets is None:
             prompt_buckets, b = [], 16
             while b <= self.max_len // 2:
@@ -156,17 +229,35 @@ class GenerateRequest(Request):
     'eos' | 'length' | 'cache_full' after a normal finish."""
 
     __slots__ = ('prompt', 'max_new_tokens', 'tokens', 'finish_reason',
-                 'step_s', '_stream_q')
+                 'step_s', '_stream_q', 'temperature', 'top_k', 'top_p',
+                 'sample_seed', '_rng')
 
-    def __init__(self, prompt, seq_len, bucket, deadline, max_new_tokens):
+    def __init__(self, prompt, seq_len, bucket, deadline, max_new_tokens,
+                 temperature=0.0, top_k=0, top_p=0.0, sample_seed=None):
         Request.__init__(self, {'prompt': prompt}, 1, seq_len, bucket,
                          deadline)
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
         self.tokens = []
         self.finish_reason = None
-        self.step_s = []        # per-token decode gaps (bounded by
-        self._stream_q = _pyqueue.Queue()   # max_new_tokens)
+        self.step_s = []        # engine-attributed per-token step times
+        self._stream_q = _pyqueue.Queue()   # (bounded by max_new_tokens)
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.sample_seed = sample_seed
+        self._rng = None
+
+    def _draw_u(self):
+        """Next uniform of this request's OWN sampling stream: one host
+        PRNG per request, so co-resident slots sample independently and
+        a (sample_seed, prompt) pair replays bit-exactly regardless of
+        slot assignment or neighbors."""
+        if self.temperature <= 0.0:
+            return 0.0
+        if self._rng is None:
+            self._rng = _sampling_stream(self.sample_seed)
+        return float(self._rng.random())
 
     # engine-side delivery ------------------------------------------------
     def _emit(self, tok):
@@ -220,15 +311,18 @@ class GenerateRequest(Request):
 
 
 class _Slot(object):
-    __slots__ = ('req', 'pos', 'generated', 'last', 'last_t', 'wall0')
+    __slots__ = ('req', 'pos', 'generated', 'last', 'last_t', 'wall0',
+                 'blocks', 'table')
 
-    def __init__(self, req, pos, last):
+    def __init__(self, req, pos, last, blocks=None, table=None):
         self.req = req
         self.pos = pos          # cache position the NEXT step writes
         self.generated = 1      # prefill already emitted the first token
         self.last = last        # last generated token (next step's input)
         self.last_t = time.perf_counter()   # previous token's completion
         self.wall0 = time.time() * 1e6      # decode-phase start (us)
+        self.blocks = blocks    # paged: physical block ids, table order
+        self.table = table      # paged: np [max_blocks] int64, filler 0
 
 
 class GenerateEngine(object):
@@ -253,11 +347,22 @@ class GenerateEngine(object):
         self.config = config or GenerateConfig()
         self.scope = scope if scope is not None else Scope()
         self.executor = Executor(TPUPlace(0))
+        c = self.config
+        if c.paged:
+            self._alloc = BlockAllocator(c.num_blocks, c.block_size)
+            self._prefix = PrefixCache(self._alloc) \
+                if c.prefix_sharing else None
+            self._max_blocks = c.max_len // c.block_size
+            self._cow_jit = None
+        else:
+            self._alloc = None
+            self._prefix = None
         self._build_programs()
         self._init_state()
         self.queue = RequestQueue(self.config.queue_cap)
         self._slots = [None] * self.config.slots
         self._free = list(range(self.config.slots))[::-1]
+        self._pending_admit = None   # popped but awaiting free blocks
         self._prefill_bound = {}
         self._step_bound = None
         self._thread = None
@@ -269,8 +374,12 @@ class GenerateEngine(object):
         self._decode_tokens = 0
         self._occ_sum = 0.0
         self._occ_peak = 0.0
+        self._active_peak = 0
+        self._blocks_peak = 0
         monitor.set_gauge('kv_slot_occupancy', 0.0)
         monitor.set_gauge('generate_queue_depth', 0.0)
+        if c.paged:
+            self._set_block_gauges()
 
     # ------------------------------------------------------------------
     # build + state
@@ -281,15 +390,22 @@ class GenerateEngine(object):
         self._step_prog.random_seed = c.seed
         with program_guard(self._step_prog, self._startup):
             with unique_name.guard():
-                self._step_vars = build_lm_decode_step(cfg, c.slots,
-                                                       c.max_len)
+                self._step_vars = build_lm_decode_step(
+                    cfg, c.slots, c.max_len,
+                    block_size=c.block_size if c.paged else None,
+                    num_blocks=c.num_blocks)
         self._prefill = {}
         for b in c.prompt_buckets:
             main, start = Program(), Program()
             main.random_seed = c.seed
             with program_guard(main, start):
                 with unique_name.guard():
-                    v = build_lm_prefill(cfg, b, c.slots, c.max_len)
+                    if c.paged:
+                        v = build_lm_prefill_paged(
+                            cfg, b, c.num_blocks, c.block_size,
+                            self._max_blocks)
+                    else:
+                        v = build_lm_prefill(cfg, b, c.slots, c.max_len)
             self._prefill[b] = (main, v)
 
     def _init_state(self):
@@ -300,11 +416,90 @@ class GenerateEngine(object):
                 # fresh engine: init params from config.seed; a provided
                 # scope with trained weights skips this entirely
                 self.executor.run(self._startup, scope=self.scope)
-        if not self.scope.has(KV_CACHE_K):
-            dh = cfg.d_model // cfg.n_head
+        self._ensure_cache()
+
+    def _ensure_cache(self):
+        """Make the scope's gen_kv_k/v buffers match THIS engine's
+        geometry. A provided scope may carry another engine's cache
+        under the same names — contiguous vs paged, or a different
+        slots/max_len/pool shape; the cache holds no trained state, so
+        re-zeroing is always safe, while reusing a mismatched buffer
+        would feed the compiled programs garbage shapes. Re-checked at
+        warmup()/start()/generate_once() so engines sharing one trained
+        scope SEQUENTIALLY each reclaim it (concurrent use of one scope
+        by two live engines stays unsupported)."""
+        import jax.numpy as jnp
+        cfg, c = self.config.model, self.config
+        dh = cfg.d_model // cfg.n_head
+        if c.paged:
+            shape = (c.num_blocks, cfg.n_layer, cfg.n_head,
+                     c.block_size, dh)
+        else:
             shape = (c.slots, cfg.n_layer, cfg.n_head, c.max_len, dh)
+        have = self.scope.get(KV_CACHE_K)
+        if have is None or tuple(have.shape) != shape:
             self.scope.set(KV_CACHE_K, jnp.zeros(shape, 'float32'))
             self.scope.set(KV_CACHE_V, jnp.zeros(shape, 'float32'))
+
+    # ------------------------------------------------------------------
+    # paged helpers
+    @staticmethod
+    def _sample_feed(n, temp=0.0, topk=0, topp=0.0, u=0.0):
+        return {'gen_temp': np.full((n, 1), temp, 'float32'),
+                'gen_topk': np.full((n, 1), topk, 'int64'),
+                'gen_topp': np.full((n, 1), topp, 'float32'),
+                'gen_u': np.full((n, 1), u, 'float32')}
+
+    def _cow_copy(self, src, dst):
+        """Device-side block copy for copy-on-write: duplicate physical
+        block `src` into `dst` in BOTH caches. One jitted
+        dynamic-slice/update pair, compiled once at warmup (src/dst are
+        traced scalars), donation aliases the pool in place."""
+        import jax
+        if self._cow_jit is None:
+            def _copy(cache, s, d):
+                return cache.at[d].set(cache[s])
+            # no donate: CPU ignores it with a warning, and COW is rare
+            # enough that a transient copy of the pool is acceptable
+            self._cow_jit = jax.jit(_copy)
+        s = np.asarray(src, 'int32')
+        d = np.asarray(dst, 'int32')
+        for name in (KV_CACHE_K, KV_CACHE_V):
+            self.scope.set(name, self._cow_jit(
+                self.executor._state_value(self.scope, name,
+                                           self._step_prog, cache=False),
+                s, d))
+
+    def _set_block_gauges(self):
+        used = self._alloc.in_use()
+        self._blocks_peak = max(self._blocks_peak, used)
+        monitor.set_gauge('kv_blocks_in_use', float(used))
+        monitor.set_gauge('kv_blocks_free', float(self._alloc.available()))
+
+    def _alloc_blocks(self, n):
+        """n blocks, evicting idle prefix-cache entries under pressure;
+        None when the pool genuinely cannot satisfy the request."""
+        ids = self._alloc.alloc(n)
+        if ids is None and self._prefix is not None:
+            self._prefix.evict_for(n)
+            ids = self._alloc.alloc(n)
+        if ids is not None:
+            self._set_block_gauges()
+        return ids
+
+    def _deref_blocks(self, blocks):
+        for b in blocks:
+            self._alloc.deref(b)
+        self._set_block_gauges()
+
+    def _release_blocks(self, st):
+        self._deref_blocks(st.blocks or [])
+        st.blocks = []
+
+    def _slot_table(self, blocks):
+        table = np.zeros((self._max_blocks,), 'int64')
+        table[:len(blocks)] = blocks
+        return table
 
     # ------------------------------------------------------------------
     # warmup
@@ -326,16 +521,27 @@ class GenerateEngine(object):
                 "warmup() executes the decode programs against the live "
                 "KV cache and must not race the started engine loop — "
                 "warm up before start() (start() warms up automatically)")
+        self._ensure_cache()
         from ..warmfarm import farm
         t0 = time.perf_counter()
         before = monitor.counters()
         S = self.config.slots
         reused = 0
+        paged = self.config.paged
         with monitor.span('generate.warmup'):
             for b, (prog, v) in sorted(self._prefill.items()):
                 feed = {'gen_prompt': np.zeros((1, b), 'int64'),
-                        'gen_slot': np.zeros((1, 1), 'int64'),
                         'gen_len': np.ones((1, 1), 'int64')}
+                if paged:
+                    # an all-zero block table points every write at the
+                    # reserved trash block — warmup never touches a row
+                    # a live request could own
+                    feed['gen_pos'] = np.zeros((1, b), 'int64')
+                    feed['gen_btab'] = np.zeros((1, self._max_blocks),
+                                                'int64')
+                else:
+                    feed['gen_slot'] = np.zeros((1, 1), 'int64')
+                feed.update(self._sample_feed(1))
                 key, already = farm.track(self.executor, prog, feed,
                                           fetch_list=[v['first_token']],
                                           scope=self.scope)
@@ -348,6 +554,10 @@ class GenerateEngine(object):
                     farm.commit(key)
             feed = {'gen_tokens': np.zeros((S, 1), 'int64'),
                     'gen_pos': np.zeros((S, 1), 'int64')}
+            if paged:
+                feed['gen_btab'] = np.zeros((S, self._max_blocks),
+                                            'int64')
+            feed.update(self._sample_feed(S))
             key, already = farm.track(
                 self.executor, self._step_prog, feed,
                 fetch_list=[self._step_vars['next_tokens']],
@@ -360,6 +570,11 @@ class GenerateEngine(object):
                 reused += 1
             else:
                 farm.commit(key)
+            if paged:
+                # compile the copy-on-write block copy now (0 -> 0 is a
+                # trash-block no-op) so steady traffic stays at zero
+                # compiles even when the first COW lands mid-stream
+                self._cow_copy(0, 0)
         delta = monitor.counter_delta(before)
         compiles = sum(v for k, v in delta.items()
                        if k.startswith('compile_cache_miss'))
@@ -380,6 +595,8 @@ class GenerateEngine(object):
                     "fresh engine (the queue already failed its callers)")
             if self._step_bound is None:
                 self.warmup()
+            else:
+                self._ensure_cache()
             self._started = True
             if self._metrics_server is None:
                 self._metrics_server = start_metrics_server(
@@ -404,6 +621,11 @@ class GenerateEngine(object):
         if self._thread is not None:
             self._thread.join(timeout_s)
             self._thread = None
+        if self._prefix is not None:
+            # a stopped engine cannot serve another hit; release the
+            # cache's block references so accounting reads empty
+            self._prefix.drop_all()
+            self._set_block_gauges()
         if self._metrics_server is not None:
             self._metrics_server.close()
             self._metrics_server = None
@@ -424,11 +646,19 @@ class GenerateEngine(object):
 
     # ------------------------------------------------------------------
     # request path
-    def submit(self, prompt, max_new_tokens=None, deadline_s=None):
+    def submit(self, prompt, max_new_tokens=None, deadline_s=None,
+               temperature=None, top_k=None, top_p=None,
+               sample_seed=None):
         """Enqueue one prompt (1-D int token ids); returns the
         `GenerateRequest` stream/future. Raises ValueError synchronously
         for prompts the ladder cannot serve and `LoadShedError` when the
-        bounded queue is full."""
+        bounded queue is full.
+
+        temperature/top_k/top_p default to the engine-wide
+        `GenerateConfig` values; temperature <= 0 is bitwise greedy.
+        `sample_seed` pins the request's private sampling stream — the
+        same (seed, prompt) replays the same tokens whatever else is
+        co-resident; None draws a fresh unpredictable stream."""
         prompt = np.asarray(prompt, dtype='int64').reshape(-1)
         buckets = self.config.prompt_buckets
         if prompt.size < 1 or prompt.size > buckets[-1]:
@@ -444,13 +674,25 @@ class GenerateEngine(object):
             monitor.inc('generate_request_total',
                         labels={'outcome': 'rejected'})
             raise ValueError("max_new_tokens must be >= 1")
+        c = self.config
+        temperature = c.temperature if temperature is None \
+            else float(temperature)
+        top_k = c.top_k if top_k is None else int(top_k)
+        top_p = c.top_p if top_p is None else float(top_p)
+        if top_p < 0.0 or top_p > 1.0:
+            monitor.inc('generate_request_total',
+                        labels={'outcome': 'rejected'})
+            raise ValueError("top_p must lie in [0, 1] — 0 (or 1) "
+                             "disables nucleus sampling")
         if deadline_s is None:
             deadline_s = self.config.default_deadline_s
         deadline = (time.monotonic() + deadline_s
                     if deadline_s is not None else None)
         req = GenerateRequest(prompt, prompt.size,
                               bucketize(prompt.size, buckets), deadline,
-                              int(max_new_tokens))
+                              int(max_new_tokens),
+                              temperature=temperature, top_k=top_k,
+                              top_p=top_p, sample_seed=sample_seed)
         req.trace = trace_mod.start('generate')
         try:
             self.queue.put(req)
@@ -465,40 +707,93 @@ class GenerateEngine(object):
         return req
 
     def generate(self, prompt, max_new_tokens=None, deadline_s=None,
-                 timeout=None):
+                 timeout=None, temperature=None, top_k=None, top_p=None,
+                 sample_seed=None):
         """Blocking convenience: submit + result (the generated tokens)."""
         return self.submit(prompt, max_new_tokens=max_new_tokens,
-                           deadline_s=deadline_s).result(timeout)
+                           deadline_s=deadline_s, temperature=temperature,
+                           top_k=top_k, top_p=top_p,
+                           sample_seed=sample_seed).result(timeout)
 
-    def generate_once(self, prompt, max_new_tokens=None):
-        """Synchronous single-prompt greedy decode on slot 0, driving the
-        SAME compiled prefill/step programs step by step — the sequential
+    def generate_once(self, prompt, max_new_tokens=None, temperature=0.0,
+                      top_k=0, top_p=0.0, sample_seed=None):
+        """Synchronous single-prompt decode on slot 0, driving the SAME
+        compiled prefill/step programs step by step — the sequential
         reference the parity tests compare the continuous batcher
-        against, and a zero-thread debug path. Only valid while the
-        engine is NOT started (it shares the loop's cache slots)."""
+        against, and a zero-thread debug path. Greedy by default;
+        sampling args mirror submit() (a pinned `sample_seed` replays
+        the exact submit() sampling stream). Only valid while the engine
+        is NOT started (it shares the loop's cache slots). Paged engines
+        allocate the reference's blocks from the live pool (bypassing
+        the prefix cache) and return every block before returning."""
         if self._started:
             raise RuntimeError(
                 "generate_once drives the decode programs inline and "
                 "must not race the started engine loop — use submit()")
         if self._step_bound is None:
             self.warmup()
+        else:
+            self._ensure_cache()
         prompt = np.asarray(prompt, dtype='int64').reshape(-1)
         if max_new_tokens is None:
             max_new_tokens = self.config.max_new_tokens
         c = self.config
-        first = self._run_prefill(0, prompt)
-        tokens, last, pos = [first], first, prompt.size
-        while (len(tokens) < max_new_tokens and pos < c.max_len and
-               (c.eos_id is None or last != c.eos_id)):
-            S = c.slots
-            toks = np.zeros((S, 1), 'int64')
-            posf = np.zeros((S, 1), 'int64')
-            toks[0], posf[0] = last, pos
-            out = self._step_bound({'gen_tokens': toks, 'gen_pos': posf})
-            last = int(np.asarray(out[0]).reshape(-1)[0])
-            tokens.append(last)
-            pos += 1
-        return tokens
+        temperature = float(temperature)
+        rng = [None]
+
+        def draw_u():
+            if temperature <= 0.0:
+                return 0.0
+            if rng[0] is None:
+                rng[0] = _sampling_stream(sample_seed)
+            return float(rng[0].random())
+
+        sample = (temperature, int(top_k), float(top_p))
+        blocks, table = None, None
+        if c.paged:
+            bs = c.block_size
+            blocks = self._alloc_blocks(-(-prompt.size // bs))
+            if blocks is None:
+                raise RuntimeError(
+                    "paged KV pool cannot hold a %d-token prompt right "
+                    "now (%d blocks free of %d)"
+                    % (prompt.size, self._alloc.available(),
+                       self._alloc.capacity))
+            table = self._slot_table(blocks)
+        try:
+            first = self._run_prefill(0, prompt,
+                                      sample + (draw_u(),),
+                                      table=table, ctx_len=0)
+            tokens, last, pos = [first], first, prompt.size
+            while (len(tokens) < max_new_tokens and pos < c.max_len and
+                   (c.eos_id is None or last != c.eos_id)):
+                if c.paged and pos // c.block_size >= len(blocks):
+                    grown = self._alloc_blocks(1)
+                    if grown is None:     # pool dry: cache_full semantics
+                        break
+                    table[len(blocks)] = grown[0]
+                    blocks.append(grown[0])
+                S = c.slots
+                toks = np.zeros((S, 1), 'int64')
+                posf = np.zeros((S, 1), 'int64')
+                toks[0], posf[0] = last, pos
+                feed = {'gen_tokens': toks, 'gen_pos': posf}
+                if c.paged:
+                    btab = np.zeros((S, self._max_blocks), 'int64')
+                    btab[0] = table
+                    feed['gen_btab'] = btab
+                sf = self._sample_feed(S)
+                sf['gen_temp'][0], sf['gen_topk'][0] = sample[0], sample[1]
+                sf['gen_topp'][0], sf['gen_u'][0] = sample[2], draw_u()
+                feed.update(sf)
+                out = self._step_bound(feed)
+                last = int(np.asarray(out[0]).reshape(-1)[0])
+                tokens.append(last)
+                pos += 1
+            return tokens
+        finally:
+            if blocks:
+                self._deref_blocks(blocks)
 
     # ------------------------------------------------------------------
     # decode loop
@@ -508,6 +803,13 @@ class GenerateEngine(object):
             self._evict_expired()
             self._admit()
             if not any(s is not None for s in self._slots):
+                if self._pending_admit is not None:
+                    # parked for blocks with nothing resident: _admit()
+                    # retries it at the top of every loop pass (it can
+                    # only be reachable transiently — with no residents
+                    # the prefix cache is fully evictable)
+                    time.sleep(poll)
+                    continue
                 # idle: block briefly for new work instead of spinning
                 batch, expired = self.queue.take_batch(1, 0.0,
                                                        poll_s=poll)
@@ -541,18 +843,93 @@ class GenerateEngine(object):
                 st.req.fail(EngineStoppedError(
                     "engine stopped after %d generated tokens"
                     % st.generated))
+        if self._pending_admit is not None:
+            req, self._pending_admit = self._pending_admit, None
+            monitor.inc('generate_request_total',
+                        labels={'outcome': 'stopped'})
+            req.fail(EngineStoppedError(
+                "engine stopped while the request waited for KV blocks"))
         self._set_occupancy()
 
     def _admit(self):
         while self._free and not self._stop_evt.is_set():
-            batch, expired = self.queue.take_batch(1, 0.0, poll_s=0.0)
-            self._fail_expired(expired)
-            if not batch:
-                return
-            self._admit_one(batch[0])
+            req = self._pending_admit
+            self._pending_admit = None
+            if req is None:
+                batch, expired = self.queue.take_batch(1, 0.0, poll_s=0.0)
+                self._fail_expired(expired)
+                if not batch:
+                    return
+                req = batch[0]
+            if not self._admit_one(req):
+                return      # parked for blocks: retry next token boundary
             monitor.set_gauge('generate_queue_depth', self.queue.depth())
 
+    def _paged_plan(self, req):
+        """Block plan for one admission: (blocks, ctx_len, hashes).
+        `blocks` covers the whole prompt in logical order — prefix-cache
+        hits mapped to their existing physical blocks (referenced),
+        fresh blocks for the rest, and a copy-on-write duplicate of the
+        final shared block when the ENTIRE prompt landed on shared
+        blocks (its last position must be recomputed, a divergent
+        write). Returns None when the pool cannot satisfy the request
+        right now (nothing referenced, nothing allocated)."""
+        c = self.config
+        bs = c.block_size
+        L = req.prompt.size
+        total = -(-L // bs)
+        shared, hashes = [], []
+        if self._prefix is not None:
+            hashes = chain_hashes(req.prompt, bs)
+            shared = self._prefix.match(hashes)
+        cow = bool(shared) and len(shared) * bs >= L
+        n_keep = len(shared) - (1 if cow else 0)
+        ctx_len = min(n_keep * bs + (bs if cow else 0), L - 1)
+        # pin every matched block (incl. the COW source) BEFORE touching
+        # the allocator: under pool pressure _alloc_blocks evicts
+        # refcount-1 prefix entries, and without the pin it could evict
+        # a block match() just returned and recycle it as "fresh" —
+        # a duplicate id in the plan, i.e. the suffix prefill clobbering
+        # its own cached prefix
+        pinned = shared[:n_keep] + (shared[-1:] if cow else [])
+        for b in pinned:
+            self._alloc.ref(b)
+        new_ids = self._alloc_blocks(total - n_keep)
+        if new_ids is None:
+            self._deref_blocks(pinned)
+            return None
+        if cow:
+            self._cow_copy(shared[-1], new_ids[0])
+            self._alloc.deref(shared[-1])   # pinned only for the copy
+            monitor.inc('kv_block_cow_total')
+        if self._prefix is not None:
+            monitor.inc('kv_prefix_hit_total', labels={
+                'outcome': 'hit' if ctx_len > 0 else 'miss'})
+            if ctx_len > 0:
+                monitor.inc('kv_prefix_tokens_saved_total', ctx_len)
+        return shared[:n_keep] + new_ids, ctx_len, hashes
+
     def _admit_one(self, req):
+        """Admit one popped request. Returns False when a paged engine
+        must wait for blocks (the request parks in _pending_admit and is
+        retried every token boundary); True when the request was
+        consumed — admitted, finished, or failed."""
+        c = self.config
+        blocks, table, ctx_len, hashes = None, None, 0, []
+        if c.paged:
+            if -(-req.prompt.size // c.block_size) > self._alloc.capacity:
+                # no eviction can ever fit this prompt: structured
+                # cache_full, zero tokens, nothing leaked
+                monitor.inc('generate_request_total',
+                            labels={'outcome': 'ok'})
+                req._finish('cache_full')
+                return True
+            plan = self._paged_plan(req)
+            if plan is None:
+                self._pending_admit = req
+                return False
+            blocks, ctx_len, hashes = plan
+            table = self._slot_table(blocks)
         slot = self._free.pop()
         qs = max(0.0, time.monotonic() - req.enqueue_t)
         if req.trace is not None:
@@ -565,13 +942,23 @@ class GenerateEngine(object):
         t0 = time.perf_counter()
         pf_wall = time.time() * 1e6
         try:
-            first = self._run_prefill(slot, req.prompt)
+            first = self._run_prefill(
+                slot, req.prompt,
+                (req.temperature, req.top_k, req.top_p, req._draw_u()),
+                table=table, ctx_len=ctx_len)
         except Exception as e:  # noqa: BLE001 — delivered per-request
             self._free.append(slot)
+            if blocks:
+                self._deref_blocks(blocks)
             monitor.inc('generate_request_total',
                         labels={'outcome': 'error'})
             req.fail(e)
-            return
+            return True
+        if c.paged and self._prefix is not None:
+            # publish this prompt's FULL blocks (immutable once
+            # prefilled: decode writes land strictly past the prompt)
+            for i, h in enumerate(hashes):
+                self._prefix.register(h, i, blocks[i])
         pf_s = time.perf_counter() - t0
         monitor.observe('prefill_seconds', pf_s)
         if req.trace is not None:
@@ -581,9 +968,12 @@ class GenerateEngine(object):
         monitor.inc('decode_tokens_total')
         self._decode_tokens += 1
         req._emit(first)
-        st = _Slot(req, pos=req.prompt.size, last=first)
+        st = _Slot(req, pos=req.prompt.size, last=first,
+                   blocks=blocks, table=table)
         reason = self._finish_reason(st)
         if reason:
+            if c.paged:
+                self._release_blocks(st)
             self._free.append(slot)
             monitor.inc('generate_request_total',
                         labels={'outcome': 'ok'})
@@ -591,15 +981,32 @@ class GenerateEngine(object):
         else:
             self._slots[slot] = st
         self._set_occupancy()
+        return True
 
-    def _run_prefill(self, slot, prompt):
-        b = bucketize(prompt.size, self.config.prompt_buckets)
-        padded = np.full((1, b), self.config.pad_id, 'int64')
-        padded[0, :prompt.size] = prompt
-        out = self._prefill_bound[b]({
-            'gen_prompt': padded,
-            'gen_slot': np.array([[slot]], 'int64'),
-            'gen_len': np.array([[prompt.size]], 'int64')})
+    def _run_prefill(self, slot, prompt, sample=(0.0, 0, 0.0, 0.0),
+                     table=None, ctx_len=0):
+        c = self.config
+        if table is None:
+            b = bucketize(prompt.size, c.prompt_buckets)
+            padded = np.full((1, b), c.pad_id, 'int64')
+            padded[0, :prompt.size] = prompt
+            feed = {'gen_prompt': padded,
+                    'gen_slot': np.array([[slot]], 'int64'),
+                    'gen_len': np.array([[prompt.size]], 'int64')}
+        else:
+            # paged: only the UN-CACHED suffix is computed; it buckets by
+            # suffix length — the prefill-compute saving of a prefix hit
+            suffix = prompt[ctx_len:]
+            b = bucketize(suffix.size, c.prompt_buckets)
+            padded = np.full((1, b), c.pad_id, 'int64')
+            padded[0, :suffix.size] = suffix
+            pos = np.clip(ctx_len + np.arange(b), 0, c.max_len - 1)
+            feed = {'gen_prompt': padded,
+                    'gen_pos': pos[None].astype('int64'),
+                    'gen_btab': table[None],
+                    'gen_len': np.array([[suffix.size]], 'int64')}
+        feed.update(self._sample_feed(1, *sample))
+        out = self._prefill_bound[b](feed)
         return int(np.asarray(out[0]).reshape(-1)[0])
 
     def _step(self):
@@ -610,26 +1017,66 @@ class GenerateEngine(object):
         if pending is not None:
             self._step_complete(pending)
 
+    def _grow_blocks(self):
+        """Paged pre-step pass: any resident whose next write position
+        crosses into an unallocated block gets one more block; a dry
+        pool (even after prefix-cache eviction) finishes the starved
+        request with 'cache_full' and returns its blocks — neighbors
+        keep decoding."""
+        bs = self.config.block_size
+        for i, st in enumerate(self._slots):
+            if st is None:
+                continue
+            bi = st.pos // bs
+            if bi < len(st.blocks):
+                continue
+            grown = self._alloc_blocks(1)
+            if grown is None:
+                self._release(i)
+                monitor.inc('generate_request_total',
+                            labels={'outcome': 'ok'})
+                st.req._finish('cache_full')
+                continue
+            st.table[len(st.blocks)] = grown[0]
+            st.blocks.append(grown[0])
+        self._set_occupancy()
+
     def _step_dispatch(self):
         """Snapshot the resident slots and dispatch one decode step
         WITHOUT materializing its next-token fetch — JAX's async
         dispatch returns as soon as the step is staged, so the caller
         can do host work (admission) while the device computes."""
-        S = self.config.slots
+        c = self.config
+        if c.paged:
+            self._grow_blocks()
+        S = c.slots
         toks = np.zeros((S, 1), 'int64')
         pos = np.zeros((S, 1), 'int64')
+        sample = self._sample_feed(S)
+        btab = np.zeros((S, self._max_blocks), 'int64') if c.paged \
+            else None
         active = []
         for i, st in enumerate(self._slots):
             if st is None:
                 continue
             toks[i], pos[i] = st.last, st.pos
+            r = st.req
+            sample['gen_temp'][i] = r.temperature
+            sample['gen_topk'][i] = r.top_k
+            sample['gen_topp'][i] = r.top_p
+            sample['gen_u'][i] = r._draw_u()
+            if btab is not None:
+                btab[i] = st.table
             active.append((i, st))
         if not active:
             return None
+        feed = {'gen_tokens': toks, 'gen_pos': pos}
+        if btab is not None:
+            feed['gen_btab'] = btab
+        feed.update(sample)
         t0 = time.perf_counter()
         try:
-            out = self._step_bound({'gen_tokens': toks, 'gen_pos': pos},
-                                   return_numpy=False)
+            out = self._step_bound(feed, return_numpy=False)
         except Exception as e:  # noqa: BLE001 — delivered per-request
             self._fail_step(active, e)
             return None
@@ -712,6 +1159,13 @@ class GenerateEngine(object):
                 st.req.fail(DeadlineExceededError(
                     "deadline passed mid-generation after %d tokens"
                     % st.generated))
+        if self._pending_admit is not None and \
+                self._pending_admit.expired(now):
+            req, self._pending_admit = self._pending_admit, None
+            monitor.inc('generate_request_total',
+                        labels={'outcome': 'deadline'})
+            req.fail(DeadlineExceededError(
+                "deadline passed waiting for free KV blocks"))
         self._set_occupancy()
 
     def _fail_expired(self, expired):
@@ -724,22 +1178,30 @@ class GenerateEngine(object):
                 % (now - r.enqueue_t)))
 
     def _release(self, i):
+        st = self._slots[i]
+        if st is not None and self.config.paged:
+            self._release_blocks(st)
         self._slots[i] = None
         self._free.append(i)
 
     def _set_occupancy(self):
-        occ = sum(1 for s in self._slots if s is not None) \
-            / float(len(self._slots))
+        n = sum(1 for s in self._slots if s is not None)
+        occ = n / float(len(self._slots))
         self._occ_peak = max(self._occ_peak, occ)
+        self._active_peak = max(self._active_peak, n)
         monitor.set_gauge('kv_slot_occupancy', occ)
 
     # ------------------------------------------------------------------
     def stats(self):
-        """Decode-loop statistics since construction."""
+        """Decode-loop statistics since construction. Paged engines add
+        the block-level capacity accounting under 'blocks' — physical
+        pool state, the peak footprint, and the prefix-cache entry
+        count (the monitor mirrors it as kv_blocks_in_use/free)."""
         steps = self._decode_steps
-        return {
+        out = {
             'slots': self.config.slots,
             'active': sum(1 for s in self._slots if s is not None),
+            'peak_active': self._active_peak,
             'queue_depth': self.queue.depth(),
             'decode_steps': steps,
             'decode_tokens': self._decode_tokens,
@@ -747,3 +1209,14 @@ class GenerateEngine(object):
             'mean_slot_occupancy': round(self._occ_sum / steps, 4)
             if steps else 0.0,
         }
+        if self.config.paged:
+            out['blocks'] = {
+                'block_size': self.config.block_size,
+                'capacity': self._alloc.capacity,
+                'in_use': self._alloc.in_use(),
+                'free': self._alloc.available(),
+                'peak_in_use': self._blocks_peak,
+                'prefix_entries': len(self._prefix)
+                if self._prefix is not None else 0,
+            }
+        return out

@@ -1,0 +1,172 @@
+"""Physical KV-cache block accounting for the paged decode engine.
+
+The paged cache (ops/kv_cache_ops.py paged variants) is a pool of
+fixed-size physical blocks addressed through runtime-fed per-slot block
+tables. Two host-side structures own the pool:
+
+- ``BlockAllocator``: free-list + per-block refcounts over blocks
+  ``1..num_blocks-1`` (block 0 is the TRASH block — table filler and
+  pad-write target — and is never handed out). Admission becomes a
+  blocks-available decision; a finished or evicted request's ``deref``
+  returns refcount-0 blocks to the free list.
+- ``PrefixCache``: content-addressed map from prompt-prefix CHAIN hashes
+  (one per full block of prompt tokens) to the physical block already
+  holding that prefix's K/V. A hit maps the new request's leading table
+  entries onto the SAME physical blocks (refcount++) — the identical
+  system prompt of a million-user service is stored once and its
+  prefill computed once. The cache itself holds one reference per
+  registered block, so prefix blocks survive their creator request and
+  are reclaimed lazily, LRU-deepest-first, only under allocation
+  pressure.
+
+Sharing is at FULL-BLOCK granularity. Because a block's K/V rows depend
+only on tokens at or before them (causal), a block fully covered by
+prompt tokens is immutable once prefilled — the one exception is a
+request whose ENTIRE prompt lands on shared blocks (prompt length a
+multiple of block_size and all blocks hit): its last prompt position
+must be recomputed to produce the first token, which makes its final
+block's row a divergent write → the engine copies that block first
+(copy-on-write, ``kv_block_cow_total``) and writes into the private
+copy. Neither sharer ever observes the other's tokens.
+"""
+import hashlib
+
+__all__ = ['BlockAllocator', 'PrefixCache', 'chain_hashes']
+
+
+def chain_hashes(tokens, block_size):
+    """One chained content hash per FULL block of `tokens`: hash i
+    commits to every token in blocks 0..i, so equal hash means equal
+    whole prefix (not just an equal i-th block)."""
+    out, h = [], b'kv-prefix'
+    n_full = len(tokens) // block_size
+    for i in range(n_full):
+        blk = tokens[i * block_size:(i + 1) * block_size]
+        h = hashlib.sha1(
+            h + b'|' + b','.join(b'%d' % int(t) for t in blk)).digest()
+        out.append(h)
+    return out
+
+
+class BlockAllocator(object):
+    """Free-list + refcount accounting over `num_blocks` physical blocks.
+    Block 0 is reserved (trash) and never allocated; `capacity` is the
+    usable pool size (num_blocks - 1)."""
+
+    def __init__(self, num_blocks, block_size):
+        if num_blocks < 2:
+            raise ValueError(
+                "paged cache needs >= 2 physical blocks (block 0 is the "
+                "reserved trash block), got %d" % num_blocks)
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self._free = list(range(self.num_blocks - 1, 0, -1))
+        self._ref = [0] * self.num_blocks
+
+    @property
+    def capacity(self):
+        return self.num_blocks - 1
+
+    def available(self):
+        return len(self._free)
+
+    def in_use(self):
+        return self.capacity - len(self._free)
+
+    def refcount(self, bid):
+        return self._ref[bid]
+
+    def alloc(self, n):
+        """n fresh blocks at refcount 1, or None when the free list is
+        short (nothing is partially allocated on failure)."""
+        if n > len(self._free):
+            return None
+        out = [self._free.pop() for _ in range(n)]
+        for b in out:
+            self._ref[b] = 1
+        return out
+
+    def ref(self, bid):
+        if self._ref[bid] < 1:
+            raise ValueError("ref of unallocated block %d" % bid)
+        self._ref[bid] += 1
+
+    def deref(self, bid):
+        """Drop one reference; a refcount-0 block returns to the free
+        list. Returns True when the block was actually freed."""
+        if self._ref[bid] < 1:
+            raise ValueError("deref of unallocated block %d" % bid)
+        self._ref[bid] -= 1
+        if self._ref[bid] == 0:
+            self._free.append(bid)
+            return True
+        return False
+
+
+class PrefixCache(object):
+    """hash-chain -> physical block map with LRU pressure eviction.
+
+    Each registered block carries ONE cache reference (so it outlives
+    its creator request). `match` walks the chain from depth 0 and
+    returns the longest cached run; `evict_for` releases stale entries
+    — least-recently-used first, deepest entry first within a tie, so a
+    chain never loses a shallow link before its deeper ones — until the
+    allocator can satisfy a request, and is only called under
+    allocation pressure."""
+
+    def __init__(self, alloc):
+        self._alloc = alloc
+        self._entries = {}      # hash -> [block_id, depth, last_used]
+        self._clock = 0
+
+    def __len__(self):
+        return len(self._entries)
+
+    def match(self, hashes):
+        """Longest cached prefix run for `hashes` (chain order): the
+        list of physical block ids, NOT yet referenced — the caller
+        refs the ones it keeps."""
+        self._clock += 1
+        out = []
+        for i, h in enumerate(hashes):
+            e = self._entries.get(h)
+            if e is None or e[1] != i:      # depth-checked: chains only
+                break                       # ever match from the root
+            e[2] = self._clock
+            out.append(e[0])
+        return out
+
+    def register(self, h, depth, block_id):
+        """Publish `block_id` as the home of chain hash `h` (depth =
+        its block index within the prompt). First writer wins — an
+        already-registered hash keeps its existing block."""
+        if h in self._entries:
+            return False
+        self._clock += 1
+        self._alloc.ref(block_id)
+        self._entries[h] = [block_id, int(depth), self._clock]
+        return True
+
+    def evict_for(self, n_needed):
+        """Drop cache-only entries (block refcount 1 — no live slot)
+        until the allocator has `n_needed` free blocks. Returns the
+        number of entries evicted."""
+        if self._alloc.available() >= n_needed:
+            return 0
+        victims = sorted(self._entries.items(),
+                         key=lambda kv: (kv[1][2], -kv[1][1]))
+        evicted = 0
+        for h, (bid, _depth, _used) in victims:
+            if self._alloc.available() >= n_needed:
+                break
+            if self._alloc.refcount(bid) == 1:   # only the cache holds it
+                del self._entries[h]
+                self._alloc.deref(bid)
+                evicted += 1
+        return evicted
+
+    def drop_all(self):
+        """Release every cached entry (engine shutdown)."""
+        for h, (bid, _d, _u) in list(self._entries.items()):
+            del self._entries[h]
+            self._alloc.deref(bid)

@@ -36,15 +36,16 @@ def pytest_collection_modifyitems(config, items):
     # by design — the split is unobservable there, don't assert on it.
     if any('::' in a for a in config.args):
         return
-    gen = [it for it in items
-           if os.path.basename(str(it.fspath)) == 'test_generate.py']
-    if gen:
-        slow = [it for it in gen if it.get_closest_marker('slow')]
-        fast = [it for it in gen if not it.get_closest_marker('slow')]
-        assert slow, ('test_generate.py lost its @slow-marked heavy '
-                      'measurement test')
-        assert fast, ('test_generate.py lost its fast tier-1 smoke '
-                      'variants')
+    for fname in ('test_generate.py', 'test_paged_generate.py'):
+        gen = [it for it in items
+               if os.path.basename(str(it.fspath)) == fname]
+        if gen:
+            slow = [it for it in gen if it.get_closest_marker('slow')]
+            fast = [it for it in gen if not it.get_closest_marker('slow')]
+            assert slow, ('%s lost its @slow-marked heavy '
+                          'measurement test' % fname)
+            assert fast, ('%s lost its fast tier-1 smoke '
+                          'variants' % fname)
 
 
 @pytest.fixture(autouse=True)

@@ -283,6 +283,33 @@ def test_generate_once_refuses_started_engine():
             eng.generate_once(_prompt(4))
 
 
+def test_per_token_latency_attribution_is_step_time():
+    """Regression for the bogus BENCH_r06 per-token stat
+    (ms_per_token_p50 0.003 vs p99 72): tokens buffered in the stream
+    queue drain with ~0 client-side gap, so per-token latency must be
+    ENGINE-attributed — each decode step's wall time charged to every
+    token that step emitted (GenerateRequest.step_s, what servebench
+    now reports). On a steady decode those per-step times are a tight
+    distribution: p50 sits near the mean and p99 within the same order
+    of magnitude, neither of which holds for arrival gaps."""
+    eng = GenerateEngine(_cfg())
+    eng.warmup()
+    step_s = []
+    with eng:
+        for i in range(2):       # sequential residents: steady decode
+            req = eng.submit(_prompt(6, seed=80 + i), max_new_tokens=41)
+            req.result(60)
+            assert len(req.step_s) == 40    # one entry per step token
+            step_s.extend(req.step_s)
+    lat = sorted(step_s)
+    p50 = lat[monitor._rank_idx(0.5, len(lat))]
+    p99 = lat[monitor._rank_idx(0.99, len(lat))]
+    mean = sum(lat) / len(lat)
+    assert p50 > 0.25 * mean, (p50, mean)   # arrival gaps: p50 ~ 0
+    # same order of magnitude (+20ms grace for scheduler blips on CI)
+    assert p99 <= 10.0 * p50 + 0.020, (p50, p99)
+
+
 # ---------------------------------------------------------------------------
 # throughput vs the re-traced baseline (heavy: @slow, tier-1 skips)
 
