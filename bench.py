@@ -856,17 +856,24 @@ def _child(mode):
     # generative-decode row: continuous-batching GenerateEngine with the
     # device-resident KV cache vs the sequential re-traced greedy
     # baseline — tokens/sec, ENGINE-attributed per-token p50/p99 (step
-    # time charged to each token the step emitted — client arrival gaps
-    # under-reported p50 by 4 orders of magnitude, BENCH_r06),
-    # recompiles-after-warmup (contract: 0), kv occupancy, and the
-    # PAGED columns: the same workload at the same KV HBM budget through
-    # the block-table cache (block utilization, prefix-share hit rate,
-    # peak concurrent sequences — contract: >= 2x the contiguous slots —
-    # and exact greedy parity vs the contiguous engine). The companion
+    # time charged to each token the step emitted), recompiles-after-
+    # warmup (contract: 0), kv occupancy, and the PAGED columns: the
+    # same workload at the same KV HBM budget through the block-table
+    # cache (block utilization, prefix-share hit rate, peak concurrent
+    # sequences — contract: >= 2x the contiguous slots — and exact
+    # greedy parity vs the contiguous engine). The companion
     # shared-prefix row (one system prompt, N clients) proves physical
     # block sharing (refcounts) + measurably reduced prefill
     # (tools/servebench.py measure_generate / measure_shared_prefix;
-    # contract: >=10x sentences/s vs re-trace)
+    # contract: >=10x sentences/s vs re-trace).
+    # ROW-SCHEMA NOTE (per-token latency attribution): rounds up to and
+    # including BENCH_r06 computed ms_per_token_p50/p99 from CLIENT
+    # ARRIVAL GAPS — tokens buffered in the stream queue drain in ~0
+    # time, so those rows carry a bogus p50 (e.g. 0.003 ms against a
+    # 72 ms p99 in r06). PR 12 switched the attribution to engine step
+    # time charged per emitted token; r07+ rows are comparable to each
+    # other but NOT to the p50 column of older rows (p99 was dominated
+    # by real step time and remains roughly comparable).
     try:
         from tools.servebench import measure_generate
         generate = measure_generate(rounds=2 if on_tpu else 3)
@@ -878,6 +885,22 @@ def _child(mode):
     except Exception as e:
         generate_shared_prefix = {'error': '%s: %s'
                                   % (type(e).__name__, str(e)[:200])}
+
+    # speculative-decode row: the decode-heavy greedy workload through
+    # the paged engine plain vs SPECULATIVE (draft = target: accept
+    # rate 1.0 — one drafter dispatch + one spec_k+1-wide verify
+    # replace spec_k+1 sequential steps; contract: >= 1.5x engine
+    # tokens/sec, exact greedy parity, 0 recompiles), plus the
+    # chunked-prefill proof: a prompt past the widest bucket admitted
+    # with a bit-exact continuation (tools/servebench.py
+    # measure_speculative / --speculative)
+    try:
+        from tools.servebench import measure_speculative
+        generate_speculative = measure_speculative(
+            rounds=3 if on_tpu else 4)
+    except Exception as e:
+        generate_speculative = {'error': '%s: %s'
+                                % (type(e).__name__, str(e)[:200])}
 
     # async-pipeline row: overlapped input pipeline (DevicePrefetcher ->
     # run_async, bounded in-flight window) vs the synchronous step loop
@@ -1038,6 +1061,7 @@ def _child(mode):
         'serving': serving,
         'generate': generate,
         'generate_shared_prefix': generate_shared_prefix,
+        'generate_speculative': generate_speculative,
         'async_pipeline': async_pipeline,
         'elastic_resume': elastic_resume,
         'costreport': costreport,

@@ -17,7 +17,8 @@ from ..param_attr import ParamAttr
 
 __all__ = ['multi_head_attention', 'transformer_block', 'build_lm',
            'LMConfig', 'position_encoding_table', 'build_lm_prefill',
-           'build_lm_decode_step', 'build_lm_prefill_paged']
+           'build_lm_decode_step', 'build_lm_prefill_paged',
+           'build_lm_drafter', 'build_lm_verify']
 
 
 class LMConfig(object):
@@ -220,6 +221,15 @@ def build_lm(cfg=None, is_test=False):
 # feeds plus a host-fed uniform drive sampling; temperature 0 rows take
 # the bitwise argmax branch, so greedy engines are bit-identical to the
 # pre-sampling programs' outputs.
+#
+# SPECULATIVE decoding (PR 13) adds two paged-only program shapes:
+# - build_lm_drafter: spec_k greedy decode steps UNROLLED in-program
+#   (each one the same `_decode_tower` as the decode step), the draft
+#   model's K proposals in one dispatch.
+# - build_lm_verify: the target scores spec_k + 1 positions per slot in
+#   one batched step (span cache write + per-row-masked attention), the
+#   bitwise acceptance oracle for the drafts.
+# serving/generate.py owns the host-side accept/rollback protocol.
 # ---------------------------------------------------------------------------
 
 KV_CACHE_K = 'gen_kv_k'
@@ -296,6 +306,63 @@ def _qkv_split_step(qkv, cfg):
     return parts
 
 
+def _decode_tower(cfg, x, cache_write, attend, tag='', head=True):
+    """One decode-position transformer tower over per-slot row state
+    ``x`` ([S, d]: token embedding + position encoding). The cache
+    write and cached attention are delegated to closures so the SAME
+    structural body serves the plain decode step, each of the drafter's
+    unrolled steps, and any future cached-decode flavor — per-position
+    numerics can never drift between them. Returns logits [S, V].
+
+    ``tag`` disambiguates intermediate var names when the tower is
+    instantiated more than once in one program (the drafter's unroll).
+    ``head=False`` skips the final LayerNorm + LM head and returns
+    None — the drafter's trailing write-only step needs every layer's
+    K/V deposited but no logits."""
+    d, h = cfg.d_model, cfg.n_head
+    dh = d // h
+    for i in range(cfg.n_layer):
+        p = 'layer_%d' % i
+        ln1 = layers.layer_norm(
+            x, begin_norm_axis=1,
+            param_attr=ParamAttr(name=p + '.ln1.w'),
+            bias_attr=ParamAttr(name=p + '.ln1.b'))
+        qkv = layers.fc(ln1, size=3 * d,
+                        param_attr=ParamAttr(name=p + '.attn.qkv.w'),
+                        bias_attr=ParamAttr(name=p + '.attn.qkv.b'))
+        q, k, v = _qkv_split_step(qkv, cfg)                  # [S, H, dh]
+        cache_write(k, v, i)
+        if not head and i == cfg.n_layer - 1:
+            # write-only tower, last layer: nothing consumes x past
+            # this K/V deposit — attention/proj/ffn are dead compute
+            return None
+        ctx = attend(q, i, p + tag)
+        attn = layers.fc(layers.reshape(ctx, shape=[-1, d]), size=d,
+                         param_attr=ParamAttr(name=p + '.attn.proj.w'),
+                         bias_attr=ParamAttr(name=p + '.attn.proj.b'))
+        x = layers.elementwise_add(x, attn)
+        ln2 = layers.layer_norm(
+            x, begin_norm_axis=1,
+            param_attr=ParamAttr(name=p + '.ln2.w'),
+            bias_attr=ParamAttr(name=p + '.ln2.b'))
+        ff1 = layers.fc(ln2, size=cfg.d_ff, act='gelu',
+                        param_attr=ParamAttr(name=p + '.ffn1.w'),
+                        bias_attr=ParamAttr(name=p + '.ffn1.b'))
+        ff2 = layers.fc(ff1, size=d,
+                        param_attr=ParamAttr(name=p + '.ffn2.w'),
+                        bias_attr=ParamAttr(name=p + '.ffn2.b'))
+        x = layers.elementwise_add(x, ff2)
+
+    if not head:
+        return None
+    x = layers.layer_norm(x, begin_norm_axis=1,
+                          param_attr=ParamAttr(name='final_ln.w'),
+                          bias_attr=ParamAttr(name='final_ln.b'))
+    return layers.fc(x, size=cfg.vocab_size,
+                     param_attr=ParamAttr(name='lm_head.w'),
+                     bias_attr=False)                        # [S, V]
+
+
 def build_lm_decode_step(cfg, slots, max_len, block_size=None,
                          num_blocks=None):
     """Single-token decode step over ALL cache slots.
@@ -328,35 +395,26 @@ def build_lm_decode_step(cfg, slots, max_len, block_size=None,
     pe = layers.assign(position_encoding_table(max_len, d))
     x = layers.elementwise_add(x, layers.gather(pe, pos))
 
-    def cache_write(cache, new, layer):
-        if not paged:
-            return _cache_write(block, 'kv_cache_update', cache, new,
-                                pos, layer)
-        block.append_op(
-            type='kv_cache_update_paged',
-            inputs={'Cache': [cache], 'New': [new], 'Positions': [pos],
-                    'BlockTables': [btab]},
-            outputs={'Out': [cache]},
-            attrs={'layer': int(layer), 'block_size': int(block_size)})
-        return cache
+    def cache_write(k, v, layer):
+        for cache, new in ((kc, k), (vc, v)):
+            if not paged:
+                _cache_write(block, 'kv_cache_update', cache, new,
+                             pos, layer)
+                continue
+            block.append_op(
+                type='kv_cache_update_paged',
+                inputs={'Cache': [cache], 'New': [new],
+                        'Positions': [pos], 'BlockTables': [btab]},
+                outputs={'Out': [cache]},
+                attrs={'layer': int(layer),
+                       'block_size': int(block_size)})
 
-    for i in range(cfg.n_layer):
-        p = 'layer_%d' % i
-        ln1 = layers.layer_norm(
-            x, begin_norm_axis=1,
-            param_attr=ParamAttr(name=p + '.ln1.w'),
-            bias_attr=ParamAttr(name=p + '.ln1.b'))
-        qkv = layers.fc(ln1, size=3 * d,
-                        param_attr=ParamAttr(name=p + '.attn.qkv.w'),
-                        bias_attr=ParamAttr(name=p + '.attn.qkv.b'))
-        q, k, v = _qkv_split_step(qkv, cfg)                  # [S, H, dh]
-        kc = cache_write(kc, k, i)
-        vc = cache_write(vc, v, i)
-        ctx = block.create_var(name=p + '.kv_ctx',
+    def attend(q, layer, name):
+        ctx = block.create_var(name=name + '.kv_ctx',
                                shape=(-1, h, dh), dtype='float32')
         attn_inputs = {'Q': [q], 'KCache': [kc], 'VCache': [vc],
                        'Positions': [pos]}
-        attn_attrs = {'layer': i, 'scale': dh ** -0.5}
+        attn_attrs = {'layer': layer, 'scale': dh ** -0.5}
         if paged:
             attn_inputs['BlockTables'] = [btab]
             attn_attrs['block_size'] = int(block_size)
@@ -366,32 +424,203 @@ def build_lm_decode_step(cfg, slots, max_len, block_size=None,
             inputs=attn_inputs,
             outputs={'Out': [ctx]},
             attrs=attn_attrs)
-        attn = layers.fc(layers.reshape(ctx, shape=[-1, d]), size=d,
-                         param_attr=ParamAttr(name=p + '.attn.proj.w'),
-                         bias_attr=ParamAttr(name=p + '.attn.proj.b'))
-        x = layers.elementwise_add(x, attn)
-        ln2 = layers.layer_norm(
-            x, begin_norm_axis=1,
-            param_attr=ParamAttr(name=p + '.ln2.w'),
-            bias_attr=ParamAttr(name=p + '.ln2.b'))
-        ff1 = layers.fc(ln2, size=cfg.d_ff, act='gelu',
-                        param_attr=ParamAttr(name=p + '.ffn1.w'),
-                        bias_attr=ParamAttr(name=p + '.ffn1.b'))
-        ff2 = layers.fc(ff1, size=d,
-                        param_attr=ParamAttr(name=p + '.ffn2.w'),
-                        bias_attr=ParamAttr(name=p + '.ffn2.b'))
-        x = layers.elementwise_add(x, ff2)
+        return ctx
 
-    x = layers.layer_norm(x, begin_norm_axis=1,
-                          param_attr=ParamAttr(name='final_ln.w'),
-                          bias_attr=ParamAttr(name='final_ln.b'))
-    logits = layers.fc(x, size=cfg.vocab_size,
-                       param_attr=ParamAttr(name='lm_head.w'),
-                       bias_attr=False)                      # [S, V]
+    logits = _decode_tower(cfg, x, cache_write, attend)      # [S, V]
     next_tokens = _append_sample_op(block, logits, sample_vars,
                                     'gen_next_tokens')       # [S]
     return {'tokens': tokens, 'pos': pos, 'logits': logits,
             'next_tokens': next_tokens, 'k_cache': kc, 'v_cache': vc}
+
+
+def build_lm_drafter(cfg, slots, max_len, spec_k, num_blocks, block_size):
+    """``spec_k`` greedy decode steps UNROLLED into one compiled program
+    — the draft leg of speculative decoding. Each unrolled step is the
+    same `_decode_tower` as the plain decode step, its argmax feeding
+    the next step's embedding IN-PROGRAM, so the K draft proposals cost
+    one host dispatch instead of K (the chip never waits on the host
+    between draft tokens).
+
+    Feeds: 'gen_tokens' [slots, 1] int64 (each slot's last accepted
+    token), 'gen_pos' [slots, 1] int64 (the position draft step 0
+    writes; step j writes pos + j), 'gen_btab'
+    [slots, max_len // block_size] int64 per-slot DRAFT block tables,
+    and 'gen_vmask' [slots, spec_k + 1] int64 (nonzero = step j's write
+    is budgeted; zero rows — idle slots, positions at or past max_len —
+    redirect to the trash block). Returns {'tokens', 'pos',
+    'block_table', 'vmask', 'draft_tokens' (list of spec_k [slots]
+    int64 vars), 'k_cache', 'v_cache'}.
+
+    The unroll is spec_k + 1 towers: the trailing step is WRITE-ONLY
+    (``head=False`` — no logits), depositing the K-th draft token's own
+    K/V at position pos + spec_k. Without it, a fully-accepted round
+    (spec_k drafts + the target's bonus token) would leave a hole in
+    the draft cache at the bonus position and every later draft would
+    attend garbage there — the accept rate of a target-equal draft
+    would silently drop from 1.0.
+
+    Drafting is greedy by construction (argmax — the same
+    ``jnp.argmax`` the sample op's temperature-0 branch takes): a draft
+    is a PROPOSAL, the target's verify step decides every emitted
+    token, so draft sampling would only lower the accept rate."""
+    d, h = cfg.d_model, cfg.n_head
+    dh = d // h
+    mb = max_len // block_size
+    tokens = layers.data(name='gen_tokens', shape=[1], dtype='int64')
+    pos = layers.data(name='gen_pos', shape=[1], dtype='int64')
+    btab = layers.data(name='gen_btab', shape=[mb], dtype='int64')
+    vmask = layers.data(name='gen_vmask', shape=[spec_k + 1],
+                        dtype='int64')
+    block = tokens.block
+    kc, vc = _declare_paged_kv_caches(block, cfg, num_blocks, block_size)
+    pe = layers.assign(position_encoding_table(max_len, d))
+
+    drafts = []
+    tok = tokens                                 # [S, 1] feed; then [S]
+    for j in range(spec_k + 1):
+        if j == 0:
+            pos_j = pos
+        else:
+            pos_j = layers.elementwise_add(
+                pos, layers.fill_constant(shape=[1], dtype='int64',
+                                          value=j))
+        valid_j = layers.slice(vmask, axes=[1], starts=[j], ends=[j + 1])
+        x = layers.embedding(
+            tok, size=[cfg.vocab_size, d], dtype='float32',
+            param_attr=ParamAttr(name='tok_emb.w'))          # [S, d]
+        # jnp gather clips out-of-bounds rows, so a capped slot's
+        # pos >= max_len reads the last PE row — its output is garbage
+        # the host never accepts, and its cache write is vmask-trashed
+        x = layers.elementwise_add(x, layers.gather(pe, pos_j))
+
+        def cache_write(k, v, layer, _pos=pos_j, _valid=valid_j):
+            for cache, new in ((kc, k), (vc, v)):
+                block.append_op(
+                    type='kv_cache_update_paged',
+                    inputs={'Cache': [cache], 'New': [new],
+                            'Positions': [_pos], 'BlockTables': [btab],
+                            'Valid': [_valid]},
+                    outputs={'Out': [cache]},
+                    attrs={'layer': int(layer),
+                           'block_size': int(block_size)})
+
+        def attend(q, layer, name, _pos=pos_j):
+            ctx = block.create_var(name=name + '.kv_ctx',
+                                   shape=(-1, h, dh), dtype='float32')
+            block.append_op(
+                type='kv_decode_attention_paged',
+                inputs={'Q': [q], 'KCache': [kc], 'VCache': [vc],
+                        'Positions': [_pos], 'BlockTables': [btab]},
+                outputs={'Out': [ctx]},
+                attrs={'layer': layer, 'scale': dh ** -0.5,
+                       'block_size': int(block_size)})
+            return ctx
+
+        logits = _decode_tower(cfg, x, cache_write, attend,
+                               tag='.draft%d' % j,
+                               head=j < spec_k)              # [S, V]
+        if j < spec_k:
+            tok = layers.argmax(logits, axis=1)              # [S] int64
+            drafts.append(tok)
+    # ONE [S, spec_k] fetch: K separate fetches would cost K host
+    # syncs per round (syscall-priced in this sandbox)
+    cat = layers.concat([layers.reshape(t, shape=[-1, 1])
+                         for t in drafts], axis=1)
+    return {'tokens': tokens, 'pos': pos, 'block_table': btab,
+            'vmask': vmask, 'draft_tokens': cat,
+            'k_cache': kc, 'v_cache': vc}
+
+
+def build_lm_verify(cfg, slots, width, max_len, num_blocks, block_size):
+    """Target-model VERIFY step: score ``width = spec_k + 1`` positions
+    of every slot in ONE batched dispatch — the wide sibling of the
+    decode step that converts K sequential target steps into one.
+
+    Row t of slot s carries the token at global position
+    ``gen_pos[s, t]`` (row 0 = the slot's last accepted token, rows
+    1..K = the draft proposals). Every row's K/V is deposited through
+    the slot's block table first (`kv_cache_update_span_paged`,
+    vmask-guarded), then each row attends the cached history plus the
+    window rows at or before it (`kv_verify_attention_paged`) — so row
+    t's logits are IDENTICAL to what the plain decode step would have
+    produced at that position, and the greedy argmax over them is the
+    bitwise acceptance oracle: tokens are emitted exactly as
+    non-speculative greedy decode would have emitted them, speculation
+    only changes how many land per dispatch.
+
+    The program IS the decode tower: the (slot, window-row) pairs
+    flatten onto the tower's row axis ([slots * width, d]) and run the
+    SAME `_decode_tower` body as the plain decode step and the drafter
+    — only the cache write (span variant) and attention (per-row
+    position masks) closures differ, so the acceptance oracle can
+    never numerically drift from the step program it stands in for.
+
+    Feeds: 'gen_tokens' [slots, width] int64, 'gen_pos' [slots, width]
+    int64 (host-clipped to max_len - 1), 'gen_btab'
+    [slots, max_len // block_size] int64, 'gen_vmask' [slots, width]
+    int64. Returns {'tokens', 'pos', 'block_table', 'vmask', 'logits'
+    ([slots * width, vocab], row-major), 'verify_tokens'
+    ([slots * width] int64, row-major), 'k_cache', 'v_cache'}."""
+    d, h = cfg.d_model, cfg.n_head
+    dh = d // h
+    W = int(width)
+    if W < 2:
+        raise ValueError("verify width must be >= 2 (spec_k >= 1), "
+                         "got %d" % W)
+    mb = max_len // block_size
+    tokens = layers.data(name='gen_tokens', shape=[W], dtype='int64')
+    pos = layers.data(name='gen_pos', shape=[W], dtype='int64')
+    btab = layers.data(name='gen_btab', shape=[mb], dtype='int64')
+    vmask = layers.data(name='gen_vmask', shape=[W], dtype='int64')
+    block = tokens.block
+    kc, vc = _declare_paged_kv_caches(block, cfg, num_blocks, block_size)
+
+    flat = layers.reshape(tokens, shape=[-1])                # [S*W]
+    x = layers.embedding(
+        flat, size=[cfg.vocab_size, d], dtype='float32',
+        param_attr=ParamAttr(name='tok_emb.w'))              # [S*W, d]
+    pe = layers.assign(position_encoding_table(max_len, d))
+    x = layers.elementwise_add(x, layers.gather(pe, pos))
+
+    def cache_write(k, v, layer):
+        # tower rows [S*W, H, dh] -> the span op's [S, H, W, dh]
+        for cache, new in ((kc, k), (vc, v)):
+            rows = layers.transpose(
+                layers.reshape(new, shape=[-1, W, h, dh]),
+                perm=[0, 2, 1, 3])
+            block.append_op(
+                type='kv_cache_update_span_paged',
+                inputs={'Cache': [cache], 'New': [rows],
+                        'Positions': [pos], 'BlockTables': [btab],
+                        'Valid': [vmask]},
+                outputs={'Out': [cache]},
+                attrs={'layer': int(layer),
+                       'block_size': int(block_size)})
+
+    def attend(q, layer, name):
+        qw = layers.transpose(layers.reshape(q, shape=[-1, W, h, dh]),
+                              perm=[0, 2, 1, 3])             # [S,H,W,dh]
+        ctx = block.create_var(name=name + '.verify_attn_out',
+                               shape=(-1, h, W, dh), dtype='float32')
+        block.append_op(
+            type='kv_verify_attention_paged',
+            inputs={'Q': [qw], 'KCache': [kc], 'VCache': [vc],
+                    'Positions': [pos], 'BlockTables': [btab]},
+            outputs={'Out': [ctx]},
+            attrs={'layer': layer, 'scale': dh ** -0.5,
+                   'block_size': int(block_size)})
+        # [S, W, H, dh]: the tower's reshape([-1, d]) then folds the
+        # heads back into row order (s, w)
+        return layers.transpose(ctx, perm=[0, 2, 1, 3])
+
+    logits = _decode_tower(cfg, x, cache_write, attend,
+                           tag='.verify')                    # [S*W, V]
+    # the same jnp.argmax the sample op's temperature-0 branch takes —
+    # greedy acceptance is bitwise against the plain decode step
+    nxt = layers.argmax(logits, axis=1)                      # [S*W]
+    return {'tokens': tokens, 'pos': pos, 'block_table': btab,
+            'vmask': vmask, 'logits': logits, 'verify_tokens': nxt,
+            'k_cache': kc, 'v_cache': vc}
 
 
 def build_lm_prefill(cfg, prompt_len, slots, max_len):

@@ -59,6 +59,33 @@ engine owns one PRNG stream per request), so the op is deterministic,
 ``needs_rng``-free (bind's single-PRNGKey fast path still applies), and
 ``temperature == 0`` rows take the bitwise argmax branch — greedy stays
 the bitwise default.
+
+SPECULATIVE-DECODE ops (PR 13) widen the per-slot decode step from one
+token to a window of ``W = spec_k + 1`` tokens so a target model can
+VERIFY a draft model's K proposals in one batched dispatch:
+
+- ``kv_cache_update_span_paged``: every slot deposits W new K (or V)
+  rows at its own W positions through its block table — the wide
+  sibling of ``kv_cache_update_paged``. A per-row ``Valid`` feed
+  redirects rows the host has not budgeted (idle slots, positions at or
+  past ``max_len``, positions past the slot's allocated blocks) to the
+  trash block: a speculative write may be THROWN AWAY later, but it
+  must never be able to scribble a live block it doesn't own.
+- ``kv_verify_attention_paged``: W-query attention of every slot
+  against its block-table cache, each query row (s, t) masked to
+  positions ``<= Positions[s, t]`` — row t attends the cached history
+  plus the window rows at or before it (deposited by the span write
+  just above), exactly the causal view the plain decode step would have
+  had at that position. The exact-zero post-softmax mask keeps the
+  bitwise contract: verify logits for position p equal the plain
+  decode step's logits at p, which is what lets the engine accept draft
+  tokens with NO numeric drift from non-speculative greedy decode.
+
+Speculative ROLLBACK needs no op at all: rejected rows sit at positions
+strictly past the slot's accepted write head, where the position mask
+already zeroes them, and the engine returns their tail blocks to the
+allocator (serving/generate.py) — the block table is the rollback
+mechanism, no cache bytes are copied or cleared.
 """
 import jax
 import jax.numpy as jnp
@@ -160,18 +187,96 @@ def _kv_cache_prefill_paged(ctx, op):
 def _kv_cache_update_paged(ctx, op):
     """Cache[tables[s][Positions[s]//bs], layer, :, Positions[s]%bs, :]
     = New[s] for every slot s. Idle slots feed position 0 against an
-    all-zero table row, so their garbage row lands in the trash block."""
+    all-zero table row, so their garbage row lands in the trash block.
+    An optional per-slot ``Valid`` input ([S] or [S, 1]; nonzero = keep)
+    redirects invalid rows to the trash block explicitly — the drafter's
+    unrolled steps use it for positions at or past ``max_len``, where
+    the clipped table lookup would otherwise target a LIVE block."""
     cache = ctx.in1(op, 'Cache')                # [NB, Ln, H, bs, dh]
     new = ctx.in1(op, 'New')                    # [S, H, dh]
     tables = ctx.in1(op, 'BlockTables').astype(jnp.int32)  # [S, MB]
     pos = ctx.in1(op, 'Positions').reshape(-1).astype(jnp.int32)
+    valid = ctx.in1(op, 'Valid')                # optional [S]/[S, 1]
     layer = int(op.attr('layer'))
     bs = int(op.attr('block_size'))
     idx = jnp.clip(pos // bs, 0, tables.shape[1] - 1)
     blk = jnp.take_along_axis(tables, idx[:, None], axis=1)[:, 0]
     off = (pos % bs).astype(jnp.int32)
+    if valid is not None:
+        keep = valid.reshape(-1) != 0
+        blk = jnp.where(keep, blk, 0)
+        off = jnp.where(keep, off, 0)
     out = cache.at[blk, layer, :, off, :].set(new.astype(cache.dtype))
     ctx.out(op, 'Out', out)
+
+
+@register_op('kv_cache_update_span_paged', share_lod=False)
+def _kv_cache_update_span_paged(ctx, op):
+    """Wide decode-step write: every slot deposits W rows —
+    Cache[tables[s][Positions[s,t]//bs], layer, :, Positions[s,t]%bs, :]
+    = New[s, :, t, :] for t < W. Rows with ``Valid[s, t] == 0`` (idle
+    slots, positions past max_len or past the slot's allocated blocks)
+    are redirected to the trash block: a speculative row may later be
+    rolled back, but it must never be able to touch a live block the
+    slot doesn't own."""
+    cache = ctx.in1(op, 'Cache')                # [NB, Ln, H, bs, dh]
+    new = ctx.in1(op, 'New')                    # [S, H, W, dh]
+    tables = ctx.in1(op, 'BlockTables').astype(jnp.int32)  # [S, MB]
+    pos = ctx.in1(op, 'Positions').astype(jnp.int32)       # [S, W]
+    valid = ctx.in1(op, 'Valid')                # [S, W]
+    layer = int(op.attr('layer'))
+    bs = int(op.attr('block_size'))
+    idx = jnp.clip(pos // bs, 0, tables.shape[1] - 1)
+    blk = jnp.take_along_axis(tables, idx, axis=1)         # [S, W]
+    off = (pos % bs).astype(jnp.int32)
+    keep = valid.astype(jnp.int32) != 0
+    blk = jnp.where(keep, blk, 0)
+    off = jnp.where(keep, off, 0)
+    rows = jnp.transpose(new, (0, 2, 1, 3)).astype(cache.dtype)  # [S,W,H,dh]
+    S, W = pos.shape
+    out = cache.at[blk.reshape(-1), layer, :, off.reshape(-1), :].set(
+        rows.reshape(S * W, rows.shape[2], rows.shape[3]))
+    ctx.out(op, 'Out', out)
+
+
+@register_op('kv_verify_attention_paged', share_lod=False)
+def _kv_verify_attention_paged(ctx, op):
+    """W-query attention per slot over its block-table-gathered K/V:
+    query row (s, t) sits at global position Positions[s, t] and attends
+    every cached position <= Positions[s, t] — the slot's accepted
+    history plus the verify window's own rows at or before t (the span
+    write above deposited them). Per-row masking makes each row's
+    output IDENTICAL to what the single-query decode attention would
+    compute at that position, which is the bitwise foundation of
+    speculative acceptance; masked (stale / trash / rolled-back) rows
+    contribute exact 0."""
+    q = ctx.in1(op, 'Q')                        # [S, H, W, dh]
+    kc = ctx.in1(op, 'KCache')                  # [NB, Ln, H, bs, dh]
+    vc = ctx.in1(op, 'VCache')
+    tables = ctx.in1(op, 'BlockTables').astype(jnp.int32)  # [S, MB]
+    pos = ctx.in1(op, 'Positions')              # [S, W]
+    layer = int(op.attr('layer'))
+    scale = op.attr('scale', 1.0)
+    bs = int(op.attr('block_size'))
+    S, MB = tables.shape
+    H, dh = kc.shape[2], kc.shape[4]
+
+    def gather(c):
+        # [S, MB, H, bs, dh] -> [S, H, MB*bs, dh] (logical position order)
+        g = c[:, layer][tables]
+        return jnp.transpose(g, (0, 2, 1, 3, 4)).reshape(S, H, MB * bs, dh)
+
+    k = gather(kc)
+    v = gather(vc)
+    scores = jnp.einsum('shtd,shmd->shtm', q, k,
+                        preferred_element_type=jnp.float32) * scale
+    m = jnp.arange(MB * bs)[None, None, None, :] <= \
+        pos[:, None, :, None]                   # [S, 1, W, M]
+    scores = jnp.where(m, scores, _NEG_INF)
+    w = jax.nn.softmax(scores, axis=-1)
+    w = jnp.where(m, w, 0.0)
+    ctx.out(op, 'Out',
+            jnp.einsum('shtm,shmd->shtd', w.astype(v.dtype), v))
 
 
 @register_op('kv_decode_attention_paged', share_lod=False)

@@ -55,6 +55,38 @@ bitwise greedy default, and the program count is unchanged —
 ``len(prompt_buckets) + 1`` fixed signatures, zero recompiles after
 warmup under any mixed paged traffic.
 
+SPECULATIVE DECODING (PR 13, ``GenerateConfig(speculative=True)``,
+paged engines only) breaks the one-token-per-dispatch decode ceiling:
+a DRAFT model (``draft_model``; default = the target config, so a
+seed-built engine drafts with the target's own weights — the
+100%-accept reference; an int8-converted or distilled small model is
+the production draft) proposes ``spec_k`` greedy tokens per slot in
+ONE dispatch (`build_lm_drafter` — the K steps are unrolled in-program,
+argmax feeding the next step's embedding on-device), then the target
+VERIFIES all proposals in one batched ``spec_k + 1``-wide step
+(`build_lm_verify`). Accepted tokens advance both caches; the first
+mismatch falls back to the target's own token — since every emitted
+token IS the target's argmax given the previously emitted tokens,
+greedy output is **bitwise identical** to non-speculative decode,
+speculation only changes how many tokens land per dispatch (up to
+``spec_k + 1``). Rejected rows roll back through the PAGED block
+table: their positions sit past the accepted write head (masked to
+exact zero by every later attention), and tail blocks holding no
+accepted position return to the allocator — no cache bytes are copied
+or cleared. The draft runs against its OWN scope (own parameters, own
+paged block pool sized ``slots * max_len / block_size``) so target and
+draft state never alias. Sampled requests co-resident on a speculative
+engine fall the whole batch back to plain steps for those rounds
+(``spec_fallback_total``) — speculation accelerates greedy traffic.
+
+CHUNKED PREFILL (same PR, paged engines): prompts longer than the
+widest bucket no longer reject at submit() — the prefill runs in
+bucket-sized chunks, each chunk attending the cached prefix through
+``kv_prefix_attention`` exactly like a shared-prefix suffix, so
+admission now reaches ``max_len - 1`` tokens with ZERO new compiled
+signatures and the continuation is bit-exact vs a single-shot prefill
+through a wider bucket.
+
 Monitor series: ``decode_tokens_total``, ``kv_slot_occupancy``,
 ``decode_step_seconds``, ``prefill_seconds``,
 ``generate_request_total{outcome=ok|error|shed|deadline|rejected|stopped}``,
@@ -64,8 +96,14 @@ accounting ``kv_blocks_in_use`` / ``kv_blocks_free`` gauges (these
 replace slot occupancy as the saturation signal — slots no longer bound
 memory) and the ``kv_block_cow_total``,
 ``kv_prefix_hit_total{outcome=hit|miss}`` and
-``kv_prefix_tokens_saved_total`` counters. Full catalog:
-docs/observability.md; tuning guide: docs/serving.md.
+``kv_prefix_tokens_saved_total`` counters. Speculative engines add
+``spec_propose_total`` / ``spec_accept_total`` /
+``spec_fallback_total`` counters, ``spec_draft_seconds`` /
+``spec_verify_seconds`` histograms, per-request ``draft`` / ``verify``
+trace stages (sub-stages of the decode wall — tools/tracereport.py
+breaks them out per kind) and a ``spec_accept_rate`` field in the
+request timing. Full catalog: docs/observability.md; tuning guide:
+docs/serving.md.
 """
 import queue as _pyqueue
 import threading
@@ -155,6 +193,17 @@ class GenerateConfig(object):
       un-cached suffix, not its prompt.
     - temperature / top_k / top_p: engine-wide sampling defaults applied
       when submit() passes none. 0 / 0 / 0 = bitwise greedy.
+    - speculative / spec_k / draft_model: speculative decoding (paged
+      engines only). A draft LM proposes `spec_k` greedy tokens per
+      decode round in one dispatch and the target verifies all of them
+      in one `spec_k + 1`-wide batched step — greedy output stays
+      bitwise identical to non-speculative decode, up to spec_k + 1
+      tokens land per round. `draft_model` is the draft's LMConfig
+      (must share the target's vocab); None drafts with the target
+      config itself (a seed-built engine then drafts with identical
+      weights — the 100%-accept reference; pass a smaller config, or an
+      int8-converted variant's scope via GenerateEngine(draft_scope=),
+      for a cheap production draft).
     """
 
     def __init__(self, model=None, slots=8, max_len=256,
@@ -163,7 +212,8 @@ class GenerateConfig(object):
                  seed=0, metrics_port=None, idle_poll_s=0.02,
                  paged=False, block_size=16, num_blocks=None,
                  prefix_sharing=True, temperature=0.0, top_k=0,
-                 top_p=0.0):
+                 top_p=0.0, speculative=False, spec_k=4,
+                 draft_model=None):
         self.model = model or LMConfig()
         self.slots = int(slots)
         self.max_len = int(max_len)
@@ -194,6 +244,23 @@ class GenerateConfig(object):
         self.temperature = float(temperature)
         self.top_k = int(top_k)
         self.top_p = float(top_p)
+        self.speculative = bool(speculative)
+        self.spec_k = int(spec_k)
+        self.draft_model = draft_model
+        if self.speculative:
+            if not self.paged:
+                raise ValueError(
+                    "speculative decoding rides the paged KV engine "
+                    "(rollback is block-table truncation) — pass "
+                    "paged=True")
+            if self.spec_k < 1:
+                raise ValueError("spec_k must be >= 1")
+            if draft_model is not None and \
+                    draft_model.vocab_size != self.model.vocab_size:
+                raise ValueError(
+                    "draft_model.vocab_size (%d) must equal the target's "
+                    "(%d) — draft proposals are target token ids"
+                    % (draft_model.vocab_size, self.model.vocab_size))
         if prompt_buckets is None:
             prompt_buckets, b = [], 16
             while b <= self.max_len // 2:
@@ -230,7 +297,7 @@ class GenerateRequest(Request):
 
     __slots__ = ('prompt', 'max_new_tokens', 'tokens', 'finish_reason',
                  'step_s', '_stream_q', 'temperature', 'top_k', 'top_p',
-                 'sample_seed', '_rng')
+                 'sample_seed', '_rng', 'spec_proposed', 'spec_accepted')
 
     def __init__(self, prompt, seq_len, bucket, deadline, max_new_tokens,
                  temperature=0.0, top_k=0, top_p=0.0, sample_seed=None):
@@ -247,6 +314,8 @@ class GenerateRequest(Request):
         self.top_p = float(top_p)
         self.sample_seed = sample_seed
         self._rng = None
+        self.spec_proposed = 0  # draft tokens proposed for this request
+        self.spec_accepted = 0  # ... that became emitted tokens
 
     def _draw_u(self):
         """Next uniform of this request's OWN sampling stream: one host
@@ -276,6 +345,11 @@ class GenerateRequest(Request):
                 srt = sorted(self.step_s)
                 t['step_s_mean'] = sum(srt) / len(srt)
                 t['step_s_p99'] = srt[monitor._rank_idx(0.99, len(srt))]
+            if self.spec_proposed:
+                t['spec_proposed'] = self.spec_proposed
+                t['spec_accepted'] = self.spec_accepted
+                t['spec_accept_rate'] = round(
+                    self.spec_accepted / float(self.spec_proposed), 4)
             self.timing = t
         Request.done(self, GenerateResult(self.tokens,
                                           finish_reason=reason,
@@ -312,9 +386,10 @@ class GenerateRequest(Request):
 
 class _Slot(object):
     __slots__ = ('req', 'pos', 'generated', 'last', 'last_t', 'wall0',
-                 'blocks', 'table')
+                 'blocks', 'table', 'dblocks', 'dtable')
 
-    def __init__(self, req, pos, last, blocks=None, table=None):
+    def __init__(self, req, pos, last, blocks=None, table=None,
+                 dblocks=None, dtable=None):
         self.req = req
         self.pos = pos          # cache position the NEXT step writes
         self.generated = 1      # prefill already emitted the first token
@@ -323,6 +398,8 @@ class _Slot(object):
         self.wall0 = time.time() * 1e6      # decode-phase start (us)
         self.blocks = blocks    # paged: physical block ids, table order
         self.table = table      # paged: np [max_blocks] int64, filler 0
+        self.dblocks = dblocks  # speculative: DRAFT-pool block ids
+        self.dtable = dtable    # speculative: draft block table
 
 
 class GenerateEngine(object):
@@ -343,7 +420,7 @@ class GenerateEngine(object):
     ``config.seed``.
     """
 
-    def __init__(self, config=None, scope=None):
+    def __init__(self, config=None, scope=None, draft_scope=None):
         self.config = config or GenerateConfig()
         self.scope = scope if scope is not None else Scope()
         self.executor = Executor(TPUPlace(0))
@@ -354,9 +431,33 @@ class GenerateEngine(object):
                 if c.prefix_sharing else None
             self._max_blocks = c.max_len // c.block_size
             self._cow_jit = None
+            self._dcopy_jit = None
         else:
             self._alloc = None
             self._prefix = None
+        if c.speculative:
+            self._draft_cfg = c.draft_model or c.model
+            # +1 over the all-slots-at-max_len footprint (the trash
+            # block), so per-slot draft growth can never starve — the
+            # draft pool needs no eviction or parking machinery
+            self._draft_nb = c.slots * c.max_len // c.block_size + 1
+            self._draft_alloc = BlockAllocator(self._draft_nb,
+                                               c.block_size)
+            self._draft_scope = draft_scope if draft_scope is not None \
+                else Scope()
+            # fresh draft scope + default draft config: alias the
+            # TARGET's parameters (draft == target weights even for a
+            # trained scope — the high-accept reference); a distinct
+            # draft_model initializes from config.seed instead, and a
+            # provided draft_scope serves its own (e.g. int8/distilled)
+            # weights as-is
+            self._draft_copies_target = draft_scope is None and \
+                c.draft_model is None
+        else:
+            self._draft_cfg = None
+            self._draft_alloc = None
+            self._draft_scope = None
+            self._draft_copies_target = False
         self._build_programs()
         self._init_state()
         self.queue = RequestQueue(self.config.queue_cap)
@@ -364,7 +465,10 @@ class GenerateEngine(object):
         self._free = list(range(self.config.slots))[::-1]
         self._pending_admit = None   # popped but awaiting free blocks
         self._prefill_bound = {}
+        self._draft_prefill_bound = {}
         self._step_bound = None
+        self._drafter_bound = None
+        self._verify_bound = None
         self._thread = None
         self._started = False
         self._stop_evt = threading.Event()
@@ -376,6 +480,10 @@ class GenerateEngine(object):
         self._occ_peak = 0.0
         self._active_peak = 0
         self._blocks_peak = 0
+        self._spec_rounds = 0
+        self._spec_proposed = 0
+        self._spec_accepted = 0
+        self._spec_fallbacks = 0
         monitor.set_gauge('kv_slot_occupancy', 0.0)
         monitor.set_gauge('generate_queue_depth', 0.0)
         if c.paged:
@@ -407,6 +515,39 @@ class GenerateEngine(object):
                     else:
                         v = build_lm_prefill(cfg, b, c.slots, c.max_len)
             self._prefill[b] = (main, v)
+        if c.speculative:
+            from ..models.transformer import (build_lm_drafter,
+                                              build_lm_verify)
+            dcfg = self._draft_cfg
+            self._drafter_prog = Program()
+            self._draft_startup = Program()
+            self._drafter_prog.random_seed = c.seed
+            self._draft_startup.random_seed = c.seed
+            with program_guard(self._drafter_prog, self._draft_startup):
+                with unique_name.guard():
+                    self._drafter_vars = build_lm_drafter(
+                        dcfg, c.slots, c.max_len, c.spec_k,
+                        self._draft_nb, c.block_size)
+            self._verify_prog = Program()
+            self._verify_prog.random_seed = c.seed
+            with program_guard(self._verify_prog, Program()):
+                with unique_name.guard():
+                    self._verify_vars = build_lm_verify(
+                        cfg, c.slots, c.spec_k + 1, c.max_len,
+                        c.num_blocks, c.block_size)
+            self._draft_prefill = {}
+            if not self._draft_copies_target:
+                # a distinct draft prefills for real; the target-copy
+                # fast path block-copies instead and never runs these
+                for b in c.prompt_buckets:
+                    main, start = Program(), Program()
+                    main.random_seed = c.seed
+                    with program_guard(main, start):
+                        with unique_name.guard():
+                            v = build_lm_prefill_paged(
+                                dcfg, b, self._draft_nb, c.block_size,
+                                self._max_blocks)
+                    self._draft_prefill[b] = (main, v)
 
     def _init_state(self):
         import jax.numpy as jnp
@@ -416,6 +557,18 @@ class GenerateEngine(object):
                 # fresh engine: init params from config.seed; a provided
                 # scope with trained weights skips this entirely
                 self.executor.run(self._startup, scope=self.scope)
+        if c.speculative and not self._draft_scope.has('tok_emb.w'):
+            if self._draft_copies_target:
+                # alias the target's parameter arrays (jax arrays are
+                # immutable — zero-copy); the caches are NOT copied,
+                # _ensure_cache gives the draft scope its own pool
+                for name in self.scope.names():
+                    if name not in (KV_CACHE_K, KV_CACHE_V):
+                        self._draft_scope.set(name, self.scope.get(name))
+            else:
+                with scope_guard(self._draft_scope):
+                    self.executor.run(self._draft_startup,
+                                      scope=self._draft_scope)
         self._ensure_cache()
 
     def _ensure_cache(self):
@@ -440,6 +593,16 @@ class GenerateEngine(object):
         if have is None or tuple(have.shape) != shape:
             self.scope.set(KV_CACHE_K, jnp.zeros(shape, 'float32'))
             self.scope.set(KV_CACHE_V, jnp.zeros(shape, 'float32'))
+        if c.speculative:
+            dcfg = self._draft_cfg
+            dshape = (self._draft_nb, dcfg.n_layer, dcfg.n_head,
+                      c.block_size, dcfg.d_model // dcfg.n_head)
+            dhave = self._draft_scope.get(KV_CACHE_K)
+            if dhave is None or tuple(dhave.shape) != dshape:
+                self._draft_scope.set(KV_CACHE_K,
+                                      jnp.zeros(dshape, 'float32'))
+                self._draft_scope.set(KV_CACHE_V,
+                                      jnp.zeros(dshape, 'float32'))
 
     # ------------------------------------------------------------------
     # paged helpers
@@ -470,6 +633,30 @@ class GenerateEngine(object):
                                            self._step_prog, cache=False),
                 s, d))
 
+    def _draft_cache_sync(self, dblocks, blocks):
+        """Draft == target fast path: the draft prefill would recompute
+        EXACTLY the K/V rows the target prefill just wrote (same
+        config, aliased weights, same inputs), so copy the target's
+        prompt blocks across pools device-side instead — one jitted
+        scatter replaces a whole prefill forward. Fixed-width id
+        vectors (trash-padded) keep it one compiled signature."""
+        import jax
+        if self._dcopy_jit is None:
+            def _copy(dst, src, d_ids, s_ids):
+                return dst.at[d_ids].set(src[s_ids])
+            self._dcopy_jit = jax.jit(_copy)
+        d_ids = np.zeros((self._max_blocks,), 'int32')
+        s_ids = np.zeros((self._max_blocks,), 'int32')
+        d_ids[:len(dblocks)] = dblocks
+        s_ids[:len(blocks)] = blocks
+        for name in (KV_CACHE_K, KV_CACHE_V):
+            dst = self.executor._state_value(
+                self._draft_scope, name, self._drafter_prog, cache=False)
+            src = self.executor._state_value(
+                self.scope, name, self._step_prog, cache=False)
+            self._draft_scope.set(name,
+                                  self._dcopy_jit(dst, src, d_ids, s_ids))
+
     def _set_block_gauges(self):
         used = self._alloc.in_use()
         self._blocks_peak = max(self._blocks_peak, used)
@@ -488,13 +675,15 @@ class GenerateEngine(object):
         return ids
 
     def _deref_blocks(self, blocks):
-        for b in blocks:
-            self._alloc.deref(b)
+        self._alloc.deref_many(blocks)
         self._set_block_gauges()
 
     def _release_blocks(self, st):
         self._deref_blocks(st.blocks or [])
         st.blocks = []
+        if st.dblocks:
+            self._draft_alloc.deref_many(st.dblocks)
+            st.dblocks = []
 
     def _slot_table(self, blocks):
         table = np.zeros((self._max_blocks,), 'int64')
@@ -570,11 +759,17 @@ class GenerateEngine(object):
                 reused += 1
             else:
                 farm.commit(key)
+            if self.config.speculative:
+                reused += self._warm_spec(farm)
             if paged:
                 # compile the copy-on-write block copy now (0 -> 0 is a
                 # trash-block no-op) so steady traffic stays at zero
                 # compiles even when the first COW lands mid-stream
                 self._cow_copy(0, 0)
+                if self.config.speculative and self._draft_copies_target:
+                    # ... and the draft-pool prompt-block copy (same
+                    # trash-block no-op) for the draft==target fast path
+                    self._draft_cache_sync([0], [0])
         delta = monitor.counter_delta(before)
         compiles = sum(v for k, v in delta.items()
                        if k.startswith('compile_cache_miss'))
@@ -582,6 +777,70 @@ class GenerateEngine(object):
         return {'buckets': len(self._prefill_bound),
                 'compiles': int(compiles), 'reused': int(reused),
                 'seconds': round(time.perf_counter() - t0, 3)}
+
+    def _warm_spec(self, farm):
+        """Bind + compile the speculative signature set: one DRAFT
+        prefill per prompt bucket (against the draft scope), the
+        drafter (spec_k unrolled greedy steps) and the target's verify
+        step. All-zero block tables and vmasks route every warmup write
+        to the trash block of the respective pool. Returns how many
+        cells the warmup farm had already compiled."""
+        c = self.config
+        S, K = c.slots, c.spec_k
+        reused = 0
+        # draft == target: admissions block-copy the target's prompt
+        # rows across pools (_draft_cache_sync), so the draft prefill
+        # programs are never dispatched — don't pay their compiles
+        prefills = {} if self._draft_copies_target else \
+            self._draft_prefill
+        for b, (prog, v) in sorted(prefills.items()):
+            feed = {'gen_prompt': np.zeros((1, b), 'int64'),
+                    'gen_len': np.ones((1, 1), 'int64'),
+                    'gen_pos': np.zeros((1, b), 'int64'),
+                    'gen_btab': np.zeros((1, self._max_blocks), 'int64')}
+            feed.update(self._sample_feed(1))
+            key, already = farm.track(self.executor, prog, feed,
+                                      fetch_list=[v['first_token']],
+                                      scope=self._draft_scope)
+            self._draft_prefill_bound[b] = self.executor.bind(
+                prog, feed, fetch_list=[v['first_token']],
+                scope=self._draft_scope)
+            if already:
+                reused += 1
+            else:
+                farm.commit(key)
+        feed = {'gen_tokens': np.zeros((S, 1), 'int64'),
+                'gen_pos': np.zeros((S, 1), 'int64'),
+                'gen_btab': np.zeros((S, self._max_blocks), 'int64'),
+                'gen_vmask': np.zeros((S, K + 1), 'int64')}
+        fetches = [self._drafter_vars['draft_tokens']]
+        key, already = farm.track(self.executor, self._drafter_prog,
+                                  feed, fetch_list=fetches,
+                                  scope=self._draft_scope)
+        self._drafter_bound = self.executor.bind(
+            self._drafter_prog, feed, fetch_list=fetches,
+            scope=self._draft_scope)
+        if already:
+            reused += 1
+        else:
+            farm.commit(key)
+        feed = {'gen_tokens': np.zeros((S, K + 1), 'int64'),
+                'gen_pos': np.zeros((S, K + 1), 'int64'),
+                'gen_btab': np.zeros((S, self._max_blocks), 'int64'),
+                'gen_vmask': np.zeros((S, K + 1), 'int64')}
+        key, already = farm.track(
+            self.executor, self._verify_prog, feed,
+            fetch_list=[self._verify_vars['verify_tokens']],
+            scope=self.scope)
+        self._verify_bound = self.executor.bind(
+            self._verify_prog, feed,
+            fetch_list=[self._verify_vars['verify_tokens']],
+            scope=self.scope)
+        if already:
+            reused += 1
+        else:
+            farm.commit(key)
+        return reused
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -661,13 +920,24 @@ class GenerateEngine(object):
         co-resident; None draws a fresh unpredictable stream."""
         prompt = np.asarray(prompt, dtype='int64').reshape(-1)
         buckets = self.config.prompt_buckets
-        if prompt.size < 1 or prompt.size > buckets[-1]:
+        if self.config.paged:
+            # chunked prefill lifts admission past the bucket ladder:
+            # an over-wide prompt prefills in bucket-sized chunks, each
+            # attending the cached prefix — only the cache length bounds
+            # it (one row must remain for the first decode write)
+            limit = self.config.max_len - 1
+            limit_why = "max_len - 1 (chunked-prefill admission bound)"
+        else:
+            limit = buckets[-1]
+            limit_why = "largest prompt bucket — trim the prompt, " \
+                "widen prompt_buckets, or use paged=True (chunked " \
+                "prefill admits up to max_len - 1)"
+        if prompt.size < 1 or prompt.size > limit:
             monitor.inc('generate_request_total',
                         labels={'outcome': 'rejected'})
             raise ValueError(
-                "prompt length %d outside [1, %d] (largest prompt "
-                "bucket) — trim the prompt or widen prompt_buckets"
-                % (prompt.size, buckets[-1]))
+                "prompt length %d outside [1, %d] (%s)"
+                % (prompt.size, limit, limit_why))
         if max_new_tokens is None:
             max_new_tokens = self.config.max_new_tokens
         if int(max_new_tokens) < 1:
@@ -689,7 +959,8 @@ class GenerateEngine(object):
         deadline = (time.monotonic() + deadline_s
                     if deadline_s is not None else None)
         req = GenerateRequest(prompt, prompt.size,
-                              bucketize(prompt.size, buckets), deadline,
+                              bucketize(min(prompt.size, buckets[-1]),
+                                        buckets), deadline,
                               int(max_new_tokens),
                               temperature=temperature, top_k=top_k,
                               top_p=top_p, sample_seed=sample_seed)
@@ -819,6 +1090,15 @@ class GenerateEngine(object):
                 monitor.set_gauge('generate_queue_depth',
                                   self.queue.depth())
                 continue
+            if self._spec_ready():
+                self._spec_round()
+                continue
+            if self.config.speculative:
+                # a sampled resident pins the whole batch on plain
+                # steps this round — speculation accelerates greedy
+                # traffic (acceptance is an argmax identity)
+                monitor.inc('spec_fallback_total')
+                self._spec_fallbacks += 1
             pending = self._step_dispatch()
             if pending is not None:
                 # overlap: admit queued prompts (queue pops + prefill
@@ -941,15 +1221,35 @@ class GenerateEngine(object):
                                 qs * 1e6, tid=req._tid, trace=req.trace)
         t0 = time.perf_counter()
         pf_wall = time.time() * 1e6
+        dblocks, dtable = None, None
         try:
             first = self._run_prefill(
                 slot, req.prompt,
                 (req.temperature, req.top_k, req.top_p, req._draw_u()),
                 table=table, ctx_len=ctx_len)
+            if c.speculative:
+                # the draft tracks the request in its OWN pool: full
+                # prompt (no prefix cache — draft K/V are model-specific
+                # throwaways), chunked exactly like the target's. With
+                # draft == target the prompt rows are block-copied from
+                # the target pool instead of recomputed.
+                dblocks = self._draft_alloc.alloc(
+                    -(-req.prompt.size // c.block_size))
+                if dblocks is None:     # unreachable by pool sizing
+                    raise RuntimeError("draft KV pool exhausted")
+                dtable = self._slot_table(dblocks)
+                if self._draft_copies_target:
+                    self._draft_cache_sync(dblocks, blocks)
+                else:
+                    self._run_prefill(slot, req.prompt, table=dtable,
+                                      ctx_len=0,
+                                      bound=self._draft_prefill_bound)
         except Exception as e:  # noqa: BLE001 — delivered per-request
             self._free.append(slot)
             if blocks:
                 self._deref_blocks(blocks)
+            if dblocks:
+                self._draft_alloc.deref_many(dblocks)
             monitor.inc('generate_request_total',
                         labels={'outcome': 'error'})
             req.fail(e)
@@ -969,7 +1269,8 @@ class GenerateEngine(object):
         self._decode_tokens += 1
         req._emit(first)
         st = _Slot(req, pos=req.prompt.size, last=first,
-                   blocks=blocks, table=table)
+                   blocks=blocks, table=table,
+                   dblocks=dblocks, dtable=dtable)
         reason = self._finish_reason(st)
         if reason:
             if c.paged:
@@ -984,7 +1285,7 @@ class GenerateEngine(object):
         return True
 
     def _run_prefill(self, slot, prompt, sample=(0.0, 0, 0.0, 0.0),
-                     table=None, ctx_len=0):
+                     table=None, ctx_len=0, bound=None):
         c = self.config
         if table is None:
             b = bucketize(prompt.size, c.prompt_buckets)
@@ -993,29 +1294,68 @@ class GenerateEngine(object):
             feed = {'gen_prompt': padded,
                     'gen_slot': np.array([[slot]], 'int64'),
                     'gen_len': np.array([[prompt.size]], 'int64')}
-        else:
-            # paged: only the UN-CACHED suffix is computed; it buckets by
-            # suffix length — the prefill-compute saving of a prefix hit
-            suffix = prompt[ctx_len:]
-            b = bucketize(suffix.size, c.prompt_buckets)
-            padded = np.full((1, b), c.pad_id, 'int64')
-            padded[0, :suffix.size] = suffix
-            pos = np.clip(ctx_len + np.arange(b), 0, c.max_len - 1)
-            feed = {'gen_prompt': padded,
+            feed.update(self._sample_feed(1, *sample))
+            out = self._prefill_bound[b](feed)
+            return int(np.asarray(out[0]).reshape(-1)[0])
+        # paged: only the UN-CACHED suffix is computed; it buckets by
+        # suffix length — the prefill-compute saving of a prefix hit.
+        # A suffix wider than the widest bucket runs CHUNKED: each
+        # widest-bucket chunk deposits its K/V and attends the cached
+        # prefix (kv_prefix_attention), exactly like a shared-prefix
+        # suffix — same compiled signatures, any prompt length. Only
+        # the FINAL chunk's first-token output is the model's answer.
+        bound = bound if bound is not None else self._prefill_bound
+        wide = c.prompt_buckets[-1]
+        off = int(ctx_len)
+        suffix = prompt[off:]
+        while suffix.size > wide:
+            chunk, suffix = suffix[:wide], suffix[wide:]
+            pos = np.clip(off + np.arange(wide), 0, c.max_len - 1)
+            feed = {'gen_prompt': chunk[None],
                     'gen_pos': pos[None].astype('int64'),
                     'gen_btab': table[None],
-                    'gen_len': np.array([[suffix.size]], 'int64')}
+                    'gen_len': np.array([[wide]], 'int64')}
+            feed.update(self._sample_feed(1))
+            bound[wide](feed)       # K/V deposited; token output unused
+            off += wide
+        b = bucketize(suffix.size, c.prompt_buckets)
+        padded = np.full((1, b), c.pad_id, 'int64')
+        padded[0, :suffix.size] = suffix
+        pos = np.clip(off + np.arange(b), 0, c.max_len - 1)
+        feed = {'gen_prompt': padded,
+                'gen_pos': pos[None].astype('int64'),
+                'gen_btab': table[None],
+                'gen_len': np.array([[suffix.size]], 'int64')}
         feed.update(self._sample_feed(1, *sample))
-        out = self._prefill_bound[b](feed)
+        out = bound[b](feed)
         return int(np.asarray(out[0]).reshape(-1)[0])
 
     def _step(self):
         """One decode step, dispatch + completion back to back (the
         inline/debug path; the engine loop splits the two so admission
-        overlaps the device time)."""
+        overlaps the device time). On a speculative engine with an
+        all-greedy resident set this is one SPECULATIVE round."""
+        if self._spec_ready():
+            self._spec_round()
+            return
+        if self.config.speculative and \
+                any(s is not None for s in self._slots):
+            monitor.inc('spec_fallback_total')
+            self._spec_fallbacks += 1
         pending = self._step_dispatch()
         if pending is not None:
             self._step_complete(pending)
+
+    def _spec_ready(self):
+        """Speculate this round? Requires a speculative engine, at
+        least one resident, and every resident greedy (sampled rows
+        have no argmax-identity acceptance rule — they fall back to
+        plain steps)."""
+        if not self.config.speculative:
+            return False
+        active = [s for s in self._slots if s is not None]
+        return bool(active) and \
+            all(s.req.temperature <= 0.0 for s in active)
 
     def _grow_blocks(self):
         """Paged pre-step pass: any resident whose next write position
@@ -1039,6 +1379,238 @@ class GenerateEngine(object):
                 continue
             st.table[len(st.blocks)] = grown[0]
             st.blocks.append(grown[0])
+        self._set_occupancy()
+
+    # ------------------------------------------------------------------
+    # speculative decode
+    def _spec_grow(self, active):
+        """Pre-round block growth for speculation: per active slot,
+        extend the TARGET table to cover the verify window's write
+        positions (pos .. pos + spec_k, capped at max_len - 1) and the
+        DRAFT table to the SAME coverage — the drafter's trailing
+        write-only tower deposits position pos + spec_k too, and
+        trashing that row would silently drop a target-equal draft's
+        accept rate below 1.0.
+        Returns {slot_index: n_valid} — how many verify rows are fully
+        budgeted (cache coverage, max_len, AND the request's remaining
+        max_new_tokens: proposals past what the request may still emit
+        are never counted, so accept_rate measures draft QUALITY, not
+        budget clipping). Target tail blocks that
+        end up holding no accepted position are returned to the pool by
+        the post-verify truncation; a pool too dry to extend the tail
+        just shortens this round's window (n_valid >= 1 always — the
+        plain `_grow_blocks` already guaranteed the next write's
+        block), it never starves a request."""
+        c = self.config
+        bs = c.block_size
+        K = c.spec_k
+        n_valid = {}
+        for i, st in active:
+            want_last = min(st.pos + K, c.max_len - 1) // bs
+            while len(st.blocks) <= want_last:
+                grown = self._alloc_blocks(1)
+                if grown is None:
+                    break
+                st.table[len(st.blocks)] = grown[0]
+                st.blocks.append(grown[0])
+            covered = len(st.blocks) * bs - 1       # last writable pos
+            remaining = st.req.max_new_tokens - st.generated
+            n_valid[i] = max(1, min(K + 1, c.max_len - st.pos,
+                                    covered - st.pos + 1, remaining))
+            # draft coverage mirrors the target's: the trailing
+            # write-only draft step deposits position pos + K too
+            dwant_last = want_last
+            while len(st.dblocks) <= dwant_last:
+                grown = self._draft_alloc.alloc(1)
+                if grown is None:       # unreachable by pool sizing
+                    break
+                st.dtable[len(st.dblocks)] = grown[0]
+                st.dblocks.append(grown[0])
+        self._set_block_gauges()
+        return n_valid
+
+    def _spec_truncate(self, st):
+        """Roll back the speculative tail: blocks holding NO position
+        below the slot's accepted write head — and not needed for the
+        NEXT write either — return to their pools and their table
+        entries zero out (the trash block). No cache bytes move —
+        rejected rows sit past the write head where every attention
+        masks them to exact zero. Keeping the next-write block (not
+        just ceil(pos/bs)) matches the plain path's invariant that a
+        resident never releases the block its next token lands in:
+        when an accept ends exactly on a block boundary, freeing that
+        block would let a competing slot grab it and turn this
+        request's next growth into a premature 'cache_full'."""
+        bs = self.config.block_size
+        keep = min(self._max_blocks, st.pos // bs + 1)
+        while len(st.blocks) > keep:
+            b = st.blocks.pop()
+            st.table[len(st.blocks)] = 0
+            self._alloc.deref(b)
+        while len(st.dblocks) > keep:
+            b = st.dblocks.pop()
+            st.dtable[len(st.dblocks)] = 0
+            self._draft_alloc.deref(b)
+
+    def _spec_round(self):
+        """One speculative decode round over the resident (all-greedy)
+        slots: ONE drafter dispatch proposes spec_k tokens per slot
+        from the draft model's paged cache, ONE verify dispatch scores
+        all spec_k + 1 positions with the target, and the host accepts
+        the longest draft prefix the target agrees with plus the
+        target's own next token — every emitted token is the target's
+        argmax given the previously emitted tokens, so the output
+        stream is bitwise the non-speculative greedy stream. Rejected
+        rows roll back via block-table truncation."""
+        c = self.config
+        self._grow_blocks()     # plain growth (may starve -> cache_full)
+        active = [(i, st) for i, st in enumerate(self._slots)
+                  if st is not None]
+        if not active:
+            return
+        K, W, S, MB = c.spec_k, c.spec_k + 1, c.slots, self._max_blocks
+        n_valid = self._spec_grow(active)
+        if max(n_valid.values()) <= 1:
+            # every resident is one token from its budget/cache edge —
+            # nobody can consume a proposal, so a plain step is
+            # strictly cheaper than draft + verify this round
+            pending = self._step_dispatch()
+            if pending is not None:
+                self._step_complete(pending)
+            return
+
+        # --- draft: K unrolled greedy steps, one dispatch -------------
+        # (feed construction vectorized over the slot axis — this runs
+        # once per ~K+1 emitted tokens and must stay off the host
+        # critical path's per-token budget)
+        t0 = time.perf_counter()
+        wall0 = time.time() * 1e6
+        idx = np.array([i for i, _ in active])
+        lastv = np.array([st.last for _, st in active], 'int64')
+        posv = np.array([st.pos for _, st in active], 'int64')
+        toks = np.zeros((S, 1), 'int64')
+        pos = np.zeros((S, 1), 'int64')
+        dbtab = np.zeros((S, MB), 'int64')
+        vb = np.zeros((S, MB), 'int64')
+        toks[idx, 0] = lastv
+        pos[idx, 0] = posv
+        for i, st in active:
+            dbtab[i] = st.dtable
+            vb[i] = st.table
+        dlim = np.array([min(c.max_len, len(st.dblocks) * c.block_size)
+                         for _, st in active], 'int64')
+        dvm = np.zeros((S, K + 1), 'int64')
+        dvm[idx] = np.arange(K + 1)[None, :] < \
+            np.clip(dlim - posv, 0, K + 1)[:, None]
+        try:
+            douts = self._drafter_bound({
+                'gen_tokens': toks, 'gen_pos': pos, 'gen_btab': dbtab,
+                'gen_vmask': dvm})
+            drafts = np.asarray(douts[0]).reshape(S, K)
+        except Exception as e:  # noqa: BLE001 — delivered per-request
+            self._fail_step(active, e)
+            return
+        draft_s = time.perf_counter() - t0
+
+        # --- verify: one (K+1)-wide target step -----------------------
+        t1 = time.perf_counter()
+        vt = np.zeros((S, W), 'int64')
+        vp = np.zeros((S, W), 'int64')
+        vv = np.zeros((S, W), 'int64')
+        vt[idx, 0] = lastv
+        vt[idx, 1:] = drafts[idx]
+        vp[idx] = np.clip(posv[:, None] + np.arange(W)[None, :], 0,
+                          c.max_len - 1)
+        nvs = np.array([n_valid[i] for i, _ in active], 'int64')
+        vv[idx] = np.arange(W)[None, :] < nvs[:, None]
+        try:
+            out = self._verify_bound({
+                'gen_tokens': vt, 'gen_pos': vp, 'gen_btab': vb,
+                'gen_vmask': vv}, return_numpy=False)
+        except Exception as e:  # noqa: BLE001 — delivered per-request
+            self._fail_step(active, e)
+            return
+        # overlap: admit queued prompts while the verify computes
+        t_adm = time.perf_counter()
+        self._admit()
+        adm_s = time.perf_counter() - t_adm
+        try:
+            verdict = np.asarray(out[0]).reshape(S, W)
+        except Exception as e:  # noqa: BLE001 — delivered per-request
+            self._fail_step(active, e)
+            return
+        verify_s = max(0.0, time.perf_counter() - t1 - adm_s)
+        monitor.observe('spec_draft_seconds', draft_s)
+        monitor.observe('spec_verify_seconds', verify_s)
+        monitor.observe('decode_step_seconds', draft_s + verify_s)
+
+        # --- accept + rollback ----------------------------------------
+        now = time.perf_counter()
+        self._decode_steps += 1
+        round_proposed = round_accepted = emitted_total = 0
+        # longest draft prefix the target's argmax agrees with, per slot
+        agree = drafts[idx] == verdict[idx, :K]              # [n, K]
+        first_miss = np.argmax(~agree, axis=1)
+        runs = np.where(agree.all(axis=1), K, first_miss)
+        run_by_slot = dict(zip(idx.tolist(), runs.tolist()))
+        for i, st in active:
+            r = st.req
+            nv = n_valid[i]
+            proposed = nv - 1
+            m = 1 + min(run_by_slot[i], nv - 1)
+            m = min(m, r.max_new_tokens - st.generated)
+            emitted = [int(verdict[i, t]) for t in range(m)]
+            if c.eos_id is not None and c.eos_id in emitted:
+                emitted = emitted[:emitted.index(c.eos_id) + 1]
+                m = len(emitted)
+            accepted = max(0, m - 1)
+            round_proposed += proposed
+            round_accepted += accepted
+            r.spec_proposed += proposed
+            r.spec_accepted += accepted
+            st.pos += m
+            st.generated += m
+            st.last = emitted[-1]
+            self._spec_truncate(st)
+            dt = max(0.0, now - st.last_t)
+            st.last_t = now
+            if r.trace is not None:
+                # draft/verify are SUB-stages of the decode wall: the
+                # residual host time stays in decode_step so the stage
+                # sum still composes the request's end-to-end latency
+                r.trace.add_stage('draft', draft_s)
+                r.trace.add_stage('verify', verify_s)
+                r.trace.add_stage('decode_step',
+                                  max(0.0, dt - draft_s - verify_s))
+                monitor.record_span('request.draft', wall0,
+                                    draft_s * 1e6, trace=r.trace)
+                monitor.record_span('request.verify',
+                                    wall0 + draft_s * 1e6,
+                                    verify_s * 1e6, trace=r.trace)
+            per_tok = dt / m
+            for tok in emitted:
+                r.step_s.append(per_tok)
+                r._emit(tok)
+            emitted_total += m
+            reason = self._finish_reason(st)
+            if reason:
+                self._release(i)
+                monitor.inc('generate_request_total',
+                            labels={'outcome': 'ok'})
+                if r.trace is not None and r.trace.sampled and r.step_s:
+                    monitor.record_span('request.decode', st.wall0,
+                                        sum(r.step_s) * 1e6,
+                                        trace=r.trace)
+                r._finish(reason)
+        self._decode_tokens += emitted_total
+        monitor.inc('decode_tokens_total', emitted_total)
+        monitor.inc('spec_propose_total', round_proposed)
+        monitor.inc('spec_accept_total', round_accepted)
+        self._spec_rounds += 1
+        self._spec_proposed += round_proposed
+        self._spec_accepted += round_accepted
+        self._occ_sum += len(active) / float(c.slots)
+        self._set_block_gauges()
         self._set_occupancy()
 
     def _step_dispatch(self):
@@ -1218,5 +1790,17 @@ class GenerateEngine(object):
                 'peak_in_use': self._blocks_peak,
                 'prefix_entries': len(self._prefix)
                 if self._prefix is not None else 0,
+            }
+        if self.config.speculative:
+            prop = self._spec_proposed
+            out['spec'] = {
+                'k': self.config.spec_k,
+                'rounds': self._spec_rounds,
+                'fallback_rounds': self._spec_fallbacks,
+                'proposed': prop,
+                'accepted': self._spec_accepted,
+                'accept_rate': round(self._spec_accepted / float(prop), 4)
+                if prop else 0.0,
+                'draft_blocks_in_use': self._draft_alloc.in_use(),
             }
         return out

@@ -19,6 +19,16 @@ tables. Two host-side structures own the pool:
   are reclaimed lazily, LRU-deepest-first, only under allocation
   pressure.
 
+Speculative decoding (PR 13) rides the same accounting: the verify
+window's tail blocks are ordinary refcount-1 allocations, and ROLLBACK
+after a rejected draft is nothing but ``deref_many`` on the blocks past
+the accepted write head — the block table is the rollback mechanism, so
+a rejected speculation costs exactly the allocator bookkeeping of the
+blocks it briefly held. The draft model keeps a SECOND allocator over
+its own pool (sized ``slots * max_len / block_size`` + trash, so
+per-slot growth can never starve) with no prefix cache — draft K/V are
+model-specific throwaways.
+
 Sharing is at FULL-BLOCK granularity. Because a block's K/V rows depend
 only on tokens at or before them (causal), a block fully covered by
 prompt tokens is immutable once prefilled — the one exception is a
@@ -101,6 +111,15 @@ class BlockAllocator(object):
             self._free.append(bid)
             return True
         return False
+
+    def deref_many(self, bids):
+        """`deref` a batch (slot release, speculative-tail rollback);
+        returns how many blocks actually went back to the free list."""
+        freed = 0
+        for b in bids:
+            if self.deref(b):
+                freed += 1
+        return freed
 
 
 class PrefixCache(object):

@@ -36,7 +36,8 @@ def pytest_collection_modifyitems(config, items):
     # by design — the split is unobservable there, don't assert on it.
     if any('::' in a for a in config.args):
         return
-    for fname in ('test_generate.py', 'test_paged_generate.py'):
+    for fname in ('test_generate.py', 'test_paged_generate.py',
+                  'test_speculative.py'):
         gen = [it for it in items
                if os.path.basename(str(it.fspath)) == fname]
         if gen:

@@ -38,13 +38,27 @@ docs/serving.md) makes measurable promises about:
   proof (peak refcount on the system prompt's blocks, prefix-hit /
   tokens-saved counters) and the prefill-compute reduction (suffix
   bucketing: a hit prefills 8 tokens instead of 64).
+- speculative win (`measure_speculative`, `--speculative`): the same
+  decode-heavy greedy workload through the plain paged engine vs the
+  SPECULATIVE engine (draft proposes spec_k tokens in one dispatch,
+  target verifies spec_k + 1 positions in one batched step). Reports
+  spec-vs-plain engine tokens/sec (contract: >= 1.5x at a high-accept
+  draft on a quiet box), accept rate (1.0 at the default
+  draft = target), recompiles_after_warmup == 0, and exact greedy
+  parity. `--draft-config '{"n_layer": 1, ...}'` swaps in a custom
+  draft LMConfig (fresh-initialized — accept rate then measures that
+  draft's real agreement). The same row drives a LONG-PROMPT workload
+  (prompts past the widest bucket) exercising CHUNKED prefill, with a
+  bit-exactness check against a single-shot wide-bucket reference.
 
 Usage: python tools/servebench.py [rounds] (prints one JSON line);
        python tools/servebench.py --generate   (streaming-decode mode);
        python tools/servebench.py --shared-prefix [clients];
+       python tools/servebench.py --speculative [rounds]
+                                  [--draft-config JSON] [--spec-k K];
 importable `measure_serving()` / `measure_generate()` /
-`measure_shared_prefix()` (bench.py's 'serving' and 'generate' rows
-reuse them).
+`measure_shared_prefix()` / `measure_speculative()` (bench.py's
+'serving', 'generate' and 'generate_speculative' rows reuse them).
 """
 import json
 import os
@@ -593,8 +607,134 @@ def measure_shared_prefix(clients=8, system_len=48, suffix_len=8,
     }
 
 
+def measure_speculative(rounds=4, sentences=8, slots=8, spec_k=6,
+                        new_tokens=48, draft_config=None):
+    """Speculative-decode row: the same decode-heavy greedy workload
+    through the plain paged engine and the speculative engine, best-of
+    `rounds` minima on both sides (interleaved — this box's load comes
+    in phases). Default draft is the target itself (accept rate 1.0 by
+    construction — the upper bound of the draft-quality axis, and the
+    honest measure of the WINDOW mechanics: one drafter dispatch + one
+    wide verify replacing spec_k + 1 sequential steps). `draft_config`
+    (LMConfig kwargs dict) swaps in a fresh-initialized draft instead.
+
+    The `chunked_prefill` sub-dict drives prompts LONGER than the
+    widest warmup bucket through the same engine geometry and pins the
+    continuation bit-exact against a single-shot wide-bucket
+    reference — the admission-limit lift costs zero new signatures."""
+    import numpy as np
+    from paddle_tpu import monitor
+    from paddle_tpu.models.transformer import LMConfig
+    from paddle_tpu.serving import GenerateConfig, GenerateEngine
+
+    base = _decode_lm()
+    rng = np.random.RandomState(0)
+    p_lens = (4, 7, 12, 16)
+    work = [(rng.randint(2, 256, size=p_lens[i % len(p_lens)])
+             .astype('int64'), new_tokens) for i in range(sentences)]
+    total = sum(n for _, n in work)
+    kw = dict(model=base, slots=slots, max_len=96,
+              prompt_buckets=[8, 16, 32], eos_id=None, max_new_tokens=64,
+              seed=0, queue_cap=sentences + 2, paged=True, block_size=16)
+    draft = LMConfig(**dict(dict(vocab_size=base.vocab_size,
+                                 seq_len=base.seq_len), **draft_config)) \
+        if draft_config else None
+
+    plain = GenerateEngine(GenerateConfig(**kw))
+    plain.warmup()
+    spec = GenerateEngine(GenerateConfig(speculative=True, spec_k=spec_k,
+                                         draft_model=draft, **kw))
+    warm = spec.warmup()
+
+    def drive(eng):
+        t0 = time.perf_counter()
+        reqs = [eng.submit(p, max_new_tokens=n, deadline_s=120.0)
+                for p, n in work]
+        outs = [list(r.result(120)) for r in reqs]
+        return time.perf_counter() - t0, outs
+
+    plain.start()
+    spec.start()
+    try:
+        drive(plain), drive(spec)               # warm both loops
+        before = monitor.counters()
+        tb = ts = float('inf')
+        outs_p = outs_s = None
+        for _ in range(rounds):                  # interleaved minima
+            t, outs_p = drive(plain)
+            tb = min(tb, t)
+            t, outs_s = drive(spec)
+            ts = min(ts, t)
+        delta = monitor.counter_delta(before)
+    finally:
+        plain.stop()
+        spec.stop()
+    miss = sum(v for k, v in delta.items()
+               if k.startswith('compile_cache_miss'))
+    st = spec.stats()['spec']
+
+    # --- chunked prefill: prompts past the widest bucket --------------
+    long_p = rng.randint(2, 256, size=56).astype('int64')   # > bucket 32
+    wide = GenerateEngine(GenerateConfig(
+        model=base, slots=slots, max_len=96, prompt_buckets=[64],
+        eos_id=None, seed=0, paged=True, block_size=16))
+    ref = wide.generate_once(long_p, max_new_tokens=16)
+    chunk = GenerateEngine(GenerateConfig(**kw))
+    chunk.warmup()
+    t0 = time.perf_counter()
+    with chunk:
+        creq = chunk.submit(long_p, max_new_tokens=16, deadline_s=120.0)
+        cout = list(creq.result(120))
+    chunk_s = time.perf_counter() - t0
+
+    return {
+        'sentences': sentences,
+        'tokens_generated': total,
+        'spec_k': spec_k,
+        'draft': 'target' if draft is None else 'custom',
+        'plain_tokens_per_sec': round(total / tb, 1),
+        'spec_tokens_per_sec': round(total / ts, 1),
+        'speculative': {
+            'vs_plain_tokens_per_sec': round(tb / ts, 2),
+            'accept_rate': st['accept_rate'],
+            'proposed': st['proposed'],
+            'accepted': st['accepted'],
+            'rounds': st['rounds'],
+            'greedy_parity': outs_p == outs_s,
+            'recompiles_after_warmup': int(miss),
+            'warmup': warm,
+        },
+        'chunked_prefill': {
+            'prompt_len': int(long_p.size),
+            'widest_bucket': 32,
+            'admitted': creq.finish_reason is not None,
+            'bitexact_vs_single_shot': cout == ref,
+            'wall_s': round(chunk_s, 3),
+        },
+        'rounds': rounds,
+        'config': 'lm v%d d%d h%d L%d slots%d maxlen%d' % (
+            base.vocab_size, base.d_model, base.n_head, base.n_layer,
+            slots, 96),
+    }
+
+
 if __name__ == '__main__':
     argv = [a for a in sys.argv[1:]]
+    draft_cfg = None
+    spec_k = 6
+    if '--draft-config' in argv:
+        i = argv.index('--draft-config')
+        draft_cfg = json.loads(argv[i + 1])
+        del argv[i:i + 2]
+    if '--spec-k' in argv:
+        i = argv.index('--spec-k')
+        spec_k = int(argv[i + 1])
+        del argv[i:i + 2]
+    if (draft_cfg is not None or spec_k != 6) and \
+            '--speculative' not in argv:
+        raise SystemExit(
+            "--spec-k / --draft-config only apply to --speculative — "
+            "they would be silently ignored by this mode")
     if '--generate' in argv:
         argv.remove('--generate')
         n = int(argv[0]) if argv else 3
@@ -603,6 +743,11 @@ if __name__ == '__main__':
         argv.remove('--shared-prefix')
         n = int(argv[0]) if argv else 8
         print(json.dumps(measure_shared_prefix(clients=n)))
+    elif '--speculative' in argv:
+        argv.remove('--speculative')
+        n = int(argv[0]) if argv else 4
+        print(json.dumps(measure_speculative(rounds=n, spec_k=spec_k,
+                                             draft_config=draft_cfg)))
     else:
         n = int(argv[0]) if argv else 5
         print(json.dumps(measure_serving(rounds=n)))

@@ -6,8 +6,11 @@ channel (``PADDLE_TRACE_LOG`` / ``FLAGS_monitor_log`` — snapshot lines
 from the metrics writer are skipped automatically) and prints:
 
 - per-kind, per-stage p50/p95/p99 breakdowns (queue / batch / prefill /
-  decode_step / execute / sync ...) with each stage's share of total
-  latency and the stage-sum coverage of end-to-end time;
+  decode_step / draft / verify / execute / sync ...) with each stage's
+  share of total latency and the stage-sum coverage of end-to-end time
+  (speculative generate traces split the decode wall into ``draft`` +
+  ``verify`` + a residual ``decode_step`` of host time, so the sum
+  still composes — and their timing carries ``spec_accept_rate``);
 - outcome counts (ok / error / deadline / shed / stopped) — keep-errors
   sampling means failures are always present;
 - the slowest-trace exemplars with their full stage budgets (the "why
