@@ -42,16 +42,12 @@ TPU_TIMEOUT_S = 2400          # compile times under chip contention vary 5x
 CPU_TIMEOUT_S = 900
 TPU_MODEL_BUDGET_S = 1700     # leave headroom for JSON emission
 
-# peak dense bf16 FLOP/s per chip, by device_kind substring
-PEAK_FLOPS = [
-    ('v6', 918e12), ('v5p', 459e12), ('v5', 197e12),  # v5 lite / v5e
-    ('v4', 275e12), ('v3', 123e12), ('v2', 45e12),
-]
-
-
 def _peak_for(kind):
-    return next((p for pat, p in PEAK_FLOPS
-                 if pat in kind.lower().replace(' ', '')), None)
+    # one source of truth for the per-chip peak table: the goodput layer
+    # (paddle_tpu/goodput.py PEAK_FLOPS) — the live step_mfu gauge and
+    # this offline column must divide by the SAME denominator
+    from paddle_tpu.goodput import peak_flops_for
+    return peak_flops_for(kind)
 
 
 def _lm_train_flops_per_step(cfg, batch):
@@ -97,32 +93,59 @@ def _measure_steps(exe, program, scope, batches, loss_var, k_per_call,
     loss = float(np.asarray(out[0]).reshape(-1)[0])
     # each round is timed separately (call + its own sync); the BEST round
     # is reported — the chip may be time-shared with other tenants, and the
-    # fastest window estimates the uncontended machine
+    # fastest window estimates the uncontended machine. The goodput layer
+    # accounts the SAME rounds live: per-round (device-busy, flops)
+    # deltas give the live MFU of the best window — the cross-check
+    # column against this file's offline formula.
+    from paddle_tpu import goodput as _goodput
+    from paddle_tpu import analysis as _analysis
+    # warm the one-time XLA cost analysis BEFORE the measured window so
+    # the first round's stats() read doesn't pay it inside the wall
+    _analysis.lookup(program, kind='fused')
+    _goodput.reset()
     best = float('inf')
+    best_rate = 0.0
+    prev = _goodput.stats()
     for r in range(rounds):
         t0 = time.time()
         last = exe.run_fused(program, stacked, fetch_list=[loss_var],
                              scope=scope, return_numpy=False, steps=steps)
         float(np.asarray(last[0]).reshape(-1)[0])        # sync
         best = min(best, time.time() - t0)
-    return best / steps, loss, compile_s
+        cur = _goodput.stats()
+        d_busy = cur['productive_s'] - prev['productive_s']
+        d_flops = cur['flops'] - prev['flops']
+        prev = cur
+        if d_busy > 0:
+            best_rate = max(best_rate, d_flops / d_busy)
+    final = _goodput.stats()
+    peak, _bw = _goodput.device_peaks()
+    gp_cols = {
+        'goodput_frac': round(final['goodput_frac'], 4),
+        'live_flops_per_s': round(best_rate, 1),
+        'live_mfu': round(best_rate / peak, 4) if peak else None,
+    }
+    return best / steps, loss, compile_s, gp_cols
 
 
 def _program_cost_row(program, memory=False):
-    """XLA analytics columns for one bench row: per-step flops / bytes
-    accessed from the registered executable (normalized by the fused
-    scan length), plus buffer-assignment peak bytes when `memory` (costs
-    one extra XLA compile — CPU rows only; TPU compiles are minutes)."""
+    """XLA analytics columns for one bench row: per-STEP flops / bytes
+    accessed from the registered executable, plus buffer-assignment peak
+    bytes when `memory` (costs one extra XLA compile — CPU rows only;
+    TPU compiles are minutes). XLA's HloCostAnalysis counts a while-loop
+    BODY once regardless of trip count (measured: identical flops for a
+    4-step and an 8-step fused scan of the same program), so the
+    registered flops are ALREADY per step — rows before r08 divided by
+    the scan length again and under-reported these columns by k x."""
     try:
         from paddle_tpu import analysis
         rec = analysis.lookup(program, memory=memory)
         if rec is None:
             return {}
-        steps = max(1, rec.steps or 1)
         out = {}
         if rec.flops is not None:
-            out['flops'] = rec.flops / steps
-            out['bytes_accessed'] = rec.bytes_accessed / steps
+            out['flops'] = rec.flops
+            out['bytes_accessed'] = rec.bytes_accessed
         if rec.peak_bytes is not None:
             out['peak_bytes'] = rec.peak_bytes
         return out
@@ -159,7 +182,7 @@ def _bench_lm(cfg_kwargs, batch, k_per_call, rounds, amp,
                for _ in range(k_per_call)]
     with fluid.scope_guard(scope):
         exe.run(startup, scope=scope)
-        sec_step, loss, compile_s = _measure_steps(
+        sec_step, loss, compile_s, gp_cols = _measure_steps(
             exe, main_p, scope, batches, avg_loss, k_per_call, rounds,
             steps=steps_per_call or max(120, k_per_call))
     row = {
@@ -173,6 +196,7 @@ def _bench_lm(cfg_kwargs, batch, k_per_call, rounds, amp,
             cfg.seq_len, batch),
     }
     row.update(_program_cost_row(main_p))
+    row.update(gp_cols)
     return row
 
 
@@ -225,7 +249,7 @@ def _bench_image_model(build_fn, label_str, batch, k_per_call, rounds,
         batches.append({'img': imgs, 'label': _teacher_label(imgs)})
     with fluid.scope_guard(scope):
         exe.run(startup, scope=scope)
-        sec_step, loss, compile_s = _measure_steps(
+        sec_step, loss, compile_s, gp_cols = _measure_steps(
             exe, main_p, scope, batches, avg_cost, k_per_call, rounds,
             steps=max(240, k_per_call))
     row = {
@@ -236,6 +260,7 @@ def _bench_image_model(build_fn, label_str, batch, k_per_call, rounds,
         'config': '%s %s b%d' % (label_str, dataset, batch),
     }
     row.update(_program_cost_row(main_p))
+    row.update(gp_cols)
     return row
 
 
@@ -269,7 +294,7 @@ def _bench_bert(batch, k_per_call, rounds, amp):
                for _ in range(k_per_call)]
     with fluid.scope_guard(scope):
         exe.run(startup, scope=scope)
-        sec_step, loss, compile_s = _measure_steps(
+        sec_step, loss, compile_s, gp_cols = _measure_steps(
             exe, main_p, scope, batches, total, k_per_call, rounds,
             steps=max(120, k_per_call))
     # model FLOPs: encoder matmuls+attention (x3 for bwd) + MLM head over
@@ -281,7 +306,7 @@ def _bench_bert(batch, k_per_call, rounds, amp):
     fwd = cfg.n_layer * per_layer \
         + 2 * B * cfg.max_predictions * d * V \
         + 2 * B * d * d + 2 * B * L * d * d   # mlm transform + pooler-ish
-    return {
+    row = {
         'samples_per_sec': round(batch / sec_step, 1),
         'step_ms': round(sec_step * 1000, 2),
         'compile_s': round(compile_s, 1),
@@ -290,6 +315,8 @@ def _bench_bert(batch, k_per_call, rounds, amp):
         'config': 'bert-base L%d d%d seq%d b%d' % (
             cfg.n_layer, cfg.d_model, cfg.seq_len, batch),
     }
+    row.update(gp_cols)
+    return row
 
 
 def _bench_stacked_lstm(batch, seq_len, k_per_call, rounds):
@@ -351,7 +378,7 @@ def _bench_stacked_lstm(batch, seq_len, k_per_call, rounds):
     with fluid.scope_guard(scope):
         exe.run(startup, scope=scope)
         for sl in buckets:
-            sec_step, lossv, compile_s = _measure_steps(
+            sec_step, lossv, compile_s, gp_cols = _measure_steps(
                 exe, main_p, scope, make_batches(sl), loss, n_steps,
                 rounds, steps=n_steps)
             per_bucket['seq%d' % sl] = {
@@ -371,6 +398,9 @@ def _bench_stacked_lstm(batch, seq_len, k_per_call, rounds):
         'buckets': per_bucket,
         'config': 'stacked_lstm L%d h%d mixed-seq%s b%d' % (
             layers_n, hid, buckets, batch),
+        # goodput columns from the LAST bucket's measured window (each
+        # bucket resets the live accounting window)
+        **gp_cols,
     }
 
 
@@ -422,7 +452,7 @@ def _bench_nmt(batch, seq_len, k_per_call, rounds):
     } for _ in range(k_per_call)]
     with fluid.scope_guard(scope):
         exe.run(startup, scope=scope)
-        sec_step, loss, compile_s = _measure_steps(
+        sec_step, loss, compile_s, gp_cols = _measure_steps(
             exe, main_p, scope, batches, avg_cost, k_per_call, rounds)
     out = {
         'samples_per_sec': round(batch / sec_step, 1),
@@ -430,6 +460,7 @@ def _bench_nmt(batch, seq_len, k_per_call, rounds):
         'step_ms': round(sec_step * 1000, 2),
         'compile_s': round(compile_s, 1),
         'final_loss': round(loss, 4),
+        **gp_cols,
         'config': 'nmt emb%d enc%d dec%d V%d seq%d b%d' % (
             cfg.embedding_dim, cfg.encoder_size, cfg.decoder_size,
             cfg.dict_size, seq_len, batch),
@@ -513,16 +544,18 @@ def _bench_ctr(batch, k_per_call, rounds, vocab=100000, dim=16,
         batches.append({'ids': ids, 'label': lbl})
     with fluid.scope_guard(scope):
         exe.run(startup, scope=scope)
-        sec_step, loss, compile_s = _measure_steps(
+        sec_step, loss, compile_s, gp_cols = _measure_steps(
             exe, main_p, scope, batches, loss, n_steps, rounds,
             steps=n_steps)
-    return {
+    row = {
         'samples_per_sec': round(batch / sec_step, 1),
         'step_ms': round(sec_step * 1000, 2),
         'compile_s': round(compile_s, 1),
         'final_loss': round(loss, 4),
         'config': 'ctr v%d s%d d%d b%d' % (vocab, slots, dim, batch),
     }
+    row.update(gp_cols)
+    return row
 
 
 def _machine_window(pred, feed, over_fn):
@@ -988,6 +1021,26 @@ def _child(mode):
         mfu = round(flag['flops_per_step']
                     / (flag['step_ms'] / 1000) / peak, 4)
 
+    # live-vs-offline MFU cross-check on the flagship row: the goodput
+    # layer's best-window live flops rate vs this file's analytic
+    # formula at the best step time. The ratio is peak-independent, so
+    # the agreement verdict is defined on cpu_fallback rounds too (where
+    # both MFU numbers are None absent a known peak — same provenance
+    # caveat as the rest of a cpu_fallback line).
+    goodput_xcheck = None
+    if flag.get('live_flops_per_s') and flag.get('flops_per_step'):
+        offline_rate = flag['flops_per_step'] / (flag['step_ms'] / 1000.0)
+        ratio = flag['live_flops_per_s'] / offline_rate
+        goodput_xcheck = {
+            'live_mfu': flag.get('live_mfu'),
+            'offline_mfu': mfu,
+            'live_flops_per_s': flag['live_flops_per_s'],
+            'offline_flops_per_s': round(offline_rate, 1),
+            'live_vs_offline': round(ratio, 4),
+            'within_10pct': bool(abs(ratio - 1.0) <= 0.10),
+            'goodput_frac': flag.get('goodput_frac'),
+        }
+
     models = {}
     if on_tpu:
         def _try(name, fn, *args, **kw):
@@ -1066,6 +1119,7 @@ def _child(mode):
         'elastic_resume': elastic_resume,
         'costreport': costreport,
         'kernbench_mesh': kernbench_mesh,
+        'goodput': goodput_xcheck,
         'flops': flag.get('flops'),
         'peak_bytes': flag.get('peak_bytes'),
         'final_loss': flag['final_loss'],

@@ -61,6 +61,7 @@ from . import parallel
 from . import monitor
 from . import trace
 from . import analysis
+from . import goodput
 from . import resilience
 from .resilience import TrainingGuard, elastic_train_loop
 from . import profiler

@@ -42,6 +42,7 @@ import time
 
 __all__ = ['inc', 'set_gauge', 'observe', 'span', 'spans', 'clear_spans',
            'snapshot', 'export_prometheus', 'counters', 'counter_delta',
+           'hist_sum',
            'configure_logging', 'log_snapshot', 'reset',
            'serve_metrics', 'MetricsServer']
 
@@ -217,7 +218,8 @@ def inc(name, value=1.0, labels=None):
 # second and evict every duration span — so each track is sampled at most
 # once per _COUNTER_TRACK_MIN_S.
 _COUNTER_TRACK_NAMES = ('program_peak_bytes', 'program_flops',
-                        'executor_inflight', 'elastic_world_size')
+                        'executor_inflight', 'elastic_world_size',
+                        'step_mfu', 'goodput_frac')
 _COUNTER_TRACK_SUFFIXES = ('queue_depth', 'inflight_batches')
 _COUNTER_TRACK_MIN_S = 0.005            # <= 200 samples/s per track
 _track_last_ts = {}                     # track name -> last sample time
@@ -460,6 +462,15 @@ def counters():
         return {_fmt(n, k): _num(v)
                 for n, series in _counters.items()
                 for k, v in series.items()}
+
+
+def hist_sum(name):
+    """Sum of every observation in histogram `name` across all label
+    series (0.0 when nothing observed). Unlike snapshot(), this runs NO
+    pre-snapshot hooks — safe to call from inside one (the goodput
+    layer's loss-bucket accounting reads wall attribution this way)."""
+    with _lock:
+        return sum(h.total for h in _hists.get(name, {}).values())
 
 
 def counter_delta(before, after=None):

@@ -15,11 +15,13 @@ pin intermediate activations to specs — the mechanism for sequence
 parallelism and megatron-style activation sharding.
 """
 import re
+import time
 
 import numpy as np
 import jax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from .. import monitor
 from ..core import lowering
 from ..framework import Variable
 
@@ -141,7 +143,9 @@ class MeshRunner(object):
         key = (program._version, exe._feed_signature(feed, static_lods),
                tuple(fetch_names))
         entry = self._cache.get(key)
-        if entry is None:
+        fresh_compile = entry is None
+        t_compile = time.perf_counter()
+        if fresh_compile:
             fn_, ro_, rw_, lod_out_ = self.compile(
                 {k: (v.shape, v.dtype) for k, v in feed.items()},
                 fetch_names, scope, feed_lods=static_lods)
@@ -185,12 +189,33 @@ class MeshRunner(object):
         prev, _ACTIVE_MESH = _ACTIVE_MESH, self._mesh
         prev_spec, _ACTIVE_PARAM_SPEC = (_ACTIVE_PARAM_SPEC,
                                          self._rules.spec_for)
+        t_disp = time.perf_counter()
         try:
             with self._mesh:
                 fetches, new_state = fn(feed, ro, rw, key_arr)
         finally:
             _ACTIVE_MESH = prev
             _ACTIVE_PARAM_SPEC = prev_spec
+        from .. import analysis
+        from .. import goodput
+        from ..executor import _goodput_leaf
+        fp = program._fingerprint()
+        if fresh_compile:
+            # the jit compile landed inside this first call: its wall is
+            # compile cost (the goodput 'compile' loss bucket), and the
+            # executable registers for XLA flops/bytes analytics so mesh
+            # dispatches carry MFU like every other kind
+            compile_s = time.perf_counter() - t_compile
+            monitor.observe('compile_seconds', compile_s)
+            goodput.note_compile(fp, compile_s)
+            analysis.record_compiled(fn, program,
+                                     (feed, ro, rw, key_arr),
+                                     kind='mesh')
+        else:
+            goodput.note_dispatch(fp, 'mesh', t_disp,
+                                  time.perf_counter(),
+                                  leaf=_goodput_leaf(new_state,
+                                                     list(fetches)))
         scope.update(new_state)
         # propagate produced LoDs of written persistables into the scope
         for n in new_state:

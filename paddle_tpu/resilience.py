@@ -354,6 +354,11 @@ class RetryPolicy(object):
             if time.monotonic() + d - t0 > self.deadline_s:
                 break
             monitor.inc('retry_attempt_total', labels={'site': site})
+            # the backoff sleep is dead wall the device sits idle for —
+            # the goodput layer's 'retry_backoff' loss bucket reads this
+            # histogram's sum (docs/observability.md)
+            monitor.observe('retry_backoff_seconds', d,
+                            labels={'site': site})
             with monitor.span('retry_backoff:%s' % site):
                 time.sleep(d)
             try:
@@ -908,6 +913,7 @@ def _elastic_loop_body(step_fn, manager, num_steps, start_step, mesh,
         try:
             out = step_fn(step, mesh)
         except (WorkerFailedError, NonFiniteError, InjectedFault) as e:
+            t_recover = time.perf_counter()
             resumes += 1
             if resumes > max_resumes:
                 monitor.inc('elastic_giveup_total')
@@ -999,6 +1005,11 @@ def _elastic_loop_body(step_fn, manager, num_steps, start_step, mesh,
                      resume_step=step)
             if on_resume is not None:
                 on_resume(step, mesh, e)
+            # failure -> restored-and-ready wall: the 'elastic_recovery'
+            # goodput loss bucket (the restore itself also counts into
+            # ckpt_restore_seconds; recovery covers mesh rebuild + both)
+            monitor.observe('elastic_recovery_seconds',
+                            time.perf_counter() - t_recover)
             continue
         outputs[step] = out
         if fail_step is not None and step >= fail_step:

@@ -36,6 +36,7 @@ import time
 
 import numpy as np
 
+from .. import goodput
 from .. import monitor
 from .. import resilience
 from .. import trace as trace_mod
@@ -120,6 +121,14 @@ class ServingEngine(object):
                 model_filename=config.model_filename,
                 params_filename=config.params_filename))
         self.predictor = predictor
+        # name the program's goodput series NOW: counters exported by a
+        # periodic snapshot before the first stats() call would
+        # otherwise label as the bare fingerprint and split the series
+        try:
+            goodput.name_model(predictor.program._fingerprint(),
+                               config.model_dir or 'serving')
+        except Exception:       # noqa: BLE001 — telemetry only
+            pass
         self.ladder = BucketLadder(config.batch_buckets,
                                    seq_buckets=config.seq_buckets,
                                    seq_axis=config.seq_axis,
@@ -416,6 +425,10 @@ class ServingEngine(object):
             for r in batch:
                 qs = max(0.0, now_m - r.enqueue_t)
                 monitor.observe('serving_queue_seconds', qs)
+                # queue-SLO burn sentinel (perf_regression_total
+                # {kind=queue_burn} once the EWMA burns past
+                # PADDLE_PERFWATCH_QUEUE_SLO_MS)
+                goodput.note_queue_wait(qs)
                 if r.trace is not None:
                     r.trace.add_stage('queue', qs)
                     monitor.record_span('request.queue', r.enqueue_wall,
@@ -531,6 +544,27 @@ class ServingEngine(object):
         with self._inflight_lock:
             self._inflight_n += d
             return self._inflight_n
+
+    # ------------------------------------------------------------------
+    def stats(self):
+        """Engine statistics: queue/inflight state plus the live
+        goodput/MFU block for THIS engine's program — device-busy
+        seconds, delivered flops/s and utilization restricted to the
+        predictor's compiled signatures (the process-wide loss buckets
+        and regression log ride along; see paddle_tpu.goodput)."""
+        out = {
+            'queue_depth': self.queue.depth(),
+            'inflight_batches': self._inflight(0),
+            'workers': len(self._workers),
+            'started': self._started,
+        }
+        try:
+            fp = self.predictor.program._fingerprint()
+            goodput.name_model(fp, self.config.model_dir or 'serving')
+            out['goodput'] = goodput.stats(fps=[fp])
+        except Exception:       # noqa: BLE001 — stats stay best-effort
+            out['goodput'] = goodput.stats(fps=[])
+        return out
 
     def _slice_result(self, outs, off, req, padded_rows):
         """Un-batch: slice each fetch back to this request's rows, and

@@ -111,6 +111,7 @@ import time
 
 import numpy as np
 
+from .. import goodput
 from .. import monitor
 from .. import trace as trace_mod
 from .. import unique_name
@@ -386,7 +387,7 @@ class GenerateRequest(Request):
 
 class _Slot(object):
     __slots__ = ('req', 'pos', 'generated', 'last', 'last_t', 'wall0',
-                 'blocks', 'table', 'dblocks', 'dtable')
+                 'blocks', 'table', 'dblocks', 'dtable', 'draft_stale')
 
     def __init__(self, req, pos, last, blocks=None, table=None,
                  dblocks=None, dtable=None):
@@ -400,6 +401,9 @@ class _Slot(object):
         self.table = table      # paged: np [max_blocks] int64, filler 0
         self.dblocks = dblocks  # speculative: DRAFT-pool block ids
         self.dtable = dtable    # speculative: draft block table
+        # plain (fallback) steps write K/V into the TARGET cache only —
+        # the draft cache misses those rows until a spec round resyncs
+        self.draft_stale = False
 
 
 class GenerateEngine(object):
@@ -484,6 +488,13 @@ class GenerateEngine(object):
         self._spec_proposed = 0
         self._spec_accepted = 0
         self._spec_fallbacks = 0
+        self._spec_stale_rounds = 0
+        self._goodput_fps = None
+        # resolve + name the goodput fingerprint set NOW: a periodic
+        # snapshot exporting counters before the first stats() call
+        # would otherwise label them as bare fingerprints and split
+        # each program's series in two
+        self._goodput_fp_set()
         monitor.set_gauge('kv_slot_occupancy', 0.0)
         monitor.set_gauge('generate_queue_depth', 0.0)
         if c.paged:
@@ -1212,6 +1223,10 @@ class GenerateEngine(object):
             table = self._slot_table(blocks)
         slot = self._free.pop()
         qs = max(0.0, time.monotonic() - req.enqueue_t)
+        # queue wait as a histogram (the goodput 'queue' loss bucket
+        # reads its sum) + the queue-SLO burn sentinel feed
+        monitor.observe('generate_queue_seconds', qs)
+        goodput.note_queue_wait(qs)
         if req.trace is not None:
             # queue stage closes at admission; the span rides the
             # SUBMITTER's tid so the trace shows the thread hop into
@@ -1479,6 +1494,30 @@ class GenerateEngine(object):
                 self._step_complete(pending)
             return
 
+        # --- draft-cache staleness: fallback rounds (a sampled rider
+        # pinning the batch onto plain steps) advanced positions with
+        # K/V deposited into the TARGET cache only. Resuming speculation
+        # against those draft-cache holes is CORRECT (acceptance is the
+        # target's argmax identity) but accept-degraded — count the
+        # resume, and on the draft==target path resync by block-copying
+        # the slot's current target blocks across pools (_spec_grow just
+        # extended the draft table to the same coverage; the same jitted
+        # fixed-width scatter the admission sync uses — zero recompiles).
+        # A distinct draft model has no valid copy source (its K/V are
+        # model-specific); its stale rows age out only as its own
+        # drafter writes past them, which the counter makes visible.
+        stale = [(i, st) for i, st in active if st.draft_stale]
+        if stale:
+            monitor.inc('spec_stale_draft_rounds_total')
+            self._spec_stale_rounds += 1
+            for i, st in stale:
+                if self._draft_copies_target:
+                    nsync = min(len(st.dblocks), len(st.blocks))
+                    if nsync:
+                        self._draft_cache_sync(st.dblocks[:nsync],
+                                               st.blocks[:nsync])
+                st.draft_stale = False
+
         # --- draft: K unrolled greedy steps, one dispatch -------------
         # (feed construction vectorized over the slot axis — this runs
         # once per ~K+1 emitted tokens and must stay off the host
@@ -1606,6 +1645,11 @@ class GenerateEngine(object):
         monitor.inc('decode_tokens_total', emitted_total)
         monitor.inc('spec_propose_total', round_proposed)
         monitor.inc('spec_accept_total', round_accepted)
+        if round_proposed:
+            # accept-collapse sentinel feed (perf_regression_total
+            # {kind=accept_collapse} when the EWMA falls off its baseline)
+            goodput.note_accept(round_accepted / float(round_proposed),
+                                model='generate')
         self._spec_rounds += 1
         self._spec_proposed += round_proposed
         self._spec_accepted += round_accepted
@@ -1683,10 +1727,15 @@ class GenerateEngine(object):
         self._decode_tokens += n
         self._occ_sum += n / float(self.config.slots)
         monitor.inc('decode_tokens_total', n)
+        speculative = self.config.speculative
         for i, st in active:
             st.pos += 1
             st.generated += 1
             st.last = int(nxt[i])
+            if speculative:
+                # this plain step wrote position pos-1 into the TARGET
+                # cache only; the draft cache now has a hole there
+                st.draft_stale = True
             # per-request inter-token gap (WALL, overlap included): these
             # compose the request's 'decode_step' stage so queue +
             # prefill + decode sums to its end-to-end latency
@@ -1797,10 +1846,31 @@ class GenerateEngine(object):
                 'k': self.config.spec_k,
                 'rounds': self._spec_rounds,
                 'fallback_rounds': self._spec_fallbacks,
+                'stale_draft_rounds': self._spec_stale_rounds,
                 'proposed': prop,
                 'accepted': self._spec_accepted,
                 'accept_rate': round(self._spec_accepted / float(prop), 4)
                 if prop else 0.0,
                 'draft_blocks_in_use': self._draft_alloc.in_use(),
             }
+        out['goodput'] = goodput.stats(fps=self._goodput_fp_set())
         return out
+
+    def _goodput_fp_set(self):
+        """Fingerprints of every program this engine dispatches (decode
+        step, per-bucket prefills, drafter/verify/draft-prefills) — the
+        filter for the engine-scoped stats()['goodput'] block. Memoized:
+        the program set is fixed at construction."""
+        if self._goodput_fps is None:
+            progs = [self._step_prog] + \
+                [p for p, _ in self._prefill.values()]
+            if self.config.speculative:
+                progs += [self._drafter_prog, self._verify_prog]
+                progs += [p for p, _ in self._draft_prefill.values()]
+            fps = set()
+            for p in progs:
+                fp = p._fingerprint()
+                fps.add(fp)
+                goodput.name_model(fp, 'generate')
+            self._goodput_fps = fps
+        return self._goodput_fps

@@ -108,9 +108,16 @@ class TestProfiler(object):
         names = [e.get('name') for e in data.get('traceEvents', data)]
         assert any('custom_span' in str(n) for n in names)
 
+    @pytest.mark.slow
     def test_double_start_is_guarded(self, tmp_path):
         """Reference start_profiler returns early when already enabled; the
-        running device trace must survive a second start and finalize."""
+        running device trace must survive a second start and finalize.
+
+        @slow (ISSUE 14 tier-1 offset): ~23 s, all inside jax's device
+        trace start/finalize — the guard LOGIC is a few host lines.
+        Tier-1 keeps profiler start/stop + chrome export coverage via
+        test_host_spans_and_chrome_trace above; the jax-trace-survives-
+        nested-start behavior runs in the slow tier."""
         d = str(tmp_path / "t1")
         fluid.profiler.start_profiler(trace_dir=d)
         try:

@@ -62,6 +62,12 @@ class TestRegistry(object):
 
     def test_label_cardinality_cap(self, monkeypatch):
         monkeypatch.setenv('PADDLE_MONITOR_MAX_SERIES', '4')
+        # snapshot() runs the goodput pre-snapshot hook; with an epoch
+        # left open by an earlier test its loss-bucket gauge (6 label
+        # series) would also overflow this tiny cap and shift the
+        # process-global drop counter
+        from paddle_tpu import goodput
+        goodput.reset()
         for i in range(20):
             monitor.inc('capped_total', labels={'user': 'u%d' % i})
         snap = monitor.snapshot()

@@ -113,6 +113,11 @@ def test_warmup_then_mixed_load_zero_recompiles(model_dir):
     assert not any(k.startswith('compile_cache_miss') for k in delta), delta
     assert delta.get('serving_request_total{outcome=ok}') == 12
     assert delta.get('serving_batch_total', 0) >= 1
+    # the engine-scoped live goodput block (ISSUE 14): the mixed load's
+    # batched dispatches were accounted against this engine's program
+    gp = eng.stats()['goodput']
+    assert gp['dispatches'] >= 1 and gp['productive_s'] > 0
+    assert eng.stats()['queue_depth'] == 0
 
 
 def test_load_shed_structured_reason_and_counter(model_dir):

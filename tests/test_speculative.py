@@ -283,6 +283,66 @@ def test_spec_mixed_sampled_traffic_falls_back():
     assert eng.stats()['spec']['fallback_rounds'] > 0
 
 
+def test_draft_cache_resync_after_fallback_burst():
+    """ISSUE 14 satellite (open from PR 13): plain fallback rounds (a
+    sampled co-rider) deposit K/V into the TARGET cache only, so greedy
+    speculation used to resume against a STALE draft cache — correct
+    but accept-degraded until the next admission. The engine now counts
+    the resume (spec_stale_draft_rounds_total) and, on the
+    draft==target path, resyncs via the existing _draft_cache_sync
+    block copy BEFORE drafting — so the accept rate recovers to exactly
+    1.0 after the burst (without the resync the drafter reads zero rows
+    for every fallback-era position and acceptance collapses)."""
+    spec = GenerateEngine(_spec_cfg())
+    pg = _prompt(6, 41)
+    ref_g = spec.generate_once(pg, max_new_tokens=18)
+    spec.warmup()
+    before = monitor.counters()
+    g = spec.submit(pg, max_new_tokens=18)
+    s = spec.submit(_prompt(9, 42), max_new_tokens=5, temperature=0.8,
+                    top_k=8, sample_seed=7)
+    _drive(spec, s)     # sampled rider resident -> every round falls back
+    assert spec._spec_fallbacks > 0
+    assert g.finish_reason is None      # greedy rider still mid-flight
+    st_g = next(st for st in spec._slots
+                if st is not None and st.req is g)
+    pos_before = st_g.pos               # fallback-era write head
+    spec._step()        # the RESUMED speculative round (resync fires)
+    # mechanical pin: after the resync, every draft-cache row covering
+    # a position written BEFORE the resumed round bitwise-equals the
+    # target cache's row (the block copy moves target truth across
+    # pools) — without it the fallback-era positions are still the
+    # zero holes the plain steps never filled. Rows the resumed round
+    # itself wrote are excluded: drafter and verify deposit them from
+    # differently-shaped programs, so they agree only to float
+    # reduction order, not bitwise.
+    kt = np.asarray(spec.scope.get(KV_CACHE_K))
+    kd = np.asarray(spec._draft_scope.get(KV_CACHE_K))
+    for p in range(pos_before):
+        tb, db = st_g.blocks[p // BS], st_g.dblocks[p // BS]
+        np.testing.assert_array_equal(
+            kt[tb, :, :, p % BS, :], kd[db, :, :, p % BS, :],
+            err_msg='draft cache stale at position %d' % p)
+    _drive(spec, g)     # speculation continues on the synced cache
+    delta = monitor.counter_delta(before)
+    assert delta.get('spec_stale_draft_rounds_total', 0) >= 1
+    st = spec.stats()['spec']
+    assert st['stale_draft_rounds'] >= 1
+    assert st['fallback_rounds'] > 0
+    assert st['rounds'] > 0
+    # accept-rate RECOVERY: every post-resync proposal is target-equal
+    # again — 1.0 overall because no round before the burst speculated
+    assert st['accept_rate'] == 1.0, st
+    # the resync is a warmed fixed signature: no recompiles appeared
+    assert not any(k.startswith('compile_cache_miss')
+                   for k in delta), delta
+    assert list(g.result(5)) == ref_g   # bitwise parity held throughout
+    spec.stop()
+    # engine-scoped goodput block rode along (bound decode dispatches)
+    gp = spec.stats()['goodput']
+    assert gp['dispatches'] > 0 and gp['by_kind']['bound']['flops'] > 0
+
+
 @pytest.mark.slow
 def test_speculative_throughput_and_chunked_workload():
     """The servebench speculative row end to end: >= 1.2x engine

@@ -45,6 +45,7 @@ def iter_api():
         ('paddle_tpu.monitor', fluid.monitor),
         ('paddle_tpu.trace', fluid.trace),
         ('paddle_tpu.analysis', fluid.analysis),
+        ('paddle_tpu.goodput', fluid.goodput),
         ('paddle_tpu.resilience', fluid.resilience),
         ('paddle_tpu.evaluator', fluid.evaluator),
         ('paddle_tpu.compat', fluid.compat),

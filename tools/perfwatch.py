@@ -1,0 +1,303 @@
+"""Goodput/MFU observatory report over monitor snapshot logs.
+
+Reads the same JSON-lines channel as ``tools/obsreport.py``
+(``FLAGS_monitor_log``; the goodput layer exports its gauges/counters
+into every snapshot via the pre-snapshot hook) and prints the
+performance-accounting view:
+
+- headline utilization: window wall, productive device seconds,
+  ``goodput_frac``, ``step_mfu``, delivered ``model_flops_per_s``,
+  ``hbm_bw_util_frac``;
+- the loss-bucket breakdown (compile / input_wait / ckpt /
+  retry_backoff / elastic_recovery / queue + the unattributed
+  remainder), each as seconds and share of wall;
+- per-model/per-kind signature table from the
+  ``goodput_*_total{model,kind,fingerprint}`` counters: dispatches,
+  scan steps, device seconds, flops, per-signature flops/s and share
+  of productive time;
+- the regression log: ``perf_regression_total{kind}`` counts plus the
+  ``perf_regression`` trace events the sentinel wrote on the same
+  channel (keep-errors — they are present even at 0% trace sampling).
+
+Fleet mode: ``--merge`` aggregates the newest snapshot of EACH
+rank-suffixed log (``distributed.launch`` writes ``<path>.rank<N>``)
+into one report — counters sum, so fleet flops/s, fleet productive
+seconds and fleet MFU come out of numbers NO single rank could report
+alone (each rank only knows its own dispatches).
+
+Usage:
+    python tools/perfwatch.py runlog.jsonl
+    python tools/perfwatch.py --merge runlog.jsonl.rank0 runlog.jsonl.rank1
+    python tools/perfwatch.py runlog.jsonl --json
+"""
+import argparse
+import json
+import sys
+
+
+def _parse_labeled(key):
+    """'name{k=v,k2=v2}' -> (name, {k: v}); plain names get {}."""
+    if '{' not in key:
+        return key, {}
+    name, rest = key.split('{', 1)
+    rest = rest.rstrip('}')
+    labels = {}
+    for part in rest.split(','):
+        if '=' in part:
+            k, v = part.split('=', 1)
+            labels[k] = v
+    return name, labels
+
+
+def read_log(path):
+    """(last snapshot, perf_regression events) from one log file.
+    Snapshot lines have no trace_id; the sentinel's trip events carry
+    ``event == 'perf_regression'`` (trace lines share the channel)."""
+    snap, events = None, []
+    with open(path) as f:
+        for line in f:
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if not isinstance(rec, dict):
+                continue
+            if rec.get('event') == 'perf_regression':
+                events.append(rec)
+            elif 'trace_id' not in rec:
+                snap = rec
+    if snap is None:
+        raise SystemExit('%s: no snapshot lines' % path)
+    return snap, events
+
+
+def _signature_rows(counters):
+    """Aggregate goodput_*_total counters into per-(model, kind) rows."""
+    rows = {}
+    fields = {'goodput_device_seconds_total': 'device_s',
+              'goodput_dispatch_total': 'dispatches',
+              'goodput_steps_total': 'steps',
+              'goodput_flops_total': 'flops',
+              'goodput_bytes_total': 'bytes'}
+    for key, v in counters.items():
+        name, labels = _parse_labeled(key)
+        field = fields.get(name)
+        if field is None:
+            continue
+        rk = (labels.get('model', '?'), labels.get('kind', '?'))
+        row = rows.setdefault(rk, {'model': rk[0], 'kind': rk[1],
+                                   'device_s': 0.0, 'dispatches': 0,
+                                   'steps': 0, 'flops': 0.0,
+                                   'bytes': 0.0})
+        row[field] += v
+    return sorted(rows.values(), key=lambda r: -r['device_s'])
+
+
+def _regression_counts(counters):
+    out = {}
+    for key, v in counters.items():
+        name, labels = _parse_labeled(key)
+        if name == 'perf_regression_total':
+            out[labels.get('kind', '?')] = out.get(
+                labels.get('kind', '?'), 0) + int(v)
+    return out
+
+
+def report_from_snapshots(snaps, events=()):
+    """One aggregated report dict from >= 1 snapshots (1 = single rank;
+    more = fleet merge). Counters sum across ranks; wall/productive
+    aggregate additively (each rank's window is its own device's wall),
+    so fleet flops/s and fleet MFU are genuinely cross-rank numbers."""
+    wall = prod = flops = 0.0
+    buckets = {}
+    peak = None
+    step_mfu_ranks = []
+    counters = {}
+    for s in snaps:
+        g = s.get('gauges') or {}
+        w = g.get('goodput_wall_seconds', 0.0)
+        p = g.get('goodput_productive_seconds', 0.0)
+        wall += w
+        prod += p
+        mfu = g.get('step_mfu')
+        if mfu:
+            step_mfu_ranks.append(mfu)
+        for k, v in g.items():
+            name, labels = _parse_labeled(k)
+            if name == 'goodput_loss_seconds':
+                b = labels.get('bucket', '?')
+                buckets[b] = buckets.get(b, 0.0) + v
+        for k, v in (s.get('counters') or {}).items():
+            counters[k] = counters.get(k, 0) + v
+    rows = _signature_rows(counters)
+    flops = sum(r['flops'] for r in rows)
+    bytes_ = sum(r['bytes'] for r in rows)
+    dev_s = sum(r['device_s'] for r in rows)
+    # per-chip peak: the exported goodput_peak_flops gauge when present
+    # (robust across goodput.reset() windows); else infer it from a
+    # rank's own step_mfu gauge (peak = flops/busy/mfu — only valid
+    # while counters and gauges cover the same epoch). Fleet MFU =
+    # sum-flops over sum-productive against that peak — a number no
+    # rank holds.
+    for s in snaps:
+        g = s.get('gauges') or {}
+        if g.get('goodput_peak_flops'):
+            peak = g['goodput_peak_flops']
+            break
+    if peak is None:
+        for s in snaps:
+            g = s.get('gauges') or {}
+            mfu = g.get('step_mfu')
+            p = g.get('goodput_productive_seconds')
+            if mfu and p:
+                own = _own_flops(s)
+                if own:
+                    peak = own / p / mfu
+                    break
+    # delivered rate: sum each rank's own epoch-consistent
+    # model_flops_per_s gauge (counters survive goodput.reset(); the
+    # wall gauge restarts — mixing them would inflate by the number of
+    # reset windows). Fallback for snapshots without the gauge:
+    # own-flops / own-wall, valid while the log covers one epoch.
+    # Ranks with unequal windows (a respawned worker) sum correctly
+    # either way.
+    rate = 0.0
+    for s in snaps:
+        g = s.get('gauges') or {}
+        r = g.get('model_flops_per_s')
+        if r is None:
+            w = g.get('goodput_wall_seconds', 0.0)
+            r = _own_flops(s) / w if w else 0.0
+        rate += r
+    out = {
+        'ranks': len(snaps),
+        'wall_s': wall,
+        'productive_s': prod,
+        'goodput_frac': (prod / wall) if wall else 0.0,
+        'flops': flops,
+        'model_flops_per_s': rate,
+        # fleet MFU from counters ONLY (flops and device-seconds totals
+        # are both cumulative, so the ratio survives goodput.reset()
+        # restarting the gauge window mid-log)
+        'step_mfu': (flops / dev_s / peak) if (peak and dev_s) else
+        (step_mfu_ranks[0] if len(step_mfu_ranks) == 1 else None),
+        'hbm_bytes': bytes_,
+        'device_s_by_signature': dev_s,
+        'loss_buckets': buckets,
+        'signatures': rows,
+        'regression_counts': _regression_counts(counters),
+        'regression_events': list(events),
+    }
+    return out
+
+
+def _own_flops(snap):
+    total = 0.0
+    for k, v in (snap.get('counters') or {}).items():
+        name, _ = _parse_labeled(k)
+        if name == 'goodput_flops_total':
+            total += v
+    return total
+
+
+def _fmt_s(s):
+    if s is None:
+        return '-'
+    if s < 1e-3:
+        return '%.1fus' % (s * 1e6)
+    if s < 1.0:
+        return '%.2fms' % (s * 1e3)
+    return '%.3fs' % s
+
+
+def _fmt_flops(f):
+    for unit, div in (('PF', 1e15), ('TF', 1e12), ('GF', 1e9),
+                      ('MF', 1e6)):
+        if f >= div:
+            return '%.2f%s' % (f / div, unit)
+    return '%.0fF' % f
+
+
+def print_report(rep, out=None):
+    w = (out or sys.stdout).write
+    wall = rep['wall_s']
+    w('goodput observatory — %d rank%s\n'
+      % (rep['ranks'], '' if rep['ranks'] == 1 else 's'))
+    w('  wall (summed over ranks) %s   productive %s   goodput %.1f%%\n'
+      % (_fmt_s(wall), _fmt_s(rep['productive_s']),
+         100.0 * rep['goodput_frac']))
+    w('  model flops %s   delivered %s/s%s\n'
+      % (_fmt_flops(rep['flops']),
+         _fmt_flops(rep['model_flops_per_s']),
+         ('   step MFU %.2f%%' % (100.0 * rep['step_mfu']))
+         if rep['step_mfu'] else ''))
+    w('\nloss buckets (wall attribution):\n')
+    w('  %-18s %12s %8s\n' % ('bucket', 'seconds', 'share'))
+    w('  %-18s %12s %7.1f%%\n' % ('execute', _fmt_s(rep['productive_s']),
+                                  100.0 * rep['goodput_frac']))
+    attributed = rep['productive_s']
+    for b in sorted(rep['loss_buckets']):
+        s = rep['loss_buckets'][b]
+        attributed += s
+        w('  %-18s %12s %7.1f%%\n'
+          % (b, _fmt_s(s), 100.0 * s / wall if wall else 0.0))
+    w('  %-18s %12s %7.1f%%\n'
+      % ('(unattributed)', _fmt_s(max(0.0, wall - attributed)),
+         100.0 * max(0.0, wall - attributed) / wall if wall else 0.0))
+    if rep['signatures']:
+        w('\nper-model / per-kind signatures:\n')
+        width = max(len(r['model']) for r in rep['signatures'])
+        w('  %-*s %-10s %9s %9s %10s %10s %10s %7s\n'
+          % (width, 'model', 'kind', 'dispatch', 'steps', 'device_s',
+             'flops', 'flops/s', 'share'))
+        dev_total = rep['device_s_by_signature'] or 1.0
+        for r in rep['signatures']:
+            w('  %-*s %-10s %9d %9d %10s %10s %10s %6.1f%%\n' % (
+                width, r['model'], r['kind'], r['dispatches'], r['steps'],
+                _fmt_s(r['device_s']), _fmt_flops(r['flops']),
+                _fmt_flops(r['flops'] / r['device_s'])
+                if r['device_s'] else '-',
+                100.0 * r['device_s'] / dev_total))
+    if rep['regression_counts'] or rep['regression_events']:
+        w('\nperf regressions:\n')
+        for kind, n in sorted(rep['regression_counts'].items()):
+            w('  perf_regression_total{kind=%s} %d\n' % (kind, n))
+        for e in rep['regression_events'][-20:]:
+            extras = {k: v for k, v in e.items()
+                      if k not in ('trace_id', 'kind', 'event', 'ts',
+                                   'regression')}
+            w('  [%s] %s %s\n' % (e.get('ts'), e.get('regression', '?'),
+                                  json.dumps(extras, sort_keys=True)))
+    else:
+        w('\nno perf regressions recorded\n')
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description='Live goodput/MFU report over monitor snapshot logs')
+    p.add_argument('paths', nargs='+',
+                   help='JSON-lines snapshot log(s) (FLAGS_monitor_log)')
+    p.add_argument('--merge', action='store_true',
+                   help='aggregate the newest snapshot of EACH file into '
+                        'one fleet report (per-rank logs)')
+    p.add_argument('--json', action='store_true',
+                   help='print the report dict as JSON')
+    args = p.parse_args(argv)
+    if len(args.paths) > 1 and not args.merge:
+        raise SystemExit('multiple paths require --merge')
+    snaps, events = [], []
+    for path in args.paths:
+        s, ev = read_log(path)
+        snaps.append(s)
+        events.extend(ev)
+    rep = report_from_snapshots(snaps, events)
+    if args.json:
+        print(json.dumps(rep, sort_keys=True))
+    else:
+        print_report(rep)
+
+
+if __name__ == '__main__':
+    main()
