@@ -947,6 +947,18 @@ def _child(mode):
         async_pipeline = {'error': '%s: %s' % (type(e).__name__,
                                                str(e)[:200])}
 
+    # parameter-server CTR row: the ctr_sharded_v1m shape with the
+    # embedding table PS-RESIDENT on live socket shards (paddle_tpu/ps)
+    # — samples/s with the pull-prefetch overlap vs the serialized
+    # pull->run->push loop, pull/push counter + byte deltas, and
+    # recompiles_after_warmup (contract: overlap > no_overlap at 0
+    # recompiles; tools/psbench.py)
+    try:
+        from tools.psbench import measure_ctr_ps
+        ctr_ps = measure_ctr_ps(rounds=2 if on_tpu else 3)
+    except Exception as e:
+        ctr_ps = {'error': '%s: %s' % (type(e).__name__, str(e)[:200])}
+
     # elastic-resume chaos row: a fatal fault kills a training step
     # mid-run; elastic_train_loop restores the latest checkpoint
     # RESHARDED onto half the devices and replays
@@ -1116,6 +1128,7 @@ def _child(mode):
         'generate_shared_prefix': generate_shared_prefix,
         'generate_speculative': generate_speculative,
         'async_pipeline': async_pipeline,
+        'ctr_ps': ctr_ps,
         'elastic_resume': elastic_resume,
         'costreport': costreport,
         'kernbench_mesh': kernbench_mesh,

@@ -57,6 +57,7 @@ from .pipeline import DataLoader, train_loop
 from . import dataset
 from . import models
 from . import transpiler
+from . import ps
 from . import parallel
 from . import monitor
 from . import trace

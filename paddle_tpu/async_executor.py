@@ -251,13 +251,37 @@ class AsyncExecutor(object):
         self.scope = scope
 
     def run(self, program, data_feed, filelist, thread_num=2,
-            fetch_list=None, debug=False, queue_size=16):
+            fetch_list=None, debug=False, queue_size=16, ps_session=None):
+        """ps_session: a ``ps.PSTrainerSession`` over `program` — the
+        Fluid async-CTR idiom (filelist in, sparse pull/push per
+        minibatch) against PS-resident embedding tables. Each parsed
+        batch pulls its rows, dispatches through the session's async
+        wrapper (the executor in-flight window still overlaps parsing,
+        pulling, and device compute), and pushes its row grads; the
+        session's staleness setting governs the pull/push ordering."""
         if isinstance(data_feed, DataFeedDesc):
             data_feed = MultiSlotDataFeed(data_feed)
         program = program if program is not None else \
             default_main_program()
         scope = self.scope if self.scope is not None else global_scope()
         thread_num = max(1, int(thread_num))
+        if ps_session is not None:
+            if getattr(program, '_ps_info', None) is None:
+                raise ValueError(
+                    "AsyncExecutor.run(ps_session=...): program has no PS "
+                    "tables — transpile it with mode='pserver' first")
+            if ps_session.program is not program:
+                raise ValueError(
+                    "AsyncExecutor.run(ps_session=...): the session was "
+                    "built over a DIFFERENT program than the one passed "
+                    "here — the session's program is what runs, so build "
+                    "the PSTrainerSession over this program")
+            if ps_session.scope is not None and \
+                    ps_session.scope is not scope:
+                raise ValueError(
+                    "AsyncExecutor.run(ps_session=...): the session's "
+                    "scope differs from this executor's run scope — pass "
+                    "one scope to both (or leave the session's unset)")
 
         files = queue.Queue()
         for p in filelist:
@@ -330,13 +354,24 @@ class AsyncExecutor(object):
             # async dispatch: the parser pool assembles the NEXT batches
             # while the device computes this step — the reference's
             # many-threads-per-AsyncExecutor overlap, natively, with the
-            # executor's bounded in-flight window capping pending steps
-            pending.append(self.executor.run_async(program, feed=feed,
-                                                   fetch_list=fetch_list,
-                                                   scope=scope))
+            # executor's bounded in-flight window capping pending steps.
+            # The PS path additionally pulls this batch's embedding rows
+            # here (host time the window overlaps with device compute)
+            # and pushes row grads when the step materializes.
+            if ps_session is not None:
+                if ps_session.scope is None:
+                    ps_session.scope = scope
+                pending.append(ps_session.run_async(feed,
+                                                    fetch_list=fetch_list))
+            else:
+                pending.append(self.executor.run_async(program, feed=feed,
+                                                       fetch_list=fetch_list,
+                                                       scope=scope))
             _harvest()
         self.executor.drain_async()
         if errors:
             raise errors[0]
         _harvest(all_steps=True)
+        if ps_session is not None:
+            ps_session.flush()
         return results

@@ -332,6 +332,17 @@ def _lower_backward(ctx, ops, lo, b, bop):
 
     missing = [n for n in wrt_names if n not in base_env]
     if missing:
+        if any('@ps_rows' in n for n in missing):
+            # PS-remote rows feeds (ps/program.py) are dense wrt LEAVES:
+            # the pullback's cotangent w.r.t. the fed rows is the row
+            # gradient the trainer pushes — but only a PS-aware driver
+            # feeds them
+            raise ValueError(
+                "backward: PS rows feeds %s were not supplied — drive "
+                "this program through ps.PSTrainerSession (or feed the "
+                "pulled rows yourself); a plain Executor.run cannot "
+                "train a pserver-transpiled program"
+                % [n for n in missing if '@ps_rows' in n])
         raise ValueError(
             "backward: cannot differentiate w.r.t. %s — they are neither fed "
             "nor in scope state (only leaf variables are supported)" % missing)

@@ -195,6 +195,42 @@ def _lookup_sparse_table(ctx, op):
                                           op.attr('padding_idx', -1)))
 
 
+@register_op('ps_lookup_table')
+def _ps_lookup_table(ctx, op):
+    """PS-remote embedding lookup (paddle_tpu/ps): the [height, width]
+    table lives on parameter servers, NOT in this program. `Rows` is a
+    FED [n, width] tensor of pulled rows in flat-id order (the trainer's
+    PSTrainerSession / serving PSRowResolver supplies it per batch); the
+    lowering applies only the lookup_table epilogue (padding_idx zeroing
+    + id-shape restore). Gradients: the rows feed is a dense wrt of the
+    backward op (ps/program.py wires it), so the pullback's cotangent
+    w.r.t. the feed IS the per-position row gradient pushed back to the
+    servers — no [height, width] cotangent can exist."""
+    from .tensor_ops import embedding_epilogue
+    rows_name = op.input('Rows')[0]
+    if not ctx.has(rows_name):
+        raise KeyError(
+            "ps_lookup_table(table=%r): rows feed %r was not supplied — "
+            "drive this program through ps.PSTrainerSession (training) "
+            "or a serving PSRowResolver, which pull the rows per batch"
+            % (op.attr('table_name'), rows_name))
+    rows = ctx.get(rows_name)
+    ids = ctx.in1(op, 'Ids')
+    flat = ids.reshape(-1).astype(jnp.int32)
+    if rows.shape[0] != flat.shape[0]:
+        raise ValueError(
+            "ps_lookup_table(table=%r): rows feed %r has %d rows for %d "
+            "ids — the pull must cover ids.reshape(-1) in order"
+            % (op.attr('table_name'), rows_name, rows.shape[0],
+               flat.shape[0]))
+
+    class _WShape(object):          # epilogue reads w.shape only
+        shape = (int(op.attr('height')), int(rows.shape[1]))
+
+    ctx.out(op, 'Out', embedding_epilogue(rows, flat, ids, _WShape,
+                                          op.attr('padding_idx', -1)))
+
+
 @register_op('fake_init', stateful=True)
 def _fake_init(ctx, op):
     """fake_init_op.cc: declare a var's shape without materializing data —

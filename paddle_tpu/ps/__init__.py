@@ -1,0 +1,39 @@
+"""paddle_tpu.ps — host-sharded parameter server for sparse embeddings.
+
+The Fluid production capability the TPU port was missing: embedding
+tables BIGGER than device (or even host) memory, hash-sharded across
+parameter-server processes, with sparse pull/push per minibatch, a
+prefetch path that overlaps the next batch's row fetch with the current
+step's device execution, and a serving path whose hot rows live in a
+bounded staleness-versioned LRU. See docs/parameter_server.md.
+
+Layer map:
+
+- ``table``      — PSTable / PSTableSpec: one shard's lazy row store;
+  push applies the device path's own ``_adam_sparse`` body.
+- ``transport``  — PSServer / PSClient: length-prefixed-pickle socket
+  RPC (or in-process shards), request batching, retry at the
+  ``ps_pull`` / ``ps_push`` fault sites.
+- ``cache``      — HotRowCache: bounded LRU + staleness eviction.
+- ``program``    — convert_to_ps_program: the transpile(mode='pserver')
+  rewrite; build_pserver_tables: per-endpoint startup state.
+- ``worker``     — PSTrainerSession: pull -> step -> push with the
+  run_async overlap window.
+- ``serving``    — PSRowResolver / psify_predictor: the CTR inference
+  path for ServingEngine.
+"""
+from .table import PSTable, PSTableSpec, owners_of_ids, shard_of_key
+from .transport import PSClient, PSRemoteError, PSServer
+from .cache import HotRowCache
+from .program import (PSLookupSite, PSProgramInfo, build_pserver_tables,
+                      convert_to_ps_program)
+from .worker import PSTrainerSession
+from .serving import PSRowResolver, psify_predictor
+
+__all__ = [
+    'PSTable', 'PSTableSpec', 'PSServer', 'PSClient', 'PSRemoteError',
+    'HotRowCache', 'PSTrainerSession', 'PSRowResolver',
+    'PSLookupSite', 'PSProgramInfo',
+    'convert_to_ps_program', 'build_pserver_tables', 'psify_predictor',
+    'owners_of_ids', 'shard_of_key',
+]

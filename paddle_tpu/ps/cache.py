@@ -1,0 +1,134 @@
+"""HotRowCache: bounded hot-row LRU with staleness-versioned eviction.
+
+The serving side of a PS-resident table must not pay a network pull per
+request for the head of the id distribution (ads/recsys traffic is
+heavily zipfian). This cache keeps the hot rows in-process:
+
+- bounded LRU over (table, id) -> (row, version): `max_rows` caps
+  resident rows, the coldest evict first (``ps_cache_evicted_total
+  {reason="lru"}``);
+- staleness-versioned eviction: every pull response carries the OLDEST
+  shard version it covers (shard counters advance independently; the
+  min is the only stamp a bound can trust — PSClient.pull); the cache
+  tracks the LATEST version seen per table, and an entry more than
+  `max_staleness` versions behind it is dropped on lookup
+  (``reason="stale"``) and re-pulled.
+  `max_staleness=None` (default) disables version eviction — a pure
+  LRU for frozen serving snapshots;
+- hit accounting: ``ps_cache_hit_total`` / ``ps_cache_miss_total``
+  counters plus the live ``ps_cache_hit_rate`` / ``ps_cache_rows``
+  gauges.
+
+Thread-safe; rows are stored as 1-d numpy copies.
+"""
+import collections
+import threading
+
+import numpy as np
+
+from .. import monitor
+
+__all__ = ['HotRowCache']
+
+
+class HotRowCache(object):
+    def __init__(self, max_rows=1 << 16, max_staleness=None):
+        self.max_rows = int(max_rows)
+        self.max_staleness = (None if max_staleness is None
+                              else int(max_staleness))
+        self._od = collections.OrderedDict()   # (table, id) -> (row, ver)
+        self._latest = {}                      # table -> latest version
+        self._lock = threading.Lock()
+        self._hits = 0
+        self._misses = 0
+
+    # ------------------------------------------------------------------
+    def note_version(self, table, version):
+        """Record the newest shard version observed for `table` (pull
+        responses carry it); lookups evict entries that have fallen more
+        than `max_staleness` versions behind."""
+        with self._lock:
+            if version > self._latest.get(table, -1):
+                self._latest[table] = int(version)
+
+    def get_many(self, table, ids):
+        """Look up `ids` (unique, 1-d). Returns (rows_by_pos, miss_ids)
+        where rows_by_pos maps position -> row for hits; stale entries
+        count as misses and are evicted."""
+        ids = np.asarray(ids).reshape(-1)
+        hits = {}
+        misses = []
+        with self._lock:
+            horizon = None
+            if self.max_staleness is not None:
+                horizon = self._latest.get(table, 0) - self.max_staleness
+            for pos, i in enumerate(ids.tolist()):
+                key = (table, i)
+                ent = self._od.get(key)
+                if ent is not None and horizon is not None \
+                        and ent[1] < horizon:
+                    del self._od[key]
+                    monitor.inc('ps_cache_evicted_total',
+                                labels={'reason': 'stale'})
+                    ent = None
+                if ent is None:
+                    misses.append(i)
+                else:
+                    self._od.move_to_end(key)
+                    hits[pos] = ent[0]
+            self._hits += len(hits)
+            self._misses += len(misses)
+            self._publish_locked()
+        if hits:
+            monitor.inc('ps_cache_hit_total', float(len(hits)))
+        if misses:
+            monitor.inc('ps_cache_miss_total', float(len(misses)))
+        return hits, np.asarray(misses, ids.dtype)
+
+    def put_many(self, table, ids, rows, version):
+        """Insert pulled rows (ids unique, rows [n, d]) at `version`."""
+        rows = np.asarray(rows)
+        with self._lock:
+            if version > self._latest.get(table, -1):
+                self._latest[table] = int(version)
+            for i, row in zip(np.asarray(ids).reshape(-1).tolist(), rows):
+                self._od[(table, i)] = (np.array(row, copy=True),
+                                        int(version))
+                self._od.move_to_end((table, i))
+            while len(self._od) > self.max_rows:
+                self._od.popitem(last=False)
+                monitor.inc('ps_cache_evicted_total',
+                            labels={'reason': 'lru'})
+            self._publish_locked()
+
+    def invalidate(self, table=None):
+        with self._lock:
+            if table is None:
+                self._od.clear()
+            else:
+                for key in [k for k in self._od if k[0] == table]:
+                    del self._od[key]
+            self._publish_locked()
+
+    # ------------------------------------------------------------------
+    def _publish_locked(self):
+        total = self._hits + self._misses
+        if total:
+            monitor.set_gauge('ps_cache_hit_rate', self._hits / total)
+        monitor.set_gauge('ps_cache_rows', float(len(self._od)))
+
+    def stats(self):
+        with self._lock:
+            total = self._hits + self._misses
+            return {
+                'rows': len(self._od),
+                'max_rows': self.max_rows,
+                'hits': self._hits,
+                'misses': self._misses,
+                'hit_rate': (self._hits / total) if total else 0.0,
+                'latest_versions': dict(self._latest),
+            }
+
+    def __len__(self):
+        with self._lock:
+            return len(self._od)

@@ -1,0 +1,346 @@
+"""Program surgery: rewrite in-device embedding tables into PS-remote ones.
+
+`convert_to_ps_program` is the engine behind
+``DistributeTranspiler.transpile(mode='pserver')`` (and the inference-side
+``psify_predictor``): for every targeted `lookup_table` it
+
+1. replaces the op with ``ps_lookup_table`` (ops/dist_ops.py), whose
+   `Rows` input is a FED [n, width] tensor of pulled rows in flat-id
+   order — the [height, width] parameter never exists in the trainer
+   process or on device;
+2. re-points the program's `backward` meta op: the table leaves
+   wrt_names/sparse_wrt and each site's rows feed enters as a DENSE wrt,
+   so the pullback's cotangent w.r.t. the fed rows IS the per-position
+   row gradient the trainer pushes (core/lowering.py differentiates fed
+   leaves like any other wrt);
+3. strips the table's optimizer op (+ its accumulators) from the main
+   program and every init of the table/accumulators from the startup
+   program — the per-row optimizer runs server-side (table.py, via the
+   shared `_adam_sparse` body), configured from the removed op's attrs;
+4. records everything in a `PSProgramInfo` attached to the program
+   (`program._ps_info`), which PSTrainerSession / PSRowResolver /
+   build_pserver_tables consume.
+
+The default (mesh-sharding) transpile path does not run any of this —
+programs without PS tables are untouched byte-for-byte.
+"""
+import collections
+
+import numpy as np
+
+from ..framework import Parameter, default_startup_program
+from .table import PSTable, PSTableSpec
+
+__all__ = ['PSLookupSite', 'PSProgramInfo', 'convert_to_ps_program',
+           'build_pserver_tables']
+
+_OPTIMIZER_OPS = ('sgd', 'momentum', 'lars_momentum', 'adagrad', 'adam',
+                  'adamax', 'adadelta', 'decayed_adagrad', 'rmsprop',
+                  'ftrl', 'proximal_gd', 'proximal_adagrad')
+
+
+class PSLookupSite(object):
+    """One rewritten lookup site: which table, which ids input, and the
+    names of the rows feed + its gradient fetch."""
+
+    __slots__ = ('table', 'rows_var', 'grad_var', 'ids_var', 'width',
+                 'trainable')
+
+    def __init__(self, table, rows_var, grad_var, ids_var, width,
+                 trainable):
+        self.table = table
+        self.rows_var = rows_var
+        self.grad_var = grad_var
+        self.ids_var = ids_var
+        self.width = width
+        self.trainable = trainable
+
+    def __repr__(self):
+        return "PSLookupSite(%s <- %s as %s)" % (self.table, self.ids_var,
+                                                 self.rows_var)
+
+
+class PSProgramInfo(object):
+    """tables: {name: PSTableSpec}; sites: [PSLookupSite] in program
+    order (push concatenation order == the device path's multi-site
+    SelectedRows concat order)."""
+
+    def __init__(self, tables, sites):
+        self.tables = tables
+        self.sites = sites
+
+    @property
+    def grad_names(self):
+        return [s.grad_var for s in self.sites if s.trainable]
+
+    def __repr__(self):
+        return "PSProgramInfo(tables=%s, sites=%d)" % (
+            sorted(self.tables), len(self.sites))
+
+
+def _fill_value_of(var_name, programs):
+    """The constant a fill_constant init op assigns to `var_name`, or
+    None (searches main + startup — initializer ops land in startup)."""
+    for program in programs:
+        if program is None:
+            continue
+        for block in program.blocks:
+            for op in block.ops:
+                if op.type == 'fill_constant' and \
+                        var_name in op.output_arg_names:
+                    return float(op.attr('value', 0.0))
+    return None
+
+
+def _table_init_of(w_name, startup):
+    """(init_kind, init_value) from the startup init op of the table."""
+    if startup is not None:
+        for op in startup.global_block().ops:
+            if w_name in op.output_arg_names:
+                if op.type == 'fill_constant':
+                    return 'fill_constant', float(op.attr('value', 0.0))
+                return op.type, 0.0
+    return 'none', 0.0
+
+
+def _strip_startup_inits(startup, names):
+    """Remove every startup op initializing one of `names` (the [height,
+    width] fill the whole subsystem exists to avoid) and the vars."""
+    if startup is None:
+        return
+    for block in startup.blocks:
+        keep = [op for op in block.ops
+                if not (set(op.output_arg_names) & names)]
+        if len(keep) != len(block.ops):
+            block.ops[:] = keep
+            block.program._bump_version()
+        for n in names:
+            block.vars.pop(n, None)
+
+
+def _optimizer_spec_from_op(op, w_name, programs):
+    """Map the removed in-device optimizer op to the PSTable optimizer
+    config (type + hyperparameters + learning rate)."""
+    lr_names = op.input('LearningRate')
+    lr = _fill_value_of(lr_names[0], programs) if lr_names else None
+    if lr is None:
+        raise ValueError(
+            "pserver transpile: cannot resolve a constant learning rate "
+            "for table %r (op %s, lr var %s) — LR schedules are not "
+            "supported on PS tables yet; use a float learning_rate"
+            % (w_name, op.type, lr_names))
+    if op.type == 'adam':
+        return dict(optimizer='adam', lr=lr,
+                    beta1=float(op.attr('beta1', 0.9)),
+                    beta2=float(op.attr('beta2', 0.999)),
+                    epsilon=float(op.attr('epsilon', 1e-8)))
+    if op.type == 'sgd':
+        return dict(optimizer='sgd', lr=lr)
+    raise ValueError(
+        "pserver transpile: table %r is optimized by %r, but the PS "
+        "subsystem mirrors only the adam/sgd sparse kernels (table.py); "
+        "switch the table's optimizer or keep it in-device"
+        % (w_name, op.type))
+
+
+_SHAPE_ONLY_OPS = ('reshape', 'reshape2', 'unsqueeze', 'unsqueeze2',
+                   'squeeze', 'squeeze2', 'cast')
+
+
+def _resolve_ids_feed(gb, ids_name):
+    """Trace a lookup's Ids input back to the FED variable it derives
+    from, through ops that preserve the raveled id order (reshape /
+    squeeze / cast). The host pull reads ids from the feed dict, so the
+    flat order there must equal ``ids.reshape(-1)`` at the lookup — these
+    ops guarantee exactly that. Anything else (slice, concat, compute)
+    would reorder or synthesize ids the host cannot see."""
+    producers = {}
+    for op in gb.ops:
+        for n in op.output_arg_names:
+            producers.setdefault(n, op)
+    name = ids_name
+    seen = set()
+    while name in producers and name not in seen:
+        seen.add(name)
+        op = producers[name]
+        if op.type not in _SHAPE_ONLY_OPS or not op.input('X'):
+            raise ValueError(
+                "pserver transpile: lookup ids %r derive from op %r, "
+                "which does not preserve flat id order — feed the table's "
+                "ids directly (or through reshape/cast only) so the "
+                "trainer can pull rows host-side" % (ids_name, op.type))
+        name = op.input('X')[0]
+    return name
+
+
+def convert_to_ps_program(program, startup_program=None, tables=None):
+    """Rewrite `program` (in place) so the tables' lookups run against
+    PS-pulled rows. `tables`: iterable of parameter names; default = the
+    W of every ``lookup_table`` op with ``is_distributed=True`` (the
+    reference's distributed-lookup-table criterion). Returns the
+    `PSProgramInfo` (also attached as ``program._ps_info``).
+
+    Works on training programs (backward + optimizer surgery) and on
+    inference programs (lookup rewrite only)."""
+    gb = program.global_block()
+    if startup_program is None:
+        try:
+            startup_program = default_startup_program()
+        except Exception:       # noqa: BLE001 — inference-only callers
+            startup_program = None
+
+    if tables is None:
+        targets = []
+        for block in program.blocks:
+            for op in block.ops:
+                if op.type in ('lookup_table', 'lookup_sparse_table') and \
+                        op.attr('is_distributed', False):
+                    w = op.input('W')[0]
+                    if w not in targets:
+                        targets.append(w)
+    else:
+        targets = [t.name if hasattr(t, 'name') else t for t in tables]
+    if not targets:
+        raise ValueError(
+            "pserver transpile: no PS-remote tables found — mark the "
+            "embedding with is_distributed=True (layers.embedding) or "
+            "pass tables=[...] explicitly")
+
+    for block in program.blocks[1:]:
+        for op in block.ops:
+            hit = set(op.input_arg_names) & set(targets)
+            if hit:
+                raise ValueError(
+                    "pserver transpile: table %s is consumed inside a "
+                    "control-flow sub-block (op %s); PS-remote tables "
+                    "must be read by main-block lookups only — the rows "
+                    "feed is formed per step on the host" % (sorted(hit),
+                                                             op.type))
+
+    specs = {}
+    widths = {}
+    for w_name in targets:
+        var = gb.vars.get(w_name)
+        if var is None or not isinstance(var, Parameter) or \
+                var.shape is None or len(var.shape) != 2:
+            raise ValueError(
+                "pserver transpile: %r is not a 2-d embedding parameter "
+                "of this program" % w_name)
+        widths[w_name] = int(var.shape[1])
+        init_kind, init_value = _table_init_of(w_name, startup_program)
+        specs[w_name] = dict(name=w_name, height=int(var.shape[0]),
+                             width=int(var.shape[1]),
+                             dtype=str(np.dtype(var.dtype)),
+                             init_kind=init_kind, init_value=init_value)
+
+    # 1. rewrite the lookup ops ----------------------------------------
+    sites = []
+    site_count = collections.Counter()
+    for op in gb.ops:
+        if op.type not in ('lookup_table', 'lookup_sparse_table'):
+            continue
+        w_name = op.input('W')[0]
+        if w_name not in targets:
+            continue
+        k = site_count[w_name]
+        site_count[w_name] += 1
+        rows_name = '%s@ps_rows%d' % (w_name, k)
+        width = widths[w_name]
+        var = gb.vars[w_name]
+        gb.create_var(name=rows_name, shape=(-1, width), dtype=var.dtype,
+                      persistable=False, stop_gradient=False)
+        trainable = getattr(var, 'trainable', True)
+        grad_name = rows_name + '@GRAD'
+        if trainable:
+            gb.create_var(name=grad_name, shape=(-1, width),
+                          dtype=var.dtype, persistable=False)
+        op.type = 'ps_lookup_table'
+        new_inputs = collections.OrderedDict()
+        new_inputs['Ids'] = list(op.input('Ids'))
+        new_inputs['Rows'] = [rows_name]
+        op.inputs = new_inputs
+        op.attrs = dict(op.attrs)
+        op.attrs.update({'table_name': w_name,
+                         'height': specs[w_name]['height'],
+                         'width': width,
+                         'padding_idx': op.attr('padding_idx', -1)})
+        op.attrs.pop('is_sparse', None)
+        op.attrs.pop('is_distributed', None)
+        program._bump_version()
+        sites.append(PSLookupSite(
+            w_name, rows_name, grad_name,
+            _resolve_ids_feed(gb, op.input('Ids')[0]), width, trainable))
+
+    # 2. backward surgery ----------------------------------------------
+    for op in gb.ops:
+        if op.type != 'backward':
+            continue
+        wrt = list(op.attr('wrt_names'))
+        sparse = [n for n in (op.attr('sparse_wrt') or ())
+                  if n not in targets]
+        grads = list(op.output('Grads'))
+        for w_name in targets:
+            while w_name in wrt:
+                i = wrt.index(w_name)
+                del wrt[i]
+                if i < len(grads):
+                    del grads[i]
+        for site in sites:
+            if site.trainable and site.rows_var not in wrt:
+                wrt.append(site.rows_var)
+                grads.append(site.grad_var)
+        op.attrs['wrt_names'] = wrt
+        op.attrs['sparse_wrt'] = sparse
+        op.outputs['Grads'] = grads
+        program._bump_version()
+
+    # 3. optimizer strip + server-side optimizer config ----------------
+    removed_acc = set()
+    for w_name in targets:
+        opt_cfg = None
+        keep_ops = []
+        for op in gb.ops:
+            if op.type in _OPTIMIZER_OPS and op.input('Param') == [w_name]:
+                if opt_cfg is None:
+                    opt_cfg = _optimizer_spec_from_op(
+                        op, w_name, (program, startup_program))
+                    for slot in ('Moment', 'Moment1', 'Moment2',
+                                 'Velocity', 'Beta1Pow', 'Beta2Pow',
+                                 'InfNorm', 'AvgSquaredGrad',
+                                 'AvgSquaredUpdate', 'MeanSquare',
+                                 'SquaredAccumulator',
+                                 'LinearAccumulator'):
+                        removed_acc.update(op.input(slot))
+                continue
+            if op.type == 'fused_adam' and w_name in op.input('Params'):
+                raise ValueError(
+                    "pserver transpile: table %r rides a fused_adam op; "
+                    "build the optimizer with fuse=False so the table "
+                    "keeps its own op to strip" % w_name)
+            keep_ops.append(op)
+        if len(keep_ops) != len(gb.ops):
+            gb.ops[:] = keep_ops
+            program._bump_version()
+        if opt_cfg is not None:
+            specs[w_name].update(opt_cfg)
+
+    # 4. drop the table params + accumulators everywhere ---------------
+    doomed = set(targets) | removed_acc
+    for n in doomed:
+        gb.vars.pop(n, None)
+    _strip_startup_inits(startup_program, doomed)
+
+    info = PSProgramInfo(
+        {n: PSTableSpec(**specs[n]) for n in targets}, sites)
+    program._ps_info = info
+    return info
+
+
+def build_pserver_tables(info, num_shards, shard_id):
+    """Instantiate one pserver's shard of every table in `info` —
+    the runnable startup state ``get_pserver_programs`` returns."""
+    if not 0 <= int(shard_id) < int(num_shards):
+        raise ValueError('shard_id %r outside [0, %r)'
+                         % (shard_id, num_shards))
+    return {name: PSTable(spec, num_shards=num_shards, shard_id=shard_id)
+            for name, spec in info.tables.items()}

@@ -1,0 +1,140 @@
+"""Serving over PS-resident tables: PSRowResolver + psify_predictor.
+
+The CTR inference path: the model's embedding table lives on the
+parameter servers, the serving process holds only a bounded
+`HotRowCache`. On request ADMISSION (`ServingEngine.submit`) the
+resolver pulls the request's rows through the cache — zipfian traffic
+makes steady-state admissions cache hits — and at batch-formation time
+it assembles each ``ps_lookup_table`` site's rows feed from the cached
+rows, so the bucketed/padded batch executes with fixed signatures and
+zero recompiles while the full table never resides in process.
+"""
+import time
+
+import numpy as np
+
+from .. import trace as trace_mod
+from .cache import HotRowCache
+from .program import convert_to_ps_program
+
+__all__ = ['PSRowResolver', 'psify_predictor']
+
+
+class PSRowResolver(object):
+    """Resolve a PS-converted program's rows feeds from client + cache.
+
+    `sites` come from ``program._ps_info``; `cache=None` builds a
+    default 64k-row `HotRowCache` (pass your own for staleness bounds,
+    or ``cache=False`` to pull straight through)."""
+
+    def __init__(self, client, program=None, sites=None, cache=None):
+        heights = {}
+        if sites is None:
+            info = getattr(program, '_ps_info', None)
+            if info is None:
+                raise ValueError(
+                    'PSRowResolver: program has no _ps_info — convert it '
+                    'with ps.convert_to_ps_program / psify_predictor')
+            sites = info.sites
+            heights = {n: spec.height for n, spec in info.tables.items()}
+        self.client = client
+        self.sites = list(sites)
+        self._heights = heights
+        if cache is None:
+            cache = HotRowCache()
+        self.cache = cache if cache is not False else None
+
+    @property
+    def managed_names(self):
+        """Feed names the resolver supplies (exempt from engine feed
+        validation)."""
+        return {s.rows_var for s in self.sites}
+
+    # ------------------------------------------------------------------
+    def _lookup(self, table, flat_ids):
+        """Rows for flat_ids (in order), through the cache. Out-of-range
+        ids (bucket pad_value fill, bad request ids) clamp into the
+        table — the device gather's clamp semantics — instead of
+        failing the whole batch on the server's range check."""
+        height = self._heights.get(table)
+        if height:
+            flat_ids = np.clip(flat_ids, 0, height - 1)
+        uniq, inv = np.unique(flat_ids, return_inverse=True)
+        if self.cache is None:
+            return self.client.pull(table, uniq)[inv]
+        hits, miss_ids = self.cache.get_many(table, uniq)
+        dtype = np.float32
+        width = None
+        if miss_ids.size:
+            pulled, version = self.client.pull(table, miss_ids,
+                                               return_version=True)
+            self.cache.put_many(table, miss_ids, pulled, version)
+            width = pulled.shape[1]
+            dtype = pulled.dtype
+        elif hits:
+            first = next(iter(hits.values()))
+            width = first.shape[0]
+            dtype = first.dtype
+        rows_u = np.empty((uniq.shape[0], width or 0), dtype)
+        for pos, row in hits.items():
+            rows_u[pos] = row
+        if miss_ids.size:
+            miss_pos = [p for p in range(uniq.shape[0]) if p not in hits]
+            rows_u[miss_pos] = pulled
+        return rows_u[inv]
+
+    def prewarm(self, feed):
+        """Admission-time pull: warm the cache with this request's rows
+        (counts into the request's `ps` trace stage at the engine).
+        No-op without a cache — the pull would be discarded and the
+        same rows re-pulled at batch formation."""
+        if self.cache is None:
+            return 0.0
+        t0 = time.perf_counter()
+        for s in self.sites:
+            if s.ids_var in feed:
+                v = feed[s.ids_var]
+                if isinstance(v, tuple):
+                    v = v[0]
+                self._lookup(s.table, np.asarray(v).reshape(-1)
+                             .astype(np.int64))
+        dt = time.perf_counter() - t0
+        tr = trace_mod.current()
+        if tr is not None:
+            tr.add_stage('ps', dt)
+        return dt
+
+    def resolve(self, feed):
+        """{rows_var: rows} for every site whose ids are in `feed` and
+        whose rows feed is not already present (idempotent)."""
+        out = {}
+        for s in self.sites:
+            if s.rows_var in feed or s.ids_var not in feed:
+                continue
+            v = feed[s.ids_var]
+            if isinstance(v, tuple):
+                v = v[0]
+            flat = np.asarray(v).reshape(-1).astype(np.int64)
+            out[s.rows_var] = self._lookup(s.table, flat)
+        return out
+
+
+def psify_predictor(predictor, client, cache=None, load_tables=True,
+                    tables=None):
+    """Convert a loaded inference `Predictor` to serve its embedding
+    tables from the parameter server: rewrites the program's lookups
+    (``convert_to_ps_program``), LOADS the scope-resident table values
+    into the PS (`load_tables=True` — skip when the PS already holds the
+    trained rows), drops the tables from the predictor scope, and
+    returns the `PSRowResolver` to hand to ``ServingConfig``."""
+    from ..framework import Program
+    info = convert_to_ps_program(predictor.program,
+                                 startup_program=Program(),
+                                 tables=tables)
+    for name in info.tables:
+        if load_tables:
+            arr = predictor.scope.get(name)
+            if arr is not None:
+                client.load(name, np.asarray(arr))
+        predictor.scope.drop(name)
+    return PSRowResolver(client, program=predictor.program, cache=cache)

@@ -1,0 +1,304 @@
+"""PSTable: one shard of a host-memory sparse embedding table.
+
+The reference serves 10^8+-row CTR embeddings from parameter-server
+processes (operators/distributed/*, pserver side of the distribute
+transpiler); device memory never holds the full table. This module is
+that row store, rebuilt for the jax runtime:
+
+- rows live in HOST memory in a growable slab (`_data` [cap, width]) with
+  an id -> slot dict; rows materialize lazily on first touch with the
+  table's constant init (the reference's auto-grown table,
+  lookup_sparse_table_op.cc), so a 10^8-row table costs only its TOUCHED
+  rows;
+- row -> shard placement uses the SAME stable crc32 digest as the
+  transpiler's HashName dispatcher (transpiler/ps_dispatcher.py) — the
+  id's decimal string is the "block name" — so placement is identical
+  whether computed by a trainer, a server, or after a restart;
+- the sparse optimizer apply is LITERALLY the device path's row-wise
+  update: `push` calls ops/optimizer_ops._adam_sparse (the one body the
+  in-device `adam` op and `fused_adam` share), so PS-resident and
+  device-resident tables cannot drift in optimizer semantics. Beta-power
+  state is derived from the trainer-supplied global step by the same
+  repeated-f32-multiplication the device accumulator performs, keeping
+  lr_t bit-identical to the in-device schedule.
+
+Thread-safe per table (the transport layer serves concurrent
+connections); all numerics float32 unless the spec says otherwise.
+"""
+import threading
+
+import numpy as np
+
+__all__ = ['PSTableSpec', 'PSTable', 'shard_of_key', 'owners_of_ids']
+
+
+def shard_of_key(key, num_shards):
+    """Stable shard index for a row id / block name: the ps_dispatcher
+    HashName digest (crc32 of the decimal string — NOT python hash(),
+    which is salted per process)."""
+    from ..transpiler.ps_dispatcher import HashName
+    return HashName._hash_block(key, num_shards)
+
+
+def owners_of_ids(ids, num_shards):
+    """Vectorized shard_of_key over an id array -> int32 owner indices."""
+    ids = np.asarray(ids).reshape(-1)
+    if num_shards <= 1:
+        return np.zeros(ids.shape[0], np.int32)
+    import zlib
+    return np.fromiter(
+        (zlib.crc32(str(int(i)).encode('utf-8')) % num_shards for i in ids),
+        np.int32, ids.shape[0])
+
+
+_ADAM_APPLY_CACHE = {}
+_ADAM_APPLY_LOCK = threading.Lock()
+
+
+def _shared_adam_apply(beta1, beta2, epsilon):
+    """One jitted `_adam_sparse` body per (beta1, beta2, epsilon)."""
+    key = (float(beta1), float(beta2), float(epsilon))
+    with _ADAM_APPLY_LOCK:
+        fn = _ADAM_APPLY_CACHE.get(key)
+        if fn is None:
+            import jax
+            from ..ops.optimizer_ops import _adam_sparse
+
+            def apply(p, g, m1, m2, lr_t, _b1=key[0], _b2=key[1],
+                      _eps=key[2]):
+                return _adam_sparse(p, g, m1, m2, lr_t, _b1, _b2, _eps)
+
+            fn = _ADAM_APPLY_CACHE[key] = jax.jit(apply)
+        return fn
+
+
+class PSTableSpec(object):
+    """Declarative table description — picklable, so trainers, servers and
+    tools can agree on a table without sharing a Program object.
+
+    optimizer: 'adam' | 'sgd' (the two device sparse kernels mirrored
+    here); hyperparameters mirror the removed in-device optimizer op's
+    attrs. init_value is the lazy-materialization constant; tables whose
+    original initializer was random must be load()ed explicitly (see
+    docs/parameter_server.md, "initialization").
+    """
+
+    def __init__(self, name, height, width, dtype='float32',
+                 optimizer='adam', lr=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, init_value=0.0, init_kind='fill_constant'):
+        if optimizer not in ('adam', 'sgd'):
+            raise ValueError(
+                "PSTableSpec %r: optimizer must be 'adam' or 'sgd' (the "
+                "device sparse kernels mirrored host-side); got %r — keep "
+                "the table on an adam/sgd optimizer or leave it in-device"
+                % (name, optimizer))
+        self.name = name
+        self.height = int(height)
+        self.width = int(width)
+        self.dtype = str(dtype)
+        self.optimizer = optimizer
+        self.lr = float(lr)
+        self.beta1 = float(beta1)
+        self.beta2 = float(beta2)
+        self.epsilon = float(epsilon)
+        self.init_value = float(init_value)
+        self.init_kind = init_kind
+
+    def to_dict(self):
+        return dict(self.__dict__)
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(**d)
+
+    def __repr__(self):
+        return "PSTableSpec(%r, [%d, %d], %s, %s)" % (
+            self.name, self.height, self.width, self.dtype, self.optimizer)
+
+
+class PSTable(object):
+    """One shard of a hash-sharded row store, with pull/push/load.
+
+    `pull(ids)` -> rows [n, width] (lazily materialized); `push(ids,
+    grads, step)` applies the row-wise optimizer via the shared
+    `_adam_sparse` body (duplicate ids accumulate exactly like a
+    SelectedRows gradient). `version` counts applied pushes — the
+    staleness unit the serving HotRowCache evicts on.
+    """
+
+    _GROW = 1024
+
+    def __init__(self, spec, num_shards=1, shard_id=0):
+        if isinstance(spec, dict):
+            spec = PSTableSpec.from_dict(spec)
+        self.spec = spec
+        self.num_shards = int(num_shards)
+        self.shard_id = int(shard_id)
+        self.version = 0
+        self._lock = threading.RLock()
+        self._slot = {}
+        self._n = 0
+        dt = np.dtype(spec.dtype)
+        self._data = np.empty((0, spec.width), dt)
+        self._m1 = np.empty((0, spec.width), dt)
+        self._m2 = np.empty((0, spec.width), dt)
+        # f32 beta-power accumulators, advanced by repeated multiplication
+        # exactly like the device Beta1Pow/Beta2Pow state (bitwise lr_t)
+        self._pow_step = 0
+        self._b1p = np.float32(1.0)
+        self._b2p = np.float32(1.0)
+        self._apply_jit = None
+
+    # ------------------------------------------------------------------
+    def _check_ids(self, ids):
+        ids = np.asarray(ids).reshape(-1).astype(np.int64)
+        if ids.size and (ids.min() < 0 or ids.max() >= self.spec.height):
+            bad = ids[(ids < 0) | (ids >= self.spec.height)][:5]
+            raise ValueError(
+                "table %r: ids %s out of range [0, %d)"
+                % (self.spec.name, bad.tolist(), self.spec.height))
+        return ids
+
+    def _slots_for(self, uniq_ids):
+        """Slab slots for unique ids, materializing missing rows with the
+        constant init (auto-grown-table semantics)."""
+        slot = self._slot
+        new = [i for i in uniq_ids.tolist() if i not in slot]
+        if new:
+            need = self._n + len(new)
+            if need > self._data.shape[0]:
+                cap = max(need, self._data.shape[0] * 2, self._GROW)
+                for name in ('_data', '_m1', '_m2'):
+                    old = getattr(self, name)
+                    grown = np.empty((cap, self.spec.width), old.dtype)
+                    grown[:self._n] = old[:self._n]
+                    setattr(self, name, grown)
+            lo = self._n
+            for i in new:
+                slot[i] = self._n
+                self._n += 1
+            self._data[lo:self._n] = self.spec.init_value
+            self._m1[lo:self._n] = 0
+            self._m2[lo:self._n] = 0
+        return np.fromiter((slot[i] for i in uniq_ids.tolist()),
+                           np.int64, uniq_ids.shape[0])
+
+    # ------------------------------------------------------------------
+    def pull(self, ids):
+        """Rows for `ids` (any duplicates allowed), in id order.
+        Returns (rows [n, width], version)."""
+        ids = self._check_ids(ids)
+        with self._lock:
+            uniq, inv = np.unique(ids, return_inverse=True)
+            slots = self._slots_for(uniq)
+            # one gather (fancy indexing already returns a private copy)
+            return self._data[slots[inv]], self.version
+
+    def _beta_pows(self, step):
+        """(beta1^step, beta2^step) as f32 accumulated multiplicatively —
+        the exact sequence the device Beta{1,2}Pow state walks, so lr_t
+        matches the in-device adam bitwise for any step reachable by
+        one-push-per-step training. Recomputes from scratch on a step
+        jump (restore, replay)."""
+        if step < self._pow_step:
+            self._pow_step, self._b1p, self._b2p = 0, np.float32(1.0), \
+                np.float32(1.0)
+        b1 = np.float32(self.spec.beta1)
+        b2 = np.float32(self.spec.beta2)
+        while self._pow_step < step:
+            self._b1p = np.float32(self._b1p * b1)
+            self._b2p = np.float32(self._b2p * b2)
+            self._pow_step += 1
+        return self._b1p, self._b2p
+
+    def _apply_fn(self):
+        # shared per (b1, b2, eps) — NOT per table/shard — so every
+        # shard of every table with the same hyperparameters reuses one
+        # jitted body (and its per-shape compile cache) instead of
+        # paying a compile per PSTable instance
+        if self._apply_jit is None:
+            self._apply_jit = _shared_adam_apply(
+                self.spec.beta1, self.spec.beta2, self.spec.epsilon)
+        return self._apply_jit
+
+    def push(self, ids, grads, step):
+        """Apply one step's row gradients. `ids` may repeat (un-merged
+        SelectedRows state — _adam_sparse merges with the same stable
+        ordering the device kernel uses); `step` is the trainer's global
+        1-based step, from which the beta-power/lr_t schedule derives.
+        Returns the new shard version."""
+        ids = self._check_ids(ids)
+        grads = np.asarray(grads)
+        if grads.ndim != 2 or grads.shape != (ids.shape[0], self.spec.width):
+            raise ValueError(
+                "table %r push: grads shape %s does not match (%d, %d)"
+                % (self.spec.name, grads.shape, ids.shape[0],
+                   self.spec.width))
+        step = max(1, int(step))
+        with self._lock:
+            uniq, inv = np.unique(ids, return_inverse=True)
+            slots = self._slots_for(uniq)
+            if self.spec.optimizer == 'adam':
+                import jax.numpy as jnp
+                from ..core.selected_rows import SelectedRows
+                b1p, b2p = self._beta_pows(step)
+                lr_t = np.float32(
+                    np.float32(self.spec.lr)
+                    * np.sqrt(np.float32(1.0) - b2p)
+                    / (np.float32(1.0) - b1p))
+                g = SelectedRows(jnp.asarray(inv.astype(np.int32)),
+                                 jnp.asarray(grads), int(uniq.shape[0]))
+                po, m1o, m2o = self._apply_fn()(
+                    jnp.asarray(self._data[slots]), g,
+                    jnp.asarray(self._m1[slots]),
+                    jnp.asarray(self._m2[slots]), jnp.float32(lr_t))
+                self._data[slots] = np.asarray(po)
+                self._m1[slots] = np.asarray(m1o)
+                self._m2[slots] = np.asarray(m2o)
+            else:               # sgd: the _sgd op's SelectedRows kernel
+                import jax.numpy as jnp
+                p = jnp.asarray(self._data[slots])
+                upd = (-np.float32(self.spec.lr)) * \
+                    jnp.asarray(grads).astype(p.dtype)
+                self._data[slots] = np.asarray(
+                    p.at[jnp.asarray(inv.astype(np.int32))].add(
+                        upd, mode='drop'))
+            self.version += 1
+            return self.version
+
+    # ------------------------------------------------------------------
+    def load(self, ids, values):
+        """Bulk-set rows (checkpoint restore / table import); optimizer
+        moments reset for the loaded rows, version unchanged."""
+        ids = self._check_ids(ids)
+        values = np.asarray(values, self._data.dtype)
+        with self._lock:
+            uniq, idx = np.unique(ids, return_index=True)
+            slots = self._slots_for(uniq)
+            self._data[slots] = values[idx]
+            self._m1[slots] = 0
+            self._m2[slots] = 0
+
+    def export(self):
+        """(ids [n], rows [n, width]) of every resident row."""
+        with self._lock:
+            ids = np.fromiter(self._slot.keys(), np.int64, len(self._slot))
+            slots = np.fromiter(self._slot.values(), np.int64,
+                                len(self._slot))
+            order = np.argsort(ids)
+            return ids[order], self._data[slots[order]].copy()
+
+    def stats(self):
+        with self._lock:
+            return {
+                'table': self.spec.name,
+                'shard': self.shard_id,
+                'num_shards': self.num_shards,
+                'rows_resident': self._n,
+                'height': self.spec.height,
+                'width': self.spec.width,
+                'version': self.version,
+                'bytes': int(self._n * self.spec.width
+                             * self._data.dtype.itemsize
+                             * (3 if self.spec.optimizer == 'adam' else 1)),
+            }

@@ -1,0 +1,587 @@
+"""PSServer / PSClient: the parameter-server transport.
+
+PS traffic is HOST RPC — numpy rows over sockets — not jax collectives,
+so it runs multi-process on a CPU-only box (the jaxlib CPU-collectives
+gap that blocks cross-process SPMD does not apply). Design:
+
+- framing: 8-byte big-endian length + pickle (protocol 4; numpy arrays
+  pickle as raw buffers). One persistent connection per (client,
+  endpoint), requests serialized per connection; the server runs a
+  thread per connection (the reference brpc pserver's request loop).
+- request batching: a `multi` request carries several pull/push
+  sub-requests in ONE round trip — the trainer batches every embedding
+  site's traffic for a step into one RPC per shard.
+- retry: every shard RPC runs under ``resilience.RetryPolicy`` at the
+  ``ps_pull`` / ``ps_push`` fault sites (the PADDLE_FAULT_SPEC registry:
+  ``ps_pull:nth=2`` injects one transient pull failure). Pulls are
+  idempotent; pushes are made idempotent by the server's per-client
+  step ledger — a retried push of an already-applied (client, step,
+  table) is acknowledged without re-applying, so a retry after a lost
+  ACK cannot double-apply a gradient.
+- local mode: a client built over in-process ``PSTable`` shards skips
+  sockets but keeps the same batching/retry/metrics path — single-process
+  tests and benches exercise the exact client code the socket path runs.
+
+Observability (docs/observability.md "Parameter-server"): counters
+``ps_pull_total`` / ``ps_push_total`` {table}, ``ps_pull_rows_total`` /
+``ps_push_rows_total``, ``ps_pull_bytes`` / ``ps_push_bytes``;
+histograms ``ps_pull_seconds`` / ``ps_push_seconds``; the server side
+counts ``ps_server_request_total{op}``. Bulk load/export/stats traffic
+rides the separate ``ps_admin`` site so the pull series (and
+``ps_pull:*`` fault specs) mean per-step pulls only.
+"""
+import pickle
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+
+from .. import monitor
+from .. import resilience
+from .table import PSTable, owners_of_ids
+
+__all__ = ['PSServer', 'PSClient', 'PSRemoteError']
+
+_HDR = struct.Struct('>Q')
+
+
+class PSRemoteError(RuntimeError):
+    """A server-reported failure. `transient` mirrors the server's
+    classification so the client retry layer treats a remote transient
+    (injected fault, overload) like a local one."""
+
+    def __init__(self, message, transient=False):
+        RuntimeError.__init__(self, message)
+        self.transient = transient
+
+
+def _retryable(exc):
+    if isinstance(exc, PSRemoteError):
+        return exc.transient
+    return resilience.is_transient(exc)
+
+
+def _send_msg(sock, obj):
+    blob = pickle.dumps(obj, protocol=4)
+    sock.sendall(_HDR.pack(len(blob)) + blob)
+    return len(blob)
+
+
+def _recv_exact(sock, n):
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError('ps transport: socket closed mid-message')
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _recv_msg(sock):
+    (n,) = _HDR.unpack(_recv_exact(sock, _HDR.size))
+    blob = _recv_exact(sock, n)
+    return pickle.loads(blob), n
+
+
+class _ShardHandler(object):
+    """The shard request handler shared by the socket server and the
+    in-process local transport — one request vocabulary, one code path."""
+
+    def __init__(self, tables, endpoint='local'):
+        if isinstance(tables, PSTable):
+            tables = {tables.spec.name: tables}
+        if isinstance(tables, (list, tuple)):
+            tables = {t.spec.name: t for t in tables}
+        self.tables = dict(tables)
+        self.endpoint = endpoint
+        # (client_id, table, step) -> version: the push-idempotence
+        # ledger, plus the set of keys whose apply is IN FLIGHT — a
+        # timeout-triggered retry racing a still-running apply must wait
+        # for it and ack as a duplicate, not re-apply
+        self._applied = {}
+        self._pending = set()
+        self._applied_cv = threading.Condition()
+
+    def _table(self, name):
+        t = self.tables.get(name)
+        if t is None:
+            raise KeyError(
+                'ps server %s: unknown table %r (serves %s)'
+                % (self.endpoint, name, sorted(self.tables)))
+        return t
+
+    def handle(self, req):
+        op = req.get('op')
+        monitor.inc('ps_server_request_total', labels={'op': str(op)})
+        if op == 'pull':
+            rows, version = self._table(req['table']).pull(req['ids'])
+            return {'ok': True, 'rows': rows, 'version': version}
+        if op == 'push':
+            table = self._table(req['table'])
+            key = (req.get('client'), req['table'], int(req['step']))
+            if key[0] is not None:
+                with self._applied_cv:
+                    while key in self._pending:
+                        self._applied_cv.wait()
+                    if key in self._applied:
+                        # retried push after a lost ACK: already applied
+                        return {'ok': True, 'version': self._applied[key],
+                                'duplicate': True}
+                    self._pending.add(key)
+            try:
+                version = table.push(req['ids'], req['grads'],
+                                     req['step'])
+            except Exception:
+                if key[0] is not None:
+                    with self._applied_cv:
+                        self._pending.discard(key)
+                        self._applied_cv.notify_all()
+                raise
+            if key[0] is not None:
+                with self._applied_cv:
+                    self._pending.discard(key)
+                    self._applied[key] = version
+                    if len(self._applied) > 4096:
+                        for k in list(self._applied)[:2048]:
+                            del self._applied[k]
+                    self._applied_cv.notify_all()
+            return {'ok': True, 'version': version}
+        if op == 'multi':
+            return {'ok': True,
+                    'resps': [self.handle(r) for r in req['reqs']]}
+        if op == 'load':
+            self._table(req['table']).load(req['ids'], req['values'])
+            # a load re-initializes the table (checkpoint restore /
+            # import): trainers legitimately restart step numbering, so
+            # the push-idempotence ledger for this table must not drop
+            # their first pushes as "duplicates" of the previous run
+            with self._applied_cv:
+                for k in [k for k in self._applied if k[1] == req['table']]:
+                    del self._applied[k]
+            return {'ok': True}
+        if op == 'export':
+            ids, rows = self._table(req['table']).export()
+            return {'ok': True, 'ids': ids, 'rows': rows}
+        if op == 'stats':
+            return {'ok': True,
+                    'tables': {n: t.stats() for n, t in self.tables.items()}}
+        if op == 'ping':
+            return {'ok': True}
+        raise ValueError('ps server: unknown op %r' % (op,))
+
+
+class PSServer(object):
+    """Serve one shard's tables over a listening socket. ::
+
+        server = PSServer({'emb': table}, port=0)   # ephemeral port
+        print(server.endpoint)                      # '127.0.0.1:PORT'
+        ...
+        server.close()
+    """
+
+    def __init__(self, tables, host='127.0.0.1', port=0):
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, int(port)))
+        self._sock.listen(64)
+        self.host, self.port = self._sock.getsockname()[:2]
+        self._handler = _ShardHandler(tables, '%s:%d' % (self.host,
+                                                         self.port))
+        self._closing = threading.Event()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name='ps-server-%d' % self.port,
+            daemon=True)
+        self._accept_thread.start()
+
+    @property
+    def tables(self):
+        return self._handler.tables
+
+    @property
+    def endpoint(self):
+        return '%s:%d' % (self.host, self.port)
+
+    def _accept_loop(self):
+        while not self._closing.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            t = threading.Thread(target=self._serve_conn, args=(conn,),
+                                 daemon=True)
+            t.start()
+
+    def _serve_conn(self, conn):
+        try:
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            while not self._closing.is_set():
+                try:
+                    req, _ = _recv_msg(conn)
+                except (ConnectionError, OSError):
+                    return
+                try:
+                    resp = self._handler.handle(req)
+                except Exception as e:      # noqa: BLE001 — shipped back
+                    resp = {'ok': False,
+                            'error': '%s: %s' % (type(e).__name__, e),
+                            'transient': resilience.is_transient(e)}
+                try:
+                    _send_msg(conn, resp)
+                except (ConnectionError, OSError):
+                    return
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def close(self):
+        self._closing.set()
+        # shutdown() (not just close()) — on Linux, close() does not
+        # wake a thread blocked in accept(), which would make every
+        # server teardown eat the full join timeout
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._accept_thread.join(timeout=2.0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+class _Endpoint(object):
+    """One persistent client connection (lazy connect, serialized)."""
+
+    def __init__(self, addr, connect_timeout_s, io_timeout_s):
+        host, _, port = addr.rpartition(':')
+        self.addr = (host or '127.0.0.1', int(port))
+        self.connect_timeout_s = connect_timeout_s
+        self.io_timeout_s = io_timeout_s
+        self._sock = None
+        self.lock = threading.Lock()
+
+    def rpc(self, req):
+        """One request/response on this endpoint. Returns (resp,
+        bytes_out, bytes_in). Socket errors tear the connection down so
+        the next (retried) attempt reconnects."""
+        with self.lock:
+            try:
+                if self._sock is None:
+                    s = socket.create_connection(
+                        self.addr, timeout=self.connect_timeout_s)
+                    s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                    s.settimeout(self.io_timeout_s)
+                    self._sock = s
+                out = _send_msg(self._sock, req)
+                resp, inn = _recv_msg(self._sock)
+                return resp, out, inn
+            except (ConnectionError, OSError, socket.timeout):
+                self.close()
+                raise
+
+    def close(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+
+class _LocalEndpoint(object):
+    """In-process shard: the same request vocabulary dispatched straight
+    into a shard handler (single-process benches/tests)."""
+
+    def __init__(self, tables):
+        self._handler = _ShardHandler(tables)
+
+    def rpc(self, req):
+        return self._handler.handle(req), 0, 0
+
+    def close(self):
+        pass
+
+
+class PSClient(object):
+    """Trainer/server-facing client over all shards of a table set.
+
+    Exactly one of `endpoints` (['host:port', ...] — socket transport) or
+    `shards` ([{name: PSTable}, ...] in shard order — in-process
+    transport) names the fleet; `num_shards` is its length and row ->
+    shard placement is `owners_of_ids` (the HashName crc32 digest).
+
+    pull/push are LOGICAL ops over all shards: ids are split by owner,
+    per-shard RPCs run concurrently (and each retries independently
+    under `retry_policy` at the ps_pull/ps_push fault sites), and rows
+    reassemble in id order.
+    """
+
+    def __init__(self, endpoints=None, shards=None, retry_policy=None,
+                 connect_timeout_s=5.0, io_timeout_s=60.0, client_id=None):
+        if (endpoints is None) == (shards is None):
+            raise ValueError(
+                'PSClient: pass exactly one of endpoints= (socket '
+                'transport) or shards= (in-process tables)')
+        if endpoints is not None:
+            if isinstance(endpoints, str):
+                endpoints = [e for e in endpoints.split(',') if e]
+            self._eps = [_Endpoint(e, connect_timeout_s, io_timeout_s)
+                         for e in endpoints]
+        else:
+            self._eps = [_LocalEndpoint(t) for t in shards]
+        self.num_shards = len(self._eps)
+        self._policy = retry_policy or resilience.RetryPolicy()
+        self.client_id = client_id or ('pscli-%d-%d'
+                                       % (id(self) & 0xffffff,
+                                          int(time.time() * 1e3) & 0xffffff))
+        self._pool = None
+        self._pool_lock = threading.Lock()
+
+    # ------------------------------------------------------------------
+    def _executor(self):
+        from concurrent.futures import ThreadPoolExecutor
+        with self._pool_lock:
+            if self._pool is None:
+                self._pool = ThreadPoolExecutor(
+                    max_workers=max(1, self.num_shards),
+                    thread_name_prefix='ps-client')
+            return self._pool
+
+    def _shard_rpc(self, shard, req, site):
+        """One shard RPC under retry at fault site `site`."""
+
+        def attempt():
+            resilience.maybe_fault(site)
+            resp, out, inn = self._eps[shard].rpc(req)
+            if not resp.get('ok'):
+                raise PSRemoteError(
+                    'ps shard %d: %s' % (shard, resp.get('error')),
+                    transient=bool(resp.get('transient')))
+            if out or inn:
+                monitor.inc('%s_bytes' % site, out + inn)
+            return resp
+
+        return self._policy.call(attempt, site=site, retryable=_retryable)
+
+    def _fanout(self, reqs_by_shard, site):
+        """Run one request per shard (concurrently when >1 shard);
+        returns {shard: resp}."""
+        items = list(reqs_by_shard.items())
+        if len(items) == 1:
+            shard, req = items[0]
+            return {shard: self._shard_rpc(shard, req, site)}
+        ex = self._executor()
+        futs = {shard: ex.submit(self._shard_rpc, shard, req, site)
+                for shard, req in items}
+        return {shard: f.result() for shard, f in futs.items()}
+
+    # ------------------------------------------------------------------
+    def pull(self, table, ids, return_version=False):
+        """Rows for `ids` (duplicates fine) in id order: [n, width].
+        Dedups for transport; one RPC per owning shard, in parallel.
+        `return_version`: also return the OLDEST shard version covering
+        this pull — shard versions advance independently, so the min is
+        the only stamp a staleness bound can trust (a row's own shard is
+        at least that fresh; stamping the max would let a behind shard's
+        rows masquerade as fresh and never evict)."""
+        t0 = time.perf_counter()
+        ids = np.asarray(ids).reshape(-1).astype(np.int64)
+        uniq, inv = np.unique(ids, return_inverse=True)
+        owners = owners_of_ids(uniq, self.num_shards)
+        reqs = {}
+        index_of = {}
+        for shard in np.unique(owners):
+            shard = int(shard)
+            mask = owners == shard
+            index_of[shard] = np.nonzero(mask)[0]
+            reqs[shard] = {'op': 'pull', 'table': table, 'ids': uniq[mask]}
+        resps = self._fanout(reqs, 'ps_pull')
+        width = None
+        rows_u = None
+        version = None
+        for shard, resp in resps.items():
+            rows = resp['rows']
+            if rows_u is None:
+                width = rows.shape[1] if rows.ndim == 2 else 0
+                rows_u = np.empty((uniq.shape[0], width), rows.dtype)
+            rows_u[index_of[shard]] = rows
+            v = int(resp.get('version', 0))
+            version = v if version is None else min(version, v)
+        version = version or 0
+        if rows_u is None:
+            rows_u = np.empty((0, 0), np.float32)
+        out = rows_u[inv]
+        monitor.inc('ps_pull_total', labels={'table': table})
+        monitor.inc('ps_pull_rows_total', float(uniq.shape[0]))
+        monitor.observe('ps_pull_seconds', time.perf_counter() - t0)
+        return (out, version) if return_version else out
+
+    def pull_many(self, requests, return_version=False):
+        """Batched pulls: `requests` is [(table, ids), ...]; ALL tables'
+        traffic rides ONE `multi` RPC per shard. Returns the rows list
+        aligned with `requests` (and the OLDEST shard version seen when
+        asked — see `pull`)."""
+        t0 = time.perf_counter()
+        prepared = []
+        per_shard = {}
+        for table, ids in requests:
+            ids = np.asarray(ids).reshape(-1).astype(np.int64)
+            uniq, inv = np.unique(ids, return_inverse=True)
+            owners = owners_of_ids(uniq, self.num_shards)
+            entry = {'uniq': uniq, 'inv': inv, 'rows': None, 'index': {}}
+            for shard in np.unique(owners):
+                shard = int(shard)
+                mask = owners == shard
+                entry['index'][shard] = np.nonzero(mask)[0]
+                per_shard.setdefault(shard, []).append(
+                    (len(prepared),
+                     {'op': 'pull', 'table': table, 'ids': uniq[mask]}))
+            prepared.append(entry)
+        reqs = {shard: {'op': 'multi', 'reqs': [r for _, r in subs]}
+                for shard, subs in per_shard.items()}
+        resps = self._fanout(reqs, 'ps_pull')
+        version = None
+        for shard, resp in resps.items():
+            for (req_idx, _), sub in zip(per_shard[shard], resp['resps']):
+                if not sub.get('ok'):
+                    raise PSRemoteError('ps shard %d: %s'
+                                        % (shard, sub.get('error')),
+                                        transient=bool(sub.get('transient')))
+                entry = prepared[req_idx]
+                rows = sub['rows']
+                if entry['rows'] is None:
+                    entry['rows'] = np.empty(
+                        (entry['uniq'].shape[0], rows.shape[1]), rows.dtype)
+                entry['rows'][entry['index'][shard]] = rows
+                v = int(sub.get('version', 0))
+                version = v if version is None else min(version, v)
+        version = version or 0
+        outs = []
+        for (table, _), entry in zip(requests, prepared):
+            monitor.inc('ps_pull_total', labels={'table': table})
+            monitor.inc('ps_pull_rows_total', float(entry['uniq'].shape[0]))
+            if entry['rows'] is None:       # empty ids: no shard touched
+                entry['rows'] = np.empty((0, 0), np.float32)
+            outs.append(entry['rows'][entry['inv']])
+        monitor.observe('ps_pull_seconds', time.perf_counter() - t0)
+        return (outs, version) if return_version else outs
+
+    def push(self, table, ids, grads, step):
+        """Push one step's (ids, grads) for `table`; duplicates are NOT
+        pre-merged — the shard's `_adam_sparse` merges them with the same
+        summation order as the device kernel. Idempotent per (client,
+        step, table): a retried push cannot double-apply."""
+        t0 = time.perf_counter()
+        ids = np.asarray(ids).reshape(-1).astype(np.int64)
+        grads = np.asarray(grads)
+        owners = owners_of_ids(ids, self.num_shards)
+        reqs = {}
+        for shard in np.unique(owners):
+            shard = int(shard)
+            mask = owners == shard
+            reqs[shard] = {'op': 'push', 'table': table,
+                           'ids': ids[mask], 'grads': grads[mask],
+                           'step': int(step), 'client': self.client_id}
+        self._fanout(reqs, 'ps_push')
+        monitor.inc('ps_push_total', labels={'table': table})
+        monitor.inc('ps_push_rows_total', float(ids.shape[0]))
+        monitor.observe('ps_push_seconds', time.perf_counter() - t0)
+
+    # ------------------------------------------------------------------
+    def load(self, table, array, chunk_rows=1 << 16):
+        """Bulk-load a dense [height, width] array into the sharded table
+        (test/parity/import path — rows land on their owning shards)."""
+        array = np.asarray(array)
+        for lo in range(0, array.shape[0], int(chunk_rows)):
+            hi = min(lo + int(chunk_rows), array.shape[0])
+            ids = np.arange(lo, hi, dtype=np.int64)
+            owners = owners_of_ids(ids, self.num_shards)
+            reqs = {}
+            for shard in np.unique(owners):
+                shard = int(shard)
+                mask = owners == shard
+                reqs[shard] = {'op': 'load', 'table': table,
+                               'ids': ids[mask], 'values': array[lo:hi][mask]}
+            self._fanout(reqs, 'ps_admin')
+
+    def export(self, table):
+        """Gather every resident row of `table` from all shards:
+        (ids, rows) sorted by id."""
+        reqs = {s: {'op': 'export', 'table': table}
+                for s in range(self.num_shards)}
+        resps = self._fanout(reqs, 'ps_admin')
+        ids = np.concatenate([resps[s]['ids'] for s in sorted(resps)])
+        rows = np.concatenate([resps[s]['rows'] for s in sorted(resps)])
+        order = np.argsort(ids)
+        return ids[order], rows[order]
+
+    def stats(self):
+        reqs = {s: {'op': 'stats'} for s in range(self.num_shards)}
+        resps = self._fanout(reqs, 'ps_admin')
+        return {s: resps[s]['tables'] for s in sorted(resps)}
+
+    def close(self):
+        for ep in self._eps:
+            ep.close()
+        with self._pool_lock:
+            if self._pool is not None:
+                self._pool.shutdown(wait=False)
+                self._pool = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def main(argv=None):
+    """``python -m paddle_tpu.ps.transport --table name:height:width
+    [--shards N --shard-id K] [--port P]`` — stand up one pserver shard
+    process. Prints ``PS_ENDPOINT host:port`` on stdout, serves until
+    stdin closes (the launcher idiom: kill the child, the daemon dies)."""
+    import argparse
+    import sys
+    from .table import PSTableSpec
+
+    ap = argparse.ArgumentParser(description='paddle_tpu pserver shard')
+    ap.add_argument('--table', action='append', required=True,
+                    help='name:height:width[:optimizer[:lr]]')
+    ap.add_argument('--shards', type=int, default=1)
+    ap.add_argument('--shard-id', type=int, default=0)
+    ap.add_argument('--host', default='127.0.0.1')
+    ap.add_argument('--port', type=int, default=0)
+    args = ap.parse_args(argv)
+    tables = {}
+    for t in args.table:
+        parts = t.split(':')
+        name, height, width = parts[0], int(parts[1]), int(parts[2])
+        optimizer = parts[3] if len(parts) > 3 else 'adam'
+        lr = float(parts[4]) if len(parts) > 4 else 0.001
+        tables[name] = PSTable(
+            PSTableSpec(name, height, width, optimizer=optimizer, lr=lr),
+            num_shards=args.shards, shard_id=args.shard_id)
+    server = PSServer(tables, host=args.host, port=args.port)
+    sys.stdout.write('PS_ENDPOINT %s\n' % server.endpoint)
+    sys.stdout.flush()
+    try:
+        sys.stdin.read()        # serve until the parent closes our stdin
+    except KeyboardInterrupt:
+        pass
+    server.close()
+
+
+if __name__ == '__main__':
+    main()

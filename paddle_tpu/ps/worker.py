@@ -1,0 +1,347 @@
+"""PSTrainerSession: the trainer half of the parameter server.
+
+Per training step the session (1) extracts every PS site's flat ids from
+the feed (the same ``ids.reshape(-1)`` order the ``ps_lookup_table``
+lowering consumes), (2) pulls the rows — ONE batched RPC per shard via
+``PSClient.pull_many`` — and feeds them, (3) dispatches the step with the
+rows-gradient fetches appended, and (4) pushes each table's concatenated
+(ids, grads) to its shards, where the shared ``_adam_sparse`` body
+applies the row-wise update.
+
+Overlap (the PR 7 async substrate): ``train(..., overlap=True)`` rides
+``Executor.run_async``'s bounded in-flight window — while the device
+executes step *i*, the host pulls step *i+1*'s rows and pushes step
+*i-1*'s gradients on a FIFO pusher thread. Staleness contract: with
+``overlap=True`` the rows fetched for step *i* reflect every push
+through step *i-2* (bounded staleness 1 — the classic async-PS
+trade); ``overlap=False`` (and the synchronous ``run``) serializes
+pull -> step -> push and is TRAJECTORY-EXACT against the in-device
+dense-lookup baseline (tests/test_ps.py parity).
+
+Trace: each step's pull wait (and synchronous push wait) lands in a
+``ps`` stage on the active trace, so ``tools/tracereport.py`` attributes
+PS wait vs device ``execute`` time per step.
+"""
+import queue
+import threading
+import time
+
+import numpy as np
+
+from .. import trace as trace_mod
+
+__all__ = ['PSTrainerSession']
+
+
+def _flat_ids(feed, name):
+    v = feed[name]
+    if isinstance(v, tuple):        # (values, lod) ragged feed
+        v = v[0]
+    return np.asarray(v).reshape(-1).astype(np.int64)
+
+
+class _Pusher(object):
+    """FIFO push thread: pushes apply strictly in step order (the
+    ordering the beta-power schedule and the staleness bound rely on);
+    errors surface on the next session call / flush."""
+
+    def __init__(self, client):
+        self._client = client
+        self._q = queue.Queue()
+        self._done_step = -1
+        self._cv = threading.Condition()
+        self._error = None
+        self._thread = threading.Thread(target=self._loop,
+                                        name='ps-pusher', daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            step, per_table = item
+            try:
+                if self._error is None:
+                    for table, (ids, grads) in per_table.items():
+                        self._client.push(table, ids, grads, step + 1)
+            except Exception as e:      # noqa: BLE001 — re-raised upstream
+                with self._cv:
+                    if self._error is None:
+                        self._error = e
+            with self._cv:
+                self._done_step = step
+                self._cv.notify_all()
+
+    def enqueue(self, step, per_table):
+        self.check()
+        self._q.put((step, per_table))
+
+    def wait_step(self, step, timeout_s=120.0):
+        """Block until the push for `step` completed (no-op for step<0)."""
+        deadline = time.monotonic() + timeout_s
+        with self._cv:
+            while self._done_step < step and self._error is None:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    raise TimeoutError(
+                        'ps pusher: push for step %d not done after %.0fs'
+                        % (step, timeout_s))
+                self._cv.wait(min(left, 1.0))
+        self.check()
+
+    def check(self):
+        if self._error is not None:
+            raise self._error
+
+    def close(self):
+        self._q.put(None)
+        self._thread.join(timeout=5.0)
+
+
+class _PSStepFuture(object):
+    """Wraps a StepFuture: strips the appended rows-grad fetches, hands
+    them to the pusher exactly once, returns the user fetches."""
+
+    def __init__(self, session, fut, n_user, push_ids, step):
+        self._session = session
+        self._fut = fut
+        self._n_user = n_user
+        self._push_ids = push_ids
+        self.step = step
+        self._pushed = False
+        self._outs = None
+
+    def done(self):
+        return self._fut.done()
+
+    def result(self, return_numpy=True):
+        if self._outs is None:
+            outs = self._fut.result(return_numpy=return_numpy)
+            grads = outs[self._n_user:]
+            self._outs = list(outs[:self._n_user])
+            if not self._pushed:
+                self._pushed = True
+                self._session._push_step(self.step, self._push_ids, grads)
+        return self._outs
+
+    def wait(self):
+        self._fut.wait()
+
+    @property
+    def exception(self):
+        return getattr(self._fut, 'exception', None)
+
+
+class PSTrainerSession(object):
+    """Drive a PS-converted program (``program._ps_info``) through an
+    executor. ::
+
+        info = transpiler.transpile(0, pservers=eps, mode='pserver')
+        session = fluid.ps.PSTrainerSession(exe, trainer_prog, client,
+                                            scope=scope)
+        losses = session.train(batches, fetch_list=[loss], overlap=True)
+
+    `staleness`: rows for step i reflect pushes through step
+    i-1-staleness. 0 = exact (synchronous push barrier), 1 = the overlap
+    default (pull(i+1) proceeds while step i's push is in flight).
+    """
+
+    def __init__(self, executor, program, client, scope=None,
+                 staleness=1):
+        info = getattr(program, '_ps_info', None)
+        if info is None or not info.sites:
+            raise ValueError(
+                "PSTrainerSession: program has no PS tables — run "
+                "DistributeTranspiler.transpile(mode='pserver') (or "
+                "ps.convert_to_ps_program) on it first")
+        self.executor = executor
+        self.program = program
+        self.client = client
+        self.scope = scope
+        self.info = info
+        self.staleness = max(0, int(staleness))
+        self._grad_names = info.grad_names
+        self._step = 0
+        self._pusher = _Pusher(client)
+        self._inflight = []
+
+    # ------------------------------------------------------------------
+    def pull_rows(self, feed):
+        """The prefetch half: {rows_var: rows [n, width]} for this feed,
+        plus the per-site flat ids the matching push needs. Blocks until
+        the staleness barrier for the NEXT step is satisfied."""
+        self._barrier(self._step - 1 - self.staleness)
+        t0 = time.perf_counter()
+        ids_per_site = [_flat_ids(feed, s.ids_var) for s in self.info.sites]
+        rows = self.client.pull_many(
+            [(s.table, ids) for s, ids in
+             zip(self.info.sites, ids_per_site)])
+        dt = time.perf_counter() - t0
+        tr = trace_mod.current()
+        if tr is not None:
+            tr.add_stage('ps', dt)
+        rows_feed = {s.rows_var: r
+                     for s, r in zip(self.info.sites, rows)}
+        push_ids = {}
+        for s, ids in zip(self.info.sites, ids_per_site):
+            if s.trainable:
+                push_ids.setdefault(s.table, []).append(ids)
+        return rows_feed, push_ids
+
+    def _barrier(self, upto_step):
+        """Ensure pushes through `upto_step` are applied: materialize any
+        in-flight step futures up to it (their result() enqueues the
+        push), then wait for the pusher."""
+        if upto_step < 0:
+            return
+        for fut in [f for f in self._inflight if f.step <= upto_step]:
+            fut.result()
+        self._inflight = [f for f in self._inflight
+                          if f._outs is None]
+        self._pusher.wait_step(upto_step)
+
+    def _push_step(self, step, push_ids, grads):
+        # concatenate per table in SITE ORDER — the same order the device
+        # path concatenates multi-site SelectedRows grads, so duplicate
+        # rows sum in the identical sequence
+        per_table = {}
+        gi = 0
+        ids_iters = {t: iter(lst) for t, lst in push_ids.items()}
+        for s in self.info.sites:
+            if not s.trainable:
+                continue
+            ids = next(ids_iters[s.table])
+            g = np.asarray(grads[gi])
+            gi += 1
+            acc = per_table.setdefault(s.table, ([], []))
+            acc[0].append(ids)
+            acc[1].append(g)
+        merged = {t: (np.concatenate(ids), np.concatenate(gs))
+                  for t, (ids, gs) in per_table.items()}
+        self._pusher.enqueue(step, merged)
+
+    # ------------------------------------------------------------------
+    def run(self, feed, fetch_list=None, return_numpy=True):
+        """One SYNCHRONOUS, trajectory-exact step: barrier on every prior
+        push, pull, execute, push, wait. Returns the user fetches."""
+        self._drain()
+        saved, self.staleness = self.staleness, 0
+        try:
+            rows_feed, push_ids = self.pull_rows(feed)
+        finally:
+            self.staleness = saved
+        full = dict(feed)
+        full.update(rows_feed)
+        fetch_list = list(fetch_list or [])
+        outs = self.executor.run(
+            self.program, feed=full,
+            fetch_list=fetch_list + self._grad_names,
+            scope=self.scope, return_numpy=return_numpy)
+        grads = outs[len(fetch_list):]
+        step = self._step
+        self._step += 1
+        t0 = time.perf_counter()
+        self._push_step(step, push_ids, grads)
+        self._pusher.wait_step(step)
+        tr = trace_mod.current()
+        if tr is not None:
+            tr.add_stage('ps', time.perf_counter() - t0)
+        return list(outs[:len(fetch_list)])
+
+    def run_async(self, feed, fetch_list=None, rows=None):
+        """Dispatch one step through the executor's async window; the
+        returned future strips the rows-grad fetches and pushes on
+        result(). `rows` short-circuits the pull with prefetched rows
+        (the train() overlap path)."""
+        self._pusher.check()
+        if rows is None:
+            rows = self.pull_rows(feed)
+        rows_feed, push_ids = rows
+        full = dict(feed)
+        full.update(rows_feed)
+        fetch_list = list(fetch_list or [])
+        fut = self.executor.run_async(
+            self.program, feed=full,
+            fetch_list=fetch_list + self._grad_names, scope=self.scope)
+        wrapped = _PSStepFuture(self, fut, len(fetch_list), push_ids,
+                                self._step)
+        self._step += 1
+        self._inflight.append(wrapped)
+        if len(self._inflight) > 8:
+            self._inflight = [f for f in self._inflight
+                              if f._outs is None]
+        return wrapped
+
+    def train(self, batches, fetch_list=None, overlap=True):
+        """Run a batch stream end to end; returns per-step fetches.
+
+        overlap=True: step i's device execution overlaps step i+1's row
+        pull and step i-1's grad push (staleness 1). overlap=False:
+        fully serialized, trajectory-exact."""
+        results = []
+        if not overlap:
+            for feed in batches:
+                tr = trace_mod.start('ps_step')
+                with trace_mod.activate(tr):
+                    results.append(self.run(feed, fetch_list=fetch_list))
+                tr.finish()
+            return results
+        it = iter(batches)
+        prev = None                     # (feed, rows, future)
+        nxt = next(it, None)
+        nxt_rows = self.pull_rows(nxt) if nxt is not None else None
+        while nxt is not None:
+            feed, rows = nxt, nxt_rows
+            # one ps_step trace per LOOP ITERATION: its `ps` stage is
+            # the PS wait paid in this wall-clock window (the next
+            # batch's overlapped pull + any staleness-barrier wait) —
+            # the where-did-this-step's-wall-go attribution tracereport
+            # breaks down, in overlap mode too
+            tr = trace_mod.start('ps_step')
+            with trace_mod.activate(tr):
+                fut = self.run_async(feed, fetch_list=fetch_list,
+                                     rows=rows)
+                nxt = next(it, None)
+                # pull the NEXT batch's rows while the device runs this
+                # step
+                nxt_rows = self.pull_rows(nxt) if nxt is not None \
+                    else None
+                if prev is not None:
+                    results.append(prev.result())
+            tr.finish()
+            prev = fut
+        if prev is not None:
+            results.append(prev.result())
+        self.flush()
+        return results
+
+    # ------------------------------------------------------------------
+    def _drain(self):
+        for fut in list(self._inflight):
+            fut.result()
+        self._inflight = []
+        if self._step:
+            self._pusher.wait_step(self._step - 1)
+
+    def flush(self):
+        """Materialize every in-flight step and wait for its push."""
+        self._drain()
+        self._pusher.check()
+
+    def close(self, close_client=True):
+        """Flush and stop the pusher thread; `close_client=False` leaves
+        the (possibly shared) PSClient open for another session."""
+        try:
+            self.flush()
+        finally:
+            self._pusher.close()
+            if close_client:
+                self.client.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -70,13 +70,20 @@ class ServingConfig(object):
       port (read it back from ``engine.metrics_port``), None (default)
       falls back to the ``PADDLE_METRICS_PORT`` env var, and no endpoint
       is started when neither is set.
+    - ps_resolver: a ``ps.PSRowResolver`` when the model's embedding
+      tables are PS-resident (``ps.psify_predictor``): admission pulls
+      the request's rows through the hot-row cache (`ps` trace stage),
+      batch formation feeds each ``ps_lookup_table`` site from it — the
+      table never fully resides in process, signatures stay fixed.
     """
 
     def __init__(self, model_dir=None, model_filename=None,
                  params_filename=None, max_batch_size=8, max_wait_ms=2.0,
                  batch_buckets=None, seq_buckets=None, seq_axis=1,
                  pad_value=0, num_workers=2, queue_cap=64,
-                 default_deadline_s=30.0, metrics_port=None):
+                 default_deadline_s=30.0, metrics_port=None,
+                 ps_resolver=None):
+        self.ps_resolver = ps_resolver
         self.model_dir = model_dir
         self.model_filename = model_filename
         self.params_filename = params_filename
@@ -137,6 +144,7 @@ class ServingEngine(object):
             raise ValueError(
                 "batch_buckets %r must top out at max_batch_size %d"
                 % (config.batch_buckets, config.max_batch_size))
+        self.ps_resolver = config.ps_resolver
         self.queue = RequestQueue(config.queue_cap)
         self._workers = []
         self._started = False
@@ -222,7 +230,10 @@ class ServingEngine(object):
         only this request's rows ever cross to the host (batch padding
         stays on device either way)."""
         names = self.predictor.get_input_names()
-        missing = sorted(n for n in names if n not in feed)
+        managed = (self.ps_resolver.managed_names
+                   if self.ps_resolver is not None else ())
+        missing = sorted(n for n in names
+                         if n not in feed and n not in managed)
         extra = sorted(k for k in feed if k not in names)
         if missing or extra:
             monitor.inc('serving_request_total',
@@ -247,6 +258,18 @@ class ServingEngine(object):
         # timing breakdown on req.timing) is unconditional; span-level
         # recording and the trace-log line ride head sampling
         req.trace = trace_mod.start('serving')
+        if self.ps_resolver is not None:
+            # ADMISSION pull: this request's embedding rows enter the
+            # hot-row cache now (the 'ps' stage on the request trace),
+            # so batch formation assembles rows feeds from cache hits
+            try:
+                with trace_mod.activate(req.trace):
+                    self.ps_resolver.prewarm(feed)
+            except Exception as e:     # noqa: BLE001 — per-request error
+                monitor.inc('serving_request_total',
+                            labels={'outcome': 'error'})
+                req.fail(e)
+                raise
         try:
             self.queue.put(req)
         except (LoadShedError, EngineStoppedError) as e:
@@ -318,6 +341,10 @@ class ServingEngine(object):
                 elif n > bb:
                     v = v[:bb]
                 feed[name] = v
+            if self.ps_resolver is not None:
+                # rows feeds are part of the compiled signature: resolve
+                # BEFORE the farm tracks it, exactly like live dispatch
+                feed.update(self.ps_resolver.resolve(feed))
             p = self.predictor
             key, already = farm.track(p.executor, p.program, feed,
                                       fetch_list=p.fetch_vars,
@@ -357,6 +384,9 @@ class ServingEngine(object):
         slices them on device and only each request's own rows are
         materialized at delivery (see _slice_result) — the padded batch
         never round-trips through the host."""
+        if self.ps_resolver is not None:
+            feed = dict(feed)
+            feed.update(self.ps_resolver.resolve(feed))
         p = self.predictor
         return p.executor.run(p.program, feed=feed,
                               fetch_list=p.fetch_vars, scope=p.scope,
@@ -441,6 +471,12 @@ class ServingEngine(object):
                     name: np.concatenate([p[name] for p in padded], axis=0)
                     for name in padded[0]}
                 stacked, padded_rows = self.ladder.pad_rows(stacked, n_rows)
+                if self.ps_resolver is not None:
+                    # rows feeds for the PADDED batch (pad-value ids hit
+                    # the cache after the first batch of a bucket); the
+                    # fed rows shape is a pure function of the bucketed
+                    # ids shape, so signatures stay fixed
+                    stacked.update(self.ps_resolver.resolve(stacked))
             except Exception as e:      # noqa: BLE001 — delivered per-request
                 monitor.inc('serving_batch_error_total')
                 for r in batch:

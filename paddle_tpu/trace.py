@@ -15,11 +15,12 @@ work gets a `Trace`:
   post-mortem).
 
 A `Trace` carries a process-unique ``trace_id``, accumulates named
-**stages** (``queue`` -> ``batch`` -> ``prefill`` -> ``decode_step`` ->
-``execute`` -> ``sync``: the request's full latency budget; stage sums
-compose to the end-to-end latency within the gaps the runtime cannot
-see), and appends structured **events** (elastic restarts, reshard
-direction, retry give-ups). While a SAMPLED trace is activated on a
+**stages** (``queue`` -> ``batch`` -> ``ps`` -> ``prefill`` ->
+``decode_step`` -> ``execute`` -> ``sync``: the request's full latency
+budget; stage sums compose to the end-to-end latency within the gaps
+the runtime cannot see — ``ps`` is parameter-server row pull/push wait,
+paddle_tpu/ps), and appends structured **events** (elastic restarts,
+reshard direction, retry give-ups). While a SAMPLED trace is activated on a
 thread, every ``monitor.span`` records ``trace_id``/``span_id``/
 ``parent_id`` causality — ``profiler.export_chrome_tracing`` then emits
 flow events linking one trace's spans across threads.

@@ -23,7 +23,8 @@ from jax.sharding import PartitionSpec as P
 from ..framework import default_main_program, Parameter
 from ..parallel.api import ShardingRules
 
-__all__ = ['DistributeTranspiler', 'DistributeTranspilerConfig']
+__all__ = ['DistributeTranspiler', 'DistributeTranspilerConfig',
+           'PSServerState']
 
 
 class DistributeTranspilerConfig(object):
@@ -50,15 +51,49 @@ class ShardingPlan(object):
         return [('data', num_devices // model), ('model', model)]
 
 
+class PSServerState(object):
+    """One pserver endpoint's runnable startup state (mode='pserver'):
+    the shard's tables plus a `serve()` that binds the transport."""
+
+    def __init__(self, endpoint, shard_id, num_shards, tables):
+        self.endpoint = endpoint
+        self.shard_id = shard_id
+        self.num_shards = num_shards
+        self.tables = tables
+
+    def serve(self, host=None, port=None):
+        """Start a ps.PSServer on this state's endpoint (or an explicit
+        host/port — port=0 picks an ephemeral one)."""
+        from ..ps.transport import PSServer
+        if host is None or port is None:
+            h, _, p = self.endpoint.rpartition(':')
+            host = host if host is not None else (h or '127.0.0.1')
+            port = port if port is not None else int(p)
+        return PSServer(self.tables, host=host, port=port)
+
+    def __repr__(self):
+        return "PSServerState(%s, shard %d/%d, tables=%s)" % (
+            self.endpoint, self.shard_id, self.num_shards,
+            sorted(self.tables))
+
+
 class DistributeTranspiler(object):
     def __init__(self, config=None):
         self.config = config or DistributeTranspilerConfig()
         self._plan = None
         self._program = None
+        self._ps_info = None
 
     def transpile(self, trainer_id, program=None, pservers="127.0.0.1:6174",
                   trainers=1, sync_mode=True, startup_program=None,
-                  current_endpoint="127.0.0.1:6174"):
+                  current_endpoint="127.0.0.1:6174", mode=None):
+        """mode=None (default): the in-process SPMD planning below —
+        byte-for-byte the pre-PS behavior. mode='pserver': the HOST
+        parameter-server subsystem (paddle_tpu/ps) — the program is
+        rewritten so is_distributed embedding tables are PS-remote
+        (ps_lookup_table + rows feeds + server-side optimizer), one
+        pserver shard per endpoint; get_pserver_programs(endpoint) then
+        returns that endpoint's runnable startup state."""
         if program is None:
             program = default_main_program()
         self._program = program
@@ -70,6 +105,23 @@ class DistributeTranspiler(object):
         else:
             eplist = list(pservers)
         self.pserver_endpoints = eplist
+        self._ps_info = None
+
+        if mode == 'pserver':
+            from ..ps.program import convert_to_ps_program
+            if not eplist:
+                raise ValueError(
+                    "transpile(mode='pserver') needs at least one pserver "
+                    "endpoint (pservers='host:port,...')")
+            self._ps_info = convert_to_ps_program(
+                program, startup_program=startup_program)
+            self._startup = startup_program
+            self._plan = ShardingPlan(ShardingRules([]), num_shards=1)
+            return
+        if mode not in (None, 'mesh'):
+            raise ValueError("transpile: unknown mode %r "
+                             "(None/'mesh' = SPMD plan, 'pserver' = host "
+                             "parameter server)" % (mode,))
 
         if self.config.mode == "nccl2" or not eplist:
             # pure data parallel; params replicated
@@ -129,20 +181,51 @@ class DistributeTranspiler(object):
         return self._program
 
     def get_pserver_program(self, endpoint):
-        """No pserver process exists on TPU; kept for API parity."""
+        """mode='pserver': this endpoint's runnable startup state — a
+        `PSServerState` whose `.tables` are the endpoint's shard of every
+        PS table and whose `.serve()` binds a live `ps.PSServer`.
+        Default (mesh) mode keeps the API-parity error: no pserver
+        process exists in SPMD training."""
+        if self._ps_info is not None:
+            if endpoint not in self.pserver_endpoints:
+                raise ValueError(
+                    "get_pserver_program: %r is not one of the transpiled "
+                    "endpoints %s" % (endpoint, self.pserver_endpoints))
+            from ..ps.program import build_pserver_tables
+            shard_id = self.pserver_endpoints.index(endpoint)
+            return PSServerState(
+                endpoint, shard_id, len(self.pserver_endpoints),
+                build_pserver_tables(self._ps_info,
+                                     len(self.pserver_endpoints),
+                                     shard_id))
         raise NotImplementedError(
             "TPU-native training has no parameter-server role: parameters "
             "are sharded over the mesh ('model' axis) inside one SPMD "
             "program. Run get_trainer_program() on every host; "
-            "jax.distributed.initialize() replaces the pserver bootstrap.")
+            "jax.distributed.initialize() replaces the pserver bootstrap. "
+            "For a HOST parameter server (tables beyond device memory), "
+            "transpile(..., mode='pserver').")
 
     def get_pserver_programs(self, endpoint):
         return self.get_pserver_program(endpoint)
 
+    @property
+    def ps_info(self):
+        """The PSProgramInfo of a mode='pserver' transpile (None in the
+        default mesh mode)."""
+        return self._ps_info
+
     def get_startup_program(self, endpoint=None, pserver_program=None,
                             startup_program=None):
         from ..framework import default_startup_program
-        return startup_program or default_startup_program()
+        if startup_program is not None:
+            return startup_program
+        if self._ps_info is not None and \
+                getattr(self, '_startup', None) is not None:
+            # mode='pserver': the startup that transpile stripped the
+            # table/accumulator inits from
+            return self._startup
+        return default_startup_program()
 
     @property
     def sharding_plan(self):

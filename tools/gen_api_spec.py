@@ -59,6 +59,7 @@ def iter_api():
         ('paddle_tpu.contrib.slim', fluid.contrib.slim),
         ('paddle_tpu.parallel', fluid.parallel),
         ('paddle_tpu.serving', fluid.serving),
+        ('paddle_tpu.ps', fluid.ps),
         ('paddle_tpu.distributed.launch',
          __import__('paddle_tpu.distributed.launch',
                     fromlist=['launch'])),

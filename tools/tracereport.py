@@ -5,9 +5,10 @@ Reads the trace records `paddle_tpu.trace` appends to the monitor-log
 channel (``PADDLE_TRACE_LOG`` / ``FLAGS_monitor_log`` — snapshot lines
 from the metrics writer are skipped automatically) and prints:
 
-- per-kind, per-stage p50/p95/p99 breakdowns (queue / batch / prefill /
-  decode_step / draft / verify / execute / sync ...) with each stage's
-  share of total latency and the stage-sum coverage of end-to-end time
+- per-kind, per-stage p50/p95/p99 breakdowns (queue / batch / ps /
+  prefill / decode_step / draft / verify / execute / sync ...) with
+  each stage's share of total latency and the stage-sum coverage of
+  end-to-end time
   (speculative generate traces split the decode wall into ``draft`` +
   ``verify`` + a residual ``decode_step`` of host time, so the sum
   still composes — and their timing carries ``spec_accept_rate``);
