@@ -31,7 +31,7 @@ __all__ = ['AutoMixedPrecisionLists', 'rewrite_program_bf16', 'decorate',
 # Ops whose FLOPs dominate and that are numerically safe in bf16 with fp32
 # accumulation: they run on the MXU.
 WHITE_LIST = {
-    'mul', 'matmul', 'fc', 'flash_attention',
+    'mul', 'matmul', 'fc', 'flash_attention', 'fused_ffn_tail',
     'conv2d', 'depthwise_conv2d', 'conv2d_transpose',
     'depthwise_conv2d_transpose', 'conv3d', 'conv3d_transpose',
 }

@@ -23,6 +23,10 @@ class OpDef(object):
         self.lower = lower
         self.infer_shape = infer_shape
         self.stateful = stateful
+        # bool for most ops; a static predicate `fn(op) -> bool` over the
+        # op instance for ops whose RNG use depends on attrs alone (e.g.
+        # fused_ffn_tail draws a key only in train mode with live
+        # dropout). executor.bind resolves it per op at bind time.
         self.needs_rng = needs_rng
         # input slots whose concrete *values* determine output shapes/layout
         # (e.g. sequence_unpad's Length). The executor binds these feeds as

@@ -211,6 +211,15 @@ def _next_program_run(program):
     return n
 
 
+def _op_needs_rng(opdef, op):
+    """An OpDef's needs_rng is a bool for most ops, or a static predicate
+    over the op instance (attrs only — resolvable at bind time) for ops
+    whose RNG use is conditional, like fused_ffn_tail's train-mode-only
+    dropout key."""
+    nr = opdef.needs_rng
+    return nr(op) if callable(nr) else bool(nr)
+
+
 # Ops whose lowering calls back into the host (pure_callback / io_callback /
 # debug.print). Backends without host-callback support (the axon PJRT relay
 # rejects send/recv callbacks at run time) execute programs containing them
@@ -1949,8 +1958,11 @@ class Executor(object):
                 "(program, feed, fetch) signature — bind() supports "
                 "host-op-free programs outside profile_ops mode only "
                 "(the run above went through a different execution path)")
+        # needs_rng may be a static per-op-instance predicate (e.g.
+        # fused_ffn_tail: only a train-mode op with live dropout draws a
+        # key) — decode programs keep the single-PRNGKey fast path
         needs_rng = any(
-            has_op(op.type) and get_op(op.type).needs_rng
+            has_op(op.type) and _op_needs_rng(get_op(op.type), op)
             for block in program.blocks for op in block.ops)
         return BoundProgram(self, entry, program, scope, needs_rng,
                             first_out, example_feed=feed2)

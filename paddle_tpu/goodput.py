@@ -64,6 +64,9 @@ frozen) detect:
                        ACCEPT_DROP (fed by GenerateEngine per round)
 - ``queue_burn``       queue-wait EWMA > QUEUE_SLO_MS (0 disables; fed
                        by both engines per request)
+- ``bench_row_drift``  a bench-row reading below its committed baseline
+                       * ROW_DRIFT (fed by bench tools that registered
+                       a baseline, e.g. servebench's serving row)
 
 Each trip increments ``perf_regression_total{kind}`` and writes an
 always-kept ``perf_regression`` trace event (the keep-errors channel —
@@ -85,9 +88,10 @@ from . import monitor
 from . import trace as trace_mod
 
 __all__ = ['note_dispatch', 'note_compile', 'note_accept',
-           'note_queue_wait', 'name_model', 'flush', 'stats', 'reset',
-           'regressions', 'enabled', 'device_peaks', 'peak_flops_for',
-           'peak_hbm_bps_for', 'PEAK_FLOPS', 'PEAK_HBM_BPS']
+           'note_queue_wait', 'note_bench_row', 'name_model', 'flush',
+           'stats', 'reset', 'regressions', 'enabled', 'device_peaks',
+           'peak_flops_for', 'peak_hbm_bps_for', 'PEAK_FLOPS',
+           'PEAK_HBM_BPS']
 
 # peak dense bf16 FLOP/s per chip, by device_kind substring (the bench
 # suite imports this table — one source of truth for MFU denominators)
@@ -180,7 +184,8 @@ _CFG_KEYS = ('PADDLE_PERFWATCH_EWMA', 'PADDLE_PERFWATCH_MIN_SAMPLES',
              'PADDLE_PERFWATCH_RECOMPILE_WINDOW_S',
              'PADDLE_PERFWATCH_ACCEPT_DROP',
              'PADDLE_PERFWATCH_QUEUE_SLO_MS',
-             'PADDLE_PERFWATCH_COOLDOWN_S')
+             'PADDLE_PERFWATCH_COOLDOWN_S',
+             'PADDLE_PERFWATCH_ROW_DRIFT')
 _cfg_cache = [None, None]       # [raw env tuple, parsed dict]
 
 
@@ -203,6 +208,7 @@ def _cfg():
         'queue_slo_s': _env_float('PADDLE_PERFWATCH_QUEUE_SLO_MS', 0.0)
         / 1e3,
         'cooldown_s': _env_float('PADDLE_PERFWATCH_COOLDOWN_S', 60.0),
+        'row_drift': _env_float('PADDLE_PERFWATCH_ROW_DRIFT', 0.5),
     }
     _cfg_cache[0], _cfg_cache[1] = raw, cfg
     return cfg
@@ -372,6 +378,27 @@ def note_accept(rate, model='default'):
             _trip('accept_collapse', model=model,
                   baseline=round(st['base'], 4),
                   ewma=round(st['ewma'], 4))
+
+
+def note_bench_row(row, value, baseline, floor_frac=None):
+    """Compare a bench-row reading against its REGISTERED baseline (a
+    committed number from a past round, e.g. servebench's serving-row
+    speedup from BENCH_r08): measuring below ``baseline * floor_frac``
+    (default PADDLE_PERFWATCH_ROW_DRIFT = 0.5 — bench rows on a shared
+    CPU box are noisy, so the floor is generous) trips
+    ``perf_regression_total{kind=bench_row_drift}`` with the row name
+    and both numbers in the trace event. Higher-is-better rows only.
+    Returns True if the reading is within the floor."""
+    if not enabled():
+        return True
+    with _lock:
+        cfg = _cfg()
+        frac = cfg['row_drift'] if floor_frac is None else float(floor_frac)
+        ok = float(value) >= float(baseline) * frac
+        if not ok and _cooldown_ok(('bench_row_drift', row), cfg):
+            _trip('bench_row_drift', row=row, value=round(float(value), 4),
+                  baseline=round(float(baseline), 4), floor_frac=frac)
+        return ok
 
 
 def note_queue_wait(seconds):

@@ -14,7 +14,7 @@ __all__ = [
     'square_error_cost', 'softmax_with_cross_entropy',
     'sigmoid_cross_entropy_with_logits', 'conv2d', 'conv3d',
     'conv2d_transpose', 'pool2d', 'pool3d', 'batch_norm', 'layer_norm',
-    'fused_layer_norm_residual',
+    'fused_layer_norm_residual', 'fused_ffn_tail',
     'group_norm', 'data_norm', 'l2_normalize', 'matmul', 'mul', 'topk',
     'reshape', 'squeeze', 'unsqueeze', 'flatten', 'transpose', 'split',
     'reduce_sum', 'reduce_mean', 'reduce_max', 'reduce_min', 'reduce_prod',
@@ -526,6 +526,52 @@ def fused_layer_norm_residual(input, residual, begin_norm_axis=1,
                      attrs={'epsilon': epsilon,
                             'begin_norm_axis': begin_norm_axis})
     return out, summed
+
+
+def fused_ffn_tail(input, inner_size, size, num_flatten_dims=1,
+                   dropout_prob=0.0, is_test=False, seed=None,
+                   inner_param_attr=None, inner_bias_attr=None,
+                   param_attr=None, bias_attr=None, name=None):
+    """Fused transformer FFN sublayer (kernel-tier unit,
+    ops/ffn_ops.py fused_ffn_tail):
+
+        out = dropout(gelu(input @ W1 + b1) @ W2 + b2)
+
+    One op in place of the ``fc(act='gelu') -> fc -> dropout`` chain
+    (the dropout with ``upscale_in_train`` semantics — keep-mask scaled
+    at train time, identity at inference). PADDLE_FUSED_TIER selects
+    the lowering; tier 'off' reproduces that
+    six-op composition bitwise, so wiring this into a model never
+    changes legacy numerics (the training-mode dropout key comes from
+    the program's counted RNG stream — see ops/ffn_ops.py on mask
+    replay vs. program structure). Parameters are created exactly as
+    the two ``fc`` calls would (same shapes, initializers and creation
+    order), so trained scopes serve either wiring unchanged."""
+    helper = LayerHelper('fused_ffn_tail', name=name)
+    dtype = input.dtype
+    d_in = _prod(input.shape[num_flatten_dims:])
+    w1 = helper.create_parameter(attr=inner_param_attr or ParamAttr(),
+                                 shape=[d_in, inner_size], dtype=dtype)
+    b1 = helper.create_parameter(attr=inner_bias_attr or ParamAttr(),
+                                 shape=[inner_size], dtype=dtype,
+                                 is_bias=True)
+    w2 = helper.create_parameter(attr=param_attr or ParamAttr(),
+                                 shape=[inner_size, size], dtype=dtype)
+    b2 = helper.create_parameter(attr=bias_attr or ParamAttr(),
+                                 shape=[size], dtype=dtype, is_bias=True)
+    out_shape = tuple(input.shape[:num_flatten_dims]) + (size,)
+    out = helper.create_variable_for_type_inference(dtype,
+                                                    shape=out_shape)
+    helper.append_op(
+        type='fused_ffn_tail',
+        inputs={'X': [input], 'W1': [w1], 'B1': [b1],
+                'W2': [w2], 'B2': [b2]},
+        outputs={'Out': [out]},
+        attrs={'x_num_col_dims': num_flatten_dims,
+               'dropout_prob': dropout_prob, 'is_test': is_test,
+               'seed': seed if seed is not None else 0,
+               'dropout_implementation': 'upscale_in_train'})
+    return out
 
 
 def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,

@@ -78,15 +78,23 @@ def build_bert_pretrain(cfg=None, is_test=False):
         mask_var = layers.reshape(neg, shape=[-1, 1, 1, cfg.seq_len])
 
     ckpts = []
+    # zero pending delta: every block (block 0 included) lowers the same
+    # op sequence — see build_lm; x + x*0 is bitwise x
+    delta = layers.scale(x, scale=0.0)
     for i in range(cfg.n_layer):
-        x = transformer_block(x, cfg, 'bert.layer_%d' % i,
-                              mask_var=mask_var, is_test=is_test,
-                              causal=False, key_padding_bias=bias_var)
+        x, delta = transformer_block(x, cfg, 'bert.layer_%d' % i,
+                                     mask_var=mask_var, is_test=is_test,
+                                     causal=False,
+                                     key_padding_bias=bias_var,
+                                     residual=delta, defer_residual=True)
         ckpts.append(x)
     tokens.block.program._lm_checkpoint_vars = ckpts
-    x = layers.layer_norm(x, begin_norm_axis=2,
-                          param_attr=ParamAttr(name='bert.final_ln.w'),
-                          bias_attr=ParamAttr(name='bert.final_ln.b'))
+    # resolve the last block's deferred FFN delta inside the final LN
+    # (fused residual-add + LN; tier 'off' is bitwise add + layer_norm)
+    x, _ = layers.fused_layer_norm_residual(
+        x, delta, begin_norm_axis=2,
+        param_attr=ParamAttr(name='bert.final_ln.w'),
+        bias_attr=ParamAttr(name='bert.final_ln.b'))
 
     # --- MLM head: gather only the masked positions
     flat = layers.reshape(x, shape=[-1, cfg.d_model])      # [B*L, D]

@@ -24,6 +24,7 @@ from . import quant_ops
 from . import misc_ops
 from . import attention_ops
 from . import ce_ops
+from . import ffn_ops
 from . import embedding_ops
 from . import kernel_tier
 from . import kv_cache_ops

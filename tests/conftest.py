@@ -37,7 +37,8 @@ def pytest_collection_modifyitems(config, items):
     if any('::' in a for a in config.args):
         return
     for fname in ('test_generate.py', 'test_paged_generate.py',
-                  'test_speculative.py', 'test_goodput.py'):
+                  'test_speculative.py', 'test_goodput.py',
+                  'test_ffn_tail.py'):
         gen = [it for it in items
                if os.path.basename(str(it.fspath)) == fname]
         if gen:

@@ -180,13 +180,18 @@ class TestInflightWindow(object):
         the next submission, the submitter must block (pipeline stall)
         and count/time the wait."""
         monkeypatch.setenv('PADDLE_MAX_INFLIGHT_STEPS', '1')
-        main, startup, loss = _build(dim=64, hidden=2048, seed=2)
+        # The step must be much heavier than the submission path or the
+        # completer can drain each step before the next run_async lands
+        # and no stall ever happens (flaked on fast boxes at hidden=2048
+        # / 3 batches).  batch=256 x hidden=8192 is ~50x submission
+        # cost, and 6 submissions give 5 independent stall chances.
+        main, startup, loss = _build(dim=64, hidden=8192, seed=2)
         exe = fluid.Executor(fluid.CPUPlace())
         scope = fluid.Scope()
         before = monitor.counters()
         with fluid.scope_guard(scope):
             exe.run(startup, scope=scope)
-            for b in _batches(3, batch=64, dim=64, seed=2):
+            for b in _batches(6, batch=256, dim=64, seed=2):
                 exe.run_async(main, feed=b, fetch_list=[loss], scope=scope)
             exe.drain_async()
         delta = monitor.counter_delta(before)

@@ -1,0 +1,338 @@
+"""fused_ffn_tail (ISSUE 16 tentpole): the transformer FFN sublayer —
+matmul + bias + gelu + matmul + bias (+ train-mode dropout) — as one
+kernel-tier unit.
+
+Contracts pinned here:
+- tier 'off' BITWISE matches the legacy ``fc(act='gelu') -> fc ->
+  dropout`` composition, forward AND through training updates, in both
+  the dropout-free train regime and the is_test inert-dropout regime
+  (the only regimes where fused/unfused program structures draw the
+  same — i.e. no — masks; see ops/ffn_ops.py on op-index shift);
+- train-mode dropout masks come from the program's counted RNG stream:
+  rewinding ``_rng_run_counter`` (what checkpoint restore does) replays
+  a step's mask bitwise;
+- xla tier whole-LM trajectory tracks tier 'off' allclose with the
+  residual/LN threading of PR 16 in place (n_layer=2 exercises the
+  cross-block deferred-delta handoff);
+- per-shard fallback under a >1-device mesh: shapes that stop tiling
+  after row partitioning degrade pallas -> xla with the mesh='n'
+  counter label; tileable ones keep the partitioned kernel and match
+  the unsharded reference (fwd + grad);
+- dispatch-counter deltas carry op=fused_ffn_tail with the impl that
+  actually ran.
+
+The heavy interpret-tier (real pallas kernel) whole-LM run with live
+dropout is @slow; tier-1 keeps the kernel-level interpret parity and
+the xla trajectory.
+"""
+import os
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, monitor
+from paddle_tpu.param_attr import ParamAttr
+
+D_IN, D_FF = 64, 96          # deliberately NOT 128-tiling: xla-tier sizes
+
+
+@pytest.fixture
+def tier_env(monkeypatch):
+    def set_tier(v):
+        if v is None:
+            monkeypatch.delenv('PADDLE_FUSED_TIER', raising=False)
+        else:
+            monkeypatch.setenv('PADDLE_FUSED_TIER', v)
+    yield set_tier
+    monkeypatch.delenv('PADDLE_FUSED_TIER', raising=False)
+
+
+def _tail_program(fused, prob, is_test, d_in=D_IN, d_ff=D_FF, seed=11,
+                  opt=True):
+    """One FFN sublayer + a square loss + SGD. The fused builder creates
+    parameters with the same names/shapes/order as the two fc calls, so
+    both builds start from identical Xavier draws under equal seeds."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = seed
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = layers.data(name='x', shape=[d_in], dtype='float32')
+        if fused:
+            out = layers.fused_ffn_tail(
+                x, d_ff, d_in, num_flatten_dims=1,
+                dropout_prob=prob, is_test=is_test,
+                inner_param_attr=ParamAttr(name='t.w1'),
+                inner_bias_attr=ParamAttr(name='t.b1'),
+                param_attr=ParamAttr(name='t.w2'),
+                bias_attr=ParamAttr(name='t.b2'))
+        else:
+            h = layers.fc(x, size=d_ff, act='gelu',
+                          param_attr=ParamAttr(name='t.w1'),
+                          bias_attr=ParamAttr(name='t.b1'))
+            out = layers.fc(h, size=d_in,
+                            param_attr=ParamAttr(name='t.w2'),
+                            bias_attr=ParamAttr(name='t.b2'))
+            if prob:
+                out = layers.dropout(
+                    out, dropout_prob=prob, is_test=is_test,
+                    dropout_implementation='upscale_in_train')
+        loss = layers.mean(layers.elementwise_mul(out, out))
+        if opt and not is_test:
+            fluid.optimizer.SGD(0.1).minimize(loss)
+    return main, startup, out, loss
+
+
+def _run_tail(fused, prob, is_test, tier, steps=3, batch=4):
+    os.environ.pop('PADDLE_FUSED_TIER', None)
+    if tier is not None:
+        os.environ['PADDLE_FUSED_TIER'] = tier
+    try:
+        main, startup, out, loss = _tail_program(fused, prob, is_test)
+        exe, scope = fluid.Executor(), fluid.Scope()
+        rng = np.random.RandomState(3)
+        traj = []
+        with fluid.scope_guard(scope):
+            exe.run(startup, scope=scope)
+            for _ in range(steps):
+                f = {'x': rng.randn(batch, D_IN).astype('float32')}
+                o, l = exe.run(main, feed=f, fetch_list=[out, loss],
+                               scope=scope)
+                traj.append((np.asarray(o), np.asarray(l)))
+            params = {n: np.asarray(scope.find_var(n).get_tensor())
+                      for n in ('t.w1', 't.b1', 't.w2', 't.b2')}
+        return traj, params
+    finally:
+        os.environ.pop('PADDLE_FUSED_TIER', None)
+
+
+# ---------------------------------------------------------------------------
+# tier 'off': the bitwise parity anchor (fwd + grad, train and is_test)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize('prob,is_test', [(0.0, False), (0.1, True)])
+def test_off_tier_bitwise_vs_legacy_composition(prob, is_test):
+    ref_traj, ref_p = _run_tail(False, prob, is_test, tier=None)
+    got_traj, got_p = _run_tail(True, prob, is_test, tier='off')
+    for step, ((ro, rl), (go, gl)) in enumerate(zip(ref_traj, got_traj)):
+        np.testing.assert_array_equal(go, ro, err_msg='out step %d' % step)
+        np.testing.assert_array_equal(gl, rl, err_msg='loss step %d' % step)
+    for n in ref_p:        # SGD updates applied the identical gradients
+        np.testing.assert_array_equal(got_p[n], ref_p[n], err_msg=n)
+
+
+@pytest.mark.parametrize('tier', ['xla', 'interpret'])
+def test_fused_tiers_allclose_fwd_and_grad(tier):
+    """The fused emissions (custom_vjp recompute backward) track the off
+    tier through updates. interpret needs 128-tiling sizes."""
+    if tier == 'interpret':
+        d_in = d_ff = 128
+    else:
+        d_in, d_ff = D_IN, D_FF
+
+    def run(t):
+        os.environ['PADDLE_FUSED_TIER'] = t
+        try:
+            main, startup, out, loss = _tail_program(
+                True, 0.0, False, d_in=d_in, d_ff=d_ff)
+            exe, scope = fluid.Executor(), fluid.Scope()
+            rng = np.random.RandomState(3)
+            losses = []
+            with fluid.scope_guard(scope):
+                exe.run(startup, scope=scope)
+                for _ in range(3):
+                    f = {'x': rng.randn(8, d_in).astype('float32')}
+                    l, = exe.run(main, feed=f, fetch_list=[loss],
+                                 scope=scope)
+                    losses.append(float(np.asarray(l).reshape(())))
+                w1 = np.asarray(scope.find_var('t.w1').get_tensor())
+            return losses, w1
+        finally:
+            os.environ.pop('PADDLE_FUSED_TIER', None)
+
+    ref_l, ref_w = run('off')
+    got_l, got_w = run(tier)
+    np.testing.assert_allclose(got_l, ref_l, rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(got_w, ref_w, rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# counted-RNG dropout: replay across a checkpoint-style rewind
+# ---------------------------------------------------------------------------
+
+def test_dropout_rng_replay_after_counter_rewind(tier_env):
+    """Step N's mask is a pure function of (program seed, run counter,
+    op index): rewinding _rng_run_counter — what checkpoint restore does
+    on resume — replays the step bitwise; without the rewind the next
+    run draws a fresh mask."""
+    tier_env('off')
+    # forward-only program (no optimizer): parameters stay frozen, so
+    # any output change between runs is the mask alone
+    main, startup, out, loss = _tail_program(True, 0.5, False, opt=False)
+    exe, scope = fluid.Executor(), fluid.Scope()
+    x = np.ones((4, D_IN), 'float32')
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        o1 = np.asarray(exe.run(main, feed={'x': x}, fetch_list=[out],
+                                scope=scope)[0])
+        o2 = np.asarray(exe.run(main, feed={'x': x}, fetch_list=[out],
+                                scope=scope)[0])
+        assert not np.array_equal(o1, o2), \
+            'consecutive train steps must draw fresh masks'
+        main._rng_run_counter -= 1        # checkpoint-restore rewind
+        o2b = np.asarray(exe.run(main, feed={'x': x}, fetch_list=[out],
+                                 scope=scope)[0])
+        np.testing.assert_array_equal(o2b, o2)
+
+
+# ---------------------------------------------------------------------------
+# dispatch counters + shape/fallback rules (incl. >1-device mesh)
+# ---------------------------------------------------------------------------
+
+def test_dispatch_counter_labels(tier_env):
+    # dispatch runs at LOWERING time: unique batch sizes force fresh
+    # compile signatures so the compile cache can't absorb the trace
+    for batch, (tier, impl) in enumerate(
+            [('off', 'off'), ('xla', 'xla')], start=5):
+        tier_env(tier)
+        before = monitor.counters()
+        _run_tail(True, 0.0, False, tier=tier, steps=1, batch=batch)
+        d = monitor.counter_delta(before)
+        key = ('fused_kernel_dispatch_total'
+               '{impl=%s,mesh=1,op=fused_ffn_tail}' % impl)
+        assert d.get(key, 0) >= 1, (tier, d)
+    # pallas request on non-tiling shapes (d_in=64) degrades to xla
+    tier_env('pallas')
+    before = monitor.counters()
+    _run_tail(True, 0.0, False, tier='pallas', steps=1, batch=7)
+    d = monitor.counter_delta(before)
+    assert d.get('fused_kernel_dispatch_total'
+                 '{impl=xla,mesh=1,op=fused_ffn_tail}', 0) >= 1, d
+
+
+def test_shape_and_mesh_fallback_rules():
+    from paddle_tpu.ops.ffn_ops import ffn_shapes_ok, ffn_spmd_ok
+    assert ffn_shapes_ok(256, 128, 256, 128)
+    assert not ffn_shapes_ok(256, 64, 256, 128)     # d_in misses the lane
+    assert not ffn_shapes_ok(255, 128, 256, 128)    # rows don't tile
+    mesh = Mesh(np.array(jax.devices()[:2]).reshape(2), ('data',))
+    assert ffn_spmd_ok(mesh, 256, 128, 256, 128)    # 128 rows/shard
+    # 8 global rows -> 4/shard: below the minimum row tile
+    assert not ffn_spmd_ok(mesh, 8, 128, 256, 128)
+
+
+def test_mesh_partitioned_kernel_matches_unsharded():
+    """fused_ffn_spmd (rows over 'data', replicated weights) reproduces
+    the unsharded core — forward and the recompute backward's psum'd
+    weight cotangents."""
+    from paddle_tpu.ops.ffn_ops import fused_ffn_core, fused_ffn_spmd
+    rng = np.random.RandomState(0)
+    n, d_in, d_ff, d_out = 256, 128, 128, 128
+    x = jnp.asarray(rng.randn(n, d_in).astype('float32'))
+    w1 = jnp.asarray((rng.randn(d_in, d_ff) * 0.1).astype('float32'))
+    b1 = jnp.asarray(rng.randn(d_ff).astype('float32') * 0.1)
+    w2 = jnp.asarray((rng.randn(d_ff, d_out) * 0.1).astype('float32'))
+    b2 = jnp.asarray(rng.randn(d_out).astype('float32') * 0.1)
+    mesh = Mesh(np.array(jax.devices()[:2]).reshape(2), ('data',))
+    ref = fused_ffn_core(x, w1, b1, w2, b2, None, 'xla')
+    got = fused_ffn_spmd(x, w1, b1, w2, b2, None, mesh, 'interpret')
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+    def loss_ref(xx, a1):
+        return jnp.sum(fused_ffn_core(xx, a1, b1, w2, b2, None, 'xla') ** 2)
+
+    def loss_spmd(xx, a1):
+        return jnp.sum(
+            fused_ffn_spmd(xx, a1, b1, w2, b2, None, mesh,
+                           'interpret') ** 2)
+    gr = jax.grad(loss_ref, argnums=(0, 1))(x, w1)
+    gg = jax.grad(loss_spmd, argnums=(0, 1))(x, w1)
+    for r, g, tag in ((gr[0], gg[0], 'dx'), (gr[1], gg[1], 'dw1')):
+        scale = max(float(np.abs(np.asarray(r)).max()), 1.0)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(r),
+                                   atol=3e-5 * scale, err_msg=tag)
+
+
+# ---------------------------------------------------------------------------
+# whole-LM trajectories (the PR 16 residual/LN threading rides along)
+# ---------------------------------------------------------------------------
+
+def _lm_traj(tier, dropout, steps=3):
+    from paddle_tpu.models.transformer import build_lm, LMConfig
+    os.environ['PADDLE_FUSED_TIER'] = tier
+    try:
+        cfg = LMConfig(vocab_size=128, seq_len=8, d_model=32, n_head=4,
+                       n_layer=2, d_ff=64, dropout=dropout,
+                       attn_dropout=0.0, use_flash_attention=False)
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 5
+        with fluid.program_guard(main, startup), fluid.unique_name.guard():
+            tokens, labels, logits, avg_loss = build_lm(cfg)
+            fluid.optimizer.Adam(1e-3).minimize(avg_loss)
+        exe, scope = fluid.Executor(), fluid.Scope()
+        rng = np.random.RandomState(0)
+        losses = []
+        with fluid.scope_guard(scope):
+            exe.run(startup, scope=scope)
+            for _ in range(steps):
+                f = {'tokens': rng.randint(0, 128, (4, 8)).astype('int64'),
+                     'labels': rng.randint(0, 128, (4, 8)).astype('int64')}
+                l, = exe.run(main, feed=f, fetch_list=[avg_loss],
+                             scope=scope)
+                losses.append(float(np.asarray(l).reshape(())))
+        return losses
+    finally:
+        os.environ.pop('PADDLE_FUSED_TIER', None)
+
+
+def test_lm_trajectory_xla_tracks_off():
+    """n_layer=2: block 0's zero-delta entry, the cross-block deferred
+    FFN delta, and the final-LN resolution all in play."""
+    ref = _lm_traj('off', 0.0)
+    got = _lm_traj('xla', 0.0)
+    np.testing.assert_allclose(got, ref, rtol=2e-5, atol=1e-6)
+
+
+@pytest.mark.slow
+def test_lm_trajectory_interpret_with_live_dropout():
+    """Real pallas kernels (interpreted) on a 128-tiling LM with TRAIN
+    dropout active: masks are drawn once per program build from the
+    counted stream, so they are identical across tiers for the same
+    structure and the trajectories compare allclose."""
+    from paddle_tpu.models.transformer import build_lm, LMConfig
+
+    def run(tier):
+        os.environ['PADDLE_FUSED_TIER'] = tier
+        try:
+            cfg = LMConfig(vocab_size=512, seq_len=32, d_model=128,
+                           n_head=4, n_layer=1, d_ff=128, dropout=0.1,
+                           attn_dropout=0.0, use_flash_attention=False)
+            main, startup = fluid.Program(), fluid.Program()
+            main.random_seed = startup.random_seed = 5
+            with fluid.program_guard(main, startup), \
+                    fluid.unique_name.guard():
+                tokens, labels, logits, avg_loss = build_lm(cfg)
+                fluid.optimizer.Adam(1e-3).minimize(avg_loss)
+            exe, scope = fluid.Executor(), fluid.Scope()
+            rng = np.random.RandomState(0)
+            losses = []
+            with fluid.scope_guard(scope):
+                exe.run(startup, scope=scope)
+                for _ in range(3):
+                    f = {'tokens': rng.randint(0, 512, (4, 32))
+                         .astype('int64'),
+                         'labels': rng.randint(0, 512, (4, 32))
+                         .astype('int64')}
+                    l, = exe.run(main, feed=f, fetch_list=[avg_loss],
+                                 scope=scope)
+                    losses.append(float(np.asarray(l).reshape(())))
+            return losses
+        finally:
+            os.environ.pop('PADDLE_FUSED_TIER', None)
+
+    ref = run('off')
+    got = run('interpret')
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)

@@ -309,6 +309,32 @@ def test_sentinel_accept_collapse_and_queue_burn(monkeypatch, tmp_path):
     assert {'accept_collapse', 'queue_burn'} <= kinds
 
 
+def test_sentinel_bench_row_drift():
+    """The registered-baseline row sentinel (PR 16, backs the
+    servebench serving-row registration): readings within
+    baseline * floor stay silent, a reading below the floor trips
+    bench_row_drift once per cooldown, and floor_frac overrides the
+    PADDLE_PERFWATCH_ROW_DRIFT default."""
+    before = _regression_count('bench_row_drift')
+    # 1.6 vs baseline 1.77: well inside the default 0.5 floor
+    assert goodput.note_bench_row('serving_speedup', 1.6, 1.77)
+    assert _regression_count('bench_row_drift') == before
+    # the r06-style reading (0.84 < 1.77 * 0.5) trips — but only once
+    # for the same row inside the cooldown window
+    assert not goodput.note_bench_row('serving_speedup', 0.84, 1.77)
+    assert not goodput.note_bench_row('serving_speedup', 0.85, 1.77)
+    assert _regression_count('bench_row_drift') == before + 1
+    # per-row cooldown keys: a different row still trips, and an
+    # explicit floor_frac tightens the default
+    assert not goodput.note_bench_row('other_row', 0.9, 1.0,
+                                      floor_frac=0.95)
+    assert _regression_count('bench_row_drift') == before + 2
+    trips = [r for r in goodput.regressions()
+             if r['kind'] == 'bench_row_drift']
+    assert trips[-1]['row'] == 'other_row'
+    assert trips[-1]['baseline'] == 1.0
+
+
 def test_dispatch_hook_overhead_guard():
     """The exact per-dispatch addition (note_dispatch) stays <= 5 us:
     interleaved min-of-per-call, gc disabled — the PR 9 methodology (a

@@ -1,7 +1,9 @@
 """Per-op fused-vs-unfused microbench for the kernel tier.
 
 For each fused unit (softmax_ce / fused_adam / embedding_gather /
-layernorm_residual) this builds a small program that isolates the op,
+layernorm_residual / ffn_tail / ln_sites — the last two are the PR 16
+FFN-tail epilogue and the block-entry/final-LN residual-threading
+sites) this builds a small program that isolates the op,
 compiles it under each requested PADDLE_FUSED_TIER, and reports
 steady-state wall time (best-of-rounds minima over k dispatches — the
 box-noise protocol from BASELINE notes) next to the XLA cost-analysis
@@ -17,7 +19,8 @@ impl actually ran). Needs >= N local devices; as a CLI this file forces
 an 8-device virtual CPU host when no accelerator is attached.
 
 Usage: python tools/kernbench.py [--tiers off,xla,interpret]
-       [--cases softmax_ce,fused_adam,embedding_gather,layernorm_residual]
+       [--cases softmax_ce,fused_adam,embedding_gather,
+                layernorm_residual,ffn_tail,ln_sites]
        [--rounds 5] [--size small|bench] [--mesh N]
        (prints one JSON line)
 
@@ -106,11 +109,58 @@ def _build_layernorm_residual(size):
     return main, startup, feed, loss
 
 
+def _build_ffn_tail(size):
+    import numpy as np
+    import paddle_tpu as fluid
+    n, d, d_ff = (2048, 128, 512) if size == 'small' else (4096, 1024, 4096)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data(name='fx', shape=[d], dtype='float32')
+        # the whole FFN sublayer as one op; tier 'off' lowers the
+        # unfused fc->gelu->fc composition — the vs_off column IS the
+        # fused-vs-unfused story. Train-mode dropout included so the
+        # fused epilogue (mask multiply) is part of what gets timed.
+        out = fluid.layers.fused_ffn_tail(x, d_ff, d, num_flatten_dims=1,
+                                          dropout_prob=0.1, is_test=False)
+        loss = fluid.layers.mean(out)
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    rng = np.random.RandomState(0)
+    feed = {'fx': rng.randn(n, d).astype('float32')}
+    return main, startup, feed, loss
+
+
+def _build_ln_sites(size):
+    import numpy as np
+    import paddle_tpu as fluid
+    n, d = (256, 128) if size == 'small' else (4096, 1024)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        # the PR 16 residual-threading sites: a block-ENTRY ln1
+        # resolving the previous block's pending FFN delta, then a
+        # final_ln resolving the last delta — two chained
+        # residual-add + LN pairs on one stream, exactly the shape the
+        # LM/BERT towers lower after the deferral rewrite
+        x = fluid.layers.data(name='sx', shape=[d], dtype='float32')
+        delta = fluid.layers.fc(x, size=d)
+        ln1, stream = fluid.layers.fused_layer_norm_residual(
+            x, delta, begin_norm_axis=1)
+        delta2 = fluid.layers.fc(ln1, size=d)
+        final, _ = fluid.layers.fused_layer_norm_residual(
+            stream, delta2, begin_norm_axis=1)
+        loss = fluid.layers.mean(final)
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    rng = np.random.RandomState(0)
+    feed = {'sx': rng.randn(n, d).astype('float32')}
+    return main, startup, feed, loss
+
+
 _CASES = {
     'softmax_ce': _build_softmax_ce,
     'fused_adam': _build_fused_adam,
     'embedding_gather': _build_embedding_gather,
     'layernorm_residual': _build_layernorm_residual,
+    'ffn_tail': _build_ffn_tail,
+    'ln_sites': _build_ln_sites,
 }
 
 

@@ -70,6 +70,22 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+# Committed serving-row baseline (BENCH_r08, the PR 14-sentinel era
+# box): engine/sequential speedup 1.77. The r06/r07 0.84-0.85x readings
+# were TRIAGED as sequential-BASELINE drift, not an engine regression:
+# sequential_rps swings 3.7x across cpu_fallback rounds on identical
+# code (720 r08 / 1712 r07 / 1886 r06 / 2673 standalone 2026-08) while
+# the engine re-measures >= 1.6x standalone on the same tree, and the
+# ratio IMPROVES under both external CPU load (4.3x) and in-process GIL
+# contention (20x) — the single-threaded tiny-dispatch sequential loop
+# is the noisy term. measure_serving feeds the measured speedup to the
+# goodput sentinel against this baseline so a REAL engine collapse
+# (below baseline * PADDLE_PERFWATCH_ROW_DRIFT) trips
+# perf_regression_total{kind=bench_row_drift} instead of hiding in
+# round-to-round noise.
+SERVING_ROW_BASELINE = {'speedup': 1.77, 'source': 'BENCH_r08'}
+
+
 def _build_model(dirname):
     """Small 3-layer MLP saved as an inference model: big enough that a
     batched dispatch does real work, small enough to compile in ~100 ms
@@ -272,13 +288,18 @@ def measure_serving(rounds=5, clients=8, requests_per_client=40,
             shutil.rmtree(tmp, ignore_errors=True)
 
     eng_rps = n_requests / eng_best
+    from paddle_tpu import goodput
+    speedup = eng_rps / seq_rps
+    goodput.note_bench_row('serving_speedup', speedup,
+                           SERVING_ROW_BASELINE['speedup'])
     return {
         'requests': n_requests,
         'clients': clients,
         'bucket_sizes_spanned': 3,
         'sequential_rps': round(seq_rps, 1),
         'engine_rps': round(eng_rps, 1),
-        'speedup': round(eng_rps / seq_rps, 2),
+        'speedup': round(speedup, 2),
+        'baseline': dict(SERVING_ROW_BASELINE),
         'latency_p50_ms': round(1e3 * (_quantile(lat, 0.5) or 0), 2),
         'latency_p99_ms': round(1e3 * (_quantile(lat, 0.99) or 0), 2),
         'errors': errors[0],
