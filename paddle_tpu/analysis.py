@@ -223,6 +223,21 @@ class ProgramAnalytics(object):
             monitor.set_gauge('program_peak_bytes', self.peak_bytes,
                               labels=labels)
 
+    def hlo_text(self):
+        """Lowered HLO text of this program for post-mortem bundles
+        (PADDLE_BLACKBOX_HLO=1 / tools/hlodump.py). None once the
+        (fn, avals) refs were released by full materialization, or when
+        lowering fails — advisory data only, never raises."""
+        if self._fn is None:
+            return None
+        try:
+            return self._lower().as_text()
+        except Exception as e:          # noqa: BLE001 — advisory data only
+            logger.warning("hlo_text failed for %s: %s",
+                           self.fingerprint[:16], e)
+            monitor.inc('analysis_error_total', labels={'stage': 'hlo'})
+            return None
+
     # -- views -------------------------------------------------------------
     def as_dict(self):
         self.materialize_cost()

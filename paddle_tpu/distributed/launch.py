@@ -140,6 +140,13 @@ def launch_procs(entrypoint, entrypoint_args=(), nproc_per_node=1,
         mport = env.get('PADDLE_METRICS_PORT')
         if mport and mport.strip().isdigit() and int(mport) != 0:
             env['PADDLE_METRICS_PORT'] = str(int(mport) + rank)
+        # ... and publishes its incident bundles under a rank-suffixed
+        # dir, for the same torn-interleaving reason as the monitor log
+        # (two ranks sharing one rotation window would evict each other)
+        if env.get('PADDLE_BLACKBOX'):
+            bdir = env.get('PADDLE_BLACKBOX_DIR', '') or 'blackbox'
+            env['PADDLE_BLACKBOX_DIR'] = os.path.join(
+                bdir, 'rank%d' % rank)
         if devices_per_proc:
             # virtual-device CPU runs (tests / laptops): give each worker
             # its own device slice
@@ -229,8 +236,11 @@ def wait_procs(procs, deadline_s=None, poll_s=0.2, kill_survivors=True,
             if rc != 0:
                 running = _kill_and_reap(
                     pending, kill_survivors and not elastic)
-                from .. import monitor
+                from .. import blackbox, monitor
                 monitor.inc('worker_failure_total')
+                blackbox.record(
+                    'worker_failed', rank=_rank_of(p, procs.index(p)),
+                    returncode=rc, running=running, elastic=elastic)
                 if elastic:
                     detail = ("ranks %s left RUNNING for elastic respawn"
                               % running)
@@ -330,6 +340,19 @@ def run_elastic(entrypoint, entrypoint_args=(), nproc_per_node=1,
             if restarts:
                 extra['PADDLE_ELASTIC_RESTART'] = str(restarts)
                 extra['PADDLE_ELASTIC_RESUME'] = '1'
+                # incident bundles survive respawns the same way worker
+                # logs do: each incarnation publishes under its own
+                # restart_<n>/ subtree, so the FAILED incarnation's
+                # bundles (the crash evidence) are never evicted by the
+                # new incarnation's keep-last-N rotation
+                if extra.get('PADDLE_BLACKBOX',
+                             os.environ.get('PADDLE_BLACKBOX')):
+                    bdir = extra.get(
+                        'PADDLE_BLACKBOX_DIR',
+                        os.environ.get('PADDLE_BLACKBOX_DIR', '')) \
+                        or 'blackbox'
+                    extra['PADDLE_BLACKBOX_DIR'] = os.path.join(
+                        bdir, 'restart_%d' % restarts)
             # each incarnation logs into its own subdir: launch_procs opens
             # workerlog.<rank> with mode 'w', and truncating the FAILED
             # incarnation's logs would destroy exactly the crash evidence

@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 
 from . import analysis
+from . import blackbox
 from . import goodput
 from . import monitor
 from . import resilience
@@ -1271,6 +1272,7 @@ class Executor(object):
         # the step's PRNG key, kept for debug replays (TrainingGuard's
         # NaN-provenance pass must reproduce the failed step's randomness)
         program._last_run_key = key_arr
+        blackbox.note_step(program)
         if fresh_compile:
             # jax.jit is lazy: the XLA compile happens inside the FIRST
             # call, so honest compile wall time spans lowering + that call.
@@ -1498,6 +1500,7 @@ class Executor(object):
         # kept for debug replays, as in _run_impl (TrainingGuard's NaN
         # provenance must not fall back to PRNGKey(0) for host-op programs)
         program._last_run_key = key_arr
+        blackbox.note_step(program)
         val_env = dict(feed)
         lod_env = dict(static_lods)
         for seg in plan:
@@ -1863,6 +1866,7 @@ class Executor(object):
         key_arr = _run_key(program.random_seed, _next_program_run(program),
                            self._run_counter)
         program._last_run_key = key_arr
+        blackbox.note_step(program)
         if fresh_compile:
             # as in run(): jax.jit compiles inside the first call;
             # transient XLA failures retry under the 'compile' site

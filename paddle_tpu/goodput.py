@@ -550,6 +550,14 @@ def _trip(kind, **fields):
         tr.event('perf_regression', **fields, regression=kind)
     except Exception:           # noqa: BLE001 — telemetry only
         monitor.inc('trace_log_write_errors')
+    try:
+        # flight recorder: every sentinel trip publishes a post-mortem
+        # bundle (rate-limit + heavy capture live in blackbox — this is
+        # an enqueue, safe under _lock)
+        from . import blackbox
+        blackbox.record(kind, **fields)
+    except Exception:           # noqa: BLE001 — telemetry only
+        monitor.inc('blackbox_write_errors_total')
 
 
 # ---------------------------------------------------------------------------

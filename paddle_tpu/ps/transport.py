@@ -26,7 +26,10 @@ Observability (docs/observability.md "Parameter-server"): counters
 ``ps_pull_total`` / ``ps_push_total`` {table}, ``ps_pull_rows_total`` /
 ``ps_push_rows_total``, ``ps_pull_bytes`` / ``ps_push_bytes``;
 histograms ``ps_pull_seconds`` / ``ps_push_seconds``; the server side
-counts ``ps_server_request_total{op}``. Bulk load/export/stats traffic
+counts ``ps_server_request_total{op}``, times each op into
+``ps_server_seconds{op}`` (client seconds minus server seconds = wire +
+queueing), and counts torn/undecodable frames in
+``ps_wire_error_total{stage=recv|decode|send}``. Bulk load/export/stats traffic
 rides the separate ``ps_admin`` site so the pull series (and
 ``ps_pull:*`` fault specs) mean per-step pulls only.
 """
@@ -63,26 +66,45 @@ def _retryable(exc):
     return resilience.is_transient(exc)
 
 
+class _PeerClosed(ConnectionError):
+    """Clean EOF at a message boundary: the peer hung up between
+    requests. The server's connection loop treats it as a normal
+    disconnect, NOT a wire error — only a mid-message close is one."""
+
+
+class _DecodeError(ValueError):
+    """The peer's frame arrived whole but did not unpickle: a protocol /
+    version mismatch or corruption, not a connectivity blip — so it is
+    deliberately NOT a ConnectionError (the retry layer must not retry
+    a request the other side cannot even parse)."""
+
+
 def _send_msg(sock, obj):
     blob = pickle.dumps(obj, protocol=4)
     sock.sendall(_HDR.pack(len(blob)) + blob)
     return len(blob)
 
 
-def _recv_exact(sock, n):
+def _recv_exact(sock, n, eof_ok=False):
     buf = bytearray()
     while len(buf) < n:
         chunk = sock.recv(n - len(buf))
         if not chunk:
+            if eof_ok and not buf:
+                raise _PeerClosed('ps transport: peer closed')
             raise ConnectionError('ps transport: socket closed mid-message')
         buf.extend(chunk)
     return bytes(buf)
 
 
-def _recv_msg(sock):
-    (n,) = _HDR.unpack(_recv_exact(sock, _HDR.size))
+def _recv_msg(sock, eof_ok=False):
+    (n,) = _HDR.unpack(_recv_exact(sock, _HDR.size, eof_ok=eof_ok))
     blob = _recv_exact(sock, n)
-    return pickle.loads(blob), n
+    try:
+        return pickle.loads(blob), n
+    except Exception as e:          # noqa: BLE001 — classified for the wire
+        raise _DecodeError('ps transport: undecodable %d-byte frame (%s: '
+                           '%s)' % (n, type(e).__name__, e)) from e
 
 
 class _ShardHandler(object):
@@ -113,8 +135,22 @@ class _ShardHandler(object):
         return t
 
     def handle(self, req):
+        """One request: count + time it, then dispatch. The per-op
+        service-time histogram (``ps_server_seconds{op}``) is the
+        server-side half of fleet triage: client ``ps_pull_seconds``
+        minus this is wire + queueing. A ``multi`` envelope times its
+        sub-requests individually AND the envelope total."""
+        op = str(req.get('op'))
+        monitor.inc('ps_server_request_total', labels={'op': op})
+        t0 = time.perf_counter()
+        try:
+            return self._dispatch(req)
+        finally:
+            monitor.observe('ps_server_seconds',
+                            time.perf_counter() - t0, labels={'op': op})
+
+    def _dispatch(self, req):
         op = req.get('op')
-        monitor.inc('ps_server_request_total', labels={'op': str(op)})
         if op == 'pull':
             rows, version = self._table(req['table']).pull(req['ids'])
             return {'ok': True, 'rows': rows, 'version': version}
@@ -218,8 +254,19 @@ class PSServer(object):
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             while not self._closing.is_set():
                 try:
-                    req, _ = _recv_msg(conn)
+                    req, _ = _recv_msg(conn, eof_ok=True)
+                except _PeerClosed:
+                    return      # clean client disconnect, not an error
+                except _DecodeError:
+                    # a whole frame that didn't unpickle: drop the
+                    # connection (the stream offset is still sane, but
+                    # the peer speaks a different protocol)
+                    monitor.inc('ps_wire_error_total',
+                                labels={'stage': 'decode'})
+                    return
                 except (ConnectionError, OSError):
+                    monitor.inc('ps_wire_error_total',
+                                labels={'stage': 'recv'})
                     return
                 try:
                     resp = self._handler.handle(req)
@@ -230,6 +277,8 @@ class PSServer(object):
                 try:
                     _send_msg(conn, resp)
                 except (ConnectionError, OSError):
+                    monitor.inc('ps_wire_error_total',
+                                labels={'stage': 'send'})
                     return
         finally:
             try:

@@ -60,6 +60,7 @@ import zlib
 
 import numpy as np
 
+from . import blackbox
 from . import monitor
 from . import trace as trace_mod
 
@@ -339,6 +340,8 @@ class RetryPolicy(object):
             monitor.inc('retry_giveup_total', labels={'site': site})
             trace_mod.note('retry_giveup', site=site, reason='donated',
                            error=type(cause).__name__)
+            blackbox.record('retry_giveup', error=cause, site=site,
+                            reason='donated')
             return RuntimeError(
                 "cannot retry %r after %s: the failed attempt consumed "
                 "donated input buffers (set PADDLE_DONATE=0 to trade peak "
@@ -373,6 +376,8 @@ class RetryPolicy(object):
         monitor.inc('retry_giveup_total', labels={'site': site})
         trace_mod.note('retry_giveup', site=site, reason='exhausted',
                        error=type(last).__name__)
+        blackbox.record('retry_giveup', error=last, site=site,
+                        reason='exhausted', attempts=self.max_attempts)
         raise last
 
 
@@ -807,10 +812,26 @@ class TrainingGuard(object):
             monitor.inc('nonfinite_skip_total')
             if self.bad_steps >= self.max_bad_steps:
                 monitor.inc('nonfinite_escalate_total')
+                from . import analysis
                 where = ''
                 if self.last_localization:
                     where = '; ' + analysis.format_localization(
                         self.last_localization)
+                if blackbox.enabled():
+                    # the replayable incident: the scope already holds the
+                    # rolled-back PRE-step state and the program still has
+                    # the failed step's rng key — exactly what
+                    # localize_from_scope (and tools/blackbox.py replay)
+                    # re-executes
+                    prog = getattr(self._program, '_program', self._program)
+                    blackbox.record(
+                        'nonfinite_escalate', program=prog, feed=feed,
+                        state={n: scope.get(n) for n in scope.names()},
+                        lods=dict(getattr(scope, '_lods', {})),
+                        key_arr=getattr(prog, '_last_run_key', None),
+                        localization=self.last_localization,
+                        bad_steps=self.bad_steps,
+                        loss=self._loss_name)
                 raise NonFiniteError(
                     "TrainingGuard: %d consecutive non-finite steps "
                     "(loss %r) — the optimizer update was skipped each "
@@ -919,6 +940,8 @@ def _elastic_loop_body(step_fn, manager, num_steps, start_step, mesh,
                 monitor.inc('elastic_giveup_total')
                 tr.event('elastic_giveup', step=step, resumes=resumes,
                          failure=type(e).__name__)
+                blackbox.record('elastic_giveup', error=e, step=step,
+                                resumes=resumes)
                 raise
             fail_step = step
             import jax
@@ -1010,6 +1033,10 @@ def _elastic_loop_body(step_fn, manager, num_steps, start_step, mesh,
             # ckpt_restore_seconds; recovery covers mesh rebuild + both)
             monitor.observe('elastic_recovery_seconds',
                             time.perf_counter() - t_recover)
+            blackbox.record('elastic_resume', error=e, step=fail_step,
+                            world_size=new_size,
+                            reshard_direction=direction,
+                            restored_step=rstep, resume_step=step)
             continue
         outputs[step] = out
         if fail_step is not None and step >= fail_step:

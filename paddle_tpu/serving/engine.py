@@ -36,6 +36,7 @@ import time
 
 import numpy as np
 
+from .. import blackbox
 from .. import goodput
 from .. import monitor
 from .. import resilience
@@ -479,6 +480,8 @@ class ServingEngine(object):
                     stacked.update(self.ps_resolver.resolve(stacked))
             except Exception as e:      # noqa: BLE001 — delivered per-request
                 monitor.inc('serving_batch_error_total')
+                blackbox.record('serving_batch_error', error=e,
+                                stage='form', requests=len(batch))
                 for r in batch:
                     monitor.inc('serving_request_total',
                                 labels={'outcome': 'error'})
@@ -532,6 +535,9 @@ class ServingEngine(object):
             # a failed batch fails ITS requests; the worker and the
             # pool live on (retry-exhausted transients land here too)
             monitor.inc('serving_batch_error_total')
+            blackbox.record('serving_batch_error', error=e,
+                            stage='execute', requests=len(batch),
+                            padded_rows=padded_rows)
             for r in batch:
                 if r.trace is not None:
                     r.trace.add_stage('execute',

@@ -111,6 +111,7 @@ import time
 
 import numpy as np
 
+from .. import blackbox
 from .. import goodput
 from .. import monitor
 from .. import trace as trace_mod
@@ -1703,6 +1704,9 @@ class GenerateEngine(object):
         # requests; the loop and the engine live on — the decode
         # analog of the PR 4 "pool never dies" contract
         monitor.inc('generate_step_error_total')
+        blackbox.record('generate_step_error', error=e,
+                        program=getattr(self._step_bound, '_program', None),
+                        residents=len(active))
         for i, st in active:
             self._release(i)
             monitor.inc('generate_request_total',

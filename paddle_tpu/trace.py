@@ -52,7 +52,7 @@ from . import monitor
 
 __all__ = ['Trace', 'start', 'maybe_trace', 'current', 'activate',
            'step_scope', 'note', 'flat_timing', 'recent', 'reset',
-           'new_trace_id', 'sample_rate']
+           'new_trace_id', 'sample_rate', 'log_line']
 
 _ids = itertools.count(1)
 _rng = random.Random()
@@ -155,6 +155,14 @@ def _write_line(rec):
                 f.write(line + '\n')
     except Exception:       # noqa: BLE001 — telemetry only
         monitor.inc('trace_log_write_errors')
+
+
+def log_line(rec):
+    """Write one raw JSON record to the trace channel (the blackbox
+    recorder's bundle-pointer lines ride here so a merged rank log names
+    every bundle it references). Same contract as trace records: never
+    raises, silenced by PADDLE_TRACE=0, no-op without a log path."""
+    _write_line(dict(rec))
 
 
 def _rank():

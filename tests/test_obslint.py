@@ -1,0 +1,67 @@
+"""Metric-catalog lint (tools/obslint.py): the tier-1 gate that keeps
+every monitor series documented in docs/observability.md and every
+doc-claimed series real. The repo-level check IS the enforcement — a new
+``monitor.inc('..._total')`` without a catalog entry fails here."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tools import obslint
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_repo_catalog_in_sync():
+    """The live repo: no undocumented code series, no phantom doc
+    series. Failure output names each drifted series and its emission
+    site — fix the doc (or the code), don't widen the allowlist unless
+    the name is dynamically built."""
+    undocumented, unknown = obslint.lint()
+    assert not undocumented, (
+        'series emitted in code but missing from docs/observability.md: '
+        '%s' % undocumented)
+    assert not unknown, (
+        'series documented but not found anywhere in code: %s' % unknown)
+
+
+def test_detects_drift_both_directions(tmp_path):
+    pkg = tmp_path / 'pkg'
+    pkg.mkdir()
+    (pkg / 'm.py').write_text(
+        "monitor.inc('widget_total')\n"
+        "monitor.observe('spam_seconds', 1.0)\n"
+        "monitor.timed_span('stage:x', 'span_stage_seconds')\n")
+    doc = tmp_path / 'doc.md'
+    doc.write_text('`widget_total` and `span_stage_seconds` exist; '
+                   '`ghost_errors` is a doc-only claim.\n')
+    undocumented, unknown = obslint.lint(root=str(pkg), doc_path=str(doc))
+    assert list(undocumented) == ['spam_seconds']
+    assert 'm.py' in undocumented['spam_seconds'][0]
+    assert unknown == ['ghost_errors']
+
+
+def test_mentioned_literals_satisfy_doc_direction(tmp_path):
+    """Table-driven emitters (goodput's export loop) reach monitor.inc
+    through a variable; the docs->code direction accepts any
+    series-suffixed string literal so those need no allowlist entry."""
+    pkg = tmp_path / 'pkg'
+    pkg.mkdir()
+    (pkg / 'm.py').write_text(
+        "ROWS = [('table_driven_total', 3)]\n"
+        "for name, v in ROWS:\n"
+        "    monitor.inc(name, v)\n")
+    doc = tmp_path / 'doc.md'
+    doc.write_text('`table_driven_total` comes from the export table.\n')
+    undocumented, unknown = obslint.lint(root=str(pkg), doc_path=str(doc))
+    assert not undocumented and not unknown
+
+
+@pytest.mark.slow
+def test_cli_exits_zero_on_clean_repo():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, 'tools', 'obslint.py')],
+        capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert 'catalog and code agree' in proc.stdout

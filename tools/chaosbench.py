@@ -17,7 +17,11 @@ Reported:
 - trajectory_parity: the elastic run's per-step losses bit-match an
   uninterrupted same-math baseline (contract: True);
 - devices '8->4', checkpoint cadence, and the elastic_resume /
-  ckpt_reshard counter deltas.
+  ckpt_reshard counter deltas;
+- bundles / bundle_write_ms: the drill runs with the blackbox flight
+  recorder ON (scoped env) and ASSERTS the kill published an incident
+  bundle — the recorder's cost is on the perf record from day one
+  (docs/observability.md "Incident flight recorder").
 
 Usage: python tools/chaosbench.py [steps] [kill_at]   (prints one JSON
 line; PADDLE_FAULT_SPEC-equivalent faults are installed
@@ -65,13 +69,14 @@ def measure_elastic_resume(steps=10, kill_at=7, every_steps=2,
     import numpy as np
     import jax
     import paddle_tpu as fluid
-    from paddle_tpu import monitor, resilience
+    from paddle_tpu import blackbox, monitor, resilience
     from paddle_tpu.parallel.mesh import data_mesh
 
     import shutil
     import tempfile
     own_dir = ckpt_dir is None
     ckpt_dir = ckpt_dir or tempfile.mkdtemp(prefix='chaosbench_')
+    bundle_dir = tempfile.mkdtemp(prefix='chaosbench_blackbox_')
     feeds = _batches(steps, seed=seed)
 
     def _run(exe, main, loss, scope, feed):
@@ -117,15 +122,33 @@ def measure_elastic_resume(steps=10, kill_at=7, every_steps=2,
                 resumed_at[0] = step
 
             # the kill: (kill_at+1)-th run-site check after the startup
-            # run, fatal so the retry layer steps aside
+            # run, fatal so the retry layer steps aside. The flight
+            # recorder is ON for the drill (scoped env): the kill's
+            # elastic_resume must publish a bundle, and its write cost
+            # goes on the bench row.
             resilience.install_fault('run', 'nth', kill_at + 1,
                                      fatal=True)
+            bb_env = {'PADDLE_BLACKBOX': '1',
+                      'PADDLE_BLACKBOX_DIR': bundle_dir,
+                      'PADDLE_BLACKBOX_RATE': '0'}
+            bb_saved = {k: os.environ.get(k) for k in bb_env}
+            os.environ.update(bb_env)
+            blackbox.reset()
             t0 = time.perf_counter()
-            out = resilience.elastic_train_loop(
-                step_fn, mgr, steps, mesh=data_mesh(len(devices)),
-                devices_fn=lambda: devices[:shrink],
-                on_resume=on_resume)
-            wall = time.perf_counter() - t0
+            try:
+                out = resilience.elastic_train_loop(
+                    step_fn, mgr, steps, mesh=data_mesh(len(devices)),
+                    devices_fn=lambda: devices[:shrink],
+                    on_resume=on_resume)
+                wall = time.perf_counter() - t0
+                blackbox.flush(10.0)
+                bundles = blackbox.bundles(bundle_dir)
+            finally:
+                for k, v in bb_saved.items():
+                    if v is None:
+                        os.environ.pop(k, None)
+                    else:
+                        os.environ[k] = v
     finally:
         resilience.clear_faults()
         if own_dir:     # a caller-supplied dir is theirs to keep/inspect
@@ -133,6 +156,14 @@ def measure_elastic_resume(steps=10, kill_at=7, every_steps=2,
 
     delta = monitor.counter_delta(before)
     parity = all(np.array_equal(a, b) for a, b in zip(base, out))
+    bundle_write_ms = blackbox.last_write_ms()
+    kinds = [os.path.basename(b).split('_', 1)[1].rsplit('_', 3)[0]
+             for b in bundles]
+    shutil.rmtree(bundle_dir, ignore_errors=True)
+    if 'elastic_resume' not in kinds:
+        raise AssertionError(
+            'chaosbench: the kill published no elastic_resume bundle '
+            '(got %s) — the flight recorder missed the incident' % kinds)
     return {
         'steps': steps,
         'kill_at_step': kill_at,
@@ -145,6 +176,9 @@ def measure_elastic_resume(steps=10, kill_at=7, every_steps=2,
         'resumed_at_step': resumed_at[0],
         'trajectory_parity': bool(parity),
         'elastic_wall_s': round(wall, 3),
+        'bundles': len(bundles),
+        'bundle_write_ms': round(bundle_write_ms, 3)
+        if bundle_write_ms is not None else None,
         'counters': {k: v for k, v in delta.items()
                      if k.startswith(('elastic_', 'ckpt_reshard',
                                       'ckpt_fallback', 'fault_injected'))},

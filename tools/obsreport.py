@@ -17,7 +17,10 @@ across workers into true fleet p50/p95/p99 (bucket-interpolated; logs
 predating the bucket pairs fall back to count/sum/min/max only).
 
 Trace JSON lines (paddle_tpu.trace shares the monitor-log channel) are
-skipped; ``tools/tracereport.py`` reads that side.
+skipped; ``tools/tracereport.py`` reads that side. Blackbox
+bundle-pointer lines are skipped too; ``--bundles`` lists the incident
+bundles the log references (docs/observability.md "Incident flight
+recorder").
 
 Usage:
     python tools/obsreport.py runlog.jsonl
@@ -116,11 +119,44 @@ def print_trace(trace, out=None):
             a['total'] / a['n'] / 1e3, a['max'] / 1e3, len(a['tids'])))
 
 
+def _is_bundle_pointer(rec):
+    # the blackbox recorder drops one pointer line per published bundle
+    # on this channel ({'blackbox_bundle': <path>, 'kind': ..., ...});
+    # it is neither a snapshot nor a span record — list with --bundles
+    return isinstance(rec, dict) and 'blackbox_bundle' in rec
+
+
 def _is_snapshot(rec):
     # trace records (paddle_tpu.trace) share the monitor-log channel and
     # carry a trace_id; snapshot lines never do — tools/tracereport.py
-    # reads the trace side, this tool reads the snapshot side
-    return isinstance(rec, dict) and 'trace_id' not in rec
+    # reads the trace side, this tool reads the snapshot side. Bundle
+    # pointers (blackbox) are excluded explicitly.
+    return isinstance(rec, dict) and 'trace_id' not in rec \
+        and 'blackbox_bundle' not in rec
+
+
+def print_bundles(paths, out=None):
+    """List every blackbox bundle the log(s) reference, oldest first."""
+    w = (out or sys.stdout).write
+    rows = []
+    for path in paths:
+        with open(path) as f:
+            for line in f:
+                if line.strip():
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    if _is_bundle_pointer(rec):
+                        rows.append(rec)
+    rows.sort(key=lambda r: r.get('ts') or 0)
+    if not rows:
+        w('no bundle pointers\n')
+        return
+    for r in rows:
+        w('%-20s %s\n' % (r.get('kind', '?'), r['blackbox_bundle']))
+    w('%d bundle(s); inspect with: python tools/blackbox.py show <path>\n'
+      % len(rows))
 
 
 def _last_snapshot(path):
@@ -276,8 +312,14 @@ def main(argv=None):
                    help='aggregate the newest snapshot of EACH file into '
                         'one fleet report (per-rank logs from '
                         'distributed.launch)')
+    p.add_argument('--bundles', action='store_true',
+                   help='list the blackbox incident bundles the log(s) '
+                        'reference instead of printing a report')
     args = p.parse_args(argv)
 
+    if args.bundles:
+        print_bundles(args.paths)
+        return
     if args.merge:
         print_merged(merge_snapshots([_last_snapshot(p)
                                       for p in args.paths]))

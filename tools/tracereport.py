@@ -21,9 +21,14 @@ from the metrics writer are skipped automatically) and prints:
 - ``--slo <ms>``: SLO-violation summary (count, rate, and the stage
   that dominated the violators).
 
+Blackbox bundle-pointer lines on the channel are recognized and kept out
+of the latency tables; ``--bundles`` lists the incident bundles a merged
+rank log references (docs/observability.md "Incident flight recorder").
+
 Usage:
     python tools/tracereport.py run.jsonl
     python tools/tracereport.py run.jsonl --slo 50 --top 5
+    python tools/tracereport.py run.jsonl --bundles
     python tools/tracereport.py --merge run.jsonl.rank0 run.jsonl.rank1
     python tools/tracereport.py --merge logs/run.jsonl.rank*
 """
@@ -52,9 +57,12 @@ def _pct(values, q):
 
 
 def read_records(paths):
-    """(traces, events) from trace JSON-lines files; monitor snapshot
-    lines (no trace_id) and unparsable lines are skipped."""
-    traces, events = [], []
+    """(traces, events, bundles) from trace JSON-lines files; monitor
+    snapshot lines (no trace_id) and unparsable lines are skipped.
+    Bundle-pointer lines from the blackbox recorder
+    ({'blackbox_bundle': <path>, ...}) are collected separately — they
+    are neither spans nor lifecycle events (--bundles lists them)."""
+    traces, events, bundles = [], [], []
     for path in paths:
         with open(path) as f:
             for line in f:
@@ -65,13 +73,18 @@ def read_records(paths):
                     rec = json.loads(line)
                 except ValueError:
                     continue
-                if not isinstance(rec, dict) or 'trace_id' not in rec:
+                if not isinstance(rec, dict):
+                    continue
+                if 'blackbox_bundle' in rec:
+                    bundles.append(rec)
+                    continue
+                if 'trace_id' not in rec:
                     continue
                 if 'event' in rec:
                     events.append(rec)
                 elif 'dur_s' in rec:
                     traces.append(rec)
-    return traces, events
+    return traces, events, bundles
 
 
 def stage_table(traces):
@@ -202,16 +215,31 @@ def main(argv=None):
                    help='flag traces slower than this many milliseconds')
     p.add_argument('--top', type=int, default=3,
                    help='how many slowest-trace exemplars to print')
+    p.add_argument('--bundles', action='store_true',
+                   help='list the blackbox incident bundles the log(s) '
+                        'reference instead of the latency report')
     args = p.parse_args(argv)
     if len(args.paths) > 1 and not args.merge:
         args.merge = True           # several files only make sense merged
 
-    traces, events = read_records(args.paths)
+    traces, events, bundles = read_records(args.paths)
+    if args.bundles:
+        if not bundles:
+            sys.stdout.write('no bundle pointers\n')
+            return
+        for r in sorted(bundles, key=lambda r: r.get('ts') or 0):
+            sys.stdout.write('%-20s %s\n'
+                             % (r.get('kind', '?'), r['blackbox_bundle']))
+        sys.stdout.write('%d bundle(s); inspect with: python '
+                         'tools/blackbox.py show <path>\n' % len(bundles))
+        return
     ranks = sorted({t['rank'] for t in traces + events
                     if t.get('rank') is not None})
-    sys.stdout.write('%d traces, %d events from %d file(s)%s\n'
+    sys.stdout.write('%d traces, %d events from %d file(s)%s%s\n'
                      % (len(traces), len(events), len(args.paths),
-                        ' (ranks %s)' % ranks if ranks else ''))
+                        ' (ranks %s)' % ranks if ranks else '',
+                        ' [%d bundle pointer(s); --bundles lists them]'
+                        % len(bundles) if bundles else ''))
     if not traces and not events:
         raise SystemExit('no trace records found — is sampling off? '
                          '(PADDLE_TRACE_SAMPLE, docs/observability.md)')
