@@ -971,6 +971,26 @@ def _child(mode):
         elastic_resume = {'error': '%s: %s' % (type(e).__name__,
                                                str(e)[:200])}
 
+    # shrink-THEN-grow chaos row: the kill halves the fleet, capacity
+    # later returns and the loop re-expands onto the full mesh via a
+    # checkpoint-publish barrier (time_to_recover both directions;
+    # contract: trajectory_parity True). Runs as a subprocess — the
+    # drill needs an 8-way CPU mesh forced before jax initializes,
+    # which this process's jax can no longer do.
+    try:
+        res = subprocess.run(
+            [sys.executable,
+             os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          'tools', 'chaosbench.py'), '--grow'],
+            capture_output=True, text=True, timeout=600)
+        line = [l for l in res.stdout.splitlines()
+                if l.startswith('{')][-1]
+        elastic_grow_back = json.loads(line)
+        elastic_grow_back.pop('metric', None)
+    except Exception as e:
+        elastic_grow_back = {'error': '%s: %s' % (type(e).__name__,
+                                                  str(e)[:200])}
+
     # XLA cost/memory analytics smoke (tools/costreport.py — the
     # Executor.explain CLI): flops + buffer-assignment peak for the
     # mnist-mlp reference programs. Memory stats cost one extra XLA
@@ -1130,6 +1150,7 @@ def _child(mode):
         'async_pipeline': async_pipeline,
         'ctr_ps': ctr_ps,
         'elastic_resume': elastic_resume,
+        'elastic_grow_back': elastic_grow_back,
         'costreport': costreport,
         'kernbench_mesh': kernbench_mesh,
         'goodput': goodput_xcheck,

@@ -78,6 +78,11 @@ TRIGGER_KINDS = {
                           'localization and the replayable step',
     'elastic_resume': 'elastic_train_loop survived a failure and resumed',
     'elastic_giveup': 'elastic_train_loop exhausted its resume budget',
+    'elastic_grow': 'elastic grow-back: preempted capacity returned and '
+                    'the run re-expanded onto the larger mesh',
+    'ps_restore_fallback': 'CheckpointManager: a dense checkpoint '
+                           'restored but its paired PS fleet dump was '
+                           'missing/corrupt — fell back to an older pair',
     'worker_failed': 'distributed.launch: a worker rank died',
     'serving_batch_error': 'ServingEngine: a dispatched batch failed',
     'generate_step_error': 'GenerateEngine: a decode step failed its '

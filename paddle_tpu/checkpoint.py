@@ -36,10 +36,26 @@ actionable errors, and numpy/scalar state restores untouched. A
 checkpoint written on ``mesh(data=8)`` resumes bit-identically on
 ``mesh(data=4)`` or a single device — the substrate for
 ``resilience.elastic_train_loop``'s preemption-aware shrink/grow resume.
+
+Async (non-blocking) saves: ``CheckpointManager(..., async_save=True)``
+splits every save into a step-visible **snapshot** (host offload of the
+persistable state — ``ckpt_snapshot_seconds``) and a background
+**publish** (the same hardened orbax+manifest+rename path, on a single
+writer thread — ``ckpt_publish_seconds``). The training loop only pays
+the snapshot; the goodput ``ckpt`` loss bucket (which sums
+``ckpt_write_seconds``) collapses to snapshot-only. At most ONE publish
+is in flight: a second save arriving before the first published blocks
+(``ckpt_async_backpressure_total``), so the writer can never fall
+unboundedly behind. A publish failure is deferred and re-raised at the
+next ``save``/``flush`` — and ``restore_latest`` flushes the writer
+first, so an elastic resume always sees a consistent "latest" pointer
+(the in-flight publish either completed atomically or left the previous
+checkpoint in place).
 """
 import os
 import re
 import shutil
+import threading
 import time
 
 import numpy as np
@@ -270,17 +286,7 @@ def save_checkpoint(dirname, main_program=None, scope=None, step=None,
             _save_hardened(path, state, step, shard_man)
     monitor.inc('ckpt_write_total')
     if step is not None and os.path.isdir(os.path.dirname(path)):
-        if keep_last_n is None:
-            env = os.environ.get('PADDLE_CKPT_KEEP', '')
-            try:
-                keep_last_n = int(env) if env else None
-            except ValueError:
-                # a typo'd knob must not fail a save that already
-                # published — run without rotation and say so
-                import warnings
-                warnings.warn("PADDLE_CKPT_KEEP=%r is not an integer; "
-                              "rotation disabled" % env, stacklevel=2)
-                keep_last_n = None
+        keep_last_n = _resolve_keep(keep_last_n)
         # rank-gated: on shared storage every process sees the same step
         # dirs — concurrent rmtrees strand half-deleted checkpoints (and
         # inflate ckpt_rotate_total world-size-fold). Non-positive keep
@@ -290,6 +296,21 @@ def save_checkpoint(dirname, main_program=None, scope=None, step=None,
                 and jax.process_index() == 0:
             _rotate(os.path.dirname(path), int(keep_last_n))
     return path
+
+
+def _resolve_keep(keep_last_n):
+    if keep_last_n is None:
+        env = os.environ.get('PADDLE_CKPT_KEEP', '')
+        try:
+            keep_last_n = int(env) if env else None
+        except ValueError:
+            # a typo'd knob must not fail a save that already
+            # published — run without rotation and say so
+            import warnings
+            warnings.warn("PADDLE_CKPT_KEEP=%r is not an integer; "
+                          "rotation disabled" % env, stacklevel=2)
+            keep_last_n = None
+    return keep_last_n
 
 
 def _save_hardened(path, state, step, shard_man=None):
@@ -337,6 +358,11 @@ def _save_hardened(path, state, step, shard_man=None):
 def _rotate(dirname, keep):
     for step_n, path in list_checkpoints(dirname)[:-keep]:
         shutil.rmtree(path, ignore_errors=True)
+        # a PS fleet dump paired with this step (CheckpointManager with
+        # ps_client=) rotates with it — a dense/PS pair is only
+        # restorable together
+        shutil.rmtree(os.path.join(dirname, 'ps_step_%d' % step_n),
+                      ignore_errors=True)
         monitor.inc('ckpt_rotate_total')
 
 
@@ -559,6 +585,138 @@ def load_latest_valid(dirname, main_program=None, scope=None, mesh=None,
         % (dirname, len(candidates), '; '.join(errors) or 'none found'))
 
 
+def _host_snapshot(state):
+    """Decouple a state pytree from the live training buffers: jax arrays
+    offload to host numpy in one batched device_get, numpy values are
+    copied (the scope may hand the same buffer to an in-place update),
+    scalars pass through. The snapshot owns every byte — a later donated
+    or overwritten device buffer cannot corrupt an in-flight publish."""
+    import jax
+    arrs = {k: v for k, v in state.items() if isinstance(v, jax.Array)}
+    got = jax.device_get(arrs) if arrs else {}
+    out = {}
+    for k, v in state.items():
+        if k in got:
+            out[k] = got[k]
+        elif isinstance(v, np.ndarray):
+            out[k] = v.copy()
+        else:
+            out[k] = v
+    return out
+
+
+class _AsyncCkptWriter(object):
+    """Single-slot background checkpoint publisher.
+
+    One daemon thread, one job slot: ``wait_idle`` blocks while a publish
+    is in flight (the save-side backpressure point), ``submit`` hands the
+    next publish over, ``flush`` barriers on completion. A publish
+    failure is stored and re-raised at the next ``check``/``flush`` —
+    the atomic rename in ``_save_hardened`` guarantees a failed publish
+    left the previous checkpoint in place, so callers that flush before
+    reading "latest" (restore_latest) can never observe a torn pointer."""
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._job = None
+        self._busy = False
+        self._error = None
+        self._thread = None
+
+    def _ensure_thread(self):
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._loop, name='paddle-ckpt-writer', daemon=True)
+            self._thread.start()
+
+    def _loop(self):
+        while True:
+            with self._cv:
+                while self._job is None:
+                    self._cv.wait()
+                job = self._job
+                self._job = None
+            try:
+                job()
+            except BaseException as e:     # noqa: BLE001 — deferred
+                with self._cv:
+                    self._error = e
+            with self._cv:
+                self._busy = False
+                monitor.set_gauge('ckpt_async_pending', 0.0)
+                self._cv.notify_all()
+
+    def check(self):
+        """Re-raise (and clear) a deferred publish failure."""
+        with self._cv:
+            err, self._error = self._error, None
+        if err is not None:
+            raise err
+
+    def wait_idle(self):
+        """Block until no publish is in flight; counts the backpressure
+        event when it actually had to wait."""
+        with self._cv:
+            if self._busy or self._job is not None:
+                monitor.inc('ckpt_async_backpressure_total')
+                while self._busy or self._job is not None:
+                    self._cv.wait()
+
+    def submit(self, job):
+        """Hand one publish to the writer (caller holds the single-save
+        pipeline: wait_idle first)."""
+        self._ensure_thread()
+        with self._cv:
+            self._busy = True
+            self._job = job
+            monitor.set_gauge('ckpt_async_pending', 1.0)
+            self._cv.notify_all()
+
+    def flush(self, raise_errors=True):
+        """Barrier: wait for any in-flight publish, then surface (or
+        warn about) a deferred failure. With raise_errors=False a failed
+        publish only warns — the restore path must proceed to the newest
+        checkpoint that DID publish."""
+        with self._cv:
+            while self._busy or self._job is not None:
+                self._cv.wait()
+            err, self._error = self._error, None
+        if err is not None:
+            if raise_errors:
+                raise err
+            import warnings
+            warnings.warn(
+                'async checkpoint publish failed (%s: %s); the previous '
+                'checkpoint remains the recovery point'
+                % (type(err).__name__, err), stacklevel=2)
+
+
+_LIVE_WRITERS = None
+
+
+def _register_writer(writer):
+    """Track every live async writer in a WeakSet and install ONE atexit
+    hook that flushes them quietly — the flush-on-exit barrier: a final
+    save near interpreter shutdown must still publish (daemon writer
+    threads would otherwise be killed mid-rename-free, leaving only the
+    snapshot). Weak references: a dropped CheckpointManager must not be
+    kept alive (or flushed) forever by the registry."""
+    global _LIVE_WRITERS
+    if _LIVE_WRITERS is None:
+        import atexit
+        import weakref
+        _LIVE_WRITERS = weakref.WeakSet()
+
+        def _flush_all():
+            for w in list(_LIVE_WRITERS):
+                try:
+                    w.flush(raise_errors=False)
+                except Exception:       # noqa: BLE001 — shutdown path
+                    pass
+        atexit.register(_flush_all)
+    _LIVE_WRITERS.add(writer)
+
+
 class CheckpointManager(object):
     """Cadenced checkpointing + topology-independent resume — the driver
     object ``resilience.elastic_train_loop`` saves through and restores
@@ -578,10 +736,35 @@ class CheckpointManager(object):
     step; ``force=True`` always writes) and rotates to ``keep_last_n``. ``restore_latest`` walks checkpoints
     newest-first past corrupt/partial ones (load_latest_valid) and
     returns ``(step, path, restored_names)`` — with ``mesh=`` the state
-    reshards onto the new topology (shrink/grow after a worker loss)."""
+    reshards onto the new topology (shrink/grow after a worker loss).
+
+    ``async_save=True``: ``save`` only pays the host snapshot
+    (``ckpt_snapshot_seconds``); the hardened publish runs on a single
+    background writer thread (``ckpt_publish_seconds``,
+    ``ckpt_async_pending`` gauge). At most one publish is in flight — a
+    second save first waits for the previous publish
+    (``ckpt_async_backpressure_total``), bounding the recovery-point lag
+    at one cadence interval. ``flush()`` barriers on the writer (called
+    automatically by ``restore_latest`` and at interpreter exit); a
+    deferred publish failure re-raises at the next ``save``/``flush``.
+    Multi-host saves ignore the flag (the cross-process orbax commit
+    must run collectively on the training thread).
+
+    ``ps_client=`` (a ``ps.PSClient``): every cadenced save also
+    snapshots the parameter-server fleet into
+    ``dirname/ps_step_<step>/`` (one atomic per-shard dump + fleet
+    manifest — see ``PSClient.save_state``) BEFORE the dense state is
+    captured, and ``restore_latest`` restores dense+PS as a pair,
+    falling back to an older step when either half is corrupt
+    (``ps_restore_fallback_total`` + a ``ps_restore_fallback`` incident
+    bundle when only the PS half failed). The PS dump is synchronous
+    even under ``async_save`` — the version-consistent cut across the
+    push ledger must happen at the save point, not when the writer
+    thread gets around to it."""
 
     def __init__(self, dirname, main_program=None, scope=None,
-                 every_steps=None, every_s=None, keep_last_n=None):
+                 every_steps=None, every_s=None, keep_last_n=None,
+                 async_save=False, ps_client=None):
         if every_steps is not None and int(every_steps) < 1:
             raise ValueError("every_steps must be >= 1 (or None)")
         if every_steps is None and every_s is None:
@@ -597,6 +780,11 @@ class CheckpointManager(object):
         self.keep_last_n = keep_last_n
         self.last_saved_step = None
         self._last_save_t = None
+        self.async_save = bool(async_save)
+        self._ps_client = ps_client
+        self._writer = _AsyncCkptWriter() if self.async_save else None
+        if self._writer is not None:
+            _register_writer(self._writer)
 
     def _resolve(self, scope):
         prog = self._program if self._program is not None else \
@@ -622,19 +810,80 @@ class CheckpointManager(object):
 
     def save(self, step, force=False, scope=None):
         """Checkpoint after `step` if the cadence (or `force`) says so;
-        returns the written path or None when skipped."""
+        returns the written path (async: the path the writer will
+        publish) or None when skipped."""
         if not (force or self.should_save(step)):
             return None
         prog, scope = self._resolve(scope)
-        path = save_checkpoint(self.dirname, prog, scope=scope,
-                               step=int(step), keep_last_n=self.keep_last_n)
+        if self._ps_client is not None:
+            # PS fleet first: the cut is taken at the save point (the
+            # trainer is between steps, so the push ledger is quiescent)
+            # and a crash before the dense publish leaves only an orphan
+            # ps_step dir, never a dense step without its PS half
+            self._ps_client.save_state(
+                os.path.join(self.dirname, 'ps_step_%d' % int(step)))
+        import jax
+        if self._writer is not None and jax.process_count() == 1:
+            path = self._save_async(prog, scope, int(step))
+        else:
+            path = save_checkpoint(self.dirname, prog, scope=scope,
+                                   step=int(step),
+                                   keep_last_n=self.keep_last_n)
         self.last_saved_step = int(step)
         self._last_save_t = time.monotonic()
         return path
 
+    def _save_async(self, prog, scope, step):
+        """The non-blocking save: surface any deferred publish failure,
+        wait out the single-publish backpressure, snapshot host-side,
+        hand the hardened publish to the writer thread. Only the wait +
+        snapshot is step-visible — that is what lands in
+        ``ckpt_write_seconds`` (the goodput ``ckpt`` loss bucket); the
+        publish cost lands in ``ckpt_publish_seconds`` off the step
+        path."""
+        w = self._writer
+        w.check()
+        t0 = time.perf_counter()
+        w.wait_idle()
+        with monitor.timed_span('ckpt_snapshot', 'ckpt_snapshot_seconds'):
+            state = _persistable_state(prog, scope)
+            if not state:
+                raise RuntimeError(
+                    "save_checkpoint: nothing persistable to save")
+            shard_man = _sharding_manifest(state, prog)
+            host = _host_snapshot(state)
+        path = os.path.abspath(os.path.join(self.dirname,
+                                            'step_%d' % step))
+        keep = self.keep_last_n
+
+        def publish():
+            with monitor.timed_span('ckpt_publish',
+                                    'ckpt_publish_seconds'):
+                _save_hardened(path, host, step, shard_man)
+            monitor.inc('ckpt_write_total')
+            keep_n = _resolve_keep(keep)
+            if keep_n is not None and int(keep_n) > 0:
+                _rotate(os.path.dirname(path), int(keep_n))
+
+        w.submit(publish)
+        monitor.observe('ckpt_write_seconds', time.perf_counter() - t0)
+        return path
+
+    def flush(self, raise_errors=True):
+        """Async-save barrier: block until any in-flight publish
+        completed and surface a deferred failure. No-op for sync
+        managers — call it before reading checkpoints externally or at
+        a clean shutdown (final saves must be durable, not merely
+        snapshotted)."""
+        if self._writer is not None:
+            self._writer.flush(raise_errors=raise_errors)
+
     def latest_step(self):
         """Newest on-disk step number, or None when no checkpoint exists
-        (validity is only established by actually restoring)."""
+        (validity is only established by actually restoring). Flushes
+        the async writer first — "latest" must mean published, not
+        merely snapshotted."""
+        self.flush(raise_errors=False)
         cks = list_checkpoints(self.dirname)
         return cks[-1][0] if cks else None
 
@@ -643,12 +892,56 @@ class CheckpointManager(object):
         """Restore the newest valid checkpoint (falling back past corrupt
         ones), optionally resharded onto `mesh`; returns
         ``(step, path, restored_names)``. Raises IOError when nothing
-        valid exists."""
+        valid exists.
+
+        Async saves: the writer is flushed (await-or-fail, never a torn
+        pointer) before the walk — an in-flight publish either lands
+        atomically and is restored, or failed and the walk starts at the
+        previous checkpoint. With ``ps_client=``, dense and PS state
+        restore as a PAIR per step; a step whose PS half is
+        missing/corrupt falls back to an older pair
+        (``ps_restore_fallback_total`` + incident bundle)."""
         prog, scope = self._resolve(scope)
-        path, names = load_latest_valid(self.dirname, prog, scope,
-                                        mesh=mesh, reshard=reshard,
-                                        restore_rng=restore_rng)
-        m = _STEP_RE.match(os.path.basename(path))
-        step = int(m.group(1)) if m else None
-        self.last_saved_step = step
-        return step, path, names
+        self.flush(raise_errors=False)
+        if self._ps_client is None:
+            path, names = load_latest_valid(self.dirname, prog, scope,
+                                            mesh=mesh, reshard=reshard,
+                                            restore_rng=restore_rng)
+            m = _STEP_RE.match(os.path.basename(path))
+            step = int(m.group(1)) if m else None
+            self.last_saved_step = step
+            return step, path, names
+        mesh, reshard = _resolve_mesh(mesh, reshard)
+        dirname = os.path.abspath(self.dirname)
+        _clean_stale_tmp(dirname)
+        candidates = list(reversed(list_checkpoints(dirname)))
+        errors = []
+        for i, (step_n, path) in enumerate(candidates):
+            try:
+                names = _restore(path, prog, scope, mesh=mesh,
+                                 reshard=reshard, restore_rng=restore_rng)
+            except Exception as e:      # noqa: BLE001 — corrupt ckpt
+                errors.append('%s: %s' % (os.path.basename(path), e))
+                monitor.inc('ckpt_fallback_total')
+                continue
+            ps_dir = os.path.join(dirname, 'ps_step_%d' % step_n)
+            try:
+                self._ps_client.restore_state(ps_dir)
+            except Exception as e:      # noqa: BLE001 — bad PS half
+                # the dense half restored but the fleet dump is
+                # missing/corrupt: the PAIR is unusable — record the
+                # incident and fall back to an older pair (the scope
+                # will be overwritten by that older dense restore)
+                monitor.inc('ps_restore_fallback_total')
+                from . import blackbox
+                blackbox.record('ps_restore_fallback', error=e,
+                                step=step_n, ps_dir=ps_dir)
+                errors.append('%s [ps]: %s' % (os.path.basename(path), e))
+                continue
+            monitor.set_gauge('ckpt_fallback_depth', float(i))
+            self.last_saved_step = step_n
+            return step_n, path, names
+        raise IOError(
+            "restore_latest: no valid dense+PS checkpoint pair under %r "
+            "(tried %d): %s" % (dirname, len(candidates),
+                                '; '.join(errors) or 'none found'))

@@ -185,8 +185,20 @@ def _rank_of(p, i):
     return getattr(p, 'paddle_rank', i)
 
 
+class CapacityReturned(object):
+    """Sentinel ``wait_procs(elastic=True, capacity_fn=)`` returns when
+    the capacity probe reports more worker slots than the current world
+    size — the ``run_elastic`` cue to drain the (healthy, shrunken)
+    fleet and respawn LARGER (grow-back). ``.capacity`` is the probed
+    slot count; ``.running`` the ranks alive at probe time."""
+
+    def __init__(self, capacity, running):
+        self.capacity = int(capacity)
+        self.running = list(running)
+
+
 def wait_procs(procs, deadline_s=None, poll_s=0.2, kill_survivors=True,
-               elastic=False):
+               elastic=False, capacity_fn=None):
     """Wait for every launched worker; FAIL FAST with a rank-naming error.
 
     - a worker exits nonzero -> the survivors are killed (they would hang
@@ -203,7 +215,14 @@ def wait_procs(procs, deadline_s=None, poll_s=0.2, kill_survivors=True,
     ``.running`` = ranks still alive) so an elastic driver (run_elastic)
     can drain the survivors and respawn at a smaller world size. Only
     the deadline still kills everything and raises: a hung fleet has
-    nothing left to shrink around."""
+    nothing left to shrink around.
+
+    capacity_fn (elastic only): the returned-rank rendezvous — a
+    callable polled once per sweep returning the number of worker slots
+    currently schedulable (freed machines rejoining, a scheduler quota
+    restored). When it exceeds ``len(procs)``, a ``CapacityReturned``
+    sentinel is **returned** (the workers stay running — the caller
+    decides when to drain and re-expand)."""
     if deadline_s is None:
         env = os.environ.get('PADDLE_LAUNCH_DEADLINE_S', '')
         deadline_s = float(env) if env else None
@@ -260,6 +279,12 @@ def wait_procs(procs, deadline_s=None, poll_s=0.2, kill_survivors=True,
                 if elastic:
                     return err
                 raise err
+        if pending and elastic and capacity_fn is not None:
+            cap = int(capacity_fn())
+            if cap > len(procs):
+                running = sorted(_rank_of(q, procs.index(q))
+                                 for q in pending if q.poll() is None)
+                return CapacityReturned(cap, running)
         if pending and deadline_s is not None and \
                 time.monotonic() - t0 > deadline_s:
             running = _kill_and_reap(pending, True)
@@ -306,7 +331,7 @@ def _drain(procs, grace_s=10.0):
 def run_elastic(entrypoint, entrypoint_args=(), nproc_per_node=1,
                 min_nproc=1, max_restarts=None, deadline_s=None,
                 log_dir=None, env_extra=None, devices_per_proc=None,
-                **launch_kw):
+                capacity_fn=None, **launch_kw):
     """Elastic launch driver: spawn `nproc_per_node` workers, and when one
     dies, SHRINK instead of dying — drain the survivors (SIGTERM, so they
     can flush a final checkpoint), then respawn the job at
@@ -315,6 +340,16 @@ def run_elastic(entrypoint, entrypoint_args=(), nproc_per_node=1,
     restart ordinal) and ``PADDLE_ELASTIC_RESUME=1`` in its env — the
     worker-side cue to restore the latest valid checkpoint with
     ``reshard=True`` before training (docs/resilience.md).
+
+    GROW-BACK: with ``capacity_fn`` (a callable returning the number of
+    schedulable worker slots), a SHRUNKEN fleet is re-expanded when
+    capacity returns: the probe is polled while world size is below the
+    original ``nproc_per_node``, and when it reports more slots the
+    healthy workers are drained (SIGTERM — they publish their final
+    checkpoint) and the job respawns at
+    ``min(nproc_per_node, capacity)`` with the same resume cue. Grow
+    respawns count ``elastic_grow_total`` and do NOT consume
+    `max_restarts` — returned capacity is good news, not a failure.
 
     Returns ``(exit_codes, restarts)`` on success. Raises the final
     WorkerFailedError when the world would shrink below `min_nproc` or
@@ -326,7 +361,8 @@ def run_elastic(entrypoint, entrypoint_args=(), nproc_per_node=1,
     from .. import monitor
     from .. import trace as trace_mod
     nproc = int(nproc_per_node)
-    restarts = 0
+    restarts = 0            # incarnation ordinal (log/bundle subdirs)
+    fail_restarts = 0       # only FAILURE respawns consume max_restarts
     # the incarnation trace: one id across every respawn of this job,
     # stamped into each worker's env (PADDLE_TRACE_PARENT) by
     # launch_procs — a post-mortem joins the driver's respawn events
@@ -364,19 +400,49 @@ def run_elastic(entrypoint, entrypoint_args=(), nproc_per_node=1,
                 log_dir=ld, env_extra=extra,
                 devices_per_proc=devices_per_proc, **launch_kw)
             try:
-                res = wait_procs(procs, deadline_s=deadline_s,
-                                 elastic=True)
+                # probe for returned capacity only while SHRUNKEN — at
+                # full size there is nothing to grow back to
+                res = wait_procs(
+                    procs, deadline_s=deadline_s, elastic=True,
+                    capacity_fn=capacity_fn
+                    if nproc < int(nproc_per_node) else None)
             except BaseException as e:
                 _drain(procs)
                 tr.finish('error', error=e, restarts=restarts)
                 raise
+            if isinstance(res, CapacityReturned):
+                # grow-back: drain the healthy shrunken fleet (SIGTERM,
+                # so each worker publishes its final checkpoint) and
+                # respawn at the returned capacity with the same
+                # restore-with-reshard resume cue — the grow direction
+                # of the same elastic machinery
+                _drain(procs)
+                new_n = min(int(nproc_per_node), res.capacity)
+                restarts += 1       # a new incarnation (log/bundle dirs)
+                monitor.inc('elastic_grow_total')
+                monitor.inc('elastic_resume_total')
+                tr.event('elastic_grow', restart=restarts,
+                         world_size=new_n, capacity=res.capacity,
+                         old_world_size=nproc)
+                from .. import blackbox
+                blackbox.record('elastic_grow', restart=restarts,
+                                world_size=new_n, capacity=res.capacity,
+                                old_world_size=nproc)
+                sys.stderr.write(
+                    'paddle_tpu.distributed.launch: capacity returned '
+                    '(%d slots); elastic grow-back #%d to world size %d\n'
+                    % (res.capacity, restarts, new_n))
+                nproc = new_n
+                continue
             if not isinstance(res, WorkerFailedError):
                 tr.finish('ok', restarts=restarts, world_size=nproc)
                 return res, restarts
             _drain(procs)
             survivors = len(res.running)
             restarts += 1
-            if survivors < int(min_nproc) or restarts > int(max_restarts):
+            fail_restarts += 1
+            if survivors < int(min_nproc) or \
+                    fail_restarts > int(max_restarts):
                 monitor.inc('elastic_giveup_total')
                 tr.event('elastic_giveup', restarts=restarts,
                          dead_rank=res.rank, world_size=survivors,

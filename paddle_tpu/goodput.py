@@ -258,6 +258,11 @@ _ANALYSIS_KIND = {'run': 'run', 'bound': 'run', 'fused': 'fused',
 # (unattributed) remainder with step_wait near zero.
 LOSS_BUCKETS = {
     'compile': ('compile_seconds',),
+    # 'ckpt' sums only STEP-VISIBLE save wall: under async saves
+    # ckpt_write_seconds records just the backpressure wait + host
+    # snapshot, while the background publish (ckpt_publish_seconds) is
+    # deliberately NOT bucketed — it overlaps training compute, so
+    # counting it would double-bill wall the step loop never lost
     'ckpt': ('ckpt_write_seconds', 'ckpt_restore_seconds'),
     'retry_backoff': ('retry_backoff_seconds',),
     'elastic_recovery': ('elastic_recovery_seconds',),

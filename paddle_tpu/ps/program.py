@@ -122,20 +122,30 @@ def _optimizer_spec_from_op(op, w_name, programs):
     """Map the removed in-device optimizer op to the PSTable optimizer
     config (type + hyperparameters + learning rate)."""
     lr_names = op.input('LearningRate')
-    lr = _fill_value_of(lr_names[0], programs) if lr_names else None
-    if lr is None:
+    if not lr_names:
         raise ValueError(
-            "pserver transpile: cannot resolve a constant learning rate "
-            "for table %r (op %s, lr var %s) — LR schedules are not "
-            "supported on PS tables yet; use a float learning_rate"
-            % (w_name, op.type, lr_names))
+            "pserver transpile: optimizer op %s for table %r has no "
+            "LearningRate input" % (op.type, w_name))
+    lr = _fill_value_of(lr_names[0], programs)
+    lr_var = None
+    if lr is None:
+        # not a resolvable constant: an LR SCHEDULE — the rate is a
+        # variable computed by graph ops (learning_rate_scheduler's
+        # decay over @LR_DECAY_COUNTER@). Record the variable name; the
+        # trainer fetches it each step and ships the float with every
+        # push (PSTable.push lr=), so the server-side optimizer follows
+        # the schedule bitwise. lr stays 0.0 as a tripwire: a push that
+        # forgets the rate raises in PSTable.push rather than silently
+        # training at a wrong constant.
+        lr_var = lr_names[0]
+        lr = 0.0
     if op.type == 'adam':
-        return dict(optimizer='adam', lr=lr,
+        return dict(optimizer='adam', lr=lr, lr_var=lr_var,
                     beta1=float(op.attr('beta1', 0.9)),
                     beta2=float(op.attr('beta2', 0.999)),
                     epsilon=float(op.attr('epsilon', 1e-8)))
     if op.type == 'sgd':
-        return dict(optimizer='sgd', lr=lr)
+        return dict(optimizer='sgd', lr=lr, lr_var=lr_var)
     raise ValueError(
         "pserver transpile: table %r is optimized by %r, but the PS "
         "subsystem mirrors only the adam/sgd sparse kernels (table.py); "

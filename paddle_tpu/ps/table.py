@@ -85,7 +85,8 @@ class PSTableSpec(object):
 
     def __init__(self, name, height, width, dtype='float32',
                  optimizer='adam', lr=0.001, beta1=0.9, beta2=0.999,
-                 epsilon=1e-8, init_value=0.0, init_kind='fill_constant'):
+                 epsilon=1e-8, init_value=0.0, init_kind='fill_constant',
+                 lr_var=None):
         if optimizer not in ('adam', 'sgd'):
             raise ValueError(
                 "PSTableSpec %r: optimizer must be 'adam' or 'sgd' (the "
@@ -103,6 +104,12 @@ class PSTableSpec(object):
         self.epsilon = float(epsilon)
         self.init_value = float(init_value)
         self.init_kind = init_kind
+        # name of the program's learning-rate VARIABLE when lr is a
+        # schedule (exponential_decay etc.) rather than a constant: the
+        # trainer fetches it each step and sends the float with the push
+        # (push lr= override); `lr` then only serves as the fallback for
+        # pushes that carry no rate
+        self.lr_var = lr_var
 
     def to_dict(self):
         return dict(self.__dict__)
@@ -221,12 +228,16 @@ class PSTable(object):
                 self.spec.beta1, self.spec.beta2, self.spec.epsilon)
         return self._apply_jit
 
-    def push(self, ids, grads, step):
+    def push(self, ids, grads, step, lr=None):
         """Apply one step's row gradients. `ids` may repeat (un-merged
         SelectedRows state — _adam_sparse merges with the same stable
         ordering the device kernel uses); `step` is the trainer's global
         1-based step, from which the beta-power/lr_t schedule derives.
-        Returns the new shard version."""
+        `lr` overrides the spec's constant rate for THIS push — the
+        trainer fetches its LR-schedule variable per step and sends the
+        value along, so server-side adam/sgd follow decay schedules
+        bitwise (host f32 lr_t math matches the device's). Returns the
+        new shard version."""
         ids = self._check_ids(ids)
         grads = np.asarray(grads)
         if grads.ndim != 2 or grads.shape != (ids.shape[0], self.spec.width):
@@ -235,6 +246,13 @@ class PSTable(object):
                 % (self.spec.name, grads.shape, ids.shape[0],
                    self.spec.width))
         step = max(1, int(step))
+        if lr is None and self.spec.lr_var:
+            raise ValueError(
+                "table %r runs the LR schedule variable %r: every push "
+                "must carry this step's lr= (PSTrainerSession fetches it "
+                "automatically; manual pushes must supply it)"
+                % (self.spec.name, self.spec.lr_var))
+        lr_f = np.float32(self.spec.lr if lr is None else lr)
         with self._lock:
             uniq, inv = np.unique(ids, return_inverse=True)
             slots = self._slots_for(uniq)
@@ -243,7 +261,7 @@ class PSTable(object):
                 from ..core.selected_rows import SelectedRows
                 b1p, b2p = self._beta_pows(step)
                 lr_t = np.float32(
-                    np.float32(self.spec.lr)
+                    lr_f
                     * np.sqrt(np.float32(1.0) - b2p)
                     / (np.float32(1.0) - b1p))
                 g = SelectedRows(jnp.asarray(inv.astype(np.int32)),
@@ -258,7 +276,7 @@ class PSTable(object):
             else:               # sgd: the _sgd op's SelectedRows kernel
                 import jax.numpy as jnp
                 p = jnp.asarray(self._data[slots])
-                upd = (-np.float32(self.spec.lr)) * \
+                upd = (-lr_f) * \
                     jnp.asarray(grads).astype(p.dtype)
                 self._data[slots] = np.asarray(
                     p.at[jnp.asarray(inv.astype(np.int32))].add(
@@ -278,6 +296,53 @@ class PSTable(object):
             self._data[slots] = values[idx]
             self._m1[slots] = 0
             self._m2[slots] = 0
+
+    def state(self):
+        """Full shard state for checkpointing: resident rows WITH their
+        optimizer moments and the push-version, id-sorted (a
+        deterministic byte stream, so per-array crc32s are stable for
+        the manifest). Unlike export(), the moments ride along — a
+        restored table resumes bitwise, not just weight-equal. The
+        beta-power accumulators are deliberately absent: they re-derive
+        from the trainer's global step at the next push (_beta_pows
+        recomputes from scratch on any step jump)."""
+        with self._lock:
+            ids = np.fromiter(self._slot.keys(), np.int64, len(self._slot))
+            slots = np.fromiter(self._slot.values(), np.int64,
+                                len(self._slot))
+            order = np.argsort(ids)
+            slots = slots[order]
+            return {'ids': ids[order],
+                    'data': self._data[slots].copy(),
+                    'm1': self._m1[slots].copy(),
+                    'm2': self._m2[slots].copy(),
+                    'version': int(self.version)}
+
+    def load_state(self, state):
+        """REPLACE this shard from a state() dict (or a re-bucketed
+        merge of several — restore onto a different server count hands
+        each new shard exactly its crc32-owned rows). Rows, moments and
+        version all land; anything previously resident is dropped —
+        restore is a full substitution, not a merge with live state."""
+        ids = self._check_ids(state['ids'])
+        dt = self._data.dtype
+        with self._lock:
+            self._slot = {}
+            self._n = 0
+            self._data = np.empty((0, self.spec.width), dt)
+            self._m1 = np.empty((0, self.spec.width), dt)
+            self._m2 = np.empty((0, self.spec.width), dt)
+            uniq, idx = np.unique(ids, return_index=True)
+            slots = self._slots_for(uniq)
+            self._data[slots] = np.asarray(state['data'], dt)[idx]
+            self._m1[slots] = np.asarray(state['m1'], dt)[idx]
+            self._m2[slots] = np.asarray(state['m2'], dt)[idx]
+            self.version = int(state.get('version', 0))
+            # pow accumulators: reset; they rebuild deterministically
+            # from the next push's trainer step
+            self._pow_step = 0
+            self._b1p = np.float32(1.0)
+            self._b2p = np.float32(1.0)
 
     def export(self):
         """(ids [n], rows [n, width]) of every resident row."""

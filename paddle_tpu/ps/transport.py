@@ -33,11 +33,15 @@ queueing), and counts torn/undecodable frames in
 rides the separate ``ps_admin`` site so the pull series (and
 ``ps_pull:*`` fault specs) mean per-step pulls only.
 """
+import io
+import json
+import os
 import pickle
 import socket
 import struct
 import threading
 import time
+import zlib
 
 import numpy as np
 
@@ -168,7 +172,7 @@ class _ShardHandler(object):
                     self._pending.add(key)
             try:
                 version = table.push(req['ids'], req['grads'],
-                                     req['step'])
+                                     req['step'], lr=req.get('lr'))
             except Exception:
                 if key[0] is not None:
                     with self._applied_cv:
@@ -200,12 +204,55 @@ class _ShardHandler(object):
         if op == 'export':
             ids, rows = self._table(req['table']).export()
             return {'ok': True, 'ids': ids, 'rows': rows}
+        if op == 'save_shard':
+            return self._save_shard(req['dir'], int(req.get('shard', 0)))
+        if op == 'restore_state':
+            for name, st in req['tables'].items():
+                self._table(name).load_state(st)
+            # like 'load': the restored run legitimately replays step
+            # numbers the ledger already saw — drop them for every
+            # restored table so the replayed pushes apply
+            with self._applied_cv:
+                for k in [k for k in self._applied
+                          if k[1] in req['tables']]:
+                    del self._applied[k]
+                self._applied_cv.notify_all()
+            return {'ok': True}
         if op == 'stats':
             return {'ok': True,
                     'tables': {n: t.stats() for n, t in self.tables.items()}}
         if op == 'ping':
             return {'ok': True}
         raise ValueError('ps server: unknown op %r' % (op,))
+
+    def _save_shard(self, dirname, shard):
+        """Atomically dump every table's full state (rows + moments +
+        version) to ``<dirname>/shard_<k>.npz``. The cut is
+        version-consistent: the push-idempotence condition is held while
+        snapshotting, so no apply is in flight (``_pending`` drained
+        first) and pushes racing the snapshot queue up behind it — every
+        table's dump reflects the same push frontier."""
+        resilience.maybe_fault('ps_save')
+        with self._applied_cv:
+            while self._pending:
+                self._applied_cv.wait()
+            payload = {}
+            versions = {}
+            for name, t in self.tables.items():
+                st = t.state()
+                versions[name] = st['version']
+                for k in ('ids', 'data', 'm1', 'm2'):
+                    payload['%s/%s' % (name, k)] = st[k]
+                payload['%s/version' % name] = np.int64(st['version'])
+        buf = io.BytesIO()
+        np.savez(buf, **payload)
+        blob = buf.getvalue()
+        path = os.path.join(os.path.abspath(dirname),
+                            'shard_%d.npz' % shard)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        resilience.atomic_write_bytes(path, blob)
+        return {'ok': True, 'path': path, 'crc32': zlib.crc32(blob),
+                'bytes': len(blob), 'versions': versions}
 
 
 class PSServer(object):
@@ -526,11 +573,13 @@ class PSClient(object):
         monitor.observe('ps_pull_seconds', time.perf_counter() - t0)
         return (outs, version) if return_version else outs
 
-    def push(self, table, ids, grads, step):
+    def push(self, table, ids, grads, step, lr=None):
         """Push one step's (ids, grads) for `table`; duplicates are NOT
         pre-merged — the shard's `_adam_sparse` merges them with the same
         summation order as the device kernel. Idempotent per (client,
-        step, table): a retried push cannot double-apply."""
+        step, table): a retried push cannot double-apply. `lr` carries
+        this step's learning rate when the program runs an LR schedule
+        (the spec's constant applies when omitted)."""
         t0 = time.perf_counter()
         ids = np.asarray(ids).reshape(-1).astype(np.int64)
         grads = np.asarray(grads)
@@ -542,6 +591,8 @@ class PSClient(object):
             reqs[shard] = {'op': 'push', 'table': table,
                            'ids': ids[mask], 'grads': grads[mask],
                            'step': int(step), 'client': self.client_id}
+            if lr is not None:
+                reqs[shard]['lr'] = float(lr)
         self._fanout(reqs, 'ps_push')
         monitor.inc('ps_push_total', labels={'table': table})
         monitor.inc('ps_push_rows_total', float(ids.shape[0]))
@@ -579,6 +630,108 @@ class PSClient(object):
         reqs = {s: {'op': 'stats'} for s in range(self.num_shards)}
         resps = self._fanout(reqs, 'ps_admin')
         return {s: resps[s]['tables'] for s in sorted(resps)}
+
+    # ------------------------------------------------------------------
+    FLEET_MANIFEST = 'ps_fleet.json'
+
+    def save_state(self, dirname):
+        """Version-consistent fleet snapshot into `dirname`.
+
+        Each server quiesces its push ledger and atomically dumps every
+        table's rows + optimizer moments + version to
+        ``shard_<k>.npz`` (op ``save_shard``); the client then publishes
+        ``ps_fleet.json`` — num_shards, per-file crc32s, per-table
+        versions — LAST, fsynced, as the completeness marker. A crash
+        mid-dump leaves no manifest, so ``restore_state`` treats the
+        directory as absent and the checkpoint walk falls back to an
+        older pair. Servers must share a filesystem with the manifest
+        writer (the single-host fleet the launcher runs; a remote-FS
+        fleet mounts the checkpoint dir the same way the reference's
+        pservers mount their save path)."""
+        t0 = time.perf_counter()
+        dirname = os.path.abspath(dirname)
+        os.makedirs(dirname, exist_ok=True)
+        reqs = {s: {'op': 'save_shard', 'dir': dirname, 'shard': s}
+                for s in range(self.num_shards)}
+        resps = self._fanout(reqs, 'ps_admin')
+        man = {'format': 'paddle_tpu_ps_fleet', 'version': 1,
+               'num_shards': self.num_shards,
+               'shards': {str(s): {
+                   'file': os.path.basename(resps[s]['path']),
+                   'crc32': int(resps[s]['crc32']),
+                   'versions': {k: int(v) for k, v in
+                                resps[s]['versions'].items()}}
+                   for s in sorted(resps)}}
+        resilience.atomic_write_bytes(
+            os.path.join(dirname, self.FLEET_MANIFEST),
+            json.dumps(man, sort_keys=True).encode())
+        resilience.fsync_dir(dirname)
+        monitor.observe('ps_save_seconds', time.perf_counter() - t0)
+        return dirname
+
+    def restore_state(self, dirname):
+        """Restore a ``save_state`` fleet dump onto THIS client's shard
+        set — which may be a DIFFERENT size than the one that saved:
+        rows re-bucket by the same crc32 ``owners_of_ids`` placement
+        (data-independent, so re-placement is a deterministic
+        re-bucketing) and every row's weights + moments move intact;
+        training resumes bitwise either way. Each dump is crc32-verified
+        against the fleet manifest; a missing manifest or corrupt dump
+        raises (the caller falls back to an older checkpoint pair).
+        Every shard receives a full-replace restore — stale resident
+        rows and push-ledger entries for the restored tables drop."""
+        t0 = time.perf_counter()
+        dirname = os.path.abspath(dirname)
+        try:
+            with open(os.path.join(dirname, self.FLEET_MANIFEST),
+                      'rb') as f:
+                man = json.loads(f.read().decode())
+        except (OSError, ValueError) as e:
+            raise IOError('ps restore: no usable fleet manifest under %r '
+                          '(%s)' % (dirname, e))
+        if man.get('format') != 'paddle_tpu_ps_fleet':
+            raise IOError('ps restore: %r is not a fleet dump' % dirname)
+        parts = {}          # table -> [state dict per saved shard]
+        for s, ent in sorted(man['shards'].items(), key=lambda kv: int(kv[0])):
+            path = os.path.join(dirname, ent['file'])
+            with open(path, 'rb') as f:
+                blob = f.read()
+            if zlib.crc32(blob) != int(ent['crc32']):
+                raise IOError('ps restore: %r fails crc32 verification '
+                              '— the dump is corrupt' % path)
+            npz = np.load(io.BytesIO(blob))
+            names = sorted(set(k.split('/', 1)[0] for k in npz.files))
+            for name in names:
+                parts.setdefault(name, []).append({
+                    'ids': npz['%s/ids' % name],
+                    'data': npz['%s/data' % name],
+                    'm1': npz['%s/m1' % name],
+                    'm2': npz['%s/m2' % name],
+                    'version': int(npz['%s/version' % name])})
+        same_count = int(man['num_shards']) == self.num_shards
+        reqs = {s: {'op': 'restore_state', 'tables': {}}
+                for s in range(self.num_shards)}
+        for name, plist in parts.items():
+            ids = np.concatenate([p['ids'] for p in plist])
+            data = np.concatenate([p['data'] for p in plist])
+            m1 = np.concatenate([p['m1'] for p in plist])
+            m2 = np.concatenate([p['m2'] for p in plist])
+            vmax = max(p['version'] for p in plist)
+            owners = owners_of_ids(ids, self.num_shards)
+            for s in range(self.num_shards):
+                mask = owners == s
+                # same shard count -> identical bucketing: each shard
+                # gets back exactly its own rows AND its own version;
+                # re-hashed fleets take the max (versions only order
+                # staleness, they carry no math)
+                reqs[s]['tables'][name] = {
+                    'ids': ids[mask], 'data': data[mask],
+                    'm1': m1[mask], 'm2': m2[mask],
+                    'version': plist[s]['version'] if same_count
+                    else vmax}
+        self._fanout(reqs, 'ps_admin')
+        monitor.observe('ps_restore_seconds', time.perf_counter() - t0)
+        return dirname
 
     def close(self):
         for ep in self._eps:

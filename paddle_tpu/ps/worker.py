@@ -45,10 +45,13 @@ class _Pusher(object):
     ordering the beta-power schedule and the staleness bound rely on);
     errors surface on the next session call / flush."""
 
-    def __init__(self, client):
+    def __init__(self, client, start_step=0):
         self._client = client
         self._q = queue.Queue()
-        self._done_step = -1
+        # steps before `start_step` were pushed by the PRE-RESTORE
+        # incarnation (their effect is in the restored fleet state) —
+        # the barrier must treat them as already done
+        self._done_step = int(start_step) - 1
         self._cv = threading.Condition()
         self._error = None
         self._thread = threading.Thread(target=self._loop,
@@ -60,11 +63,12 @@ class _Pusher(object):
             item = self._q.get()
             if item is None:
                 return
-            step, per_table = item
+            step, per_table, lrs = item
             try:
                 if self._error is None:
                     for table, (ids, grads) in per_table.items():
-                        self._client.push(table, ids, grads, step + 1)
+                        self._client.push(table, ids, grads, step + 1,
+                                          lr=(lrs or {}).get(table))
             except Exception as e:      # noqa: BLE001 — re-raised upstream
                 with self._cv:
                     if self._error is None:
@@ -73,9 +77,9 @@ class _Pusher(object):
                 self._done_step = step
                 self._cv.notify_all()
 
-    def enqueue(self, step, per_table):
+    def enqueue(self, step, per_table, lrs=None):
         self.check()
-        self._q.put((step, per_table))
+        self._q.put((step, per_table, lrs))
 
     def wait_step(self, step, timeout_s=120.0):
         """Block until the push for `step` completed (no-op for step<0)."""
@@ -145,10 +149,17 @@ class PSTrainerSession(object):
     `staleness`: rows for step i reflect pushes through step
     i-1-staleness. 0 = exact (synchronous push barrier), 1 = the overlap
     default (pull(i+1) proceeds while step i's push is in flight).
+
+    `start_step`: first step number this session runs — pass the
+    restored step when resuming from a checkpoint
+    (``CheckpointManager(..., ps_client=)``) so push step numbers
+    continue the interrupted run's sequence; server-side adam's
+    beta-power schedule is keyed on them, which is what makes the
+    resumed trajectory bitwise.
     """
 
     def __init__(self, executor, program, client, scope=None,
-                 staleness=1):
+                 staleness=1, start_step=0):
         info = getattr(program, '_ps_info', None)
         if info is None or not info.sites:
             raise ValueError(
@@ -162,8 +173,18 @@ class PSTrainerSession(object):
         self.info = info
         self.staleness = max(0, int(staleness))
         self._grad_names = info.grad_names
-        self._step = 0
-        self._pusher = _Pusher(client)
+        # tables on an LR SCHEDULE (spec.lr_var): the rate variable is
+        # fetched with the grad fetches each step and its float rides
+        # every push — server-side adam/sgd then follow the schedule
+        # bitwise (the lr var value at step i is exactly what the
+        # in-device optimizer would have read at step i)
+        self._lr_of_table = {
+            name: spec.lr_var for name, spec in info.tables.items()
+            if getattr(spec, 'lr_var', None)}
+        self._lr_fetches = sorted(set(self._lr_of_table.values()))
+        self._extra_fetches = self._grad_names + self._lr_fetches
+        self._step = int(start_step)
+        self._pusher = _Pusher(client, start_step=self._step)
         self._inflight = []
 
     # ------------------------------------------------------------------
@@ -201,10 +222,17 @@ class PSTrainerSession(object):
                           if f._outs is None]
         self._pusher.wait_step(upto_step)
 
-    def _push_step(self, step, push_ids, grads):
-        # concatenate per table in SITE ORDER — the same order the device
-        # path concatenates multi-site SelectedRows grads, so duplicate
-        # rows sum in the identical sequence
+    def _push_step(self, step, push_ids, extra):
+        # `extra` is the appended-fetch tail: grads in site order, then
+        # the LR-schedule variables. Concatenate per table in SITE
+        # ORDER — the same order the device path concatenates multi-site
+        # SelectedRows grads, so duplicate rows sum in the identical
+        # sequence
+        grads = extra[:len(self._grad_names)]
+        lr_by_var = {n: float(np.asarray(v).reshape(-1)[0])
+                     for n, v in zip(self._lr_fetches,
+                                     extra[len(self._grad_names):])}
+        lrs = {t: lr_by_var[v] for t, v in self._lr_of_table.items()}
         per_table = {}
         gi = 0
         ids_iters = {t: iter(lst) for t, lst in push_ids.items()}
@@ -219,7 +247,7 @@ class PSTrainerSession(object):
             acc[1].append(g)
         merged = {t: (np.concatenate(ids), np.concatenate(gs))
                   for t, (ids, gs) in per_table.items()}
-        self._pusher.enqueue(step, merged)
+        self._pusher.enqueue(step, merged, lrs)
 
     # ------------------------------------------------------------------
     def run(self, feed, fetch_list=None, return_numpy=True):
@@ -236,13 +264,13 @@ class PSTrainerSession(object):
         fetch_list = list(fetch_list or [])
         outs = self.executor.run(
             self.program, feed=full,
-            fetch_list=fetch_list + self._grad_names,
+            fetch_list=fetch_list + self._extra_fetches,
             scope=self.scope, return_numpy=return_numpy)
-        grads = outs[len(fetch_list):]
+        extra = outs[len(fetch_list):]
         step = self._step
         self._step += 1
         t0 = time.perf_counter()
-        self._push_step(step, push_ids, grads)
+        self._push_step(step, push_ids, extra)
         self._pusher.wait_step(step)
         tr = trace_mod.current()
         if tr is not None:
@@ -263,7 +291,7 @@ class PSTrainerSession(object):
         fetch_list = list(fetch_list or [])
         fut = self.executor.run_async(
             self.program, feed=full,
-            fetch_list=fetch_list + self._grad_names, scope=self.scope)
+            fetch_list=fetch_list + self._extra_fetches, scope=self.scope)
         wrapped = _PSStepFuture(self, fut, len(fetch_list), push_ids,
                                 self._step)
         self._step += 1

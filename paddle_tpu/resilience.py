@@ -876,6 +876,17 @@ def elastic_train_loop(step_fn, manager, num_steps, start_step=0, mesh=None,
        included), and
     4. the loop replays from the checkpointed step.
 
+    GROW-BACK: the loop also probes ``devices_fn`` each step in the
+    other direction — when it reports MORE devices than the current mesh
+    uses (preempted capacity returned), the just-completed step is
+    force-published (checkpoint-publish barrier, async writer flushed),
+    restored resharded onto the larger mesh, and training continues at
+    the NEXT step: no replay, bitwise vs an uninterrupted run.
+    ``elastic_grow_total`` + ``elastic_resume_total`` count it,
+    ``ckpt_reshard_total{direction=grow}`` stamps the reshard, and
+    ``on_resume(step, mesh, None)`` announces it — a ``None`` exception
+    distinguishes growth from failure resumes.
+
     Cadenced saves run under the ``ckpt_write`` retry policy; a save that
     still fails only warns (``elastic_save_skipped_total``) — a broken
     checkpoint disk degrades the recovery point, it does not stop
@@ -931,6 +942,48 @@ def _elastic_loop_body(step_fn, manager, num_steps, start_step, mesh,
     # resets the resume budget — max_resumes bounds failures WITHOUT
     # forward progress, not lifetime preemptions of a month-long job
     while step < num_steps:
+        if devices_fn is not None and mesh is not None and \
+                step > int(start_step):
+            # GROW-BACK probe: preempted capacity that returned mid-run
+            # re-expands the job instead of limping shrunken to the end.
+            # devices_fn() reporting more devices than the mesh uses
+            # triggers a checkpoint-publish barrier (force-save the
+            # just-completed step, flush any async publish), a reshard
+            # of that checkpoint onto the larger mesh, and a resume at
+            # the NEXT step — no step replays and no state is
+            # approximated, so the trajectory stays bitwise vs an
+            # uninterrupted run.
+            devices = list(devices_fn())
+            if len(devices) > int(mesh.devices.size):
+                grown = mesh_mod.surviving_mesh(mesh, devices)
+                if int(grown.devices.size) > int(mesh.devices.size):
+                    t_grow = time.perf_counter()
+                    old_size = int(mesh.devices.size)
+                    manager.save(step - 1, force=True)
+                    flush = getattr(manager, 'flush', None)
+                    if callable(flush):
+                        flush()
+                    rstep, _path, _names = manager.restore_latest(
+                        mesh=grown, reshard=reshard)
+                    mesh = grown
+                    if rstep is not None:
+                        step = rstep + 1
+                    new_size = int(mesh.devices.size)
+                    monitor.inc('elastic_resume_total')
+                    monitor.inc('elastic_grow_total')
+                    monitor.set_gauge('elastic_world_size',
+                                      float(new_size))
+                    tr.event('elastic_grow', step=step,
+                             world_size=new_size, old_world_size=old_size,
+                             restored_step=rstep)
+                    blackbox.record('elastic_grow', step=step,
+                                    world_size=new_size,
+                                    old_world_size=old_size,
+                                    restored_step=rstep)
+                    if on_resume is not None:
+                        on_resume(step, mesh, None)
+                    monitor.observe('elastic_recovery_seconds',
+                                    time.perf_counter() - t_grow)
         try:
             out = step_fn(step, mesh)
         except (WorkerFailedError, NonFiniteError, InjectedFault) as e:
@@ -1058,4 +1111,11 @@ def _elastic_loop_body(step_fn, manager, num_steps, start_step, mesh,
                 "previous checkpoint" % (step, type(save_err).__name__,
                                          save_err), stacklevel=2)
         step += 1
+    # flush-on-exit barrier: with async saves the final cadenced save may
+    # still be publishing — the loop's contract is that its recovery
+    # point is durable when it returns (a deferred publish failure
+    # surfaces here rather than being lost with the writer thread)
+    flush = getattr(manager, 'flush', None)
+    if callable(flush):
+        flush()
     return outputs

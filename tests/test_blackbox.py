@@ -294,3 +294,19 @@ def test_elastic_kill_drill_publishes_bundle():
     assert row['bundles'] >= 1
     assert row['bundle_write_ms'] is not None
     assert row['bundle_write_ms'] >= 0.0
+
+
+@pytest.mark.slow
+def test_shrink_grow_drill_publishes_both_bundles():
+    """chaosbench shrink-THEN-grow end-to-end: the kill halves the
+    fleet, capacity returns mid-run and the loop re-expands — the drill
+    bit-matches the uninterrupted baseline, reports time-to-recover in
+    BOTH directions, and publishes bundles for both the elastic_resume
+    and the elastic_grow incidents (measure_shrink_grow raises if
+    either is missing)."""
+    from tools.chaosbench import measure_shrink_grow
+    row = measure_shrink_grow(steps=10, kill_at=3, grow_at=6)
+    assert row['trajectory_parity'] is True
+    assert row['time_to_recover_shrink_s'] is not None
+    assert row['time_to_recover_grow_s'] is not None
+    assert row['counters'].get('elastic_grow_total', 0) == 1

@@ -323,6 +323,109 @@ def test_crash_recovery_sweep_write_boundaries(tmp_path):
         os.path.join(ck, 'step_2')) is not None
 
 
+def test_async_save_bitwise_matches_sync(tmp_path):
+    """Async saves are pure overlap: every step an async manager
+    publishes restores BITWISE identical to what the sync manager wrote
+    — even though training kept mutating the live scope while each
+    publish was in flight (the step-visible host snapshot isolates the
+    save point from later steps)."""
+    def run(ck, async_save):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            w = fluid.layers.create_global_var(
+                [8], value=0.0, dtype='float32', persistable=True,
+                name='ab_w')
+            fluid.layers.increment(w)
+        exe = fluid.Executor()
+        scope = fluid.Scope()
+        mgr = fluid.CheckpointManager(ck, main, scope=scope,
+                                      every_steps=2, keep_last_n=10,
+                                      async_save=async_save)
+        with fluid.scope_guard(scope):
+            exe.run(startup, scope=scope)
+            for step in range(6):
+                exe.run(main, scope=scope)
+                mgr.save(step)
+        mgr.flush()
+        return main
+
+    ck_sync = str(tmp_path / 'sync')
+    ck_async = str(tmp_path / 'async')
+    main_sync = run(ck_sync, async_save=False)
+    main_async = run(ck_async, async_save=True)
+    steps_sync = [s for s, _ in fluid.checkpoint.list_checkpoints(ck_sync)]
+    steps_async = [s for s, _ in
+                   fluid.checkpoint.list_checkpoints(ck_async)]
+    assert steps_sync == steps_async == [1, 3, 5]
+    for (_, p_sync), (_, p_async) in zip(
+            fluid.checkpoint.list_checkpoints(ck_sync),
+            fluid.checkpoint.list_checkpoints(ck_async)):
+        s_a, s_b = fluid.Scope(), fluid.Scope()
+        with fluid.scope_guard(s_a):
+            fluid.checkpoint.load_checkpoint(p_sync, main_sync, scope=s_a)
+        with fluid.scope_guard(s_b):
+            fluid.checkpoint.load_checkpoint(p_async, main_async,
+                                             scope=s_b)
+        a = np.asarray(s_a.get('ab_w'))
+        b = np.asarray(s_b.get('ab_w'))
+        assert np.array_equal(a, b)
+        # and the snapshot really froze the SAVE point, not a later
+        # mutated state: step_k holds k+1 increments
+        step = int(os.path.basename(p_sync).split('_')[1])
+        assert np.array_equal(a, np.full([8], step + 1.0, 'float32'))
+
+
+def test_async_publish_crash_keeps_previous_checkpoint(tmp_path):
+    """Crash DURING the async background publish — the write-boundary
+    sweep's async arm: the step-visible snapshot succeeded but the
+    background _save_hardened dies pre-swap. Contract: flush() surfaces
+    the failure deterministically (await-or-fail, never a torn
+    pointer), the previously published step is untouched and
+    restorable, and the SAME writer publishes the next save clean."""
+    from paddle_tpu import resilience as res
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        w = fluid.layers.create_global_var(
+            [4], value=0.0, dtype='float32', persistable=True,
+            name='async_w')
+        fluid.layers.increment(w)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    ck = str(tmp_path / 'ck')
+    mgr = fluid.CheckpointManager(ck, main, scope=scope, every_steps=1,
+                                  async_save=True)
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        exe.run(main, scope=scope)
+        assert mgr.save(1) is not None
+        mgr.flush()                          # step_1 published
+        saved = _host_state(scope)
+        exe.run(main, scope=scope)
+        try:
+            # nth=3: shardings (1) + crc manifest (2) pass, the pre-swap
+            # site check (3) fires — inside the writer thread
+            res.install_fault('ckpt_write', 'nth', 3)
+            assert mgr.save(2) is not None   # snapshot ok, publish dies
+            with pytest.raises(res.InjectedFault):
+                mgr.flush()
+        finally:
+            res.clear_faults()
+        # old-or-new: the failed publish left step_1 alone, no tmp litter
+        assert sorted(os.listdir(ck)) == ['step_1']
+        s2 = fluid.Scope()
+        with fluid.scope_guard(s2):
+            step, path, _names = mgr.restore_latest(scope=s2)
+        assert step == 1 and path.endswith('step_1')
+        assert np.array_equal(np.asarray(s2.get('async_w')),
+                              saved['async_w'])
+        # the same writer recovers: the next save publishes clean
+        exe.run(main, scope=scope)
+        assert mgr.save(3) is not None
+        mgr.flush()
+    assert sorted(os.listdir(ck)) == ['step_1', 'step_3']
+
+
 def test_checkpoint_manager_cadence_and_restore(tmp_path):
     """CheckpointManager: every_steps cadence, rotation, restore_latest
     returning the step, and the RNG-run-counter round-trip that keeps

@@ -583,6 +583,146 @@ class TestServingPS(object):
             server.close()
 
 
+class TestLRSchedule(object):
+    def test_lr_schedule_ps_matches_dense_baseline(self):
+        """A table whose optimizer runs an LR SCHEDULE (exponential
+        decay): the trainer fetches the rate variable each step and its
+        float rides every push, so server-side adam follows the schedule
+        bitwise — per-step PS losses equal the dense baseline's. A push
+        that omits the rate on such a table is a hard error (silently
+        training at lr=0 is the bug the tripwire exists for)."""
+        def build():
+            main, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main, startup):
+                with fluid.unique_name.guard():
+                    ids = fluid.layers.data(name='ids', shape=[SLOTS],
+                                            dtype='int64')
+                    label = fluid.layers.data(name='label', shape=[1],
+                                              dtype='float32')
+                    emb = fluid.layers.embedding(
+                        input=fluid.layers.reshape(ids, [-1, SLOTS, 1]),
+                        size=[VOCAB, DIM], is_sparse=True,
+                        is_distributed=True)
+                    flat = fluid.layers.reshape(emb, [-1, SLOTS * DIM])
+                    h = fluid.layers.fc(flat, size=16, act='relu')
+                    p = fluid.layers.fc(h, size=1, act='sigmoid')
+                    loss = fluid.layers.mean(
+                        fluid.layers.log_loss(p, label))
+                    lr = fluid.layers.exponential_decay(
+                        0.05, decay_steps=2, decay_rate=0.9)
+                    fluid.optimizer.Adam(learning_rate=lr).minimize(loss)
+            return main, startup, loss
+
+        batches = _make_batches()
+        exe = fluid.Executor(fluid.CPUPlace())
+        main_b, startup_b, loss_b = build()
+        scope_b = fluid.Scope()
+        with fluid.scope_guard(scope_b):
+            exe.run(startup_b, scope=scope_b)
+            init = {n: np.array(scope_b.get(n)) for n in scope_b.names()}
+            losses_b = [np.asarray(exe.run(
+                main_b, feed=b, fetch_list=[loss_b],
+                scope=scope_b)[0]).reshape(-1)[0] for b in batches]
+
+        main_p, startup_p, loss_p = build()
+        info = ps.convert_to_ps_program(main_p, startup_p)
+        (table,) = list(info.tables)
+        assert info.tables[table].lr_var is not None
+        shards = [ps.build_pserver_tables(info, 2, k) for k in range(2)]
+        client = ps.PSClient(shards=shards)
+        scope_p = fluid.Scope()
+        with fluid.scope_guard(scope_p):
+            exe.run(startup_p, scope=scope_p)
+            for n in scope_p.names():
+                if n in init:
+                    scope_p.set(n, init[n])
+            client.load(table, init[table])
+            sess = ps.PSTrainerSession(exe, main_p, client, scope=scope_p)
+            outs = sess.train(batches, fetch_list=[loss_p], overlap=False)
+            sess.flush()
+        losses_p = [np.asarray(o[0]).reshape(-1)[0] for o in outs]
+        np.testing.assert_array_equal(np.asarray(losses_b),
+                                      np.asarray(losses_p))
+        # the tripwire: a scheduled table rejects rate-less pushes
+        with pytest.raises(ValueError, match='lr'):
+            shards[0][table].push(np.array([1]),
+                                  np.zeros((1, DIM), 'f4'), 1)
+
+
+class TestPSCheckpoint(object):
+    def test_fleet_round_trip_bitwise_same_and_resharded(self, tmp_path):
+        """The PS checkpointing acceptance chain: CheckpointManager with
+        ps_client= dumps the fleet (quiesced, version-consistent) next
+        to the dense step; a NEW fleet — same OR different server count
+        — restores the pair and the continued sync-mode run is BITWISE
+        the uninterrupted one (crc32 re-bucketing is data-independent;
+        rows move with their moments; push steps resume via
+        start_step)."""
+        batches = _make_batches(steps=6)
+        exe = fluid.Executor(fluid.CPUPlace())
+
+        # dense baseline over all 6 steps
+        main_b, startup_b, loss_b = _build_ctr()
+        scope_b = fluid.Scope()
+        with fluid.scope_guard(scope_b):
+            exe.run(startup_b, scope=scope_b)
+            init = {n: np.array(scope_b.get(n)) for n in scope_b.names()}
+            losses_b = [np.asarray(exe.run(
+                main_b, feed=b, fetch_list=[loss_b],
+                scope=scope_b)[0]).reshape(-1)[0] for b in batches]
+
+        ck = str(tmp_path / 'ck')
+        fx = _PSFixture()
+        try:
+            scope_p = fx.start_scope(exe, init, init[fx.table])
+            sess = ps.PSTrainerSession(exe, fx.main, fx.client,
+                                       scope=scope_p)
+            with fluid.scope_guard(scope_p):
+                head = sess.train(batches[:3], fetch_list=[fx.loss],
+                                  overlap=False)
+                mgr = fluid.CheckpointManager(ck, fx.main, scope=scope_p,
+                                              every_steps=1,
+                                              ps_client=fx.client)
+                assert mgr.save(3) is not None
+                tail = sess.train(batches[3:], fetch_list=[fx.loss],
+                                  overlap=False)
+            sess.flush()
+            losses_p = [np.asarray(o[0]).reshape(-1)[0]
+                        for o in head + tail]
+            np.testing.assert_array_equal(np.asarray(losses_b),
+                                          np.asarray(losses_p))
+            # the fleet dump sits next to the dense step, manifest last
+            assert os.path.isfile(os.path.join(
+                ck, 'ps_step_3', ps.PSClient.FLEET_MANIFEST))
+            tail_ref = [np.asarray(o[0]).reshape(-1)[0] for o in tail]
+        finally:
+            fx.close()
+
+        for num_shards in (2, 3):       # same count, then re-sharded
+            fx2 = _PSFixture(num_shards=num_shards)
+            try:
+                scope2 = fx2.start_scope(exe)    # fresh random init:
+                # everything must come from the checkpoint pair
+                mgr2 = fluid.CheckpointManager(ck, fx2.main, scope=scope2,
+                                               every_steps=1,
+                                               ps_client=fx2.client)
+                step, path, names = mgr2.restore_latest()
+                assert step == 3 and path.endswith('step_3') and names
+                sess2 = ps.PSTrainerSession(exe, fx2.main, fx2.client,
+                                            scope=scope2, start_step=3)
+                with fluid.scope_guard(scope2):
+                    outs = sess2.train(batches[3:], fetch_list=[fx2.loss],
+                                       overlap=False)
+                sess2.flush()
+                got = [np.asarray(o[0]).reshape(-1)[0] for o in outs]
+                np.testing.assert_array_equal(
+                    np.asarray(tail_ref), np.asarray(got),
+                    err_msg='resumed run diverged at %d shards'
+                            % num_shards)
+            finally:
+                fx2.close()
+
+
 @pytest.mark.slow
 class TestMultiProcess(object):
     def test_subprocess_pserver(self):

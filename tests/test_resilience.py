@@ -676,6 +676,126 @@ def test_elastic_train_loop_chaos_drill(tmp_path):
     assert isinstance(w, _jax.Array) and len(w.sharding.device_set) == 4
 
 
+def test_elastic_grow_back_bitwise(tmp_path):
+    """Grow-back acceptance: a fatal kill shrinks 8 -> 4; capacity
+    returns mid-run and the loop re-expands onto the full mesh through
+    a checkpoint-publish barrier (async saves ON, no replay) — and the
+    whole 8 -> 4 -> 8 trajectory BIT-MATCHES the uninterrupted run."""
+    import jax
+    from paddle_tpu.parallel.mesh import data_mesh
+
+    X, Y = _data()
+
+    def build():
+        fluid.unique_name.switch()     # identical var names across builds
+        return _train_model()
+
+    main, startup, loss = build()
+    exe = fluid.Executor()
+    s0 = fluid.Scope()
+    base = []
+    with fluid.scope_guard(s0):
+        exe.run(startup, scope=s0)
+        for _ in range(8):
+            base.append(np.asarray(exe.run(
+                main, feed={'x': X, 'y': Y}, fetch_list=[loss],
+                scope=s0)[0]).copy())
+
+    main, startup, loss = build()
+    s1 = fluid.Scope()
+    ck = str(tmp_path / 'ck')
+    devices = jax.devices()
+    phase = ['full']
+    before_grow = _counter('elastic_grow_total')
+    before_resume = _counter('elastic_resume_total')
+    with fluid.scope_guard(s1):
+        exe.run(startup, scope=s1)
+        mgr = fluid.CheckpointManager(ck, main, scope=s1, every_steps=2,
+                                      keep_last_n=3, async_save=True)
+
+        def step_fn(step, mesh):
+            try:
+                out = np.asarray(exe.run(
+                    main, feed={'x': X, 'y': Y}, fetch_list=[loss],
+                    scope=s1)[0]).copy()
+            except BaseException:
+                phase[0] = 'half'      # the kill took half the fleet
+                raise
+            if step == 5 and phase[0] == 'half':
+                phase[0] = 'full'      # capacity returns; the probe at
+            return out                 # the top of step 6 re-expands
+
+        resilience.install_fault('run', 'nth', 5, fatal=True)
+        events = []
+        out = resilience.elastic_train_loop(
+            step_fn, mgr, 8, mesh=data_mesh(8),
+            devices_fn=lambda: (devices[:4] if phase[0] == 'half'
+                                else devices),
+            on_resume=lambda st, m, e: events.append(
+                (st, int(m.devices.size), e is None)))
+        resilience.clear_faults()
+        mgr.flush()
+    # kill at step 4 -> shrink resume at 4 on 4 devices (exc set);
+    # grow barrier saves step_5, restores it on 8, resumes at 6 (exc
+    # None) — NO replay in the grow direction
+    assert events == [(4, 4, False), (6, 8, True)]
+    assert _counter('elastic_grow_total') - before_grow == 1
+    assert _counter('elastic_resume_total') - before_resume == 2
+    assert len(out) == 8 and all(o is not None for o in out)
+    for i, (a, b) in enumerate(zip(base, out)):
+        assert np.array_equal(a, b), 'trajectory diverged at step %d' % i
+    # the final state lives back on the FULL mesh
+    w = s1.get('fc_0.w_0')
+    assert len(w.sharding.device_set) == 8
+
+
+def test_run_elastic_grows_back_on_capacity(tmp_path):
+    """Launcher grow-back: after shrinking 3 -> 2 on a worker death, the
+    capacity probe reports 3 slots again — the driver drains the healthy
+    shrunken fleet and respawns at full size with the resume cue."""
+    from paddle_tpu.distributed.launch import run_elastic
+
+    marker = str(tmp_path / 'm')
+    script = tmp_path / 'worker.py'
+    script.write_text(
+        "import os, sys, time\n"
+        "marker = sys.argv[1]\n"
+        "rank = int(os.environ['PADDLE_TRAINER_ID'])\n"
+        "world = int(os.environ['PADDLE_TRAINERS_NUM'])\n"
+        "restart = os.environ.get('PADDLE_ELASTIC_RESTART', '0')\n"
+        "resume = os.environ.get('PADDLE_ELASTIC_RESUME', '')\n"
+        "open('%s.r%s.rank%d' % (marker, restart, rank), 'w').write(\n"
+        "    'world=%d resume=%s' % (world, resume))\n"
+        "if restart == '0':\n"
+        "    if rank == world - 1:\n"
+        "        sys.exit(3)\n"       # dies at once; survivors outlive
+        "    time.sleep(0.6)\n"       # the detection poll
+        "elif restart == '1':\n"
+        "    time.sleep(30)\n"        # healthy shrunken fleet: drained
+        )                             # when capacity returns (SIGTERM)
+    import glob
+
+    def capacity_fn():
+        # capacity "returns" only once both shrunken workers checked in
+        # (markers on disk) — otherwise the probe drains them before
+        # they even start, which is legal but leaves nothing to assert
+        return 3 if len(glob.glob(marker + '.r1.rank*')) == 2 else 2
+
+    before = _counter('elastic_grow_total')
+    codes, restarts = run_elastic(str(script), (marker,),
+                                  nproc_per_node=3, min_nproc=1,
+                                  capacity_fn=capacity_fn)
+    # restart 1 = the shrink respawn, restart 2 = the grow respawn
+    assert codes == [0, 0, 0] and restarts == 2
+    assert _counter('elastic_grow_total') - before == 1
+    shrunk = sorted(glob.glob(marker + '.r1.rank*'))
+    assert len(shrunk) == 2                  # respawned at world size 2
+    assert open(shrunk[0]).read() == 'world=2 resume=1'
+    grown = sorted(glob.glob(marker + '.r2.rank*'))
+    assert len(grown) == 3                   # grew back to full size
+    assert open(grown[0]).read() == 'world=3 resume=1'
+
+
 def test_elastic_loop_gives_up_after_max_resumes(tmp_path):
     main, startup = _inc_model()
     exe = fluid.Executor()

@@ -25,7 +25,10 @@ Reported:
 
 Usage: python tools/chaosbench.py [steps] [kill_at]   (prints one JSON
 line; PADDLE_FAULT_SPEC-equivalent faults are installed
-programmatically so the drill is self-contained).
+programmatically so the drill is self-contained). `--grow` runs the
+shrink-THEN-grow drill instead (kill halves the fleet, capacity later
+returns and the loop re-expands onto the full mesh); it forces an
+8-way CPU mesh and reports time-to-recover both directions.
 """
 import json
 import os
@@ -185,7 +188,169 @@ def measure_elastic_resume(steps=10, kill_at=7, every_steps=2,
     }
 
 
+def _ensure_cpu_mesh(n=8):
+    """Force an n-device CPU mesh for the grow drill. Only effective
+    before jax's first import — growth needs a real multi-device
+    reshard, which the default 1-device CPU host can't express."""
+    if 'jax' in sys.modules:
+        return
+    os.environ['JAX_PLATFORMS'] = 'cpu'
+    flags = os.environ.get('XLA_FLAGS', '')
+    if 'xla_force_host_platform_device_count' not in flags:
+        os.environ['XLA_FLAGS'] = (
+            flags + ' --xla_force_host_platform_device_count=%d' % n
+        ).strip()
+    import jax
+    try:  # the image's sitecustomize overrides the env var; re-assert
+        jax.config.update('jax_platforms', 'cpu')
+    except Exception:
+        pass
+
+
+def measure_shrink_grow(steps=12, kill_at=4, grow_at=8, every_steps=2,
+                        seed=37):
+    """The shrink-THEN-grow drill: a fatal kill at `kill_at` halves the
+    fleet (elastic shrink resume), capacity returns after step `grow_at`
+    completes and the loop re-expands onto the full device set
+    (checkpoint-publish barrier + reshard, no replay). Reports
+    time-to-recover BOTH directions plus the bitwise-parity contract vs
+    an uninterrupted run. Async saves are ON — the grow barrier also
+    exercises the writer flush."""
+    import numpy as np
+    import jax
+    import paddle_tpu as fluid
+    from paddle_tpu import blackbox, monitor, resilience
+    from paddle_tpu.parallel.mesh import data_mesh
+
+    import shutil
+    import tempfile
+    ckpt_dir = tempfile.mkdtemp(prefix='chaosbench_grow_')
+    bundle_dir = tempfile.mkdtemp(prefix='chaosbench_grow_blackbox_')
+    feeds = _batches(steps, seed=seed)
+
+    def _run(exe, main, loss, scope, feed):
+        return np.asarray(exe.run(main, feed=feed, fetch_list=[loss],
+                                  scope=scope)[0]).copy()
+
+    main, startup, loss = _build_model(seed)
+    exe = fluid.Executor()
+    s0 = fluid.Scope()
+    base = []
+    with fluid.scope_guard(s0):
+        exe.run(startup, scope=s0)
+        for i in range(steps):
+            base.append(_run(exe, main, loss, s0, feeds[i]))
+
+    devices = jax.devices()
+    if len(devices) < 2:
+        raise RuntimeError(
+            'shrink-then-grow needs >=2 devices (got %d); run '
+            '`python tools/chaosbench.py --grow`, which forces an '
+            '8-way CPU mesh before jax initializes' % len(devices))
+    shrink = max(1, len(devices) // 2)
+    half = devices[:shrink]
+    phase = ['full']
+    t_fail = [None]
+    t_first_ok = [None]
+    t_grow_req = [None]
+    t_grow_ok = [None]
+    resumed = [None]            # 'shrink' after the kill, 'grow' after
+    main, startup, loss = _build_model(seed)
+    s1 = fluid.Scope()
+    before = monitor.counters()
+    try:
+        with fluid.scope_guard(s1):
+            exe.run(startup, scope=s1)
+            mgr = fluid.CheckpointManager(ckpt_dir, main, scope=s1,
+                                          every_steps=every_steps,
+                                          keep_last_n=3, async_save=True)
+
+            def step_fn(step, mesh):
+                try:
+                    out = _run(exe, main, loss, s1, feeds[step])
+                except BaseException:
+                    phase[0] = 'half'   # the kill took half the fleet
+                    t_fail[0] = time.perf_counter()
+                    raise
+                if resumed[0] == 'shrink' and t_first_ok[0] is None:
+                    t_first_ok[0] = time.perf_counter()
+                if resumed[0] == 'grow' and t_grow_ok[0] is None:
+                    t_grow_ok[0] = time.perf_counter()
+                if step == grow_at and phase[0] == 'half':
+                    phase[0] = 'full'   # capacity returned; the loop's
+                    t_grow_req[0] = time.perf_counter()  # probe fires
+                    # at the top of the next iteration
+                return out
+
+            def on_resume(step, mesh, exc):
+                resumed[0] = 'shrink' if exc is not None else 'grow'
+
+            resilience.install_fault('run', 'nth', kill_at + 1,
+                                     fatal=True)
+            bb_env = {'PADDLE_BLACKBOX': '1',
+                      'PADDLE_BLACKBOX_DIR': bundle_dir,
+                      'PADDLE_BLACKBOX_RATE': '0'}
+            bb_saved = {k: os.environ.get(k) for k in bb_env}
+            os.environ.update(bb_env)
+            blackbox.reset()
+            t0 = time.perf_counter()
+            try:
+                out = resilience.elastic_train_loop(
+                    step_fn, mgr, steps, mesh=data_mesh(len(devices)),
+                    devices_fn=lambda: (half if phase[0] == 'half'
+                                        else devices),
+                    on_resume=on_resume)
+                wall = time.perf_counter() - t0
+                blackbox.flush(10.0)
+                bundles = blackbox.bundles(bundle_dir)
+            finally:
+                for k, v in bb_saved.items():
+                    if v is None:
+                        os.environ.pop(k, None)
+                    else:
+                        os.environ[k] = v
+    finally:
+        resilience.clear_faults()
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+
+    delta = monitor.counter_delta(before)
+    parity = all(np.array_equal(a, b) for a, b in zip(base, out))
+    kinds = [os.path.basename(b).split('_', 1)[1].rsplit('_', 3)[0]
+             for b in bundles]
+    shutil.rmtree(bundle_dir, ignore_errors=True)
+    for want in ('elastic_resume', 'elastic_grow'):
+        if want not in kinds:
+            raise AssertionError(
+                'chaosbench grow drill: no %s bundle published (got %s)'
+                % (want, kinds))
+    return {
+        'steps': steps,
+        'kill_at_step': kill_at,
+        'grow_at_step': grow_at,
+        'ckpt_every_steps': every_steps,
+        'devices': '%d->%d->%d' % (len(devices), shrink, len(devices)),
+        'time_to_recover_shrink_s': round(t_first_ok[0] - t_fail[0], 3)
+        if t_first_ok[0] and t_fail[0] else None,
+        'time_to_recover_grow_s': round(t_grow_ok[0] - t_grow_req[0], 3)
+        if t_grow_ok[0] and t_grow_req[0] else None,
+        'trajectory_parity': bool(parity),
+        'elastic_wall_s': round(wall, 3),
+        'bundles': len(bundles),
+        'counters': {k: v for k, v in delta.items()
+                     if k.startswith(('elastic_', 'ckpt_reshard',
+                                      'ckpt_async', 'fault_injected'))},
+    }
+
+
 def main(argv):
+    if '--grow' in argv:
+        argv = [a for a in argv if a != '--grow']
+        _ensure_cpu_mesh(8)
+        steps = int(argv[1]) if len(argv) > 1 else 12
+        kill_at = int(argv[2]) if len(argv) > 2 else 4
+        row = measure_shrink_grow(steps=steps, kill_at=kill_at)
+        print(json.dumps({'metric': 'elastic_grow_back', **row}))
+        return 0 if row['trajectory_parity'] else 1
     steps = int(argv[1]) if len(argv) > 1 else 10
     kill_at = int(argv[2]) if len(argv) > 2 else 7
     row = measure_elastic_resume(steps=steps, kill_at=kill_at)
