@@ -886,6 +886,22 @@ def _child(mode):
     except Exception as e:
         serving = {'error': '%s: %s' % (type(e).__name__, str(e)[:200])}
 
+    # multi-tenant fleet row: fp32 + PTQ-int8 models co-resident in one
+    # ModelFleet behind the goodput-priced Router — premium closed-loop
+    # deadline traffic (contract: p99 under deadline, 0 errors) next to
+    # a flooding quota'd batch tenant (contract: sheds structured, never
+    # starves the deadline class), with a mid-bench hot-swap of the
+    # premium model under live load (contract: dropped_inflight == 0,
+    # recompiles_after_warmup == 0) and LIVE goodput.cost_estimate
+    # pricing per model (tools/servebench.py measure_fleet / --fleet)
+    try:
+        from tools.servebench import measure_fleet
+        serving_fleet = measure_fleet(
+            requests_per_client=20 if on_tpu else 40)
+    except Exception as e:
+        serving_fleet = {'error': '%s: %s'
+                         % (type(e).__name__, str(e)[:200])}
+
     # generative-decode row: continuous-batching GenerateEngine with the
     # device-resident KV cache vs the sequential re-traced greedy
     # baseline — tokens/sec, ENGINE-attributed per-token p50/p99 (step
@@ -1144,6 +1160,7 @@ def _child(mode):
         'sync_ms': sync_ms,
         'run_overhead': run_overhead,
         'serving': serving,
+        'serving_fleet': serving_fleet,
         'generate': generate,
         'generate_shared_prefix': generate_shared_prefix,
         'generate_speculative': generate_speculative,

@@ -87,6 +87,12 @@ TRIGGER_KINDS = {
     'serving_batch_error': 'ServingEngine: a dispatched batch failed',
     'generate_step_error': 'GenerateEngine: a decode step failed its '
                            'residents',
+    'fleet_slo_burn': 'fleet Router: a tenant queue-wait EWMA burned '
+                      'past its SLO, or sheds stormed — bundle carries '
+                      'every tenant\'s queue state',
+    'deploy_failed': 'ModelFleet.deploy: loading/warming a new artifact '
+                     'failed before the traffic flip (old version kept '
+                     'serving)',
 }
 
 _DEFAULT_KEEP = 8

@@ -88,10 +88,10 @@ from . import monitor
 from . import trace as trace_mod
 
 __all__ = ['note_dispatch', 'note_compile', 'note_accept',
-           'note_queue_wait', 'note_bench_row', 'name_model', 'flush',
-           'stats', 'reset', 'regressions', 'enabled', 'device_peaks',
-           'peak_flops_for', 'peak_hbm_bps_for', 'PEAK_FLOPS',
-           'PEAK_HBM_BPS']
+           'note_queue_wait', 'note_bench_row', 'name_model',
+           'cost_estimate', 'flush', 'stats', 'reset', 'regressions',
+           'enabled', 'device_peaks', 'peak_flops_for',
+           'peak_hbm_bps_for', 'PEAK_FLOPS', 'PEAK_HBM_BPS']
 
 # peak dense bf16 FLOP/s per chip, by device_kind substring (the bench
 # suite imports this table — one source of truth for MFU denominators)
@@ -716,6 +716,57 @@ def stats(fps=None):
             'by_kind': by_kind,
             'loss_buckets': {k: round(v, 6) for k, v in buckets.items()},
             'regressions': list(_trips),
+        }
+
+
+def cost_estimate(model, kind=None):
+    """Live per-model cost model for admission control: device-seconds
+    per dispatch/step for every signature whose goodput series is named
+    `model` (``name_model`` — engines name their programs at
+    construction). This is the stable API a fleet router prices
+    admissions with: estimates come from the SAME serially-attributed
+    device-busy accounting as ``stats()``, so they track the hardware
+    live instead of a hardcoded cost table. ``kind`` restricts to one
+    dispatch kind ('run' | 'fused' | 'mesh' | ...).
+
+    Returns ``{'model', 'dispatches', 'steps', 'device_s',
+    'device_s_per_dispatch', 'device_s_per_step', 'by_kind'}``, or None
+    before any accounted dispatch for the model — a router must treat
+    None as "no data yet" (admit and learn), never as free."""
+    if _epoch[0] is None:
+        return None
+    _drain()
+    name = str(model)
+    with _lock:
+        fps = {fp for fp, n in _names.items() if n == name}
+        if not fps:
+            return None
+        n = steps = 0
+        busy = 0.0
+        by_kind = {}
+        for (fp, k), a in _acct.items():
+            if fp not in fps or (kind is not None and k != kind):
+                continue
+            n += a.n
+            steps += a.steps
+            busy += a.busy_s
+            bk = by_kind.setdefault(k, {'dispatches': 0, 'steps': 0,
+                                        'device_s': 0.0})
+            bk['dispatches'] += a.n
+            bk['steps'] += a.steps
+            bk['device_s'] += a.busy_s
+        if n == 0:
+            return None
+        for bk in by_kind.values():
+            bk['device_s'] = round(bk['device_s'], 9)
+        return {
+            'model': name,
+            'dispatches': n,
+            'steps': steps,
+            'device_s': round(busy, 9),
+            'device_s_per_dispatch': busy / n,
+            'device_s_per_step': busy / max(1, steps),
+            'by_kind': by_kind,
         }
 
 

@@ -7,13 +7,21 @@ bucket-shaped batches, a worker pool executes them through the shared
 compile cache (pre-warmed by `ServingEngine.warmup`), and a bounded queue
 sheds overload with structured errors instead of unbounded latency. See
 docs/serving.md for architecture and tuning.
+
+Multi-tenant layer (fleet.py / router.py): a `ModelFleet` hosts many
+models resident in one process under shared HBM / paged-block budgets
+with zero-downtime hot-swap, and a `Router` schedules admissions by
+priority class and deadline using live `goodput.cost_estimate` pricing.
 """
 from .bucketing import BucketLadder
 from .batcher import (ServingError, LoadShedError, DeadlineExceededError,
                       EngineStoppedError, Request, RequestQueue)
 from .engine import ServingConfig, ServingEngine, create_engine
+from .fleet import FleetError, ModelFleet
 from .generate import (GenerateConfig, GenerateEngine, GenerateRequest,
                        GenerateResult)
+from .kv_blocks import BlockAllocator, PrefixCache, QuotaBlockAllocator
+from .router import Router, TenantConfig
 
 __all__ = [
     'BucketLadder', 'Request', 'RequestQueue',
@@ -22,4 +30,6 @@ __all__ = [
     'ServingConfig', 'ServingEngine', 'create_engine',
     'GenerateConfig', 'GenerateEngine', 'GenerateRequest',
     'GenerateResult',
+    'BlockAllocator', 'PrefixCache', 'QuotaBlockAllocator',
+    'FleetError', 'ModelFleet', 'Router', 'TenantConfig',
 ]

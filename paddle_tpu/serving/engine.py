@@ -76,6 +76,10 @@ class ServingConfig(object):
       the request's rows through the hot-row cache (`ps` trace stage),
       batch formation feeds each ``ps_lookup_table`` site from it — the
       table never fully resides in process, signatures stay fixed.
+    - name: stable model name labelling this engine's goodput series
+      (defaults to model_dir). A ModelFleet sets it to the fleet-wide
+      model name so ``goodput.cost_estimate(name)`` keeps pricing the
+      model across hot-swapped versions living in different dirs.
     """
 
     def __init__(self, model_dir=None, model_filename=None,
@@ -83,9 +87,10 @@ class ServingConfig(object):
                  batch_buckets=None, seq_buckets=None, seq_axis=1,
                  pad_value=0, num_workers=2, queue_cap=64,
                  default_deadline_s=30.0, metrics_port=None,
-                 ps_resolver=None):
+                 ps_resolver=None, name=None):
         self.ps_resolver = ps_resolver
         self.model_dir = model_dir
+        self.name = name
         self.model_filename = model_filename
         self.params_filename = params_filename
         self.max_batch_size = int(max_batch_size)
@@ -134,7 +139,8 @@ class ServingEngine(object):
         # otherwise label as the bare fingerprint and split the series
         try:
             goodput.name_model(predictor.program._fingerprint(),
-                               config.model_dir or 'serving')
+                               config.name or config.model_dir
+                               or 'serving')
         except Exception:       # noqa: BLE001 — telemetry only
             pass
         self.ladder = BucketLadder(config.batch_buckets,
@@ -602,7 +608,8 @@ class ServingEngine(object):
         }
         try:
             fp = self.predictor.program._fingerprint()
-            goodput.name_model(fp, self.config.model_dir or 'serving')
+            goodput.name_model(fp, self.config.name
+                               or self.config.model_dir or 'serving')
             out['goodput'] = goodput.stats(fps=[fp])
         except Exception:       # noqa: BLE001 — stats stay best-effort
             out['goodput'] = goodput.stats(fps=[])

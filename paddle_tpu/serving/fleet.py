@@ -1,0 +1,355 @@
+"""ModelFleet: many models resident in ONE process under a shared
+HBM / paged-block budget, with zero-downtime hot-swap.
+
+The inference layer up to PR 18 serves one model per engine; the
+north-star traffic shape ("millions of users") runs a FLEET — an fp32
+flagship, its int8 variant for the cheap tier, draft models for
+speculation — co-resident so they share the process's compile cache,
+warmup farm and HBM instead of paying a process each. This module is
+that residency layer; router.py in front of it decides admission.
+
+**Residency budget.** Two budgets, both optional:
+
+- ``hbm_budget_bytes`` bounds summed parameter bytes across resident
+  models (measured from each predictor's scope at deploy — int8
+  artifacts really are ~4x cheaper here). A deploy that would overflow
+  is REFUSED before it loads traffic-visible state.
+- ``block_budget`` (+ ``block_size``) sizes ONE shared
+  `BlockAllocator` pool for paged decode tenants: each attached
+  `GenerateEngine` gets a `QuotaBlockAllocator` view
+  (``fleet.block_view(tenant, quota)``) so per-tenant quotas are
+  enforced against one physical free list, and one tenant's
+  ``cache_full`` pressure can never evict another tenant's prefix
+  blocks (each engine's PrefixCache lives over its own view).
+
+**Zero-downtime hot-swap.** ``deploy(name, path)`` on an already-
+resident name builds the NEW engine fully off to the side: load the
+``load_inference_model`` artifact (fp32 or int8 — the loader
+recognizes quantized blobs), warm every ladder cell through the
+process-wide warmup farm (an artifact with the same program structure
+re-warms at cache-hit speed — ``recompiles_after_warmup == 0`` is the
+measured contract), start its workers, and only then atomically flip
+the name to the new engine. The OLD engine is drained — submissions
+already routed to it finish normally (its queue empties, in-flight
+batches deliver) — and stopped only once idle, so a hot-swap under
+live traffic completes with zero failed or dropped in-flight requests
+(asserted in tests/test_fleet.py; measured in the ``serving_fleet``
+bench row). A deploy that fails anywhere before the flip leaves the
+old version serving untouched and publishes a ``deploy_failed``
+flight-recorder bundle.
+
+Metrics: ``fleet_deploy_total{outcome}``, ``fleet_models`` /
+``fleet_resident_bytes`` gauges (docs/observability.md), plus the
+router's ``fleet_request_total`` / ``fleet_scale_hint`` series.
+"""
+import threading
+import time
+
+import numpy as np
+
+from .. import goodput
+from .. import monitor
+from .batcher import ServingError
+from .engine import ServingConfig, ServingEngine
+from .kv_blocks import BlockAllocator, QuotaBlockAllocator
+
+__all__ = ['FleetError', 'ModelFleet']
+
+
+class FleetError(ServingError):
+    """Fleet-level deployment/residency failure (budget overflow,
+    unknown model, missing block pool)."""
+
+
+class ModelFleet(object):
+    """Multi-model residency under shared budgets (module docstring). ::
+
+        fleet = ModelFleet(hbm_budget_bytes=2 << 30)
+        fleet.deploy('bert_fp32', 'models/bert_fp32',
+                     warm_feed={'x': example})
+        fleet.deploy('bert_int8', 'models/bert_int8',
+                     warm_feed={'x': example})
+        req = fleet.submit('bert_int8', {'x': rows})
+        ...
+        fleet.deploy('bert_fp32', 'models/bert_fp32_v2',
+                     warm_feed={'x': example})   # hot-swap, zero drops
+        fleet.stop()
+    """
+
+    def __init__(self, hbm_budget_bytes=None, block_budget=None,
+                 block_size=16):
+        self.hbm_budget_bytes = hbm_budget_bytes
+        self._lock = threading.RLock()
+        self._models = {}       # name -> record dict
+        self._block_pool = None
+        if block_budget is not None:
+            # +1 physical block: block 0 is the pool's reserved trash
+            # block, so `block_budget` stays the ALLOCATABLE capacity
+            self._block_pool = BlockAllocator(int(block_budget) + 1,
+                                              int(block_size))
+
+    # ------------------------------------------------------------------
+    # residency
+    @property
+    def block_pool(self):
+        return self._block_pool
+
+    def block_view(self, tenant, quota):
+        """A per-tenant `QuotaBlockAllocator` over the fleet's shared
+        block pool — pass it to ``GenerateEngine(block_allocator=)``."""
+        if self._block_pool is None:
+            raise FleetError(
+                "this fleet has no shared block pool — construct with "
+                "block_budget= to host paged decode tenants")
+        return QuotaBlockAllocator(self._block_pool, quota,
+                                   tenant=tenant)
+
+    def models(self):
+        with self._lock:
+            return sorted(self._models)
+
+    def engine(self, name):
+        """The CURRENT engine serving `name` (hot-swap flips this)."""
+        with self._lock:
+            return self._record(name)['engine']
+
+    def version(self, name):
+        with self._lock:
+            return self._record(name)['version']
+
+    def _record(self, name):
+        rec = self._models.get(name)
+        if rec is None:
+            raise FleetError("no model %r resident (have: %s)"
+                             % (name, sorted(self._models)))
+        return rec
+
+    @staticmethod
+    def _resident_bytes(predictor):
+        """Weight bytes resident for one loaded model (the HBM budget's
+        unit of account): every PERSISTABLE array in the predictor's
+        private scope — not just Parameters, because a PTQ artifact's
+        int8 blobs are persistable plain Variables and they ARE the
+        resident weights (counted at their real 1-byte width, which is
+        what makes the int8 variant ~4x cheaper under the budget)."""
+        total = 0
+        try:
+            for v in predictor.program.global_block().vars.values():
+                if not getattr(v, 'persistable', False):
+                    continue
+                try:
+                    total += int(np.asarray(
+                        predictor.scope.get(v.name)).nbytes)
+                except Exception:   # noqa: BLE001 — unmaterialized var
+                    continue
+        except Exception:           # noqa: BLE001 — budget is advisory
+            return 0
+        return total
+
+    def _set_gauges_locked(self):
+        monitor.set_gauge('fleet_models', float(len(self._models)))
+        monitor.set_gauge('fleet_resident_bytes',
+                          float(sum(r['bytes']
+                                    for r in self._models.values())))
+
+    # ------------------------------------------------------------------
+    # deploy / hot-swap
+    def deploy(self, name, path, warm_feed=None, drain_timeout_s=30.0,
+               **config_kw):
+        """Load (first deploy) or hot-swap (already-resident name) model
+        `name` from the ``load_inference_model`` artifact at `path`.
+        `warm_feed` (one representative request feed) warms every
+        ladder cell through the warmup farm BEFORE the new version sees
+        traffic; `config_kw` forwards to `ServingConfig`.
+
+        Returns ``{'model', 'version', 'resident_bytes', 'warm',
+        'swapped', 'drained_ok', 'seconds'}``. On any failure before
+        the traffic flip the old version keeps serving and the error
+        re-raises (``deploy_failed`` flight-recorder bundle +
+        ``fleet_deploy_total{outcome=failed}``)."""
+        t0 = time.perf_counter()
+        engine = None
+        try:
+            cfg = ServingConfig(path, name=name, **config_kw)
+            engine = ServingEngine(cfg)
+            size = self._resident_bytes(engine.predictor)
+            with self._lock:
+                if self.hbm_budget_bytes is not None:
+                    old = self._models.get(name)
+                    projected = size + sum(
+                        r['bytes'] for n, r in self._models.items()
+                        if n != name) + (0 if old is None
+                                         else old['bytes'])
+                    # the old version stays resident until the new one
+                    # is live — a swap transiently holds BOTH
+                    if projected > self.hbm_budget_bytes:
+                        raise FleetError(
+                            "deploying %r (%d bytes) would put fleet "
+                            "residency at %d bytes, over the %d-byte "
+                            "HBM budget" % (name, size, projected,
+                                            self.hbm_budget_bytes))
+            warm = engine.warmup(warm_feed) \
+                if warm_feed is not None else None
+            engine.start()
+        except Exception as e:
+            if engine is not None:
+                try:
+                    engine.stop(timeout_s=1.0)
+                except Exception:   # noqa: BLE001 — best-effort cleanup
+                    pass
+            monitor.inc('fleet_deploy_total',
+                        labels={'outcome': 'failed'})
+            try:
+                from .. import blackbox
+                blackbox.record('deploy_failed', error=e, model=name,
+                                path=str(path),
+                                resident=sorted(self._models))
+            except Exception:       # noqa: BLE001 — telemetry only
+                monitor.inc('blackbox_write_errors_total')
+            raise
+        with self._lock:
+            old = self._models.get(name)
+            version = 1 if old is None else old['version'] + 1
+            self._models[name] = {
+                'engine': engine, 'path': str(path), 'version': version,
+                'bytes': size, 'warm': warm, 'external': False,
+            }
+            self._set_gauges_locked()
+        drained_ok = True
+        if old is not None:
+            # new version is live — drain the old one WITHOUT failing
+            # anything: its queue empties through its own workers,
+            # in-flight batches deliver, then stop() joins an idle pool
+            drained_ok = self._drain_and_stop(old['engine'],
+                                              drain_timeout_s)
+        monitor.inc('fleet_deploy_total', labels={'outcome': 'ok'})
+        return {
+            'model': name, 'version': version, 'resident_bytes': size,
+            'warm': warm, 'swapped': old is not None,
+            'drained_ok': drained_ok,
+            'seconds': round(time.perf_counter() - t0, 3),
+        }
+
+    def attach(self, name, engine, resident_bytes=0):
+        """Register a pre-built engine (e.g. a paged `GenerateEngine`
+        over ``block_view(...)``) as resident model `name`. The fleet
+        routes to it and stops it with the fleet; deploy-style
+        hot-swap stays the ServingEngine path."""
+        with self._lock:
+            if name in self._models:
+                raise FleetError("model %r already resident — deploy() "
+                                 "is the swap path" % name)
+            self._models[name] = {
+                'engine': engine, 'path': None, 'version': 1,
+                'bytes': int(resident_bytes), 'warm': None,
+                'external': True,
+            }
+            self._set_gauges_locked()
+        return engine
+
+    def unload(self, name, drain_timeout_s=30.0):
+        """Drain and stop model `name`, releasing its residency."""
+        with self._lock:
+            rec = self._record(name)
+            del self._models[name]
+            self._set_gauges_locked()
+        return self._drain_and_stop(rec['engine'], drain_timeout_s)
+
+    def _drain_and_stop(self, engine, timeout_s):
+        """Wait until `engine` has nothing queued or in flight, then
+        stop it. Returns True when it drained inside the timeout (a
+        False stop still delivers in-flight batches; only still-QUEUED
+        requests would fail — the fleet lock guarantees no new
+        submissions target a flipped-out engine)."""
+        def busy():
+            if engine.queue.depth() > 0:
+                return True
+            infl = getattr(engine, '_inflight', None)
+            if infl is not None:            # ServingEngine batches
+                return infl(0) > 0
+            slots = getattr(engine, '_slots', None)
+            if slots is not None:           # GenerateEngine residents
+                return any(s is not None for s in slots)
+            return False
+
+        deadline = time.monotonic() + timeout_s
+        drained = False
+        while time.monotonic() < deadline:
+            try:
+                if not busy():
+                    drained = True
+                    break
+            except Exception:   # noqa: BLE001 — engine died mid-drain
+                break
+            time.sleep(0.005)
+        engine.stop()
+        return drained
+
+    # ------------------------------------------------------------------
+    # request path
+    def submit(self, name, feed, deadline_s=None, **kw):
+        """Submit one request to the CURRENT version of model `name`
+        (the router's dispatch target). Holding the fleet lock across
+        the engine's submit makes the hot-swap flip atomic against
+        admissions: a request is either fully in the old engine's queue
+        before the drain begins, or lands in the new one."""
+        with self._lock:
+            engine = self._record(name)['engine']
+            return engine.submit(feed, deadline_s=deadline_s, **kw)
+
+    def run(self, name, feed, deadline_s=None, timeout=None, **kw):
+        """Blocking convenience: submit + result."""
+        return self.submit(name, feed, deadline_s=deadline_s,
+                           **kw).result(timeout)
+
+    # ------------------------------------------------------------------
+    def stats(self):
+        """Per-model residency + engine stats + live cost estimates,
+        plus shared block-pool accounting when the fleet hosts paged
+        tenants."""
+        with self._lock:
+            names = dict(self._models)
+            pool = self._block_pool
+        out = {'models': {}, 'hbm_budget_bytes': self.hbm_budget_bytes,
+               'resident_bytes_total': 0}
+        for name, rec in sorted(names.items()):
+            try:
+                estats = rec['engine'].stats()
+            except Exception:   # noqa: BLE001 — stats stay best-effort
+                estats = None
+            out['models'][name] = {
+                'version': rec['version'],
+                'path': rec['path'],
+                'resident_bytes': rec['bytes'],
+                'warm': rec['warm'],
+                'engine': estats,
+                'cost': goodput.cost_estimate(name),
+            }
+            out['resident_bytes_total'] += rec['bytes']
+        if pool is not None:
+            out['blocks'] = {
+                'block_size': pool.block_size,
+                'capacity': pool.capacity,
+                'in_use': pool.in_use(),
+                'free': pool.available(),
+            }
+        return out
+
+    def stop(self, drain_timeout_s=10.0):
+        """Drain and stop every resident engine (process shutdown)."""
+        with self._lock:
+            recs = list(self._models.values())
+            self._models = {}
+            self._set_gauges_locked()
+        for rec in recs:
+            try:
+                self._drain_and_stop(rec['engine'], drain_timeout_s)
+            except Exception:   # noqa: BLE001 — shutdown is best-effort
+                pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False

@@ -423,15 +423,40 @@ class GenerateEngine(object):
     Pass ``scope=`` to serve already-trained parameters (names match
     build_lm); otherwise the engine initializes fresh parameters from
     ``config.seed``.
+
+    ``block_allocator=`` (paged mode) injects a shared pool instead of
+    the engine-private default — the multi-tenant residency path: a
+    `ModelFleet` sizes ONE ``BlockAllocator`` to the real HBM budget
+    and hands each co-resident engine a `QuotaBlockAllocator` view, so
+    per-tenant quotas are enforced while every tenant draws from the
+    same physical free list. The allocator's block_size must match the
+    config's; the engine's prefix cache is built over the injected
+    view, keeping cache-pressure eviction tenant-local.
     """
 
-    def __init__(self, config=None, scope=None, draft_scope=None):
+    def __init__(self, config=None, scope=None, draft_scope=None,
+                 block_allocator=None):
         self.config = config or GenerateConfig()
         self.scope = scope if scope is not None else Scope()
         self.executor = Executor(TPUPlace(0))
         c = self.config
+        if block_allocator is not None and not c.paged:
+            raise ValueError(
+                "block_allocator= injection is a paged-mode feature "
+                "(the contiguous cache reserves slots * max_len rows "
+                "up front) — pass paged=True")
         if c.paged:
-            self._alloc = BlockAllocator(c.num_blocks, c.block_size)
+            if block_allocator is not None:
+                if block_allocator.block_size != c.block_size:
+                    raise ValueError(
+                        "injected allocator block_size %d != config "
+                        "block_size %d — the paged kernels address the "
+                        "cache through the table at the allocator's "
+                        "granularity" % (block_allocator.block_size,
+                                         c.block_size))
+                self._alloc = block_allocator
+            else:
+                self._alloc = BlockAllocator(c.num_blocks, c.block_size)
             self._prefix = PrefixCache(self._alloc) \
                 if c.prefix_sharing else None
             self._max_blocks = c.max_len // c.block_size

@@ -41,7 +41,8 @@ copy. Neither sharer ever observes the other's tokens.
 """
 import hashlib
 
-__all__ = ['BlockAllocator', 'PrefixCache', 'chain_hashes']
+__all__ = ['BlockAllocator', 'PrefixCache', 'QuotaBlockAllocator',
+           'chain_hashes']
 
 
 def chain_hashes(tokens, block_size):
@@ -115,6 +116,86 @@ class BlockAllocator(object):
     def deref_many(self, bids):
         """`deref` a batch (slot release, speculative-tail rollback);
         returns how many blocks actually went back to the free list."""
+        freed = 0
+        for b in bids:
+            if self.deref(b):
+                freed += 1
+        return freed
+
+
+class QuotaBlockAllocator(object):
+    """A per-tenant VIEW over a shared ``BlockAllocator`` pool: the same
+    interface a `GenerateEngine` allocates through, bounded by `quota`
+    DISTINCT physical blocks. Multiple tenants resident in one process
+    (ModelFleet) each hold a view over the one pool sized to the real
+    HBM budget; a tenant's admission/growth then competes only inside
+    its quota and the pool's free list — one tenant can never allocate
+    the pool empty past its own share.
+
+    Accounting: a view is charged one unit per DISTINCT block it holds
+    at least one reference to (extra refs to an owned block — the
+    within-tenant prefix-sharing case — consume no additional physical
+    blocks and are not double-charged). ``in_use()`` is the tenant's
+    footprint, ``capacity`` its quota, ``available()`` the admission
+    headroom = min(pool free, quota remaining). Eviction isolation is
+    structural: each tenant's `PrefixCache` is built over its own view,
+    so ``evict_for`` under one tenant's allocation pressure only ever
+    walks (and derefs) that tenant's entries."""
+
+    def __init__(self, pool, quota, tenant=None):
+        quota = int(quota)
+        if quota < 1:
+            raise ValueError("block quota must be >= 1, got %d" % quota)
+        self.pool = pool
+        self.quota = quota
+        self.tenant = tenant
+        self.block_size = pool.block_size
+        self._held = {}         # block id -> refs held through this view
+
+    @property
+    def capacity(self):
+        return min(self.quota, self.pool.capacity)
+
+    def available(self):
+        return max(0, min(self.pool.available(),
+                          self.quota - len(self._held)))
+
+    def in_use(self):
+        return len(self._held)
+
+    def refcount(self, bid):
+        return self.pool.refcount(bid)
+
+    def alloc(self, n):
+        if len(self._held) + n > self.quota:
+            return None
+        out = self.pool.alloc(n)
+        if out is not None:
+            for b in out:
+                self._held[b] = 1
+        return out
+
+    def ref(self, bid):
+        if bid not in self._held and len(self._held) >= self.quota:
+            raise ValueError(
+                "ref of block %d would exceed tenant %r quota %d"
+                % (bid, self.tenant, self.quota))
+        self.pool.ref(bid)
+        self._held[bid] = self._held.get(bid, 0) + 1
+
+    def deref(self, bid):
+        held = self._held.get(bid, 0)
+        if held < 1:
+            raise ValueError(
+                "deref of block %d not held by tenant %r" % (bid,
+                                                             self.tenant))
+        if held == 1:
+            del self._held[bid]
+        else:
+            self._held[bid] = held - 1
+        return self.pool.deref(bid)
+
+    def deref_many(self, bids):
         freed = 0
         for b in bids:
             if self.deref(b):

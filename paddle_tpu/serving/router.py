@@ -1,0 +1,309 @@
+"""Goodput-priced admission router for a multi-tenant model fleet.
+
+The fleet (fleet.py) makes many models resident in one process; this
+module decides WHO gets on the accelerator. Each tenant maps to one
+resident model and carries a priority class, a default deadline and an
+outstanding-work quota; the router prices every admission with the LIVE
+per-model cost estimate ``goodput.cost_estimate(model)`` — device-
+seconds per dispatch measured by the PR 14 accounting, never a
+hardcoded table — and admits or sheds against three invariants:
+
+- **tenant quota**: at most ``max_outstanding`` of a tenant's requests
+  in flight (``LoadShedError(reason='tenant_quota')``).
+- **deadline feasibility**: the estimated backlog of work at this
+  tenant's priority or higher, plus this request's own estimated cost,
+  must fit inside the request's deadline
+  (``reason='deadline_unmeetable'`` — admitting would only burn device
+  time on a request that cannot make it).
+- **priority protection**: a LOWER-priority admission may only use the
+  capacity slack that keeps every higher-priority tenant's deadline
+  feasible: if total estimated backlog + this cost exceeds a
+  higher-priority tenant's ``deadline_s * headroom_frac``, the cheap
+  request sheds (``reason='priority_backlog'``) instead of starving the
+  deadline traffic. High-priority admissions ignore lower-priority
+  backlog entirely — the asymmetry is the point.
+
+Before any dispatch has been accounted for a model, ``cost_estimate``
+returns None and the router admits at ``default_cost_s`` (0 — admit and
+learn); the estimates sharpen as traffic flows.
+
+**Scale-out signal.** The router keeps a per-tenant queue-wait EWMA
+(the PR 14 ``queue_burn`` sentinel shape, but per tenant — goodput's
+own stream is process-wide). A tenant whose EWMA burns past its
+``slo_ms`` drives the ``fleet_scale_hint{tenant}`` gauge (EWMA / SLO —
+>1 means "add replicas") and the ``on_scale_hint(tenant, hint, state)``
+callback a replica manager consumes, and publishes a
+``fleet_slo_burn`` flight-recorder bundle (blackbox.py) carrying every
+tenant's queue state. A shed storm (``storm_n`` sheds inside
+``storm_window_s``) publishes the same kind with ``cause='shed_storm'``.
+
+Metrics: ``fleet_request_total{tenant, outcome}``
+(admitted|shed_tenant_quota|shed_deadline_unmeetable|
+shed_priority_backlog), ``fleet_scale_hint{tenant}``. See
+docs/serving.md "Multi-tenant fleet" for the policy math and
+docs/observability.md for the series.
+"""
+import collections
+import threading
+import time
+
+from .. import goodput
+from .. import monitor
+from .batcher import LoadShedError
+
+__all__ = ['TenantConfig', 'Router']
+
+
+class TenantConfig(object):
+    """One tenant's admission contract.
+
+    - model: resident model name in the fleet this tenant's traffic
+      routes to.
+    - priority: integer class, HIGHER is more important. Admission of a
+      request only competes against backlog at its own priority or
+      above; lower classes are invisible to it.
+    - deadline_s: default per-request deadline (None = the engine's
+      default; also disables the feasibility check).
+    - max_outstanding: cap on this tenant's in-flight requests (None =
+      unbounded — the engine queue_cap still backstops).
+    - slo_ms: queue-wait SLO driving the per-tenant scale hint (None
+      disables the hint for this tenant).
+    - min_samples: waits observed before the EWMA may trip the hint.
+    - headroom_frac: fraction of this tenant's deadline lower-priority
+      work may fill before it sheds (protection threshold; 1.0 = the
+      whole deadline).
+    """
+
+    def __init__(self, model, priority=0, deadline_s=None,
+                 max_outstanding=None, slo_ms=None, min_samples=4,
+                 headroom_frac=1.0):
+        self.model = str(model)
+        self.priority = int(priority)
+        self.deadline_s = deadline_s
+        self.max_outstanding = max_outstanding
+        self.slo_ms = slo_ms
+        self.min_samples = int(min_samples)
+        self.headroom_frac = float(headroom_frac)
+
+
+class Router(object):
+    """Priority/deadline admission over a ModelFleet (module docstring
+    has the policy). ::
+
+        router = Router(fleet, tenants={
+            'premium': TenantConfig('bert_fp32', priority=10,
+                                    deadline_s=0.5, slo_ms=50.0),
+            'batch':   TenantConfig('bert_int8', priority=0,
+                                    deadline_s=30.0, max_outstanding=64),
+        }, on_scale_hint=lambda tenant, hint, state: ...)
+        req = router.submit('premium', {'x': rows})
+        out = req.result()
+    """
+
+    def __init__(self, fleet, tenants=None, on_scale_hint=None,
+                 default_cost_s=0.0, hint_cooldown_s=30.0,
+                 storm_n=10, storm_window_s=5.0):
+        self._fleet = fleet
+        self._tenants = {}
+        self._lock = threading.Lock()
+        self._out = {}          # tenant -> [[req, est_s, t_submit], ...]
+        self._waits = {}        # tenant -> {'n': int, 'ewma': float|None}
+        self._sheds = {}        # tenant -> deque of shed perf times
+        self._shed_n = {}       # tenant -> lifetime shed count
+        self._burn_last = {}    # (tenant, cause) -> last publish time
+        self.on_scale_hint = on_scale_hint
+        self.default_cost_s = float(default_cost_s)
+        self.hint_cooldown_s = float(hint_cooldown_s)
+        self.storm_n = int(storm_n)
+        self.storm_window_s = float(storm_window_s)
+        for name, cfg in (tenants or {}).items():
+            self.add_tenant(name, cfg)
+
+    def add_tenant(self, name, cfg):
+        if not isinstance(cfg, TenantConfig):
+            raise TypeError("add_tenant takes a TenantConfig, got %r"
+                            % (cfg,))
+        with self._lock:
+            self._tenants[str(name)] = cfg
+            self._out.setdefault(str(name), [])
+            self._waits.setdefault(str(name), {'n': 0, 'ewma': None})
+            self._sheds.setdefault(str(name),
+                                   collections.deque(maxlen=256))
+            self._shed_n.setdefault(str(name), 0)
+        return cfg
+
+    def cost(self, model):
+        """Estimated device-seconds one dispatch of `model` costs right
+        now (goodput.cost_estimate; default_cost_s before any sample)."""
+        est = goodput.cost_estimate(model)
+        if est is None:
+            return self.default_cost_s
+        return est['device_s_per_dispatch']
+
+    # ------------------------------------------------------------------
+    # admission
+    def submit(self, tenant, feed, deadline_s=None, **kw):
+        """Admit one request for `tenant` (raises KeyError for unknown
+        tenants, LoadShedError with a structured reason on shed) and
+        submit it to the tenant's model through the fleet. Returns the
+        engine's Request future."""
+        cfg = self._tenants[tenant]
+        if deadline_s is None:
+            deadline_s = cfg.deadline_s
+        est = self.cost(cfg.model)
+        with self._lock:
+            self._reap_locked()
+            mine = self._out[tenant]
+            if cfg.max_outstanding is not None and \
+                    len(mine) >= cfg.max_outstanding:
+                raise self._shed_locked(tenant, 'tenant_quota',
+                                        len(mine), cfg.max_outstanding)
+            backlog_ge = 0.0
+            backlog_all = 0.0
+            for t, entries in self._out.items():
+                s = sum(e for _r, e, _t in entries)
+                backlog_all += s
+                if self._tenants[t].priority >= cfg.priority:
+                    backlog_ge += s
+            if deadline_s is not None and backlog_ge + est > deadline_s:
+                raise self._shed_locked(tenant, 'deadline_unmeetable',
+                                        len(mine),
+                                        cfg.max_outstanding or 0)
+            for hname, hcfg in self._tenants.items():
+                if hcfg.priority <= cfg.priority or \
+                        hcfg.deadline_s is None:
+                    continue
+                if backlog_all + est > \
+                        hcfg.deadline_s * hcfg.headroom_frac:
+                    raise self._shed_locked(tenant, 'priority_backlog',
+                                            len(mine),
+                                            cfg.max_outstanding or 0)
+        req = self._fleet.submit(cfg.model, feed, deadline_s=deadline_s,
+                                 **kw)
+        with self._lock:
+            self._out[tenant].append([req, est, time.monotonic()])
+        monitor.inc('fleet_request_total',
+                    labels={'tenant': tenant, 'outcome': 'admitted'})
+        return req
+
+    def _shed_locked(self, tenant, reason, depth, cap):
+        """Count one shed, check the storm detector, and build the
+        LoadShedError the caller raises (callers hold _lock)."""
+        monitor.inc('fleet_request_total',
+                    labels={'tenant': tenant, 'outcome': 'shed_' + reason})
+        now = time.perf_counter()
+        self._sheds[tenant].append(now)
+        self._shed_n[tenant] += 1
+        lo = now - self.storm_window_s
+        n = sum(1 for t in self._sheds[tenant] if t >= lo)
+        if n >= self.storm_n and \
+                self._burn_ok_locked(tenant, 'shed_storm'):
+            self._publish_burn(tenant, 'shed_storm',
+                               sheds_in_window=n,
+                               window_s=self.storm_window_s,
+                               last_reason=reason)
+        return LoadShedError(reason, depth, cap)
+
+    # ------------------------------------------------------------------
+    # completion reaping + per-tenant queue-burn
+    def _reap_locked(self):
+        """Drop finished requests from the outstanding books and feed
+        each tenant's queue-wait EWMA from the request's own timing
+        breakdown (callers hold _lock)."""
+        hints = []
+        for tenant, entries in self._out.items():
+            live = []
+            for rec in entries:
+                req = rec[0]
+                if not req._event.is_set():
+                    live.append(rec)
+                    continue
+                wait = None
+                if req.timing is not None:
+                    wait = req.timing.get('queue_s')
+                if wait is not None:
+                    hint = self._note_wait_locked(tenant, float(wait))
+                    if hint is not None:
+                        hints.append(hint)
+            self._out[tenant] = live
+        # callbacks/bundles run outside the book-keeping loop but still
+        # under _lock (blackbox.record is an enqueue; the callback is
+        # the replica manager's hook and must not re-enter submit)
+        for tenant, hint, ewma_ms, slo_ms in hints:
+            self._publish_burn(tenant, 'queue_burn', hint=round(hint, 3),
+                               ewma_ms=round(ewma_ms, 3),
+                               slo_ms=slo_ms)
+
+    def _note_wait_locked(self, tenant, wait_s):
+        """EWMA one observed queue wait; returns a (tenant, hint,
+        ewma_ms, slo_ms) tuple when the SLO is burning past cooldown."""
+        cfg = self._tenants[tenant]
+        st = self._waits[tenant]
+        st['n'] += 1
+        a = 0.3
+        st['ewma'] = wait_s if st['ewma'] is None else \
+            a * wait_s + (1.0 - a) * st['ewma']
+        if cfg.slo_ms is None or cfg.slo_ms <= 0:
+            return None
+        hint = st['ewma'] * 1e3 / cfg.slo_ms
+        monitor.set_gauge('fleet_scale_hint', hint,
+                          labels={'tenant': tenant})
+        if hint > 1.0 and st['n'] >= cfg.min_samples and \
+                self._burn_ok_locked(tenant, 'queue_burn'):
+            return (tenant, hint, st['ewma'] * 1e3, cfg.slo_ms)
+        return None
+
+    def _burn_ok_locked(self, tenant, cause):
+        now = time.perf_counter()
+        last = self._burn_last.get((tenant, cause))
+        if last is not None and now - last < self.hint_cooldown_s:
+            return False
+        self._burn_last[(tenant, cause)] = now
+        return True
+
+    def _publish_burn(self, tenant, cause, **fields):
+        """One SLO-burn event: the flight-recorder bundle (with every
+        tenant's queue state) + the scale-hint callback."""
+        state = self._queue_state_locked()
+        try:
+            from .. import blackbox
+            blackbox.record('fleet_slo_burn', tenant=tenant, cause=cause,
+                            tenants=state, **fields)
+        except Exception:       # noqa: BLE001 — telemetry only
+            monitor.inc('blackbox_write_errors_total')
+        cb = self.on_scale_hint
+        if cb is not None and cause == 'queue_burn':
+            try:
+                cb(tenant, fields.get('hint', 1.0), state)
+            except Exception:   # noqa: BLE001 — a broken replica-manager
+                pass            # hook must not fail the request path
+
+    def _queue_state_locked(self):
+        out = {}
+        for tenant, entries in self._out.items():
+            cfg = self._tenants[tenant]
+            st = self._waits[tenant]
+            out[tenant] = {
+                'model': cfg.model,
+                'priority': cfg.priority,
+                'outstanding': len(entries),
+                'est_backlog_s': round(sum(e for _r, e, _t in entries),
+                                       6),
+                'ewma_wait_ms': round(st['ewma'] * 1e3, 3)
+                if st['ewma'] is not None else None,
+                'sheds': self._shed_n[tenant],
+            }
+        return out
+
+    # ------------------------------------------------------------------
+    def stats(self):
+        """Per-tenant queue state + the live per-model cost estimates
+        the admission math is currently pricing with."""
+        with self._lock:
+            self._reap_locked()
+            state = self._queue_state_locked()
+            models = sorted({c.model for c in self._tenants.values()})
+        return {
+            'tenants': state,
+            'costs': {m: goodput.cost_estimate(m) for m in models},
+        }

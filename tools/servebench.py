@@ -51,14 +51,25 @@ docs/serving.md) makes measurable promises about:
   (prompts past the widest bucket) exercising CHUNKED prefill, with a
   bit-exactness check against a single-shot wide-bucket reference.
 
+- fleet win (`measure_fleet`, `--fleet`): an fp32 model + its PTQ-int8
+  variant co-resident in one `ModelFleet` behind a goodput-priced
+  `Router`. Premium closed-loop deadline traffic (p99 under deadline)
+  shares the process with a flooding low-priority tenant (quota sheds,
+  never starves the deadline class), a mid-bench hot-swap redeploys the
+  premium model under the live load (zero dropped in-flight,
+  recompiles_after_warmup == 0), and the row carries the LIVE
+  `goodput.cost_estimate` device-seconds per dispatch per model.
+
 Usage: python tools/servebench.py [rounds] (prints one JSON line);
        python tools/servebench.py --generate   (streaming-decode mode);
        python tools/servebench.py --shared-prefix [clients];
        python tools/servebench.py --speculative [rounds]
                                   [--draft-config JSON] [--spec-k K];
+       python tools/servebench.py --fleet [requests_per_client]
 importable `measure_serving()` / `measure_generate()` /
-`measure_shared_prefix()` / `measure_speculative()` (bench.py's
-'serving', 'generate' and 'generate_speculative' rows reuse them).
+`measure_shared_prefix()` / `measure_speculative()` / `measure_fleet()`
+(bench.py's 'serving', 'generate', 'generate_speculative' and
+'serving_fleet' rows reuse them).
 """
 import json
 import os
@@ -105,6 +116,35 @@ def _build_model(dirname):
         exe.run(startup, scope=scope)
         fluid.save_inference_model(dirname, ['x'], [y], exe,
                                    main_program=main_p)
+    return 'x', 64
+
+
+def _build_int8_model(dirname, seed=0):
+    """The `_build_model` MLP post-training-quantized to int8 (quantize ->
+    quantized_matmul rewrite over calibration batches) and saved as a
+    `load_inference_model` artifact — the cheap-tier fleet variant.
+    Loading it in a serving process counts
+    `quantized_program_total{kind=loaded}`."""
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu.contrib.quantize import post_training_quantize
+    main_p, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup):
+        with fluid.unique_name.guard():
+            x = fluid.layers.data(name='x', shape=[64], dtype='float32')
+            h = fluid.layers.fc(x, size=128, act='relu')
+            h = fluid.layers.fc(h, size=128, act='relu')
+            y = fluid.layers.fc(h, size=16)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    rng = np.random.RandomState(seed)
+    calib = [{'x': rng.randn(4, 64).astype('float32')} for _ in range(4)]
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        infer = main_p.clone(for_test=True)
+        post_training_quantize(exe, infer, scope, calib)
+        fluid.save_inference_model(dirname, ['x'], [y], exe,
+                                   main_program=infer)
     return 'x', 64
 
 
@@ -739,6 +779,202 @@ def measure_speculative(rounds=4, sentences=8, slots=8, spec_k=6,
     }
 
 
+def measure_fleet(high_clients=3, low_clients=3, requests_per_client=40,
+                  deadline_ms=2000.0, low_quota=8):
+    """Returns the serving_fleet row dict: an fp32 model AND its PTQ-int8
+    variant resident in ONE `ModelFleet`, a goodput-priced `Router` in
+    front, and a mixed-priority workload driving both at once:
+
+    - premium tenant (priority 10, per-request deadline) runs CLOSED-LOOP
+      clients against the fp32 model; every admitted request must
+      complete, and p99 under the deadline is the headline.
+    - batch tenant (priority 0, `max_outstanding` quota) FLOODS the int8
+      model open-loop; overload sheds structured (tenant_quota) instead
+      of queueing unboundedly — shed count proves the policy bit.
+    - mid-bench, a hot-swap redeploys the premium model (v2 artifact)
+      UNDER the live closed loop. The zero-downtime contract:
+      `dropped_inflight == 0` (no premium request fails across the flip)
+      and `recompiles_after_warmup == 0` (the v2 warmup reuses the
+      warmfarm's AOT executables — same program structure, cache-hit
+      warm).
+    - admission prices come from LIVE `goodput.cost_estimate` — the row
+      carries the measured device-seconds per dispatch per model, primed
+      by a handful of direct requests before the window opens.
+    """
+    import paddle_tpu as fluid  # noqa: F401 — predictor deps
+    from paddle_tpu import monitor
+    from paddle_tpu.serving import (LoadShedError, ModelFleet, Router,
+                                    TenantConfig)
+
+    tmp = tempfile.mkdtemp(prefix='fleetbench_')
+    d_fp32_v1 = os.path.join(tmp, 'fp32_v1')
+    d_fp32_v2 = os.path.join(tmp, 'fp32_v2')
+    d_int8 = os.path.join(tmp, 'int8')
+    feed_name, width = _build_model(d_fp32_v1)
+    _build_model(d_fp32_v2)
+    _build_int8_model(d_int8)
+
+    reqs = _mixed_requests(feed_name, width, 64)
+    warm = {feed_name: reqs[0][feed_name][:1]}
+    cfg_kw = dict(max_batch_size=16, max_wait_ms=1.0, num_workers=2,
+                  queue_cap=256)
+    deadline_s = deadline_ms / 1e3
+
+    fleet = ModelFleet()
+    before_all = monitor.counters()
+    try:
+        fleet.deploy('fleet_fp32', d_fp32_v1, warm_feed=warm, **cfg_kw)
+        fleet.deploy('fleet_int8', d_int8, warm_feed=warm, **cfg_kw)
+        int8_loaded = sum(
+            v for k, v in monitor.counter_delta(before_all).items()
+            if k.startswith('quantized_program_total') and 'loaded' in k)
+
+        router = Router(fleet, tenants={
+            'premium': TenantConfig('fleet_fp32', priority=10,
+                                    deadline_s=deadline_s,
+                                    slo_ms=deadline_ms / 2),
+            'batch': TenantConfig('fleet_int8', priority=0,
+                                  deadline_s=30.0,
+                                  max_outstanding=low_quota),
+        })
+        # prime the live cost estimates — the router admits-and-learns
+        # at default_cost_s until goodput has dispatches for a model
+        for r in reqs[:6]:
+            fleet.run('fleet_fp32', r, timeout=10.0)
+            fleet.run('fleet_int8', r, timeout=10.0)
+
+        lock = threading.Lock()
+        hi_lat, hi_err, hi_n = [], [0], [0]
+        lo_ok, lo_err, lo_shed, lo_sub = [0], [0], [0], [0]
+        half = threading.Event()
+        swap_done = threading.Event()
+        swap_result = {}
+        t_end = time.monotonic() + 60.0
+        barrier = threading.Barrier(high_clients + low_clients + 1)
+
+        def premium_client(cid):
+            barrier.wait()
+            n = 0
+            # closed loop, one request in flight per client; clients keep
+            # looping until the hot-swap lands so the flip happens UNDER
+            # live deadline traffic (t_end backstops a stuck swap)
+            while (n < requests_per_client or not swap_done.is_set()) \
+                    and time.monotonic() < t_end:
+                t0 = time.perf_counter()
+                try:
+                    f = router.submit('premium', reqs[n % len(reqs)])
+                    f.result(deadline_s)
+                except Exception:   # noqa: BLE001 — any failure counts
+                    with lock:
+                        hi_err[0] += 1
+                else:
+                    with lock:
+                        hi_lat.append(time.perf_counter() - t0)
+                n += 1
+                if cid == 0 and n == max(1, requests_per_client // 2):
+                    half.set()
+            with lock:
+                hi_n[0] += n
+
+        def batch_client(cid):
+            barrier.wait()
+            futs = []
+            for i in range(requests_per_client * 3):
+                try:
+                    futs.append(router.submit(
+                        'batch', reqs[(cid + i) % len(reqs)]))
+                except LoadShedError:
+                    with lock:
+                        lo_shed[0] += 1
+                except Exception:   # noqa: BLE001
+                    with lock:
+                        lo_err[0] += 1
+            with lock:
+                lo_sub[0] += requests_per_client * 3
+            for f in futs:
+                try:
+                    f.result(30.0)
+                except Exception:   # noqa: BLE001
+                    with lock:
+                        lo_err[0] += 1
+                else:
+                    with lock:
+                        lo_ok[0] += 1
+
+        def swapper():
+            half.wait(30.0)
+            try:
+                swap_result.update(fleet.deploy(
+                    'fleet_fp32', d_fp32_v2, warm_feed=warm, **cfg_kw))
+            except Exception as e:  # noqa: BLE001 — reported in the row
+                swap_result['error'] = '%s: %s' % (type(e).__name__, e)
+            finally:
+                swap_done.set()
+
+        before = monitor.counters()
+        threads = [threading.Thread(target=premium_client, args=(c,),
+                                    daemon=True)
+                   for c in range(high_clients)]
+        threads += [threading.Thread(target=batch_client, args=(c,),
+                                     daemon=True)
+                    for c in range(low_clients)]
+        sw = threading.Thread(target=swapper, daemon=True)
+        for t in threads:
+            t.start()
+        sw.start()
+        barrier.wait()
+        for t in threads:
+            t.join(90.0)
+        sw.join(90.0)
+        delta = monitor.counter_delta(before)
+        miss = sum(v for k, v in delta.items()
+                   if k.startswith('compile_cache_miss'))
+        rstats = router.stats()
+        fstats = fleet.stats()
+    finally:
+        fleet.stop()
+        import shutil
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    lat = sorted(hi_lat)
+    p99 = 1e3 * (_quantile(lat, 0.99) or 0)
+    costs = {m: (c or {}).get('device_s_per_dispatch')
+             for m, c in (rstats.get('costs') or {}).items()}
+    return {
+        'models': {
+            name: {'version': m['version'],
+                   'resident_bytes': m['resident_bytes'],
+                   'cost_s_per_dispatch': costs.get(name)}
+            for name, m in fstats['models'].items()},
+        'high_priority': {
+            'clients': high_clients,
+            'requests': hi_n[0],
+            'ok': len(hi_lat),
+            'errors': hi_err[0],
+            'p50_ms': round(1e3 * (_quantile(lat, 0.5) or 0), 2),
+            'p99_ms': round(p99, 2),
+            'deadline_ms': deadline_ms,
+            'p99_under_deadline': bool(lat) and p99 < deadline_ms,
+        },
+        'low_priority': {
+            'clients': low_clients,
+            'submitted': lo_sub[0],
+            'ok': lo_ok[0],
+            'errors': lo_err[0],
+            'shed': lo_shed[0],
+            'quota': low_quota,
+        },
+        'hot_swap': {
+            'performed': swap_result.get('swapped', False),
+            'result': swap_result,
+            'dropped_inflight': hi_err[0],
+        },
+        'recompiles_after_warmup': int(miss),
+        'int8_programs_loaded': int(int8_loaded),
+        'tenants': rstats.get('tenants'),
+    }
+
+
 if __name__ == '__main__':
     argv = [a for a in sys.argv[1:]]
     draft_cfg = None
@@ -769,6 +1005,10 @@ if __name__ == '__main__':
         n = int(argv[0]) if argv else 4
         print(json.dumps(measure_speculative(rounds=n, spec_k=spec_k,
                                              draft_config=draft_cfg)))
+    elif '--fleet' in argv:
+        argv.remove('--fleet')
+        n = int(argv[0]) if argv else 40
+        print(json.dumps(measure_fleet(requests_per_client=n)))
     else:
         n = int(argv[0]) if argv else 5
         print(json.dumps(measure_serving(rounds=n)))
