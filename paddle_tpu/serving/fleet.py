@@ -81,6 +81,7 @@ class ModelFleet(object):
         self.hbm_budget_bytes = hbm_budget_bytes
         self._lock = threading.RLock()
         self._models = {}       # name -> record dict
+        self._reserved = {}     # in-flight deploy token -> pending bytes
         self._block_pool = None
         if block_budget is not None:
             # +1 physical block: block 0 is the pool's reserved trash
@@ -131,7 +132,11 @@ class ModelFleet(object):
         private scope — not just Parameters, because a PTQ artifact's
         int8 blobs are persistable plain Variables and they ARE the
         resident weights (counted at their real 1-byte width, which is
-        what makes the int8 variant ~4x cheaper under the budget)."""
+        what makes the int8 variant ~4x cheaper under the budget).
+
+        Returns None when the scope walk itself fails — the caller must
+        not price an unmeasurable model as free (deploy refuses it when
+        an HBM budget is set, and counts the failure either way)."""
         total = 0
         try:
             for v in predictor.program.global_block().vars.values():
@@ -142,8 +147,8 @@ class ModelFleet(object):
                         predictor.scope.get(v.name)).nbytes)
                 except Exception:   # noqa: BLE001 — unmaterialized var
                     continue
-        except Exception:           # noqa: BLE001 — budget is advisory
-            return 0
+        except Exception:           # noqa: BLE001 — measurement failed
+            return None
         return total
 
     def _set_gauges_locked(self):
@@ -169,29 +174,46 @@ class ModelFleet(object):
         ``fleet_deploy_total{outcome=failed}``)."""
         t0 = time.perf_counter()
         engine = None
+        token = object()        # this deploy's budget-reservation key
         try:
             cfg = ServingConfig(path, name=name, **config_kw)
             engine = ServingEngine(cfg)
             size = self._resident_bytes(engine.predictor)
+            if size is None:
+                monitor.inc('fleet_size_measure_errors_total')
+                if self.hbm_budget_bytes is not None:
+                    raise FleetError(
+                        "could not measure resident bytes for %r — an "
+                        "unmeasurable model cannot be admitted under "
+                        "the %d-byte HBM budget"
+                        % (name, self.hbm_budget_bytes))
+                size = 0
             with self._lock:
                 if self.hbm_budget_bytes is not None:
                     old = self._models.get(name)
-                    projected = size + sum(
-                        r['bytes'] for n, r in self._models.items()
-                        if n != name) + (0 if old is None
-                                         else old['bytes'])
+                    projected = size + sum(self._reserved.values()) \
+                        + sum(r['bytes']
+                              for n, r in self._models.items()
+                              if n != name) + (0 if old is None
+                                               else old['bytes'])
                     # the old version stays resident until the new one
-                    # is live — a swap transiently holds BOTH
+                    # is live — a swap transiently holds BOTH. The
+                    # reservation makes check-and-charge atomic: a
+                    # concurrent deploy prices this one in even though
+                    # it only registers after warmup, seconds from now.
                     if projected > self.hbm_budget_bytes:
                         raise FleetError(
                             "deploying %r (%d bytes) would put fleet "
                             "residency at %d bytes, over the %d-byte "
                             "HBM budget" % (name, size, projected,
                                             self.hbm_budget_bytes))
+                    self._reserved[token] = size
             warm = engine.warmup(warm_feed) \
                 if warm_feed is not None else None
             engine.start()
         except Exception as e:
+            with self._lock:
+                self._reserved.pop(token, None)
             if engine is not None:
                 try:
                     engine.stop(timeout_s=1.0)
@@ -208,6 +230,7 @@ class ModelFleet(object):
                 monitor.inc('blackbox_write_errors_total')
             raise
         with self._lock:
+            self._reserved.pop(token, None)
             old = self._models.get(name)
             version = 1 if old is None else old['version'] + 1
             self._models[name] = {

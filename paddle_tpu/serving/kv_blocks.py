@@ -40,6 +40,7 @@ block's row a divergent write → the engine copies that block first
 copy. Neither sharer ever observes the other's tokens.
 """
 import hashlib
+import threading
 
 __all__ = ['BlockAllocator', 'PrefixCache', 'QuotaBlockAllocator',
            'chain_hashes']
@@ -62,7 +63,13 @@ def chain_hashes(tokens, block_size):
 class BlockAllocator(object):
     """Free-list + refcount accounting over `num_blocks` physical blocks.
     Block 0 is reserved (trash) and never allocated; `capacity` is the
-    usable pool size (num_blocks - 1)."""
+    usable pool size (num_blocks - 1).
+
+    Thread-safe: a fleet hands per-tenant `QuotaBlockAllocator` views
+    over ONE pool to multiple decode-loop threads, so every mutation
+    (and every check that gates one) runs under the pool's reentrant
+    `lock` — views take the SAME lock so their quota check-and-charge
+    is atomic against concurrent tenants."""
 
     def __init__(self, num_blocks, block_size):
         if num_blocks < 2:
@@ -71,6 +78,7 @@ class BlockAllocator(object):
                 "reserved trash block), got %d" % num_blocks)
         self.num_blocks = int(num_blocks)
         self.block_size = int(block_size)
+        self.lock = threading.RLock()
         self._free = list(range(self.num_blocks - 1, 0, -1))
         self._ref = [0] * self.num_blocks
 
@@ -79,48 +87,55 @@ class BlockAllocator(object):
         return self.num_blocks - 1
 
     def available(self):
-        return len(self._free)
+        with self.lock:
+            return len(self._free)
 
     def in_use(self):
-        return self.capacity - len(self._free)
+        with self.lock:
+            return self.capacity - len(self._free)
 
     def refcount(self, bid):
-        return self._ref[bid]
+        with self.lock:
+            return self._ref[bid]
 
     def alloc(self, n):
         """n fresh blocks at refcount 1, or None when the free list is
         short (nothing is partially allocated on failure)."""
-        if n > len(self._free):
-            return None
-        out = [self._free.pop() for _ in range(n)]
-        for b in out:
-            self._ref[b] = 1
-        return out
+        with self.lock:
+            if n > len(self._free):
+                return None
+            out = [self._free.pop() for _ in range(n)]
+            for b in out:
+                self._ref[b] = 1
+            return out
 
     def ref(self, bid):
-        if self._ref[bid] < 1:
-            raise ValueError("ref of unallocated block %d" % bid)
-        self._ref[bid] += 1
+        with self.lock:
+            if self._ref[bid] < 1:
+                raise ValueError("ref of unallocated block %d" % bid)
+            self._ref[bid] += 1
 
     def deref(self, bid):
         """Drop one reference; a refcount-0 block returns to the free
         list. Returns True when the block was actually freed."""
-        if self._ref[bid] < 1:
-            raise ValueError("deref of unallocated block %d" % bid)
-        self._ref[bid] -= 1
-        if self._ref[bid] == 0:
-            self._free.append(bid)
-            return True
-        return False
+        with self.lock:
+            if self._ref[bid] < 1:
+                raise ValueError("deref of unallocated block %d" % bid)
+            self._ref[bid] -= 1
+            if self._ref[bid] == 0:
+                self._free.append(bid)
+                return True
+            return False
 
     def deref_many(self, bids):
         """`deref` a batch (slot release, speculative-tail rollback);
         returns how many blocks actually went back to the free list."""
-        freed = 0
-        for b in bids:
-            if self.deref(b):
-                freed += 1
-        return freed
+        with self.lock:
+            freed = 0
+            for b in bids:
+                if self.deref(b):
+                    freed += 1
+            return freed
 
 
 class QuotaBlockAllocator(object):
@@ -140,7 +155,11 @@ class QuotaBlockAllocator(object):
     headroom = min(pool free, quota remaining). Eviction isolation is
     structural: each tenant's `PrefixCache` is built over its own view,
     so ``evict_for`` under one tenant's allocation pressure only ever
-    walks (and derefs) that tenant's entries."""
+    walks (and derefs) that tenant's entries.
+
+    Every view method runs under the POOL's reentrant lock (the quota
+    check and the pool mutation must be one atomic step — two tenants'
+    decode threads race on the same free list otherwise)."""
 
     def __init__(self, pool, quota, tenant=None):
         quota = int(quota)
@@ -150,6 +169,7 @@ class QuotaBlockAllocator(object):
         self.quota = quota
         self.tenant = tenant
         self.block_size = pool.block_size
+        self.lock = pool.lock
         self._held = {}         # block id -> refs held through this view
 
     @property
@@ -157,50 +177,56 @@ class QuotaBlockAllocator(object):
         return min(self.quota, self.pool.capacity)
 
     def available(self):
-        return max(0, min(self.pool.available(),
-                          self.quota - len(self._held)))
+        with self.lock:
+            return max(0, min(self.pool.available(),
+                              self.quota - len(self._held)))
 
     def in_use(self):
-        return len(self._held)
+        with self.lock:
+            return len(self._held)
 
     def refcount(self, bid):
         return self.pool.refcount(bid)
 
     def alloc(self, n):
-        if len(self._held) + n > self.quota:
-            return None
-        out = self.pool.alloc(n)
-        if out is not None:
-            for b in out:
-                self._held[b] = 1
-        return out
+        with self.lock:
+            if len(self._held) + n > self.quota:
+                return None
+            out = self.pool.alloc(n)
+            if out is not None:
+                for b in out:
+                    self._held[b] = 1
+            return out
 
     def ref(self, bid):
-        if bid not in self._held and len(self._held) >= self.quota:
-            raise ValueError(
-                "ref of block %d would exceed tenant %r quota %d"
-                % (bid, self.tenant, self.quota))
-        self.pool.ref(bid)
-        self._held[bid] = self._held.get(bid, 0) + 1
+        with self.lock:
+            if bid not in self._held and len(self._held) >= self.quota:
+                raise ValueError(
+                    "ref of block %d would exceed tenant %r quota %d"
+                    % (bid, self.tenant, self.quota))
+            self.pool.ref(bid)
+            self._held[bid] = self._held.get(bid, 0) + 1
 
     def deref(self, bid):
-        held = self._held.get(bid, 0)
-        if held < 1:
-            raise ValueError(
-                "deref of block %d not held by tenant %r" % (bid,
-                                                             self.tenant))
-        if held == 1:
-            del self._held[bid]
-        else:
-            self._held[bid] = held - 1
-        return self.pool.deref(bid)
+        with self.lock:
+            held = self._held.get(bid, 0)
+            if held < 1:
+                raise ValueError(
+                    "deref of block %d not held by tenant %r"
+                    % (bid, self.tenant))
+            if held == 1:
+                del self._held[bid]
+            else:
+                self._held[bid] = held - 1
+            return self.pool.deref(bid)
 
     def deref_many(self, bids):
-        freed = 0
-        for b in bids:
-            if self.deref(b):
-                freed += 1
-        return freed
+        with self.lock:
+            freed = 0
+            for b in bids:
+                if self.deref(b):
+                    freed += 1
+            return freed
 
 
 class PrefixCache(object):

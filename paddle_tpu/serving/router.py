@@ -111,6 +111,7 @@ class Router(object):
         self._sheds = {}        # tenant -> deque of shed perf times
         self._shed_n = {}       # tenant -> lifetime shed count
         self._burn_last = {}    # (tenant, cause) -> last publish time
+        self._burns = []        # queued burn events, delivered unlocked
         self.on_scale_hint = on_scale_hint
         self.default_cost_s = float(default_cost_s)
         self.hint_cooldown_s = float(hint_cooldown_s)
@@ -151,37 +152,59 @@ class Router(object):
         if deadline_s is None:
             deadline_s = cfg.deadline_s
         est = self.cost(cfg.model)
-        with self._lock:
-            self._reap_locked()
-            mine = self._out[tenant]
-            if cfg.max_outstanding is not None and \
-                    len(mine) >= cfg.max_outstanding:
-                raise self._shed_locked(tenant, 'tenant_quota',
-                                        len(mine), cfg.max_outstanding)
-            backlog_ge = 0.0
-            backlog_all = 0.0
-            for t, entries in self._out.items():
-                s = sum(e for _r, e, _t in entries)
-                backlog_all += s
-                if self._tenants[t].priority >= cfg.priority:
-                    backlog_ge += s
-            if deadline_s is not None and backlog_ge + est > deadline_s:
-                raise self._shed_locked(tenant, 'deadline_unmeetable',
-                                        len(mine),
-                                        cfg.max_outstanding or 0)
-            for hname, hcfg in self._tenants.items():
-                if hcfg.priority <= cfg.priority or \
-                        hcfg.deadline_s is None:
-                    continue
-                if backlog_all + est > \
-                        hcfg.deadline_s * hcfg.headroom_frac:
-                    raise self._shed_locked(tenant, 'priority_backlog',
+        # the admission decision and its bookkeeping are ONE locked
+        # step: the provisional entry (req slot still None) lands in
+        # the outstanding book before the lock drops, so concurrent
+        # submits see each other's quota/backlog charge even though the
+        # fleet dispatch happens unlocked below
+        rec = [None, est, time.monotonic()]
+        try:
+            with self._lock:
+                self._reap_locked()
+                mine = self._out[tenant]
+                if cfg.max_outstanding is not None and \
+                        len(mine) >= cfg.max_outstanding:
+                    raise self._shed_locked(tenant, 'tenant_quota',
+                                            len(mine),
+                                            cfg.max_outstanding)
+                backlog_ge = 0.0
+                backlog_all = 0.0
+                for t, entries in self._out.items():
+                    s = sum(e for _r, e, _t in entries)
+                    backlog_all += s
+                    if self._tenants[t].priority >= cfg.priority:
+                        backlog_ge += s
+                if deadline_s is not None and \
+                        backlog_ge + est > deadline_s:
+                    raise self._shed_locked(tenant,
+                                            'deadline_unmeetable',
                                             len(mine),
                                             cfg.max_outstanding or 0)
-        req = self._fleet.submit(cfg.model, feed, deadline_s=deadline_s,
-                                 **kw)
+                for hname, hcfg in self._tenants.items():
+                    if hcfg.priority <= cfg.priority or \
+                            hcfg.deadline_s is None:
+                        continue
+                    if backlog_all + est > \
+                            hcfg.deadline_s * hcfg.headroom_frac:
+                        raise self._shed_locked(tenant,
+                                                'priority_backlog',
+                                                len(mine),
+                                                cfg.max_outstanding or 0)
+                mine.append(rec)
+        finally:
+            self._deliver_burns()
+        try:
+            req = self._fleet.submit(cfg.model, feed,
+                                     deadline_s=deadline_s, **kw)
+        except BaseException:
+            with self._lock:
+                try:
+                    self._out[tenant].remove(rec)
+                except ValueError:  # reaped/cleared concurrently
+                    pass
+            raise
         with self._lock:
-            self._out[tenant].append([req, est, time.monotonic()])
+            rec[0] = req
         monitor.inc('fleet_request_total',
                     labels={'tenant': tenant, 'outcome': 'admitted'})
         return req
@@ -198,10 +221,10 @@ class Router(object):
         n = sum(1 for t in self._sheds[tenant] if t >= lo)
         if n >= self.storm_n and \
                 self._burn_ok_locked(tenant, 'shed_storm'):
-            self._publish_burn(tenant, 'shed_storm',
-                               sheds_in_window=n,
-                               window_s=self.storm_window_s,
-                               last_reason=reason)
+            self._queue_burn_locked(tenant, 'shed_storm',
+                                    sheds_in_window=n,
+                                    window_s=self.storm_window_s,
+                                    last_reason=reason)
         return LoadShedError(reason, depth, cap)
 
     # ------------------------------------------------------------------
@@ -209,13 +232,13 @@ class Router(object):
     def _reap_locked(self):
         """Drop finished requests from the outstanding books and feed
         each tenant's queue-wait EWMA from the request's own timing
-        breakdown (callers hold _lock)."""
-        hints = []
+        breakdown (callers hold _lock). An entry whose req slot is
+        still None is a submit() mid-dispatch — always live."""
         for tenant, entries in self._out.items():
             live = []
             for rec in entries:
                 req = rec[0]
-                if not req._event.is_set():
+                if req is None or not req._event.is_set():
                     live.append(rec)
                     continue
                 wait = None
@@ -224,15 +247,11 @@ class Router(object):
                 if wait is not None:
                     hint = self._note_wait_locked(tenant, float(wait))
                     if hint is not None:
-                        hints.append(hint)
+                        tenant_, h, ewma_ms, slo_ms = hint
+                        self._queue_burn_locked(
+                            tenant_, 'queue_burn', hint=round(h, 3),
+                            ewma_ms=round(ewma_ms, 3), slo_ms=slo_ms)
             self._out[tenant] = live
-        # callbacks/bundles run outside the book-keeping loop but still
-        # under _lock (blackbox.record is an enqueue; the callback is
-        # the replica manager's hook and must not re-enter submit)
-        for tenant, hint, ewma_ms, slo_ms in hints:
-            self._publish_burn(tenant, 'queue_burn', hint=round(hint, 3),
-                               ewma_ms=round(ewma_ms, 3),
-                               slo_ms=slo_ms)
 
     def _note_wait_locked(self, tenant, wait_s):
         """EWMA one observed queue wait; returns a (tenant, hint,
@@ -261,22 +280,36 @@ class Router(object):
         self._burn_last[(tenant, cause)] = now
         return True
 
-    def _publish_burn(self, tenant, cause, **fields):
-        """One SLO-burn event: the flight-recorder bundle (with every
-        tenant's queue state) + the scale-hint callback."""
-        state = self._queue_state_locked()
-        try:
-            from .. import blackbox
-            blackbox.record('fleet_slo_burn', tenant=tenant, cause=cause,
-                            tenants=state, **fields)
-        except Exception:       # noqa: BLE001 — telemetry only
-            monitor.inc('blackbox_write_errors_total')
-        cb = self.on_scale_hint
-        if cb is not None and cause == 'queue_burn':
+    def _queue_burn_locked(self, tenant, cause, **fields):
+        """Snapshot the queue state for one SLO-burn event and queue it
+        for delivery (callers hold _lock). Delivery — the flight-
+        recorder bundle and the scale-hint callback — happens in
+        `_deliver_burns` AFTER the lock drops, so a replica-manager
+        hook may freely call router.stats() or router.submit() without
+        deadlocking the request path."""
+        self._burns.append((tenant, cause, fields,
+                            self._queue_state_locked()))
+
+    def _deliver_burns(self):
+        """Drain queued burn events outside _lock (each event carries
+        the state snapshot taken when it fired)."""
+        while True:
+            with self._lock:
+                if not self._burns:
+                    return
+                tenant, cause, fields, state = self._burns.pop(0)
             try:
-                cb(tenant, fields.get('hint', 1.0), state)
-            except Exception:   # noqa: BLE001 — a broken replica-manager
-                pass            # hook must not fail the request path
+                from .. import blackbox
+                blackbox.record('fleet_slo_burn', tenant=tenant,
+                                cause=cause, tenants=state, **fields)
+            except Exception:   # noqa: BLE001 — telemetry only
+                monitor.inc('blackbox_write_errors_total')
+            cb = self.on_scale_hint
+            if cb is not None and cause == 'queue_burn':
+                try:
+                    cb(tenant, fields.get('hint', 1.0), state)
+                except Exception:   # noqa: BLE001 — a broken replica-
+                    pass            # manager hook must not fail requests
 
     def _queue_state_locked(self):
         out = {}
@@ -303,6 +336,7 @@ class Router(object):
             self._reap_locked()
             state = self._queue_state_locked()
             models = sorted({c.model for c in self._tenants.values()})
+        self._deliver_burns()
         return {
             'tenants': state,
             'costs': {m: goodput.cost_estimate(m) for m in models},

@@ -81,6 +81,41 @@ def test_quota_view_validation():
         ModelFleet().block_view('t', 1)     # no shared pool configured
 
 
+def test_shared_pool_concurrent_tenants_conserve_blocks():
+    """Three tenants' decode threads hammer ONE pool through their
+    views: the pool lock makes every check-then-mutate atomic, so the
+    free list never underflows (an unsynchronized allocator IndexErrors
+    here) and refcounts conserve exactly."""
+    fleet = ModelFleet(block_budget=8, block_size=8)
+    pool = fleet.block_pool
+    views = [fleet.block_view('t%d' % i, 4) for i in range(3)]
+    errors = []
+
+    def hammer(view, seed):
+        rng = np.random.RandomState(seed)
+        try:
+            for _ in range(300):
+                got = view.alloc(int(rng.randint(1, 4)))
+                if got is None:             # quota or pool dry — legal
+                    continue
+                view.ref(got[0])            # within-tenant prefix share
+                view.deref(got[0])
+                view.deref_many(got)
+        except Exception as e:              # noqa: BLE001 — any crash
+            errors.append(e)                # is the regression
+
+    threads = [threading.Thread(target=hammer, args=(v, i))
+               for i, v in enumerate(views)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(30.0)
+    assert errors == []
+    assert all(v.in_use() == 0 for v in views)
+    assert pool.in_use() == 0 and pool.available() == 8
+    assert all(r == 0 for r in pool._ref)
+
+
 # ---------------------------------------------------------------------------
 # live cost estimates (goodput)
 
@@ -150,6 +185,56 @@ def test_router_tenant_quota_shed():
     assert ei.value.reason == 'tenant_quota'
     with pytest.raises(KeyError):
         r.submit('nobody', {})
+
+
+def test_router_concurrent_submits_respect_quota():
+    """Racing submits must not overshoot max_outstanding: the
+    provisional outstanding entry lands in the SAME locked section as
+    the admission checks, so concurrent threads charge each other's
+    quota even though the fleet dispatch runs unlocked."""
+    goodput.reset()
+    fleet = _StubFleet()
+    r = Router(fleet, tenants={
+        't': TenantConfig('rq_conc', max_outstanding=3)})
+    n_threads = 8
+    barrier = threading.Barrier(n_threads)
+    outcomes = []
+
+    def rush():
+        barrier.wait()
+        try:
+            r.submit('t', {})
+        except LoadShedError:
+            outcomes.append('shed')
+        else:
+            outcomes.append('admitted')
+
+    threads = [threading.Thread(target=rush) for _ in range(n_threads)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(30.0)
+    assert outcomes.count('admitted') == 3      # never 4+
+    assert outcomes.count('shed') == n_threads - 3
+    assert len(fleet.submitted) == 3
+    assert r.stats()['tenants']['t']['outstanding'] == 3
+
+
+def test_router_submit_failure_releases_provisional_entry():
+    """A fleet.submit that raises must roll back the provisional
+    outstanding entry, or the tenant's quota leaks away permanently."""
+    goodput.reset()
+
+    class _BoomFleet(object):
+        def submit(self, name, feed, deadline_s=None, **kw):
+            raise RuntimeError('engine gone')
+
+    r = Router(_BoomFleet(), tenants={
+        't': TenantConfig('rq_boom', max_outstanding=1)})
+    for _ in range(3):                  # quota 1, yet every retry admits
+        with pytest.raises(RuntimeError):
+            r.submit('t', {})
+    assert r.stats()['tenants']['t']['outstanding'] == 0
 
 
 def test_router_deadline_unmeetable_priced_by_goodput():
@@ -231,6 +316,30 @@ def test_router_scale_hint_callback_and_slo_burn(monkeypatch):
     assert 'fleet_slo_burn' in kinds
     _, fields = bundles[kinds.index('fleet_slo_burn')]
     assert fields['cause'] == 'queue_burn' and 'tenants' in fields
+    goodput.reset()
+
+
+def test_router_scale_hint_callback_may_reenter(monkeypatch):
+    """Burn delivery (bundle + callback) happens AFTER _lock drops, so
+    a replica-manager hook that reads router.stats() — the natural
+    thing for a manager deciding placement — must not deadlock."""
+    goodput.reset()
+    from paddle_tpu import blackbox
+    monkeypatch.setattr(blackbox, 'record', lambda kind, **kw: None)
+    seen = []
+    fleet = _StubFleet()
+    r = Router(fleet,
+               tenants={'t': TenantConfig('rq_reent', slo_ms=10.0,
+                                          min_samples=2)},
+               on_scale_hint=lambda tenant, hint, state:
+               seen.append(r.stats()),
+               hint_cooldown_s=30.0)
+    for _ in range(3):
+        r.submit('t', {})
+    for _name, req in fleet.submitted:
+        req.finish(queue_s=0.05)
+    r.stats()                           # reap -> burn -> re-entrant hook
+    assert seen and 't' in seen[0]['tenants']
     goodput.reset()
 
 
