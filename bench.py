@@ -42,6 +42,11 @@ TPU_TIMEOUT_S = 2400          # compile times under chip contention vary 5x
 CPU_TIMEOUT_S = 900
 TPU_MODEL_BUDGET_S = 1700     # leave headroom for JSON emission
 
+# committed flagship-LM training-throughput baseline for the goodput
+# sentinel (like tools/servebench.py SERVING_ROW_BASELINE): a reading
+# below baseline * PADDLE_PERFWATCH_ROW_DRIFT trips bench_row_drift
+TRAIN_ROW_BASELINE = {'cpu': 12167.0, 'source': 'BENCH_r09'}
+
 def _peak_for(kind):
     # one source of truth for the per-chip peak table: the goodput layer
     # (paddle_tpu/goodput.py PEAK_FLOPS) — the live step_mfu gauge and
@@ -1146,6 +1151,13 @@ def _child(mode):
     flag.pop('flops_per_step', None)
 
     tokens_per_sec = flag['tokens_per_sec']
+    if not on_tpu and TRAIN_ROW_BASELINE.get('cpu'):
+        # drift-watch the training flagship row too (the serving rows
+        # already register theirs in servebench) — same committed-number
+        # contract, keyed to the platform the baseline was measured on
+        from paddle_tpu import goodput
+        goodput.note_bench_row('transformer_lm_train_throughput',
+                               tokens_per_sec, TRAIN_ROW_BASELINE['cpu'])
     print(json.dumps({
         'metric': 'transformer_lm_train_throughput',
         'value': round(tokens_per_sec, 2),

@@ -63,6 +63,7 @@ from . import monitor
 from . import trace
 from . import analysis
 from . import goodput
+from . import health
 from . import resilience
 from .resilience import TrainingGuard, elastic_train_loop
 from . import profiler

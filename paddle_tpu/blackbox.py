@@ -76,6 +76,11 @@ TRIGGER_KINDS = {
                     '(includes ps_pull/ps_push transport give-ups)',
     'nonfinite_escalate': 'TrainingGuard escalation — carries the NaN '
                           'localization and the replayable step',
+    'training_anomaly': 'health detector bank: confirmed training-dynamics '
+                        'anomaly (grad explosion/vanish, loss spike, '
+                        'update-ratio drift, non-finite grads) — bundle '
+                        'carries the per-layer stat table and the '
+                        'last-N-step history ring',
     'elastic_resume': 'elastic_train_loop survived a failure and resumed',
     'elastic_giveup': 'elastic_train_loop exhausted its resume budget',
     'elastic_grow': 'elastic grow-back: preempted capacity returned and '

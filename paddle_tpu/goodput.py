@@ -673,7 +673,7 @@ def stats(fps=None):
                 'model_flops_per_s': 0.0, 'step_mfu': None,
                 'hbm_bw_util_frac': None, 'by_kind': {},
                 'loss_buckets': {k: 0.0 for k in LOSS_BUCKETS},
-                'regressions': []}
+                'regressions': [], 'health': _health_block()}
     _drain()
     keep = None if fps is None else set(fps)
     with _lock:
@@ -716,7 +716,21 @@ def stats(fps=None):
             'by_kind': by_kind,
             'loss_buckets': {k: round(v, 6) for k, v in buckets.items()},
             'regressions': list(_trips),
+            'health': _health_block(),
         }
+
+
+def _health_block():
+    """The training-health view nested into every stats() reading (and so
+    into every flight-recorder bundle's goodput.json): None until the
+    health observatory has observed a step."""
+    try:
+        from . import health
+        if health.active():
+            return health.stats()
+    except Exception:           # noqa: BLE001 — telemetry only
+        pass
+    return None
 
 
 def cost_estimate(model, kind=None):

@@ -219,6 +219,11 @@ def build_lm(cfg=None, is_test=False):
     # boundary (segment lowering carries any crossing var generically).
     cfg.block_outputs = block_outputs
     tokens.block.program._lm_checkpoint_vars = block_outputs
+    # training-health activation taps: the same residual-stream boundaries
+    # double as the health observatory's activation-RMS sites — they
+    # survive remat lowering (they ARE the remat segment outputs)
+    tokens.block.program._health_act_taps = tuple(
+        v.name for v in block_outputs)
     x, _ = _entry_ln(x, delta, 2, 'final_ln')
     logits = layers.fc(input=x, size=cfg.vocab_size, num_flatten_dims=2,
                        param_attr=ParamAttr(name='lm_head.w'),

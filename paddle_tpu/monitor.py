@@ -219,7 +219,8 @@ def inc(name, value=1.0, labels=None):
 # once per _COUNTER_TRACK_MIN_S.
 _COUNTER_TRACK_NAMES = ('program_peak_bytes', 'program_flops',
                         'executor_inflight', 'elastic_world_size',
-                        'step_mfu', 'goodput_frac')
+                        'step_mfu', 'goodput_frac',
+                        'health_grad_norm_global', 'health_loss')
 _COUNTER_TRACK_SUFFIXES = ('queue_depth', 'inflight_batches')
 _COUNTER_TRACK_MIN_S = 0.005            # <= 200 samples/s per track
 _track_last_ts = {}                     # track name -> last sample time

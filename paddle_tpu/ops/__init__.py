@@ -31,3 +31,4 @@ from . import kv_cache_ops
 from . import fused_ops
 from . import dist_ops
 from . import pipeline_ops
+from . import health_ops

@@ -1,0 +1,80 @@
+"""Training-health stat harvesting op (health.py).
+
+One op, appended at the end of an instrumented training program, reduces
+every gradient / parameter / pre-update copy / tagged activation into a
+single small float32 vector — the ONE extra fetch the health observatory
+rides on the existing step dispatch. Pure jnp reductions: they fuse into
+the step's XLA program and run on the global arrays under a mesh, so
+multi-chip programs report global (not per-shard) norms for free.
+
+Output layout (health.instrument builds the matching decode schema):
+
+    [ per-grad L2 norm            x len(Grads)
+      per-param update/param     x len(Params)   (||p - p_pre|| / ||p_pre||)
+      per-site activation RMS    x len(Acts)
+      global grad L2 norm
+      global param L2 norm
+      non-finite grad entries (count)
+      |g| > attr('large') entries (count)
+      mean loss                               ]  (only when Loss is given)
+"""
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+def _dense_values(x):
+    # SelectedRows grads (sparse embeddings): the implicit zero rows
+    # contribute nothing to norms/counts — reduce over the values only
+    from ..core.selected_rows import SelectedRows
+    if isinstance(x, SelectedRows):
+        return x.values
+    return x
+
+
+@register_op('health_stats', share_lod=False)
+def _health_stats(ctx, op):
+    f32 = jnp.float32
+    grads = [_dense_values(g).astype(f32)
+             for g in ctx.in_list(op, 'Grads')]
+    params = [p.astype(f32) for p in ctx.in_list(op, 'Params')]
+    pres = [p.astype(f32) for p in ctx.in_list(op, 'Pre')]
+    acts = ctx.in_list(op, 'Acts')
+    loss = ctx.in1(op, 'Loss')
+    large = float(op.attr('large', 1e3))
+
+    parts = []
+    gsq = jnp.asarray(0.0, f32)
+    nonfinite = jnp.asarray(0.0, f32)
+    big = jnp.asarray(0.0, f32)
+    for g in grads:
+        sq = jnp.sum(g * g)
+        gsq = gsq + sq
+        nonfinite = nonfinite + jnp.sum(~jnp.isfinite(g)).astype(f32)
+        big = big + jnp.sum(jnp.abs(g) > large).astype(f32)
+        parts.append(jnp.sqrt(sq))
+
+    psq = jnp.asarray(0.0, f32)
+    for p, pre in zip(params, pres):
+        psq = psq + jnp.sum(p * p)
+        d = p - pre
+        pre_norm = jnp.sqrt(jnp.sum(pre * pre))
+        # zero-init params (biases at step 1) have no meaningful relative
+        # update — report 0 instead of ||d||/eps, which would poison the
+        # drift detector's baseline with a ~1e10 reading
+        ratio = jnp.sqrt(jnp.sum(d * d)) / (pre_norm + 1e-12)
+        parts.append(jnp.where(pre_norm > 0, ratio, jnp.asarray(0.0, f32)))
+
+    for a in acts:
+        a = a.astype(f32)
+        parts.append(jnp.sqrt(jnp.mean(a * a)))
+
+    parts.append(jnp.sqrt(gsq))
+    parts.append(jnp.sqrt(psq))
+    parts.append(nonfinite)
+    parts.append(big)
+    if loss is not None:
+        parts.append(jnp.mean(loss.astype(f32)))
+
+    ctx.out(op, 'Out', jnp.stack([p.reshape(()) for p in parts])
+            if parts else jnp.zeros((0,), f32))

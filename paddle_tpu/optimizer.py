@@ -126,6 +126,12 @@ class Optimizer(object):
             params_grads = append_gradient_clip_ops(params_grads)
             params_grads = append_regularization_ops(params_grads,
                                                      self.regularization)
+            # training-health hook: record the FINAL (clipped/regularized)
+            # param/grad names so health.instrument harvests the gradients
+            # this update actually consumes — works for the fused paths
+            # too, which only override _append_optimize_ops below
+            from . import health
+            health.note_params_grads(program, params_grads)
             self._create_global_learning_rate()
             block = program.global_block()
             self._create_accumulators(block, [pg[0] for pg in params_grads])

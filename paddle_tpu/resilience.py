@@ -686,13 +686,32 @@ class TrainingGuard(object):
     def __init__(self, executor, program, loss_name=None, scope=None,
                  max_bad_steps=3, loss_scale_name=None, backoff_factor=0.5,
                  growth_interval=0, growth_factor=2.0,
-                 max_loss_scale=2.0 ** 15, check_state=False):
+                 max_loss_scale=2.0 ** 15, check_state=False, health=None):
         if max_bad_steps < 1:
             raise ValueError("max_bad_steps must be >= 1")
         self._exe = executor
         self._program = program
         self._loss_name = loss_name
         self._scope = scope
+        # training-health observatory (health.py). None (default): follow
+        # PADDLE_HEALTH. True/'watch': telemetry only — per-layer stats
+        # ride the step fetch, detectors trip counters/bundles. 'preempt':
+        # additionally roll the step back on a confirmed grad_explosion /
+        # loss_spike BEFORE anything goes non-finite (same snapshot/
+        # rollback + loss-scale backoff as the NaN path). False: off.
+        from . import health as _health_mod
+        mode = health
+        if mode is None:
+            mode = 'watch' if _health_mod.enabled() else False
+        elif mode is True:
+            mode = 'watch'
+        if mode not in (False, 'watch', 'preempt'):
+            raise ValueError("health must be one of None/True/False/"
+                             "'watch'/'preempt', got %r" % (health,))
+        self.health_mode = mode or None
+        if self.health_mode:
+            _health_mod.instrument(
+                getattr(program, '_program', program), loss_name)
         self.max_bad_steps = int(max_bad_steps)
         self.loss_scale_name = loss_scale_name
         self.backoff_factor = float(backoff_factor)
@@ -727,6 +746,51 @@ class TrainingGuard(object):
         new = np.minimum(cur * factor, self.max_loss_scale).astype(cur.dtype)
         scope.set(self.loss_scale_name, new)
 
+    # -- shared snapshot/restore (NaN path AND preemptive health path) ----
+    def _snapshot(self, scope):
+        """By-reference snapshot of every written persistable, the lod
+        table, and the program's RNG run counter — everything a rollback
+        must restore."""
+        prog = getattr(self._program, '_program', self._program)
+        state = {}
+        for n in self._written_names():
+            if scope.has(n):
+                state[n] = scope.get(n)
+        return {'state': state,
+                'lods': dict(getattr(scope, '_lods', {})),
+                'rng': int(getattr(prog, '_rng_run_counter', 0) or 0)}
+
+    def _restore(self, scope, snap):
+        """Roll the scope back to a _snapshot and REWIND the RNG run
+        counter (the checkpoint-restore rewind rule): the retried step
+        replays the same dropout stream the rolled-back step consumed,
+        so a guarded trajectory with a skipped step is bit-identical to
+        an unguarded one over the same good batches. The failed step's
+        own key stays on program._last_run_key for NaN localization."""
+        scope.update(snap['state'])
+        scope._lods = snap['lods']
+        # drop state the bad step CREATED (not present pre-step): a
+        # half-written first step must not survive the rollback
+        for n in self._written_names():
+            if n not in snap['state'] and scope.has(n):
+                scope.drop(n)
+        prog = getattr(self._program, '_program', self._program)
+        prog._rng_run_counter = snap['rng']
+
+    def stats(self):
+        """Loop-surface stats block; ['health'] carries the observatory
+        view when health mode is on (None otherwise)."""
+        out = {'bad_steps': self.bad_steps,
+               'total_skipped': self.total_skipped,
+               'last_step_skipped': self.last_step_skipped,
+               'health_mode': self.health_mode,
+               'health': None}
+        if self.health_mode:
+            from . import health as _health_mod
+            out['health'] = _health_mod.stats(
+                getattr(self._program, '_program', self._program))
+        return out
+
     def step(self, feed=None, fetch_list=None, **run_kw):
         """One guarded executor run; returns the fetches of the requested
         fetch_list (loss is fetched internally when not already listed).
@@ -739,14 +803,19 @@ class TrainingGuard(object):
         extra_loss = (self._loss_name is not None
                       and self._loss_name not in names)
         run_fetch = fetch_list + ([self._loss_name] if extra_loss else [])
+        health_fetch = None
+        if self.health_mode:
+            from . import health as _health_mod
+            hf = _health_mod.fetch_name(
+                getattr(self._program, '_program', self._program))
+            if hf and hf not in names:
+                health_fetch = hf
+                run_fetch = run_fetch + [hf]
 
-        snap = {}
-        for n in self._written_names():
-            if scope.has(n):
-                snap[n] = scope.get(n)
-        snap_lods = dict(getattr(scope, '_lods', {}))
+        snap = self._snapshot(scope)
 
         bad = False
+        raised = False
         run_localization = None     # executor-side provenance, if it ran
         fetches = []
         # donation off for THIS call only (the rollback snapshot must
@@ -764,6 +833,7 @@ class TrainingGuard(object):
                     'NaN/Inf' not in str(e):
                 raise
             bad = True
+            raised = True
             run_localization = getattr(e, 'nonfinite_localization', None)
             # the raise swallowed the fetch values; keep the
             # documented "bad values for logging" return shape with
@@ -783,20 +853,34 @@ class TrainingGuard(object):
                     _finite(scope.get(n)) for n in self._written_names()
                     if scope.has(n))
 
-        if bad:
-            scope.update(snap)
-            scope._lods = snap_lods
-            # drop state the bad step CREATED (not present pre-step): a
-            # half-written first step must not survive the rollback
-            for n in self._written_names():
-                if n not in snap and scope.has(n):
-                    scope.drop(n)
+        # health observatory: decode the stat vector the step already
+        # fetched (skip the raise path — its fetches are NaN stand-ins,
+        # not real values) and collect the detector verdicts
+        detected = ()
+        preempt = False
+        if health_fetch and not raised and fetches:
+            from . import health as _health_mod
+            detected = _health_mod.observe(
+                getattr(self._program, '_program', self._program),
+                fetches[-1])
+            if not bad and self.health_mode == 'preempt' and \
+                    any(k in _health_mod.PREEMPT_KINDS for k in detected):
+                # confirmed divergence while everything is still finite:
+                # roll back NOW, before the NaN destroys the evidence
+                preempt = True
+                monitor.inc('health_preempt_rollback_total')
+
+        if bad or preempt:
+            self._restore(scope, snap)
             # opt-in NaN provenance (PADDLE_NAN_LOCALIZE=1): reuse the
             # localization the executor's check_nan_inf path already paid
             # for when it raised; otherwise replay the failed step against
             # the just-restored pre-step state, with the SAME rng key, and
             # record which op went non-finite first
-            if run_localization is not None:
+            if preempt:
+                # nothing is non-finite yet — there is no NaN to localize
+                self.last_localization = None
+            elif run_localization is not None:
                 self.last_localization = run_localization
             else:
                 from . import analysis
@@ -809,7 +893,8 @@ class TrainingGuard(object):
             self.total_skipped += 1
             self._good_streak = 0
             self.last_step_skipped = True
-            monitor.inc('nonfinite_skip_total')
+            if not preempt:
+                monitor.inc('nonfinite_skip_total')
             if self.bad_steps >= self.max_bad_steps:
                 monitor.inc('nonfinite_escalate_total')
                 from . import analysis
@@ -822,8 +907,13 @@ class TrainingGuard(object):
                     # rolled-back PRE-step state and the program still has
                     # the failed step's rng key — exactly what
                     # localize_from_scope (and tools/blackbox.py replay)
-                    # re-executes
+                    # re-executes. With the health observatory on, the
+                    # bundle also embeds the per-layer stat history.
                     prog = getattr(self._program, '_program', self._program)
+                    extra = {}
+                    if self.health_mode:
+                        from . import health as _health_mod
+                        extra['health'] = _health_mod.stats(prog)
                     blackbox.record(
                         'nonfinite_escalate', program=prog, feed=feed,
                         state={n: scope.get(n) for n in scope.names()},
@@ -831,13 +921,15 @@ class TrainingGuard(object):
                         key_arr=getattr(prog, '_last_run_key', None),
                         localization=self.last_localization,
                         bad_steps=self.bad_steps,
-                        loss=self._loss_name)
+                        loss=self._loss_name, **extra)
                 raise NonFiniteError(
-                    "TrainingGuard: %d consecutive non-finite steps "
+                    "TrainingGuard: %d consecutive %s steps "
                     "(loss %r) — the optimizer update was skipped each "
                     "time; inspect the data pipeline / lower the learning "
                     "rate / check loss scaling%s"
                     % (self.bad_steps,
+                       'non-finite' if not preempt
+                       else 'diverging (health-preempted)',
                        self._loss_name or '<unnamed>', where))
         else:
             self.bad_steps = 0
@@ -848,7 +940,9 @@ class TrainingGuard(object):
                     self._good_streak % self.growth_interval == 0:
                 self._scale_adjust(scope, self.growth_factor)
 
-        return fetches[:len(fetch_list)] if extra_loss else fetches
+        if extra_loss or health_fetch:
+            return fetches[:len(fetch_list)]
+        return fetches
 
 
 # ---------------------------------------------------------------------------

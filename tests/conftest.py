@@ -39,7 +39,8 @@ def pytest_collection_modifyitems(config, items):
     for fname in ('test_generate.py', 'test_paged_generate.py',
                   'test_speculative.py', 'test_goodput.py',
                   'test_ffn_tail.py', 'test_blackbox.py',
-                  'test_obslint.py', 'test_ps.py', 'test_fleet.py'):
+                  'test_obslint.py', 'test_ps.py', 'test_fleet.py',
+                  'test_health.py'):
         gen = [it for it in items
                if os.path.basename(str(it.fspath)) == fname]
         if gen:

@@ -1,0 +1,513 @@
+"""Training-health observatory (paddle_tpu/health.py,
+tools/healthreport.py, TrainingGuard health modes).
+
+Load-bearing contracts:
+
+- each detector kind trips on a crafted series, with goodput-style
+  frozen-baseline + cooldown semantics (baseline freezes after
+  min_samples; the counter/trace/bundle side effects respect the
+  cooldown while the returned verdicts do not);
+- instrumenting a program adds ONE constant extra fetch: zero recompiles
+  after warmup at the guarded-loop surface, and the disabled hot path
+  (enabled() + fetch_name()) stays <= 5 us (min-of-per-call, gc off —
+  the PR 9/14 guard methodology, interleaved minima);
+- the seeded-divergence drill: an oversized-LR MLP trips grad_explosion
+  / loss_spike >= 1 step BEFORE the loss goes non-finite, publishes a
+  training_anomaly bundle carrying the per-layer stat table + history
+  ring, and TrainingGuard(health='preempt') keeps the whole trajectory
+  finite via the shared snapshot/rollback;
+- a guarded rollback REWINDS the RNG run counter (the checkpoint-rewind
+  rule): a trajectory with a skipped bad step replays bit-identically to
+  the unguarded trajectory over the same good batches, dropout included;
+- healthreport renders trajectories/anomalies/bundles from snapshot
+  logs; obsreport/tracereport pick training_anomaly pointers up
+  generically.
+
+The full LM drill (activation taps on build_lm residual streams, remat
+interplay) is @slow; tier-1 keeps the fast MLP variants (conftest
+asserts this file's marker split).
+"""
+import gc
+import itertools
+import json
+import os
+import time
+import types
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import blackbox, health, monitor, resilience
+
+
+@pytest.fixture(autouse=True)
+def _fresh_health():
+    health.reset()
+    yield
+    health.reset()
+
+
+@pytest.fixture
+def bb(tmp_path, monkeypatch):
+    d = str(tmp_path / 'bb')
+    monkeypatch.setenv('PADDLE_BLACKBOX', '1')
+    monkeypatch.setenv('PADDLE_BLACKBOX_DIR', d)
+    monkeypatch.setenv('PADDLE_BLACKBOX_RATE', '0')
+    blackbox.reset()
+    yield d
+    blackbox.flush(10.0)
+    blackbox.reset()
+
+
+# ---------------------------------------------------------------------------
+# detector units on a stub program (no compile: observe() is pure host)
+
+_uid_gen = itertools.count(10 ** 9)
+
+
+def _stub(n_params=1, with_loss=True, acts=0):
+    entries = []
+    params = ['p%d' % i for i in range(n_params)]
+    for p in params:
+        entries.append(('grad_norm', p))
+    for p in params:
+        entries.append(('upd_ratio', p))
+    for i in range(acts):
+        entries.append(('act_rms', 'site%d' % i))
+    entries += [('grad_norm_global', ''), ('param_norm_global', ''),
+                ('nonfinite', ''), ('large', '')]
+    if with_loss:
+        entries.append(('loss', ''))
+    sch = {'fetch': health.FETCH_NAME, 'entries': entries,
+           'params': params, 'acts': ['site%d' % i for i in range(acts)],
+           'loss': 'loss' if with_loss else None}
+    return types.SimpleNamespace(_uid=next(_uid_gen), _health_schema=sch)
+
+
+def _vec(prog, grad=1.0, ratio=1e-3, act=1.0, pnorm=10.0, nonfinite=0.0,
+         large=0.0, loss=1.0):
+    out = []
+    for kind, _label in prog._health_schema['entries']:
+        out.append({'grad_norm': grad, 'upd_ratio': ratio, 'act_rms': act,
+                    'grad_norm_global': grad, 'param_norm_global': pnorm,
+                    'nonfinite': nonfinite, 'large': large,
+                    'loss': loss}[kind])
+    return np.asarray(out, dtype=np.float32)
+
+
+def _anomaly_count(kind):
+    return monitor.counters().get(
+        'health_anomaly_total{kind=%s}' % kind, 0)
+
+
+def test_grad_explosion_trips_after_frozen_baseline(monkeypatch):
+    monkeypatch.setenv('PADDLE_HEALTH_MIN_SAMPLES', '3')
+    monkeypatch.setenv('PADDLE_HEALTH_COOLDOWN_S', '0')
+    prog = _stub()
+    before = _anomaly_count('grad_explosion')
+    for _ in range(3):
+        assert 'grad_explosion' not in health.observe(prog, _vec(prog))
+    # baseline frozen at 1.0; default threshold 8x
+    assert 'grad_explosion' not in health.observe(prog, _vec(prog, grad=7.0))
+    detected = health.observe(prog, _vec(prog, grad=9.0))
+    assert 'grad_explosion' in detected
+    assert _anomaly_count('grad_explosion') == before + 1
+    # the anomaly log carries value + baseline
+    an = [a for a in health.stats(prog)['anomalies']
+          if a['kind'] == 'grad_explosion']
+    assert an and an[-1]['value'] == 9.0 and an[-1]['baseline'] == 1.0
+
+
+def test_grad_vanish_uses_ewma_not_instant(monkeypatch):
+    monkeypatch.setenv('PADDLE_HEALTH_MIN_SAMPLES', '2')
+    monkeypatch.setenv('PADDLE_HEALTH_COOLDOWN_S', '0')
+    monkeypatch.setenv('PADDLE_HEALTH_EWMA', '0.5')
+    prog = _stub()
+    for _ in range(2):
+        health.observe(prog, _vec(prog, grad=1.0))
+    # one tiny reading: EWMA ~0.5 — above the 0.05 * baseline floor
+    assert 'grad_vanish' not in health.observe(prog, _vec(prog, grad=1e-9))
+    # sustained collapse drags the EWMA under the floor
+    det = ()
+    for _ in range(5):
+        det = health.observe(prog, _vec(prog, grad=1e-9))
+    assert 'grad_vanish' in det
+    assert _anomaly_count('grad_vanish') >= 1
+
+
+def test_loss_spike_and_update_ratio_drift(monkeypatch):
+    monkeypatch.setenv('PADDLE_HEALTH_MIN_SAMPLES', '2')
+    monkeypatch.setenv('PADDLE_HEALTH_COOLDOWN_S', '0')
+    monkeypatch.setenv('PADDLE_HEALTH_EWMA', '0.9')
+    monkeypatch.setenv('PADDLE_HEALTH_RATIO_DRIFT', '4')
+    prog = _stub()
+    for _ in range(2):
+        health.observe(prog, _vec(prog, loss=2.0, ratio=1e-3))
+    det = health.observe(prog, _vec(prog, loss=7.0, ratio=1e-3))
+    assert 'loss_spike' in det          # 7 > 2 * 3.0 default
+    det = health.observe(prog, _vec(prog, loss=2.0, ratio=0.5))
+    assert 'update_ratio_drift' in det  # ewma ~0.45 > 1e-3 * 4
+    assert _anomaly_count('loss_spike') >= 1
+    assert _anomaly_count('update_ratio_drift') >= 1
+
+
+def test_nonfinite_rate_immediate_no_baseline(monkeypatch):
+    monkeypatch.setenv('PADDLE_HEALTH_COOLDOWN_S', '0')
+    prog = _stub()
+    det = health.observe(prog, _vec(prog, nonfinite=3.0))
+    assert 'nonfinite_rate' in det      # first step, no baseline needed
+    assert _anomaly_count('nonfinite_rate') >= 1
+
+
+def test_frozen_baseline_and_cooldown_semantics(monkeypatch):
+    """The baseline freezes after min_samples (later calm readings do
+    not drag it); within the cooldown the verdict is still returned but
+    the counter/bundle side effects fire once — goodput._trip parity."""
+    monkeypatch.setenv('PADDLE_HEALTH_MIN_SAMPLES', '2')
+    monkeypatch.setenv('PADDLE_HEALTH_COOLDOWN_S', '600')
+    prog = _stub()
+    for _ in range(2):
+        health.observe(prog, _vec(prog, grad=1.0))
+    for _ in range(10):     # calm readings after the freeze
+        health.observe(prog, _vec(prog, grad=0.5))
+    before = _anomaly_count('grad_explosion')
+    # 8x the FROZEN baseline (1.0), not 8x the recent 0.5 stream
+    det1 = health.observe(prog, _vec(prog, grad=9.0))
+    det2 = health.observe(prog, _vec(prog, grad=9.0))
+    assert 'grad_explosion' in det1 and 'grad_explosion' in det2
+    assert _anomaly_count('grad_explosion') == before + 1   # cooldown
+
+
+# ---------------------------------------------------------------------------
+# instrumentation + guarded-loop surface
+
+
+def _mlp(lr=0.1, dropout=0.0, seed=0):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        h = fluid.layers.fc(input=x, size=8, act='tanh')
+        if dropout:
+            h = fluid.layers.dropout(h, dropout_prob=dropout,
+                                     is_test=False)
+        y = fluid.layers.fc(input=h, size=4)
+        loss = fluid.layers.mean(fluid.layers.elementwise_mul(y, y))
+        fluid.optimizer.SGDOptimizer(learning_rate=lr).minimize(loss)
+    return main, startup, loss
+
+
+def _feeds(n, batch=8, seed=0):
+    rng = np.random.RandomState(seed)
+    return [{'x': rng.randn(batch, 4).astype('float32')}
+            for _ in range(n)]
+
+
+def test_instrument_zero_recompile_and_stats_surface():
+    main, startup, loss = _mlp()
+    exe, scope = fluid.Executor(), fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        guard = resilience.TrainingGuard(exe, main, loss_name=loss.name,
+                                         scope=scope, health='watch')
+        sch = main._health_schema
+        kinds = [k for k, _l in sch['entries']]
+        assert kinds.count('grad_norm') == 4        # 2 fc: w + b each
+        assert kinds.count('upd_ratio') == 4
+        assert 'loss' in kinds and 'nonfinite' in kinds
+        feeds = _feeds(5)
+        out = guard.step(feed=feeds[0], fetch_list=[loss.name])
+        assert len(out) == 1                        # health fetch stripped
+        warm = monitor.counters().get('compile_cache_miss', 0)
+        for f in feeds[1:]:
+            guard.step(feed=f, fetch_list=[loss.name])
+        assert monitor.counters().get('compile_cache_miss', 0) == warm
+        st = guard.stats()
+        assert st['health_mode'] == 'watch'
+        assert st['health']['steps'] == 5
+        assert len(st['health']['history']) == 5
+        gauges = monitor.snapshot()['gauges']
+        assert 'health_grad_norm_global' in gauges
+        assert 'health_loss' in gauges
+        assert any(k.startswith('health_grad_norm{param=')
+                   for k in gauges)
+        # instrumentation is idempotent
+        assert health.instrument(main) is sch
+        # and the goodput stats() view nests the health block
+        from paddle_tpu import goodput
+        assert goodput.stats()['health']['steps'] == 5
+
+
+def test_disabled_path_overhead_guard(monkeypatch):
+    """PR 14 hot-path discipline: with health off, the per-dispatch host
+    hook (enabled() + fetch_name()) costs <= 5 us. Interleaved on/off
+    minima, gc disabled, min-of-per-call — the goodput guard method."""
+    prog = types.SimpleNamespace()      # uninstrumented program
+    n = 3000
+    best_on = best_off = float('inf')
+    gc.disable()
+    try:
+        for i in range(n):
+            if i % 2 == 0:
+                monkeypatch.setenv('PADDLE_HEALTH', '1')
+                t0 = time.perf_counter()
+                health.enabled()
+                health.fetch_name(prog)
+                best_on = min(best_on, time.perf_counter() - t0)
+            else:
+                monkeypatch.delenv('PADDLE_HEALTH', raising=False)
+                t0 = time.perf_counter()
+                health.enabled()
+                health.fetch_name(prog)
+                best_off = min(best_off, time.perf_counter() - t0)
+    finally:
+        gc.enable()
+    assert best_on <= 5e-6, best_on
+    assert best_off <= 5e-6, best_off
+
+
+# ---------------------------------------------------------------------------
+# seeded-divergence drill (fast variant; the LM drill is @slow)
+
+
+def test_divergence_drill_detects_before_nonfinite(monkeypatch, bb):
+    """Watch mode on an oversized-LR MLP: the detector fires while the
+    loss is still finite, >= 1 step before the first non-finite step,
+    and publishes a training_anomaly bundle with the per-layer table."""
+    monkeypatch.setenv('PADDLE_HEALTH_MIN_SAMPLES', '2')
+    monkeypatch.setenv('PADDLE_HEALTH_EXPLODE', '5')
+    monkeypatch.setenv('PADDLE_HEALTH_COOLDOWN_S', '0')
+    main, startup, loss = _mlp(lr=40.0)
+    exe, scope = fluid.Executor(), fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        guard = resilience.TrainingGuard(exe, main, loss_name=loss.name,
+                                         scope=scope, health='watch',
+                                         max_bad_steps=100)
+        first_anomaly = first_nonfinite = None
+        for i, f in enumerate(_feeds(30)):
+            out = guard.step(feed=f, fetch_list=[loss.name])
+            val = float(np.asarray(out[0]).ravel()[0])
+            st = health.stats(main)
+            if first_anomaly is None and st['anomalies']:
+                first_anomaly = i
+                assert np.isfinite(val)     # fired BEFORE the NaN
+            if first_nonfinite is None and not np.isfinite(val):
+                first_nonfinite = i
+                break
+        assert first_anomaly is not None
+        assert first_nonfinite is None or first_anomaly < first_nonfinite
+    assert blackbox.flush(10.0)
+    mans = [json.load(open(os.path.join(b, 'manifest.json')))
+            for b in blackbox.bundles(bb)]
+    anomalies = [m for m in mans if m.get('kind') == 'training_anomaly']
+    assert anomalies
+    trig = anomalies[0]['trigger']
+    assert trig['anomaly'] in ('grad_explosion', 'loss_spike')
+    assert any(k.startswith('grad_norm:') for k in trig['table'])
+    assert trig['history'] and 'grad_norm_global' in trig['history'][-1]
+
+
+def test_preemptive_rollback_keeps_trajectory_finite(monkeypatch, bb):
+    monkeypatch.setenv('PADDLE_HEALTH_MIN_SAMPLES', '2')
+    monkeypatch.setenv('PADDLE_HEALTH_EXPLODE', '5')
+    monkeypatch.setenv('PADDLE_HEALTH_COOLDOWN_S', '0')
+    main, startup, loss = _mlp(lr=40.0)
+    exe, scope = fluid.Executor(), fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        guard = resilience.TrainingGuard(exe, main, loss_name=loss.name,
+                                         scope=scope, health='preempt',
+                                         max_bad_steps=100)
+        pre_rb = monitor.counters().get('health_preempt_rollback_total', 0)
+        pre_nf = monitor.counters().get('nonfinite_skip_total', 0)
+        losses, skipped = [], 0
+        for f in _feeds(10):
+            out = guard.step(feed=f, fetch_list=[loss.name])
+            losses.append(float(np.asarray(out[0]).ravel()[0]))
+            skipped += bool(guard.last_step_skipped)
+        assert all(np.isfinite(l) for l in losses)      # never went NaN
+        assert skipped >= 1                             # and it rolled back
+        assert monitor.counters().get(
+            'health_preempt_rollback_total', 0) > pre_rb
+        # the NaN counter stayed clean — these were PREEMPTIVE skips
+        assert monitor.counters().get('nonfinite_skip_total', 0) == pre_nf
+
+
+def test_guarded_rollback_replays_rng_bit_identical():
+    """The rewind rule (satellite): a rolled-back step must not consume
+    an RNG draw — the guarded trajectory with one injected bad step is
+    bit-identical to the clean trajectory over the same good batches,
+    dropout included."""
+    feeds = _feeds(3, seed=3)
+    bad = {'x': np.full((8, 4), np.nan, dtype='float32')}
+
+    def _run(inject_bad):
+        with fluid.unique_name.guard():
+            return _run_inner(inject_bad)
+
+    def _run_inner(inject_bad):
+        main, startup, loss = _mlp(lr=0.1, dropout=0.5, seed=11)
+        exe, scope = fluid.Executor(), fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup, scope=scope)
+            guard = resilience.TrainingGuard(
+                exe, main, loss_name=loss.name, scope=scope,
+                max_bad_steps=5)
+            guard.step(feed=feeds[0], fetch_list=[loss.name])
+            if inject_bad:
+                guard.step(feed=bad, fetch_list=[loss.name])
+                assert guard.last_step_skipped
+            for f in feeds[1:]:
+                guard.step(feed=f, fetch_list=[loss.name])
+                assert not guard.last_step_skipped
+            params = {p.name: np.asarray(scope.get(p.name))
+                      for p in main.global_block().all_parameters()}
+        return params, main._rng_run_counter
+
+    clean, clean_runs = _run(inject_bad=False)
+    guarded, guarded_runs = _run(inject_bad=True)
+    assert clean_runs == guarded_runs       # the bad step was rewound
+    assert set(clean) == set(guarded)
+    for name in clean:
+        assert np.array_equal(clean[name], guarded[name]), name
+
+
+# ---------------------------------------------------------------------------
+# report tooling pickup (healthreport + the generic obs/trace readers)
+
+
+def _snapshot_line(step, grad, loss_v, anomalies=0):
+    g = {'health_grad_norm{param=fc_0.w_0}': grad,
+         'health_grad_norm{param=fc_1.w_0}': grad * 0.5,
+         'health_act_rms{site=layer_0}': 1.0,
+         'health_grad_norm_global': grad * 1.2,
+         'health_param_norm_global': 3.0,
+         'health_update_ratio': 1e-3,
+         'health_loss': loss_v}
+    c = {}
+    if anomalies:
+        c['health_anomaly_total{kind=grad_explosion}'] = anomalies
+    return {'ts': 1000.0 + step, 'counters': c, 'gauges': g}
+
+
+def test_healthreport_trajectories_anomalies_bundles(tmp_path, capsys):
+    from tools import healthreport
+    log = tmp_path / 'run.jsonl'
+    lines = [
+        _snapshot_line(0, 1.0, 2.0),
+        {'trace_id': 'aaaa', 'event': 'health_anomaly',
+         'anomaly': 'grad_explosion', 'value': 9.0, 'baseline': 1.0,
+         'ts': 1001.0},
+        _snapshot_line(1, 9.0, 7.0, anomalies=1),
+        {'blackbox_bundle': '/tmp/bb/training_anomaly-1',
+         'kind': 'training_anomaly', 'ts': 1002.0, 'trace_id': 'aaaa'},
+        {'blackbox_bundle': '/tmp/bb/step_drift-1',
+         'kind': 'step_drift', 'ts': 1003.0, 'trace_id': 'bbbb'},
+    ]
+    log.write_text('\n'.join(json.dumps(l) for l in lines) + '\n')
+    snaps, events, bundles = healthreport.read_log(str(log))
+    assert len(snaps) == 2 and len(events) == 1
+    assert [b['blackbox_bundle'] for b in bundles] == \
+        ['/tmp/bb/training_anomaly-1']       # only training_anomaly kind
+    rep = healthreport.report_from_logs([snaps], events, bundles)
+    row = {r['label']: r for r in rep['grad_norms']}['fc_0.w_0']
+    assert row['first'] == 1.0 and row['last'] == 9.0 and row['n'] == 2
+    assert rep['anomaly_counts'] == {'grad_explosion': 1}
+    assert rep['global']['health_loss'] == 7.0
+    healthreport.main([str(log)])
+    out = capsys.readouterr().out
+    assert 'fc_0.w_0' in out and 'grad_explosion' in out
+    assert 'training_anomaly-1' in out
+    healthreport.main(['--merge', str(log), str(log), '--json'])
+    merged = json.loads(capsys.readouterr().out)
+    assert merged['ranks'] == 2
+    assert merged['anomaly_counts'] == {'grad_explosion': 2}
+
+
+def test_obs_tools_pick_up_training_anomaly_bundle(bb, monkeypatch,
+                                                   tmp_path, capsys):
+    """Satellite check: the generic pointer-line readers (obsreport
+    --bundles, tracereport) surface training_anomaly bundles without any
+    kind-specific filter."""
+    log = str(tmp_path / 'trace.jsonl')
+    monkeypatch.setenv('PADDLE_TRACE_LOG', log)
+    monkeypatch.setenv('PADDLE_HEALTH_MIN_SAMPLES', '1')
+    monkeypatch.setenv('PADDLE_HEALTH_COOLDOWN_S', '0')
+    prog = _stub()
+    health.observe(prog, _vec(prog, grad=1.0))
+    assert 'grad_explosion' in health.observe(prog, _vec(prog, grad=100.0))
+    assert blackbox.flush(10.0)
+    bundle = [b for b in blackbox.bundles(bb)
+              if 'training_anomaly' in os.path.basename(b)]
+    assert bundle
+    import tools.obsreport as obs
+    import tools.tracereport as tr
+    with open(log) as f:
+        recs = [json.loads(line) for line in f if line.strip()]
+    pointers = [r for r in recs if 'blackbox_bundle' in r]
+    assert pointers and pointers[0]['kind'] == 'training_anomaly'
+    assert obs._is_bundle_pointer(pointers[0])
+    _traces, _events, bundles = tr.read_records([log])
+    assert any(b.get('kind') == 'training_anomaly' for b in bundles)
+    obs.print_bundles([log])
+    assert 'training_anomaly' in capsys.readouterr().out
+    # the always-kept anomaly event landed on the same channel
+    assert any(r.get('event') == 'health_anomaly' for r in recs)
+
+
+# ---------------------------------------------------------------------------
+# heavy drill (nightly): full LM with activation taps + remat interplay
+
+
+@pytest.mark.slow
+def test_lm_drill_activation_taps_and_preempt(monkeypatch, bb):
+    """build_lm end-to-end: residual-stream taps surface as
+    health_act_rms{site} gauges, the oversized-LR run trips a detector
+    and stays finite under preemptive rollback, with zero recompiles
+    after warmup."""
+    monkeypatch.setenv('PADDLE_HEALTH_MIN_SAMPLES', '2')
+    monkeypatch.setenv('PADDLE_HEALTH_EXPLODE', '5')
+    monkeypatch.setenv('PADDLE_HEALTH_COOLDOWN_S', '0')
+    from paddle_tpu.models import transformer
+    cfg = transformer.LMConfig(vocab_size=64, seq_len=16, d_model=32,
+                               n_head=4, n_layer=2, d_ff=64, dropout=0.1)
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 5
+    with fluid.program_guard(main, startup):
+        tokens, labels, _logits, loss = transformer.build_lm(cfg)
+        fluid.optimizer.SGDOptimizer(learning_rate=500.0).minimize(loss)
+    assert len(main._health_act_taps) == 2
+    exe, scope = fluid.Executor(), fluid.Scope()
+    rng = np.random.RandomState(0)
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+        guard = resilience.TrainingGuard(exe, main, loss_name=loss.name,
+                                         scope=scope, health='preempt',
+                                         max_bad_steps=100)
+        sch = main._health_schema
+        assert [l for k, l in sch['entries'] if k == 'act_rms'] == \
+            list(main._health_act_taps)
+        losses = []
+        warm = None
+        for i in range(8):
+            feed = {'tokens': rng.randint(0, 64, (4, 16)).astype('int64'),
+                    'labels': rng.randint(0, 64, (4, 16)).astype('int64')}
+            out = guard.step(feed=feed, fetch_list=[loss.name])
+            losses.append(float(np.asarray(out[0]).ravel()[0]))
+            if i == 0:
+                warm = monitor.counters().get('compile_cache_miss', 0)
+        assert monitor.counters().get('compile_cache_miss', 0) == warm
+        assert all(np.isfinite(l) for l in losses)
+        st = health.stats(main)
+        assert st['anomalies']
+        gauges = monitor.snapshot()['gauges']
+        assert any(k.startswith('health_act_rms{site=') for k in gauges)
+    assert blackbox.flush(10.0)
+    mans = [json.load(open(os.path.join(b, 'manifest.json')))
+            for b in blackbox.bundles(bb)]
+    anomalies = [m for m in mans if m.get('kind') == 'training_anomaly']
+    assert anomalies
+    assert any(k.startswith('act_rms:')
+               for k in anomalies[0]['trigger']['table'])

@@ -46,6 +46,7 @@ def iter_api():
         ('paddle_tpu.trace', fluid.trace),
         ('paddle_tpu.analysis', fluid.analysis),
         ('paddle_tpu.goodput', fluid.goodput),
+        ('paddle_tpu.health', fluid.health),
         ('paddle_tpu.blackbox', fluid.blackbox),
         ('paddle_tpu.resilience', fluid.resilience),
         ('paddle_tpu.evaluator', fluid.evaluator),

@@ -1,0 +1,221 @@
+"""Training-health observatory report over monitor snapshot logs.
+
+Reads the same JSON-lines channel as ``tools/perfwatch.py`` /
+``tools/obsreport.py`` (``FLAGS_monitor_log``; the health layer's gauges
+and counters land in every snapshot) and prints the model-dynamics view:
+
+- per-parameter gradient-norm trajectory table (first/last/min/max over
+  every snapshot in the log — the divergence shape at a glance);
+- activation-RMS trajectory per tagged site (``health_act_rms{site}``);
+- latest global stats: global grad norm, global param norm, update/param
+  ratio, loss;
+- the anomaly log: ``health_anomaly_total{kind}`` counts plus the
+  ``health_anomaly`` trace events the detector bank wrote on the same
+  channel (keep-errors — present even at 0% trace sampling);
+- ``training_anomaly`` flight-recorder bundle pointers, newest last.
+
+Fleet mode: ``--merge`` aggregates EACH rank-suffixed log
+(``distributed.launch`` writes ``<path>.rank<N>``): anomaly counters sum,
+trajectories and events pool across ranks.
+
+Usage:
+    python tools/healthreport.py runlog.jsonl
+    python tools/healthreport.py --merge runlog.jsonl.rank0 runlog.jsonl.rank1
+    python tools/healthreport.py runlog.jsonl --json
+"""
+import argparse
+import json
+import sys
+
+
+def _parse_labeled(key):
+    """'name{k=v,k2=v2}' -> (name, {k: v}); plain names get {}."""
+    if '{' not in key:
+        return key, {}
+    name, rest = key.split('{', 1)
+    rest = rest.rstrip('}')
+    labels = {}
+    for part in rest.split(','):
+        if '=' in part:
+            k, v = part.split('=', 1)
+            labels[k] = v
+    return name, labels
+
+
+def read_log(path):
+    """(snapshots, health_anomaly events, training_anomaly bundle
+    pointers) from one log file. Snapshot lines have no trace_id; the
+    detector bank's events carry ``event == 'health_anomaly'``; bundle
+    pointers carry a ``blackbox_bundle`` path."""
+    snaps, events, bundles = [], [], []
+    with open(path) as f:
+        for line in f:
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if not isinstance(rec, dict):
+                continue
+            if rec.get('event') == 'health_anomaly':
+                events.append(rec)
+            elif 'blackbox_bundle' in rec:
+                if rec.get('kind') == 'training_anomaly':
+                    bundles.append(rec)
+            elif 'trace_id' not in rec:
+                snaps.append(rec)
+    if not snaps and not events and not bundles:
+        raise SystemExit('%s: no health data (no snapshot lines, '
+                         'anomaly events, or bundle pointers)' % path)
+    return snaps, events, bundles
+
+
+def _trajectories(snaps, series, label_key):
+    """Per-label first/last/min/max rows for one gauge series across a
+    snapshot sequence (snapshots are appended in time order)."""
+    rows = {}
+    for s in snaps:
+        for k, v in (s.get('gauges') or {}).items():
+            name, labels = _parse_labeled(k)
+            if name != series:
+                continue
+            lab = labels.get(label_key, '?')
+            r = rows.get(lab)
+            if r is None:
+                rows[lab] = {'label': lab, 'first': v, 'last': v,
+                             'min': v, 'max': v, 'n': 1}
+            else:
+                r['last'] = v
+                r['min'] = min(r['min'], v)
+                r['max'] = max(r['max'], v)
+                r['n'] += 1
+    return sorted(rows.values(), key=lambda r: r['label'])
+
+
+def _anomaly_counts(snaps):
+    # counters are cumulative within one rank's log: the newest snapshot
+    # that carries the series holds that rank's totals
+    out = {}
+    for s in reversed(snaps):
+        for k, v in (s.get('counters') or {}).items():
+            name, labels = _parse_labeled(k)
+            if name == 'health_anomaly_total':
+                kind = labels.get('kind', '?')
+                if kind not in out:
+                    out[kind] = int(v)
+        if out:
+            break
+    return out
+
+
+def report_from_logs(logs, events=(), bundles=()):
+    """One aggregated report dict from >= 1 (per-rank) snapshot lists."""
+    grad = []
+    acts = []
+    counts = {}
+    glob_last = {}
+    for snaps in logs:
+        grad.extend(_trajectories(snaps, 'health_grad_norm', 'param'))
+        acts.extend(_trajectories(snaps, 'health_act_rms', 'site'))
+        for kind, v in _anomaly_counts(snaps).items():
+            counts[kind] = counts.get(kind, 0) + v
+        for s in snaps:
+            g = s.get('gauges') or {}
+            for name in ('health_grad_norm_global',
+                         'health_param_norm_global',
+                         'health_update_ratio', 'health_loss'):
+                if name in g:
+                    glob_last[name] = g[name]
+    return {
+        'ranks': len(logs),
+        'grad_norms': grad,
+        'act_rms': acts,
+        'global': glob_last,
+        'anomaly_counts': counts,
+        'anomaly_events': list(events),
+        'bundles': [{'path': b.get('blackbox_bundle'),
+                     'ts': b.get('ts')} for b in bundles],
+    }
+
+
+def _fmt(v):
+    if v is None:
+        return '-'
+    a = abs(v)
+    if a != 0 and (a < 1e-3 or a >= 1e5):
+        return '%.3e' % v
+    return '%.4f' % v
+
+
+def _traj_table(w, title, rows):
+    if not rows:
+        return
+    w('\n%s:\n' % title)
+    width = max(len(r['label']) for r in rows)
+    w('  %-*s %12s %12s %12s %12s %6s\n'
+      % (width, 'name', 'first', 'last', 'min', 'max', 'snaps'))
+    for r in rows:
+        w('  %-*s %12s %12s %12s %12s %6d\n'
+          % (width, r['label'], _fmt(r['first']), _fmt(r['last']),
+             _fmt(r['min']), _fmt(r['max']), r['n']))
+
+
+def print_report(rep, out=None):
+    w = (out or sys.stdout).write
+    w('training-health observatory — %d rank%s\n'
+      % (rep['ranks'], '' if rep['ranks'] == 1 else 's'))
+    g = rep['global']
+    if g:
+        w('  grad norm %s   param norm %s   update/param %s   loss %s\n'
+          % (_fmt(g.get('health_grad_norm_global')),
+             _fmt(g.get('health_param_norm_global')),
+             _fmt(g.get('health_update_ratio')),
+             _fmt(g.get('health_loss'))))
+    _traj_table(w, 'per-parameter gradient norms', rep['grad_norms'])
+    _traj_table(w, 'activation RMS by site', rep['act_rms'])
+    if rep['anomaly_counts'] or rep['anomaly_events']:
+        w('\nanomalies:\n')
+        for kind, n in sorted(rep['anomaly_counts'].items()):
+            w('  health_anomaly_total{kind=%s} %d\n' % (kind, n))
+        for e in rep['anomaly_events'][-20:]:
+            extras = {k: v for k, v in e.items()
+                      if k not in ('trace_id', 'event', 'ts', 'anomaly')}
+            w('  [%s] %s %s\n' % (e.get('ts'), e.get('anomaly', '?'),
+                                  json.dumps(extras, sort_keys=True)))
+    else:
+        w('\nno anomalies recorded\n')
+    if rep['bundles']:
+        w('\ntraining_anomaly bundles:\n')
+        for b in rep['bundles'][-10:]:
+            w('  %s\n' % b['path'])
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description='Training-health report over monitor snapshot logs')
+    p.add_argument('paths', nargs='+',
+                   help='JSON-lines snapshot log(s) (FLAGS_monitor_log)')
+    p.add_argument('--merge', action='store_true',
+                   help='aggregate EACH file (per-rank logs) into one '
+                        'fleet report')
+    p.add_argument('--json', action='store_true',
+                   help='print the report dict as JSON')
+    args = p.parse_args(argv)
+    if len(args.paths) > 1 and not args.merge:
+        raise SystemExit('multiple paths require --merge')
+    logs, events, bundles = [], [], []
+    for path in args.paths:
+        snaps, ev, bu = read_log(path)
+        logs.append(snaps)
+        events.extend(ev)
+        bundles.extend(bu)
+    rep = report_from_logs(logs, events, bundles)
+    if args.json:
+        print(json.dumps(rep, sort_keys=True))
+    else:
+        print_report(rep)
+
+
+if __name__ == '__main__':
+    main()
